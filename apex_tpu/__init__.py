@@ -1,0 +1,67 @@
+"""apex_tpu — TPU-native training-utilities framework with the capabilities of NVIDIA Apex.
+
+A from-scratch JAX/XLA/Pallas re-design (NOT a port) of the reference stack
+(``/root/reference``, NVIDIA Apex): declarative mixed-precision policies
+(O0-O3 semantics — ref ``apex/amp/frontend.py:102-193``), fused optimizers
+(ref ``apex/optimizers/``), fused normalization / softmax / attention kernels
+(ref ``csrc/``), data-parallel gradient sync + synchronized batch norm
+(ref ``apex/parallel/``), and Megatron-style tensor/pipeline parallelism
+(ref ``apex/transformer/``) — all expressed as mesh programs, functional
+transforms, and Pallas kernels instead of CUDA extensions and monkey-patching.
+
+Layering (mirrors SURVEY.md §1's layer map, re-drawn for TPU):
+
+=====  =============================  ==========================================
+Layer  apex_tpu module                Reference analogue
+=====  =============================  ==========================================
+L0     ``apex_tpu.ops``               ``csrc/`` CUDA kernels → Pallas / XLA
+L1     ``apex_tpu.ops.multi_tensor``  ``apex/multi_tensor_apply``
+L2     ``apex_tpu.amp``               ``apex/amp`` (+ ``apex/fp16_utils``)
+L3     ``apex_tpu.optimizers``,       ``apex/optimizers``, ``apex/normalization``,
+       ``.normalization``, ``.mlp``,  ``apex/mlp``, ``apex/fused_dense``
+       ``.fused_dense``
+L4     ``apex_tpu.parallel``          ``apex/parallel`` (DDP, SyncBN, LARC)
+L5     ``apex_tpu.transformer``       ``apex/transformer`` (TP/PP runtime)
+L6     ``apex_tpu.contrib``           ``apex/contrib``
+L7     ``apex_tpu.profiler``          ``apex/pyprof``
+=====  =============================  ==========================================
+"""
+
+from apex_tpu._logging import get_logger, RankInfoFormatter  # noqa: F401
+from apex_tpu import config  # noqa: F401
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "amp",
+    "config",
+    "contrib",
+    "fp16_utils",
+    "fused_dense",
+    "get_logger",
+    "mlp",
+    "normalization",
+    "ops",
+    "optimizers",
+    "parallel",
+    "profiler",
+    "transformer",
+    "RankInfoFormatter",
+]
+
+
+def __getattr__(name):
+    # Lazy subpackage import: keeps `import apex_tpu` cheap and avoids import
+    # cycles (the reference does conditional imports in apex/__init__.py:13-20;
+    # here nothing is conditional — every subsystem is pure JAX + optional
+    # Pallas/C++ with graceful fallbacks).
+    if name in __all__:
+        import importlib
+
+        try:
+            return importlib.import_module(f"apex_tpu.{name}")
+        except ModuleNotFoundError as e:
+            raise AttributeError(
+                f"module 'apex_tpu' has no attribute {name!r} ({e})"
+            ) from e
+    raise AttributeError(f"module 'apex_tpu' has no attribute {name!r}")

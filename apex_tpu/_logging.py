@@ -1,0 +1,62 @@
+"""Rank-aware logging.
+
+Reference analogue: ``apex/__init__.py:27-42`` installs a ``RankInfoFormatter``
+that prefixes every log record with the caller's (data-parallel, tensor-parallel,
+pipeline-parallel) rank triple obtained from ``parallel_state.get_rank_info``.
+
+On TPU the equivalent host-level identity is ``jax.process_index`` (one process
+may drive many chips); mesh-coordinate identity only exists inside a mesh
+program, so the formatter shows process index / process count plus, when a
+global mesh has been initialized (see ``apex_tpu.transformer.parallel_state``),
+the mesh axis sizes.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+
+def _rank_info() -> str:
+    try:
+        import jax
+
+        pidx, pcount = jax.process_index(), jax.process_count()
+    except Exception:  # jax not importable / not initialized yet
+        return "proc ?/?"
+    info = f"proc {pidx}/{pcount}"
+    try:
+        from apex_tpu.transformer import parallel_state
+
+        if parallel_state.model_parallel_is_initialized():
+            info += f" mesh {parallel_state.get_mesh_axes_str()}"
+    except Exception:
+        pass
+    return info
+
+
+class RankInfoFormatter(logging.Formatter):
+    """Formatter prefixing records with process/mesh identity (ref apex/__init__.py:27-35)."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        record.rank_info = _rank_info()
+        return super().format(record)
+
+
+_FORMAT = "%(asctime)s - %(name)s - %(levelname)s - [%(rank_info)s] - %(message)s"
+_configured_roots = set()
+
+
+def get_logger(name: str = "apex_tpu") -> logging.Logger:
+    """Return a rank-aware logger. The handler is installed once per top-level
+    logger hierarchy, so names outside ``apex_tpu.*`` get the rank prefix too."""
+    logger = logging.getLogger(name)
+    root_name = name.split(".", 1)[0]
+    if root_name not in _configured_roots:
+        root = logging.getLogger(root_name)
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(RankInfoFormatter(_FORMAT))
+        root.addHandler(handler)
+        root.propagate = False
+        _configured_roots.add(root_name)
+    return logger

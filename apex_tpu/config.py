@@ -1,0 +1,104 @@
+"""Unified configuration dataclasses.
+
+The reference has three separate flag systems (SURVEY.md §5): amp's
+``Properties`` policy object with consistency checks (``apex/amp/frontend.py:7-193``),
+setup.py build flags, and the Megatron global-args singleton
+(``apex/transformer/testing/arguments.py``). Here they are unified into plain
+frozen dataclasses: a :class:`MeshConfig` describing the device mesh, a
+:class:`PrecisionConfig` describing the mixed-precision policy (the O0-O3
+presets live in :mod:`apex_tpu.amp` and *produce* one of these), and a
+:class:`TransformerParallelConfig` for the Megatron-style runtime. No build
+flags exist: every subsystem is importable always, with runtime fallbacks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Logical device-mesh shape. Axes use the scaling-book convention:
+
+    - ``dp``: data parallel (outermost; rides DCN across slices, ICI within)
+    - ``pp``: pipeline stages (collective-permute neighbours over ICI)
+    - ``tp``: tensor/model parallel (innermost — highest-bandwidth ICI ring)
+    - ``sp``: sequence/context parallel (ring attention axis)
+
+    ``dp=-1`` means "all remaining devices" (resolved at mesh build time).
+    Reference analogue: the four process-group families built by
+    ``apex/transformer/parallel_state.py:57-185``.
+    """
+
+    dp: int = -1
+    pp: int = 1
+    tp: int = 1
+    sp: int = 1
+
+    def axis_sizes(self) -> Tuple[int, ...]:
+        return (self.dp, self.pp, self.sp, self.tp)
+
+
+@dataclasses.dataclass(frozen=True)
+class PrecisionConfig:
+    """Declarative mixed-precision policy — the trace-time equivalent of amp's
+    ``Properties`` (ref ``apex/amp/frontend.py:7-100``).
+
+    ``cast_model_type``      — dtype model params are cast to before forward
+                               (None = leave fp32; ref Properties.cast_model_type)
+    ``compute_dtype``        — dtype whitelisted ops (matmul/conv) run in under
+                               the O1-style autocast interpreter (None = off;
+                               ref "patch_torch_functions")
+    ``keep_batchnorm_fp32``  — keep normalization layers' math + params fp32
+                               (ref Properties.keep_batchnorm_fp32)
+    ``master_weights``       — hold an fp32 master copy of params and run the
+                               optimizer on it (ref Properties.master_weights)
+    ``loss_scale``           — float for static scale, "dynamic" for dynamic
+                               (ref Properties.loss_scale)
+    """
+
+    opt_level: str = "O0"
+    cast_model_type: Optional[jnp.dtype] = None
+    compute_dtype: Optional[jnp.dtype] = None
+    keep_batchnorm_fp32: Optional[bool] = None
+    master_weights: Optional[bool] = None
+    loss_scale: object = 1.0  # float | "dynamic"
+
+    def __post_init__(self):
+        self._check({})
+
+    def replace(self, **kw) -> "PrecisionConfig":
+        self._check(kw)
+        return dataclasses.replace(self, **kw)
+
+    def _check(self, kw) -> None:
+        # Consistency checks mirroring Properties.__setattr__ guards
+        # (apex/amp/frontend.py:40-100): O1-style per-op casting manages its
+        # own casts, so cast_model_type conflicts with compute_dtype != None.
+        compute = kw.get("compute_dtype", self.compute_dtype)
+        cast_model = kw.get("cast_model_type", self.cast_model_type)
+        if compute is not None and cast_model is not None:
+            raise ValueError(
+                "compute_dtype (O1-style per-op autocast) and cast_model_type "
+                "(O2/O3-style whole-model cast) are mutually exclusive"
+            )
+        ls = kw.get("loss_scale", self.loss_scale)
+        if not (ls == "dynamic" or isinstance(ls, (int, float))):
+            raise ValueError(f"loss_scale must be a number or 'dynamic', got {ls!r}")
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerParallelConfig:
+    """Megatron-runtime knobs (subset of ``apex/transformer/testing/arguments.py``
+    that affects the library rather than the test fixture)."""
+
+    tensor_model_parallel_size: int = 1
+    pipeline_model_parallel_size: int = 1
+    virtual_pipeline_model_parallel_size: Optional[int] = None
+    sequence_parallel_size: int = 1
+    micro_batch_size: int = 1
+    global_batch_size: int = 1
+    params_dtype: jnp.dtype = jnp.float32

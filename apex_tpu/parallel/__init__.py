@@ -1,0 +1,50 @@
+"""Data-parallel runtime (L4) — ref ``apex/parallel/__init__.py``.
+
+Exports mirror the reference surface: ``DistributedDataParallel`` (bucketed,
+overlap-friendly gradient averaging as a functional transform), ``Reducer``,
+``SyncBatchNorm`` + ``convert_syncbn_model``, ``LARC``, and mesh helpers.
+"""
+
+from apex_tpu.parallel.mesh import (  # noqa: F401
+    AXIS_ORDER,
+    DP_AXIS,
+    PP_AXIS,
+    SP_AXIS,
+    TP_AXIS,
+    build_mesh,
+)
+
+__all__ = [
+    "AXIS_ORDER",
+    "DP_AXIS",
+    "PP_AXIS",
+    "SP_AXIS",
+    "TP_AXIS",
+    "build_mesh",
+    "DistributedDataParallel",
+    "Reducer",
+    "SyncBatchNorm",
+    "convert_syncbn_model",
+    "LARC",
+]
+
+
+def __getattr__(name):
+    try:
+        if name in ("DistributedDataParallel", "Reducer"):
+            from apex_tpu.parallel import distributed
+
+            return getattr(distributed, name)
+        if name in ("SyncBatchNorm", "convert_syncbn_model"):
+            from apex_tpu.parallel import sync_batchnorm
+
+            return getattr(sync_batchnorm, name)
+        if name == "LARC":
+            from apex_tpu.parallel.larc import LARC
+
+            return LARC
+    except ModuleNotFoundError as e:
+        raise AttributeError(
+            f"module 'apex_tpu.parallel' has no attribute {name!r} ({e})"
+        ) from e
+    raise AttributeError(f"module 'apex_tpu.parallel' has no attribute {name!r}")

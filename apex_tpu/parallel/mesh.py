@@ -1,0 +1,88 @@
+"""Device-mesh construction — the TPU-native replacement for process groups.
+
+Reference analogue: ``apex/transformer/parallel_state.py:57-185`` builds four
+families of ``torch.distributed`` process groups (data-parallel, tensor-MP,
+pipeline-MP, model-parallel) by slicing the flat rank list. On TPU the single
+source of truth is one ``jax.sharding.Mesh`` with named axes; every "process
+group" becomes a named axis (or tuple of axes) passed to ``lax.psum`` /
+``all_gather`` / ``ppermute``, and "grouped" collectives (e.g. SyncBN process
+groups, ``apex/parallel/__init__.py:58-95``) become collectives over a subset
+of axes.
+
+Axis order is chosen for the hardware, innermost-last so the highest-traffic
+axis gets the fastest-varying device placement (contiguous ICI neighbours):
+``("dp", "pp", "sp", "tp")``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+# Canonical axis names, outermost → innermost.
+DP_AXIS = "dp"
+PP_AXIS = "pp"
+SP_AXIS = "sp"
+TP_AXIS = "tp"
+AXIS_ORDER: Tuple[str, ...] = (DP_AXIS, PP_AXIS, SP_AXIS, TP_AXIS)
+
+
+def build_mesh(
+    tp: int = 1,
+    pp: int = 1,
+    sp: int = 1,
+    dp: int = -1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build the global 4-axis mesh.
+
+    ``dp=-1`` means "all remaining devices". Raises if the requested product
+    does not divide the device count (mirrors the divisibility assertions in
+    ``apex/transformer/parallel_state.py:80-90``).
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    model = tp * pp * sp
+    if dp == -1:
+        if n % model != 0:
+            raise ValueError(
+                f"device count {n} is not divisible by tp*pp*sp = {model}"
+            )
+        dp = n // model
+    if dp * model != n:
+        raise ValueError(
+            f"mesh shape dp={dp} pp={pp} sp={sp} tp={tp} requires {dp * model} "
+            f"devices, have {n}"
+        )
+    shape = (dp, pp, sp, tp)
+    try:
+        from jax.experimental import mesh_utils
+
+        dev_array = mesh_utils.create_device_mesh(shape, devices=list(devices))
+    except (ImportError, ValueError, NotImplementedError) as e:
+        # create_device_mesh optimizes placement for the physical ICI topology;
+        # when it can't handle the shape, fall back to flat order but say so —
+        # TP neighbours may no longer be contiguous ICI rings.
+        from apex_tpu._logging import get_logger
+
+        get_logger(__name__).warning(
+            "mesh_utils.create_device_mesh failed (%s); falling back to flat "
+            "device order — collective bandwidth may be degraded", e
+        )
+        dev_array = np.asarray(list(devices)).reshape(shape)
+    return Mesh(dev_array, axis_names=AXIS_ORDER)
+
+
+def axis_size(mesh: Mesh, axis: str) -> int:
+    return mesh.shape[axis]
+
+
+def model_parallel_axes(mesh: Mesh) -> Tuple[str, ...]:
+    """Axes forming the "model-parallel group" (ref parallel_state.py:110-120):
+    everything except data parallel."""
+    return tuple(a for a in mesh.axis_names if a != DP_AXIS)

@@ -1,0 +1,29 @@
+"""Megatron-style model-parallel transformer runtime (L5).
+
+Ref ``apex/transformer/__init__.py:1-23``: exports ``parallel_state``,
+``tensor_parallel``, ``pipeline_parallel``, the fused softmax module, and the
+model-parallel-aware grad scaler.
+"""
+
+from apex_tpu.transformer import parallel_state  # noqa: F401
+
+__all__ = [
+    "parallel_state",
+    "tensor_parallel",
+    "pipeline_parallel",
+    "functional",
+    "amp",
+]
+
+
+def __getattr__(name):
+    if name in __all__:
+        import importlib
+
+        try:
+            return importlib.import_module(f"apex_tpu.transformer.{name}")
+        except ModuleNotFoundError as e:
+            raise AttributeError(
+                f"module 'apex_tpu.transformer' has no attribute {name!r} ({e})"
+            ) from e
+    raise AttributeError(f"module 'apex_tpu.transformer' has no attribute {name!r}")

@@ -1,0 +1,209 @@
+"""Model-parallel state: the mesh-backed analogue of Megatron process groups.
+
+Reference: ``apex/transformer/parallel_state.py`` — builds DP/TP/PP/embedding
+process groups and exposes ~30 rank/size accessors. Here the state is a single
+global ``jax.sharding.Mesh`` (built by :func:`initialize_model_parallel`) plus
+virtual-pipeline bookkeeping. Two kinds of accessor exist:
+
+* **Host-level sizes** (``get_*_world_size``) read the mesh shape and work
+  anywhere.
+* **Rank accessors** (``get_*_rank``) return ``lax.axis_index(axis)`` — a
+  traced value — and are therefore only valid *inside* a mesh program
+  (``shard_map`` / ``pjit`` body). This is the honest TPU translation: under
+  SPMD one program runs on every device, so "my rank" is a device-varying
+  value, not a Python int. (The reference can return a Python int because each
+  NCCL rank is its own process.)
+
+Virtual pipeline (interleaved schedule) rank/size are host-level Python ints,
+as in the reference (``parallel_state.py:297-320``), because they index model
+*chunks* held by the current stage, not devices.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax import lax
+from jax.sharding import Mesh
+
+from apex_tpu.parallel.mesh import (
+    AXIS_ORDER,
+    DP_AXIS,
+    PP_AXIS,
+    SP_AXIS,
+    TP_AXIS,
+    build_mesh,
+    model_parallel_axes,
+)
+
+_MESH: Optional[Mesh] = None
+_VIRTUAL_PP_SIZE: Optional[int] = None
+_VIRTUAL_PP_RANK: Optional[int] = None
+
+
+def initialize_model_parallel(
+    tensor_model_parallel_size_: int = 1,
+    pipeline_model_parallel_size_: int = 1,
+    virtual_pipeline_model_parallel_size_: Optional[int] = None,
+    sequence_parallel_size_: int = 1,
+    *,
+    devices=None,
+) -> Mesh:
+    """Build and install the global mesh (ref parallel_state.py:57-185)."""
+    global _MESH, _VIRTUAL_PP_SIZE, _VIRTUAL_PP_RANK
+    _MESH = build_mesh(
+        tp=tensor_model_parallel_size_,
+        pp=pipeline_model_parallel_size_,
+        sp=sequence_parallel_size_,
+        devices=devices,
+    )
+    _VIRTUAL_PP_SIZE = virtual_pipeline_model_parallel_size_
+    _VIRTUAL_PP_RANK = 0 if virtual_pipeline_model_parallel_size_ else None
+    return _MESH
+
+
+def model_parallel_is_initialized() -> bool:
+    return _MESH is not None
+
+
+def get_mesh() -> Mesh:
+    if _MESH is None:
+        raise RuntimeError(
+            "model parallel mesh is not initialized; call "
+            "initialize_model_parallel() first"
+        )
+    return _MESH
+
+
+def destroy_model_parallel() -> None:
+    """Ref parallel_state.py:440-465."""
+    global _MESH, _VIRTUAL_PP_SIZE, _VIRTUAL_PP_RANK
+    _MESH = None
+    _VIRTUAL_PP_SIZE = None
+    _VIRTUAL_PP_RANK = None
+
+
+def get_mesh_axes_str() -> str:
+    if _MESH is None:
+        return "uninitialized"
+    return "x".join(f"{a}={_MESH.shape[a]}" for a in AXIS_ORDER)
+
+
+# ---------------------------------------------------------------------------
+# World sizes (host-level)
+
+def get_tensor_model_parallel_world_size() -> int:
+    return get_mesh().shape[TP_AXIS]
+
+
+def get_pipeline_model_parallel_world_size() -> int:
+    return get_mesh().shape[PP_AXIS]
+
+
+def get_sequence_parallel_world_size() -> int:
+    return get_mesh().shape[SP_AXIS]
+
+
+def get_data_parallel_world_size() -> int:
+    return get_mesh().shape[DP_AXIS]
+
+
+def get_model_parallel_world_size() -> int:
+    m = get_mesh()
+    out = 1
+    for a in model_parallel_axes(m):
+        out *= m.shape[a]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Ranks (traced values; valid inside mesh programs only)
+
+def get_tensor_model_parallel_rank():
+    return lax.axis_index(TP_AXIS)
+
+
+def get_pipeline_model_parallel_rank():
+    return lax.axis_index(PP_AXIS)
+
+
+def get_sequence_parallel_rank():
+    return lax.axis_index(SP_AXIS)
+
+
+def get_data_parallel_rank():
+    return lax.axis_index(DP_AXIS)
+
+
+def is_pipeline_first_stage(ignore_virtual: bool = False):
+    """Traced boolean (ref parallel_state.py:322-338). With virtual pipeline,
+    the first *virtual* chunk on the first stage is the model's first layer.
+
+    .. warning:: The virtual-pipeline rank is read at **trace time** (it is
+       host-level Python state, as in the reference). Functions that branch on
+       it must be re-traced after ``set_virtual_pipeline_model_parallel_rank``
+       — the interleaved schedule builder does this by constructing one traced
+       program per model chunk; do not bake this call into a single jit cache
+       entry reused across chunks."""
+    first = get_pipeline_model_parallel_rank() == 0
+    if not ignore_virtual and _VIRTUAL_PP_SIZE is not None:
+        return first if _VIRTUAL_PP_RANK == 0 else (first & False)
+    return first
+
+
+def is_pipeline_last_stage(ignore_virtual: bool = False):
+    last = (
+        get_pipeline_model_parallel_rank()
+        == get_pipeline_model_parallel_world_size() - 1
+    )
+    if not ignore_virtual and _VIRTUAL_PP_SIZE is not None:
+        if _VIRTUAL_PP_RANK != _VIRTUAL_PP_SIZE - 1:
+            return last & False
+    return last
+
+
+# ---------------------------------------------------------------------------
+# Virtual pipeline bookkeeping (host-level ints, ref parallel_state.py:297-320)
+
+def get_virtual_pipeline_model_parallel_world_size() -> Optional[int]:
+    return _VIRTUAL_PP_SIZE
+
+
+def get_virtual_pipeline_model_parallel_rank() -> Optional[int]:
+    return _VIRTUAL_PP_RANK
+
+
+def set_virtual_pipeline_model_parallel_rank(rank: int) -> None:
+    global _VIRTUAL_PP_RANK
+    _VIRTUAL_PP_RANK = rank
+
+
+# ---------------------------------------------------------------------------
+# Axis-name exports (the "process group" handles; ref get_*_group())
+
+def get_tensor_model_parallel_axis() -> str:
+    return TP_AXIS
+
+
+def get_pipeline_model_parallel_axis() -> str:
+    return PP_AXIS
+
+
+def get_sequence_parallel_axis() -> str:
+    return SP_AXIS
+
+
+def get_data_parallel_axis() -> str:
+    return DP_AXIS
+
+
+def get_model_parallel_axes():
+    return model_parallel_axes(get_mesh())
+
+
+def get_rank_info() -> str:
+    """Human-readable identity for logging (ref parallel_state.py:186-204)."""
+    if _MESH is None:
+        return "mesh uninitialized"
+    return get_mesh_axes_str()

@@ -1,0 +1,3 @@
+from apex_tpu.utils.timers import Timers, _Timer  # noqa: F401
+
+__all__ = ["Timers"]

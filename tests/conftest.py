@@ -1,0 +1,40 @@
+"""Test configuration: force an 8-device virtual CPU platform BEFORE jax init.
+
+This mirrors the reference's multi-node-without-a-cluster strategy (SURVEY.md
+§4): the same mesh code that runs on a v5e-8 slice runs here on 8 virtual CPU
+devices, so every distributed test (DDP, SyncBN, TP, PP, ring attention)
+executes real collectives in-process.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# The image's sitecustomize pins JAX_PLATFORMS to the one-chip 'axon' TPU
+# tunnel at interpreter startup; the config flag takes precedence over it.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def mesh8():
+    """A dp=8 mesh over the 8 virtual devices."""
+    from apex_tpu.parallel.mesh import build_mesh
+
+    return build_mesh(tp=1, pp=1, sp=1)
+
+
+@pytest.fixture(autouse=True)
+def _reset_parallel_state():
+    yield
+    from apex_tpu.transformer import parallel_state
+
+    parallel_state.destroy_model_parallel()

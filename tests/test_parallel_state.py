@@ -1,0 +1,88 @@
+"""Mesh / parallel_state tests — ref tests/L0/run_transformer/run_initialize_test.py."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.parallel.mesh import build_mesh
+from apex_tpu.transformer import parallel_state
+
+
+def test_device_count_is_8():
+    assert jax.device_count() == 8
+
+
+@pytest.mark.parametrize("tp,pp,sp", [(1, 1, 1), (2, 1, 1), (2, 2, 1), (4, 2, 1), (2, 1, 2)])
+def test_initialize_model_parallel_sizes(tp, pp, sp):
+    parallel_state.initialize_model_parallel(
+        tensor_model_parallel_size_=tp,
+        pipeline_model_parallel_size_=pp,
+        sequence_parallel_size_=sp,
+    )
+    assert parallel_state.get_tensor_model_parallel_world_size() == tp
+    assert parallel_state.get_pipeline_model_parallel_world_size() == pp
+    assert parallel_state.get_sequence_parallel_world_size() == sp
+    assert parallel_state.get_data_parallel_world_size() == 8 // (tp * pp * sp)
+    assert parallel_state.get_model_parallel_world_size() == tp * pp * sp
+    assert parallel_state.model_parallel_is_initialized()
+
+
+def test_initialize_rejects_bad_shape():
+    with pytest.raises(ValueError):
+        build_mesh(tp=3)  # 3 does not divide 8
+
+
+def test_destroy():
+    parallel_state.initialize_model_parallel(2, 2)
+    parallel_state.destroy_model_parallel()
+    assert not parallel_state.model_parallel_is_initialized()
+    with pytest.raises(RuntimeError):
+        parallel_state.get_mesh()
+
+
+def test_rank_accessors_inside_mesh_program():
+    mesh = parallel_state.initialize_model_parallel(
+        tensor_model_parallel_size_=2, pipeline_model_parallel_size_=2
+    )
+
+    def body(x):
+        tp_r = parallel_state.get_tensor_model_parallel_rank()
+        pp_r = parallel_state.get_pipeline_model_parallel_rank()
+        dp_r = parallel_state.get_data_parallel_rank()
+        return x + tp_r + 10 * pp_r + 100 * dp_r
+
+    f = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=P("dp", ("pp", "sp", "tp")),
+        out_specs=P("dp", ("pp", "sp", "tp")),
+    )
+    x = jnp.zeros((2, 4), jnp.int32)
+    out = np.asarray(f(x))
+    # Every device contributes 100*dp + 10*pp + tp to its (1,1) shard.
+    assert set(out.ravel().tolist()) == {0, 1, 10, 11, 100, 101, 110, 111}
+
+
+def test_psum_over_each_axis():
+    mesh = parallel_state.initialize_model_parallel(2, 2)
+
+    def body(x):
+        s_tp = jax.lax.psum(x, "tp")
+        s_pp = jax.lax.psum(s_tp, "pp")
+        s_dp = jax.lax.psum(s_pp, "dp")
+        return s_dp
+
+    f = shard_map(body, mesh=mesh, in_specs=P(), out_specs=P())
+    out = f(jnp.ones(()))
+    assert float(out) == 8.0
+
+
+def test_virtual_pipeline_bookkeeping():
+    parallel_state.initialize_model_parallel(1, 2, virtual_pipeline_model_parallel_size_=2)
+    assert parallel_state.get_virtual_pipeline_model_parallel_world_size() == 2
+    assert parallel_state.get_virtual_pipeline_model_parallel_rank() == 0
+    parallel_state.set_virtual_pipeline_model_parallel_rank(1)
+    assert parallel_state.get_virtual_pipeline_model_parallel_rank() == 1
