@@ -1,0 +1,55 @@
+"""Mixed precision (L2) — the trace-time re-design of ``apex.amp``.
+
+Public surface (ref ``apex/amp/__init__.py`` + ``frontend.py:195`` +
+``handle.py:17`` + ``amp.py:30-64``):
+
+* :func:`initialize`, :func:`get_policy` — opt levels O0-O3 as declarative
+  policies.
+* :func:`autocast` — O1 per-op cast transform (replaces monkey-patching).
+* :func:`scale_loss`, :func:`apply_grads` — dynamic loss scaling + skip-step.
+* :class:`LossScaler` / :class:`LossScalerState` — the functional scaler.
+* :func:`half_function` / :func:`float_function` / :func:`promote_function` —
+  user registration decorators.
+* :func:`state_dict` / :func:`load_state_dict` — checkpoint parity.
+"""
+
+from apex_tpu.amp.autocast import (  # noqa: F401
+    autocast,
+    float_function,
+    half_function,
+    promote_function,
+)
+from apex_tpu.amp.frontend import (  # noqa: F401
+    AmpState,
+    apply_grads,
+    cast_inputs,
+    cast_params,
+    default_norm_predicate,
+    get_policy,
+    initialize,
+    load_state_dict,
+    model_params,
+    scale_loss,
+    state_dict,
+)
+from apex_tpu.amp.scaler import LossScaler, LossScalerState  # noqa: F401
+
+__all__ = [
+    "AmpState",
+    "LossScaler",
+    "LossScalerState",
+    "apply_grads",
+    "autocast",
+    "cast_inputs",
+    "cast_params",
+    "default_norm_predicate",
+    "float_function",
+    "get_policy",
+    "half_function",
+    "initialize",
+    "load_state_dict",
+    "model_params",
+    "promote_function",
+    "scale_loss",
+    "state_dict",
+]

@@ -1,0 +1,289 @@
+"""O1-style per-op mixed precision as a jaxpr-interpreting transform.
+
+Reference: ``apex/amp/amp.py:68`` + ``wrap.py`` monkey-patch ~200 torch entry
+points with cast wrappers because eager PyTorch has no graph to rewrite. JAX
+traces to a jaxpr, so the same capability is a **function transform**:
+:func:`autocast` traces the wrapped function, then re-evaluates the jaxpr with
+per-primitive dtype rules from :mod:`apex_tpu.amp.lists` —
+
+* whitelist (``dot_general``/``conv``): float inputs cast to the compute dtype
+  (bf16/fp16) so they hit the MXU (ref ``wrap.py:10-29`` + cached_cast;
+  no cast cache is needed — XLA CSEs the repeated weight casts),
+* blacklist (exp/log/pow/reductions/...): float inputs cast to fp32
+  (ref ``wrap.py:36-41`` maybe_float),
+* everything else: mixed float inputs promoted to the widest present
+  (ref ``wrap.py:43-63`` promote wrappers).
+
+Higher-order primitives: ``scan``/``while``/``cond`` bodies are recursively
+transformed with boundary casts so carry/branch signatures stay consistent;
+``pjit`` regions are inlined; ``custom_jvp/vjp`` regions are left opaque at
+their original dtypes (their authors chose those dtypes — and their custom
+derivative rules must survive).
+
+Composability: ``autocast`` runs at trace time, so ``jax.jit``, ``jax.grad``,
+``shard_map`` etc. compose around it; under ``grad`` the casts are part of the
+traced graph and AD differentiates through them (matching torch autocast
+semantics, where casts are autograd ops).
+"""
+
+from __future__ import annotations
+
+import contextvars
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.extend import core as jax_core
+from jax.tree_util import tree_flatten, tree_unflatten
+
+from apex_tpu.amp.lists import (
+    CONTROL_FLOW_PRIM_NAMES,
+    FP16_PRIMS,
+    FP32_PRIMS,
+    INLINE_PRIM_NAMES,
+    OPAQUE_PRIM_NAMES,
+)
+
+_ACTIVE_COMPUTE_DTYPE: contextvars.ContextVar[Optional[Any]] = contextvars.ContextVar(
+    "apex_tpu_autocast_compute_dtype", default=None
+)
+
+
+def _is_float(x) -> bool:
+    return jnp.issubdtype(jnp.result_type(x), jnp.floating)
+
+
+def _cast(x, dtype):
+    if _is_float(x) and jnp.result_type(x) != jnp.dtype(dtype):
+        return lax.convert_element_type(x, dtype)
+    return x
+
+
+def _widest_float(vals):
+    dt = None
+    for v in vals:
+        if _is_float(v):
+            vdt = jnp.result_type(v)
+            dt = vdt if dt is None else jnp.promote_types(dt, vdt)
+    return dt
+
+
+def _bind(prim, invals, params):
+    """Bind an eqn the way core.eval_jaxpr does: recover callable
+    sub-functions from stored jaxpr params first (custom_jvp/vjp, pjit, ...)."""
+    subfuns, bind_params = prim.get_bind_params(params)
+    out = prim.bind(*subfuns, *invals, **bind_params)
+    return out if isinstance(out, (list, tuple)) else [out]
+
+
+def _eval_autocast(jaxpr, consts, args, compute_dtype):
+    env = {}
+
+    def read(v):
+        return v.val if isinstance(v, jax_core.Literal) else env[v]
+
+    def write(v, val):
+        env[v] = val
+
+    for v, c in zip(jaxpr.constvars, consts):
+        write(v, c)
+    for v, a in zip(jaxpr.invars, args):
+        write(v, a)
+
+    for eqn in jaxpr.eqns:
+        invals = [read(v) for v in eqn.invars]
+        prim = eqn.primitive
+        params = dict(eqn.params)
+        name = prim.name
+
+        if name in INLINE_PRIM_NAMES:
+            inner = params.get("jaxpr") or params.get("call_jaxpr")
+            if hasattr(inner, "jaxpr"):  # ClosedJaxpr
+                out = _eval_autocast(inner.jaxpr, inner.consts, invals, compute_dtype)
+            else:
+                out = _eval_autocast(inner, [], invals, compute_dtype)
+        elif name in OPAQUE_PRIM_NAMES:
+            invals = [
+                _cast(val, var.aval.dtype) if _is_float(val) else val
+                for val, var in zip(invals, eqn.invars)
+            ]
+            out = _bind(prim, invals, params)
+        elif name in CONTROL_FLOW_PRIM_NAMES:
+            out = _rebind_higher_order(eqn, invals, compute_dtype)
+        elif prim in FP16_PRIMS:
+            invals = [_cast(v, compute_dtype) for v in invals]
+            # Whitelist ops *output* the compute dtype (ref wrap.py:10-29 —
+            # the fp16 function returns fp16): downgrade an f32
+            # preferred_element_type that only reflected the f32 trace. The
+            # MXU still accumulates fp32 internally before rounding.
+            if params.get("preferred_element_type") == jnp.float32:
+                params["preferred_element_type"] = jnp.dtype(compute_dtype)
+            out = _bind(prim, invals, params)
+        elif prim in FP32_PRIMS:
+            invals = [_cast(v, jnp.float32) for v in invals]
+            out = _bind(prim, invals, params)
+        else:
+            wide = _widest_float(invals)
+            if wide is not None and any(
+                _is_float(v) and jnp.result_type(v) != wide for v in invals
+            ):
+                # Only promote where the primitive itself is dtype-polymorphic
+                # over several float args (add/mul/concat/select...); prims
+                # with a single float input are left alone.
+                n_float = sum(1 for v in invals if _is_float(v))
+                if n_float > 1:
+                    invals = [_cast(v, wide) for v in invals]
+            out = _bind(prim, invals, params)
+
+        if prim.multiple_results:
+            for v, o in zip(eqn.outvars, out):
+                write(v, o)
+        else:
+            write(eqn.outvars[0], out[0])
+
+    return [read(v) for v in jaxpr.outvars]
+
+
+def _rebind_higher_order(eqn, invals, compute_dtype):
+    """Re-trace scan/while/cond bodies under autocast with boundary casts so
+    the loop-carry / branch-output signatures keep their traced dtypes."""
+    prim = eqn.primitive
+    params = dict(eqn.params)
+
+    if prim.name == "scan":
+        closed = params["jaxpr"]
+        new_closed = _retrace_closed(closed, compute_dtype)
+        params["jaxpr"] = new_closed
+    elif prim.name == "while":
+        params["cond_jaxpr"] = _retrace_closed(params["cond_jaxpr"], compute_dtype)
+        params["body_jaxpr"] = _retrace_closed(params["body_jaxpr"], compute_dtype)
+    elif prim.name == "cond":
+        params["branches"] = tuple(
+            _retrace_closed(b, compute_dtype) for b in params["branches"]
+        )
+    # Inputs must match the original signature dtypes.
+    invals = [
+        _cast(v, var.aval.dtype) if _is_float(v) else v
+        for v, var in zip(invals, eqn.invars)
+    ]
+    return _bind(prim, invals, params)
+
+
+def _retrace_closed(closed, compute_dtype):
+    """Autocast a ClosedJaxpr, casting outputs back to their original dtypes."""
+    inner_jaxpr, inner_consts = closed.jaxpr, closed.consts
+    out_avals = [v.aval for v in inner_jaxpr.outvars]
+
+    def body(*xs):
+        outs = _eval_autocast(inner_jaxpr, inner_consts, list(xs), compute_dtype)
+        return tuple(
+            _cast(o, av.dtype) if _is_float(o) else o
+            for o, av in zip(outs, out_avals)
+        )
+
+    in_structs = [
+        jax.ShapeDtypeStruct(v.aval.shape, v.aval.dtype) for v in inner_jaxpr.invars
+    ]
+    return jax.make_jaxpr(body)(*in_structs)
+
+
+def autocast(
+    fn: Callable,
+    compute_dtype=jnp.bfloat16,
+    enabled: bool = True,
+) -> Callable:
+    """Wrap ``fn`` so its float ops run under the O1 per-op cast policy.
+
+    Equivalent of running a model under ``amp.initialize(opt_level="O1")``
+    (ref ``apex/amp/frontend.py:147-168`` + ``amp.py:68``). ``enabled=False``
+    returns ``fn`` unchanged (ref ``handle.py:164`` ``disable_casts``).
+    """
+    if not enabled:
+        return fn
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        flat_all, in_tree = tree_flatten((args, kwargs))
+        # Non-array leaves (strings, None handled by tree, config flags,
+        # python callables...) are static: closed over rather than traced —
+        # the jaxpr-level analogue of jit's static_argnums.
+        is_dynamic = [
+            isinstance(x, (jax.Array, np.ndarray))
+            or isinstance(x, (int, float, complex, bool, np.generic))
+            for x in flat_all
+        ]
+        flat_args = [x for x, d in zip(flat_all, is_dynamic) if d]
+        out_tree_box = []
+
+        def f_flat(*flat):
+            it = iter(flat)
+            merged = [next(it) if d else x for x, d in zip(flat_all, is_dynamic)]
+            a, k = tree_unflatten(in_tree, merged)
+            out = fn(*a, **k)
+            out_flat, out_tree = tree_flatten(out)
+            out_tree_box.append(out_tree)
+            return out_flat
+
+        token = _ACTIVE_COMPUTE_DTYPE.set(compute_dtype)
+        try:
+            closed = jax.make_jaxpr(f_flat)(*flat_args)
+        finally:
+            _ACTIVE_COMPUTE_DTYPE.reset(token)
+        out_flat = _eval_autocast(closed.jaxpr, closed.consts, list(flat_args), compute_dtype)
+        return tree_unflatten(out_tree_box[0], out_flat)
+
+    return wrapped
+
+
+# ---------------------------------------------------------------------------
+# User registration decorators (ref apex/amp/amp.py:30-64: half_function /
+# float_function / promote_function and the register_* variants). In the
+# trace-time design these insert explicit casts while an autocast trace is
+# active; the interpreter then respects them (explicit convert_element_type is
+# never rewritten).
+
+def _region(fn, dtype_of):
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        dt = dtype_of()
+        if dt is None:  # no autocast active — behave like the raw function
+            return fn(*args, **kwargs)
+        args, kwargs = jax.tree_util.tree_map(
+            lambda x: _cast(x, dt) if _is_float(x) else x, (args, kwargs)
+        )
+        return fn(*args, **kwargs)
+
+    return wrapped
+
+
+def half_function(fn: Callable) -> Callable:
+    """Force ``fn``'s float inputs to the active compute dtype (ref amp.py:36)."""
+    return _region(fn, _ACTIVE_COMPUTE_DTYPE.get)
+
+
+def float_function(fn: Callable) -> Callable:
+    """Force ``fn``'s float inputs to fp32 while autocast is active (ref amp.py:41)."""
+    return _region(
+        fn, lambda: jnp.float32 if _ACTIVE_COMPUTE_DTYPE.get() is not None else None
+    )
+
+
+def promote_function(fn: Callable) -> Callable:
+    """Promote ``fn``'s float inputs to their widest dtype (ref amp.py:46)."""
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        if _ACTIVE_COMPUTE_DTYPE.get() is None:
+            return fn(*args, **kwargs)
+        leaves = [x for x in jax.tree_util.tree_leaves((args, kwargs)) if _is_float(x)]
+        wide = _widest_float(leaves)
+        if wide is not None:
+            args, kwargs = jax.tree_util.tree_map(
+                lambda x: _cast(x, wide) if _is_float(x) else x, (args, kwargs)
+            )
+        return fn(*args, **kwargs)
+
+    return wrapped

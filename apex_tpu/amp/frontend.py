@@ -1,0 +1,282 @@
+"""amp frontend: opt-level presets, param casting, master weights, initialize.
+
+Reference: ``apex/amp/frontend.py`` (``Properties`` + O0-O3 presets +
+``initialize``), ``_initialize.py`` (model cast, forward patch, per-loss
+scalers) and ``_process_optimizer.py`` (O2 master-weight machinery). The TPU
+re-design is functional: instead of mutating models/optimizers in place, the
+opt level resolves to a :class:`~apex_tpu.config.PrecisionConfig`, and the
+master-weight flow is explicit pytree arithmetic inside the user's (jitted)
+train step — which XLA fuses into the same single-sweep updates the reference
+needs ``amp_C`` multi-tensor kernels for.
+
+Typical O2 train step::
+
+    amp_state = amp.initialize(params, opt_level="O2", loss_scale="dynamic")
+
+    def train_step(amp_state, batch):
+        model_params = amp.model_params(amp_state)        # bf16 cast-on-forward
+        def loss_fn(p):
+            loss = model.apply(p, batch)
+            return amp_state.scaler_obj.scale_loss(loss, amp_state.scaler)
+        grads = jax.grad(loss_fn)(model_params)
+        new_master, amp_state, skipped = amp.apply_grads(
+            amp_state, grads, lambda g, p: sgd_update(g, p))
+        return amp_state
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.config import PrecisionConfig
+from apex_tpu.amp.scaler import LossScaler, LossScalerState
+
+# ---------------------------------------------------------------------------
+# Opt-level presets (ref apex/amp/frontend.py:102-193)
+
+_HALF = jnp.float16
+_BF16 = jnp.bfloat16
+
+
+def _preset(opt_level: str, half_dtype) -> PrecisionConfig:
+    if opt_level == "O0":  # fp32 training (frontend.py:169-186)
+        return PrecisionConfig(
+            opt_level="O0",
+            cast_model_type=None,
+            compute_dtype=None,
+            keep_batchnorm_fp32=None,
+            master_weights=False,
+            loss_scale=1.0,
+        )
+    if opt_level == "O1":  # per-op casting (frontend.py:147-168)
+        return PrecisionConfig(
+            opt_level="O1",
+            cast_model_type=None,
+            compute_dtype=half_dtype,
+            keep_batchnorm_fp32=None,
+            master_weights=None,
+            loss_scale="dynamic",
+        )
+    if opt_level == "O2":  # half model + fp32 masters + fp32 norms (frontend.py:124-146)
+        return PrecisionConfig(
+            opt_level="O2",
+            cast_model_type=half_dtype,
+            compute_dtype=None,
+            keep_batchnorm_fp32=True,
+            master_weights=True,
+            loss_scale="dynamic",
+        )
+    if opt_level == "O3":  # pure half, perf ceiling (frontend.py:102-123)
+        return PrecisionConfig(
+            opt_level="O3",
+            cast_model_type=half_dtype,
+            compute_dtype=None,
+            keep_batchnorm_fp32=False,
+            master_weights=False,
+            loss_scale=1.0,
+        )
+    raise ValueError(
+        f"Unexpected optimization level {opt_level!r} "
+        "(options are 'O0', 'O1', 'O2', 'O3')"
+    )
+
+
+def get_policy(
+    opt_level: str = "O0", half_dtype=_BF16, **overrides
+) -> PrecisionConfig:
+    """Resolve an opt level + kwarg overrides to a PrecisionConfig
+    (ref ``frontend.py:195-360`` property-override flow). ``half_dtype``
+    defaults to bf16 — the TPU-native half type; pass ``jnp.float16`` for
+    strict fp16 parity."""
+    cfg = _preset(opt_level, half_dtype)
+    if overrides:
+        cfg = cfg.replace(**overrides)
+    return cfg
+
+
+# ---------------------------------------------------------------------------
+# Param casting (ref _initialize.py:177-203 + fp16_utils/fp16util.py:60)
+
+_NORM_COMPONENT = re.compile(
+    r"(batch_?norm|group_?norm|layer_?norm|rms_?norm|instance_?norm|sync_?batch_?norm"
+    r"|(bn|gn|ln|norm))(_?[a-z0-9]{0,3})?$",
+    re.IGNORECASE,
+)
+
+
+def default_norm_predicate(path: str) -> bool:
+    """Heuristic for "is this a normalization param" from its pytree path —
+    the analogue of ``convert_network`` skipping ``_BatchNorm`` modules
+    (ref ``fp16_utils/fp16util.py:60-88``). Matches flax-style scope components
+    like ``BatchNorm_0``, ``layer_norm``, ``ln_f``, ``bn1``."""
+    return any(_NORM_COMPONENT.fullmatch(c) for c in path.split("/"))
+
+
+def _path_str(path) -> str:
+    """Normalize a tree_map_with_path key path to 'a/b/c' form."""
+    parts = []
+    for k in path:
+        if hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "name"):
+            parts.append(str(k.name))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+def cast_params(
+    params: Any,
+    policy: PrecisionConfig,
+    is_norm_param: Callable[[str], bool] = default_norm_predicate,
+) -> Any:
+    """Cast a param pytree per the policy: float leaves → ``cast_model_type``,
+    except normalization params when ``keep_batchnorm_fp32``
+    (ref ``_initialize.py:177-182``)."""
+    if policy.cast_model_type is None:
+        return params
+    target = policy.cast_model_type
+
+    def leaf(path, x):
+        if not jnp.issubdtype(jnp.result_type(x), jnp.floating):
+            return x
+        if policy.keep_batchnorm_fp32 and is_norm_param(_path_str(path)):
+            return x.astype(jnp.float32)
+        return x.astype(target)
+
+    return jax.tree_util.tree_map_with_path(leaf, params)
+
+
+def cast_inputs(args: Any, policy: PrecisionConfig) -> Any:
+    """Cast float inputs to the model compute type — the analogue of the
+    patched ``model.forward`` input cast (ref ``_initialize.py:194-203``)."""
+    if policy.cast_model_type is None:
+        return args
+    t = policy.cast_model_type
+    return jax.tree_util.tree_map(
+        lambda x: x.astype(t)
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+        else x,
+        args,
+    )
+
+
+# ---------------------------------------------------------------------------
+# initialize + master-weight step (ref _process_optimizer.py)
+
+class AmpState(NamedTuple):
+    """Everything ``amp.initialize`` hangs off the model/optimizer in the
+    reference, as one explicit checkpointable pytree."""
+
+    master_params: Any  # fp32 masters when policy.master_weights, else model params
+    scaler: LossScalerState
+    policy: PrecisionConfig  # static (hashable dataclass)
+    is_norm_param: Callable[[str], bool]  # static: the keep-fp32 predicate
+    # scaler config is reconstructible from policy; kept object-free for jit.
+
+
+jax.tree_util.register_pytree_node(
+    AmpState,
+    lambda s: ((s.master_params, s.scaler), (s.policy, s.is_norm_param)),
+    lambda aux, kids: AmpState(kids[0], kids[1], aux[0], aux[1]),
+)
+
+
+def make_scaler(policy: PrecisionConfig) -> LossScaler:
+    return LossScaler(policy.loss_scale)
+
+
+def initialize(
+    params: Any,
+    opt_level: str = "O0",
+    half_dtype=_BF16,
+    is_norm_param: Callable[[str], bool] = default_norm_predicate,
+    **overrides,
+) -> Tuple[AmpState, PrecisionConfig]:
+    """Functional ``amp.initialize`` (ref ``frontend.py:195``): resolve the
+    policy, build fp32 masters if the policy wants them, and init the scaler.
+
+    Returns ``(amp_state, policy)``. Model params for the forward pass come
+    from :func:`model_params`; the optimizer runs on ``amp_state.master_params``.
+    """
+    policy = get_policy(opt_level, half_dtype, **overrides)
+    if policy.master_weights:
+        masters = jax.tree_util.tree_map(
+            lambda x: x.astype(jnp.float32)
+            if jnp.issubdtype(jnp.result_type(x), jnp.floating)
+            else x,
+            params,
+        )
+    else:
+        masters = params
+    scaler = make_scaler(policy)
+    return AmpState(masters, scaler.init_state(), policy, is_norm_param), policy
+
+
+def model_params(state: AmpState) -> Any:
+    """Model-dtype view of the masters — cast-on-forward (the O2 equivalent of
+    keeping a fp16 model copy + ``_master_params_to_model_params`` after each
+    step, ref ``_process_optimizer.py:14-25``; here it is a pure cast XLA
+    fuses into the first consumer). Uses the ``is_norm_param`` predicate
+    captured by :func:`initialize`."""
+    return cast_params(state.master_params, state.policy, state.is_norm_param)
+
+
+def scale_loss(loss: jnp.ndarray, state: AmpState) -> jnp.ndarray:
+    """Ref ``handle.py:17`` ``scale_loss`` context entry."""
+    return make_scaler(state.policy).scale_loss(loss, state.scaler)
+
+
+def apply_grads(
+    state: AmpState,
+    grads: Any,
+    update_fn: Callable[[Any, Any], Any],
+    mp_axes: Optional[Any] = None,
+) -> Tuple[AmpState, jnp.ndarray]:
+    """Unscale grads, check overflow, run ``update_fn(grads, masters) ->
+    new_masters`` unless skipping, update the scale.
+
+    This is the exit path of ``with amp.scale_loss(...)`` plus the patched
+    ``optimizer.step`` (ref ``handle.py:272-300`` + ``scaler.py:152-217``):
+    one fused unscale+check sweep, a where-guarded update, scale adjustment.
+    ``mp_axes``: mesh axis name(s) to psum the overflow flag over (the
+    Megatron GradScaler behavior, ``transformer/amp/grad_scaler.py:25-60``).
+    Returns ``(new_state, skipped)``.
+    """
+    scaler = make_scaler(state.policy)
+    out_dtype = jnp.float32 if state.policy.master_weights else None
+    grads, found_inf = scaler.unscale(grads, state.scaler, out_dtype=out_dtype)
+    if mp_axes is not None:
+        found_inf = LossScaler.all_reduce_found_inf(found_inf, mp_axes)
+    new_scaler_state, skipped = scaler.update_scale(state.scaler, found_inf)
+    new_masters = update_fn(grads, state.master_params)
+    # where-guard instead of lax.cond: both sides are cheap elementwise; a
+    # select keeps the step shape static and fuses (ref skip-step semantics,
+    # handle.py:131-158).
+    guarded = jax.tree_util.tree_map(
+        lambda new, old: jnp.where(skipped, old, new)
+        if hasattr(new, "dtype")
+        else (old if skipped else new),
+        new_masters,
+        state.master_params,
+    )
+    return AmpState(guarded, new_scaler_state, state.policy, state.is_norm_param), skipped
+
+
+# ---------------------------------------------------------------------------
+# Checkpointing (ref frontend.py:361-401)
+
+def state_dict(state: AmpState) -> dict:
+    scaler = make_scaler(state.policy)
+    return {"loss_scaler0": scaler.state_dict(state.scaler)}
+
+
+def load_state_dict(state: AmpState, d: dict) -> AmpState:
+    scaler = make_scaler(state.policy)
+    return state._replace(scaler=scaler.load_state_dict(d["loss_scaler0"]))

@@ -1,0 +1,100 @@
+"""Per-primitive cast policy tables — the trace-time analogue of amp's op lists.
+
+Reference: ``apex/amp/lists/{functional,torch,tensor}_overrides.py`` classify
+~200 torch entry points into FP16 (tensor-core ops), FP32 (numerically
+sensitive), CASTS/promote (multi-arg widest-type), and BANNED. Here the
+classification is over **JAX primitives**, which is both smaller and more
+precise: whatever composite op a user calls (``jnp.softmax``, ``nn.gelu``)
+decomposes into these primitives at trace time, so the policy catches
+everything with no monkey-patching and no cache
+(XLA CSEs repeated weight casts — the per-iteration cast cache of
+``apex/amp/utils.py:95-140`` has no equivalent cost here).
+"""
+
+from __future__ import annotations
+
+from jax import lax
+from jax.extend import core as jax_core
+
+# Ops whose FLOPs dominate and which the MXU runs natively in bf16/fp16
+# (ref lists/torch_overrides.py:7-27 — BLAS + conv family).
+FP16_PRIMS = {
+    lax.dot_general_p,
+    lax.conv_general_dilated_p,
+}
+
+# Numerically sensitive primitives kept in fp32
+# (ref lists/torch_overrides.py:29-84 + functional_overrides.py:29-68:
+# exp/log/pow family, softmax constituents, norms, losses, big reductions).
+_FP32_PRIM_NAMES = [
+    "exp",
+    "exp2",
+    "expm1",
+    "log",
+    "log1p",
+    "logistic",
+    "pow",
+    "rsqrt",
+    "erf",
+    "erfc",
+    "erf_inv",
+    "acos",
+    "acosh",
+    "asin",
+    "asinh",
+    "atan",
+    "atanh",
+    "atan2",
+    "cosh",
+    "sinh",
+    "tan",
+    "digamma",
+    "lgamma",
+    "reduce_sum",
+    "reduce_prod",
+    "cumsum",
+    "cumprod",
+    "cumlogsumexp",
+    "reduce_precision",
+]
+
+
+def _prims_by_name(names):
+    out = set()
+    for name in names:
+        prim = getattr(lax, f"{name}_p", None)
+        if isinstance(prim, jax_core.Primitive):
+            out.add(prim)
+    return out
+
+
+FP32_PRIMS = _prims_by_name(_FP32_PRIM_NAMES)
+
+# Everything else is "promote": run in the widest input dtype
+# (ref lists/torch_overrides.py:86-111 CASTS — add/mul/cat/eq...). In JAX this
+# is simply "cast mixed float inputs to the widest present", applied
+# generically by the interpreter rather than enumerated.
+
+# BANNED (ref functional_overrides.py:70-76): fp16 binary_cross_entropy is
+# banned because log(sigmoid) saturates. There is no primitive-level
+# equivalent to ban — the fp32 blacklist on exp/log already forces the
+# sensitive part of any BCE decomposition to fp32 — so the table is empty.
+BANNED_PRIMS: set = set()
+
+# Higher-order primitive classification consumed by the autocast interpreter:
+#
+# INLINE: call-like wrappers whose bodies are evaluated directly (the jit
+# boundary is re-established by the user's outer jit).
+INLINE_PRIM_NAMES = {"pjit", "jit", "closed_call", "core_call", "remat", "checkpoint"}
+# OPAQUE: custom-derivative regions rebound unchanged at their traced dtypes —
+# their authors chose those dtypes, and the custom rules must survive.
+OPAQUE_PRIM_NAMES = {
+    "custom_jvp_call",
+    "custom_vjp_call",
+    "custom_vjp_call_jaxpr",
+    "custom_jvp_call_jaxpr",
+    "custom_lin",
+}
+# CONTROL FLOW: bodies re-traced under autocast with boundary casts so the
+# carry/branch signatures keep their traced dtypes.
+CONTROL_FLOW_PRIM_NAMES = {"scan", "while", "cond"}

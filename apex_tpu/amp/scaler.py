@@ -1,0 +1,162 @@
+"""Dynamic loss scaling as a pure functional transform.
+
+Reference: ``apex/amp/scaler.py:33-217`` (``LossScaler``) — scale the loss
+before backward, unscale gradients with a fused multi-tensor sweep + overflow
+check, then adjust the scale (×2 after 2000 clean steps, ÷2 on overflow,
+min/max bounds) and skip the optimizer step on overflow.
+
+TPU re-design: the scaler is a tiny pytree (:class:`LossScalerState`) threaded
+through the jitted train step — no mutable singleton, no D2H ``.item()`` sync
+(the reference pays one at ``scaler.py:206``). The overflow check is
+``jnp.isfinite`` reduced over the grad pytree (XLA fuses this into the unscale
+sweep, which is what ``amp_C.multi_tensor_scale`` hand-fuses), the step-skip
+is a ``lax.cond``/``where`` on device, and the whole thing is checkpointable
+because the state is explicit.
+
+bf16 on TPU generally does not need loss scaling (same exponent range as
+fp32); this exists for capability parity and for genuine fp16 use.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+
+class LossScalerState(NamedTuple):
+    """Checkpointable scaler state (ref ``scaler.py:33-64`` attributes)."""
+
+    loss_scale: jnp.ndarray  # f32 scalar
+    unskipped: jnp.ndarray  # i32 scalar — clean steps since last growth
+
+
+class LossScaler:
+    """Static scaler config + pure methods over :class:`LossScalerState`.
+
+    ``LossScaler("dynamic")`` reproduces the reference's dynamic policy
+    (init 2**16, ×2/2000, ÷2 on overflow, max 2**24 — ``scaler.py:33-60,197-217``);
+    ``LossScaler(128.0)`` is a static scale (update is a no-op).
+    """
+
+    def __init__(
+        self,
+        loss_scale: Union[str, float] = "dynamic",
+        init_scale: float = 2.0 ** 16,
+        scale_factor: float = 2.0,
+        scale_window: int = 2000,
+        min_loss_scale: Optional[float] = None,
+        max_loss_scale: float = 2.0 ** 24,
+    ):
+        if loss_scale == "dynamic":
+            self.dynamic = True
+            self._init_scale = init_scale
+        else:
+            self.dynamic = False
+            self._init_scale = float(loss_scale)
+        self.scale_factor = scale_factor
+        self.scale_window = scale_window
+        self.min_loss_scale = min_loss_scale if min_loss_scale is not None else 1.0
+        self.max_loss_scale = max_loss_scale
+
+    # -- state ------------------------------------------------------------
+    def init_state(self) -> LossScalerState:
+        return LossScalerState(
+            loss_scale=jnp.asarray(self._init_scale, jnp.float32),
+            unskipped=jnp.asarray(0, jnp.int32),
+        )
+
+    def loss_scale(self, state: LossScalerState) -> jnp.ndarray:
+        return state.loss_scale
+
+    # -- train-step ops ---------------------------------------------------
+    def scale_loss(self, loss: jnp.ndarray, state: LossScalerState) -> jnp.ndarray:
+        """Ref ``handle.py:270`` (yield ``loss.float() * loss_scale``). The
+        result stays fp32 — a 2**16 scale overflows an fp16 loss of 1.0."""
+        return loss.astype(jnp.float32) * state.loss_scale
+
+    def unscale(
+        self,
+        grads: Any,
+        state: LossScalerState,
+        out_dtype: Optional[jnp.dtype] = jnp.float32,
+    ) -> Tuple[Any, jnp.ndarray]:
+        """Unscale a grad pytree and detect overflow in the same sweep.
+
+        Ref ``scaler.py:94-150`` (``unscale`` via ``multi_tensor_scale`` with
+        the fused inf/nan flag). Returns ``(unscaled_grads, found_inf)`` where
+        ``found_inf`` is a f32 scalar 0/1 (f32 so it can ride a psum across
+        model-parallel axes, ref ``transformer/amp/grad_scaler.py:25-60``).
+        ``out_dtype=None`` keeps each leaf's dtype (the no-master-weights
+        path); fp32 is the O2 master-grad path.
+        """
+        inv = 1.0 / state.loss_scale
+
+        leaves = jax.tree_util.tree_leaves(grads)
+        finite = (
+            jnp.stack([jnp.all(jnp.isfinite(g)) for g in leaves]).all()
+            if leaves
+            else jnp.asarray(True)
+        )
+        out = jax.tree_util.tree_map(
+            lambda g: (g.astype(jnp.float32) * inv).astype(
+                g.dtype if out_dtype is None else out_dtype
+            ),
+            grads,
+        )
+        found_inf = (~finite).astype(jnp.float32)
+        return out, found_inf
+
+    def update_scale(
+        self, state: LossScalerState, found_inf: jnp.ndarray
+    ) -> Tuple[LossScalerState, jnp.ndarray]:
+        """Adjust the scale; return ``(new_state, should_skip)``.
+
+        Ref ``scaler.py:197-217``: on overflow halve (bounded below) and reset
+        the growth counter; after ``scale_window`` clean steps double (bounded
+        above). ``should_skip`` is a traced bool — feed it to ``lax.cond`` or
+        ``jnp.where`` around the optimizer update (the functional equivalent of
+        the reference's patched ``optimizer.step``, ``handle.py:131-158``).
+        """
+        overflow = found_inf > 0
+        if not self.dynamic:
+            return state, overflow
+
+        new_unskipped = jnp.where(overflow, 0, state.unskipped + 1)
+        grow = new_unskipped >= self.scale_window
+        new_scale = jnp.where(
+            overflow,
+            jnp.maximum(state.loss_scale / self.scale_factor, self.min_loss_scale),
+            jnp.where(
+                grow,
+                jnp.minimum(state.loss_scale * self.scale_factor, self.max_loss_scale),
+                state.loss_scale,
+            ),
+        )
+        new_unskipped = jnp.where(grow, 0, new_unskipped)
+        return LossScalerState(new_scale, new_unskipped.astype(jnp.int32)), overflow
+
+    # -- distributed ------------------------------------------------------
+    @staticmethod
+    def all_reduce_found_inf(
+        found_inf: jnp.ndarray, axis_names: Union[str, Sequence[str]]
+    ) -> jnp.ndarray:
+        """Max-reduce the overflow flag across model-parallel axes so every
+        rank agrees on skipping (ref ``transformer/amp/grad_scaler.py:25-60``,
+        which all-reduces ``found_inf`` with MAX over the MP group). Call
+        inside the mesh program."""
+        return jax.lax.pmax(found_inf, axis_names)
+
+    # -- checkpointing (ref frontend.py:361-401, scaler state entries) -----
+    def state_dict(self, state: LossScalerState) -> dict:
+        return {
+            "loss_scale": float(state.loss_scale),
+            "unskipped": int(state.unskipped),
+        }
+
+    def load_state_dict(self, d: dict) -> LossScalerState:
+        return LossScalerState(
+            loss_scale=jnp.asarray(d["loss_scale"], jnp.float32),
+            unskipped=jnp.asarray(d["unskipped"], jnp.int32),
+        )

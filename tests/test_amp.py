@@ -1,0 +1,311 @@
+"""AMP tests — mirror the reference L0/run_amp strategy (SURVEY.md §4):
+behavioral dtype checks for the cast policy, scaler semantics with injected
+inf/nan, O2 master-weight flow, checkpoint round-trips."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import amp
+
+
+# ---------------------------------------------------------------------------
+# O1 autocast — ref tests/L0/run_amp/test_basic_casts.py
+
+
+def _dot_out_dtype(fn, *args):
+    out = amp.autocast(fn)(*args)
+    return out.dtype
+
+
+def test_whitelist_matmul_runs_bf16():
+    x = jnp.ones((4, 8));  w = jnp.ones((8, 16))
+    out = amp.autocast(lambda x, w: x @ w)(x, w)
+    assert out.dtype == jnp.bfloat16
+
+
+def test_whitelist_conv_runs_bf16():
+    x = jnp.ones((1, 8, 8, 3))
+    k = jnp.ones((3, 3, 3, 4))
+    fn = lambda x, k: jax.lax.conv_general_dilated(
+        x, k, (1, 1), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC")
+    )
+    out = amp.autocast(fn)(x, k)
+    assert out.dtype == jnp.bfloat16
+
+
+def test_blacklist_exp_stays_fp32():
+    x = jnp.ones((4, 8)); w = jnp.ones((8, 8)) * 0.1
+    out = amp.autocast(lambda x, w: jnp.exp(x @ w))(x, w)
+    # matmul produced bf16, exp must cast back up
+    assert out.dtype == jnp.float32
+
+
+def test_blacklist_softmax_numerics():
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 128)) * 10
+    w = jnp.eye(128)
+    ref = jax.nn.softmax(x)
+    got = amp.autocast(lambda x, w: jax.nn.softmax(x @ w))(x, w)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(got, np.float32), atol=2e-2)
+
+
+def test_promote_mixed_dtypes():
+    a = jnp.ones((4,), jnp.bfloat16)
+    b = jnp.ones((4,), jnp.float32)
+    out = amp.autocast(lambda a, b: a + b)(a, b)
+    assert out.dtype == jnp.float32
+
+
+def test_fp16_compute_dtype():
+    x = jnp.ones((4, 8)); w = jnp.ones((8, 16))
+    out = amp.autocast(lambda x, w: x @ w, compute_dtype=jnp.float16)(x, w)
+    assert out.dtype == jnp.float16
+
+
+def test_autocast_disabled_is_identity():
+    f = lambda x: x * 2
+    assert amp.autocast(f, enabled=False) is f
+
+
+def test_autocast_under_jit_and_grad():
+    x = jnp.ones((4, 8)); w = jnp.full((8, 8), 0.05)
+    fn = amp.autocast(lambda x, w: jnp.exp(x @ w).sum())
+    g = jax.jit(jax.grad(fn, argnums=1))(x, w)
+    assert g.shape == (8, 8) and g.dtype == jnp.float32
+    ref = jax.grad(lambda x, w: jnp.exp(x @ w).sum(), argnums=1)(x, w)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(ref), rtol=2e-2)
+
+
+def test_autocast_scan_cond_while():
+    x = jnp.ones((4, 8)); w = jnp.eye(8) * 1.01
+
+    def f_scan(x):
+        out, _ = jax.lax.scan(lambda c, _: (c @ w, None), x, None, length=3)
+        return out.sum()
+
+    def f_cond(x):
+        return jax.lax.cond(x.sum() > 0, lambda v: (v @ w).sum(), lambda v: v.sum(), x)
+
+    def f_while(x):
+        def body(c):
+            return (c[0] @ w, c[1] + 1)
+        out, _ = jax.lax.while_loop(lambda c: c[1] < 3, body, (x, 0))
+        return out.sum()
+
+    for f in (f_scan, f_cond, f_while):
+        ref = float(f(x))
+        got = float(amp.autocast(f)(x))
+        assert abs(ref - got) / abs(ref) < 2e-2, f
+
+
+def test_half_and_float_function_registration():
+    # ref apex/amp/amp.py:30-64 decorator API
+    captured = {}
+
+    @amp.half_function
+    def my_gemm(x):
+        captured["dtype"] = x.dtype
+        return x
+
+    @amp.float_function
+    def my_loss(x):
+        captured["loss_dtype"] = x.dtype
+        return x
+
+    x = jnp.ones((4,), jnp.float32)
+    # outside autocast: no casting
+    my_gemm(x)
+    assert captured["dtype"] == jnp.float32
+
+    def model(x):
+        y = my_gemm(x)
+        return my_loss(y.astype(jnp.bfloat16)).sum()
+
+    amp.autocast(model)(x)
+    assert captured["dtype"] == jnp.bfloat16
+    assert captured["loss_dtype"] == jnp.float32
+
+
+# ---------------------------------------------------------------------------
+# Loss scaler — ref tests/L0/run_amp test of scale update + overflow handling
+
+
+def test_dynamic_scaler_growth_and_backoff():
+    scaler = amp.LossScaler("dynamic", init_scale=2.0 ** 8, scale_window=4)
+    state = scaler.init_state()
+    ok = jnp.asarray(0.0)
+    bad = jnp.asarray(1.0)
+    # 4 clean steps -> double
+    for _ in range(4):
+        state, skipped = scaler.update_scale(state, ok)
+    assert float(state.loss_scale) == 2.0 ** 9
+    assert int(state.unskipped) == 0
+    # overflow -> halve + reset
+    state, skipped = scaler.update_scale(state, bad)
+    assert bool(skipped)
+    assert float(state.loss_scale) == 2.0 ** 8
+    assert int(state.unskipped) == 0
+
+
+def test_dynamic_scaler_bounds():
+    scaler = amp.LossScaler("dynamic", init_scale=2.0, min_loss_scale=1.0,
+                            max_loss_scale=4.0, scale_window=1)
+    state = scaler.init_state()
+    state, _ = scaler.update_scale(state, jnp.asarray(1.0))
+    assert float(state.loss_scale) == 1.0
+    state, _ = scaler.update_scale(state, jnp.asarray(1.0))
+    assert float(state.loss_scale) == 1.0  # clamped below
+    for _ in range(5):
+        state, _ = scaler.update_scale(state, jnp.asarray(0.0))
+    assert float(state.loss_scale) == 4.0  # clamped above
+
+
+def test_static_scaler_never_updates():
+    scaler = amp.LossScaler(128.0)
+    state = scaler.init_state()
+    state, skipped = scaler.update_scale(state, jnp.asarray(1.0))
+    assert float(state.loss_scale) == 128.0
+    assert bool(skipped)  # still skips the step on overflow
+
+
+def test_unscale_detects_inf_and_nan():
+    scaler = amp.LossScaler("dynamic")
+    state = scaler.init_state()
+    good = {"a": jnp.ones((4,)), "b": jnp.ones((2, 2))}
+    for poison in (jnp.inf, jnp.nan):
+        bad = {"a": jnp.ones((4,)).at[1].set(poison), "b": jnp.ones((2, 2))}
+        _, found = scaler.unscale(bad, state)
+        assert float(found) == 1.0
+    _, found = scaler.unscale(good, state)
+    assert float(found) == 0.0
+
+
+def test_unscale_divides_by_scale():
+    scaler = amp.LossScaler(16.0)
+    state = scaler.init_state()
+    grads = {"w": jnp.full((3,), 32.0, jnp.bfloat16)}
+    out, _ = scaler.unscale(grads, state)
+    assert out["w"].dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(out["w"]), 2.0)
+
+
+# ---------------------------------------------------------------------------
+# O0-O3 presets + O2 end-to-end — ref frontend.py policies + _process_optimizer
+
+
+def test_opt_level_presets():
+    o0 = amp.get_policy("O0")
+    assert o0.cast_model_type is None and o0.master_weights is False
+    o1 = amp.get_policy("O1")
+    assert o1.compute_dtype == jnp.bfloat16 and o1.loss_scale == "dynamic"
+    o2 = amp.get_policy("O2")
+    assert o2.cast_model_type == jnp.bfloat16
+    assert o2.keep_batchnorm_fp32 is True and o2.master_weights is True
+    o3 = amp.get_policy("O3")
+    assert o3.keep_batchnorm_fp32 is False and o3.loss_scale == 1.0
+    with pytest.raises(ValueError):
+        amp.get_policy("O4")
+
+
+def test_policy_overrides():
+    p = amp.get_policy("O2", loss_scale=512.0, keep_batchnorm_fp32=False)
+    assert p.loss_scale == 512.0 and p.keep_batchnorm_fp32 is False
+
+
+def test_o2_keeps_norm_params_fp32():
+    params = {
+        "Dense_0": {"kernel": jnp.ones((8, 4))},
+        "BatchNorm_0": {"scale": jnp.ones((4,)), "bias": jnp.zeros((4,))},
+        "layer_norm": {"scale": jnp.ones((4,))},
+    }
+    state, policy = amp.initialize(params, "O2")
+    mp = amp.model_params(state)
+    assert mp["Dense_0"]["kernel"].dtype == jnp.bfloat16
+    assert mp["BatchNorm_0"]["scale"].dtype == jnp.float32
+    assert mp["layer_norm"]["scale"].dtype == jnp.float32
+    # masters stay fp32
+    assert state.master_params["Dense_0"]["kernel"].dtype == jnp.float32
+
+
+def test_o3_casts_everything():
+    params = {"BatchNorm_0": {"scale": jnp.ones((4,))}}
+    state, _ = amp.initialize(params, "O3")
+    assert amp.model_params(state)["BatchNorm_0"]["scale"].dtype == jnp.bfloat16
+
+
+def test_o2_step_and_overflow_skip():
+    params = {"w": jnp.ones((8, 4))}
+    state, _ = amp.initialize(params, "O2")
+    x = jnp.ones((2, 8))
+
+    def sgd(g, p):
+        return jax.tree_util.tree_map(lambda pi, gi: pi - 0.1 * gi, p, g)
+
+    @jax.jit
+    def step(state):
+        mp = amp.model_params(state)
+
+        def loss_fn(p):
+            return amp.scale_loss(((x @ p["w"].astype(jnp.float32)) ** 2).mean(), state)
+
+        grads = jax.grad(loss_fn)(mp)
+        return amp.apply_grads(state, grads, sgd)
+
+    state2, skipped = step(state)
+    assert not bool(skipped)
+    assert float(state2.master_params["w"][0, 0]) < 1.0  # actually stepped
+    assert state2.master_params["w"].dtype == jnp.float32
+
+    @jax.jit
+    def step_inf(state):
+        grads = {"w": jnp.full((8, 4), jnp.inf)}
+        return amp.apply_grads(state, grads, sgd)
+
+    state3, skipped3 = step_inf(state)
+    assert bool(skipped3)
+    np.testing.assert_array_equal(
+        np.asarray(state3.master_params["w"]), np.asarray(state.master_params["w"])
+    )
+    assert float(state3.scaler.loss_scale) == float(state.scaler.loss_scale) / 2
+
+
+def test_checkpoint_roundtrip():
+    # ref tests/L0/run_amp/test_checkpointing.py + frontend.py:361-401
+    params = {"w": jnp.ones((2,))}
+    state, _ = amp.initialize(params, "O2")
+    scaler = amp.LossScaler("dynamic")
+    # advance the scaler a bit
+    s = state.scaler
+    for _ in range(3):
+        s, _ = scaler.update_scale(s, jnp.asarray(0.0))
+    state = state._replace(scaler=s)
+    d = amp.state_dict(state)
+    assert d["loss_scaler0"]["unskipped"] == 3
+    restored = amp.load_state_dict(state, d)
+    assert int(restored.scaler.unskipped) == 3
+    assert float(restored.scaler.loss_scale) == float(s.loss_scale)
+
+
+def test_two_models_independent_scalers():
+    # ref test_multiple_models_optimizers_losses.py: per-loss scaler state
+    pa, _ = amp.initialize({"w": jnp.ones((2,))}, "O2")
+    pb, _ = amp.initialize({"w": jnp.ones((2,))}, "O2")
+    sgd = lambda g, p: p
+    pa2, _ = amp.apply_grads(pa, {"w": jnp.full((2,), jnp.inf)}, sgd)
+    pb2, _ = amp.apply_grads(pb, {"w": jnp.ones((2,))}, sgd)
+    assert float(pa2.scaler.loss_scale) == 2.0 ** 15
+    assert float(pb2.scaler.loss_scale) == 2.0 ** 16
+
+
+def test_found_inf_allreduce_across_mesh(mesh8):
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    def body(flag):
+        return amp.LossScaler.all_reduce_found_inf(flag, "dp")
+
+    f = shard_map(body, mesh=mesh8, in_specs=P("dp"), out_specs=P("dp"))
+    flags = jnp.zeros((8,)).at[3].set(1.0)
+    out = f(flags)
+    np.testing.assert_array_equal(np.asarray(out), np.ones((8,)))
