@@ -22,6 +22,7 @@ from apex_tpu.amp.autocast import (  # noqa: F401
 from apex_tpu.amp.frontend import (  # noqa: F401
     AmpState,
     apply_grads,
+    apply_grads_with_optimizer,
     cast_inputs,
     cast_params,
     default_norm_predicate,
@@ -39,6 +40,7 @@ __all__ = [
     "LossScaler",
     "LossScalerState",
     "apply_grads",
+    "apply_grads_with_optimizer",
     "autocast",
     "cast_inputs",
     "cast_params",

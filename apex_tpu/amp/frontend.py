@@ -233,6 +233,30 @@ def scale_loss(loss: jnp.ndarray, state: AmpState) -> jnp.ndarray:
     return make_scaler(state.policy).scale_loss(loss, state.scaler)
 
 
+def _unscale_and_check(state: AmpState, grads: Any, mp_axes):
+    """Shared unscale + overflow-check + scale-update prelude."""
+    scaler = make_scaler(state.policy)
+    out_dtype = jnp.float32 if state.policy.master_weights else None
+    grads, found_inf = scaler.unscale(grads, state.scaler, out_dtype=out_dtype)
+    if mp_axes is not None:
+        found_inf = LossScaler.all_reduce_found_inf(found_inf, mp_axes)
+    new_scaler_state, skipped = scaler.update_scale(state.scaler, found_inf)
+    return grads, new_scaler_state, skipped
+
+
+def _guard_tree(skipped, new, old):
+    """where-guard instead of lax.cond: both sides are cheap elementwise; a
+    select keeps the step shape static and fuses (ref skip-step semantics,
+    handle.py:131-158). Non-array leaves roll back too when eager."""
+    return jax.tree_util.tree_map(
+        lambda n, o: jnp.where(skipped, o, n)
+        if hasattr(n, "dtype")
+        else (o if skipped else n),
+        new,
+        old,
+    )
+
+
 def apply_grads(
     state: AmpState,
     grads: Any,
@@ -249,24 +273,39 @@ def apply_grads(
     Megatron GradScaler behavior, ``transformer/amp/grad_scaler.py:25-60``).
     Returns ``(new_state, skipped)``.
     """
-    scaler = make_scaler(state.policy)
-    out_dtype = jnp.float32 if state.policy.master_weights else None
-    grads, found_inf = scaler.unscale(grads, state.scaler, out_dtype=out_dtype)
-    if mp_axes is not None:
-        found_inf = LossScaler.all_reduce_found_inf(found_inf, mp_axes)
-    new_scaler_state, skipped = scaler.update_scale(state.scaler, found_inf)
+    grads, new_scaler_state, skipped = _unscale_and_check(state, grads, mp_axes)
     new_masters = update_fn(grads, state.master_params)
-    # where-guard instead of lax.cond: both sides are cheap elementwise; a
-    # select keeps the step shape static and fuses (ref skip-step semantics,
-    # handle.py:131-158).
-    guarded = jax.tree_util.tree_map(
-        lambda new, old: jnp.where(skipped, old, new)
-        if hasattr(new, "dtype")
-        else (old if skipped else new),
-        new_masters,
-        state.master_params,
-    )
+    guarded = _guard_tree(skipped, new_masters, state.master_params)
     return AmpState(guarded, new_scaler_state, state.policy, state.is_norm_param), skipped
+
+
+def apply_grads_with_optimizer(
+    state: AmpState,
+    grads: Any,
+    tx,  # optax.GradientTransformation
+    opt_state: Any,
+    mp_axes: Optional[Any] = None,
+) -> Tuple[AmpState, Any, jnp.ndarray]:
+    """:func:`apply_grads` specialized for an optax transform: unscale, check
+    overflow, run ``tx.update`` on the masters, guard both the params and the
+    optimizer state on overflow. Returns ``(amp_state, opt_state, skipped)``.
+
+    This is the whole of the reference's patched ``optimizer.step`` +
+    ``_post_amp_backward`` pipeline (``_process_optimizer.py:161-204,345-365``)
+    in one call.
+    """
+    from apex_tpu.optimizers._common import apply_updates
+
+    grads, new_scaler_state, skipped = _unscale_and_check(state, grads, mp_axes)
+    updates, new_opt_state = tx.update(grads, opt_state, state.master_params)
+    new_masters = apply_updates(state.master_params, updates)
+    guarded_params = _guard_tree(skipped, new_masters, state.master_params)
+    guarded_opt = _guard_tree(skipped, new_opt_state, opt_state)
+    return (
+        AmpState(guarded_params, new_scaler_state, state.policy, state.is_norm_param),
+        guarded_opt,
+        skipped,
+    )
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,38 @@
+"""Fused optimizer suite (L3) — ref ``apex/optimizers/__init__.py``.
+
+Each is an optax-style ``GradientTransformation`` factory reproducing the
+reference kernel's update math exactly; "fused" on TPU means the whole pytree
+update compiles to a handful of XLA loops under jit (the capability the
+reference needs ``multi_tensor_applier`` + chunked CUDA kernels for).
+"""
+
+from apex_tpu.optimizers.fused_adam import FusedAdam, FusedAdamState  # noqa: F401
+from apex_tpu.optimizers.fused_adagrad import (  # noqa: F401
+    FusedAdagrad,
+    FusedAdagradState,
+)
+from apex_tpu.optimizers.fused_lamb import (  # noqa: F401
+    FusedLAMB,
+    FusedLAMBState,
+    FusedMixedPrecisionLamb,
+)
+from apex_tpu.optimizers.fused_novograd import (  # noqa: F401
+    FusedNovoGrad,
+    FusedNovoGradState,
+)
+from apex_tpu.optimizers.fused_sgd import FusedSGD, FusedSGDState  # noqa: F401
+from apex_tpu.optimizers._common import apply_updates, global_norm  # noqa: F401
+from apex_tpu.parallel.larc import LARC, larc_transform  # noqa: F401
+
+__all__ = [
+    "FusedAdam",
+    "FusedAdagrad",
+    "FusedLAMB",
+    "FusedMixedPrecisionLamb",
+    "FusedNovoGrad",
+    "FusedSGD",
+    "LARC",
+    "apply_updates",
+    "global_norm",
+    "larc_transform",
+]

@@ -1,0 +1,59 @@
+"""Shared plumbing for the fused optimizer suite.
+
+The reference's optimizers exist because eager PyTorch launches one kernel per
+tensor per op; ``multi_tensor_applier`` batches the whole param list into a few
+chunked kernels (ref ``apex/multi_tensor_apply/multi_tensor_apply.py:3-30``,
+``csrc/multi_tensor_apply.cuh:16-70``). Under XLA a jitted update over the
+param pytree compiles to the same handful of fused loops, so the TPU-native
+design is: **optimizer = optax-style pure transform over pytrees**; the
+"fused" quality comes from jit, not a special kernel. Each optimizer below
+reproduces the reference's update *math* exactly (cited per file) and follows
+the optax ``GradientTransformation`` protocol so it composes with the JAX
+ecosystem.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import optax
+
+Schedule = Union[float, Callable[[jnp.ndarray], jnp.ndarray]]
+
+
+def value_at(lr: Schedule, count: jnp.ndarray) -> jnp.ndarray:
+    return lr(count) if callable(lr) else jnp.asarray(lr, jnp.float32)
+
+
+def tree_map(f, *trees, is_leaf=None):
+    return jax.tree_util.tree_map(f, *trees, is_leaf=is_leaf)
+
+
+def global_norm(tree) -> jnp.ndarray:
+    """L2 norm over the whole pytree (ref ``amp_C.multi_tensor_l2norm``
+    per-tensor + reduction, ``csrc/multi_tensor_l2norm_kernel.cu``)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.asarray(0.0, jnp.float32)
+    return jnp.sqrt(
+        sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in leaves)
+    )
+
+
+def apply_updates(params, updates):
+    """params + updates, preserving each param's dtype (masters stay fp32)."""
+    return tree_map(
+        lambda p, u: (p.astype(jnp.float32) + u.astype(jnp.float32)).astype(p.dtype),
+        params,
+        updates,
+    )
+
+
+class ScaleByStep(NamedTuple):
+    count: jnp.ndarray
+
+
+def chain(*transforms) -> optax.GradientTransformation:
+    return optax.chain(*transforms)

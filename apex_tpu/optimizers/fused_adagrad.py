@@ -1,0 +1,68 @@
+"""FusedAdagrad.
+
+Reference: ``apex/optimizers/fused_adagrad.py:5-122`` and
+``csrc/multi_tensor_adagrad.cu``:
+
+MODE_0 (L2, default)::
+
+    g += weight_decay * p
+    h += g*g
+    p -= lr * g / (sqrt(h) + eps)
+
+MODE_1 (adagrad_w, decoupled)::
+
+    h += g*g
+    p -= lr * (g / (sqrt(h) + eps) + weight_decay * p)
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax.numpy as jnp
+import optax
+
+from apex_tpu.optimizers._common import Schedule, tree_map, value_at
+
+
+class FusedAdagradState(NamedTuple):
+    count: jnp.ndarray
+    sum: Any  # accumulated squared grads ("sum" in torch/apex state)
+
+
+def FusedAdagrad(
+    lr: Schedule = 1e-2,
+    eps: float = 1e-10,
+    weight_decay: float = 0.0,
+    adagrad_w_mode: bool = False,
+) -> optax.GradientTransformation:
+    def init(params):
+        return FusedAdagradState(
+            count=jnp.zeros((), jnp.int32),
+            sum=tree_map(lambda p: jnp.zeros(p.shape, jnp.float32), params),
+        )
+
+    def update(grads, state, params):
+        if params is None:
+            raise ValueError("FusedAdagrad requires params in update()")
+        count = state.count + 1
+        step_lr = value_at(lr, count)
+
+        def leaf(g, p, h):
+            g = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            if not adagrad_w_mode and weight_decay != 0.0:
+                g = g + weight_decay * p32
+            h_new = h + g * g
+            upd = g / (jnp.sqrt(h_new) + eps)
+            if adagrad_w_mode and weight_decay != 0.0:
+                upd = upd + weight_decay * p32
+            return (-step_lr * upd).astype(p.dtype), h_new
+
+        flat = tree_map(leaf, grads, params, state.sum)
+        is_t = lambda x: isinstance(x, tuple)
+        updates = tree_map(lambda t: t[0], flat, is_leaf=is_t)
+        sums = tree_map(lambda t: t[1], flat, is_leaf=is_t)
+        return updates, FusedAdagradState(count, sums)
+
+    return optax.GradientTransformation(init, update)

@@ -1,0 +1,150 @@
+"""FusedLAMB — layer-wise adaptive moments with global grad-norm clipping.
+
+Reference: ``apex/optimizers/fused_lamb.py:4-214`` (driver computing per-tensor
+L2 norms via ``multi_tensor_l2norm`` at ``:124-133``, then the two-stage
+``multi_tensor_lamb``) and ``csrc/multi_tensor_lamb.cu:41``:
+
+stage 1 (per element)::
+
+    clip = max_grad_norm > 0 and global_grad_norm > max_grad_norm
+           ? global_grad_norm / max_grad_norm : 1
+    g' = g / clip
+    m = b1*m + beta3*g'            (beta3 = 1-b1 when grad_averaging else 1)
+    v = b2*v + (1-b2)*g'*g'
+    update = (m/c1) / (sqrt(v/c2) + eps) + weight_decay * p
+
+stage 2 (per tensor)::
+
+    w_norm = ||p||,  u_norm = ||update||
+    ratio  = (w_norm > 0 and u_norm > 0) ? w_norm / u_norm : 1
+    applied only when weight_decay != 0, unless use_nvlamb
+    p -= lr * ratio * update
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from apex_tpu.optimizers._common import Schedule, global_norm, tree_map, value_at
+
+
+class FusedLAMBState(NamedTuple):
+    count: jnp.ndarray
+    mu: Any
+    nu: Any
+
+
+def FusedLAMB(
+    lr: Schedule = 1e-3,
+    bias_correction: bool = True,
+    betas: Tuple[float, float] = (0.9, 0.999),
+    eps: float = 1e-6,
+    weight_decay: float = 0.01,
+    amsgrad: bool = False,
+    adam_w_mode: bool = True,
+    grad_averaging: bool = True,
+    max_grad_norm: float = 1.0,
+    use_nvlamb: bool = False,
+) -> optax.GradientTransformation:
+    if amsgrad:
+        raise RuntimeError("FusedLAMB does not support the AMSGrad variant.")
+    if not adam_w_mode:
+        raise RuntimeError(
+            "FusedLAMB only supports the decoupled (adamw) decay mode, "
+            "as in the reference kernel."
+        )
+    b1, b2 = betas
+    beta3 = (1.0 - b1) if grad_averaging else 1.0
+
+    def init(params):
+        zeros = lambda p: jnp.zeros(p.shape, jnp.float32)
+        return FusedLAMBState(
+            count=jnp.zeros((), jnp.int32),
+            mu=tree_map(zeros, params),
+            nu=tree_map(zeros, params),
+        )
+
+    def update(grads, state, params):
+        if params is None:
+            raise ValueError("FusedLAMB requires params in update()")
+        count = state.count + 1
+        step_lr = value_at(lr, count)
+        t = count.astype(jnp.float32)
+        c1 = 1.0 - jnp.power(b1, t) if bias_correction else jnp.asarray(1.0)
+        c2 = 1.0 - jnp.power(b2, t) if bias_correction else jnp.asarray(1.0)
+
+        # global grad norm over every param (ref fused_lamb.py:124-133)
+        gnorm = global_norm(grads)
+        if max_grad_norm > 0:
+            clip = jnp.where(gnorm > max_grad_norm, gnorm / max_grad_norm, 1.0)
+        else:
+            clip = jnp.asarray(1.0)
+
+        def leaf(g, p, m, v):
+            g = g.astype(jnp.float32) / clip
+            p32 = p.astype(jnp.float32)
+            m_new = b1 * m + beta3 * g
+            v_new = b2 * v + (1.0 - b2) * g * g
+            upd = (m_new / c1) / (jnp.sqrt(v_new / c2) + eps)
+            if weight_decay != 0.0:
+                upd = upd + weight_decay * p32
+            w_norm = jnp.sqrt(jnp.sum(p32 * p32))
+            u_norm = jnp.sqrt(jnp.sum(upd * upd))
+            ratio = jnp.where(
+                (w_norm > 0) & (u_norm > 0), w_norm / u_norm, 1.0
+            )
+            if weight_decay == 0.0 and not use_nvlamb:
+                ratio = jnp.asarray(1.0)
+            return (-step_lr * ratio * upd).astype(p.dtype), m_new, v_new
+
+        flat = tree_map(leaf, grads, params, state.mu, state.nu)
+        is_t = lambda x: isinstance(x, tuple)
+        updates = tree_map(lambda t3: t3[0], flat, is_leaf=is_t)
+        mu = tree_map(lambda t3: t3[1], flat, is_leaf=is_t)
+        nu = tree_map(lambda t3: t3[2], flat, is_leaf=is_t)
+        return updates, FusedLAMBState(count, mu, nu)
+
+    return optax.GradientTransformation(init, update)
+
+
+def FusedMixedPrecisionLamb(
+    lr: Schedule = 1e-3,
+    step: int = 0,
+    bias_correction: bool = True,
+    betas: Tuple[float, float] = (0.9, 0.999),
+    eps: float = 1e-6,
+    weight_decay: float = 0.01,
+    amsgrad: bool = False,
+    grad_averaging: bool = True,
+    max_grad_norm: float = 1.0,
+    use_nvlamb: bool = False,
+    reduced_precision_dtype=None,
+) -> optax.GradientTransformation:
+    """Mixed-precision LAMB (ref ``apex/optimizers/fused_mixed_precision_lamb.py:8``,
+    step ``:140``): fp32 master params/state with bf16/fp16 model params and a
+    ``grad_scaler`` argument.
+
+    In the functional design the fp32 masters + cast-on-forward live in
+    :mod:`apex_tpu.amp` (``initialize``/``model_params``/``apply_grads``), so
+    this is LAMB with an unscale hook: pass ``grad_scale`` (the current loss
+    scale) via ``optax``'s extra-args convention by wrapping grads before
+    ``update`` — or simply use :func:`apex_tpu.amp.apply_grads` with this
+    transform, which is the supported path. ``reduced_precision_dtype`` is
+    accepted for signature parity; dtype handling is the amp layer's job.
+    """
+    del step, reduced_precision_dtype
+    return FusedLAMB(
+        lr=lr,
+        bias_correction=bias_correction,
+        betas=betas,
+        eps=eps,
+        weight_decay=weight_decay,
+        amsgrad=amsgrad,
+        grad_averaging=grad_averaging,
+        max_grad_norm=max_grad_norm,
+        use_nvlamb=use_nvlamb,
+    )

@@ -1,0 +1,97 @@
+"""FusedNovoGrad — layer-wise normalized gradient descent with momentum.
+
+Reference: ``apex/optimizers/fused_novograd.py:4-135`` and
+``csrc/multi_tensor_novograd.cu``; the second moment is **per tensor**, not
+per element (the reference keeps a flat ``exp_avg_sq`` vector, one scalar per
+tensor, ``fused_novograd.py:95-100``):
+
+    norm = ||g||_2^2        (norm_type=2; norm_type=0 -> max|g|)
+    v    = norm                       on the first step (init_zero=False)
+         = b2*v + (1-b2)*norm         afterwards
+    d    = g / (sqrt(v) + eps) + weight_decay * p
+    m    = b1*m + beta3*d             (beta3 = 1-b1 when grad_averaging)
+    p   -= lr * m
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Tuple
+
+import jax.numpy as jnp
+import optax
+
+from apex_tpu.optimizers._common import Schedule, tree_map, value_at
+
+
+class FusedNovoGradState(NamedTuple):
+    count: jnp.ndarray
+    mu: Any  # per-element momentum
+    nu: Any  # per-TENSOR second moment (scalar per leaf)
+
+
+def FusedNovoGrad(
+    lr: Schedule = 1e-3,
+    bias_correction: bool = True,
+    betas: Tuple[float, float] = (0.95, 0.98),
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+    amsgrad: bool = False,
+    reg_inside_moment: bool = False,
+    grad_averaging: bool = True,
+    norm_type: int = 2,
+    init_zero: bool = False,
+) -> optax.GradientTransformation:
+    if amsgrad:
+        raise RuntimeError("FusedNovoGrad does not support the AMSGrad variant.")
+    if norm_type not in (0, 2):
+        raise ValueError("norm_type must be 2 (L2) or 0 (inf)")
+    b1, b2 = betas
+    beta3 = (1.0 - b1) if grad_averaging else 1.0
+
+    def init(params):
+        return FusedNovoGradState(
+            count=jnp.zeros((), jnp.int32),
+            mu=tree_map(lambda p: jnp.zeros(p.shape, jnp.float32), params),
+            nu=tree_map(lambda p: jnp.zeros((), jnp.float32), params),
+        )
+
+    def update(grads, state, params):
+        if params is None:
+            raise ValueError("FusedNovoGrad requires params in update()")
+        count = state.count + 1
+        step_lr = value_at(lr, count)
+        first = state.count == 0
+
+        def leaf(g, p, m, v):
+            g = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            if norm_type == 2:
+                norm = jnp.sum(g * g)
+            else:
+                norm = jnp.max(jnp.abs(g)) ** 2
+            if init_zero:
+                v_new = b2 * v + (1.0 - b2) * norm
+            else:
+                v_new = jnp.where(first, norm, b2 * v + (1.0 - b2) * norm)
+            denom = jnp.sqrt(v_new) + eps
+            d = g / denom
+            # reg_inside_moment=True folds decay into the momentum input;
+            # False (default) decouples it from the momentum ("MD" decay,
+            # ref fused_novograd.py:28-33 + multi_tensor_novograd.cu moment
+            # mode).
+            if weight_decay != 0.0 and reg_inside_moment:
+                d = d + weight_decay * p32
+            m_new = b1 * m + beta3 * d
+            step = m_new
+            if weight_decay != 0.0 and not reg_inside_moment:
+                step = step + weight_decay * p32
+            return (-step_lr * step).astype(p.dtype), m_new, v_new
+
+        flat = tree_map(leaf, grads, params, state.mu, state.nu)
+        is_t = lambda x: isinstance(x, tuple)
+        updates = tree_map(lambda t3: t3[0], flat, is_leaf=is_t)
+        mu = tree_map(lambda t3: t3[1], flat, is_leaf=is_t)
+        nu = tree_map(lambda t3: t3[2], flat, is_leaf=is_t)
+        return updates, FusedNovoGradState(count, mu, nu)
+
+    return optax.GradientTransformation(init, update)

@@ -1,0 +1,78 @@
+"""FusedSGD — SGD + momentum/dampening/nesterov with the reference math.
+
+Reference: ``apex/optimizers/fused_sgd.py:6-213`` (driver) and
+``csrc/multi_tensor_sgd_kernel.cu:30-140``:
+
+    d = g + weight_decay * p                  (wd before momentum, default)
+    buf = momentum * buf + (1 - dampening) * d     (first step: buf = d)
+    step = d + momentum * buf   if nesterov else buf
+    p -= lr * step
+
+``wd_after_momentum=True`` instead applies decay to the momentum-combined
+update (ref ``fused_sgd.py:46-52``, kernel ``wd_after_momentum`` branch).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax.numpy as jnp
+import optax
+
+from apex_tpu.optimizers._common import Schedule, tree_map, value_at
+
+
+class FusedSGDState(NamedTuple):
+    count: jnp.ndarray
+    momentum_buffer: Any
+
+
+def FusedSGD(
+    lr: Schedule = 1e-3,
+    momentum: float = 0.0,
+    dampening: float = 0.0,
+    weight_decay: float = 0.0,
+    nesterov: bool = False,
+    wd_after_momentum: bool = False,
+) -> optax.GradientTransformation:
+    if nesterov and (momentum <= 0 or dampening != 0):
+        raise ValueError("Nesterov momentum requires a momentum and zero dampening")
+
+    def init(params):
+        return FusedSGDState(
+            count=jnp.zeros((), jnp.int32),
+            momentum_buffer=tree_map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), params
+            ),
+        )
+
+    def update(grads, state, params):
+        if params is None:
+            raise ValueError("FusedSGD requires params in update()")
+        count = state.count + 1
+        step_lr = value_at(lr, count)
+        first = state.count == 0
+
+        def leaf(g, p, buf):
+            g = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            d = g if wd_after_momentum else g + weight_decay * p32
+            if momentum != 0.0:
+                # First step initializes buf = d (torch/apex semantics:
+                # momentum_buffer starts as a clone of d, not 0-decayed).
+                new_buf = jnp.where(first, d, momentum * buf + (1.0 - dampening) * d)
+                step = d + momentum * new_buf if nesterov else new_buf
+            else:
+                new_buf = buf
+                step = d
+            if wd_after_momentum:
+                step = step + weight_decay * p32
+            return (-step_lr * step).astype(p.dtype), new_buf
+
+        flat = tree_map(leaf, grads, params, state.momentum_buffer)
+        is_pair = lambda x: isinstance(x, tuple)
+        updates = tree_map(lambda t: t[0], flat, is_leaf=is_pair)
+        bufs = tree_map(lambda t: t[1], flat, is_leaf=is_pair)
+        return updates, FusedSGDState(count, bufs)
+
+    return optax.GradientTransformation(init, update)

@@ -1,0 +1,79 @@
+"""LARC — Layer-wise Adaptive Rate Clipping/scaling.
+
+Reference: ``apex/parallel/LARC.py:5-107``, a wrapper around any optimizer
+that rescales each param's gradient by an adaptive local LR before the inner
+step (``step`` at ``:78``)::
+
+    local_lr = trust_coefficient * ||p|| / (||g|| + weight_decay * ||p|| + eps)
+    clip mode:  g' = (g + wd*p) * min(local_lr / lr, 1)
+    scale mode: g' = (g + wd*p) * local_lr
+    params with ||p|| == 0 or ||g|| == 0 pass through unchanged
+
+The wrapper zeroes the inner optimizer's own weight decay (the reference
+temporarily sets group['weight_decay']=0 and folds wd into the grad) — so
+construct the inner transform with ``weight_decay=0``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import optax
+
+from apex_tpu.optimizers._common import Schedule, tree_map, value_at
+
+
+def larc_transform(
+    trust_coefficient: float = 0.02,
+    clip: bool = True,
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+    lr: Optional[Schedule] = None,
+) -> optax.GradientTransformation:
+    """The grad-rescaling stage as a standalone transform; chain it before the
+    inner optimizer: ``optax.chain(larc_transform(...), FusedSGD(lr, ...))``.
+    ``lr`` is required in clip mode (the reference divides by group['lr'])."""
+    if clip and lr is None:
+        raise ValueError("clip mode requires the lr used by the inner optimizer")
+
+    def init(params):
+        return optax.ScaleByScheduleState(count=jnp.zeros((), jnp.int32))
+
+    def update(grads, state, params):
+        if params is None:
+            raise ValueError("LARC requires params in update()")
+        count = state.count + 1
+        step_lr = value_at(lr, count) if lr is not None else None
+
+        def leaf(g, p):
+            g32 = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            p_norm = jnp.sqrt(jnp.sum(p32 * p32))
+            g_norm = jnp.sqrt(jnp.sum(g32 * g32))
+            adaptive = (
+                trust_coefficient * p_norm / (g_norm + weight_decay * p_norm + eps)
+            )
+            if clip:
+                adaptive = jnp.minimum(adaptive / step_lr, 1.0)
+            adaptive = jnp.where((p_norm > 0) & (g_norm > 0), adaptive, 1.0)
+            out = (g32 + weight_decay * p32) * adaptive
+            return out.astype(g.dtype)
+
+        return tree_map(leaf, grads, params), optax.ScaleByScheduleState(count=count)
+
+    return optax.GradientTransformation(init, update)
+
+
+def LARC(
+    inner: optax.GradientTransformation,
+    trust_coefficient: float = 0.02,
+    clip: bool = True,
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+    lr: Optional[Schedule] = None,
+) -> optax.GradientTransformation:
+    """Wrap ``inner`` with LARC (ref ``LARC.py:5`` constructor semantics)."""
+    return optax.chain(
+        larc_transform(trust_coefficient, clip, eps, weight_decay, lr), inner
+    )

@@ -309,3 +309,32 @@ def test_found_inf_allreduce_across_mesh(mesh8):
     flags = jnp.zeros((8,)).at[3].set(1.0)
     out = f(flags)
     np.testing.assert_array_equal(np.asarray(out), np.ones((8,)))
+
+
+def test_apply_grads_with_optimizer_guards_opt_state():
+    from apex_tpu import optimizers as opt
+
+    params = {"w": jnp.ones((4,))}
+    state, _ = amp.initialize(params, "O2")
+    tx = opt.FusedAdam(lr=1e-2)
+    opt_state = tx.init(state.master_params)
+
+    state2, opt2, sk = jax.jit(
+        lambda s, o: amp.apply_grads_with_optimizer(s, {"w": jnp.ones((4,))}, tx, o)
+    )(state, opt_state)
+    assert not bool(sk)
+    assert int(opt2.count) == 1
+    assert float(state2.master_params["w"][0]) < 1.0
+
+    # overflow: params AND optimizer state roll back together
+    state3, opt3, sk3 = jax.jit(
+        lambda s, o: amp.apply_grads_with_optimizer(s, {"w": jnp.full((4,), jnp.nan)}, tx, o)
+    )(state2, opt2)
+    assert bool(sk3)
+    assert int(opt3.count) == int(opt2.count)
+    np.testing.assert_array_equal(
+        np.asarray(opt3.mu["w"]), np.asarray(opt2.mu["w"])
+    )
+    np.testing.assert_array_equal(
+        np.asarray(state3.master_params["w"]), np.asarray(state2.master_params["w"])
+    )

@@ -1,0 +1,222 @@
+"""Fused optimizer golden tests — ref tests/L0/run_optimizers/test_fused_optimizer.py
+pattern: same init, same grads, compare params within max_abs_diff against a
+reference implementation (torch.optim where one exists, hand-computed math
+otherwise)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import torch
+
+from apex_tpu import optimizers as opt
+from apex_tpu.optimizers import apply_updates
+
+
+def _rand_tree(seed=0, shapes=((7, 3), (11,), (2, 5, 3))):
+    rng = np.random.RandomState(seed)
+    params = {f"p{i}": rng.randn(*s).astype(np.float32) for i, s in enumerate(shapes)}
+    grads = {f"p{i}": rng.randn(*s).astype(np.float32) for i, s in enumerate(shapes)}
+    return params, grads
+
+
+def _run_jax(tx, params_np, grads_seq):
+    params = jax.tree_util.tree_map(jnp.asarray, params_np)
+    state = tx.init(params)
+
+    @jax.jit
+    def step(params, state, grads):
+        updates, state = tx.update(grads, state, params)
+        return apply_updates(params, updates), state
+
+    for g in grads_seq:
+        params, state = step(params, state, jax.tree_util.tree_map(jnp.asarray, g))
+    return jax.tree_util.tree_map(np.asarray, params)
+
+
+def _run_torch(opt_ctor, params_np, grads_seq):
+    tparams = {k: torch.nn.Parameter(torch.tensor(v)) for k, v in params_np.items()}
+    optimizer = opt_ctor(list(tparams.values()))
+    for g in grads_seq:
+        for k, p in tparams.items():
+            p.grad = torch.tensor(g[k])
+        optimizer.step()
+    return {k: p.detach().numpy() for k, p in tparams.items()}
+
+
+def _grad_seq(n=5, seed=1):
+    rng = np.random.RandomState(seed)
+    params, _ = _rand_tree()
+    return [
+        {k: rng.randn(*v.shape).astype(np.float32) for k, v in params.items()}
+        for _ in range(n)
+    ]
+
+
+@pytest.mark.parametrize("adam_w,wd", [(True, 0.0), (True, 0.1), (False, 0.0), (False, 0.1)])
+def test_fused_adam_matches_torch(adam_w, wd):
+    params, _ = _rand_tree()
+    grads_seq = _grad_seq()
+    got = _run_jax(
+        opt.FusedAdam(lr=1e-2, weight_decay=wd, adam_w_mode=adam_w), params, grads_seq
+    )
+    ctor = (
+        (lambda ps: torch.optim.AdamW(ps, lr=1e-2, weight_decay=wd))
+        if adam_w
+        else (lambda ps: torch.optim.Adam(ps, lr=1e-2, weight_decay=wd))
+    )
+    want = _run_torch(ctor, params, grads_seq)
+    for k in params:
+        np.testing.assert_allclose(got[k], want[k], atol=2e-5, err_msg=k)
+
+
+@pytest.mark.parametrize(
+    "momentum,nesterov,wd", [(0.0, False, 0.0), (0.9, False, 0.0), (0.9, True, 0.0), (0.9, False, 0.05)]
+)
+def test_fused_sgd_matches_torch(momentum, nesterov, wd):
+    params, _ = _rand_tree()
+    grads_seq = _grad_seq()
+    got = _run_jax(
+        opt.FusedSGD(lr=1e-2, momentum=momentum, nesterov=nesterov, weight_decay=wd),
+        params,
+        grads_seq,
+    )
+    want = _run_torch(
+        lambda ps: torch.optim.SGD(
+            ps, lr=1e-2, momentum=momentum, nesterov=nesterov, weight_decay=wd
+        ),
+        params,
+        grads_seq,
+    )
+    for k in params:
+        np.testing.assert_allclose(got[k], want[k], atol=2e-5, err_msg=k)
+
+
+@pytest.mark.parametrize("wd", [0.0, 0.1])
+def test_fused_adagrad_matches_torch(wd):
+    params, _ = _rand_tree()
+    grads_seq = _grad_seq()
+    got = _run_jax(opt.FusedAdagrad(lr=1e-2, weight_decay=wd), params, grads_seq)
+    want = _run_torch(
+        lambda ps: torch.optim.Adagrad(ps, lr=1e-2, weight_decay=wd, eps=1e-10),
+        params,
+        grads_seq,
+    )
+    for k in params:
+        np.testing.assert_allclose(got[k], want[k], atol=2e-5, err_msg=k)
+
+
+def _lamb_reference(params, grads_seq, lr, b1, b2, eps, wd, max_grad_norm):
+    """Hand implementation of the reference two-stage LAMB
+    (csrc/multi_tensor_lamb.cu:41 semantics)."""
+    m = {k: np.zeros_like(v) for k, v in params.items()}
+    v = {k: np.zeros_like(vv) for k, vv in params.items()}
+    p = {k: vv.copy() for k, vv in params.items()}
+    t = 0
+    for grads in grads_seq:
+        t += 1
+        gnorm = np.sqrt(sum(np.sum(g ** 2) for g in grads.values()))
+        clip = gnorm / max_grad_norm if (max_grad_norm > 0 and gnorm > max_grad_norm) else 1.0
+        c1 = 1 - b1 ** t
+        c2 = 1 - b2 ** t
+        for k in p:
+            g = grads[k] / clip
+            m[k] = b1 * m[k] + (1 - b1) * g
+            v[k] = b2 * v[k] + (1 - b2) * g * g
+            upd = (m[k] / c1) / (np.sqrt(v[k] / c2) + eps) + wd * p[k]
+            w_norm = np.sqrt(np.sum(p[k] ** 2))
+            u_norm = np.sqrt(np.sum(upd ** 2))
+            ratio = w_norm / u_norm if (w_norm > 0 and u_norm > 0) else 1.0
+            if wd == 0.0:
+                ratio = 1.0
+            p[k] = p[k] - lr * ratio * upd
+    return p
+
+
+@pytest.mark.parametrize("wd,mgn", [(0.01, 1.0), (0.0, 1.0), (0.1, 0.0)])
+def test_fused_lamb_matches_reference_math(wd, mgn):
+    params, _ = _rand_tree()
+    grads_seq = _grad_seq()
+    got = _run_jax(
+        opt.FusedLAMB(lr=1e-2, weight_decay=wd, max_grad_norm=mgn, eps=1e-6),
+        params,
+        grads_seq,
+    )
+    want = _lamb_reference(params, grads_seq, 1e-2, 0.9, 0.999, 1e-6, wd, mgn)
+    for k in params:
+        np.testing.assert_allclose(got[k], want[k], atol=3e-5, err_msg=k)
+
+
+def _novograd_reference(params, grads_seq, lr, b1, b2, eps, wd, grad_averaging):
+    m = {k: np.zeros_like(v) for k, v in params.items()}
+    v = {k: 0.0 for k in params}
+    p = {k: vv.copy() for k, vv in params.items()}
+    beta3 = (1 - b1) if grad_averaging else 1.0
+    first = True
+    for grads in grads_seq:
+        for k in p:
+            g = grads[k]
+            norm = np.sum(g * g)
+            v[k] = norm if first else b2 * v[k] + (1 - b2) * norm
+            d = g / (np.sqrt(v[k]) + eps)
+            m[k] = b1 * m[k] + beta3 * d
+            step = m[k] + wd * p[k]
+            p[k] = p[k] - lr * step
+        first = False
+    return p
+
+
+@pytest.mark.parametrize("wd", [0.0, 0.01])
+def test_fused_novograd_matches_reference_math(wd):
+    params, _ = _rand_tree()
+    grads_seq = _grad_seq()
+    got = _run_jax(
+        opt.FusedNovoGrad(lr=1e-2, betas=(0.95, 0.98), weight_decay=wd), params, grads_seq
+    )
+    want = _novograd_reference(params, grads_seq, 1e-2, 0.95, 0.98, 1e-8, wd, True)
+    for k in params:
+        np.testing.assert_allclose(got[k], want[k], atol=3e-5, err_msg=k)
+
+
+def test_larc_rescales_gradients():
+    # ref apex/parallel/LARC.py:78-107 semantics
+    params = {"w": np.full((4,), 2.0, np.float32)}   # |p| = 4
+    grads = {"w": np.full((4,), 0.001, np.float32)}  # tiny grads -> adaptive lr big -> clipped to 1
+    tx = opt.LARC(opt.FusedSGD(lr=0.1), trust_coefficient=0.02, clip=True, lr=0.1)
+    got = _run_jax(tx, params, [grads])
+    # clipped: min(0.02*|p|/(|g|)/lr, 1) = min(0.02*4/0.002/0.1, 1) = 1 -> plain SGD
+    np.testing.assert_allclose(got["w"], 2.0 - 0.1 * 0.001, rtol=1e-6)
+
+    # huge grads -> adaptive < 1 -> grad scaled down
+    big = {"w": np.full((4,), 100.0, np.float32)}  # |g| = 200
+    got2 = _run_jax(tx, params, [big])
+    adaptive = 0.02 * 4.0 / 200.0 / 0.1  # = 0.004
+    np.testing.assert_allclose(got2["w"], 2.0 - 0.1 * 100.0 * adaptive, rtol=1e-5)
+
+
+def test_zero_norm_params_passthrough_larc():
+    params = {"w": np.zeros((4,), np.float32)}
+    grads = {"w": np.ones((4,), np.float32)}
+    tx = opt.LARC(opt.FusedSGD(lr=0.1), clip=True, lr=0.1)
+    got = _run_jax(tx, params, [grads])
+    np.testing.assert_allclose(got["w"], -0.1, rtol=1e-6)  # adaptive forced to 1
+
+
+def test_bf16_params_fp32_state():
+    # mixed-precision capability: bf16 params, fp32 moments (ref
+    # fused_adam dtype grouping + FusedMixedPrecisionLamb fp32 state)
+    params = {"w": jnp.ones((8,), jnp.bfloat16)}
+    tx = opt.FusedAdam(lr=1e-2)
+    state = tx.init(params)
+    assert state.mu["w"].dtype == jnp.float32
+    updates, state = tx.update({"w": jnp.ones((8,), jnp.bfloat16)}, state, params)
+    assert updates["w"].dtype == jnp.bfloat16
+    new = apply_updates(params, updates)
+    assert new["w"].dtype == jnp.bfloat16
+
+
+def test_global_norm():
+    tree = {"a": jnp.ones((3,)), "b": jnp.full((4,), 2.0)}
+    np.testing.assert_allclose(float(opt.global_norm(tree)), np.sqrt(3 + 16), rtol=1e-6)
