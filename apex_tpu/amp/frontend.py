@@ -102,9 +102,10 @@ def get_policy(
 # Param casting (ref _initialize.py:177-203 + fp16_utils/fp16util.py:60)
 
 _NORM_COMPONENT = re.compile(
-    r"(batch_?norm|group_?norm|layer_?norm|rms_?norm|instance_?norm|sync_?batch_?norm"
-    r"|(bn|gn|ln|norm))(_?[a-z0-9]{0,3})?$",
-    re.IGNORECASE,
+    # after lowercasing and stripping underscores:
+    # [fused|mixedfused|sync]?[batch|group|layer|rms|instance]?norm[suffix]
+    r"((fused|mixedfused|sync)?(batch|group|layer|rms|instance)?norm[a-z0-9]{0,3}"
+    r"|(bn|gn|ln)[a-z0-9]{0,3})$"
 )
 
 
@@ -112,8 +113,13 @@ def default_norm_predicate(path: str) -> bool:
     """Heuristic for "is this a normalization param" from its pytree path —
     the analogue of ``convert_network`` skipping ``_BatchNorm`` modules
     (ref ``fp16_utils/fp16util.py:60-88``). Matches flax-style scope components
-    like ``BatchNorm_0``, ``layer_norm``, ``ln_f``, ``bn1``."""
-    return any(_NORM_COMPONENT.fullmatch(c) for c in path.split("/"))
+    like ``BatchNorm_0``, ``FusedLayerNorm_2``, ``layer_norm``, ``ln_f``,
+    ``bn1``. Pass a custom predicate to :func:`initialize` when your scopes
+    don't follow these conventions."""
+    return any(
+        _NORM_COMPONENT.fullmatch(c.lower().replace("_", ""))
+        for c in path.split("/")
+    )
 
 
 def _path_str(path) -> str:

@@ -1,0 +1,13 @@
+from apex_tpu.fused_dense.fused_dense import (  # noqa: F401
+    FusedDense,
+    FusedDenseGeluDense,
+    fused_dense,
+    fused_dense_gelu_dense,
+)
+
+__all__ = [
+    "FusedDense",
+    "FusedDenseGeluDense",
+    "fused_dense",
+    "fused_dense_gelu_dense",
+]

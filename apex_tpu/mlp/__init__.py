@@ -1,0 +1,3 @@
+from apex_tpu.mlp.mlp import MLP, mlp_forward  # noqa: F401
+
+__all__ = ["MLP", "mlp_forward"]

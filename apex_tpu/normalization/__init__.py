@@ -1,0 +1,18 @@
+"""Fused normalization modules (L3) — ref ``apex/normalization/__init__.py``."""
+
+from apex_tpu.normalization.fused_layer_norm import (  # noqa: F401
+    FusedLayerNorm,
+    FusedRMSNorm,
+    MixedFusedLayerNorm,
+    MixedFusedRMSNorm,
+)
+from apex_tpu.ops.layer_norm import layer_norm, rms_norm  # noqa: F401
+
+__all__ = [
+    "FusedLayerNorm",
+    "FusedRMSNorm",
+    "MixedFusedLayerNorm",
+    "MixedFusedRMSNorm",
+    "layer_norm",
+    "rms_norm",
+]

@@ -1,0 +1,90 @@
+"""FusedLayerNorm / FusedRMSNorm flax modules.
+
+Reference: ``apex/normalization/fused_layer_norm.py`` — ``FusedLayerNorm``
+(``:204``), ``FusedRMSNorm`` (``:300``), mixed-dtype Megatron variants
+(``MixedFusedLayerNorm``/``MixedFusedRMSNorm``, ``:398,420``), each binding
+``fused_layer_norm_cuda`` with a CPU fallback. Here the modules wrap the
+Pallas/XLA kernels in :mod:`apex_tpu.ops.layer_norm`; "mixed" means params are
+created fp32 and the computation runs fp32 regardless of input dtype, with the
+output cast back to the input dtype (the Megatron convention).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from apex_tpu.ops.layer_norm import layer_norm, rms_norm
+
+
+def _norm_shape(shape: Union[int, Sequence[int]]):
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(shape)
+
+
+class FusedLayerNorm(nn.Module):
+    """Layer norm over the trailing ``normalized_shape`` dims
+    (ref ``fused_layer_norm.py:204-298``)."""
+
+    normalized_shape: Union[int, Sequence[int]]
+    eps: float = 1e-5
+    elementwise_affine: bool = True
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        shape = _norm_shape(self.normalized_shape)
+        hidden = 1
+        for s in shape:
+            hidden *= s
+        lead = x.shape[: len(x.shape) - len(shape)]
+        x2 = x.reshape(lead + (hidden,))
+        if self.elementwise_affine:
+            w = self.param("scale", nn.initializers.ones, (hidden,), self.param_dtype)
+            b = self.param("bias", nn.initializers.zeros, (hidden,), self.param_dtype)
+        else:
+            w = b = None
+        y = layer_norm(x2, w, b, self.eps)
+        return y.reshape(x.shape)
+
+
+class FusedRMSNorm(nn.Module):
+    """RMS norm (ref ``fused_layer_norm.py:300-396``)."""
+
+    normalized_shape: Union[int, Sequence[int]]
+    eps: float = 1e-5
+    elementwise_affine: bool = True
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        shape = _norm_shape(self.normalized_shape)
+        hidden = 1
+        for s in shape:
+            hidden *= s
+        lead = x.shape[: len(x.shape) - len(shape)]
+        x2 = x.reshape(lead + (hidden,))
+        if self.elementwise_affine:
+            w = self.param("scale", nn.initializers.ones, (hidden,), self.param_dtype)
+        else:
+            w = None
+        y = rms_norm(x2, w, self.eps)
+        return y.reshape(x.shape)
+
+
+class MixedFusedLayerNorm(FusedLayerNorm):
+    """Megatron mixed-dtype variant (ref ``fused_layer_norm.py:398-418``):
+    fp32 params + fp32 math with bf16/fp16 I/O. The base kernels already
+    compute in fp32 and return x.dtype, so this is the base class with the
+    param dtype pinned fp32 — kept as a distinct name for API parity."""
+
+    param_dtype: jnp.dtype = jnp.float32
+
+
+class MixedFusedRMSNorm(FusedRMSNorm):
+    """Ref ``fused_layer_norm.py:420-438``."""
+
+    param_dtype: jnp.dtype = jnp.float32

@@ -1,0 +1,40 @@
+"""Kernel layer (L0) — Pallas TPU kernels + XLA-fused JAX, replacing csrc/.
+
+Mapping to the reference extensions (SURVEY.md §2.2):
+
+=============================================  =================================
+Reference CUDA ext                             apex_tpu equivalent
+=============================================  =================================
+``fused_layer_norm_cuda``, ``fast_layer_norm`` ``ops.layer_norm`` (Pallas)
+``scaled_masked_softmax_cuda`` (+causal)       ``ops.softmax``
+``xentropy_cuda``                              ``ops.xentropy``
+``mlp_cuda``, ``fused_dense_cuda``             ``apex_tpu.mlp`` / ``fused_dense``
+``fmhalib``, ``fast_multihead_attn``           ``ops.flash_attention``
+``amp_C`` multi-tensor kernels                 jit over pytrees (+``ops.multi_tensor``)
+``syncbn`` Welford kernels                     ``parallel.sync_batchnorm``
+=============================================  =================================
+"""
+
+from apex_tpu.ops.layer_norm import (  # noqa: F401
+    layer_norm,
+    layer_norm_reference,
+    rms_norm,
+    rms_norm_reference,
+)
+from apex_tpu.ops.softmax import (  # noqa: F401
+    scaled_masked_softmax,
+    scaled_softmax,
+    scaled_upper_triang_masked_softmax,
+)
+from apex_tpu.ops.xentropy import softmax_cross_entropy_loss  # noqa: F401
+
+__all__ = [
+    "layer_norm",
+    "layer_norm_reference",
+    "rms_norm",
+    "rms_norm_reference",
+    "scaled_masked_softmax",
+    "scaled_softmax",
+    "scaled_upper_triang_masked_softmax",
+    "softmax_cross_entropy_loss",
+]

@@ -1,0 +1,116 @@
+"""Fused scale + mask + softmax — the Megatron softmax kernels, TPU-style.
+
+Reference: ``csrc/megatron/scaled_masked_softmax*`` (padding-mask variant) and
+``scaled_upper_triang_masked_softmax*`` (causal variant), driven by
+``apex/transformer/functional/fused_softmax.py:21-199``. The CUDA kernels
+exist to fuse scale→mask→softmax→(bwd from saved output) into one pass and are
+shape-limited (fp16/bf16, sk ≤ 2048).
+
+TPU re-design: the fusion itself is XLA's bread and butter — a single jitted
+``scale→where→softmax`` chain compiles to one fused loop — so the kernels
+here are expressed as pure JAX with a ``custom_vjp`` that reproduces the
+reference's *backward-from-saved-softmax-output* memory trade (the reference
+saves the softmax output instead of the input, ``fused_softmax.py:30-42``),
+in fp32 accumulation, with **no sequence-length limit**. The masked-out value
+is -10000.0, matching the reference kernels' fill.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+MASK_FILL = -10000.0
+
+
+def _softmax_last(x32):
+    m = jnp.max(x32, axis=-1, keepdims=True)
+    e = jnp.exp(x32 - m)
+    return e / jnp.sum(e, axis=-1, keepdims=True)
+
+
+def _softmax_bwd_from_output(y, dy):
+    """dx = (dy - sum(dy*y)) * y — the saved-output backward used by both
+    reference kernels (scaled_masked_softmax.h backward)."""
+    y32 = y.astype(jnp.float32)
+    dy32 = dy.astype(jnp.float32)
+    s = jnp.sum(dy32 * y32, axis=-1, keepdims=True)
+    return (dy32 - s) * y32
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def scaled_masked_softmax(x, mask, scale: float = 1.0):
+    """softmax(scale * x masked by `mask`) over the last axis.
+
+    ``x``: (b, np, sq, sk) or any shape ending in the key axis.
+    ``mask``: broadcastable boolean, True = MASKED OUT (the reference's
+    convention: mask==1 positions are filled with -10000 before softmax,
+    ``scaled_masked_softmax.h``). Returns x.dtype.
+    """
+    return _sms_fwd(x, mask, scale)[0]
+
+
+def _sms_fwd(x, mask, scale):
+    x32 = x.astype(jnp.float32) * scale
+    if mask is not None:
+        x32 = jnp.where(mask, MASK_FILL, x32)
+    y = _softmax_last(x32).astype(x.dtype)
+    return y, y
+
+
+def _sms_fwd_vjp(x, mask, scale):
+    y, _ = _sms_fwd(x, mask, scale)
+    return y, y
+
+
+def _sms_bwd_vjp(scale, y, dy):
+    dx = _softmax_bwd_from_output(y, dy) * scale
+    return dx.astype(y.dtype), None
+
+
+scaled_masked_softmax.defvjp(_sms_fwd_vjp, _sms_bwd_vjp)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def scaled_upper_triang_masked_softmax(x, scale: float = 1.0):
+    """Causal softmax(scale * x) over the last axis (ref
+    ``scaled_upper_triang_masked_softmax_cuda``): position (q, k) with k > q
+    is masked. ``x``: (..., sq, sk) with sq == sk."""
+    return _suts_fwd(x, scale)[0]
+
+
+def _causal_mask(sq, sk):
+    q = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+    k = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+    return k > q
+
+
+def _suts_fwd(x, scale):
+    sq, sk = x.shape[-2], x.shape[-1]
+    x32 = x.astype(jnp.float32) * scale
+    x32 = jnp.where(_causal_mask(sq, sk), MASK_FILL, x32)
+    y = _softmax_last(x32).astype(x.dtype)
+    return y, y
+
+
+def _suts_fwd_vjp(x, scale):
+    y, _ = _suts_fwd(x, scale)
+    return y, y
+
+
+def _suts_bwd_vjp(scale, y, dy):
+    dx = _softmax_bwd_from_output(y, dy) * scale
+    # zero the masked triangle in the grad as the reference kernel does
+    sq, sk = y.shape[-2], y.shape[-1]
+    dx = jnp.where(_causal_mask(sq, sk), 0.0, dx)
+    return (dx.astype(y.dtype),)
+
+
+scaled_upper_triang_masked_softmax.defvjp(_suts_fwd_vjp, _suts_bwd_vjp)
+
+
+def scaled_softmax(x, scale: float = 1.0):
+    """No-mask variant (ref ``scaled_softmax_cuda`` entry in fused_softmax.py)."""
+    return scaled_masked_softmax(x, None, scale)

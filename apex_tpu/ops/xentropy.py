@@ -1,0 +1,70 @@
+"""Fused softmax cross-entropy with label smoothing.
+
+Reference: ``apex/contrib/csrc/xentropy/`` (``xentropy_cuda``) driven by
+``apex/contrib/xentropy/softmax_xentropy.py:4-37``. The CUDA kernel's trick is
+to save only (max, logsumexp) per row for the backward instead of the full
+softmax — halving activation memory vs the naive composition.
+
+TPU re-design: same memory trade via ``custom_vjp``: forward saves the scalar
+``logsumexp`` per row; backward recomputes ``softmax = exp(logits - lse)``
+in-register (one fused XLA loop) rather than storing it.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def softmax_cross_entropy_loss(
+    logits, labels, smoothing: float = 0.0, half_to_float: bool = False
+):
+    """Per-example loss (ref ``SoftmaxCrossEntropyLoss.forward``).
+
+    ``logits``: (N, V); ``labels``: (N,) int. With label smoothing s, the
+    target distribution is (1-s) on the label + s/V uniform; loss =
+    lse - (1-s)*logit[label] - (s/V)*sum(logits).
+    """
+    loss, _ = _xent_fwd(logits, labels, smoothing, half_to_float)
+    return loss
+
+
+def _xent_fwd(logits, labels, smoothing, half_to_float):
+    x = logits.astype(jnp.float32)
+    m = jnp.max(x, axis=-1, keepdims=True)
+    lse = (jnp.log(jnp.sum(jnp.exp(x - m), axis=-1, keepdims=True)) + m)[..., 0]
+    n = x.shape[-1]
+    picked = jnp.take_along_axis(x, labels[..., None], axis=-1)[..., 0]
+    if smoothing > 0.0:
+        mean_all = jnp.mean(x, axis=-1)
+        nll = lse - (1.0 - smoothing) * picked - smoothing * mean_all
+    else:
+        nll = lse - picked
+    out_dtype = jnp.float32 if half_to_float else logits.dtype
+    return nll.astype(out_dtype), (logits, labels, lse)
+
+
+def _xent_fwd_vjp(logits, labels, smoothing, half_to_float):
+    loss, res = _xent_fwd(logits, labels, smoothing, half_to_float)
+    return loss, res
+
+
+def _xent_bwd_vjp(smoothing, half_to_float, res, dloss):
+    logits, labels, lse = res
+    x = logits.astype(jnp.float32)
+    n = x.shape[-1]
+    # softmax recomputed from saved lse (the xentropy_cuda backward)
+    p = jnp.exp(x - lse[..., None])
+    onehot = jax.nn.one_hot(labels, n, dtype=jnp.float32)
+    if smoothing > 0.0:
+        target = (1.0 - smoothing) * onehot + smoothing / n
+    else:
+        target = onehot
+    dx = (p - target) * dloss.astype(jnp.float32)[..., None]
+    return dx.astype(logits.dtype), None
+
+
+softmax_cross_entropy_loss.defvjp(_xent_fwd_vjp, _xent_bwd_vjp)

@@ -1,0 +1,207 @@
+"""Data-parallel gradient synchronization — the DDP capability as a mesh
+program.
+
+Reference: ``apex/parallel/distributed.py:129-639`` — bucketed, comm/compute-
+overlapped NCCL allreduce driven by per-param grad hooks: first backward
+records arrival order, buckets are flattened (``apex_C.flatten``), optionally
+cast fp32, pre-divided, allreduced on side streams, averaged and unflattened
+back (``allreduce_bucket:425-470``), with options ``message_size``,
+``allreduce_always_fp32``, ``gradient_average``, ``gradient_predivide_factor``,
+``delay_allreduce``, ``num_allreduce_streams``.
+
+TPU re-design: grads come out of ``jax.grad`` as one pytree, so "hook-driven
+readiness" disappears; the capability that remains is (a) the collective
+itself (``lax.psum`` over the ``dp`` mesh axis), (b) dtype policy, (c)
+pre/post scaling, and (d) **bucketing** — concatenating many small grads into
+a few flat buffers so the ICI sees large transfers (the reference's
+``message_size`` batching; XLA also combines small all-reduces itself, this
+makes the batching explicit and deterministic). Comm/compute overlap is XLA's
+latency-hiding scheduler's job — the psums are emitted inside the jitted step
+so the scheduler interleaves them with the optimizer math, replacing the
+reference's manual side streams + events (``distributed.py:411-470``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.parallel.mesh import DP_AXIS
+
+
+def _flatten_buckets(leaves: List[jnp.ndarray], message_size: int):
+    """Group leaf indices into buckets of ~message_size elements per dtype
+    (ref bucket construction, ``distributed.py:283-318`` + ``message_size``
+    default 10M elements)."""
+    buckets = []  # list of (dtype, [leaf_idx...])
+    current = {}
+    counts = {}
+    for i, g in enumerate(leaves):
+        dt = g.dtype
+        current.setdefault(dt, []).append(i)
+        counts[dt] = counts.get(dt, 0) + g.size
+        if counts[dt] >= message_size:
+            buckets.append((dt, current.pop(dt)))
+            counts[dt] = 0
+    for dt, idxs in current.items():
+        if idxs:
+            buckets.append((dt, idxs))
+    return buckets
+
+
+class DistributedDataParallel:
+    """Functional DDP: ``grads = ddp.average_gradients(grads)`` inside the
+    mesh program (shard_map/pjit body). Mirrors the reference constructor
+    options (``distributed.py:162-253``) that still have meaning under XLA.
+    """
+
+    def __init__(
+        self,
+        axis: str = DP_AXIS,
+        message_size: int = 10_000_000,
+        gradient_average: bool = True,
+        gradient_predivide_factor: float = 1.0,
+        allreduce_always_fp32: bool = False,
+        flat_buckets: bool = True,
+    ):
+        self.axis = axis
+        self.message_size = message_size
+        self.gradient_average = gradient_average
+        self.gradient_predivide_factor = gradient_predivide_factor
+        self.allreduce_always_fp32 = allreduce_always_fp32
+        self.flat_buckets = flat_buckets
+        self._sync_enabled = True
+
+    # ref distributed.py:275-281 enable/disable_allreduce (no_sync)
+    def enable_allreduce(self):
+        self._sync_enabled = True
+
+    def disable_allreduce(self):
+        self._sync_enabled = False
+
+    class _NoSync:
+        def __init__(self, ddp):
+            self.ddp = ddp
+
+        def __enter__(self):
+            self.ddp.disable_allreduce()
+
+        def __exit__(self, *a):
+            self.ddp.enable_allreduce()
+
+    def no_sync(self):
+        """Context manager: skip the allreduce for grad accumulation
+        (torch-DDP-style ``no_sync``; ref enable/disable_allreduce).
+
+        .. warning:: The flag is read at **trace time**. It must be active
+           while the step function is traced (i.e. wrap the first call /
+           construction of the accumulation step), not around calls to an
+           already-jitted function — a cached executable keeps whichever
+           behavior it was traced with. For a single jitted step that both
+           accumulates and syncs, pass ``enabled`` explicitly to
+           :meth:`average_gradients` and thread it as a static argument so
+           jit specializes both variants."""
+        return DistributedDataParallel._NoSync(self)
+
+    def _world(self):
+        # inside a mesh program the axis size is static
+        return lax.axis_size(self.axis)
+
+    def replicate(self, params: Any) -> Any:
+        """Mark params as per-replica (device-varying) inside the mesh
+        program — the analogue of each DDP rank holding its own module copy.
+
+        This matters for AD semantics: JAX's shard_map auto-inserts a psum
+        when differentiating w.r.t. *replicated* values (the transpose of the
+        implicit broadcast), which would double-count with
+        :meth:`average_gradients`. Differentiate w.r.t.
+        ``ddp.replicate(params)`` and the gradients come back per-replica,
+        exactly like the reference's per-process ``.grad`` buffers, ready for
+        the explicit allreduce."""
+        return jax.tree_util.tree_map(
+            lambda p: lax.pcast(p, self.axis, to="varying"), params
+        )
+
+    def average_gradients(self, grads: Any, enabled: Optional[bool] = None) -> Any:
+        """The allreduce_bucket pipeline (ref ``distributed.py:425-470``):
+        [flatten] → [fp32 cast] → predivide → psum → postdivide → unflatten.
+        Must be called inside a mesh program with ``self.axis`` bound.
+        ``enabled``: static python bool overriding the no_sync flag (see
+        :meth:`no_sync` for the trace-time caveat)."""
+        if enabled is None:
+            enabled = self._sync_enabled
+        if not enabled:
+            return grads
+        leaves, treedef = jax.tree_util.tree_flatten(grads)
+        if not leaves:
+            return grads
+        world = self._world()
+
+        pre = 1.0
+        post = 1.0
+        if self.gradient_average:
+            if self.gradient_predivide_factor != 1.0:
+                pre = 1.0 / self.gradient_predivide_factor
+                post = self.gradient_predivide_factor / world
+            else:
+                post = 1.0 / world
+
+        def _reduce_flat(flat):
+            comm = flat.astype(jnp.float32) if self.allreduce_always_fp32 else flat
+            if pre != 1.0:
+                comm = comm * pre
+            comm = lax.psum(comm, self.axis)
+            if post != 1.0:
+                comm = comm * post
+            return comm
+
+        if not self.flat_buckets:
+            out = [ _reduce_flat(g).astype(g.dtype) for g in leaves ]
+            return jax.tree_util.tree_unflatten(treedef, out)
+
+        out = [None] * len(leaves)
+        for dt, idxs in _flatten_buckets(leaves, self.message_size):
+            flat = jnp.concatenate([leaves[i].reshape(-1) for i in idxs])
+            red = _reduce_flat(flat)
+            offset = 0
+            for i in idxs:
+                n = leaves[i].size
+                out[i] = red[offset : offset + n].reshape(leaves[i].shape).astype(
+                    leaves[i].dtype
+                )
+                offset += n
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    def broadcast_params(self, params: Any) -> Any:
+        """Make all ranks along the axis agree on rank-0's values (ref param
+        broadcast at DDP init, ``distributed.py:254``). Implemented as a
+        masked psum — same result as gathering and taking index 0, but 1x
+        memory and ordinary allreduce traffic instead of a world-times-size
+        gather."""
+        # is_zero is device-varying; mixing it in makes the select varying
+        # regardless of whether params came in replicated or per-replica.
+        is_zero = lax.axis_index(self.axis) == 0
+        return jax.tree_util.tree_map(
+            lambda p: lax.psum(
+                jnp.where(is_zero, p, jnp.zeros_like(p)), self.axis
+            ),
+            params,
+        )
+
+
+class Reducer:
+    """Manual-sync variant (ref ``apex/parallel/distributed.py:89-128``):
+    broadcast once, then ``reduce`` when the user says so — no averaging
+    options, raw sum like the reference."""
+
+    def __init__(self, axis: str = DP_AXIS):
+        self.axis = axis
+
+    def reduce(self, tree: Any) -> Any:
+        return jax.tree_util.tree_map(lambda g: lax.psum(g, self.axis), tree)
+
+    def broadcast_params(self, params: Any) -> Any:
+        return DistributedDataParallel(axis=self.axis).broadcast_params(params)

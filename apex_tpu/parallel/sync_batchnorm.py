@@ -1,0 +1,182 @@
+"""Synchronized BatchNorm over a mesh axis.
+
+Reference: two implementations —
+python (``apex/parallel/sync_batchnorm.py:9-120`` + ``sync_batchnorm_kernel.py``:
+local mean & sqr-mean, two ``all_reduce(SUM)``s, unbiased running-var update,
+custom backward allreducing ``mean_dy`` / ``mean_dy_xmu``) and the optimized
+CUDA path (``optimized_sync_batchnorm*.py`` + ``csrc/welford.cu``: local
+Welford, single fused all_gather of [mean,var,count], ``welford_parallel``
+merge, fused kernels, channels-last, group BN via ``process_group``).
+
+TPU re-design: the statistics collectives are ``lax.psum`` of
+``[sum, sum_sq, count]`` over the mesh axis (one fused psum — the analogue of
+the optimized path's single combined all_gather; the Welford merge is
+algebraically identical to merging (sum, sum_sq) and the fp32 accumulation
+keeps it stable). The backward needs **no custom kernel**: JAX differentiates
+through the forward psums, and the transpose of psum is exactly the
+``mean_dy``/``mean_dy_xmu`` allreduce pair of the reference backward
+(``sync_batchnorm_kernel.py:80-119``). "BN groups"
+(``create_syncbn_process_group``, ``apex/parallel/__init__.py:58-95``) map to
+``axis_index_groups`` on the psum.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.parallel.mesh import DP_AXIS
+
+
+def create_syncbn_process_group(group_size: int, world_size: int):
+    """Partition ``world_size`` ranks into contiguous groups of ``group_size``
+    for grouped-stat BN (ref ``apex/parallel/__init__.py:58-95``). Returns the
+    ``axis_index_groups`` argument for the psum."""
+    if group_size == 0 or group_size >= world_size:
+        return None
+    if world_size % group_size != 0:
+        raise ValueError(
+            f"group_size {group_size} must divide world size {world_size}"
+        )
+    return [
+        list(range(i, i + group_size)) for i in range(0, world_size, group_size)
+    ]
+
+
+def sync_batch_stats(
+    x,
+    reduce_axes,
+    axis_name: Optional[str],
+    axis_index_groups=None,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Cross-replica mean/var: one psum of the packed [sum, sum_sq, count]
+    (the optimized path's single collective, ``optimized_sync_batchnorm_kernel.py:36-41``).
+    Returns (mean, var, total_count) as fp32, per channel."""
+    x32 = x.astype(jnp.float32)
+    local_sum = jnp.sum(x32, axis=reduce_axes)
+    local_sq = jnp.sum(x32 * x32, axis=reduce_axes)
+    count = 1
+    for a in reduce_axes:
+        count *= x.shape[a]
+    local_count = jnp.full_like(local_sum, float(count))
+    packed = jnp.stack([local_sum, local_sq, local_count])
+    if axis_name is not None:
+        if axis_index_groups is None:
+            packed = lax.psum(packed, axis_name)
+        else:
+            # Grouped reduction. shard_map does not support axis_index_groups
+            # on psum, so gather the whole axis and slice out this rank's
+            # (contiguous, uniform) group — the groups produced by
+            # create_syncbn_process_group.
+            gsize = len(axis_index_groups[0])
+            if any(
+                list(g) != list(range(g[0], g[0] + gsize)) for g in axis_index_groups
+            ):
+                raise ValueError("axis_index_groups must be contiguous and uniform")
+            gathered = lax.all_gather(packed, axis_name)  # (world, 3, C)
+            gid = lax.axis_index(axis_name) // gsize
+            grp = lax.dynamic_slice_in_dim(gathered, gid * gsize, gsize, 0)
+            packed = jnp.sum(grp, axis=0)
+    total_sum, total_sq, total_count = packed[0], packed[1], packed[2]
+    mean = total_sum / total_count
+    var = total_sq / total_count - mean * mean
+    return mean, var, total_count
+
+
+class SyncBatchNorm(nn.Module):
+    """flax module with the reference's semantics (constructor mirrors
+    ``optimized_sync_batchnorm.py:9-20``: ``momentum``, ``eps``, affine flags,
+    ``process_group`` → ``axis_index_groups``, ``channel_last`` → the channel
+    axis is always last here, NHWC being the TPU-native layout anyway).
+
+    Stats sync across ``axis_name`` during training; running stats live in the
+    ``batch_stats`` collection with the unbiased m/(m-1) correction
+    (ref ``sync_batchnorm.py:96-104``). Call with ``use_running_average=True``
+    for eval (no collectives, matching the reference eval path).
+    """
+
+    features: Optional[int] = None  # None: inferred from x.shape[-1]
+    momentum: float = 0.1
+    eps: float = 1e-5
+    affine: bool = True
+    track_running_stats: bool = True
+    axis_name: Optional[str] = DP_AXIS
+    axis_index_groups: Optional[Sequence[Sequence[int]]] = None
+    param_dtype: jnp.dtype = jnp.float32
+    fuse_relu: bool = False  # ref optimized path's fuse_relu option
+
+    @nn.compact
+    def __call__(self, x, use_running_average: bool = False):
+        reduce_axes = tuple(range(x.ndim - 1))
+        features = self.features if self.features is not None else x.shape[-1]
+        # During flax init there is no mesh axis bound — compute local stats
+        # (same shapes, no collectives), like nn.BatchNorm's axis_name handling.
+        axis_name = None if self.is_initializing() else self.axis_name
+        ra_mean = self.variable(
+            "batch_stats", "mean", lambda: jnp.zeros((features,), jnp.float32)
+        )
+        ra_var = self.variable(
+            "batch_stats", "var", lambda: jnp.ones((features,), jnp.float32)
+        )
+
+        if use_running_average and self.track_running_stats:
+            # eval path; with track_running_stats=False batch stats are used
+            # even in eval (torch/apex semantics).
+            mean, var = ra_mean.value, ra_var.value
+        elif not self.track_running_stats:
+            mean, var, _ = sync_batch_stats(
+                x, reduce_axes, axis_name, self.axis_index_groups
+            )
+        else:
+            mean, var, total_count = sync_batch_stats(
+                x, reduce_axes, axis_name, self.axis_index_groups
+            )
+            if not self.is_initializing():
+                # unbiased running var: m/(m-1) (ref sync_batchnorm.py:98-103)
+                m = total_count
+                unbiased = var * m / jnp.maximum(m - 1.0, 1.0)
+                ra_mean.value = (
+                    (1 - self.momentum) * ra_mean.value + self.momentum * mean
+                )
+                ra_var.value = (
+                    (1 - self.momentum) * ra_var.value + self.momentum * unbiased
+                )
+
+        y = (x.astype(jnp.float32) - mean) * lax.rsqrt(var + self.eps)
+        if self.affine:
+            w = self.param(
+                "scale", nn.initializers.ones, (features,), self.param_dtype
+            )
+            b = self.param(
+                "bias", nn.initializers.zeros, (features,), self.param_dtype
+            )
+            y = y * w + b
+        if self.fuse_relu:
+            y = jax.nn.relu(y)
+        return y.astype(x.dtype)
+
+
+def convert_syncbn_model(module: nn.Module, axis_name: str = DP_AXIS) -> nn.Module:
+    """Best-effort analogue of ``apex.parallel.convert_syncbn_model``
+    (``apex/parallel/__init__.py:21-57``): return a copy of a flax module with
+    ``nn.BatchNorm`` submodule *fields* replaced by :class:`SyncBatchNorm`.
+
+    flax modules are frozen dataclasses, so only directly-held BatchNorm
+    attributes can be swapped generically (nested conversion belongs in the
+    model definition — accept a ``norm_cls`` there, as
+    ``apex_tpu.models.resnet`` does)."""
+    changes = {}
+    for name in getattr(module, "__dataclass_fields__", {}):
+        val = getattr(module, name, None)
+        if isinstance(val, nn.BatchNorm):
+            changes[name] = SyncBatchNorm(
+                features=None,  # inferred from input, like nn.BatchNorm
+                momentum=1.0 - val.momentum,  # flax momentum is the decay
+                eps=val.epsilon,
+                axis_name=axis_name,
+            )
+    return module.clone(**changes) if changes else module

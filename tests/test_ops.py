@@ -1,0 +1,228 @@
+"""Kernel-layer numerics tests — ref tests/L0/run_fused_layer_norm, run_mlp,
+run_transformer/test_fused_softmax.py, contrib xentropy tests: compare each
+fused op (fwd + bwd) against a pure reference at fp32 and bf16."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu import ops
+from apex_tpu.ops.layer_norm import layer_norm_reference, rms_norm_reference
+
+
+# ---------------------------------------------------------------------------
+# LayerNorm / RMSNorm (Pallas interpret mode on CPU)
+
+
+@pytest.mark.parametrize("rows,hidden", [(32, 128), (64, 256), (8, 384)])
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_layer_norm_forward_matches_reference(rows, hidden, dtype):
+    k = jax.random.PRNGKey(0)
+    x = (jax.random.normal(k, (rows, hidden)) * 3 + 1).astype(dtype)
+    w = jax.random.normal(jax.random.fold_in(k, 1), (hidden,)) * 0.5 + 1
+    b = jax.random.normal(jax.random.fold_in(k, 2), (hidden,)) * 0.1
+    got = ops.layer_norm(x, w, b, use_pallas=True)
+    want = layer_norm_reference(x, w, b)
+    atol = 1e-5 if dtype == jnp.float32 else 3e-2
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32), atol=atol
+    )
+
+
+def test_layer_norm_backward_matches_reference():
+    k = jax.random.PRNGKey(3)
+    x = jax.random.normal(k, (32, 128)) * 2
+    w = jax.random.normal(jax.random.fold_in(k, 1), (128,)) + 1
+    b = jnp.zeros((128,))
+
+    def loss_pallas(x, w, b):
+        return jnp.sum(jnp.sin(ops.layer_norm(x, w, b, use_pallas=True)))
+
+    def loss_ref(x, w, b):
+        return jnp.sum(jnp.sin(layer_norm_reference(x, w, b)))
+
+    g1 = jax.grad(loss_pallas, argnums=(0, 1, 2))(x, w, b)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(x, w, b)
+    for a, e, name in zip(g1, g2, "xwb"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(e), atol=2e-4, err_msg=name
+        )
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_rms_norm_fwd_bwd(dtype):
+    k = jax.random.PRNGKey(5)
+    x = (jax.random.normal(k, (16, 256)) * 2).astype(dtype)
+    w = jax.random.normal(jax.random.fold_in(k, 1), (256,)) + 1
+
+    got = ops.rms_norm(x, w, use_pallas=True)
+    want = rms_norm_reference(x, w)
+    atol = 1e-5 if dtype == jnp.float32 else 3e-2
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32), atol=atol
+    )
+    if dtype == jnp.float32:
+        g1 = jax.grad(lambda x, w: ops.rms_norm(x, w, use_pallas=True).sum(), (0, 1))(x, w)
+        g2 = jax.grad(lambda x, w: rms_norm_reference(x, w).sum(), (0, 1))(x, w)
+        np.testing.assert_allclose(np.asarray(g1[0]), np.asarray(g2[0]), atol=2e-4)
+        np.testing.assert_allclose(np.asarray(g1[1]), np.asarray(g2[1]), atol=2e-4)
+
+
+def test_layer_norm_unaligned_falls_back():
+    # hidden not %128: XLA path, still correct
+    x = jax.random.normal(jax.random.PRNGKey(0), (5, 100))
+    w = jnp.ones((100,)); b = jnp.zeros((100,))
+    got = ops.layer_norm(x, w, b)
+    want = layer_norm_reference(x, w, b)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-6)
+    with pytest.raises(ValueError):
+        ops.layer_norm(x, w, b, use_pallas=True)
+
+
+def test_layer_norm_no_affine():
+    x = jax.random.normal(jax.random.PRNGKey(0), (8, 128))
+    got = ops.layer_norm(x)  # non-affine variant
+    assert abs(float(got.mean())) < 1e-5
+    np.testing.assert_allclose(float(got.std()), 1.0, atol=1e-3)
+
+
+def test_fused_layer_norm_module():
+    from apex_tpu.normalization import FusedLayerNorm, FusedRMSNorm
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 16, 128), jnp.bfloat16)
+    ln = FusedLayerNorm(normalized_shape=128)
+    params = ln.init(jax.random.PRNGKey(1), x)
+    y = ln.apply(params, x)
+    assert y.shape == x.shape and y.dtype == jnp.bfloat16
+    assert params["params"]["scale"].dtype == jnp.float32
+
+    rn = FusedRMSNorm(normalized_shape=128, elementwise_affine=False)
+    y2 = rn.apply(rn.init(jax.random.PRNGKey(2), x), x)
+    assert y2.shape == x.shape
+
+
+# ---------------------------------------------------------------------------
+# Fused softmax — ref test_fused_softmax.py (kernel vs unfused reference)
+
+
+def test_scaled_masked_softmax_matches_unfused():
+    k = jax.random.PRNGKey(0)
+    x = jax.random.normal(k, (2, 4, 8, 16), jnp.bfloat16)
+    mask = jax.random.bernoulli(jax.random.fold_in(k, 1), 0.3, (2, 1, 8, 16))
+    got = ops.scaled_masked_softmax(x, mask, scale=2.0)
+    ref = jax.nn.softmax(
+        jnp.where(mask, -10000.0, x.astype(jnp.float32) * 2.0), axis=-1
+    )
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(ref), atol=2e-2
+    )
+    assert got.dtype == jnp.bfloat16
+
+
+def test_scaled_masked_softmax_grad():
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 2, 4, 8))
+    mask = jnp.zeros((2, 1, 4, 8), bool).at[:, :, :, 6:].set(True)
+    g1 = jax.grad(lambda x: ops.scaled_masked_softmax(x, mask, 1.5).sum() ** 2)(x)
+    g2 = jax.grad(
+        lambda x: jax.nn.softmax(jnp.where(mask, -10000.0, x * 1.5), -1).sum() ** 2
+    )(x)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), atol=1e-4)
+
+
+def test_causal_softmax():
+    x = jax.random.normal(jax.random.PRNGKey(2), (3, 2, 8, 8))
+    y = ops.scaled_upper_triang_masked_softmax(x, 1.0)
+    yn = np.asarray(y)
+    # strictly upper triangle ~ 0; rows sum to 1
+    for q in range(7):
+        assert yn[..., q, q + 1 :].max() < 1e-3
+    np.testing.assert_allclose(yn.sum(-1), 1.0, atol=1e-5)
+    # grad matches the unfused composition
+    g1 = jax.grad(lambda x: (ops.scaled_upper_triang_masked_softmax(x, 1.0) ** 2).sum())(x)
+    causal = np.triu(np.ones((8, 8), bool), 1)
+    g2 = jax.grad(
+        lambda x: (jax.nn.softmax(jnp.where(jnp.asarray(causal), -10000.0, x), -1) ** 2).sum()
+    )(x)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), atol=1e-4)
+
+
+def test_softmax_long_sequence_no_limit():
+    # the reference kernels cap sk at 2048; ours must not
+    x = jax.random.normal(jax.random.PRNGKey(3), (1, 1, 4, 4096))
+    y = ops.scaled_softmax(x, 1.0)
+    np.testing.assert_allclose(np.asarray(y).sum(-1), 1.0, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# xentropy — ref apex/contrib/test/xentropy
+
+
+@pytest.mark.parametrize("smoothing", [0.0, 0.1])
+def test_xentropy_matches_reference(smoothing):
+    k = jax.random.PRNGKey(0)
+    logits = jax.random.normal(k, (16, 50)) * 3
+    labels = jax.random.randint(jax.random.fold_in(k, 1), (16,), 0, 50)
+
+    got = ops.softmax_cross_entropy_loss(logits, labels, smoothing)
+
+    logp = jax.nn.log_softmax(logits)
+    n = logits.shape[-1]
+    onehot = jax.nn.one_hot(labels, n)
+    target = (1 - smoothing) * onehot + smoothing / n
+    want = -jnp.sum(target * logp, axis=-1)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+    g1 = jax.grad(lambda l: ops.softmax_cross_entropy_loss(l, labels, smoothing).sum())(logits)
+    g2 = jax.grad(lambda l: (-jnp.sum(target * jax.nn.log_softmax(l), -1)).sum())(logits)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), atol=1e-5)
+
+
+def test_xentropy_half_to_float():
+    logits = jax.random.normal(jax.random.PRNGKey(0), (4, 10), jnp.bfloat16)
+    labels = jnp.array([1, 2, 3, 4])
+    out = ops.softmax_cross_entropy_loss(logits, labels, 0.0, True)
+    assert out.dtype == jnp.float32
+
+
+# ---------------------------------------------------------------------------
+# MLP / fused dense — ref tests/L0/run_mlp numerical comparison
+
+
+def test_mlp_matches_sequential():
+    from apex_tpu.mlp import MLP
+
+    mlp = MLP(mlp_sizes=(16, 32, 8), activation="relu")
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 16))
+    params = mlp.init(jax.random.PRNGKey(1), x)
+    got = mlp.apply(params, x)
+    p = params["params"]
+    want = jax.nn.relu(x @ p["kernel_0"] + p["bias_0"]) @ p["kernel_1"] + p["bias_1"]
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-6)
+
+
+def test_mlp_no_bias_sigmoid():
+    from apex_tpu.mlp import mlp_forward
+
+    x = jnp.ones((2, 4))
+    ks = [jnp.ones((4, 4)), jnp.ones((4, 2))]
+    got = mlp_forward(x, ks, None, "sigmoid")
+    want = jax.nn.sigmoid(x @ ks[0]) @ ks[1]
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-6)
+    with pytest.raises(ValueError):
+        mlp_forward(x, ks, None, "tanh")
+
+
+def test_fused_dense_gelu_dense():
+    from apex_tpu.fused_dense import FusedDenseGeluDense
+
+    m = FusedDenseGeluDense(intermediate_features=32, out_features=8)
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 16))
+    params = m.init(jax.random.PRNGKey(1), x)
+    got = m.apply(params, x)
+    p = params["params"]
+    h = x @ p["kernel1"] + p["bias1"]
+    h = 0.5 * h * (1 + jax.lax.erf(h / jnp.sqrt(2.0)))
+    want = h @ p["kernel2"] + p["bias2"]
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-6)
