@@ -16,7 +16,7 @@ import jax.numpy as jnp
 
 def _gelu_exact(x):
     # cuBLASLt CUBLASLT_EPILOGUE_GELU uses the erf formulation
-    return 0.5 * x * (1.0 + jax.lax.erf(x / jnp.sqrt(2.0).astype(x.dtype)))
+    return jax.nn.gelu(x, approximate=False)
 
 
 def fused_dense(x, kernel, bias=None):

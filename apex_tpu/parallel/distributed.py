@@ -140,14 +140,11 @@ class DistributedDataParallel:
             return grads
         world = self._world()
 
-        pre = 1.0
-        post = 1.0
-        if self.gradient_average:
-            if self.gradient_predivide_factor != 1.0:
-                pre = 1.0 / self.gradient_predivide_factor
-                post = self.gradient_predivide_factor / world
-            else:
-                post = 1.0 / world
+        # Predivide is applied unconditionally before the allreduce — it is
+        # the fp16/bf16 overflow guard; only the post-multiply is gated on
+        # gradient_average (ref distributed.py:445-454).
+        pre = 1.0 / self.gradient_predivide_factor
+        post = self.gradient_predivide_factor / world if self.gradient_average else 1.0
 
         def _reduce_flat(flat):
             comm = flat.astype(jnp.float32) if self.allreduce_always_fp32 else flat

@@ -74,9 +74,14 @@ def sync_batch_stats(
             # create_syncbn_process_group.
             gsize = len(axis_index_groups[0])
             if any(
-                list(g) != list(range(g[0], g[0] + gsize)) for g in axis_index_groups
+                list(g) != list(range(i * gsize, (i + 1) * gsize))
+                for i, g in enumerate(axis_index_groups)
             ):
-                raise ValueError("axis_index_groups must be contiguous and uniform")
+                raise ValueError(
+                    "axis_index_groups must be contiguous, uniform, and "
+                    "aligned (group i covers ranks [i*gsize, (i+1)*gsize)) — "
+                    "the groups create_syncbn_process_group produces"
+                )
             gathered = lax.all_gather(packed, axis_name)  # (world, 3, C)
             gid = lax.axis_index(axis_name) // gsize
             grp = lax.dynamic_slice_in_dim(gathered, gid * gsize, gsize, 0)

@@ -1,0 +1,79 @@
+"""Tensor parallelism: Megatron-style sharded layers, collective mappings,
+vocab-parallel cross-entropy, RNG policy, activation checkpointing.
+
+Reference: ``apex/transformer/tensor_parallel/__init__.py`` export list.
+"""
+
+from apex_tpu.transformer.tensor_parallel.cross_entropy import (  # noqa: F401
+    vocab_parallel_cross_entropy,
+)
+from apex_tpu.transformer.tensor_parallel.data import broadcast_data  # noqa: F401
+from apex_tpu.transformer.tensor_parallel.layers import (  # noqa: F401
+    ColumnParallelLinear,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+    column_parallel_linear,
+    row_parallel_linear,
+    set_tensor_model_parallel_attributes,
+    sharded_init,
+    vocab_parallel_embedding,
+)
+from apex_tpu.transformer.tensor_parallel.mappings import (  # noqa: F401
+    copy_to_tensor_model_parallel_region,
+    gather_from_tensor_model_parallel_region,
+    reduce_from_tensor_model_parallel_region,
+    scatter_to_tensor_model_parallel_region,
+)
+from apex_tpu.transformer.tensor_parallel.memory import (  # noqa: F401
+    MemoryBuffer,
+    RingMemBuffer,
+)
+from apex_tpu.transformer.tensor_parallel.random import (  # noqa: F401
+    RngStatesTracker,
+    checkpoint,
+    checkpoint_wrapper,
+    data_parallel_key,
+    get_cuda_rng_tracker,
+    get_rng_tracker,
+    model_parallel_cuda_manual_seed,
+    model_parallel_key,
+    model_parallel_seed,
+    pipeline_stage_key,
+)
+from apex_tpu.transformer.tensor_parallel.utils import (  # noqa: F401
+    VocabUtility,
+    divide,
+    split_tensor_along_last_dim,
+)
+
+__all__ = [
+    "ColumnParallelLinear",
+    "MemoryBuffer",
+    "RingMemBuffer",
+    "RngStatesTracker",
+    "RowParallelLinear",
+    "VocabParallelEmbedding",
+    "VocabUtility",
+    "broadcast_data",
+    "checkpoint",
+    "checkpoint_wrapper",
+    "column_parallel_linear",
+    "copy_to_tensor_model_parallel_region",
+    "data_parallel_key",
+    "divide",
+    "gather_from_tensor_model_parallel_region",
+    "get_cuda_rng_tracker",
+    "get_rng_tracker",
+    "model_parallel_cuda_manual_seed",
+    "model_parallel_key",
+    "model_parallel_seed",
+    "pipeline_stage_key",
+    "reduce_from_tensor_model_parallel_region",
+    "row_parallel_linear",
+    "scatter_to_tensor_model_parallel_region",
+    "set_tensor_model_parallel_attributes",
+    "sharded_init",
+    "split_tensor_along_last_dim",
+    "vocab_parallel_cross_entropy",
+    "vocab_parallel_embedding",
+]

@@ -1,0 +1,69 @@
+"""Input-data broadcast across the tensor-parallel group.
+
+Reference: ``apex/transformer/tensor_parallel/data.py:77-121`` — only TP rank
+0 reads the batch from the data iterator; ``broadcast_data`` flattens the
+dict of int64 tensors, ``torch.distributed.broadcast``s one buffer across the
+TP group, and unpacks. Helpers ``_check_data_types`` / ``_build_key_size_numel_dictionaries``
+(:17-75) validate dtypes and ship the shapes first.
+
+TPU re-design: under single-controller SPMD every device in a mesh program
+sees the same traced inputs, so the *intra-process* broadcast is structural.
+What remains is the multi-host case: each JAX process must feed identical
+host data for TP-replicated inputs. ``broadcast_data`` therefore (a) verifies
+dtypes like the reference, and (b) on multi-process runs routes through
+``multihost_utils.broadcast_one_to_all`` so process 0's batch wins — the
+honest analogue of "TP rank 0 reads, everyone else receives".
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _check_data_types(keys: Sequence[str], data: Dict[str, jnp.ndarray], dtype):
+    """Ref data.py:17-23."""
+    for k in keys:
+        if np.dtype(data[k].dtype) != np.dtype(dtype):
+            raise TypeError(
+                f"{k} has data type {data[k].dtype} which is different than {dtype}"
+            )
+
+
+def _build_key_size_numel_dictionaries(keys, data):
+    """Ref data.py:26-75 — shape/numel bookkeeping (no collective needed:
+    shapes are host metadata and identical by construction under SPMD)."""
+    key_size = {k: tuple(data[k].shape) for k in keys}
+    key_numel = {k: int(np.prod(data[k].shape)) for k in keys}
+    total_numel = sum(key_numel.values())
+    return key_size, key_numel, total_numel
+
+
+def broadcast_data(keys, data, datatype=jnp.int32):
+    """Broadcast process-0's data members to all processes (ref data.py:77-121).
+
+    ``keys``: members to broadcast; ``data``: dict of same-shaped arrays on
+    every process; returns dict of device arrays.
+    """
+    key_size, _, _ = _build_key_size_numel_dictionaries(keys, data)
+    _check_data_types(keys, data, datatype)
+
+    if jax.process_count() == 1:
+        return {k: jnp.asarray(data[k]) for k in keys}
+
+    from jax.experimental import multihost_utils
+
+    flat = jnp.concatenate(
+        [jnp.asarray(data[k], datatype).reshape(-1) for k in keys]
+    )
+    flat = multihost_utils.broadcast_one_to_all(flat)
+    out = {}
+    offset = 0
+    for k in keys:
+        n = int(np.prod(key_size[k]))
+        out[k] = flat[offset : offset + n].reshape(key_size[k])
+        offset += n
+    return out

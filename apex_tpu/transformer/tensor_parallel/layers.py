@@ -1,0 +1,272 @@
+"""Megatron-style tensor-parallel layers: vocab-parallel embedding,
+column-parallel and row-parallel linear.
+
+Reference: ``apex/transformer/tensor_parallel/layers.py`` —
+``VocabParallelEmbedding`` (:138), ``ColumnParallelLinear`` (:321),
+``RowParallelLinear`` (:464), plus the autograd functions
+``LinearWithGradAccumulationAndAsyncAllreduce(In16Bit)`` (:217,:269) whose
+point is (a) overlapping the input-grad TP all-reduce with the weight-grad
+GEMM and (b) accumulating dW straight into an fp32 ``main_grad`` buffer
+(``fused_weight_gradient_mlp_cuda``).
+
+TPU re-design:
+
+* Layers are flax modules whose parameters are the **local shard** — the
+  natural ``shard_map`` formulation: one program per device, weights of shape
+  ``(in, out/tp)`` (column) / ``(in/tp, out)`` (row). (JAX kernels are
+  ``(in, out)``; the reference stores the torch-transposed ``(out, in)``.)
+* The backward collectives come from the :mod:`mappings` custom-VJP functions;
+  comm/compute overlap (the "async allreduce") is XLA's latency-hiding
+  scheduler reordering the psum against the dW dot — no streams to manage.
+* Gradient-accumulation fusion into fp32 main_grad is the optimizer's
+  accumulator pytree here (see ``apex_tpu.optimizers``); XLA fuses the
+  cast+add into the dW GEMM epilogue.
+* Weight init is **TP-invariant**: the full (master) weight is initialized
+  from a replicated RNG and each rank keeps its slice — the semantics of the
+  reference's ``_initialize_affine_weight_cpu`` (:89-120) master-weight path,
+  so checkpoints and tests are independent of the TP degree.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.parallel.mesh import TP_AXIS
+from apex_tpu.transformer.tensor_parallel.mappings import (
+    copy_to_tensor_model_parallel_region,
+    gather_from_tensor_model_parallel_region,
+    reduce_from_tensor_model_parallel_region,
+    scatter_to_tensor_model_parallel_region,
+)
+from apex_tpu.transformer.tensor_parallel.utils import VocabUtility, divide
+
+
+# ---------------------------------------------------------------------------
+# TP parameter attributes (ref layers.py:55-87). In JAX the "attribute" worth
+# keeping is the partition spec; these helpers build flax metadata boxes that
+# GSPMD-style code can read with nn.get_partition_spec.
+
+def set_tensor_model_parallel_attributes(
+    init_fn: Callable, is_parallel: bool, dim: int, stride: int = 1, ndim: int = 2
+):
+    """Wrap an initializer with TP partition metadata (ref :55-66). Under
+    shard_map the metadata is advisory; under pjit it becomes the sharding."""
+    if not is_parallel:
+        return init_fn
+    names = [None] * ndim
+    names[dim] = TP_AXIS
+    return nn.with_partitioning(init_fn, tuple(names))
+
+
+def param_is_tensor_parallel(meta) -> bool:
+    """Ref ``param_is_not_tensor_parallel_duplicate`` (:67-76), inverted."""
+    return isinstance(meta, nn.Partitioned) or getattr(meta, "names", None)
+
+
+# ---------------------------------------------------------------------------
+# TP-invariant init: initialize the full master weight, keep this rank's slice
+# (ref _initialize_affine_weight_cpu, layers.py:89-120).
+
+def sharded_init(
+    base_init: Callable, full_shape, partition_dim: int, axis_name: str = TP_AXIS
+) -> Callable:
+    """Initializer producing this rank's slice of a master weight initialized
+    at full shape. Must run inside a mesh program so ``axis_index`` resolves.
+    """
+
+    def init(key, shard_shape, dtype=jnp.float32):
+        master = base_init(key, tuple(full_shape), dtype)
+        rank = lax.axis_index(axis_name)
+        chunk = shard_shape[partition_dim]
+        return lax.dynamic_slice_in_dim(master, rank * chunk, chunk, partition_dim)
+
+    return init
+
+
+# ---------------------------------------------------------------------------
+# Functional cores
+
+
+def vocab_parallel_embedding(ids, weight, axis_name: str = TP_AXIS):
+    """Lookup into a vocab-sharded embedding table (ref forward :191-215).
+
+    ``weight``: (vocab/tp, hidden) local shard. Out-of-range ids contribute a
+    zero row; psum assembles each token's row from its owner rank.
+    """
+    per_partition = weight.shape[0]
+    rank = lax.axis_index(axis_name)
+    start, end = VocabUtility.vocab_range_from_per_partition_vocab_size(
+        per_partition, rank, lax.axis_size(axis_name)
+    )
+    mask = (ids < start) | (ids >= end)
+    local_ids = jnp.where(mask, 0, ids - start)
+    out = jnp.take(weight, local_ids, axis=0)
+    out = jnp.where(mask[..., None], jnp.zeros((), out.dtype), out)
+    return reduce_from_tensor_model_parallel_region(out, axis_name)
+
+
+def column_parallel_linear(
+    x,
+    kernel,
+    bias=None,
+    *,
+    gather_output: bool = True,
+    axis_name: str = TP_AXIS,
+):
+    """Y_i = X @ A_i (+ b_i); A sharded on the output dim (ref forward
+    :443-463). ``kernel``: (in, out/tp)."""
+    x = copy_to_tensor_model_parallel_region(x, axis_name)
+    y = jnp.dot(x, kernel, preferred_element_type=jnp.float32).astype(x.dtype)
+    if bias is not None:
+        y = y + bias
+    if gather_output:
+        y = gather_from_tensor_model_parallel_region(y, axis_name)
+    return y
+
+
+def row_parallel_linear(
+    x,
+    kernel,
+    bias=None,
+    *,
+    input_is_parallel: bool = False,
+    axis_name: str = TP_AXIS,
+):
+    """Y = sum_i X_i @ A_i (+ b); A sharded on the input dim (ref forward
+    :560-576). ``kernel``: (in/tp, out); bias added once, after the reduce."""
+    if not input_is_parallel:
+        x = scatter_to_tensor_model_parallel_region(x, axis_name)
+    y = jnp.dot(x, kernel, preferred_element_type=jnp.float32).astype(x.dtype)
+    y = reduce_from_tensor_model_parallel_region(y, axis_name)
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+# ---------------------------------------------------------------------------
+# Modules
+
+
+def _tp_world() -> int:
+    from apex_tpu.transformer import parallel_state
+
+    return parallel_state.get_tensor_model_parallel_world_size()
+
+
+class VocabParallelEmbedding(nn.Module):
+    """Ref layers.py:138-215. Params are the local (vocab/tp, hidden) shard;
+    call inside a mesh program."""
+
+    num_embeddings: int
+    embedding_dim: int
+    init_method: Callable = nn.initializers.normal(stddev=0.02)
+    params_dtype: jnp.dtype = jnp.float32
+    axis_name: str = TP_AXIS
+
+    @nn.compact
+    def __call__(self, ids):
+        per_partition = divide(self.num_embeddings, _tp_world())
+        weight = self.param(
+            "weight",
+            sharded_init(
+                self.init_method,
+                (self.num_embeddings, self.embedding_dim),
+                partition_dim=0,
+                axis_name=self.axis_name,
+            ),
+            (per_partition, self.embedding_dim),
+            self.params_dtype,
+        )
+        return vocab_parallel_embedding(ids, weight, self.axis_name)
+
+
+class ColumnParallelLinear(nn.Module):
+    """Ref layers.py:321-463. Returns ``(output, output_bias)`` exactly like
+    the reference (``output_bias`` is the unapplied bias iff skip_bias_add)."""
+
+    input_size: int
+    output_size: int
+    use_bias: bool = True
+    gather_output: bool = True
+    init_method: Callable = nn.initializers.xavier_normal()
+    skip_bias_add: bool = False
+    params_dtype: jnp.dtype = jnp.float32
+    axis_name: str = TP_AXIS
+
+    @nn.compact
+    def __call__(self, x):
+        out_per_partition = divide(self.output_size, _tp_world())
+        kernel = self.param(
+            "kernel",
+            sharded_init(
+                self.init_method,
+                (self.input_size, self.output_size),
+                partition_dim=1,
+                axis_name=self.axis_name,
+            ),
+            (self.input_size, out_per_partition),
+            self.params_dtype,
+        )
+        bias = (
+            self.param("bias", nn.initializers.zeros, (out_per_partition,),
+                       self.params_dtype)
+            if self.use_bias
+            else None
+        )
+        y = column_parallel_linear(
+            x,
+            kernel,
+            None if self.skip_bias_add else bias,
+            gather_output=self.gather_output,
+            axis_name=self.axis_name,
+        )
+        return y, (bias if self.skip_bias_add else None)
+
+
+class RowParallelLinear(nn.Module):
+    """Ref layers.py:464-576. Returns ``(output, output_bias)``."""
+
+    input_size: int
+    output_size: int
+    use_bias: bool = True
+    input_is_parallel: bool = False
+    init_method: Callable = nn.initializers.xavier_normal()
+    skip_bias_add: bool = False
+    params_dtype: jnp.dtype = jnp.float32
+    axis_name: str = TP_AXIS
+
+    @nn.compact
+    def __call__(self, x):
+        in_per_partition = divide(self.input_size, _tp_world())
+        kernel = self.param(
+            "kernel",
+            sharded_init(
+                self.init_method,
+                (self.input_size, self.output_size),
+                partition_dim=0,
+                axis_name=self.axis_name,
+            ),
+            (in_per_partition, self.output_size),
+            self.params_dtype,
+        )
+        # Bias is NOT sharded; initialized zero (ref :540-548) and added after
+        # the reduce so it is applied exactly once.
+        bias = (
+            self.param("bias", nn.initializers.zeros, (self.output_size,),
+                       self.params_dtype)
+            if self.use_bias
+            else None
+        )
+        y = row_parallel_linear(
+            x,
+            kernel,
+            None if self.skip_bias_add else bias,
+            input_is_parallel=self.input_is_parallel,
+            axis_name=self.axis_name,
+        )
+        return y, (bias if self.skip_bias_add else None)

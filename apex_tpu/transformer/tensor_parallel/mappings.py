@@ -1,0 +1,108 @@
+"""The four tensor-parallel collective mappings, as differentiable functions.
+
+Reference: ``apex/transformer/tensor_parallel/mappings.py:23-157`` — four
+``torch.autograd.Function``s pairing a forward collective with its transpose
+in backward:
+
+====================  =============================  =======================
+mapping               forward                        backward
+====================  =============================  =======================
+copy_to_...           identity                       all-reduce
+reduce_from_...       all-reduce                     identity
+scatter_to_...        split last dim (keep my slice) all-gather (concat)
+gather_from_...       all-gather (concat last dim)   split (keep my slice)
+====================  =============================  =======================
+
+TPU re-design: in a ``shard_map`` body JAX tracks which values vary across
+each mesh axis (the VMA system) and *derives* the transpose collectives, so
+three of the four mappings are raw primitives whose autodiff rules already
+match the reference's backward table:
+
+* copy      = ``pcast(to='varying')`` — identity whose transpose is ``psum``
+  (the reference's bwd all-reduce, ``mappings.py:77-92``); crucially the psum
+  is inserted exactly once, where a hand-written custom-VJP psum would
+  double-count against shard_map's own invariant-input reduction.
+* reduce    = ``lax.psum`` — its transpose is the identity cast (:95-107).
+* scatter   = ``axis_index``-based slice — its transpose (scatter-add + the
+  invariant-input psum) reassembles the full gradient = the reference's bwd
+  all-gather (:110-121).
+* gather    = ``lax.all_gather(tiled)`` — this one DOES need a custom VJP:
+  the built-in transpose is ``psum_scatter``, which double-counts when the
+  downstream loss is computed redundantly per TP rank (the Megatron pattern:
+  every rank holds the gathered activations and computes the same loss). The
+  reference's bwd is *split, not reduce-scatter* (:124-135) for exactly this
+  reason.
+
+These functions therefore require ``check_vma=True`` (the shard_map default)
+— with ``check_vma=False`` JAX cannot insert the copy/scatter transposes.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.parallel.mesh import TP_AXIS
+from apex_tpu.transformer.tensor_parallel.utils import divide
+
+
+def _is_varying(x, axis_name: str) -> bool:
+    try:
+        return axis_name in jax.typeof(x).vma
+    except (AttributeError, TypeError):
+        return True  # no vma tracking (check_vma=False) — treat as varying
+
+
+def _pvary(x, axis_name: str):
+    """Mark x as device-varying over axis (identity value-wise); transpose is
+    psum. No-op if already varying."""
+    if _is_varying(x, axis_name):
+        return x
+    return lax.pcast(x, axis_name, to="varying")
+
+
+def _split(x, axis_name: str):
+    """Keep this rank's slice of the last dim (ref mappings.py:36-52)."""
+    world = lax.axis_size(axis_name)
+    chunk = divide(x.shape[-1], world)
+    rank = lax.axis_index(axis_name)
+    return lax.dynamic_slice_in_dim(x, rank * chunk, chunk, axis=x.ndim - 1)
+
+
+def copy_to_tensor_model_parallel_region(x, axis_name: str = TP_AXIS):
+    """Identity fwd / all-reduce bwd (ref _CopyToModelParallelRegion,
+    mappings.py:77-92). Feeds activations into a column-parallel matmul."""
+    return _pvary(x, axis_name)
+
+
+def reduce_from_tensor_model_parallel_region(x, axis_name: str = TP_AXIS):
+    """All-reduce fwd / identity bwd (ref _ReduceFromModelParallelRegion,
+    mappings.py:95-107). Collects partial sums out of a row-parallel matmul."""
+    return lax.psum(_pvary(x, axis_name), axis_name)
+
+
+def scatter_to_tensor_model_parallel_region(x, axis_name: str = TP_AXIS):
+    """Split-last-dim fwd / all-gather bwd (ref _ScatterToModelParallelRegion,
+    mappings.py:110-121)."""
+    return _split(_pvary(x, axis_name), axis_name)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def gather_from_tensor_model_parallel_region(x, axis_name: str = TP_AXIS):
+    """All-gather-concat fwd / split bwd (ref _GatherFromModelParallelRegion,
+    mappings.py:124-135)."""
+    return lax.all_gather(x, axis_name, axis=x.ndim - 1, tiled=True)
+
+
+def _gather_fwd(x, axis_name):
+    return gather_from_tensor_model_parallel_region(x, axis_name), None
+
+
+def _gather_bwd(axis_name, _res, g):
+    return (_split(g, axis_name),)
+
+
+gather_from_tensor_model_parallel_region.defvjp(_gather_fwd, _gather_bwd)

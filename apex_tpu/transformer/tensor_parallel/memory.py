@@ -1,0 +1,84 @@
+"""Preallocated activation storage — API parity for the reference's
+MemoryBuffer/RingMemBuffer.
+
+Reference: ``apex/transformer/tensor_parallel/memory.py:34-140`` — a
+preallocated flat CUDA tensor handed out as zero-copy views so checkpointed
+activations don't churn the caching allocator.
+
+TPU re-design: XLA owns allocation; buffer reuse comes from donation
+(``jax.jit(..., donate_argnums)``) and the fact that a jitted step has a
+static memory plan — there is no allocator churn to fight. These classes keep
+the reference's shape-accounting semantics (allocate typed views out of one
+budget, error on overflow) so code written against the reference API ports,
+but the "views" are ordinary arrays.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+
+
+class MemoryBuffer:
+    """Ref memory.py:34-118: fixed element budget, ``get(shape)`` carves a
+    typed view, ``reset()`` rewinds."""
+
+    def __init__(self, name: str, numel: int, dtype, track_usage: bool = False):
+        self.name = name
+        self.numel = numel
+        self.dtype = dtype
+        self.track_usage = track_usage
+        self.in_use_numel = 0
+        self.max_used = 0
+
+    def reset(self):
+        self.in_use_numel = 0
+
+    def is_in_use(self) -> bool:
+        return self.in_use_numel > 0
+
+    def numel_in_use(self) -> int:
+        return self.in_use_numel
+
+    def get(self, shape):
+        n = 1
+        for s in shape:
+            n *= s
+        if self.in_use_numel + n > self.numel:
+            raise RuntimeError(
+                f"MemoryBuffer {self.name!r} overflow: requested {n} elements, "
+                f"{self.numel - self.in_use_numel} free of {self.numel}"
+            )
+        self.in_use_numel += n
+        if self.track_usage:
+            self.max_used = max(self.max_used, self.in_use_numel)
+        return jnp.zeros(shape, self.dtype)
+
+    def print_average_usage(self):
+        from apex_tpu._logging import get_logger
+
+        get_logger(__name__).info(
+            "MemoryBuffer %s: peak %d / %d elements", self.name, self.max_used,
+            self.numel,
+        )
+
+
+class RingMemBuffer:
+    """Ref memory.py:121-140: a rotating ring of MemoryBuffers."""
+
+    def __init__(self, name: str, num_buffers: int, numel: int, dtype,
+                 track_usage: bool = False):
+        self.num_buffers = num_buffers
+        self.buffers: List[MemoryBuffer] = [
+            MemoryBuffer(f"{name} {i}", numel, dtype, track_usage)
+            for i in range(num_buffers)
+        ]
+        self._index = -1
+
+    def get_next_buffer(self) -> MemoryBuffer:
+        self._index = (self._index + 1) % self.num_buffers
+        buf = self.buffers[self._index]
+        if buf.is_in_use():
+            raise RuntimeError("buffer is already in use")
+        return buf

@@ -1,0 +1,188 @@
+"""RNG policy + Megatron-style activation checkpointing, functional JAX.
+
+Reference: ``apex/transformer/tensor_parallel/random.py`` — two subsystems:
+
+1. ``CudaRNGStatesTracker`` (:113-193): named RNG streams so dropout inside
+   tensor-parallel regions draws *different* randomness per TP rank (seed +
+   2718 + tp_rank) while dropout outside draws the *same* randomness across
+   the TP group (plain seed), identically across DP replicas of a position.
+2. ``CheckpointFunction`` (:224-294): activation checkpointing that re-runs
+   the forward under the restored RNG states, optionally sharding the one
+   saved hidden state across TP ranks (``distribute_saved_activations``).
+
+TPU re-design: JAX RNG is already functional — a key is a value, not device
+state — so the tracker collapses to **key derivation policy**:
+``model_parallel_key`` folds ``axis_index(tp)`` into the key (distinct per TP
+rank), ``data_parallel_key`` does not (identical across the TP group). The
+stateful ``fork()`` choreography (save/restore device RNG state) has no
+analogue and nothing to get wrong. A thin ``RngStatesTracker`` keeps the
+reference's named-stream API for porting convenience.
+
+Checkpointing maps to ``jax.checkpoint``: recompute-in-backward with
+deterministic RNG is automatic (keys are inputs, replayed exactly), and
+``distribute_saved_activations`` maps to a save policy — under GSPMD the
+saved residuals inherit the activations' sharding, so the TP-sharded-save
+behavior comes from sharding, not from a manual MemoryBuffer.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.parallel.mesh import DP_AXIS, PP_AXIS, TP_AXIS
+
+_MODEL_PARALLEL_RNG_TRACKER_NAME = "model-parallel-rng"
+
+# Matches the reference's seed-offset convention (random.py:203-207):
+# "2718 is just for fun and any POSITIVE value will work."
+_MODEL_PARALLEL_SEED_OFFSET = 2718
+
+
+def model_parallel_key(key, axis_name: str = TP_AXIS):
+    """A key distinct per TP rank, identical across DP replicas — for dropout
+    inside tensor-parallel regions (ref random.py:195-221 'tensor-model-
+    parallel state'). Valid inside a mesh program."""
+    return jax.random.fold_in(
+        jax.random.fold_in(key, _MODEL_PARALLEL_SEED_OFFSET),
+        lax.axis_index(axis_name),
+    )
+
+
+def data_parallel_key(key):
+    """The 'default state': same across the TP group (ref :205-210). JAX keys
+    are replicated across the mesh unless folded, so this is the identity —
+    named for call-site clarity."""
+    return key
+
+
+def pipeline_stage_key(key, axis_name: str = PP_AXIS):
+    """Distinct per pipeline stage — used to decorrelate dropout across
+    stages when one traced program serves every stage."""
+    return jax.random.fold_in(key, lax.axis_index(axis_name))
+
+
+class RngStatesTracker:
+    """Named key streams with the reference tracker's API surface
+    (ref random.py:113-193). Each named stream holds a base key; ``fork``
+    yields a fresh subkey each use (the functional analogue of "the state
+    advances while forked")."""
+
+    def __init__(self):
+        self._keys: Dict[str, jax.Array] = {}
+        self._counters: Dict[str, int] = {}
+        self._seeds = set()
+
+    def reset(self):
+        self._keys = {}
+        self._counters = {}
+        self._seeds = set()
+
+    def get_states(self):
+        return dict(self._keys)
+
+    def set_states(self, states):
+        self._keys = dict(states)
+        self._counters = {name: self._counters.get(name, 0) for name in self._keys}
+
+    def add(self, name: str, seed_or_key):
+        if name in self._keys:
+            raise RuntimeError(f"rng state {name!r} already exists")
+        if isinstance(seed_or_key, int):
+            if seed_or_key in self._seeds:
+                raise RuntimeError(f"seed {seed_or_key} already exists")
+            self._seeds.add(seed_or_key)
+            key = jax.random.key(seed_or_key)
+        else:
+            key = seed_or_key
+        self._keys[name] = key
+        self._counters[name] = 0
+
+    def key(self, name: str = _MODEL_PARALLEL_RNG_TRACKER_NAME):
+        """Next subkey from the named stream."""
+        if name not in self._keys:
+            raise RuntimeError(f"rng state {name!r} is not added")
+        k = jax.random.fold_in(self._keys[name], self._counters[name])
+        self._counters[name] += 1
+        return k
+
+    @contextlib.contextmanager
+    def fork(self, name: str = _MODEL_PARALLEL_RNG_TRACKER_NAME):
+        """Context-manager parity shim: yields the subkey (ref fork():163-183
+        swaps device state; here the key is handed to the caller)."""
+        yield self.key(name)
+
+
+_RNG_STATE_TRACKER = RngStatesTracker()
+
+
+def get_rng_tracker() -> RngStatesTracker:
+    """Ref ``get_cuda_rng_tracker`` (random.py:187-189)."""
+    return _RNG_STATE_TRACKER
+
+
+# Alias keeping the reference's import name greppable.
+get_cuda_rng_tracker = get_rng_tracker
+
+
+def model_parallel_seed(seed: int) -> Dict[str, jax.Array]:
+    """Ref ``model_parallel_cuda_manual_seed`` (random.py:195-221): installs
+    the default (data-parallel) stream and the model-parallel stream. The
+    model-parallel stream is rank-folded lazily at use — fold_in of
+    axis_index must happen inside the mesh program — so the tracker stores
+    the *base* key and callers pass it through :func:`model_parallel_key`.
+    """
+    tracker = get_rng_tracker()
+    tracker.reset()
+    base = jax.random.key(seed)
+    tracker.add("default", base)
+    tracker.add(
+        _MODEL_PARALLEL_RNG_TRACKER_NAME,
+        jax.random.fold_in(base, _MODEL_PARALLEL_SEED_OFFSET),
+    )
+    return tracker.get_states()
+
+
+model_parallel_cuda_manual_seed = model_parallel_seed
+
+
+# ---------------------------------------------------------------------------
+# Activation checkpointing (ref CheckpointFunction, random.py:224-294)
+
+#: Save policies, in the vocabulary of the reference's memory knobs:
+#: - "nothing": recompute everything (the reference's behavior — only the
+#:   *input* is saved, random.py:239-246)
+#: - "dots": save MXU outputs, recompute elementwise (usually the TPU sweet
+#:   spot — recomputing matmuls wastes MXU cycles)
+#: - "everything": no rematerialization (checkpointing off)
+CHECKPOINT_POLICIES = {
+    "nothing": None,  # jax.checkpoint default: save nothing saveable
+    "dots": "dots_with_no_batch_dims_saveable",
+    "everything": "everything_saveable",
+}
+
+
+def checkpoint(function: Callable, *args, policy: str = "nothing", **kwargs):
+    """Checkpoint ``function(*args)``: run forward without saving
+    intermediates; re-run it during backward (ref random.py:291-294).
+
+    RNG correctness is structural: any dropout key is an explicit argument
+    and is replayed identically in the recompute — the property the reference
+    needs the whole tracker save/restore dance for (:247-253, :268-283).
+    ``distribute_saved_activations`` (:239-246) is subsumed by sharding: saved
+    residuals inherit the (TP-sharded) activation sharding under GSPMD.
+    """
+    return checkpoint_wrapper(function, policy=policy)(*args, **kwargs)
+
+
+def checkpoint_wrapper(function: Callable, policy: str = "nothing") -> Callable:
+    if policy not in CHECKPOINT_POLICIES:
+        raise ValueError(f"policy must be one of {sorted(CHECKPOINT_POLICIES)}")
+    name = CHECKPOINT_POLICIES[policy]
+    if name is None:
+        return jax.checkpoint(function)
+    return jax.checkpoint(function, policy=getattr(jax.checkpoint_policies, name))

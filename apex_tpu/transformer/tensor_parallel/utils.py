@@ -1,0 +1,55 @@
+"""Shape/vocab partition helpers for tensor parallelism.
+
+Reference: ``apex/transformer/tensor_parallel/utils.py:22-55`` (
+``split_tensor_along_last_dim``, ``VocabUtility``) and ``apex/transformer/
+utils.py`` (``divide``/``ensure_divisibility``).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+
+def ensure_divisibility(numerator: int, denominator: int) -> None:
+    if numerator % denominator != 0:
+        raise ValueError(f"{numerator} is not divisible by {denominator}")
+
+
+def divide(numerator: int, denominator: int) -> int:
+    ensure_divisibility(numerator, denominator)
+    return numerator // denominator
+
+
+def split_tensor_along_last_dim(tensor, num_partitions: int):
+    """Split along the last dim into equal chunks (ref utils.py:22-39).
+
+    Returns a tuple of arrays. (On TPU there is no contiguity knob — XLA owns
+    layout — so the reference's ``contiguous_split_chunks`` flag has no
+    analogue.)
+    """
+    divide(tensor.shape[-1], num_partitions)  # divisibility check
+    return tuple(jnp.split(tensor, num_partitions, axis=-1))
+
+
+class VocabUtility:
+    """Vocab range [first, last) owned by ``rank`` out of ``world_size``
+    (ref utils.py:40-55)."""
+
+    @staticmethod
+    def vocab_range_from_per_partition_vocab_size(
+        per_partition_vocab_size: int, rank, world_size: int
+    ):
+        index_f = rank * per_partition_vocab_size
+        index_l = index_f + per_partition_vocab_size
+        return index_f, index_l
+
+    @staticmethod
+    def vocab_range_from_global_vocab_size(
+        global_vocab_size: int, rank, world_size: int
+    ) -> Tuple[int, int]:
+        per_partition = divide(global_vocab_size, world_size)
+        return VocabUtility.vocab_range_from_per_partition_vocab_size(
+            per_partition, rank, world_size
+        )
