@@ -1,0 +1,3 @@
+"""Model-parallel AMP (ref ``apex/transformer/amp/``)."""
+
+from apex_tpu.transformer.amp.grad_scaler import GradScaler  # noqa: F401

@@ -1,0 +1,7 @@
+"""Fused functional ops for the transformer stack (ref
+``apex/transformer/functional/__init__.py``)."""
+
+from apex_tpu.transformer.functional.fused_softmax import (  # noqa: F401
+    AttnMaskType,
+    FusedScaleMaskSoftmax,
+)

@@ -1,0 +1,111 @@
+"""FusedScaleMaskSoftmax — the kernel-selection module.
+
+Reference: ``apex/transformer/functional/fused_softmax.py:95-199`` — picks the
+causal CUDA kernel (``scaled_upper_triang_masked_softmax_cuda``) or the
+padding-mask kernel (``scaled_masked_softmax_cuda``) when the shape/dtype
+constraints hold (fp16/bf16, 16 < sk ≤ 2048, ...), else falls back to an
+unfused torch softmax with optional fp32 upcast.
+
+TPU re-design: both "kernels" are the custom-VJP functions in
+``apex_tpu.ops.softmax`` (XLA fuses scale→mask→softmax into one loop; the
+custom VJP reproduces the reference's backward-from-saved-output memory
+trade), valid at any sequence length — so ``is_kernel_available`` only
+gates on the input-in-half-precision rule that changes *numerics* in the
+reference, not on shape limits.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+
+from apex_tpu.ops.softmax import (
+    scaled_masked_softmax,
+    scaled_softmax,
+    scaled_upper_triang_masked_softmax,
+)
+
+
+class AttnMaskType(enum.Enum):
+    """Ref ``apex/transformer/enums.py`` AttnMaskType."""
+
+    padding = 1
+    causal = 2
+
+
+class FusedScaleMaskSoftmax:
+    """Ref fused_softmax.py:95-199. Callable module:
+    ``softmax(input, mask) -> probs`` over ``(b, np, sq, sk)`` scores.
+
+    ``mask_func`` is the fallback-path mask application (the reference applies
+    ``mask_func(input, mask)`` before the unfused softmax, :172-186).
+    """
+
+    def __init__(
+        self,
+        input_in_fp16: bool = False,
+        input_in_bf16: bool = False,
+        attn_mask_type: AttnMaskType = AttnMaskType.padding,
+        scaled_masked_softmax_fusion: bool = True,
+        mask_func: Optional[Callable] = None,
+        softmax_in_fp32: bool = True,
+        scale: Optional[float] = None,
+    ) -> None:
+        if input_in_fp16 and input_in_bf16:
+            raise ValueError("both fp16 and bf16 flags cannot be active")
+        self.input_in_fp16 = input_in_fp16
+        self.input_in_bf16 = input_in_bf16
+        self.input_in_float16 = input_in_fp16 or input_in_bf16
+        self.attn_mask_type = attn_mask_type
+        self.scaled_masked_softmax_fusion = scaled_masked_softmax_fusion
+        self.mask_func = mask_func
+        self.softmax_in_fp32 = softmax_in_fp32
+        self.scale = scale
+        if scale is not None and not softmax_in_fp32:
+            raise ValueError("softmax should be in fp32 when scaled")
+
+    def is_kernel_available(self, mask, b, np_, sq, sk) -> bool:
+        """The reference's gate (:126-160) minus the CUDA shape limits."""
+        return self.scaled_masked_softmax_fusion and self.input_in_float16
+
+    def __call__(self, input: jnp.ndarray, mask=None) -> jnp.ndarray:
+        b, np_, sq, sk = input.shape
+        if self.is_kernel_available(mask, b, np_, sq, sk):
+            return self.forward_fused_softmax(input, mask)
+        return self.forward_torch_softmax(input, mask)
+
+    def forward_fused_softmax(self, input, mask):
+        """Ref :162-171."""
+        scale = self.scale if self.scale is not None else 1.0
+        if self.attn_mask_type == AttnMaskType.causal:
+            if input.shape[2] != input.shape[3]:
+                raise ValueError("causal mask is only for self attention")
+            b, np_, sq, sk = input.shape
+            out = scaled_upper_triang_masked_softmax(
+                input.reshape(b * np_, sq, sk), scale
+            )
+            return out.reshape(b, np_, sq, sk)
+        return scaled_masked_softmax(input, mask, scale)
+
+    def forward_torch_softmax(self, input, mask):
+        """The unfused fallback (ref :172-193): optional fp32 upcast, mask
+        via ``mask_func``, plain softmax, downcast."""
+        orig_dtype = input.dtype
+        if self.input_in_float16 and self.softmax_in_fp32:
+            input = input.astype(jnp.float32)
+        if self.scale is not None:
+            input = input * self.scale
+        if mask is not None:
+            if self.mask_func is not None:
+                input = self.mask_func(input, mask)
+            else:
+                input = jnp.where(mask, -10000.0, input)
+        probs = jnp.exp(
+            input - jnp.max(input, axis=-1, keepdims=True)
+        )
+        probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
+        if self.input_in_float16 and self.softmax_in_fp32:
+            probs = probs.astype(orig_dtype)
+        return probs

@@ -1,0 +1,133 @@
+"""Stage-to-stage activation exchange over the pipeline mesh axis.
+
+Reference: ``apex/transformer/pipeline_parallel/p2p_communication.py`` —
+``_communicate`` (:70) batches ``isend``/``irecv`` (``P2POp`` +
+``batch_isend_irecv``, :29-68) between adjacent pipeline stages and exposes
+eight public ops (:187-408): ``recv_forward``, ``recv_backward``,
+``send_forward``, ``send_backward``, and the four fused
+``send_*_recv_*`` combinations.
+
+TPU re-design: under SPMD there is no per-rank send/recv — every stage runs
+the same program, so a "send to next stage" IS a "receive from the previous
+stage" on the shifted device. The ICI-native primitive for this is
+``lax.ppermute`` (collective permute), which XLA schedules to overlap with
+compute (the reference manages this overlap by hand with separate NCCL ops).
+Consequently the eight reference ops collapse onto two ring shifts:
+
+* forward direction (activations): shift **+1** along the ``pp`` axis —
+  :func:`send_forward_recv_forward`.
+* backward direction (cotangents): shift **-1** — handled *automatically* by
+  autodiff (the transpose of a ppermute is the inverse ppermute), but also
+  exposed as :func:`send_backward_recv_backward` for hand-rolled schedules.
+
+The individual ``send_forward`` / ``recv_forward`` names are kept as aliases
+of the fused shift so schedule code written against the reference API reads
+naturally. All functions must run inside a mesh program (``shard_map``).
+
+The reference's ``scatter_gather_tensors_in_pipeline`` option (:70-186)
+splits the transferred tensor across TP ranks to cut p2p volume; the analogue
+here is :func:`send_forward_recv_forward` with ``scatter_gather=True``, which
+reduce-scatters over ``tp`` before the shift and all-gathers after —
+profitable when the TP all-gather is cheaper than (tp-1)/tp of the PP hop.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax import lax
+
+from apex_tpu.parallel.mesh import PP_AXIS, TP_AXIS
+
+
+def _ring_perm(n: int, shift: int):
+    return [(i, (i + shift) % n) for i in range(n)]
+
+
+def _shift(x, shift: int, axis_name: str):
+    n = lax.axis_size(axis_name)
+    perm = _ring_perm(n, shift)
+    return jax.tree.map(lambda a: lax.ppermute(a, axis_name, perm), x)
+
+
+def send_forward_recv_forward(output_tensor, axis_name: str = PP_AXIS,
+                              *, scatter_gather: bool = False):
+    """Hand my stage's output to the next stage; receive the previous stage's
+    (ref p2p_communication.py:350-372). Ring-wrapped: the last stage's output
+    arrives at stage 0, where schedules either ignore it or (interleaved
+    schedule) treat it as the next model chunk's input.
+    """
+    if scatter_gather:
+        return _scatter_shift_gather(output_tensor, +1, axis_name)
+    return _shift(output_tensor, +1, axis_name)
+
+
+def send_backward_recv_backward(input_tensor_grad, axis_name: str = PP_AXIS):
+    """Hand my stage's input-gradient to the previous stage
+    (ref p2p_communication.py:373-395). Autodiff of
+    :func:`send_forward_recv_forward` produces exactly this shift; it exists
+    as a public op for schedules written with explicit VJPs."""
+    return _shift(input_tensor_grad, -1, axis_name)
+
+
+# Aliases: under SPMD each of these IS the fused shift (see module docstring).
+# They take/return the full pytree; "recv" names return the shifted value,
+# "send" names return it too (callers that only send simply drop it).
+
+def send_forward(output_tensor, axis_name: str = PP_AXIS):
+    """Ref :237-263."""
+    return send_forward_recv_forward(output_tensor, axis_name)
+
+
+def recv_forward(output_tensor_from_prev, axis_name: str = PP_AXIS):
+    """Ref :187-212 — in SPMD the value to 'receive' is the previous stage's
+    output, so the caller passes the pytree that every stage computed and
+    gets back the shifted view."""
+    return send_forward_recv_forward(output_tensor_from_prev, axis_name)
+
+
+def send_backward(input_tensor_grad, axis_name: str = PP_AXIS):
+    """Ref :264-290."""
+    return send_backward_recv_backward(input_tensor_grad, axis_name)
+
+
+def recv_backward(grad_from_next, axis_name: str = PP_AXIS):
+    """Ref :213-236."""
+    return send_backward_recv_backward(grad_from_next, axis_name)
+
+
+def send_forward_recv_backward(output_tensor, grad_tensor,
+                               axis_name: str = PP_AXIS):
+    """Ref :291-319 — the 1F1B steady-state exchange: activations go up,
+    cotangents come down, in one batched launch. Here: two independent
+    ppermutes that XLA schedules concurrently over opposite ICI directions.
+    Returns ``(recv_forward_value, recv_backward_value)``."""
+    return _shift(output_tensor, +1, axis_name), _shift(grad_tensor, -1, axis_name)
+
+
+def send_backward_recv_forward(input_tensor_grad, output_tensor,
+                               axis_name: str = PP_AXIS):
+    """Ref :320-349. Returns ``(recv_backward_value, recv_forward_value)``."""
+    return _shift(input_tensor_grad, -1, axis_name), _shift(output_tensor, +1, axis_name)
+
+
+def _scatter_shift_gather(x, shift: int, axis_name: str,
+                          tp_axis: str = TP_AXIS):
+    """Shift 1/tp of the tensor per TP rank, then reassemble
+    (the ``scatter_gather_tensors_in_pipeline`` optimization,
+    ref p2p_communication.py:100-186): each (pp, tp) device moves only its
+    slice over the pp hop, and the full tensor is rebuilt with a TP
+    all-gather, which rides the (faster/shorter) tp ICI ring."""
+
+    def one(a):
+        tp = lax.axis_size(tp_axis)
+        if tp == 1 or a.shape[-1] % tp != 0:
+            return lax.ppermute(a, axis_name, _ring_perm(lax.axis_size(axis_name), shift))
+        i = lax.axis_index(tp_axis)
+        chunk = a.shape[-1] // tp
+        piece = lax.dynamic_slice_in_dim(a, i * chunk, chunk, a.ndim - 1)
+        piece = lax.ppermute(piece, axis_name, _ring_perm(lax.axis_size(axis_name), shift))
+        return lax.all_gather(piece, tp_axis, axis=a.ndim - 1, tiled=True)
+
+    return jax.tree.map(one, x)

@@ -1,0 +1,151 @@
+"""Shared plumbing for the forward-backward schedule drivers.
+
+Reference: ``apex/transformer/pipeline_parallel/schedules/common.py`` —
+``build_model`` (:25) constructs per-stage modules with ``pre_process`` /
+``post_process`` flags (and virtual chunks), ``forward_step`` (:226) runs one
+microbatch forward with loss scaling by ``num_microbatches``, and
+``backward_step`` (:288) feeds the received output-cotangent into
+``torch.autograd.backward``.
+
+TPU re-design: under jax the forward/backward split is autodiff, so the
+driver contract is value-based:
+
+* The model is a :class:`PipelineSpec` of three pure functions. The
+  embedding (``pre_process``) and loss head (``post_process``) run *outside*
+  the ring — they are cheap relative to the stack, and keeping the pipelined
+  region shape-uniform is what lets the whole schedule live in one
+  ``lax.scan``. The reference's separate "embedding group" all-reduce that
+  ties input/output embedding gradients across the first and last stage
+  (``parallel_state`` embedding group) disappears: if ``loss_fn`` reuses the
+  embed table, autodiff sums both contributions in one grad pytree.
+* ``build_model`` stacks per-stage parameter pytrees along a leading ``pp``
+  axis (plus a ``vp`` chunk axis for the interleaved schedule) so one
+  ``P("pp", ...)`` sharding puts each stage's weights on its devices.
+* ``backward_step`` needs no analogue: the transpose of the schedule's
+  ``ppermute`` ring is the reverse ring, derived by XLA.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.parallel.mesh import AXIS_ORDER, DP_AXIS, PP_AXIS
+
+Pytree = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class PipelineSpec:
+    """The pipelined model contract (the ``model_provider_func`` analogue,
+    ref common.py:25-148).
+
+    embed_fn(embed_params, inputs_mb) -> hidden
+        The ``pre_process`` half: token/position embedding for ONE
+        microbatch. Runs on every device (its FLOPs are negligible; its
+        output is consumed at stage 0 only).
+    stage_fn(stage_params, hidden) -> hidden
+        One pipeline stage (``num_layers / (pp * vp)`` transformer layers).
+        Must be shape-preserving — this uniformity is what the ring relies
+        on. May use TP/SP collectives internally.
+    loss_fn(head_params, hidden, targets_mb) -> scalar
+        The ``post_process`` half: final norm + head + loss for ONE
+        microbatch, already averaged over the microbatch's tokens.
+    """
+
+    embed_fn: Callable[[Pytree, Pytree], Pytree]
+    stage_fn: Callable[[Pytree, Pytree], Pytree]
+    loss_fn: Callable[[Pytree, Pytree, Pytree], jnp.ndarray]
+
+
+def build_model(
+    stage_init_fn: Callable[[jax.Array, int], Pytree],
+    rng: jax.Array,
+    num_stages: int,
+    virtual_pipeline_size: Optional[int] = None,
+) -> Pytree:
+    """Initialize and stack per-stage params (ref common.py:25-147).
+
+    ``stage_init_fn(rng, global_chunk_index)`` returns one chunk's params.
+    Non-interleaved: leaves gain a leading ``[pp]`` axis. Interleaved: a
+    leading ``[vp, pp]`` pair, laid out so chunk ``v`` on stage ``s`` holds
+    layer-block ``v * pp + s`` — the Megatron interleaved assignment
+    (ref fwd_bwd_pipelining_with_interleaving.py:25-60).
+    """
+    vp = virtual_pipeline_size or 1
+    chunks = [
+        stage_init_fn(jax.random.fold_in(rng, c), c) for c in range(vp * num_stages)
+    ]
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *chunks)
+    if virtual_pipeline_size is None:
+        return stacked
+    # [vp*pp, ...] -> [vp, pp, ...] with chunk-major order matching v*pp+s.
+    return jax.tree.map(
+        lambda x: x.reshape((vp, num_stages) + x.shape[1:]), stacked
+    )
+
+
+def stage_params_spec(params: Pytree, interleaved: bool = False) -> Pytree:
+    """Default PartitionSpecs for stacked stage params: shard the stage axis
+    over ``pp``, replicate the rest. Callers with TP-sharded weights supply
+    their own tree instead."""
+    lead = P(None, PP_AXIS) if interleaved else P(PP_AXIS)
+    return jax.tree.map(lambda _: lead, params)
+
+
+def split_microbatches(batch: Pytree, num_microbatches: int) -> Pytree:
+    """[B, ...] -> [M, B/M, ...] on every leaf (ref
+    pipeline_parallel/utils.py:105-139 ``get_kth_microbatch``, vectorized)."""
+
+    def one(x):
+        b = x.shape[0]
+        if b % num_microbatches != 0:
+            raise ValueError(
+                f"batch dim {b} not divisible by num_microbatches "
+                f"{num_microbatches}"
+            )
+        return x.reshape((num_microbatches, b // num_microbatches) + x.shape[1:])
+
+    return jax.tree.map(one, batch)
+
+
+def listify_spec(spec, tree: Pytree) -> Pytree:
+    """Broadcast a single PartitionSpec over a pytree."""
+    return jax.tree.map(lambda _: spec, tree)
+
+
+def replicate_loss(local_loss, mesh, masked_axis: str = PP_AXIS):
+    """Turn a loss that is nonzero only on the last pipeline stage (and
+    identical across tp/sp, different across dp) into a scalar that is
+    invariant over the whole mesh:
+
+    * psum over ``pp`` collects the last stage's value;
+    * psum/size over ``dp`` averages across data-parallel replicas — the
+      ``average_losses_across_data_parallel_group`` semantics
+      (ref pipeline_parallel/utils.py:242-252);
+    * psum/size over the remaining axes turns "replicated by construction"
+      into "invariant for the VMA system".
+    """
+    loss = local_loss
+    for a in mesh.axis_names:
+        n = mesh.shape[a]
+        loss = lax.psum(_pvary(loss, a), a)
+        if a != masked_axis:
+            loss = loss / n
+    return loss
+
+
+def _pvary(x, axis_name: str):
+    """Mark x varying over axis (identity value-wise) so psum is legal under
+    check_vma; no-op if already varying."""
+    try:
+        if axis_name in jax.typeof(x).vma:
+            return x
+    except (AttributeError, TypeError):
+        return x
+    return lax.pcast(x, axis_name, to="varying")

@@ -1,0 +1,67 @@
+"""No-pipelining schedule: a gradient-accumulation microbatch loop.
+
+Reference: ``fwd_bwd_no_pipelining.py:31-95`` — runs all-but-last microbatches
+inside ``model.no_sync()`` (suppressing the DDP all-reduce), accumulating
+grads, then the last microbatch with the all-reduce enabled.
+
+TPU re-design: a ``lax.scan`` of ``jax.value_and_grad`` over microbatches,
+summing gradient pytrees on device. The reference's no_sync dance exists to
+all-reduce once instead of M times; here grads are accumulated locally inside
+the jitted step and the data-parallel ``psum`` happens once wherever the
+caller's DP wrapper puts it (see ``apex_tpu.parallel.distributed``) — the
+same "reduce once at the end" schedule, enforced by construction.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.transformer.pipeline_parallel.schedules.common import (
+    split_microbatches,
+)
+
+Pytree = Any
+
+
+def forward_backward_no_pipelining(
+    forward_step_func: Callable[[Pytree, Pytree], jnp.ndarray],
+    batch: Pytree,
+    params: Pytree,
+    *,
+    num_microbatches: int,
+    loss_scale: Optional[jnp.ndarray] = None,
+    unroll: int = 1,
+) -> Tuple[jnp.ndarray, Pytree]:
+    """Returns ``(mean_unscaled_loss, grads)``; grads are of
+    ``mean(loss) * loss_scale`` summed over microbatches (ref common.py:226-256
+    scales each microbatch loss by 1/num_microbatches before backward).
+
+    ``forward_step_func(params, microbatch) -> scalar loss`` is the analogue
+    of the reference's ``forward_step_func(batch, model)``.
+    """
+    mb = split_microbatches(batch, num_microbatches)
+    scale = 1.0 if loss_scale is None else loss_scale
+
+    def scaled(p, m):
+        loss = forward_step_func(p, m)
+        return loss * scale / num_microbatches, loss
+
+    vg = jax.value_and_grad(scaled, has_aux=True)
+
+    def body(acc, m):
+        loss_sum, grad_sum = acc
+        (_, loss), g = vg(params, m)
+        return (
+            loss_sum + loss,
+            jax.tree.map(jnp.add, grad_sum, g),
+        ), None
+
+    zeros = jax.tree.map(jnp.zeros_like, params)
+    (loss_sum, grads), _ = lax.scan(
+        body, (jnp.zeros(()), zeros), mb, unroll=unroll
+    )
+    return loss_sum / num_microbatches, grads

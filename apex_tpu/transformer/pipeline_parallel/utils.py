@@ -1,0 +1,170 @@
+"""Pipeline-parallel utilities.
+
+Reference: ``apex/transformer/pipeline_parallel/utils.py`` — microbatch
+calculator setup (:58), microbatch slicing (:105-139), DP loss averaging
+(:242), params-l2-norm (:213), memory report (:253), GPT left-to-right mask
+builder (:303).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.parallel.mesh import DP_AXIS
+from apex_tpu.transformer.pipeline_parallel.microbatches import (
+    NumMicroBatchesCalculator,
+    build_num_microbatches_calculator,
+)
+
+_GLOBAL_NUM_MICROBATCHES_CALCULATOR: Optional[NumMicroBatchesCalculator] = None
+
+
+def setup_microbatch_calculator(
+    rank: int,
+    rampup_batch_size: Optional[List[int]],
+    global_batch_size: int,
+    micro_batch_size: int,
+    data_parallel_size: int,
+) -> None:
+    """Ref utils.py:58-80 (singleton with re-init guard)."""
+    global _GLOBAL_NUM_MICROBATCHES_CALCULATOR
+    if _GLOBAL_NUM_MICROBATCHES_CALCULATOR is not None:
+        raise RuntimeError("num microbatches calculator is already initialized")
+    _GLOBAL_NUM_MICROBATCHES_CALCULATOR = build_num_microbatches_calculator(
+        rank, rampup_batch_size, global_batch_size, micro_batch_size,
+        data_parallel_size,
+    )
+
+
+def _ensure_calculator() -> NumMicroBatchesCalculator:
+    if _GLOBAL_NUM_MICROBATCHES_CALCULATOR is None:
+        raise RuntimeError(
+            "num microbatches calculator is not initialized; call "
+            "setup_microbatch_calculator() first"
+        )
+    return _GLOBAL_NUM_MICROBATCHES_CALCULATOR
+
+
+def destroy_microbatch_calculator() -> None:
+    global _GLOBAL_NUM_MICROBATCHES_CALCULATOR
+    _GLOBAL_NUM_MICROBATCHES_CALCULATOR = None
+
+
+def get_num_microbatches() -> int:
+    return _ensure_calculator().get()
+
+
+def get_current_global_batch_size() -> int:
+    return _ensure_calculator().get_current_global_batch_size()
+
+
+def get_micro_batch_size() -> int:
+    return _ensure_calculator().micro_batch_size
+
+
+def update_num_microbatches(consumed_samples: int,
+                            consistency_check: bool = True) -> None:
+    _ensure_calculator().update(consumed_samples, consistency_check)
+
+
+# ---------------------------------------------------------------------------
+
+
+def average_losses_across_data_parallel_group(losses: Sequence[jnp.ndarray],
+                                              axis_name: str = DP_AXIS):
+    """Ref utils.py:242-252. Inside a mesh program: pmean of the stacked
+    losses over the dp axis."""
+    stacked = jnp.stack([jnp.asarray(l) for l in losses])
+    return lax.pmean(stacked, axis_name)
+
+
+def calc_params_l2_norm(params: Any, model_parallel_axes: Sequence[str] = ()):
+    """Global parameter L2 norm (ref utils.py:213-240): sum of squares over
+    the local pytree, psum over the model-parallel axes (each rank holds a
+    distinct shard), sqrt."""
+    sq = sum(
+        jnp.sum(jnp.square(p.astype(jnp.float32)))
+        for p in jax.tree.leaves(params)
+    )
+    for a in model_parallel_axes:
+        sq = lax.psum(sq, a)
+    return jnp.sqrt(sq)
+
+
+def report_memory(name: str = "") -> str:
+    """Ref utils.py:253-270 — CUDA allocator stats; here: per-device live
+    bytes from the TPU/host allocator."""
+    lines = []
+    for d in jax.local_devices():
+        stats = {}
+        try:
+            stats = d.memory_stats() or {}
+        except (RuntimeError, AttributeError, jax.errors.JaxRuntimeError):
+            pass
+        used = stats.get("bytes_in_use", 0)
+        peak = stats.get("peak_bytes_in_use", 0)
+        lines.append(
+            f"[{name}] {d}: in_use={used / 2**20:.1f}MiB "
+            f"peak={peak / 2**20:.1f}MiB"
+        )
+    report = "\n".join(lines)
+    from apex_tpu._logging import get_logger
+
+    get_logger(__name__).info("%s", report)
+    return report
+
+
+# ---------------------------------------------------------------------------
+
+
+def get_ltor_masks_and_position_ids(
+    data: jnp.ndarray,
+    eod_token: int,
+    reset_position_ids: bool = False,
+    reset_attention_mask: bool = False,
+    eod_mask_loss: bool = False,
+):
+    """Build GPT left-to-right masks + position ids (ref utils.py:303-367).
+
+    Returns ``(attention_mask, loss_mask, position_ids)`` with the reference's
+    conventions: attention_mask boolean with True = MASKED OUT (shape
+    ``(b, 1, seq, seq)``), loss_mask float (0 at eod when ``eod_mask_loss``),
+    position_ids ``(b, seq)``.
+
+    The reference's per-document reset path walks eod positions in a Python
+    loop (:330-360); here it is vectorized: the document id of each token is
+    ``cumsum(prev-token == eod)``, attention is additionally masked across
+    document boundaries, and position ids restart via a segment-local
+    cumulative count.
+    """
+    b, seq = data.shape
+    causal = jnp.tril(jnp.ones((seq, seq), dtype=bool))
+    attention_mask = jnp.broadcast_to(causal, (b, 1, seq, seq))
+
+    loss_mask = jnp.ones((b, seq), dtype=jnp.float32)
+    if eod_mask_loss:
+        loss_mask = jnp.where(data == eod_token, 0.0, loss_mask)
+
+    position_ids = jnp.broadcast_to(jnp.arange(seq), (b, seq))
+
+    if reset_position_ids or reset_attention_mask:
+        prev_is_eod = jnp.concatenate(
+            [jnp.zeros((b, 1), dtype=bool), (data == eod_token)[:, :-1]], axis=1
+        )
+        doc_id = jnp.cumsum(prev_is_eod.astype(jnp.int32), axis=1)
+        if reset_attention_mask:
+            same_doc = doc_id[:, :, None] == doc_id[:, None, :]
+            attention_mask = attention_mask & same_doc[:, None, :, :]
+        if reset_position_ids:
+            # position within document: index - index-of-document-start
+            idx = jnp.broadcast_to(jnp.arange(seq), (b, seq))
+            doc_start = jnp.where(prev_is_eod, idx, 0)
+            doc_start = jax.lax.cummax(doc_start, axis=1)
+            position_ids = idx - doc_start
+
+    # flip to the reference's "True = masked out" convention (utils.py:365)
+    return ~attention_mask, loss_mask, position_ids

@@ -83,11 +83,21 @@ class RngStatesTracker:
         self._seeds = set()
 
     def get_states(self):
-        return dict(self._keys)
+        """Snapshot of (key, counter) per stream — restoring it replays the
+        exact same subkey sequence (the point of the reference's
+        ``get_states``/``set_states``, random.py:150-161)."""
+        return {
+            name: (key, self._counters[name]) for name, key in self._keys.items()
+        }
 
     def set_states(self, states):
-        self._keys = dict(states)
-        self._counters = {name: self._counters.get(name, 0) for name in self._keys}
+        self._keys = {}
+        self._counters = {}
+        for name, entry in states.items():
+            # accept bare keys for backward compatibility (counter restarts)
+            key, counter = entry if isinstance(entry, tuple) else (entry, 0)
+            self._keys[name] = key
+            self._counters[name] = counter
 
     def add(self, name: str, seed_or_key):
         if name in self._keys:
@@ -132,9 +142,11 @@ get_cuda_rng_tracker = get_rng_tracker
 def model_parallel_seed(seed: int) -> Dict[str, jax.Array]:
     """Ref ``model_parallel_cuda_manual_seed`` (random.py:195-221): installs
     the default (data-parallel) stream and the model-parallel stream. The
-    model-parallel stream is rank-folded lazily at use — fold_in of
-    axis_index must happen inside the mesh program — so the tracker stores
-    the *base* key and callers pass it through :func:`model_parallel_key`.
+    tracker stores the model-parallel stream with the 2718 offset already
+    folded in; what remains device-dependent is the rank fold, which must
+    happen inside the mesh program — so fold ``lax.axis_index(tp)`` into the
+    key the tracker hands out (NOT :func:`model_parallel_key`, which folds
+    the offset again and is meant for raw base keys).
     """
     tracker = get_rng_tracker()
     tracker.reset()

@@ -1,0 +1,291 @@
+"""Pipeline-parallel schedule tests on the 8-device virtual mesh.
+
+Ref test strategy: ``tests/L0/run_transformer/run_pipeline_parallel_test.py``
+runs all three schedules (× dtypes × grad scaler) and checks losses; here the
+stronger check is available: the pipelined loss/grads must EQUAL the
+sequential single-device computation of the same stage stack.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.transformer import parallel_state
+from apex_tpu.transformer.pipeline_parallel import (
+    get_ltor_masks_and_position_ids,
+    microbatches as mb_mod,
+)
+from apex_tpu.transformer.pipeline_parallel.microbatches import (
+    ConstantNumMicroBatches,
+    RampupBatchsizeNumMicroBatches,
+)
+from apex_tpu.transformer.pipeline_parallel.schedules import (
+    PipelineSpec,
+    build_model,
+    forward_backward_no_pipelining,
+    forward_backward_pipelining_with_interleaving,
+    forward_backward_pipelining_without_interleaving,
+    get_forward_backward_func,
+)
+
+HID = 8
+B = 8
+SEQ = 4
+
+
+def _spec():
+    def embed_fn(ep, x):
+        return x @ ep["w"]
+
+    def stage_fn(sp, h):
+        return jnp.tanh(h @ sp["w"] + sp["b"])
+
+    def loss_fn(hp, h, tgt):
+        pred = h @ hp["w"]
+        return jnp.mean((pred - tgt) ** 2)
+
+    return PipelineSpec(embed_fn, stage_fn, loss_fn)
+
+
+def _params(rng, num_chunks, vp=None):
+    k1, k2, k3 = jax.random.split(rng, 3)
+
+    def stage_init(key, c):
+        kw, kb = jax.random.split(key)
+        return {
+            "w": jax.random.normal(kw, (HID, HID)) * 0.3,
+            "b": jax.random.normal(kb, (HID,)) * 0.1,
+        }
+
+    stages = build_model(stage_init, k1, num_chunks if vp is None else num_chunks,
+                         virtual_pipeline_size=vp)
+    return {
+        "embed": {"w": jax.random.normal(k2, (HID, HID)) * 0.3},
+        "stages": stages,
+        "head": {"w": jax.random.normal(k3, (HID, HID)) * 0.3},
+    }
+
+
+def _batch(rng, b=B):
+    ki, kt = jax.random.split(rng)
+    return (
+        jax.random.normal(ki, (b, SEQ, HID)),
+        jax.random.normal(kt, (b, SEQ, HID)),
+    )
+
+
+def _chunk_order_reference(spec, params, batch, num_microbatches, pp, vp):
+    """Ground truth for interleaved layout [vp, pp, ...]: execution order is
+    chunk v*pp+s i.e. iterate v outer, s inner."""
+    inputs, targets = batch
+
+    def loss_of(p):
+        def one_mb(x, t):
+            h = spec.embed_fn(p["embed"], x)
+            for v in range(vp):
+                for s in range(pp):
+                    sp = jax.tree.map(lambda a: a[v, s], p["stages"])
+                    h = spec.stage_fn(sp, h)
+            return spec.loss_fn(p["head"], h, t)
+
+        M = num_microbatches
+        nb = inputs.shape[0]
+        xs = inputs.reshape((M, nb // M) + inputs.shape[1:])
+        ts = targets.reshape((M, nb // M) + targets.shape[1:])
+        return jnp.mean(jax.vmap(one_mb)(xs, ts))
+
+    return jax.value_and_grad(loss_of)(params)
+
+
+def _flat_reference(spec, params, batch, num_microbatches, pp):
+    inputs, targets = batch
+
+    def loss_of(p):
+        def one_mb(x, t):
+            h = spec.embed_fn(p["embed"], x)
+            for s in range(pp):
+                sp = jax.tree.map(lambda a: a[s], p["stages"])
+                h = spec.stage_fn(sp, h)
+            return spec.loss_fn(p["head"], h, t)
+
+        M = num_microbatches
+        nb = inputs.shape[0]
+        xs = inputs.reshape((M, nb // M) + inputs.shape[1:])
+        ts = targets.reshape((M, nb // M) + targets.shape[1:])
+        return jnp.mean(jax.vmap(one_mb)(xs, ts))
+
+    return jax.value_and_grad(loss_of)(params)
+
+
+def _assert_tree_close(a, b, atol=1e-5):
+    jax.tree.map(
+        lambda x, y: np.testing.assert_allclose(
+            np.asarray(x), np.asarray(y), atol=atol, rtol=1e-4
+        ),
+        a,
+        b,
+    )
+
+
+# ---------------------------------------------------------------------------
+
+
+def test_no_pipelining_matches_plain_grad():
+    parallel_state.initialize_model_parallel()  # trivial mesh ok
+    rng = jax.random.PRNGKey(0)
+    spec = _spec()
+    params = _params(rng, 2)
+    batch = _batch(jax.random.PRNGKey(1))
+
+    def fwd(p, mb):
+        x, t = mb
+        h = spec.embed_fn(p["embed"], x)
+        for s in range(2):
+            h = spec.stage_fn(jax.tree.map(lambda a: a[s], p["stages"]), h)
+        return spec.loss_fn(p["head"], h, t)
+
+    loss, grads = forward_backward_no_pipelining(
+        fwd, batch, params, num_microbatches=4
+    )
+    ref_loss, ref_g = _flat_reference(spec, params, batch, 4, 2)
+    np.testing.assert_allclose(float(loss), float(ref_loss), atol=1e-5)
+    # grads of mean-over-microbatch loss: no_pipelining returns grads of
+    # sum(loss/M) = grads of mean
+    _assert_tree_close(grads, ref_g)
+
+
+@pytest.mark.parametrize("num_microbatches", [4, 8])
+def test_1f1b_matches_sequential(num_microbatches):
+    mesh = parallel_state.initialize_model_parallel(
+        pipeline_model_parallel_size_=4
+    )
+    rng = jax.random.PRNGKey(2)
+    spec = _spec()
+    params = _params(rng, 4)
+    batch = _batch(jax.random.PRNGKey(3), b=16)
+
+    loss, grads = forward_backward_pipelining_without_interleaving(
+        spec, params, batch, num_microbatches=num_microbatches, mesh=mesh
+    )
+    ref_loss, ref_g = _flat_reference(spec, params, batch, num_microbatches, 4)
+    np.testing.assert_allclose(float(loss), float(ref_loss), atol=1e-5)
+    _assert_tree_close(grads, ref_g)
+
+
+def test_1f1b_with_dp():
+    mesh = parallel_state.initialize_model_parallel(
+        pipeline_model_parallel_size_=4
+    )  # dp = 2 remaining
+    assert mesh.shape["dp"] == 2
+    rng = jax.random.PRNGKey(4)
+    spec = _spec()
+    params = _params(rng, 4)
+    batch = _batch(jax.random.PRNGKey(5))
+
+    loss, grads = forward_backward_pipelining_without_interleaving(
+        spec, params, batch, num_microbatches=2, mesh=mesh
+    )
+    ref_loss, ref_g = _flat_reference(spec, params, batch, 2, 4)
+    np.testing.assert_allclose(float(loss), float(ref_loss), atol=1e-5)
+    _assert_tree_close(grads, ref_g)
+
+
+@pytest.mark.parametrize("vp", [2, 3])
+def test_interleaved_matches_sequential(vp):
+    mesh = parallel_state.initialize_model_parallel(
+        pipeline_model_parallel_size_=2,
+        virtual_pipeline_model_parallel_size_=vp,
+    )
+    rng = jax.random.PRNGKey(6)
+    spec = _spec()
+    params = _params(rng, 2, vp=vp)
+    batch = _batch(jax.random.PRNGKey(7), b=16)
+
+    loss, grads = forward_backward_pipelining_with_interleaving(
+        spec, params, batch, num_microbatches=4, virtual_pipeline_size=vp,
+        mesh=mesh,
+    )
+    ref_loss, ref_g = _chunk_order_reference(spec, params, batch, 4, 2, vp)
+    np.testing.assert_allclose(float(loss), float(ref_loss), atol=1e-5)
+    _assert_tree_close(grads, ref_g)
+
+
+def test_loss_scale_scales_grads():
+    mesh = parallel_state.initialize_model_parallel(
+        pipeline_model_parallel_size_=4
+    )
+    spec = _spec()
+    params = _params(jax.random.PRNGKey(8), 4)
+    batch = _batch(jax.random.PRNGKey(9))
+    loss1, g1 = forward_backward_pipelining_without_interleaving(
+        spec, params, batch, num_microbatches=4, mesh=mesh
+    )
+    loss2, g2 = forward_backward_pipelining_without_interleaving(
+        spec, params, batch, num_microbatches=4, mesh=mesh,
+        loss_scale=jnp.asarray(8.0),
+    )
+    np.testing.assert_allclose(float(loss1), float(loss2), atol=1e-6)
+    _assert_tree_close(g2, jax.tree.map(lambda x: 8.0 * x, g1))
+
+
+def test_get_forward_backward_func_dispatch():
+    parallel_state.initialize_model_parallel(pipeline_model_parallel_size_=4)
+    assert (
+        get_forward_backward_func()
+        is forward_backward_pipelining_without_interleaving
+    )
+    parallel_state.initialize_model_parallel(
+        pipeline_model_parallel_size_=4,
+        virtual_pipeline_model_parallel_size_=2,
+    )
+    assert (
+        get_forward_backward_func()
+        is forward_backward_pipelining_with_interleaving
+    )
+    parallel_state.initialize_model_parallel()
+    assert get_forward_backward_func() is forward_backward_no_pipelining
+
+
+# ---------------------------------------------------------------------------
+# microbatch calculator (ref microbatches.py tests via run_pipeline tests)
+
+
+def test_constant_microbatches():
+    c = ConstantNumMicroBatches(64, 4, 2)
+    assert c.get() == 8
+    with pytest.raises(ValueError):
+        ConstantNumMicroBatches(63, 4, 2)
+
+
+def test_rampup_microbatches():
+    r = RampupBatchsizeNumMicroBatches(
+        start_batch_size=8, batch_size_increment=8, ramup_samples=400,
+        global_batch_size=32, micro_batch_size=4, data_parallel_size=2,
+    )
+    assert r.get_current_global_batch_size() == 8
+    r.update(100, True)
+    assert r.get_current_global_batch_size() == 8
+    r.update(200, True)
+    assert r.get_current_global_batch_size() == 16
+    r.update(1000, True)
+    assert r.get_current_global_batch_size() == 32
+    assert r.get() == 32 // (4 * 2)
+
+
+def test_ltor_masks_and_position_ids():
+    data = jnp.asarray([[5, 1, 7, 2, 9, 9]])  # eod = 9
+    am, lm, pid = get_ltor_masks_and_position_ids(
+        data, eod_token=9, reset_position_ids=True,
+        reset_attention_mask=True, eod_mask_loss=True,
+    )
+    assert am.shape == (1, 1, 6, 6)
+    # causal: last row all visible within doc; first row only position 0
+    assert not bool(am[0, 0, 0, 0])  # self not masked
+    assert bool(am[0, 0, 0, 1])  # future masked
+    np.testing.assert_array_equal(np.asarray(lm[0]), [1, 1, 1, 1, 0, 0])
+    # after the first eod at index 4, positions restart
+    np.testing.assert_array_equal(np.asarray(pid[0]), [0, 1, 2, 3, 4, 0])
+    # cross-document attention masked: token 5 (doc 1) cannot see token 0
+    assert bool(am[0, 0, 5, 0])

@@ -14,7 +14,6 @@ import jax.numpy as jnp
 from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
-from apex_tpu.parallel.mesh import build_mesh
 from apex_tpu.transformer import parallel_state
 from apex_tpu.transformer import tensor_parallel as tp
 
@@ -27,10 +26,6 @@ def mesh_tp2():
 @pytest.fixture
 def mesh_tp8():
     return parallel_state.initialize_model_parallel(tensor_model_parallel_size_=8)
-
-
-def _shard_last(x, n, i):
-    return np.split(np.asarray(x), n, axis=-1)[i]
 
 
 # ---------------------------------------------------------------------------
@@ -259,6 +254,20 @@ def test_rng_tracker_named_streams():
     )
     with pytest.raises(RuntimeError):
         tr.key("missing")
+
+
+def test_rng_tracker_state_roundtrip_replays_keys():
+    """get_states/set_states must snapshot stream counters so a restore
+    replays the same subkeys (the CheckpointFunction recompute pattern,
+    ref random.py:247-283)."""
+    tr = tp.RngStatesTracker()
+    tr.add("s", 1)
+    tr.key("s")  # advance
+    snap = tr.get_states()
+    k1 = tr.key("s")
+    tr.set_states(snap)
+    k2 = tr.key("s")
+    assert np.array_equal(jax.random.key_data(k1), jax.random.key_data(k2))
 
 
 def test_checkpoint_matches_uncheckpointed():
