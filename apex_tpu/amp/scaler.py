@@ -48,6 +48,7 @@ class LossScaler:
         scale_window: int = 2000,
         min_loss_scale: Optional[float] = None,
         max_loss_scale: float = 2.0 ** 24,
+        backoff_factor: Optional[float] = None,
     ):
         if loss_scale == "dynamic":
             self.dynamic = True
@@ -57,6 +58,11 @@ class LossScaler:
             self._init_scale = float(loss_scale)
         self.scale_factor = scale_factor
         self.scale_window = scale_window
+        # shrink multiplier on overflow; the reference uses 1/scale_factor
+        # (scaler.py:203), torch-style GradScaler exposes it separately.
+        self.backoff_factor = (
+            backoff_factor if backoff_factor is not None else 1.0 / scale_factor
+        )
         self.min_loss_scale = min_loss_scale if min_loss_scale is not None else 1.0
         self.max_loss_scale = max_loss_scale
 
@@ -127,7 +133,7 @@ class LossScaler:
         grow = new_unskipped >= self.scale_window
         new_scale = jnp.where(
             overflow,
-            jnp.maximum(state.loss_scale / self.scale_factor, self.min_loss_scale),
+            jnp.maximum(state.loss_scale * self.backoff_factor, self.min_loss_scale),
             jnp.where(
                 grow,
                 jnp.minimum(state.loss_scale * self.scale_factor, self.max_loss_scale),

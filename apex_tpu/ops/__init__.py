@@ -15,6 +15,11 @@ Reference CUDA ext                             apex_tpu equivalent
 =============================================  =================================
 """
 
+from apex_tpu.ops.attention import (  # noqa: F401
+    attention_reference,
+    flash_attention,
+    flash_attention_with_lse,
+)
 from apex_tpu.ops.layer_norm import (  # noqa: F401
     layer_norm,
     layer_norm_reference,
@@ -29,6 +34,9 @@ from apex_tpu.ops.softmax import (  # noqa: F401
 from apex_tpu.ops.xentropy import softmax_cross_entropy_loss  # noqa: F401
 
 __all__ = [
+    "attention_reference",
+    "flash_attention",
+    "flash_attention_with_lse",
     "layer_norm",
     "layer_norm_reference",
     "rms_norm",

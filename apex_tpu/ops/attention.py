@@ -1,0 +1,407 @@
+"""Flash attention — Pallas TPU kernels with online softmax, plus reference.
+
+Reference: ``apex/contrib/csrc/fmha/`` (``fmhalib`` — fused MHA for packed
+varlen sequences ≤512, driver ``apex/contrib/fmha/fmha.py:33-76``) and
+``apex/contrib/csrc/multihead_attn/`` (``fast_multihead_attn`` — fused
+QKV+softmax+dropout+out-proj, drivers ``apex/contrib/multihead_attn/``).
+Those CUDA kernels exist because eager attention materializes the (sq, sk)
+score matrix in HBM; they are hard-limited to seqlen ≤ 512.
+
+TPU re-design: the flash-attention scheme — tile Q into VMEM blocks, stream
+K/V blocks through the MXU, keep a running row-max and denominator (online
+softmax), never materialize the score matrix. This removes the reference's
+sequence-length limit entirely and is the building block for ring attention
+(``apex_tpu/transformer/sequence_parallel.py``). Backward recomputes scores
+blockwise from the saved output and row log-sum-exp (the standard flash
+backward), as two accumulation kernels (dQ, and dK/dV).
+
+Layout: (batch, heads, seq, head_dim) — matches the Megatron attention core
+the transformer layer uses.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+try:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAS_PALLAS = False
+
+# Finite stand-in for -inf: keeps exp() exact zero without nan from (-inf) - (-inf).
+NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------------
+# Pure-JAX reference (ground truth for kernel tests; also the fallback path
+# for arbitrary masks / unaligned shapes — XLA fuses it into a few loops).
+
+def attention_reference(q, k, v, mask=None, scale: Optional[float] = None,
+                        causal: bool = False):
+    """Plain softmax(QKᵀ·scale)V in fp32 accumulation.
+
+    ``mask``: broadcastable boolean over (..., sq, sk), True = masked OUT
+    (the reference convention, ``apex/contrib/fmha/fmha.py`` cu_seqlens
+    padding → masked). Returns q.dtype.
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    q32 = q.astype(jnp.float32)
+    k32 = k.astype(jnp.float32)
+    v32 = v.astype(jnp.float32)
+    s = jnp.einsum("...qd,...kd->...qk", q32, k32) * scale
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        qpos = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        kpos = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        s = jnp.where(kpos > qpos + (sk - sq), NEG_INF, s)
+    if mask is not None:
+        s = jnp.where(mask, NEG_INF, s)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("...qk,...kd->...qd", p, v32)
+    return o.astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas forward
+
+def _fa_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                   *, scale, causal, block_q, block_k, nk):
+    q_i = pl.program_id(1)
+    kv_i = pl.program_id(2)
+
+    @pl.when(kv_i == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # Causal: skip K/V blocks entirely above the diagonal.
+    run = (kv_i * block_k <= q_i * block_q + block_q - 1) if causal else True
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        if causal:
+            qpos = q_i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = kv_i * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(kpos > qpos, NEG_INF, s)
+        m_prev = m_scr[:, :1]
+        l_prev = l_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        corr = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = corr * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * corr + jax.lax.dot(
+            p, v, preferred_element_type=jnp.float32)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(kv_i == nk - 1)
+    def _finish():
+        l = l_scr[:, :1]
+        # Fully-masked rows (possible under ring-attention partial blocks)
+        # produce l == 0; emit 0 output and lse = NEG_INF for the merge.
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scr[:] / safe_l).astype(o_ref.dtype)
+        # lse is laid out (bh, sq, 1): a (block_q, 1) block writes/reads with
+        # no lane↔sublane transpose (TPU block rules need the last dim to be
+        # 128-divisible or equal to the full array dim — here it's 1 == 1).
+        lse_ref[0] = jnp.where(l == 0.0, NEG_INF, m_scr[:, :1] + jnp.log(safe_l))
+
+
+def _fa_fwd(q3, k3, v3, scale, causal, block_q, block_k, interpret):
+    bh, sq, d = q3.shape
+    sk = k3.shape[1]
+    nq = sq // block_q
+    nk = sk // block_k
+    kernel = functools.partial(
+        _fa_fwd_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, nk=nk)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), q3.dtype),
+            jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q3, k3, v3)
+    return o, lse
+
+
+# ---------------------------------------------------------------------------
+# Pallas backward: dQ kernel (grid over K/V blocks innermost) and dK/dV kernel
+# (grid over Q blocks innermost). Scores are recomputed from q, k and the
+# saved lse — p = exp(s - lse) is already normalized, so no second pass over
+# the row is needed (the flash-attention backward identity).
+
+def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                      dq_scr, *, scale, causal, block_q, block_k, nk):
+    q_i = pl.program_id(1)
+    kv_i = pl.program_id(2)
+
+    @pl.when(kv_i == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    run = (kv_i * block_k <= q_i * block_q + block_q - 1) if causal else True
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]
+        delta = delta_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        if causal:
+            qpos = q_i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = kv_i * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(kpos > qpos, NEG_INF, s)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dq_scr[:] += jax.lax.dot(ds, k, preferred_element_type=jnp.float32)
+
+    @pl.when(kv_i == nk - 1)
+    def _finish():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                       dk_ref, dv_ref, dk_scr, dv_scr,
+                       *, scale, causal, block_q, block_k, nq):
+    kv_i = pl.program_id(1)
+    q_i = pl.program_id(2)
+
+    @pl.when(q_i == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    run = (q_i * block_q + block_q - 1 >= kv_i * block_k) if causal else True
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]
+        delta = delta_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        if causal:
+            qpos = q_i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = kv_i * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(kpos > qpos, NEG_INF, s)
+        p = jnp.exp(s - lse)
+        dv_scr[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dk_scr[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    @pl.when(q_i == nq - 1)
+    def _finish():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _fa_bwd(q3, k3, v3, o3, lse, do3, scale, causal, block_q, block_k,
+            interpret):
+    bh, sq, d = q3.shape
+    sk = k3.shape[1]
+    nq = sq // block_q
+    nk = sk // block_k
+    delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
+                    axis=-1, keepdims=True)
+
+    dq_kernel = functools.partial(
+        _fa_bwd_dq_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, nk=nk)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q3.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(q3, k3, v3, do3, lse, delta)
+
+    dkv_kernel = functools.partial(
+        _fa_bwd_dkv_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, nq=nq)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(bh, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sk, d), k3.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), v3.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q3, k3, v3, do3, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp plumbing over (bh, seq, d) arrays
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash3(q3, k3, v3, scale, causal, block_q, block_k, interpret):
+    o, _ = _fa_fwd(q3, k3, v3, scale, causal, block_q, block_k, interpret)
+    return o
+
+
+def _flash3_fwd(q3, k3, v3, scale, causal, block_q, block_k, interpret):
+    o, lse = _fa_fwd(q3, k3, v3, scale, causal, block_q, block_k, interpret)
+    return o, (q3, k3, v3, o, lse)
+
+
+def _flash3_bwd(scale, causal, block_q, block_k, interpret, res, do3):
+    q3, k3, v3, o3, lse = res
+    return _fa_bwd(q3, k3, v3, o3, lse, do3, scale, causal, block_q, block_k,
+                   interpret)
+
+
+_flash3.defvjp(_flash3_fwd, _flash3_bwd)
+
+
+def flash_attention_with_lse(q3, k3, v3, scale, causal, block_q, block_k,
+                             interpret):
+    """Forward-only variant returning (o, lse) with lse (bh, sq) — the
+    ring-attention building block (merging partial results needs the
+    log-sum-exp). Not differentiable; ring attention differentiates through
+    its own recompute."""
+    o, lse = _fa_fwd(q3, k3, v3, scale, causal, block_q, block_k, interpret)
+    return o, lse[..., 0]
+
+
+# ---------------------------------------------------------------------------
+# Public API
+
+def _pick_block(seq: int, want: int) -> Optional[int]:
+    for cand in (want, 256, 128, 64, 32, 16, 8):
+        if cand <= want and seq % cand == 0:
+            return cand
+    return None
+
+
+def _pallas_ok(sq, sk, d, causal, allow_interpret):
+    if not _HAS_PALLAS:
+        return False
+    if _pick_block(sq, 128) is None or _pick_block(sk, 128) is None:
+        return False
+    if d % 8 != 0:
+        return False
+    if causal and sq != sk:
+        return False
+    return allow_interpret or jax.default_backend() == "tpu"
+
+
+def flash_attention(
+    q, k, v,
+    mask=None,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    use_pallas: Optional[bool] = None,
+):
+    """Memory-efficient attention over (batch, heads, seq, head_dim).
+
+    Pallas flash kernel for the causal / no-mask cases on aligned shapes
+    (ref capability: ``fmhalib`` + ``fast_multihead_attn``, without their
+    seqlen ≤ 512 limit); XLA reference path for arbitrary ``mask`` or odd
+    shapes. ``mask`` True = masked out.
+    """
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    pallas_possible = mask is None and _pallas_ok(
+        sq, sk, d, causal, allow_interpret=True)
+    if use_pallas is None:
+        use_pallas = mask is None and _pallas_ok(
+            sq, sk, d, causal, allow_interpret=False)
+    elif use_pallas and not pallas_possible:
+        raise ValueError(
+            f"pallas flash_attention needs mask=None, seq divisible by a "
+            f"block size, head_dim % 8 == 0, and sq == sk when causal "
+            f"(got q {q.shape}, k {k.shape}, causal={causal}, "
+            f"mask={'set' if mask is not None else None})")
+    if not use_pallas:
+        return attention_reference(q, k, v, mask=mask, scale=scale,
+                                   causal=causal)
+    bq = _pick_block(sq, block_q)
+    bk = _pick_block(sk, block_k)
+    interpret = jax.default_backend() != "tpu"
+    o3 = _flash3(
+        q.reshape(b * h, sq, d), k.reshape(b * h, sk, d),
+        v.reshape(b * h, sk, d), scale, causal, bq, bk, interpret)
+    return o3.reshape(b, h, sq, d)

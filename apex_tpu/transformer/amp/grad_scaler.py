@@ -46,11 +46,9 @@ class GradScaler(LossScaler):
             init_scale=float(init_scale),
             scale_factor=growth_factor,
             scale_window=growth_interval,
+            backoff_factor=backoff_factor,
             **kw,
         )
-        # the reference hard-codes backoff=1/growth asymmetry via two knobs;
-        # LossScaler uses one factor — honor backoff when it differs.
-        self._backoff_factor = backoff_factor
         self.axis_names = tuple(axis_names) if axis_names is not None else None
 
     def _mp_axes(self) -> Sequence[str]:
@@ -76,30 +74,4 @@ class GradScaler(LossScaler):
         program)."""
         if not synced:
             found_inf = self.sync_found_inf(found_inf)
-        if self._backoff_factor != 1.0 / self.scale_factor:
-            overflow = found_inf > 0
-            if self.dynamic:
-                new_unskipped = jnp.where(overflow, 0, state.unskipped + 1)
-                grow = new_unskipped >= self.scale_window
-                new_scale = jnp.where(
-                    overflow,
-                    jnp.maximum(
-                        state.loss_scale * self._backoff_factor,
-                        self.min_loss_scale,
-                    ),
-                    jnp.where(
-                        grow,
-                        jnp.minimum(
-                            state.loss_scale * self.scale_factor,
-                            self.max_loss_scale,
-                        ),
-                        state.loss_scale,
-                    ),
-                )
-                new_unskipped = jnp.where(grow, 0, new_unskipped)
-                return (
-                    LossScalerState(new_scale, new_unskipped.astype(jnp.int32)),
-                    overflow,
-                )
-            return state, overflow
         return super().update_scale(state, found_inf)

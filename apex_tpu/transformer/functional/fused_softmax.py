@@ -19,11 +19,11 @@ from __future__ import annotations
 import enum
 from typing import Callable, Optional
 
+import jax
 import jax.numpy as jnp
 
 from apex_tpu.ops.softmax import (
     scaled_masked_softmax,
-    scaled_softmax,
     scaled_upper_triang_masked_softmax,
 )
 
@@ -102,10 +102,7 @@ class FusedScaleMaskSoftmax:
                 input = self.mask_func(input, mask)
             else:
                 input = jnp.where(mask, -10000.0, input)
-        probs = jnp.exp(
-            input - jnp.max(input, axis=-1, keepdims=True)
-        )
-        probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
+        probs = jax.nn.softmax(input, axis=-1)
         if self.input_in_float16 and self.softmax_in_fp32:
             probs = probs.astype(orig_dtype)
         return probs

@@ -86,7 +86,12 @@ class RampupBatchsizeNumMicroBatches(NumMicroBatchesCalculator):
         )
 
         num_increments = diff_batch_size // batch_size_increment
-        self.rampup_samples_per_increment = self.ramup_samples / num_increments
+        # start == global means there is nothing to ramp: jump straight to the
+        # final batch size (avoids a 0/0 below).
+        self.rampup_samples_per_increment = (
+            self.ramup_samples / num_increments if num_increments > 0
+            else float("inf")
+        )
         self.update(0, False)
 
     def update(self, consumed_samples: int, consistency_check: bool) -> None:

@@ -33,10 +33,12 @@ def get_forward_backward_func(
         pipeline_model_parallel_size = (
             parallel_state.get_pipeline_model_parallel_world_size()
         )
-        if virtual_pipeline_model_parallel_size is None:
-            virtual_pipeline_model_parallel_size = (
-                parallel_state.get_virtual_pipeline_model_parallel_world_size()
-            )
+    if virtual_pipeline_model_parallel_size is None:
+        from apex_tpu.transformer import parallel_state
+
+        virtual_pipeline_model_parallel_size = (
+            parallel_state.get_virtual_pipeline_model_parallel_world_size()
+        )
     if pipeline_model_parallel_size > 1:
         if virtual_pipeline_model_parallel_size is not None:
             return forward_backward_pipelining_with_interleaving

@@ -114,11 +114,6 @@ def split_microbatches(batch: Pytree, num_microbatches: int) -> Pytree:
     return jax.tree.map(one, batch)
 
 
-def listify_spec(spec, tree: Pytree) -> Pytree:
-    """Broadcast a single PartitionSpec over a pytree."""
-    return jax.tree.map(lambda _: spec, tree)
-
-
 def replicate_loss(local_loss, mesh, masked_axis: str = PP_AXIS):
     """Turn a loss that is nonzero only on the last pipeline stage (and
     identical across tp/sp, different across dp) into a scalar that is

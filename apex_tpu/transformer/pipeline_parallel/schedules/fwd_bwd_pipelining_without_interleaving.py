@@ -56,9 +56,9 @@ def _tree_where(cond, a: Pytree, b: Pytree) -> Pytree:
 
 
 def _ring_shift(x: Pytree, axis_name: str) -> Pytree:
-    n = lax.axis_size(axis_name)
-    perm = [(i, (i + 1) % n) for i in range(n)]
-    return jax.tree.map(lambda a: lax.ppermute(a, axis_name, perm), x)
+    from apex_tpu.transformer.pipeline_parallel import p2p_communication
+
+    return p2p_communication.send_forward_recv_forward(x, axis_name)
 
 
 def _pvary_all(x: Pytree, axis_names) -> Pytree:
