@@ -1,0 +1,17 @@
+"""Contrib layer — TPU equivalents of ``apex/contrib`` (SURVEY.md §2.1).
+
+Subpackages mirror the reference's opt-in perf extensions. Where the
+reference needs a dedicated CUDA ext, the TPU build usually reuses the core
+Pallas/XLA kernels (``apex_tpu.ops``) under the contrib API names:
+
+=====================  ======================================================
+``contrib.multihead_attn``  fused self/enc-dec MHA over the flash kernel
+``contrib.fmha``            packed-varlen attention via segment masking
+``contrib.xentropy``        fused softmax cross-entropy (``ops.xentropy``)
+``contrib.layer_norm``      FastLayerNorm (``ops.layer_norm``)
+``contrib.optimizers``      ZeRO-style distributed Adam/LAMB
+``contrib.sparsity``        ASP 2:4 structured sparsity
+``contrib.transducer``      RNN-T joint + loss
+``contrib.groupbn``         group BatchNorm (``parallel.sync_batchnorm``)
+=====================  ======================================================
+"""

@@ -1,0 +1,20 @@
+"""Packed variable-length attention (ref ``apex/contrib/fmha``).
+
+Reference: ``apex/contrib/fmha/fmha.py:33-76`` + ``fmhalib`` (7.3k LoC CUDA):
+fused MHA over token-packed batches — sequences of different lengths
+concatenated into one (total_tokens, ...) tensor with ``cu_seqlens``
+boundaries, seqlen ≤ 512, BERT-style.
+
+TPU re-design: XLA wants static shapes, so the packed layout is kept but the
+variable lengths become a **segment-id mask**: position i may attend to j iff
+they belong to the same sequence. That is one broadcasted compare — no
+kernel needed beyond the attention itself — and there is no 512 limit.
+"""
+
+from apex_tpu.contrib.fmha.fmha import (  # noqa: F401
+    FMHA,
+    cu_seqlens_to_segment_ids,
+    fmha_packed,
+)
+
+__all__ = ["FMHA", "fmha_packed", "cu_seqlens_to_segment_ids"]

@@ -1,0 +1,54 @@
+"""Segment-masked attention over token-packed batches (see package doc)."""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops.attention import attention_reference
+
+
+def cu_seqlens_to_segment_ids(cu_seqlens, total: int):
+    """[0, l1, l1+l2, ...] -> per-token sequence index (ref fmha.py cu_seqlens
+    convention). Tokens at/after the last boundary get segment -1 (padding),
+    which matches nothing — including other padding — in the mask."""
+    positions = jnp.arange(total)
+    # segment of token t = number of boundaries <= t, minus 1
+    seg = jnp.sum(positions[:, None] >= cu_seqlens[None, :-1], axis=1) - 1
+    pad = positions >= cu_seqlens[-1]
+    return jnp.where(pad, -1, seg)
+
+
+def fmha_packed(qkv, cu_seqlens, *, causal: bool = False,
+                scale: Optional[float] = None):
+    """Attention over a packed batch.
+
+    ``qkv``: (total_tokens, 3, heads, head_dim) — the reference's interleaved
+    layout (``fmha.py:33``). ``cu_seqlens``: (batch+1,) int32 prefix sums.
+    Returns (total_tokens, heads, head_dim).
+    """
+    total, three, h, d = qkv.shape
+    if three != 3:
+        raise ValueError(f"qkv must be (total, 3, heads, d), got {qkv.shape}")
+    seg = cu_seqlens_to_segment_ids(cu_seqlens, total)
+    # cross-segment (and any-padding) pairs masked out
+    mask = (seg[:, None] != seg[None, :]) | (seg[:, None] < 0)
+    if causal:
+        mask = mask | (jnp.arange(total)[None, :] > jnp.arange(total)[:, None])
+    q, k, v = (qkv[:, i].transpose(1, 0, 2)[None] for i in range(3))
+    o = attention_reference(q, k, v, mask=mask[None, None], scale=scale)
+    return o[0].transpose(1, 0, 2)
+
+
+class FMHA(nn.Module):
+    """Ref ``fmha.py:59-76`` — module wrapper around the packed op."""
+
+    num_heads: int
+
+    @nn.compact
+    def __call__(self, qkv, cu_seqlens, *, causal: bool = False):
+        return fmha_packed(qkv, cu_seqlens, causal=causal)

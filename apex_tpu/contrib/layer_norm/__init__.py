@@ -1,0 +1,9 @@
+"""Contrib FastLayerNorm API (ref ``apex/contrib/layer_norm/layer_norm.py:40``
+over the ``fast_layer_norm`` ext for hidden sizes up to 65k): the Pallas
+layer-norm kernel in ``apex_tpu.ops.layer_norm`` covers all hidden sizes, so
+this package just re-exports it under the contrib name."""
+
+from apex_tpu.normalization import FusedLayerNorm as FastLayerNorm  # noqa: F401
+from apex_tpu.ops.layer_norm import layer_norm as fast_layer_norm  # noqa: F401
+
+__all__ = ["FastLayerNorm", "fast_layer_norm"]

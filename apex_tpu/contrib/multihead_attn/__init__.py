@@ -1,0 +1,8 @@
+"""Fused multi-head attention modules (ref ``apex/contrib/multihead_attn``)."""
+
+from apex_tpu.contrib.multihead_attn.modules import (  # noqa: F401
+    EncdecMultiheadAttn,
+    SelfMultiheadAttn,
+)
+
+__all__ = ["SelfMultiheadAttn", "EncdecMultiheadAttn"]

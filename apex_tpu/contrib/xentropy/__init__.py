@@ -1,0 +1,9 @@
+"""Contrib xentropy API (ref ``apex/contrib/xentropy/softmax_xentropy.py:4``):
+the fused label-smoothing cross-entropy lives in ``apex_tpu.ops.xentropy``;
+this package re-exports it under the reference's contrib name."""
+
+from apex_tpu.ops.xentropy import softmax_cross_entropy_loss  # noqa: F401
+
+SoftmaxCrossEntropyLoss = softmax_cross_entropy_loss
+
+__all__ = ["SoftmaxCrossEntropyLoss", "softmax_cross_entropy_loss"]

@@ -1,0 +1,149 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+Reference status (SURVEY.md §2.3 "SP" row): the reference has only *partial*
+sequence-length tooling — activation-checkpoint sharding across TP ranks
+(``apex/transformer/tensor_parallel/random.py:244-263``) and a scatter/gather
+option in pipeline p2p (``p2p_communication.py:70-186``). It has **no ring
+attention, no context parallelism, no Ulysses**. This module is the new
+first-class capability the TPU build adds on top of reference parity.
+
+Two TPU-native strategies over the ``sp`` mesh axis:
+
+* :func:`ring_attention` — K/V shards rotate around the sp ring via
+  ``lax.ppermute`` while each device's Q shard accumulates blockwise
+  (online-softmax) partial attention. Peak memory per device is O(s_local²)
+  scores per step; sequence length scales linearly with the ring size. The
+  rotation rides ICI neighbor links — the same property the reference's NCCL
+  p2p exploits for pipeline stages.
+* :func:`ulysses_attention` — ``lax.all_to_all`` re-shards from
+  sequence-sharded to head-sharded, runs dense local attention (the Pallas
+  flash kernel) on full-length sequences for h/sp heads, and re-shards back.
+  Cheaper collectives for moderate sequence lengths; requires
+  ``num_heads % sp == 0``.
+
+Both are pure functions usable inside ``shard_map`` over the global mesh and
+differentiable (the VJP of ``ppermute``/``all_to_all`` is the inverse
+collective, so the backward pass rotates the opposite way automatically).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.ops.attention import NEG_INF, flash_attention
+from apex_tpu.parallel.mesh import SP_AXIS
+
+
+def _ring_perm(n: int):
+    return [(i, (i + 1) % n) for i in range(n)]
+
+
+def ring_attention(
+    q, k, v,
+    axis_name: str = SP_AXIS,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    remat_steps: bool = True,
+):
+    """Exact attention over a sequence sharded on ``axis_name``.
+
+    ``q``/``k``/``v``: (batch, heads, s_local, head_dim) — this device's
+    sequence shard; global sequence = sp_size × s_local, shard order = ring
+    index order. Must run inside a mesh program. Returns this device's
+    (batch, heads, s_local, head_dim) output shard, equal to the
+    corresponding slice of dense attention over the gathered sequence.
+
+    Online-softmax accumulation across ring steps: masked score entries are
+    zeroed explicitly (not via exp of -inf) so fully-masked future chunks
+    contribute exactly nothing, keeping finite arithmetic throughout.
+    """
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    b, h, s_loc, d = q.shape
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    q32 = q.astype(jnp.float32)
+
+    qpos = my * s_loc + jnp.arange(s_loc)  # global positions of my Q rows
+
+    def step(carry, t):
+        k_c, v_c, m, l, acc = carry
+        origin = (my - t) % n  # ring index the current K/V chunk came from
+        s = jnp.einsum("bhqd,bhkd->bhqk", q32, k_c.astype(jnp.float32)) * scale
+        if causal:
+            kpos = origin * s_loc + jnp.arange(s_loc)
+            masked = kpos[None, :] > qpos[:, None]
+            s = jnp.where(masked, NEG_INF, s)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        # exp(NEG_INF - NEG_INF) == 1 would resurrect masked rows; zero the
+        # contributions by value instead of relying on the exponent.
+        p = jnp.exp(s - m_new)
+        p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+        corr = jnp.exp(m - m_new)
+        corr = jnp.where(m <= NEG_INF / 2, 0.0, corr)
+        l_new = corr * l + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * corr + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_c.astype(jnp.float32))
+        k_next = lax.ppermute(k_c, axis_name, _ring_perm(n))
+        v_next = lax.ppermute(v_c, axis_name, _ring_perm(n))
+        return (k_next, v_next, m_new, l_new, acc_new), None
+
+    if remat_steps:
+        step = jax.checkpoint(step)
+
+    # the accumulators become sp-varying after one step (they mix in the
+    # rotating K/V), so the scan carry must start sp-varying too
+    def _vary(x):
+        return lax.pcast(x, axis_name, to="varying")
+
+    m0 = _vary(jnp.full((b, h, s_loc, 1), NEG_INF, jnp.float32))
+    l0 = _vary(jnp.zeros((b, h, s_loc, 1), jnp.float32))
+    acc0 = _vary(jnp.zeros((b, h, s_loc, d), jnp.float32))
+    (_, _, _, l, acc), _ = lax.scan(
+        step, (k, v, m0, l0, acc0), jnp.arange(n))
+    out = acc / jnp.where(l == 0.0, 1.0, l)
+    return out.astype(q.dtype)
+
+
+def ulysses_attention(
+    q, k, v,
+    axis_name: str = SP_AXIS,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    use_pallas: Optional[bool] = None,
+):
+    """All-to-all ("Ulysses") sequence parallelism.
+
+    Input shards (batch, heads, s_local, head_dim) sequence-sharded on
+    ``axis_name``; internally re-sharded to (batch, heads/sp, seq_global,
+    head_dim) so each device runs *dense* local attention (the flash kernel)
+    over the full sequence for its head slice, then re-sharded back.
+    Requires ``heads % sp_size == 0``.
+    """
+    n = lax.axis_size(axis_name)
+    b, h, s_loc, d = q.shape
+    if h % n != 0:
+        raise ValueError(f"ulysses needs heads ({h}) % sp ({n}) == 0")
+    if n == 1:
+        return flash_attention(q, k, v, causal=causal, scale=scale,
+                               use_pallas=use_pallas)
+
+    def to_heads(x):
+        # [b, h, s_loc, d] -> [b, h/n, n*s_loc, d]: split heads across the
+        # axis, concatenate the sequence shards.
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    def to_seq(x):
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    o = flash_attention(to_heads(q), to_heads(k), to_heads(v),
+                        causal=causal, scale=scale, use_pallas=use_pallas)
+    return to_seq(o)

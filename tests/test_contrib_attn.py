@@ -1,0 +1,168 @@
+"""Contrib MHA + FMHA tests — ref apex/contrib/test/multihead_attn/* (fused
+vs torch.nn.MultiheadAttention-style reference) and test/fmha/test_fmha.py
+(packed varlen vs per-sequence dense attention)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.contrib.fmha import cu_seqlens_to_segment_ids, fmha_packed
+from apex_tpu.contrib.multihead_attn import (
+    EncdecMultiheadAttn,
+    SelfMultiheadAttn,
+)
+from apex_tpu.ops.attention import attention_reference
+
+B, S, E, H = 2, 16, 32, 4
+
+
+def _mha_reference(x, params, num_heads, kpm=None, am=None, additive=False):
+    """Dense reference with the same parameterization."""
+    e = x.shape[-1]
+    qkv = x @ params["in_proj_weight"]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+
+    def split(t):
+        b, s, _ = t.shape
+        return t.reshape(b, s, num_heads, e // num_heads).transpose(0, 2, 1, 3)
+
+    q, k, v = split(q), split(k), split(v)
+    s_ = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(e // num_heads)
+    if additive and am is not None:
+        s_ = s_ + am
+    elif am is not None:
+        s_ = jnp.where(am[None, None], -1e30, s_)
+    if kpm is not None:
+        s_ = jnp.where(kpm[:, None, None, :], -1e30, s_)
+    p = jax.nn.softmax(s_, axis=-1)
+    ctx = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    b, h, sq, d = ctx.shape
+    out = ctx.transpose(0, 2, 1, 3).reshape(b, sq, h * d)
+    return out @ params["out_proj_weight"]
+
+
+def test_self_mha_matches_dense_reference():
+    m = SelfMultiheadAttn(embed_dim=E, num_heads=H)
+    x = jax.random.normal(jax.random.PRNGKey(0), (B, S, E))
+    params = m.init(jax.random.PRNGKey(1), x)["params"]
+    got = m.apply({"params": params}, x, is_training=False)
+    want = _mha_reference(x, params, H)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+def test_self_mha_key_padding_and_attn_mask():
+    m = SelfMultiheadAttn(embed_dim=E, num_heads=H)
+    x = jax.random.normal(jax.random.PRNGKey(2), (B, S, E))
+    params = m.init(jax.random.PRNGKey(3), x)["params"]
+    kpm = jnp.arange(S)[None, :] >= jnp.asarray([[12], [9]])  # pads per batch
+    am = jnp.triu(jnp.ones((S, S), bool), k=1)  # causal
+    got = m.apply({"params": params}, x, key_padding_mask=kpm, attn_mask=am,
+                  is_training=False)
+    want = _mha_reference(x, params, H, kpm=kpm, am=am)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+def test_self_mha_additive_mask():
+    m = SelfMultiheadAttn(embed_dim=E, num_heads=H, mask_additive=True)
+    x = jax.random.normal(jax.random.PRNGKey(4), (B, S, E))
+    params = m.init(jax.random.PRNGKey(5), x)["params"]
+    am = jax.random.normal(jax.random.PRNGKey(6), (S, S)) * 0.5
+    got = m.apply({"params": params}, x, attn_mask=am, is_training=False)
+    want = _mha_reference(x, params, H, am=am, additive=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+def test_self_mha_norm_add_residual():
+    m = SelfMultiheadAttn(embed_dim=E, num_heads=H, include_norm_add=True)
+    x = jax.random.normal(jax.random.PRNGKey(7), (B, S, E))
+    params = m.init(jax.random.PRNGKey(8), x)["params"]
+    got = m.apply({"params": params}, x, is_training=False)
+    # residual path: output must differ from x but correlate (x + attn(ln(x)))
+    from apex_tpu.ops.layer_norm import layer_norm_reference
+
+    ln = layer_norm_reference(x, params["ln_weight"], params["ln_bias"])
+    want = x + _mha_reference(ln, params, H)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+def test_encdec_mha_matches_dense():
+    m = EncdecMultiheadAttn(embed_dim=E, num_heads=H)
+    q = jax.random.normal(jax.random.PRNGKey(9), (B, 8, E))
+    kv = jax.random.normal(jax.random.PRNGKey(10), (B, S, E))
+    params = m.init(jax.random.PRNGKey(11), q, kv)["params"]
+    got = m.apply({"params": params}, q, kv, is_training=False)
+
+    qq = q @ params["q_weight"]
+    k, v = jnp.split(kv @ params["kv_weight"], 2, axis=-1)
+
+    def split(t):
+        b, s, _ = t.shape
+        return t.reshape(b, s, H, E // H).transpose(0, 2, 1, 3)
+
+    o = attention_reference(split(qq), split(k), split(v))
+    b, h, sq, d = o.shape
+    want = o.transpose(0, 2, 1, 3).reshape(b, sq, h * d) @ params[
+        "out_proj_weight"]
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+def test_mha_dropout_only_when_training():
+    m = SelfMultiheadAttn(embed_dim=E, num_heads=H, dropout=0.5)
+    x = jax.random.normal(jax.random.PRNGKey(12), (B, S, E))
+    params = m.init({"params": jax.random.PRNGKey(13),
+                     "dropout": jax.random.PRNGKey(14)}, x)["params"]
+    eval_out = m.apply({"params": params}, x, is_training=False)
+    train_out = m.apply({"params": params}, x, is_training=True,
+                        rngs={"dropout": jax.random.PRNGKey(15)})
+    assert not np.allclose(np.asarray(eval_out), np.asarray(train_out))
+    # eval path is deterministic
+    np.testing.assert_array_equal(
+        np.asarray(eval_out),
+        np.asarray(m.apply({"params": params}, x, is_training=False)))
+
+
+# ---------------------------------------------------------------------------
+# FMHA packed varlen
+
+
+def test_cu_seqlens_to_segment_ids():
+    cu = jnp.asarray([0, 3, 5, 9])
+    seg = cu_seqlens_to_segment_ids(cu, 11)
+    np.testing.assert_array_equal(
+        np.asarray(seg), [0, 0, 0, 1, 1, 2, 2, 2, 2, -1, -1])
+
+
+def test_fmha_packed_matches_per_sequence_dense():
+    h, d = 2, 8
+    lens = [5, 3, 8]
+    cu = jnp.asarray(np.cumsum([0] + lens), jnp.int32)
+    total = sum(lens)
+    qkv = jax.random.normal(jax.random.PRNGKey(16), (total, 3, h, d))
+    out = fmha_packed(qkv, cu)
+    # compare each sequence against dense attention on its own slice
+    start = 0
+    for L in lens:
+        sl = slice(start, start + L)
+        q = qkv[sl, 0].transpose(1, 0, 2)[None]
+        k = qkv[sl, 1].transpose(1, 0, 2)[None]
+        v = qkv[sl, 2].transpose(1, 0, 2)[None]
+        want = attention_reference(q, k, v)[0].transpose(1, 0, 2)
+        np.testing.assert_allclose(
+            np.asarray(out[sl]), np.asarray(want), atol=2e-5,
+            err_msg=f"seq at {start}:{start+L}")
+        start += L
+
+
+def test_fmha_packed_causal_and_padding():
+    h, d = 1, 4
+    cu = jnp.asarray([0, 4, 6], jnp.int32)
+    qkv = jax.random.normal(jax.random.PRNGKey(17), (8, 3, h, d))  # 2 pad toks
+    out = fmha_packed(qkv, cu, causal=True)
+    # token 0 attends only to itself -> output == v[0]
+    np.testing.assert_allclose(
+        np.asarray(out[0]), np.asarray(qkv[0, 2]), atol=2e-5)
+    # grads never cross sequence boundaries
+    g = jax.grad(lambda qkv: jnp.sum(fmha_packed(qkv, cu)[0:4]))(qkv)
+    assert np.abs(np.asarray(g[4:6])).max() == 0.0
