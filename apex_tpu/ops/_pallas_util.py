@@ -1,0 +1,23 @@
+"""Shared Pallas plumbing for the kernel layer."""
+
+from __future__ import annotations
+
+import jax
+
+
+def sds(shape, dtype, *like):
+    """ShapeDtypeStruct whose varying-mesh-axes set is the union of the
+    inputs' — pallas_call outputs inside shard_map (check_vma=True) must
+    declare how they vary across mesh axes."""
+    vma = set()
+    tracked = False
+    for x in like:
+        try:
+            vma |= set(jax.typeof(x).vma)
+            tracked = True
+        except (AttributeError, TypeError):
+            pass
+    if tracked:
+        # under shard_map the vma set must be explicit even when empty
+        return jax.ShapeDtypeStruct(shape, dtype, vma=frozenset(vma))
+    return jax.ShapeDtypeStruct(shape, dtype)

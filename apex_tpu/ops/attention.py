@@ -40,6 +40,9 @@ except Exception:  # pragma: no cover
 NEG_INF = -1e30
 
 
+from apex_tpu.ops._pallas_util import sds as _sds  # noqa: E402
+
+
 # ---------------------------------------------------------------------------
 # Pure-JAX reference (ground truth for kernel tests; also the fallback path
 # for arbitrary masks / unaligned shapes — XLA fuses it into a few loops).
@@ -146,8 +149,8 @@ def _fa_fwd(q3, k3, v3, scale, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, sq, d), q3.dtype),
-            jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32),
+            _sds((bh, sq, d), q3.dtype, q3, k3, v3),
+            _sds((bh, sq, 1), jnp.float32, q3, k3, v3),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 128), jnp.float32),
@@ -273,7 +276,7 @@ def _fa_bwd(q3, k3, v3, o3, lse, do3, scale, causal, block_q, block_k,
             pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q3.dtype),
+        out_shape=_sds((bh, sq, d), q3.dtype, q3, k3, v3, do3),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
     )(q3, k3, v3, do3, lse, delta)
@@ -297,8 +300,8 @@ def _fa_bwd(q3, k3, v3, o3, lse, do3, scale, causal, block_q, block_k,
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, sk, d), k3.dtype),
-            jax.ShapeDtypeStruct((bh, sk, d), v3.dtype),
+            _sds((bh, sk, d), k3.dtype, q3, k3, v3, do3),
+            _sds((bh, sk, d), v3.dtype, q3, k3, v3, do3),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),

@@ -27,6 +27,8 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.ops._pallas_util import sds as _sds
+
 try:  # Pallas is part of jax, but keep import-failure graceful (CPU-only envs)
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -185,9 +187,9 @@ def _ln_fwd(x2d, w, b, eps):
             pl.BlockSpec((block, 1), lambda i: (i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((rows, hidden), x2d.dtype),
-            jax.ShapeDtypeStruct((rows, 1), jnp.float32),
-            jax.ShapeDtypeStruct((rows, 1), jnp.float32),
+            _sds((rows, hidden), x2d.dtype, x2d, w, b),
+            _sds((rows, 1), jnp.float32, x2d, w, b),
+            _sds((rows, 1), jnp.float32, x2d, w, b),
         ],
         interpret=interpret,
     )(x2d, w.reshape(1, -1), b.reshape(1, -1))
@@ -220,9 +222,9 @@ def _layer_norm_affine_bwd(eps, res, dy):
             pl.BlockSpec((1, hidden), lambda i: (0, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((rows, hidden), x2d.dtype),
-            jax.ShapeDtypeStruct((1, hidden), jnp.float32),
-            jax.ShapeDtypeStruct((1, hidden), jnp.float32),
+            _sds((rows, hidden), x2d.dtype, x2d, w, dy),
+            _sds((1, hidden), jnp.float32, x2d, w, dy),
+            _sds((1, hidden), jnp.float32, x2d, w, dy),
         ],
         interpret=_interpret_default(),
     )(dy, x2d, mean, rstd, w.reshape(1, -1))
@@ -254,8 +256,8 @@ def _rms_fwd(x2d, w, eps):
             pl.BlockSpec((block, 1), lambda i: (i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((rows, hidden), x2d.dtype),
-            jax.ShapeDtypeStruct((rows, 1), jnp.float32),
+            _sds((rows, hidden), x2d.dtype, x2d, w),
+            _sds((rows, 1), jnp.float32, x2d, w),
         ],
         interpret=_interpret_default(),
     )(x2d, w.reshape(1, -1))
@@ -286,8 +288,8 @@ def _rms_norm_affine_bwd(eps, res, dy):
             pl.BlockSpec((1, hidden), lambda i: (0, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((rows, hidden), x2d.dtype),
-            jax.ShapeDtypeStruct((1, hidden), jnp.float32),
+            _sds((rows, hidden), x2d.dtype, x2d, w, dy),
+            _sds((1, hidden), jnp.float32, x2d, w, dy),
         ],
         interpret=_interpret_default(),
     )(dy, x2d, rstd, w.reshape(1, -1))

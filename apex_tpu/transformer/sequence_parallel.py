@@ -97,10 +97,18 @@ def ring_attention(
     if remat_steps:
         step = jax.checkpoint(step)
 
-    # the accumulators become sp-varying after one step (they mix in the
-    # rotating K/V), so the scan carry must start sp-varying too
+    # the accumulators become varying after one step over every axis q/k/v
+    # vary over (plus the ring axis itself), so the scan carry must start
+    # with the same varying-axis set
+    try:
+        want_vma = (set(jax.typeof(q).vma) | set(jax.typeof(k).vma)
+                    | {axis_name})
+    except (AttributeError, TypeError):
+        want_vma = set()
+
     def _vary(x):
-        return lax.pcast(x, axis_name, to="varying")
+        missing = tuple(a for a in want_vma if a not in jax.typeof(x).vma)
+        return lax.pcast(x, missing, to="varying") if missing else x
 
     m0 = _vary(jnp.full((b, h, s_loc, 1), NEG_INF, jnp.float32))
     l0 = _vary(jnp.zeros((b, h, s_loc, 1), jnp.float32))

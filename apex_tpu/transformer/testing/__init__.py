@@ -1,0 +1,24 @@
+"""Self-contained Megatron-style test models (ref ``apex/transformer/testing``).
+
+``standalone_gpt`` / ``standalone_bert`` are the fixtures the reference's L0
+transformer suite trains through TP+PP (``standalone_gpt.py:1440``,
+``standalone_bert.py``); here they double as the flagship models for the
+benchmark harness.
+"""
+
+from apex_tpu.transformer.testing.standalone_gpt import (  # noqa: F401
+    GPTConfig,
+    gpt_forward,
+    gpt_loss,
+    gpt_param_specs,
+    gpt_pipeline_params,
+    gpt_pipeline_spec,
+    gpt_pipeline_specs_tree,
+    init_gpt_params,
+)
+from apex_tpu.transformer.testing.standalone_bert import (  # noqa: F401
+    BertConfig,
+    bert_forward,
+    bert_mlm_loss,
+    init_bert_params,
+)

@@ -1,0 +1,103 @@
+"""Standalone Megatron-style BERT (ref ``apex/transformer/testing/standalone_bert.py``).
+
+Bidirectional encoder over the same TP layer stack as the GPT fixture
+(``standalone_gpt._layer_stack`` with ``causal=False`` and a padding mask),
+token/position/type embeddings, and a tied MLM head with the Megatron
+dense→gelu→LN transform. Used by the pipeline/TP tests the way the
+reference's ``run_bert_minimal_test.py`` uses its BERT.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops.layer_norm import layer_norm
+from apex_tpu.transformer.tensor_parallel.cross_entropy import (
+    vocab_parallel_cross_entropy,
+)
+from apex_tpu.transformer.tensor_parallel.layers import (
+    vocab_parallel_embedding,
+)
+from apex_tpu.transformer.testing.standalone_gpt import (
+    GPTConfig,
+    _init_layer,
+    _layer_stack,
+)
+
+Pytree = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig(GPTConfig):
+    num_token_types: int = 2
+
+
+def init_bert_params(rng, cfg: BertConfig) -> Pytree:
+    cfg.validate()
+    ke, kl, kh = jax.random.split(rng, 3)
+    layer_rngs = jax.random.split(kl, cfg.num_layers)
+    layers = jax.tree.map(
+        lambda *xs: jnp.stack(xs), *[_init_layer(k, cfg) for k in layer_rngs])
+    dt = cfg.dtype
+    h = cfg.hidden
+    return {
+        "embed": {
+            "tok": (jax.random.normal(ke, (cfg.vocab_size, h)) * 0.02
+                    ).astype(dt),
+            "pos": (jax.random.normal(jax.random.fold_in(ke, 1),
+                                      (cfg.max_seq, h)) * 0.02).astype(dt),
+            "type": (jax.random.normal(jax.random.fold_in(ke, 2),
+                                       (cfg.num_token_types, h)) * 0.02
+                     ).astype(dt),
+            "ln_w": jnp.ones((h,), dt), "ln_b": jnp.zeros((h,), dt),
+        },
+        "layers": layers,
+        "head": {  # Megatron MLM head: dense+gelu+LN, decoder tied to embed
+            "dense_kernel": (jax.random.normal(kh, (h, h)) * 0.02).astype(dt),
+            "dense_bias": jnp.zeros((h,), dt),
+            "ln_w": jnp.ones((h,), dt), "ln_b": jnp.zeros((h,), dt),
+        },
+    }
+
+
+def bert_forward(params, tokens, cfg: BertConfig, token_types=None,
+                 padding_mask=None):
+    """tokens (b, s) -> vocab-sharded MLM logits (b, s, vocab/tp).
+
+    ``padding_mask``: (b, s) True = pad (masked out of attention both ways).
+    Call inside a mesh program.
+    """
+    e = params["embed"]
+    x = vocab_parallel_embedding(tokens, e["tok"])
+    x = x + e["pos"][None, : tokens.shape[1]].astype(x.dtype)
+    if token_types is not None:
+        x = x + jnp.take(e["type"], token_types, axis=0).astype(x.dtype)
+    x = layer_norm(x, e["ln_w"], e["ln_b"])
+    attn_mask = None
+    if padding_mask is not None:
+        attn_mask = padding_mask[:, None, None, :]
+    x = _layer_stack(params["layers"], x, cfg, causal=False, mask=attn_mask)
+    h = params["head"]
+    x = x @ h["dense_kernel"] + h["dense_bias"]
+    x = jax.nn.gelu(x, approximate=True)
+    x = layer_norm(x, h["ln_w"], h["ln_b"])
+    from apex_tpu.transformer.tensor_parallel.mappings import (
+        copy_to_tensor_model_parallel_region,
+    )
+
+    x = copy_to_tensor_model_parallel_region(x)
+    return jnp.einsum("bsh,vh->bsv", x, e["tok"])
+
+
+def bert_mlm_loss(params, tokens, targets, loss_mask, cfg: BertConfig,
+                  token_types=None, padding_mask=None):
+    """Masked-LM loss: vocab-parallel CE on masked positions only (ref
+    standalone_bert loss path). ``loss_mask`` (b, s) 1 = predict here."""
+    logits = bert_forward(params, tokens, cfg, token_types, padding_mask)
+    per_tok = vocab_parallel_cross_entropy(logits, targets)
+    m = loss_mask.astype(jnp.float32)
+    return jnp.sum(per_tok * m) / jnp.maximum(jnp.sum(m), 1.0)

@@ -1,0 +1,329 @@
+"""Standalone Megatron-style GPT — the TP+PP-parallel test/flagship model.
+
+Reference: ``apex/transformer/testing/standalone_gpt.py`` — ``GPTModel``
+(:1440) over ``ParallelTransformer(Layer)`` (:713,577), ``ParallelAttention``
+(:285), ``ParallelMLP`` (:236), vocab-parallel embedding + tied LM head +
+``vocab_parallel_cross_entropy`` loss.
+
+TPU re-design: pure functions over an explicit parameter pytree. Parameters
+are created at their **global** shapes and laid onto the mesh by
+:func:`gpt_param_specs` (GSPMD-style PartitionSpecs); inside ``shard_map``
+each function sees its local shard and uses the explicit TP collectives
+(``tensor_parallel.layers``) — column-parallel QKV/FC1, row-parallel
+out-proj/FC2, vocab-parallel embedding and loss, flash-attention core.
+The layer stack is a ``lax.scan`` over stacked layer params (one compiled
+layer body regardless of depth), rematerialized per layer — the analogue of
+the reference's activation checkpointing (``tensor_parallel/random.py:224``).
+
+Layout contract (local shapes inside shard_map, ``tp`` = TP world size):
+
+==============================  ==========================
+``embed.tok``                   (vocab/tp, hidden)
+``embed.pos``                   (max_seq, hidden)
+``layers.*`` (leading [L])      see ``_init_layer``
+``layers.qkv_kernel``           (hidden, 3·hidden/tp)
+``layers.out_kernel``           (hidden/tp, hidden)
+``layers.fc1_kernel``           (hidden, ffn/tp)
+``layers.fc2_kernel``           (ffn/tp, hidden)
+``head.ln_w/ln_b``              (hidden,)
+``head.lm`` (untied head)       (hidden, vocab/tp)
+==============================  ==========================
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.ops.attention import flash_attention
+from apex_tpu.ops.layer_norm import layer_norm
+from apex_tpu.parallel.mesh import SP_AXIS, TP_AXIS
+from apex_tpu.transformer.pipeline_parallel.schedules import PipelineSpec
+from apex_tpu.transformer.tensor_parallel.cross_entropy import (
+    vocab_parallel_cross_entropy,
+)
+from apex_tpu.transformer.tensor_parallel.layers import (
+    column_parallel_linear,
+    row_parallel_linear,
+    vocab_parallel_embedding,
+)
+
+Pytree = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    """Ref ``testing/arguments.py`` essentials, as one dataclass (SURVEY §5
+    config unification)."""
+
+    vocab_size: int = 50304
+    max_seq: int = 1024
+    hidden: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    ffn_mult: int = 4
+    dtype: Any = jnp.bfloat16
+    tie_embeddings: bool = True
+    remat: bool = True
+
+    @property
+    def ffn_hidden(self) -> int:
+        return self.ffn_mult * self.hidden
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden // self.num_heads
+
+    def validate(self, tp: int = 1) -> None:
+        if self.hidden % self.num_heads:
+            raise ValueError("hidden must be divisible by num_heads")
+        for name, dim in (("vocab_size", self.vocab_size),
+                          ("num_heads", self.num_heads),
+                          ("ffn_hidden", self.ffn_hidden)):
+            if dim % tp:
+                raise ValueError(f"{name} ({dim}) not divisible by tp ({tp})")
+
+
+# ---------------------------------------------------------------------------
+# init (global shapes)
+
+def _init_layer(rng, cfg: GPTConfig) -> Pytree:
+    h, f = cfg.hidden, cfg.ffn_hidden
+    ks = jax.random.split(rng, 4)
+    # Megatron init: normal(0.02) for input projections, output projections
+    # scaled by 1/sqrt(2L) (ref standalone_gpt scaled_init_method)
+    out_std = 0.02 / math.sqrt(2.0 * cfg.num_layers)
+    dt = cfg.dtype
+    return {
+        "ln1_w": jnp.ones((h,), dt), "ln1_b": jnp.zeros((h,), dt),
+        "qkv_kernel": (jax.random.normal(ks[0], (h, 3 * h)) * 0.02).astype(dt),
+        "qkv_bias": jnp.zeros((3 * h,), dt),
+        "out_kernel": (jax.random.normal(ks[1], (h, h)) * out_std).astype(dt),
+        "out_bias": jnp.zeros((h,), dt),
+        "ln2_w": jnp.ones((h,), dt), "ln2_b": jnp.zeros((h,), dt),
+        "fc1_kernel": (jax.random.normal(ks[2], (h, f)) * 0.02).astype(dt),
+        "fc1_bias": jnp.zeros((f,), dt),
+        "fc2_kernel": (jax.random.normal(ks[3], (f, h)) * out_std).astype(dt),
+        "fc2_bias": jnp.zeros((h,), dt),
+    }
+
+
+def init_gpt_params(rng, cfg: GPTConfig) -> Pytree:
+    """Global-shape parameter pytree: ``{"embed", "layers" ([L, ...]), "head"}``."""
+    cfg.validate()
+    ke, kl, kh = jax.random.split(rng, 3)
+    layer_rngs = jax.random.split(kl, cfg.num_layers)
+    layers = jax.tree.map(
+        lambda *xs: jnp.stack(xs), *[_init_layer(k, cfg) for k in layer_rngs])
+    dt = cfg.dtype
+    params = {
+        "embed": {
+            "tok": (jax.random.normal(ke, (cfg.vocab_size, cfg.hidden))
+                    * 0.02).astype(dt),
+            "pos": (jax.random.normal(jax.random.fold_in(ke, 1),
+                                      (cfg.max_seq, cfg.hidden))
+                    * 0.02).astype(dt),
+        },
+        "layers": layers,
+        "head": {
+            "ln_w": jnp.ones((cfg.hidden,), dt),
+            "ln_b": jnp.zeros((cfg.hidden,), dt),
+        },
+    }
+    if not cfg.tie_embeddings:
+        params["head"]["lm"] = (
+            jax.random.normal(kh, (cfg.hidden, cfg.vocab_size)) * 0.02
+        ).astype(dt)
+    return params
+
+
+def gpt_param_specs(cfg: GPTConfig, extra_layer_lead=()) -> Pytree:
+    """PartitionSpecs matching :func:`init_gpt_params`: TP sharding on the
+    Megatron dims, everything else replicated. ``extra_layer_lead`` prepends
+    axes for stacked layer params (e.g. ``("pp",)`` for pipeline stages)."""
+    lead = tuple(extra_layer_lead) + (None,)  # [(pp,)] + [L]
+    layer = {
+        "ln1_w": P(*lead), "ln1_b": P(*lead),
+        "qkv_kernel": P(*lead, None, TP_AXIS),
+        "qkv_bias": P(*lead, TP_AXIS),
+        "out_kernel": P(*lead, TP_AXIS, None),
+        "out_bias": P(*lead),
+        "ln2_w": P(*lead), "ln2_b": P(*lead),
+        "fc1_kernel": P(*lead, None, TP_AXIS),
+        "fc1_bias": P(*lead, TP_AXIS),
+        "fc2_kernel": P(*lead, TP_AXIS, None),
+        "fc2_bias": P(*lead),
+    }
+    specs = {
+        "embed": {"tok": P(TP_AXIS, None), "pos": P()},
+        "layers": layer,
+        "head": {"ln_w": P(), "ln_b": P()},
+    }
+    if not cfg.tie_embeddings:
+        specs["head"]["lm"] = P(None, TP_AXIS)
+    return specs
+
+
+# ---------------------------------------------------------------------------
+# forward (local shards, inside shard_map)
+
+def _attention(p, x, cfg, heads_local: int, causal: bool = True, mask=None):
+    """Ref ParallelAttention (:285): column-parallel fused QKV, flash core,
+    row-parallel out-proj."""
+    b, s, h = x.shape
+    qkv = column_parallel_linear(x, p["qkv_kernel"], p["qkv_bias"],
+                                 gather_output=False)
+    qkv = qkv.reshape(b, s, 3, heads_local, cfg.head_dim)
+    q, k, v = (qkv[:, :, i].transpose(0, 2, 1, 3) for i in range(3))
+    try:
+        sp = lax.axis_size(SP_AXIS)
+    except NameError:
+        sp = 1
+    if sp > 1:
+        # sequence sharded over sp: exact attention via the K/V ring
+        if mask is not None:
+            raise NotImplementedError(
+                "explicit attention masks are not supported with sp > 1; "
+                "use causal or full attention")
+        from apex_tpu.transformer.sequence_parallel import ring_attention
+
+        ctx = ring_attention(q, k, v, causal=causal)
+    else:
+        ctx = flash_attention(q, k, v, causal=causal, mask=mask)
+    ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, heads_local * cfg.head_dim)
+    return row_parallel_linear(ctx, p["out_kernel"], p["out_bias"],
+                               input_is_parallel=True)
+
+
+def _mlp(p, x):
+    """Ref ParallelMLP (:236): column-parallel FC1 + gelu, row-parallel FC2."""
+    y = column_parallel_linear(x, p["fc1_kernel"], p["fc1_bias"],
+                               gather_output=False)
+    y = jax.nn.gelu(y, approximate=True)
+    return row_parallel_linear(y, p["fc2_kernel"], p["fc2_bias"],
+                               input_is_parallel=True)
+
+
+def _layer(p, x, cfg, heads_local: int, causal: bool = True, mask=None):
+    """Pre-LN transformer layer (ref ParallelTransformerLayer :577)."""
+    x = x + _attention(p, layer_norm(x, p["ln1_w"], p["ln1_b"]), cfg,
+                       heads_local, causal, mask)
+    return x + _mlp(p, layer_norm(x, p["ln2_w"], p["ln2_b"]))
+
+
+def _layer_stack(layers, x, cfg, causal: bool = True, mask=None):
+    """scan the stacked layer params over the hidden state."""
+    tp = lax.axis_size(TP_AXIS)
+    heads_local = cfg.num_heads // tp
+
+    def one(lp, h):
+        return _layer(lp, h, cfg, heads_local, causal, mask)
+
+    if cfg.remat:
+        one = jax.checkpoint(one)
+
+    def body(h, lp):
+        return one(lp, h), None
+
+    out, _ = lax.scan(body, x, layers)
+    return out
+
+
+def embed_tokens(embed, tokens):
+    """Token + position embedding (ref GPT Embedding module). ``tokens`` may
+    be the sp-local sequence shard; positions are offset by the sp rank."""
+    h = vocab_parallel_embedding(tokens, embed["tok"])
+    s_loc = tokens.shape[1]
+    try:
+        sp = lax.axis_size(SP_AXIS)
+    except NameError:
+        sp = 1
+    if sp > 1:
+        start = lax.axis_index(SP_AXIS) * s_loc
+        pos = lax.dynamic_slice_in_dim(embed["pos"], start, s_loc, 0)
+    else:
+        pos = embed["pos"][:s_loc]
+    return h + pos[None].astype(h.dtype)
+
+
+def gpt_forward(params, tokens, cfg: GPTConfig):
+    """tokens (b, s) -> vocab-sharded logits (b, s, vocab/tp). Call inside a
+    mesh program (tp axis bound; tp=1 is the degenerate single-chip case)."""
+    x = embed_tokens(params["embed"], tokens)
+    x = _layer_stack(params["layers"], x, cfg)
+    return gpt_head(params, x, cfg)
+
+
+def gpt_head(params, x, cfg: GPTConfig):
+    """Final LN + LM head -> vocab-sharded logits. Tied: logits_i = h @ tok_iᵀ
+    (each rank's vocab shard — the reference's parallel_output=True path)."""
+    head = params["head"]
+    x = layer_norm(x, head["ln_w"], head["ln_b"])
+    if cfg.tie_embeddings:
+        from apex_tpu.transformer.tensor_parallel.mappings import (
+            copy_to_tensor_model_parallel_region,
+        )
+
+        x = copy_to_tensor_model_parallel_region(x)
+        return jnp.einsum("bsh,vh->bsv", x, params["embed"]["tok"])
+    return column_parallel_linear(x, head["lm"], gather_output=False)
+
+
+def gpt_loss(params, tokens, targets, cfg: GPTConfig):
+    """Mean vocab-parallel cross-entropy (ref vocab_parallel_cross_entropy)."""
+    logits = gpt_forward(params, tokens, cfg)
+    # logits stay in model dtype; CE upcasts internally (fused by XLA)
+    return jnp.mean(vocab_parallel_cross_entropy(logits, targets))
+
+
+# ---------------------------------------------------------------------------
+# pipeline wiring (PipelineSpec contract, schedules/common.py)
+
+def gpt_pipeline_params(rng, cfg: GPTConfig, pp: int) -> Pytree:
+    """Re-group :func:`init_gpt_params` into the pipeline driver's
+    ``{"embed", "stages" [pp, L/pp, ...], "head"}`` layout. The LM head is
+    untied across stages (ref: the embedding-group grad allreduce; see
+    schedules/common.py docstring for why tying is a non-issue here only when
+    embed and head share a param — across stages they cannot)."""
+    if cfg.num_layers % pp:
+        raise ValueError("num_layers must be divisible by pp")
+    cfg_untied = dataclasses.replace(cfg, tie_embeddings=False)
+    flat = init_gpt_params(rng, cfg_untied)
+    stages = jax.tree.map(
+        lambda x: x.reshape((pp, cfg.num_layers // pp) + x.shape[1:]),
+        flat["layers"])
+    return {"embed": flat["embed"], "stages": stages, "head": flat["head"]}
+
+
+def gpt_pipeline_specs_tree(cfg: GPTConfig) -> Pytree:
+    """PartitionSpecs for :func:`gpt_pipeline_params`."""
+    from apex_tpu.parallel.mesh import PP_AXIS
+
+    base = gpt_param_specs(
+        dataclasses.replace(cfg, tie_embeddings=False),
+        extra_layer_lead=(PP_AXIS,))
+    return {"embed": base["embed"], "stages": base["layers"],
+            "head": base["head"]}
+
+
+def gpt_pipeline_spec(cfg: GPTConfig) -> PipelineSpec:
+    """The three pipeline functions (PipelineSpec contract)."""
+
+    def embed_fn(embed, tokens):
+        return embed_tokens(embed, tokens)
+
+    def stage_fn(stage_layers, h):
+        return _layer_stack(stage_layers, h, cfg)
+
+    def loss_fn(head, h, targets):
+        logits = gpt_head({"head": head}, h, cfg=dataclasses.replace(
+            cfg, tie_embeddings=False))
+        return jnp.mean(vocab_parallel_cross_entropy(logits, targets))
+
+    return PipelineSpec(embed_fn=embed_fn, stage_fn=stage_fn, loss_fn=loss_fn)

@@ -1,0 +1,102 @@
+"""Benchmark harness — prints ONE JSON line with the headline metric.
+
+Headline: flagship GPT-2 124M-class bf16 **training step** (fwd + bwd +
+FusedAdam) tokens/s on one chip. ``vs_baseline`` is measured MFU divided by
+the driver-assigned 0.70 MFU target (BASELINE.json: the reference publishes
+no numbers — see BASELINE.md — so the target ratio is the honest comparator).
+
+Run: ``python bench.py`` (uses the real TPU chip when available; falls back
+to CPU with the same protocol, flagged in the metric name).
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+# v5e peak dense bf16 per chip
+PEAK_FLOPS = {"tpu": 197e12, "cpu": 1e12}
+
+BATCH, SEQ = 32, 1024
+STEPS = 20
+
+
+def main() -> None:
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.parallel.mesh import build_mesh
+    from apex_tpu.transformer.pipeline_parallel.schedules.common import (
+        replicate_loss,
+    )
+    from apex_tpu.transformer.testing import (
+        GPTConfig,
+        gpt_loss,
+        gpt_param_specs,
+        init_gpt_params,
+    )
+
+    backend = jax.default_backend()
+    on_tpu = backend == "tpu"
+    batch, seq, steps = (BATCH, SEQ, STEPS) if on_tpu else (2, 128, 3)
+
+    cfg = GPTConfig(vocab_size=50304, max_seq=seq, hidden=768, num_layers=12,
+                    num_heads=12, dtype=jnp.bfloat16, remat=True)
+    params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+    mesh = build_mesh(tp=1, pp=1, sp=1, devices=jax.devices()[:1])
+    specs = gpt_param_specs(cfg)
+    opt = FusedAdam(lr=1e-4)
+    opt_state = opt.init(params)
+
+    def loss_fn(p, tok, tgt):
+        def body(p, tok, tgt):
+            return replicate_loss(gpt_loss(p, tok, tgt, cfg), mesh,
+                                  masked_axis=None)
+
+        return jax.shard_map(body, mesh=mesh, in_specs=(specs, P(), P()),
+                             out_specs=P())(p, tok, tgt)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def train_step(params, opt_state, tok, tgt):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tok, tgt)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = jax.tree.map(lambda p, u: p + u, params, updates)
+        return params, opt_state, loss
+
+    key = jax.random.PRNGKey(1)
+    tok = jax.random.randint(key, (batch, seq), 0, cfg.vocab_size)
+    tgt = jnp.roll(tok, -1, axis=1)
+
+    # warmup (compile)
+    params, opt_state, loss = train_step(params, opt_state, tok, tgt)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, loss = train_step(params, opt_state, tok, tgt)
+    jax.block_until_ready(loss)
+    dt = (time.perf_counter() - t0) / steps
+
+    tokens_per_s = batch * seq / dt
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    # standard MFU accounting: 6N per token (fwd+bwd) + causal attention
+    # 6*L*hidden*seq per token; remat recompute is NOT credited
+    flops_per_token = 6 * n_params + 6 * cfg.num_layers * cfg.hidden * seq
+    mfu = tokens_per_s * flops_per_token / PEAK_FLOPS.get(backend, 1e12)
+
+    name = "gpt2_124m_bf16_train_tokens_per_sec_chip"
+    if not on_tpu:
+        name += "_CPU_FALLBACK"
+    print(json.dumps({
+        "metric": name,
+        "value": round(tokens_per_s, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.70, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

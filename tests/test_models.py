@@ -1,0 +1,195 @@
+"""GPT/BERT fixture-model tests — ref tests/L0/run_transformer/
+run_gpt_minimal_test.py and run_bert_minimal_test.py: the model must run
+under TP (+PP), match its single-device computation exactly, and train."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from apex_tpu.parallel.mesh import build_mesh
+from apex_tpu.transformer.pipeline_parallel.schedules import (
+    forward_backward_pipelining_without_interleaving,
+)
+from apex_tpu.transformer.testing import (
+    BertConfig,
+    GPTConfig,
+    bert_mlm_loss,
+    gpt_loss,
+    gpt_param_specs,
+    gpt_pipeline_params,
+    gpt_pipeline_spec,
+    gpt_pipeline_specs_tree,
+    init_gpt_params,
+)
+from apex_tpu.transformer.testing.standalone_bert import init_bert_params
+
+CFG = GPTConfig(vocab_size=64, max_seq=16, hidden=32, num_layers=2,
+                num_heads=4, dtype=jnp.float32, remat=False)
+B, S = 8, 16
+
+
+def _batch(key, cfg=CFG, b=B, s=S):
+    tokens = jax.random.randint(key, (b, s), 0, cfg.vocab_size)
+    targets = jax.random.randint(jax.random.fold_in(key, 1), (b, s), 0,
+                                 cfg.vocab_size)
+    return tokens, targets
+
+
+def _loss_on_mesh(mesh, params, tokens, targets, cfg=CFG):
+    def body(p, tok, tgt):
+        from apex_tpu.transformer.pipeline_parallel.schedules.common import (
+            replicate_loss,
+        )
+
+        return replicate_loss(gpt_loss(p, tok, tgt, cfg), mesh,
+                              masked_axis=None)
+
+    return jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(gpt_param_specs(cfg), P(DP := "dp"), P(DP)),
+        out_specs=P(),
+    )(params, tokens, targets)
+
+
+def test_gpt_tp_matches_single_device():
+    params = init_gpt_params(jax.random.PRNGKey(0), CFG)
+    tokens, targets = _batch(jax.random.PRNGKey(1))
+    mesh_tp = build_mesh(tp=4, dp=2)
+    mesh_1 = build_mesh(tp=1, dp=8)
+    l_tp = _loss_on_mesh(mesh_tp, params, tokens, targets)
+    l_1 = _loss_on_mesh(mesh_1, params, tokens, targets)
+    # tp=4 splits the GEMM/CE reductions -> different summation order
+    np.testing.assert_allclose(float(l_tp), float(l_1), rtol=1e-3)
+
+
+def test_gpt_trains_tp_dp():
+    cfg = CFG
+    params = init_gpt_params(jax.random.PRNGKey(2), cfg)
+    mesh = build_mesh(tp=2, dp=4)
+    tokens, targets = _batch(jax.random.PRNGKey(3))
+    # target = shifted tokens would be realistic; fixed random targets are
+    # memorizable by a 2-layer net — loss must drop
+    specs = gpt_param_specs(cfg)
+
+    def body(p, tok, tgt):
+        from apex_tpu.transformer.pipeline_parallel.schedules.common import (
+            replicate_loss,
+        )
+
+        loss = replicate_loss(gpt_loss(p, tok, tgt, cfg), mesh,
+                              masked_axis=None)
+        return loss
+
+    def step(p, tok, tgt):
+        loss, g = jax.value_and_grad(
+            lambda p: jax.shard_map(
+                body, mesh=mesh, in_specs=(specs, P("dp"), P("dp")),
+                out_specs=P())(p, tok, tgt))(p)
+        p = jax.tree.map(lambda w, gw: w - 0.1 * gw.astype(w.dtype), p, g)
+        return p, loss
+
+    step = jax.jit(step)
+    first = None
+    for _ in range(20):
+        params, loss = step(params, tokens, targets)
+        if first is None:
+            first = float(loss)
+    assert float(loss) < first * 0.9, (first, float(loss))
+
+
+def test_gpt_pipeline_1f1b_matches_tp_only():
+    cfg = dataclasses.replace(CFG, tie_embeddings=False)
+    pp = 2
+    params = gpt_pipeline_params(jax.random.PRNGKey(4), cfg, pp=pp)
+    tokens, targets = _batch(jax.random.PRNGKey(5))
+    mesh = build_mesh(tp=2, pp=pp, dp=2)
+    spec = gpt_pipeline_spec(cfg)
+    loss, grads = forward_backward_pipelining_without_interleaving(
+        spec, params, (tokens, targets), num_microbatches=2, mesh=mesh,
+        params_specs=gpt_pipeline_specs_tree(cfg),
+        data_spec=P(None, "dp"), remat=False,
+    )
+    # sequential single-mesh computation of the same stacked params
+    flat_layers = jax.tree.map(
+        lambda x: x.reshape((-1,) + x.shape[2:]), params["stages"])
+    flat = {"embed": params["embed"], "layers": flat_layers,
+            "head": params["head"]}
+    mesh1 = build_mesh(tp=1, dp=8)
+    want = _loss_on_mesh(mesh1, flat, tokens, targets, cfg)
+    np.testing.assert_allclose(float(loss), float(want), rtol=1e-4)
+    # grads exist and are finite everywhere
+    for leaf in jax.tree.leaves(grads):
+        assert np.all(np.isfinite(np.asarray(leaf)))
+
+
+def test_bert_runs_and_trains():
+    cfg = BertConfig(vocab_size=64, max_seq=16, hidden=32, num_layers=2,
+                     num_heads=4, dtype=jnp.float32, remat=False)
+    params = init_bert_params(jax.random.PRNGKey(6), cfg)
+    mesh = build_mesh(tp=2, dp=4)
+    tokens = jax.random.randint(jax.random.PRNGKey(7), (B, S), 0,
+                                cfg.vocab_size)
+    targets = jax.random.randint(jax.random.PRNGKey(8), (B, S), 0,
+                                 cfg.vocab_size)
+    loss_mask = (jax.random.uniform(jax.random.PRNGKey(9), (B, S)) < 0.3
+                 ).astype(jnp.float32)
+    pad = jnp.broadcast_to(jnp.arange(S)[None, :] >= 14, (B, S))  # pad tail
+
+    def body(p, tok, tgt, lm, pm):
+        from apex_tpu.transformer.pipeline_parallel.schedules.common import (
+            replicate_loss,
+        )
+
+        return replicate_loss(
+            bert_mlm_loss(p, tok, tgt, lm, cfg, padding_mask=pm), mesh,
+            masked_axis=None)
+
+    specs = gpt_param_specs(cfg)
+    specs["embed"]["type"] = P()
+    specs["embed"]["ln_w"] = P()
+    specs["embed"]["ln_b"] = P()
+    specs["head"] = jax.tree.map(lambda _: P(), {
+        "dense_kernel": 0, "dense_bias": 0, "ln_w": 0, "ln_b": 0})
+
+    def loss_fn(p):
+        return jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(specs, P("dp"), P("dp"), P("dp"), P("dp")),
+            out_specs=P())(p, tokens, targets, loss_mask, pad)
+
+    step = jax.jit(lambda p: (jax.value_and_grad(loss_fn)(p)))
+    first = None
+    for _ in range(20):
+        loss, g = step(params)
+        params = jax.tree.map(lambda w, gw: w - 0.1 * gw.astype(w.dtype),
+                              params, g)
+        if first is None:
+            first = float(loss)
+    assert float(loss) < first * 0.9, (first, float(loss))
+
+
+def test_gpt_sequence_parallel_matches():
+    params = init_gpt_params(jax.random.PRNGKey(0), CFG)
+    tokens, targets = _batch(jax.random.PRNGKey(1))
+    mesh_sp = build_mesh(tp=2, sp=2, dp=2)
+
+    def body(p, tok, tgt):
+        from apex_tpu.transformer.pipeline_parallel.schedules.common import (
+            replicate_loss,
+        )
+
+        return replicate_loss(gpt_loss(p, tok, tgt, CFG), mesh_sp,
+                              masked_axis=None)
+
+    l_sp = jax.shard_map(
+        body, mesh=mesh_sp,
+        in_specs=(gpt_param_specs(CFG), P("dp", "sp"), P("dp", "sp")),
+        out_specs=P(),
+    )(params, tokens, targets)
+    l_1 = _loss_on_mesh(build_mesh(tp=1, dp=8), params, tokens, targets)
+    np.testing.assert_allclose(float(l_sp), float(l_1), rtol=1e-3)
