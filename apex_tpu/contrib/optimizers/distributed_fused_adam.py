@@ -1,0 +1,129 @@
+"""ZeRO-style Adam with dp-sharded optimizer state.
+
+Reference: ``apex/contrib/optimizers/distributed_fused_adam.py:9`` —
+``DistributedFusedAdam``: shards Adam moments and fp32 master weights across
+the data-parallel group and pipelines bucketed reduce-scatter (grads) /
+all-gather (params) overlapped with backward, with optional global-norm
+clipping and AMP grad scaling. ~1000 LoC of stream bookkeeping + CUDA
+multi-tensor kernels.
+
+TPU re-design: the same dataflow expressed per-leaf with three collectives
+(see ``_sharding.py``), run inside the mesh program. State (fp32 master
+shard + moment shards) is 1/dp per device — ZeRO stage 1+2 memory. The
+whole step is one pure function; XLA overlaps the collectives with compute.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.contrib.optimizers._sharding import (
+    gather_leaf,
+    scatter_leaf,
+    slice_leaf,
+)
+from apex_tpu.parallel.mesh import DP_AXIS
+
+Pytree = Any
+
+
+class DistAdamState(NamedTuple):
+    count: jnp.ndarray
+    master: Pytree  # fp32 param shards, (k,) per leaf
+    mu: Pytree  # fp32 moment shards
+    nu: Pytree
+
+
+@dataclasses.dataclass(frozen=True)
+class DistributedFusedAdam:
+    """Ref constructor surface (distributed_fused_adam.py:16-46), minus the
+    CUDA plumbing knobs (stream counts, bucket sizes — XLA's job now).
+
+    Usage (inside ``shard_map`` over the full mesh)::
+
+        opt = DistributedFusedAdam(lr=1e-3, max_grad_norm=1.0)
+        state = opt.init(params)              # sharded fp32 master+moments
+        params, state = opt.step(grads, state, params)
+    """
+
+    lr: float = 1e-3
+    betas: Tuple[float, float] = (0.9, 0.999)
+    eps: float = 1e-8
+    weight_decay: float = 0.0
+    adam_w_mode: bool = True
+    max_grad_norm: Optional[float] = None  # ref clip_grad_norm
+    axis_name: str = DP_AXIS
+
+    def init(self, params: Pytree) -> DistAdamState:
+        """Shard fp32 masters + zero moments (call inside the mesh program;
+        ``params`` replicated across ``axis_name``)."""
+        master = jax.tree.map(
+            lambda p: slice_leaf(p.astype(jnp.float32), self.axis_name),
+            params)
+        zeros = jax.tree.map(lambda m: jnp.zeros_like(m), master)
+        return DistAdamState(
+            count=jnp.zeros((), jnp.int32), master=master, mu=zeros,
+            nu=jax.tree.map(jnp.zeros_like, master))
+
+    def _global_norm(self, shards) -> jnp.ndarray:
+        sq = sum(jnp.sum(jnp.square(g)) for g in jax.tree.leaves(shards))
+        return jnp.sqrt(lax.psum(sq, self.axis_name))
+
+    def step(
+        self,
+        grads: Pytree,
+        state: DistAdamState,
+        params: Pytree,
+        scale: Optional[jnp.ndarray] = None,
+    ) -> Tuple[Pytree, DistAdamState]:
+        """reduce-scatter → (unscale, clip) → Adam on shards → all-gather.
+
+        ``grads``: per-device gradients (NOT yet dp-reduced — the
+        reduce-scatter does the sum, ref "overlap_reductions" dataflow).
+        ``scale``: optional AMP loss scale to divide out
+        (ref step_supports_amp_scaling).
+        """
+        b1, b2 = self.betas
+        g_shards = jax.tree.map(
+            lambda g: scatter_leaf(g.astype(jnp.float32), self.axis_name),
+            grads)
+        world = lax.axis_size(self.axis_name)
+        # reduce-scatter sums over dp; grads are averaged like DDP does
+        g_shards = jax.tree.map(lambda g: g / world, g_shards)
+        if scale is not None:
+            g_shards = jax.tree.map(lambda g: g / scale, g_shards)
+        if self.max_grad_norm is not None:
+            gnorm = self._global_norm(g_shards)
+            clip = jnp.minimum(1.0, self.max_grad_norm / (gnorm + 1e-6))
+            g_shards = jax.tree.map(lambda g: g * clip, g_shards)
+
+        count = state.count + 1
+        t = count.astype(jnp.float32)
+        c1 = 1.0 - jnp.power(b1, t)
+        c2 = 1.0 - jnp.power(b2, t)
+
+        def upd(g, m, v, p32):
+            if not self.adam_w_mode and self.weight_decay:
+                g = g + self.weight_decay * p32
+            m_new = b1 * m + (1.0 - b1) * g
+            v_new = b2 * v + (1.0 - b2) * g * g
+            u = (m_new / c1) / (jnp.sqrt(v_new / c2) + self.eps)
+            if self.adam_w_mode and self.weight_decay:
+                u = u + self.weight_decay * p32
+            return p32 - self.lr * u, m_new, v_new
+
+        out = jax.tree.map(upd, g_shards, state.mu, state.nu, state.master)
+        is3 = lambda x: isinstance(x, tuple)
+        master = jax.tree.map(lambda o: o[0], out, is_leaf=is3)
+        mu = jax.tree.map(lambda o: o[1], out, is_leaf=is3)
+        nu = jax.tree.map(lambda o: o[2], out, is_leaf=is3)
+
+        new_params = jax.tree.map(
+            lambda m, p: gather_leaf(m, p.shape, p.dtype, self.axis_name),
+            master, params)
+        return new_params, DistAdamState(count, master, mu, nu)

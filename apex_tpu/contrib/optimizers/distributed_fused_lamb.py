@@ -1,0 +1,118 @@
+"""ZeRO-style LAMB with dp-sharded state and global-norm clipping.
+
+Reference: ``apex/contrib/optimizers/distributed_fused_lamb.py:10`` —
+``DistributedFusedLAMB`` (the MLPerf BERT optimizer): same reduce-scatter /
+all-gather dataflow as DistributedFusedAdam plus the LAMB trust ratio, which
+needs **per-parameter** weight and update norms; the reference computes them
+with ``fused_norm`` kernels over the shards and a global reduction.
+
+TPU re-design: per-leaf shard math as in DistributedFusedAdam; the
+per-parameter norms are a local squared-sum over the shard followed by a
+``psum`` over dp — exactly the reference's sharded-norm + all-reduce, in two
+lines. Update math mirrors ``apex_tpu.optimizers.FusedLAMB`` (which matches
+``multi_tensor_lamb.cu``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.contrib.optimizers._sharding import (
+    gather_leaf,
+    scatter_leaf,
+    slice_leaf,
+)
+from apex_tpu.parallel.mesh import DP_AXIS
+
+Pytree = Any
+
+
+class DistLambState(NamedTuple):
+    count: jnp.ndarray
+    master: Pytree
+    mu: Pytree
+    nu: Pytree
+
+
+@dataclasses.dataclass(frozen=True)
+class DistributedFusedLAMB:
+    """Ref constructor surface (distributed_fused_lamb.py:37-80 essentials)."""
+
+    lr: float = 1e-3
+    betas: Tuple[float, float] = (0.9, 0.999)
+    eps: float = 1e-6
+    weight_decay: float = 0.01
+    bias_correction: bool = True
+    grad_averaging: bool = True
+    max_grad_norm: Optional[float] = 1.0
+    use_nvlamb: bool = False  # apply trust ratio even with wd == 0
+    axis_name: str = DP_AXIS
+
+    def init(self, params: Pytree) -> DistLambState:
+        master = jax.tree.map(
+            lambda p: slice_leaf(p.astype(jnp.float32), self.axis_name),
+            params)
+        return DistLambState(
+            count=jnp.zeros((), jnp.int32), master=master,
+            mu=jax.tree.map(jnp.zeros_like, master),
+            nu=jax.tree.map(jnp.zeros_like, master))
+
+    def step(
+        self,
+        grads: Pytree,
+        state: DistLambState,
+        params: Pytree,
+        scale: Optional[jnp.ndarray] = None,
+    ) -> Tuple[Pytree, DistLambState]:
+        b1, b2 = self.betas
+        g_shards = jax.tree.map(
+            lambda g: scatter_leaf(g.astype(jnp.float32), self.axis_name),
+            grads)
+        world = lax.axis_size(self.axis_name)
+        if self.grad_averaging:
+            g_shards = jax.tree.map(lambda g: g / world, g_shards)
+        if scale is not None:
+            g_shards = jax.tree.map(lambda g: g / scale, g_shards)
+        if self.max_grad_norm is not None:
+            # global grad norm over ALL shards (ref fused clip path)
+            sq = sum(jnp.sum(jnp.square(g)) for g in jax.tree.leaves(g_shards))
+            gnorm = jnp.sqrt(lax.psum(sq, self.axis_name))
+            clip = jnp.minimum(1.0, self.max_grad_norm / (gnorm + 1e-6))
+            g_shards = jax.tree.map(lambda g: g * clip, g_shards)
+
+        count = state.count + 1
+        t = count.astype(jnp.float32)
+        c1 = 1.0 - jnp.power(b1, t) if self.bias_correction else 1.0
+        c2 = 1.0 - jnp.power(b2, t) if self.bias_correction else 1.0
+
+        def upd(g, m, v, p32):
+            m_new = b1 * m + (1.0 - b1) * g
+            v_new = b2 * v + (1.0 - b2) * g * g
+            u = (m_new / c1) / (jnp.sqrt(v_new / c2) + self.eps)
+            if self.weight_decay:
+                u = u + self.weight_decay * p32
+            # per-PARAMETER norms: local shard sq-sum + psum (ref two-stage
+            # multi_tensor_l2norm + allreduce)
+            w_norm = jnp.sqrt(lax.psum(jnp.sum(p32 * p32), self.axis_name))
+            u_norm = jnp.sqrt(lax.psum(jnp.sum(u * u), self.axis_name))
+            apply_trust = (w_norm > 0) & (u_norm > 0)
+            if not self.use_nvlamb and not self.weight_decay:
+                trust = 1.0
+            else:
+                trust = jnp.where(apply_trust, w_norm / u_norm, 1.0)
+            return p32 - self.lr * trust * u, m_new, v_new
+
+        out = jax.tree.map(upd, g_shards, state.mu, state.nu, state.master)
+        is3 = lambda x: isinstance(x, tuple)
+        master = jax.tree.map(lambda o: o[0], out, is_leaf=is3)
+        mu = jax.tree.map(lambda o: o[1], out, is_leaf=is3)
+        nu = jax.tree.map(lambda o: o[2], out, is_leaf=is3)
+        new_params = jax.tree.map(
+            lambda m, p: gather_leaf(m, p.shape, p.dtype, self.axis_name),
+            master, params)
+        return new_params, DistLambState(count, master, mu, nu)

@@ -1,0 +1,6 @@
+"""ASP — automatic 2:4 structured sparsity (ref ``apex/contrib/sparsity``)."""
+
+from apex_tpu.contrib.sparsity.asp import ASP  # noqa: F401
+from apex_tpu.contrib.sparsity.sparse_masklib import create_mask  # noqa: F401
+
+__all__ = ["ASP", "create_mask"]

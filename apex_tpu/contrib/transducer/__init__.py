@@ -1,0 +1,11 @@
+"""RNN-T transducer joint + loss (ref ``apex/contrib/transducer``)."""
+
+from apex_tpu.contrib.transducer.transducer import (  # noqa: F401
+    TransducerJoint,
+    TransducerLoss,
+    transducer_joint,
+    transducer_loss,
+)
+
+__all__ = ["TransducerJoint", "TransducerLoss", "transducer_joint",
+           "transducer_loss"]

@@ -1,0 +1,37 @@
+"""Legacy manual mixed-precision helpers (ref ``apex/fp16_utils``).
+
+The reference predates ``apex.amp``: module-tree casting helpers
+(``fp16util.py:35-175``), master-param bookkeeping, and the ``FP16_Optimizer``
+wrapper (``fp16_optimizer.py:13``) with static/dynamic loss scaling
+(``loss_scaler.py:7,82``). The modern path is ``apex_tpu.amp``; this package
+keeps the legacy API shape for capability parity, implemented over the same
+pure-pytree machinery.
+"""
+
+from apex_tpu.fp16_utils.fp16util import (  # noqa: F401
+    clip_grad_norm,
+    convert_network,
+    master_params_to_model_params,
+    model_grads_to_master_grads,
+    network_to_half,
+    prep_param_lists,
+    to_python_float,
+)
+from apex_tpu.fp16_utils.fp16_optimizer import FP16_Optimizer  # noqa: F401
+from apex_tpu.fp16_utils.loss_scaler import (  # noqa: F401
+    DynamicLossScaler,
+    LossScaler,
+)
+
+__all__ = [
+    "network_to_half",
+    "convert_network",
+    "prep_param_lists",
+    "model_grads_to_master_grads",
+    "master_params_to_model_params",
+    "clip_grad_norm",
+    "to_python_float",
+    "FP16_Optimizer",
+    "LossScaler",
+    "DynamicLossScaler",
+]

@@ -1,0 +1,108 @@
+"""Legacy ``FP16_Optimizer`` wrapper (ref ``apex/fp16_utils/fp16_optimizer.py:13``).
+
+Wraps any optax-style transform with fp32 master weights + a (static or
+dynamic) loss scaler: scale loss, backward in half, unscale into fp32 master
+grads, skip the step on overflow, copy masters back to model dtype — the
+flow ``apex.amp`` O2 later absorbed. Functional: all state in
+:class:`FP16OptimizerState`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp.scaler import LossScalerState
+from apex_tpu.fp16_utils.fp16util import (
+    clip_grad_norm,
+    master_params_to_model_params,
+)
+from apex_tpu.fp16_utils.loss_scaler import DynamicLossScaler, LossScaler
+
+Pytree = Any
+
+
+class FP16OptimizerState(NamedTuple):
+    master_params: Pytree  # fp32
+    inner_state: Any
+    scaler: LossScalerState
+
+
+class FP16_Optimizer:
+    """Ref constructor ``FP16_Optimizer(init_optimizer, static_loss_scale=1.0,
+    dynamic_loss_scale=False, ...)``. ``optimizer`` is an optax-style
+    transform (init/update)."""
+
+    def __init__(self, optimizer, static_loss_scale: float = 1.0,
+                 dynamic_loss_scale: bool = False,
+                 dynamic_loss_args: Optional[dict] = None):
+        self.optimizer = optimizer
+        if dynamic_loss_scale:
+            self.loss_scaler = DynamicLossScaler(**(dynamic_loss_args or {}))
+        else:
+            self.loss_scaler = LossScaler(static_loss_scale)
+
+    def init(self, model_params: Pytree) -> FP16OptimizerState:
+        masters = jax.tree_util.tree_map(
+            lambda x: x.astype(jnp.float32)
+            if jnp.issubdtype(jnp.result_type(x), jnp.floating) else x,
+            model_params)
+        return FP16OptimizerState(
+            master_params=masters,
+            inner_state=self.optimizer.init(masters),
+            scaler=self.loss_scaler.init_state())
+
+    def scale_loss(self, loss, state: FP16OptimizerState):
+        """Ref ``backward`` entry: caller differentiates the scaled loss."""
+        return self.loss_scaler.scale_loss(loss, state.scaler)
+
+    def step(
+        self,
+        model_grads: Pytree,
+        state: FP16OptimizerState,
+        max_grad_norm: Optional[float] = None,
+    ) -> Tuple[Pytree, FP16OptimizerState, jnp.ndarray]:
+        """unscale → (clip) → inner step on masters → model-dtype params.
+
+        Returns ``(model_params, new_state, skipped)`` — ``skipped`` is the
+        traced overflow flag (ref "skip step on overflow",
+        fp16_optimizer.py:160-200).
+        """
+        grads32, found_inf = self.loss_scaler.unscale(
+            model_grads, state.scaler)
+        if max_grad_norm is not None:
+            grads32, _ = clip_grad_norm(grads32, max_grad_norm)
+        new_scaler, skipped = self.loss_scaler.update_scale(
+            state.scaler, found_inf)
+        updates, new_inner = self.optimizer.update(
+            grads32, state.inner_state, state.master_params)
+        new_masters = jax.tree_util.tree_map(
+            lambda p, u: p + u, state.master_params, updates)
+        # skip-step: keep old masters/inner state on overflow
+        new_masters, new_inner = jax.tree_util.tree_map(
+            lambda new, old: jnp.where(skipped, old, new),
+            (new_masters, new_inner), (state.master_params, state.inner_state))
+        new_state = FP16OptimizerState(new_masters, new_inner, new_scaler)
+        return new_masters, new_state, skipped
+
+    def model_params(self, state: FP16OptimizerState,
+                     model_like: Pytree) -> Pytree:
+        """fp32 masters viewed in model dtype (ref
+        ``_master_params_to_model_params``)."""
+        return master_params_to_model_params(state.master_params, model_like)
+
+    # -- checkpointing (ref state_dict :209-270) ---------------------------
+    def state_dict(self, state: FP16OptimizerState) -> dict:
+        return {
+            "loss_scaler": self.loss_scaler.state_dict(state.scaler),
+            "master_params": state.master_params,
+            "inner_state": state.inner_state,
+        }
+
+    def load_state_dict(self, d: dict) -> FP16OptimizerState:
+        return FP16OptimizerState(
+            master_params=d["master_params"],
+            inner_state=d["inner_state"],
+            scaler=self.loss_scaler.load_state_dict(d["loss_scaler"]))

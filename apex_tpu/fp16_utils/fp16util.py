@@ -1,0 +1,102 @@
+"""Pytree analogues of ``apex/fp16_utils/fp16util.py``.
+
+The reference walks ``nn.Module`` trees (``convert_module:44``,
+``BN_convert_float:22``) and keeps parallel ``model_params`` /
+``master_params`` lists (``prep_param_lists:90``). Here "model" = a param
+pytree; norm params are recognized by the same path heuristic the amp layer
+uses, and master/model are two pytrees related by a pure cast.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp.frontend import _path_str, default_norm_predicate
+
+Pytree = Any
+
+
+def convert_network(
+    params: Pytree,
+    dtype,
+    is_norm_param: Callable[[str], bool] = default_norm_predicate,
+) -> Pytree:
+    """Cast float params to ``dtype``, keeping norm params fp32
+    (ref ``convert_network:60-72`` — BN stays fp32)."""
+
+    def leaf(path, x):
+        if not jnp.issubdtype(jnp.result_type(x), jnp.floating):
+            return x
+        if is_norm_param(_path_str(path)):
+            return x.astype(jnp.float32)
+        return x.astype(dtype)
+
+    return jax.tree_util.tree_map_with_path(leaf, params)
+
+
+def network_to_half(params: Pytree, half_dtype=jnp.bfloat16) -> Pytree:
+    """Ref ``network_to_half:35`` (tofp16 + BN_convert_float). bf16 is the
+    TPU half type; pass ``jnp.float16`` for literal parity."""
+    return convert_network(params, half_dtype)
+
+
+def prep_param_lists(params: Pytree, flat_master: bool = False):
+    """-> (model_params, master_params): fp32 master copies of the (half)
+    model params (ref ``prep_param_lists:90-135``). ``flat_master`` flattens
+    the masters into one fp32 vector (ref flatten path); the structured form
+    is the TPU-native default."""
+    masters = jax.tree_util.tree_map(
+        lambda x: x.astype(jnp.float32)
+        if jnp.issubdtype(jnp.result_type(x), jnp.floating) else x,
+        params,
+    )
+    if flat_master:
+        leaves = [x.reshape(-1) for x in jax.tree_util.tree_leaves(masters)]
+        masters = jnp.concatenate(leaves) if leaves else jnp.zeros((0,))
+    return params, masters
+
+
+def model_grads_to_master_grads(model_grads: Pytree,
+                                flat_master: bool = False) -> Pytree:
+    """fp16 grads -> fp32 master grads (ref :136-156)."""
+    g32 = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32), model_grads)
+    if flat_master:
+        leaves = [x.reshape(-1) for x in jax.tree_util.tree_leaves(g32)]
+        return jnp.concatenate(leaves) if leaves else jnp.zeros((0,))
+    return g32
+
+
+def master_params_to_model_params(master_params: Pytree, model_like: Pytree,
+                                  ) -> Pytree:
+    """fp32 masters -> model-dtype params (ref :158-175); ``model_like``
+    supplies the target dtypes."""
+    return jax.tree_util.tree_map(
+        lambda m, p: m.astype(p.dtype), master_params, model_like)
+
+
+def clip_grad_norm(grads: Pytree, max_norm: float,
+                   norm_type: float = 2.0) -> Tuple[Pytree, jnp.ndarray]:
+    """Global-norm clip; returns ``(clipped_grads, total_norm)``
+    (ref ``clip_grad_norm:181-214`` — torch semantics: scale by
+    max_norm/(norm+1e-6) when over)."""
+    leaves = jax.tree_util.tree_leaves(grads)
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([jnp.max(jnp.abs(g)) for g in leaves]))
+    elif norm_type == 2.0:
+        total = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                             for g in leaves))
+    else:
+        total = sum(jnp.sum(jnp.abs(g.astype(jnp.float32)) ** norm_type)
+                    for g in leaves) ** (1.0 / norm_type)
+    coef = jnp.minimum(1.0, max_norm / (total + 1e-6))
+    return jax.tree_util.tree_map(
+        lambda g: (g.astype(jnp.float32) * coef).astype(g.dtype), grads
+    ), total
+
+
+def to_python_float(t) -> float:
+    """Ref :176-180."""
+    return float(t)

@@ -1,0 +1,46 @@
+"""Legacy loss scalers (ref ``apex/fp16_utils/loss_scaler.py:7,82``).
+
+``LossScaler`` = static scale; ``DynamicLossScaler`` = the pre-amp dynamic
+policy (×2 every ``scale_window`` clean steps, ÷2 on overflow after a
+cooldown). Thin adapters over the functional ``apex_tpu.amp.scaler`` so the
+legacy constructor surface works; state is still an explicit pytree.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from apex_tpu.amp.scaler import LossScaler as _ModernScaler
+from apex_tpu.amp.scaler import LossScalerState
+
+
+class LossScaler(_ModernScaler):
+    """Static scaler (ref :7-80): ``loss_scale`` fixed, ``update_scale`` no-op."""
+
+    def __init__(self, scale: float = 1.0):
+        super().__init__(loss_scale=float(scale))
+
+    # legacy attribute name
+    @property
+    def cur_scale(self) -> float:
+        return self._init_scale
+
+
+class DynamicLossScaler(_ModernScaler):
+    """Dynamic scaler (ref :82-180): ``init_scale``/``scale_factor``/
+    ``scale_window`` legacy knobs."""
+
+    def __init__(self, init_scale: float = 2.0 ** 32,
+                 scale_factor: float = 2.0, scale_window: int = 1000):
+        super().__init__("dynamic", init_scale=init_scale,
+                         scale_factor=scale_factor, scale_window=scale_window)
+
+    @staticmethod
+    def has_overflow(grads) -> jnp.ndarray:
+        """Ref ``has_overflow``/``_has_inf_or_nan`` (:97-118): traced bool."""
+        import jax
+
+        leaves = jax.tree_util.tree_leaves(grads)
+        if not leaves:
+            return jnp.asarray(False)
+        return ~jnp.stack([jnp.all(jnp.isfinite(g)) for g in leaves]).all()

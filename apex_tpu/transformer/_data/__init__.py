@@ -1,0 +1,8 @@
+"""Megatron pretraining batch samplers (ref ``apex/transformer/_data``)."""
+
+from apex_tpu.transformer._data._batchsampler import (  # noqa: F401
+    MegatronPretrainingRandomSampler,
+    MegatronPretrainingSampler,
+)
+
+__all__ = ["MegatronPretrainingSampler", "MegatronPretrainingRandomSampler"]

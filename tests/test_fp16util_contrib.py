@@ -1,0 +1,222 @@
+"""fp16_utils + ASP + transducer + batch sampler tests — ref
+tests/L0/run_fp16util, contrib/test/sparsity, contrib/test/transducer
+(vs transducer_ref.py), run_transformer/test_batch_sampler.py."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from apex_tpu.contrib.sparsity import ASP, create_mask
+from apex_tpu.contrib.transducer import (
+    TransducerJoint,
+    TransducerLoss,
+    transducer_joint,
+    transducer_loss,
+)
+from apex_tpu.fp16_utils import (
+    FP16_Optimizer,
+    clip_grad_norm,
+    convert_network,
+    master_params_to_model_params,
+    network_to_half,
+    prep_param_lists,
+)
+from apex_tpu.transformer._data import (
+    MegatronPretrainingRandomSampler,
+    MegatronPretrainingSampler,
+)
+
+# ---------------------------------------------------------------------------
+# fp16_utils (ref tests/L0/run_fp16util/test_fp16util.py)
+
+
+def _net():
+    return {
+        "dense": {"kernel": jnp.ones((4, 4)), "bias": jnp.zeros((4,))},
+        "LayerNorm_0": {"scale": jnp.ones((4,)), "bias": jnp.zeros((4,))},
+    }
+
+
+def test_network_to_half_keeps_norms_fp32():
+    half = network_to_half(_net())
+    assert half["dense"]["kernel"].dtype == jnp.bfloat16
+    assert half["LayerNorm_0"]["scale"].dtype == jnp.float32
+
+
+def test_convert_network_fp16():
+    half = convert_network(_net(), jnp.float16)
+    assert half["dense"]["kernel"].dtype == jnp.float16
+    assert half["LayerNorm_0"]["bias"].dtype == jnp.float32
+
+
+def test_prep_and_copy_param_lists():
+    model = network_to_half(_net())
+    model_params, masters = prep_param_lists(model)
+    assert masters["dense"]["kernel"].dtype == jnp.float32
+    masters = jax.tree.map(lambda m: m + 0.25 if m.dtype == jnp.float32 else m,
+                           masters)
+    back = master_params_to_model_params(masters, model_params)
+    assert back["dense"]["kernel"].dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(back["dense"]["kernel"],
+                                          np.float32), 1.25)
+
+
+def test_clip_grad_norm():
+    g = {"a": jnp.full((4,), 3.0), "b": jnp.full((4,), 4.0)}
+    clipped, total = clip_grad_norm(g, max_norm=5.0)
+    np.testing.assert_allclose(float(total), 10.0)
+    norm2 = float(jnp.sqrt(sum(jnp.sum(x * x)
+                               for x in jax.tree.leaves(clipped))))
+    np.testing.assert_allclose(norm2, 5.0, rtol=1e-5)
+
+
+def test_fp16_optimizer_skips_on_overflow():
+    opt = FP16_Optimizer(optax.sgd(0.1), static_loss_scale=128.0)
+    params = {"w": jnp.ones((4,), jnp.bfloat16)}
+    state = opt.init(params)
+    # finite grads: step applies
+    g = {"w": jnp.full((4,), 128.0, jnp.bfloat16)}  # scaled grad of 1.0
+    p2, state2, skipped = opt.step(g, state)
+    assert not bool(skipped)
+    np.testing.assert_allclose(np.asarray(p2["w"]), 0.9, rtol=1e-2)
+    # inf grads: step skipped, masters unchanged
+    g_bad = {"w": jnp.asarray([jnp.inf, 1, 1, 1], jnp.bfloat16)}
+    p3, state3, skipped = opt.step(g_bad, state2)
+    assert bool(skipped)
+    np.testing.assert_array_equal(np.asarray(p3["w"]),
+                                  np.asarray(state2.master_params["w"]))
+
+
+def test_fp16_optimizer_dynamic_scaler_backoff():
+    opt = FP16_Optimizer(optax.sgd(0.1), dynamic_loss_scale=True,
+                         dynamic_loss_args={"init_scale": 2.0 ** 8})
+    params = {"w": jnp.ones((2,))}
+    state = opt.init(params)
+    g_bad = {"w": jnp.asarray([jnp.nan, 1.0])}
+    _, state2, skipped = opt.step(g_bad, state)
+    assert bool(skipped)
+    assert float(state2.scaler.loss_scale) == 2.0 ** 7
+
+
+# ---------------------------------------------------------------------------
+# ASP (ref contrib/test/sparsity/test_sparsity.py)
+
+
+def test_create_mask_m4n2():
+    w = jnp.asarray([[0.1, -5.0, 2.0, 0.05, 3.0, -0.2, 0.1, 4.0]])
+    mask = create_mask(w)
+    np.testing.assert_array_equal(
+        np.asarray(mask[0]),
+        [False, True, True, False, True, False, False, True])
+
+
+def test_asp_masks_and_optimizer_wrap():
+    params = {"dense": {"kernel": jnp.asarray(
+        np.random.RandomState(0).randn(8, 8), jnp.float32)},
+        "bias": jnp.ones((3,))}
+    asp = ASP()
+    masks = asp.compute_sparse_masks(params)
+    assert masks["bias"] is None  # 1-D not whitelisted
+    sparse = ASP.apply_masks(params, masks)
+    # exactly 50% zeros in every 4-group
+    k = np.asarray(sparse["dense"]["kernel"]).reshape(-1, 4)
+    assert ((k != 0).sum(axis=1) == 2).all()
+
+    opt = asp.init_optimizer_for_pruning(optax.sgd(0.1), masks)
+    state = opt.init(sparse)
+    g = jax.tree.map(jnp.ones_like, sparse)
+    updates, _ = opt.update(g, state, sparse)
+    stepped = jax.tree.map(lambda p, u: p + u, sparse, updates)
+    k2 = np.asarray(stepped["dense"]["kernel"]).reshape(-1, 4)
+    assert ((k2 != 0).sum(axis=1) == 2).all()  # still 2:4 after the step
+
+
+# ---------------------------------------------------------------------------
+# transducer (ref contrib/test/transducer/transducer_ref.py)
+
+
+def _transducer_ref_nll(logp, label, T, U):
+    """O(T·U) numpy alpha recursion — independent reference implementation."""
+    alpha = np.full((T, U + 1), -np.inf)
+    alpha[0, 0] = 0.0
+    for t in range(T):
+        for u in range(U + 1):
+            cands = []
+            if t > 0:
+                cands.append(alpha[t - 1, u] + logp[t - 1, u, 0])
+            if u > 0:
+                cands.append(alpha[t, u - 1] + logp[t, u - 1, label[u - 1]])
+            if cands:
+                alpha[t, u] = np.logaddexp.reduce(cands)
+    return -(alpha[T - 1, U] + logp[T - 1, U, 0])
+
+
+def test_transducer_loss_matches_numpy_reference():
+    rng = np.random.RandomState(1)
+    B, T, U, V = 3, 5, 4, 7
+    x = rng.randn(B, T, U + 1, V).astype(np.float32)
+    logp = x - np.log(np.exp(x).sum(-1, keepdims=True))
+    label = rng.randint(1, V, (B, U))
+    f_len = np.asarray([5, 4, 3])
+    y_len = np.asarray([4, 2, 3])
+    got = transducer_loss(jnp.asarray(logp), jnp.asarray(label),
+                          jnp.asarray(f_len), jnp.asarray(y_len))
+    for b in range(B):
+        want = _transducer_ref_nll(logp[b], label[b], f_len[b], y_len[b])
+        np.testing.assert_allclose(float(got[b]), want, rtol=1e-5,
+                                   err_msg=f"batch {b}")
+
+
+def test_transducer_loss_gradients_flow():
+    B, T, U, V = 2, 4, 3, 5
+    x = jnp.asarray(np.random.RandomState(2).randn(B, T, U + 1, V),
+                    jnp.float32)
+    label = jnp.asarray(np.random.RandomState(3).randint(1, V, (B, U)))
+    loss_mod = TransducerLoss()
+    f_len = jnp.asarray([4, 4])
+    y_len = jnp.asarray([3, 3])
+    g = jax.grad(lambda x: jnp.sum(loss_mod(x, label, f_len, y_len)))(x)
+    assert np.all(np.isfinite(np.asarray(g)))
+    assert np.abs(np.asarray(g)).max() > 0
+
+
+def test_transducer_joint():
+    f = jnp.ones((2, 3, 4))
+    g = jnp.full((2, 5, 4), -2.0)
+    out = transducer_joint(f, g)
+    assert out.shape == (2, 3, 5, 4)
+    np.testing.assert_allclose(np.asarray(out), -1.0)
+    relu_out = TransducerJoint(relu=True)(f, g)
+    np.testing.assert_allclose(np.asarray(relu_out), 0.0)
+
+
+# ---------------------------------------------------------------------------
+# batch samplers (ref run_transformer/test_batch_sampler.py)
+
+
+def test_pretraining_sampler_shards_by_rank():
+    got = {r: list(MegatronPretrainingSampler(
+        total_samples=16, consumed_samples=0, local_minibatch_size=2,
+        data_parallel_rank=r, data_parallel_size=2))
+        for r in range(2)}
+    assert got[0][0] == [0, 1] and got[1][0] == [2, 3]
+    assert got[0][1] == [4, 5] and got[1][1] == [6, 7]
+    # resume from consumed_samples
+    resumed = list(MegatronPretrainingSampler(
+        total_samples=16, consumed_samples=8, local_minibatch_size=2,
+        data_parallel_rank=0, data_parallel_size=2))
+    assert resumed[0] == [8, 9]
+
+
+def test_random_sampler_is_deterministic_and_disjoint():
+    a0 = list(MegatronPretrainingRandomSampler(64, 0, 4, 0, 2))
+    a0b = list(MegatronPretrainingRandomSampler(64, 0, 4, 0, 2))
+    a1 = list(MegatronPretrainingRandomSampler(64, 0, 4, 1, 2))
+    assert a0 == a0b  # same epoch -> same permutation
+    flat0 = {i for b in a0 for i in b}
+    flat1 = {i for b in a1 for i in b}
+    assert not (flat0 & flat1)  # ranks read disjoint shards
+    assert all(len(b) == 4 for b in a0)
