@@ -1,0 +1,57 @@
+"""Fused ResNet bottleneck (ref ``apex/contrib/bottleneck``).
+
+Reference: ``Bottleneck`` (``bottleneck/bottleneck.py:112``) — a
+cudnn-frontend-fused conv-bn-relu block — and ``SpatialBottleneck`` (:386),
+which shards the spatial H dim across GPUs with NVLink halo exchanges.
+
+TPU re-design: the plain block is ``apex_tpu.models.resnet.BottleneckBlock``
+(XLA fuses BN+ReLU into the convs; NHWC native). The spatial variant is
+:func:`spatial_conv3x3`: H-sharded conv with a 1-row halo exchanged over
+``ppermute`` — the ICI-native equivalent of the reference's ``nccl_p2p``
+halo kernels.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.models.resnet import BottleneckBlock as Bottleneck  # noqa: F401
+from apex_tpu.parallel.mesh import SP_AXIS
+
+
+def _halo_exchange(x, axis_name: str):
+    """Send my top row to the previous rank and bottom row to the next
+    (ref ``bottleneck.py`` halo_exchange with nccl_p2p): returns
+    (row_from_prev, row_from_next), zeros at the boundary ranks."""
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    top = x[:, :1]
+    bot = x[:, -1:]
+    # bottom row of rank i-1 arrives at rank i (shift +1)
+    from_prev = lax.ppermute(bot, axis_name, [(i, (i + 1) % n) for i in range(n)])
+    from_next = lax.ppermute(top, axis_name, [(i, (i - 1) % n) for i in range(n)])
+    zero = jnp.zeros_like(top)
+    from_prev = jnp.where(idx == 0, zero, from_prev)
+    from_next = jnp.where(idx == n - 1, zero, from_next)
+    return from_prev, from_next
+
+
+def spatial_conv3x3(x, kernel, axis_name: str = SP_AXIS):
+    """3x3 'SAME' conv over an H-sharded NHWC tensor (ref SpatialBottleneck
+    middle conv): exchange 1-row halos, convolve VALID over the padded
+    shard, producing exactly the rows this rank owns.
+
+    ``x``: (B, H_local, W, Cin); ``kernel``: (3, 3, Cin, Cout).
+    """
+    from_prev, from_next = _halo_exchange(x, axis_name)
+    padded = jnp.concatenate([from_prev, x, from_next], axis=1)
+    out = lax.conv_general_dilated(
+        padded, kernel, window_strides=(1, 1),
+        padding=((0, 0), (1, 1)),  # H handled by halos, W by zero-pad
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    return out
+
+
+__all__ = ["Bottleneck", "spatial_conv3x3"]

@@ -1,0 +1,43 @@
+"""Group BatchNorm (ref ``apex/contrib/groupbn``).
+
+Reference: ``BatchNorm2d_NHWC`` (``groupbn/batch_norm.py:101``) + the ``bnp``
+ext (5.1k LoC): NHWC fused BN(+add)+ReLU whose statistics are exchanged
+across a ``bn_group`` of GPUs through CUDA-IPC peer memory.
+
+TPU re-design: NHWC is already the native layout, BN+ReLU(+add) fusion is
+XLA's job, and "BN group" is an ``axis_index_groups`` partition of the dp
+axis — the same SyncBatchNorm kernel handles it (SURVEY §2.3
+"grouped/partial-replica collectives").
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+from apex_tpu.parallel.mesh import DP_AXIS
+from apex_tpu.parallel.sync_batchnorm import (
+    SyncBatchNorm,
+    create_syncbn_process_group,
+)
+
+
+def BatchNorm2d_NHWC(num_features: int, fuse_relu: bool = False,
+                     bn_group: int = 1, world_size: Optional[int] = None,
+                     axis_name: str = DP_AXIS, **kw):
+    """Ref constructor (``batch_norm.py:101-130``): ``bn_group`` devices share
+    statistics. Returns a :class:`SyncBatchNorm` configured with the group
+    partition (``bn_group=1`` -> local BN, no collectives)."""
+    if bn_group <= 1:
+        return SyncBatchNorm(features=num_features, axis_name=None,
+                             fuse_relu=fuse_relu, **kw)
+    if world_size is None:
+        import jax
+
+        world_size = len(jax.devices())
+    groups = create_syncbn_process_group(bn_group, world_size)
+    return SyncBatchNorm(features=num_features, axis_name=axis_name,
+                         axis_index_groups=groups, fuse_relu=fuse_relu, **kw)
+
+
+__all__ = ["BatchNorm2d_NHWC", "create_syncbn_process_group"]

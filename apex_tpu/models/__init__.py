@@ -1,0 +1,11 @@
+"""Model zoo for the example trainers and benchmarks.
+
+The reference ships no model library (its examples pull torchvision
+ResNet-50 and a local DCGAN); here the equivalents live in-tree since there
+is no torchvision on TPU: NHWC ResNet (ref ``examples/imagenet``) and DCGAN
+generator/discriminator (ref ``examples/dcgan/main_amp.py``), plus the
+Megatron GPT/BERT fixtures under ``apex_tpu.transformer.testing``.
+"""
+
+from apex_tpu.models.resnet import ResNet, ResNet18, ResNet50  # noqa: F401
+from apex_tpu.models.dcgan import Discriminator, Generator  # noqa: F401

@@ -1,0 +1,71 @@
+"""DCGAN generator/discriminator (ref ``examples/dcgan/main_amp.py``).
+
+The reference example exercises amp with TWO models and THREE losses
+(``main_amp.py:214-253`` — errD_real, errD_fake, errG each with its own
+``loss_id``); these are the minimal NHWC equivalents of its netG/netD."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class Generator(nn.Module):
+    """z (B, 1, 1, nz) -> image (B, isize, isize, nc)."""
+
+    isize: int = 64
+    nz: int = 100
+    ngf: int = 64
+    nc: int = 3
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, z, train: bool = True):
+        x = z
+        # 1x1 -> 4x4
+        mult = self.isize // 8
+        x = nn.ConvTranspose(self.ngf * mult, (4, 4), (1, 1), padding="VALID",
+                             use_bias=False, dtype=self.dtype)(x)
+        x = nn.BatchNorm(use_running_average=not train)(x)
+        x = nn.relu(x)
+        size = 4
+        while size < self.isize // 2:
+            mult //= 2
+            x = nn.ConvTranspose(self.ngf * mult, (4, 4), (2, 2),
+                                 padding="SAME", use_bias=False,
+                                 dtype=self.dtype)(x)
+            x = nn.BatchNorm(use_running_average=not train)(x)
+            x = nn.relu(x)
+            size *= 2
+        x = nn.ConvTranspose(self.nc, (4, 4), (2, 2), padding="SAME",
+                             use_bias=False, dtype=self.dtype)(x)
+        return jnp.tanh(x)
+
+
+class Discriminator(nn.Module):
+    """image (B, isize, isize, nc) -> logit (B,)."""
+
+    isize: int = 64
+    ndf: int = 64
+    nc: int = 3
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.Conv(self.ndf, (4, 4), (2, 2), padding="SAME", use_bias=False,
+                    dtype=self.dtype)(x)
+        x = nn.leaky_relu(x, 0.2)
+        size = self.isize // 2
+        mult = 1
+        while size > 4:
+            mult *= 2
+            x = nn.Conv(self.ndf * mult, (4, 4), (2, 2), padding="SAME",
+                        use_bias=False, dtype=self.dtype)(x)
+            x = nn.BatchNorm(use_running_average=not train)(x)
+            x = nn.leaky_relu(x, 0.2)
+            size //= 2
+        x = nn.Conv(1, (4, 4), (1, 1), padding="VALID", use_bias=False,
+                    dtype=self.dtype)(x)
+        return x.reshape(x.shape[0])

@@ -1,0 +1,117 @@
+"""NHWC ResNet for the imagenet example + DDP/SyncBN benchmarks.
+
+Reference context: ``examples/imagenet/main_amp.py`` trains torchvision
+ResNet-50 under amp O0-O3 + apex DDP (+ optional ``--sync_bn``); the
+contrib ``bottleneck`` ext (``apex/contrib/csrc/bottleneck``) fuses the
+conv-bn-relu bottleneck with cudnn-frontend. On TPU: NHWC is the native
+layout, XLA fuses BN+ReLU into the convs on its own, and the bottleneck
+block below IS the fused block (``apex_tpu.contrib.bottleneck`` re-exports
+it). ``norm`` selects plain BatchNorm or the cross-device SyncBatchNorm.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from apex_tpu.parallel.sync_batchnorm import SyncBatchNorm
+
+
+def make_norm(sync_bn: bool = False, axis_name: str = "dp",
+              momentum: float = 0.1, eps: float = 1e-5):
+    """Norm-layer factory: SyncBatchNorm across ``axis_name`` or local BN
+    (ref ``--sync_bn`` flag, main_amp.py:150-160)."""
+    if sync_bn:
+        return functools.partial(SyncBatchNorm, momentum=momentum, eps=eps,
+                                 axis_name=axis_name)
+    return functools.partial(SyncBatchNorm, momentum=momentum, eps=eps,
+                             axis_name=None)
+
+
+class BottleneckBlock(nn.Module):
+    """1x1 -> 3x3 -> 1x1 with identity/projection shortcut (the block the
+    contrib ``fast_bottleneck`` ext fuses; ref ``bottleneck.py:112``)."""
+
+    features: int
+    strides: Tuple[int, int] = (1, 1)
+    norm: Callable = SyncBatchNorm
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, use_running_average: bool = False):
+        conv = functools.partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        bn = self.norm
+        residual = x
+        y = conv(self.features, (1, 1))(x)
+        y = bn()(y, use_running_average)
+        y = nn.relu(y)
+        y = conv(self.features, (3, 3), self.strides)(y)
+        y = bn()(y, use_running_average)
+        y = nn.relu(y)
+        y = conv(self.features * 4, (1, 1))(y)
+        y = bn()(y, use_running_average)
+        if residual.shape != y.shape:
+            residual = conv(self.features * 4, (1, 1), self.strides,
+                            name="proj_conv")(residual)
+            residual = bn(name="proj_bn")(residual, use_running_average)
+        return nn.relu(y + residual)
+
+
+class BasicBlock(nn.Module):
+    features: int
+    strides: Tuple[int, int] = (1, 1)
+    norm: Callable = SyncBatchNorm
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, use_running_average: bool = False):
+        conv = functools.partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        residual = x
+        y = conv(self.features, (3, 3), self.strides)(x)
+        y = self.norm()(y, use_running_average)
+        y = nn.relu(y)
+        y = conv(self.features, (3, 3))(y)
+        y = self.norm()(y, use_running_average)
+        if residual.shape != y.shape:
+            residual = conv(self.features, (1, 1), self.strides,
+                            name="proj_conv")(residual)
+            residual = self.norm(name="proj_bn")(residual,
+                                                 use_running_average)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    """NHWC ResNet (ref torchvision resnet50 as used by main_amp.py:88)."""
+
+    stage_sizes: Sequence[int]
+    block: Any = BottleneckBlock
+    num_classes: int = 1000
+    width: int = 64
+    norm: Callable = SyncBatchNorm
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, use_running_average: bool = False):
+        x = nn.Conv(self.width, (7, 7), (2, 2), use_bias=False,
+                    dtype=self.dtype, name="conv_init")(x)
+        x = self.norm(name="bn_init")(x, use_running_average)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), (2, 2), padding="SAME")
+        for i, n_blocks in enumerate(self.stage_sizes):
+            for j in range(n_blocks):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = self.block(self.width * 2 ** i, strides=strides,
+                               norm=self.norm, dtype=self.dtype)(
+                    x, use_running_average)
+        x = jnp.mean(x, axis=(1, 2))
+        x = x.astype(jnp.float32)
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+ResNet50 = functools.partial(ResNet, stage_sizes=(3, 4, 6, 3),
+                             block=BottleneckBlock)
+ResNet18 = functools.partial(ResNet, stage_sizes=(2, 2, 2, 2),
+                             block=BasicBlock)

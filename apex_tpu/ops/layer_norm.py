@@ -45,7 +45,10 @@ except Exception:  # pragma: no cover
 def layer_norm_reference(x, weight=None, bias=None, eps: float = 1e-5):
     x32 = x.astype(jnp.float32)
     mean = jnp.mean(x32, axis=-1, keepdims=True)
-    var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True) - jnp.square(mean)
+    # clamp: E[x²]−E[x]² cancellation can dip negative → nan through rsqrt
+    var = jnp.maximum(
+        jnp.mean(jnp.square(x32), axis=-1, keepdims=True) - jnp.square(mean),
+        0.0)
     y = (x32 - mean) * jax.lax.rsqrt(var + eps)
     if weight is not None:
         y = y * weight.astype(jnp.float32)
@@ -71,7 +74,7 @@ def _ln_fwd_kernel(x_ref, w_ref, b_ref, y_ref, mean_ref, rstd_ref, *, eps, hidde
     x = x_ref[:].astype(jnp.float32)
     mean = jnp.sum(x, axis=1, keepdims=True) / hidden
     msq = jnp.sum(x * x, axis=1, keepdims=True) / hidden
-    var = msq - mean * mean
+    var = jnp.maximum(msq - mean * mean, 0.0)  # cancellation guard
     rstd = jax.lax.rsqrt(var + eps)
     xhat = (x - mean) * rstd
     y = xhat * w_ref[:].astype(jnp.float32) + b_ref[:].astype(jnp.float32)

@@ -88,7 +88,10 @@ def sync_batch_stats(
             packed = jnp.sum(grp, axis=0)
     total_sum, total_sq, total_count = packed[0], packed[1], packed[2]
     mean = total_sum / total_count
-    var = total_sq / total_count - mean * mean
+    # E[x²]−E[x]² can go (slightly) negative by cancellation at small counts;
+    # rsqrt(negative + eps) would be nan — clamp (the reference's Welford
+    # formulation avoids this by construction, csrc/welford.cu)
+    var = jnp.maximum(total_sq / total_count - mean * mean, 0.0)
     return mean, var, total_count
 
 

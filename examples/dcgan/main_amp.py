@@ -1,0 +1,130 @@
+"""DCGAN with amp mixed precision — two models, three losses.
+
+Reference: ``examples/dcgan/main_amp.py`` — the amp multi-model/multi-loss
+exercise: netD trained on errD_real + errD_fake, netG on errG, each loss
+with its own scaler (``loss_id`` 0-2, main_amp.py:214-253).
+
+TPU version: same structure with three independent LossScaler states,
+synthetic data. Run: ``python examples/dcgan/main_amp.py --iters 10``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from apex_tpu.amp.scaler import LossScaler
+from apex_tpu.models import Discriminator, Generator
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--image-size", type=int, default=32)
+    p.add_argument("--nz", type=int, default=100)
+    p.add_argument("--lr", type=float, default=2e-4)
+    p.add_argument("--beta1", type=float, default=0.5)
+    p.add_argument("--iters", type=int, default=10)
+    p.add_argument("--opt-level", default="O1", choices=["O0", "O1", "O2"])
+    p.add_argument("--seed", type=int, default=0)
+    return p.parse_args(argv)
+
+
+def train(args):
+    half = args.opt_level != "O0"
+    dtype = jnp.bfloat16 if half else jnp.float32
+    netG = Generator(isize=args.image_size, nz=args.nz, dtype=dtype)
+    netD = Discriminator(isize=args.image_size, dtype=dtype)
+
+    rng = jax.random.PRNGKey(args.seed)
+    z0 = jnp.zeros((2, 1, 1, args.nz), dtype)
+    x0 = jnp.zeros((2, args.image_size, args.image_size, 3), dtype)
+    gv = netG.init(rng, z0)
+    dv = netD.init(jax.random.fold_in(rng, 1), x0)
+
+    optG = optax.adam(args.lr, b1=args.beta1)
+    optD = optax.adam(args.lr, b1=args.beta1)
+    sG = optG.init(gv["params"])
+    sD = optD.init(dv["params"])
+    # one scaler per loss (ref loss_id 0,1,2 + num_losses=3)
+    scalers = [LossScaler("dynamic") for _ in range(3)]
+    sc_states = [s.init_state() for s in scalers]
+
+    def bce(logits, target):
+        return jnp.mean(optax.sigmoid_binary_cross_entropy(
+            logits.astype(jnp.float32), target))
+
+    @jax.jit
+    def step_d(gv, dv, sD, sc0, sc1, real, z):
+        fake, g_updates = netG.apply(gv, z, mutable=["batch_stats"])
+
+        def loss_fn(p):
+            dvars = {"params": p, "batch_stats": dv["batch_stats"]}
+            lr_, upd1 = netD.apply(dvars, real, mutable=["batch_stats"])
+            errD_real = bce(lr_, jnp.ones(real.shape[0]))
+            lf_, upd2 = netD.apply(
+                {"params": p, "batch_stats": upd1["batch_stats"]},
+                jax.lax.stop_gradient(fake), mutable=["batch_stats"])
+            errD_fake = bce(lf_, jnp.zeros(real.shape[0]))
+            scaled = (scalers[0].scale_loss(errD_real, sc0)
+                      + scalers[1].scale_loss(errD_fake, sc1))
+            return scaled, (errD_real + errD_fake, upd2["batch_stats"])
+
+        grads, (errD, new_bs) = jax.grad(loss_fn, has_aux=True)(dv["params"])
+        # combined scale: grads carry sc0.scale + sc1.scale mixture; unscale
+        # conservatively by the max to keep the check meaningful
+        g32, found0 = scalers[0].unscale(
+            grads, sc0._replace(loss_scale=sc0.loss_scale + sc1.loss_scale))
+        new_sc0, skip = scalers[0].update_scale(sc0, found0)
+        new_sc1, _ = scalers[1].update_scale(sc1, found0)
+        updates, new_sD = optD.update(g32, sD, dv["params"])
+        new_p = jax.tree.map(
+            lambda p, u: jnp.where(skip, p, p + u.astype(p.dtype)),
+            dv["params"], updates)
+        return ({"params": new_p, "batch_stats": new_bs}, new_sD, new_sc0,
+                new_sc1, errD)
+
+    @jax.jit
+    def step_g(gv, dv, sG, sc2, z):
+        def loss_fn(p):
+            gvars = {"params": p, "batch_stats": gv["batch_stats"]}
+            fake, upd = netG.apply(gvars, z, mutable=["batch_stats"])
+            logits, _ = netD.apply(dv, fake, mutable=["batch_stats"])
+            errG = bce(logits, jnp.ones(fake.shape[0]))
+            return scalers[2].scale_loss(errG, sc2), (errG, upd["batch_stats"])
+
+        grads, (errG, new_bs) = jax.grad(loss_fn, has_aux=True)(gv["params"])
+        g32, found = scalers[2].unscale(grads, sc2)
+        new_sc2, skip = scalers[2].update_scale(sc2, found)
+        updates, new_sG = optG.update(g32, sG, gv["params"])
+        new_p = jax.tree.map(
+            lambda p, u: jnp.where(skip, p, p + u.astype(p.dtype)),
+            gv["params"], updates)
+        return ({"params": new_p, "batch_stats": new_bs}, new_sG, new_sc2,
+                errG)
+
+    data_rng = jax.random.PRNGKey(args.seed + 1)
+    t0 = time.perf_counter()
+    out = []
+    for it in range(args.iters):
+        k = jax.random.fold_in(data_rng, it)
+        real = jax.random.uniform(
+            k, (args.batch_size, args.image_size, args.image_size, 3),
+            dtype, -1, 1)
+        z = jax.random.normal(jax.random.fold_in(k, 1),
+                              (args.batch_size, 1, 1, args.nz), dtype)
+        dv, sD, sc_states[0], sc_states[1], errD = step_d(
+            gv, dv, sD, sc_states[0], sc_states[1], real, z)
+        gv, sG, sc_states[2], errG = step_g(gv, dv, sG, sc_states[2], z)
+        out.append((float(errD), float(errG)))
+        print(f"iter {it:3d}  errD {out[-1][0]:.4f}  errG {out[-1][1]:.4f}")
+    print(f"{args.iters / (time.perf_counter() - t0):.2f} it/s")
+    return out
+
+
+if __name__ == "__main__":
+    train(parse_args())

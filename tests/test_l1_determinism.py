@@ -1,0 +1,48 @@
+"""L1 determinism harness — ref tests/L1/common/compare.py:34-66: run the
+imagenet trainer twice per config with --deterministic and require EXACT
+per-iteration loss equality; sweep a mini {opt_level × sync_bn}
+cross-product (ref tests/L1/cross_product/run.sh)."""
+
+import importlib.util
+import pathlib
+
+import numpy as np
+import pytest
+
+_ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _load_trainer():
+    spec = importlib.util.spec_from_file_location(
+        "imagenet_main_amp", _ROOT / "examples" / "imagenet" / "main_amp.py")
+    m = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(m)
+    return m
+
+
+_BASE = ["--arch", "resnet18", "--iters", "3", "--batch-size", "16",
+         "--image-size", "32", "--num-classes", "10", "--deterministic",
+         "--lr", "0.001"]
+
+
+@pytest.mark.parametrize("opt_level,sync_bn", [
+    ("O0", False), ("O2", False), ("O2", True), ("O1", False),
+])
+def test_l1_loss_curves_are_deterministic(opt_level, sync_bn):
+    m = _load_trainer()
+    argv = _BASE + ["--opt-level", opt_level] + (
+        ["--sync_bn"] if sync_bn else [])
+    a = m.train(m.parse_args(argv))
+    b = m.train(m.parse_args(argv))
+    # bitwise per-iteration equality (ref compare.py exact equality gate)
+    assert a == b, f"nondeterministic losses: {a} vs {b}"
+    assert np.isfinite(a).all()
+
+
+def test_l1_opt_levels_start_close():
+    """O0 (fp32) and O2 (bf16+masters) must agree at init within bf16
+    tolerance (ref cross_product expectation: same first-iter loss)."""
+    m = _load_trainer()
+    a = m.train(m.parse_args(_BASE + ["--opt-level", "O0"]))
+    b = m.train(m.parse_args(_BASE + ["--opt-level", "O2"]))
+    np.testing.assert_allclose(a[0], b[0], rtol=5e-2)
