@@ -1,0 +1,12 @@
+"""Fused-gate RNN stack (ref ``apex/RNN``, deprecated upstream).
+
+Reference: ``RNN/RNNBackend.py:25-300`` + ``cells.py`` + ``models.py`` —
+pure-PyTorch RNN/LSTM/GRU/mLSTM with fused gate math, stacked and
+bidirectional wrappers. Kept for capability parity; on TPU the gate GEMMs
+hit the MXU and ``lax.scan`` carries the recurrence (one compiled step body
+for any sequence length).
+"""
+
+from apex_tpu.RNN.models import GRU, LSTM, RNNReLU, RNNTanh, mLSTM  # noqa: F401
+
+__all__ = ["LSTM", "GRU", "RNNReLU", "RNNTanh", "mLSTM"]

@@ -1,0 +1,184 @@
+"""RNN cells + stacked/bidirectional drivers.
+
+Reference mapping: cell math mirrors ``apex/RNN/cells.py`` (fused LSTM gate
+block, mLSTM multiplicative integration) and ``RNNBackend.py`` ``RNNCell``
+(:223, gate_multiplier pattern); the stacking/bidirectional drivers mirror
+``bidirectionalRNN``/``stackedRNN`` (:25,69). Layout: (batch, time, features).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class _Cell(nn.Module):
+    """One recurrent layer scanned over time. ``gates``: multiplier on the
+    hidden size for the fused gate GEMM (ref gate_multiplier)."""
+
+    hidden_size: int
+    gates: int
+    step_fn: Callable  # (pre_gates, carry) -> (new_carry, output)
+    carry_size: int = 1  # number of state tensors (h; or h,c)
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, init_carry=None):
+        b = x.shape[0]
+        g = self.gates * self.hidden_size
+        w_i = self.param("w_ih", nn.initializers.lecun_normal(),
+                         (x.shape[-1], g), self.dtype)
+        w_h = self.param("w_hh", nn.initializers.lecun_normal(),
+                         (self.hidden_size, g), self.dtype)
+        bias = self.param("bias", nn.initializers.zeros, (g,), self.dtype)
+        if init_carry is None:
+            init_carry = tuple(
+                jnp.zeros((b, self.hidden_size), self.dtype)
+                for _ in range(self.carry_size))
+
+        # fused input GEMM over the whole sequence (one MXU matmul)
+        xg = jnp.einsum("bti,ig->btg", x, w_i) + bias
+
+        def step(carry, xg_t):
+            h = carry[0]
+            pre = xg_t + h @ w_h
+            return self.step_fn(pre, carry)
+
+        carry, ys = lax.scan(step, init_carry, xg.swapaxes(0, 1))
+        return ys.swapaxes(0, 1), carry
+
+
+def _lstm_step(pre, carry):
+    h, c = carry
+    i, f, g, o = jnp.split(pre, 4, axis=-1)
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    c_new = f * c + i * jnp.tanh(g)
+    h_new = o * jnp.tanh(c_new)
+    return (h_new, c_new), h_new
+
+
+def _gru_step(pre, carry):
+    # fused r,z from the joint GEMM; candidate uses the reset gate
+    (h,) = carry
+    r, z, n = jnp.split(pre, 3, axis=-1)
+    r, z = jax.nn.sigmoid(r), jax.nn.sigmoid(z)
+    n = jnp.tanh(n * r)  # ref cells.py GRU variant: reset applied to pre-act
+    h_new = (1 - z) * n + z * h
+    return (h_new,), h_new
+
+
+def _rnn_step(act):
+    def step(pre, carry):
+        h_new = act(pre)
+        return (h_new,), h_new
+
+    return step
+
+
+class _Stacked(nn.Module):
+    """stackedRNN + bidirectionalRNN driver (ref RNNBackend.py:25-120)."""
+
+    hidden_size: int
+    num_layers: int
+    gates: int
+    step_fn: Callable
+    carry_size: int
+    bidirectional: bool = False
+    dropout: float = 0.0
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        h = x
+        for layer in range(self.num_layers):
+            fwd, _ = _Cell(self.hidden_size, self.gates, self.step_fn,
+                           self.carry_size, self.dtype,
+                           name=f"layer_{layer}")(h)
+            if self.bidirectional:
+                bwd, _ = _Cell(self.hidden_size, self.gates, self.step_fn,
+                               self.carry_size, self.dtype,
+                               name=f"layer_{layer}_rev")(h[:, ::-1])
+                h = jnp.concatenate([fwd, bwd[:, ::-1]], axis=-1)
+            else:
+                h = fwd
+            if self.dropout > 0 and not deterministic \
+                    and layer < self.num_layers - 1:
+                h = nn.Dropout(self.dropout, deterministic=False)(h)
+        return h
+
+
+def LSTM(input_size, hidden_size, num_layers=1, bidirectional=False,
+         dropout=0.0, dtype=jnp.float32):
+    """Ref ``models.py`` LSTM factory."""
+    del input_size  # inferred at first call (flax lazy init)
+    return _Stacked(hidden_size, num_layers, 4, _lstm_step, 2,
+                    bidirectional, dropout, dtype)
+
+
+def GRU(input_size, hidden_size, num_layers=1, bidirectional=False,
+        dropout=0.0, dtype=jnp.float32):
+    del input_size
+    return _Stacked(hidden_size, num_layers, 3, _gru_step, 1,
+                    bidirectional, dropout, dtype)
+
+
+def RNNTanh(input_size, hidden_size, num_layers=1, bidirectional=False,
+            dropout=0.0, dtype=jnp.float32):
+    del input_size
+    return _Stacked(hidden_size, num_layers, 1, _rnn_step(jnp.tanh), 1,
+                    bidirectional, dropout, dtype)
+
+
+def RNNReLU(input_size, hidden_size, num_layers=1, bidirectional=False,
+            dropout=0.0, dtype=jnp.float32):
+    del input_size
+    return _Stacked(hidden_size, num_layers, 1, _rnn_step(jax.nn.relu), 1,
+                    bidirectional, dropout, dtype)
+
+
+class _MLSTMCell(nn.Module):
+    """Multiplicative LSTM (ref ``cells.py`` mLSTM: m = (W_mx x) * (W_mh h)
+    modulates the hidden input to the gate block)."""
+
+    hidden_size: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, init_carry=None):
+        b = x.shape[0]
+        hs = self.hidden_size
+        w_i = self.param("w_ih", nn.initializers.lecun_normal(),
+                         (x.shape[-1], 4 * hs), self.dtype)
+        w_h = self.param("w_hh", nn.initializers.lecun_normal(),
+                         (hs, 4 * hs), self.dtype)
+        w_mx = self.param("w_mx", nn.initializers.lecun_normal(),
+                          (x.shape[-1], hs), self.dtype)
+        w_mh = self.param("w_mh", nn.initializers.lecun_normal(),
+                          (hs, hs), self.dtype)
+        bias = self.param("bias", nn.initializers.zeros, (4 * hs,),
+                          self.dtype)
+        if init_carry is None:
+            init_carry = (jnp.zeros((b, hs), self.dtype),
+                          jnp.zeros((b, hs), self.dtype))
+        xg = jnp.einsum("bti,ig->btg", x, w_i) + bias
+        xm = jnp.einsum("bti,ih->bth", x, w_mx)
+
+        def step(carry, inp):
+            xg_t, xm_t = inp
+            h, c = carry
+            m = xm_t * (h @ w_mh)
+            pre = xg_t + m @ w_h
+            return _lstm_step(pre, (h, c))
+
+        carry, ys = lax.scan(step, init_carry,
+                             (xg.swapaxes(0, 1), xm.swapaxes(0, 1)))
+        return ys.swapaxes(0, 1), carry
+
+
+def mLSTM(input_size, hidden_size, dtype=jnp.float32):
+    del input_size
+    return _MLSTMCell(hidden_size, dtype)

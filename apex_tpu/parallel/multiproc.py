@@ -1,0 +1,71 @@
+"""Multi-host process bootstrap (ref ``apex/parallel/multiproc.py``).
+
+Reference: a pre-``torchrun`` one-node launcher that spawns ``world_size``
+subprocesses with ``--rank i`` (:12-35).
+
+TPU re-design: TPU pods do not spawn per-device processes from Python — the
+platform runner starts one process per host and JAX discovers peers. This
+module provides the idiomatic equivalents:
+
+* :func:`initialize_distributed` — ``jax.distributed.initialize`` from env
+  (coordinator address / process id / count), the ``--rank``/``--world-size``
+  analogue for multi-host DCN meshes.
+* ``python -m apex_tpu.parallel.multiproc N -- cmd...`` — a local fan-out
+  that runs ``cmd`` N times with ``RANK``/``WORLD_SIZE`` env set, for
+  CPU-simulation workflows mirroring the reference CLI.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from typing import Optional
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """``jax.distributed.initialize`` with env-var fallbacks
+    (COORDINATOR_ADDRESS / WORLD_SIZE|NPROCS / RANK|PROCESS_ID). No-op when
+    single-process and no coordinator is configured."""
+    import jax
+
+    coordinator_address = coordinator_address or os.environ.get(
+        "COORDINATOR_ADDRESS")
+    if num_processes is None:
+        env = os.environ.get("WORLD_SIZE") or os.environ.get("NPROCS")
+        num_processes = int(env) if env else None
+    if process_id is None:
+        env = os.environ.get("RANK") or os.environ.get("PROCESS_ID")
+        process_id = int(env) if env else None
+    if coordinator_address is None and (num_processes or 1) <= 1:
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id)
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if len(argv) < 3 or argv[1] != "--":
+        print("usage: python -m apex_tpu.parallel.multiproc N -- cmd [args...]",
+              file=sys.stderr)
+        return 2
+    world = int(argv[0])
+    cmd = argv[2:]
+    procs = []
+    for rank in range(world):
+        env = dict(os.environ, RANK=str(rank), WORLD_SIZE=str(world))
+        procs.append(subprocess.Popen(cmd, env=env))
+    rc = 0
+    for p in procs:
+        rc = rc or p.wait()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,30 @@
+"""Profiling toolkit (ref ``apex/pyprof``, ~5k LoC).
+
+The reference has three parts: (1) ``nvtx.init()`` monkey-patches the torch
+surface to emit NVTX ranges with call-site/shape/dtype payloads
+(``nvtx/nvmarker.py``); (2) ``parse`` reads nvprof SQLite databases;
+(3) ``prof`` maps kernels to layers and computes per-op FLOPs/bytes
+(``prof/blas.py`` etc.).
+
+TPU re-design: XLA already carries op provenance end-to-end, so the three
+parts collapse to thin, robust wrappers:
+
+* :func:`annotate` / :func:`annotate_function` — ``jax.named_scope`` ranges
+  that show up in the XLA trace viewer (the nvtx.init capability, no
+  monkey-patching needed: scopes attach to traced ops).
+* :func:`trace` — ``jax.profiler.trace`` context writing a TensorBoard-
+  loadable profile (the nvprof capture).
+* :func:`cost_analysis` — compiled-HLO FLOPs/bytes per executable (the
+  ``prof`` FLOP counting, exact instead of per-op formulas).
+"""
+
+from apex_tpu.pyprof.profiler import (  # noqa: F401
+    annotate,
+    annotate_function,
+    cost_analysis,
+    summary,
+    trace,
+)
+
+__all__ = ["annotate", "annotate_function", "trace", "cost_analysis",
+           "summary"]

@@ -1,0 +1,70 @@
+"""See package docstring."""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+from typing import Any, Callable, Dict, Optional
+
+import jax
+
+
+def annotate(name: str):
+    """Range annotation visible in profiler traces (ref
+    ``pyprof.nvtx`` ranges; ad-hoc NVTX in hot paths like
+    ``apex/parallel/distributed.py:360``)."""
+    return jax.named_scope(name)
+
+
+def annotate_function(fn: Callable = None, *, name: Optional[str] = None):
+    """Decorator form (ref ``nvtx/nvmarker.py`` function wrapping)."""
+    if fn is None:
+        return functools.partial(annotate_function, name=name)
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        with jax.named_scope(name or fn.__qualname__):
+            return fn(*args, **kwargs)
+
+    return wrapped
+
+
+@contextlib.contextmanager
+def trace(log_dir: str, create_perfetto_link: bool = False):
+    """Capture a device trace to ``log_dir`` (TensorBoard 'profile' plugin /
+    Perfetto readable — the nvprof-SQLite analogue)."""
+    jax.profiler.start_trace(log_dir,
+                             create_perfetto_link=create_perfetto_link)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def cost_analysis(fn: Callable, *args, **kwargs) -> Dict[str, float]:
+    """Exact compiled-program costs: {'flops', 'bytes accessed', ...} from
+    XLA's cost model (ref ``pyprof.prof`` per-op FLOP formulas — here the
+    compiler reports the real numbers after fusion)."""
+    compiled = jax.jit(fn).lower(*args, **kwargs).compile()
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):  # older jax returns [dict]
+        ca = ca[0] if ca else {}
+    return dict(ca) if ca else {}
+
+
+def summary(fn: Callable, *args, peak_flops: Optional[float] = None,
+            **kwargs) -> Dict[str, Any]:
+    """One-call roofline summary of a jittable function: FLOPs, bytes,
+    arithmetic intensity, and (given ``peak_flops``) the compute-bound
+    ceiling — the pyprof 'prof' report for one step."""
+    ca = cost_analysis(fn, *args, **kwargs)
+    flops = float(ca.get("flops", 0.0))
+    byts = float(ca.get("bytes accessed", 0.0))
+    out = {
+        "flops": flops,
+        "bytes_accessed": byts,
+        "arithmetic_intensity": flops / byts if byts else float("inf"),
+    }
+    if peak_flops:
+        out["min_time_s_compute_bound"] = flops / peak_flops
+    return out

@@ -1,0 +1,67 @@
+"""Weight normalization reparameterization (ref ``apex/reparameterization``).
+
+Reference: ``apply_weight_norm`` (``reparameterization/__init__.py:4``) +
+``WeightNorm``/``Reparameterization`` — forward pre-hooks that recompute
+``w = g * v / ||v||`` before every forward.
+
+TPU re-design: the hook machinery becomes two pure functions over the param
+pytree — decompose once, recompose inside the (jitted) forward; XLA fuses
+the norm into the consumer. ``dim=0`` matches the reference default (norm
+over all dims except the first / output dim — for flax kernels of shape
+(in, out) pass ``dim=-1``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+Pytree = Any
+_EPS = 1e-12
+
+
+def _norm_except(v, dim: int):
+    axes = tuple(a for a in range(v.ndim) if a != dim % v.ndim)
+    return jnp.sqrt(jnp.sum(jnp.square(v.astype(jnp.float32)), axis=axes,
+                            keepdims=True))
+
+
+def apply_weight_norm(params: Pytree, name_filter: Optional[Callable] = None,
+                      dim: int = 0) -> Pytree:
+    """Decompose selected weights into ``{"g", "v"}`` (ref
+    ``apply_weight_norm``). ``name_filter(path_str)`` selects leaves
+    (default: every float leaf with ndim >= 2)."""
+    from apex_tpu.amp.frontend import _path_str
+
+    def leaf(path, x):
+        p = _path_str(path)
+        sel = (name_filter(p) if name_filter is not None
+               else (hasattr(x, "ndim") and x.ndim >= 2
+                     and jnp.issubdtype(jnp.result_type(x), jnp.floating)))
+        if not sel:
+            return x
+        g = _norm_except(x, dim).astype(x.dtype)
+        return {"wn_g": g, "wn_v": x}
+
+    return jax.tree_util.tree_map_with_path(leaf, params)
+
+
+def remove_weight_norm(params: Pytree, dim: int = 0) -> Pytree:
+    """Recompose ``w = g * v/||v||`` (ref ``remove_weight_norm``); the
+    inverse of :func:`apply_weight_norm`. Call inside the forward so the
+    norm is recomputed each step (the pre-hook semantics)."""
+
+    def is_wn(x):
+        return isinstance(x, dict) and set(x.keys()) == {"wn_g", "wn_v"}
+
+    def leaf(x):
+        if not is_wn(x):
+            return x
+        v = x["wn_v"]
+        return (x["wn_g"].astype(jnp.float32)
+                * v.astype(jnp.float32)
+                / (_norm_except(v, dim) + _EPS)).astype(v.dtype)
+
+    return jax.tree_util.tree_map(leaf, params, is_leaf=is_wn)

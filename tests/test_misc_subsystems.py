@@ -1,0 +1,112 @@
+"""pyprof / RNN / weight-norm / multiproc tests — ref tests/L0/run_pyprof_*,
+apex/RNN usage, reparameterization tests."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.pyprof import annotate, annotate_function, cost_analysis, summary
+from apex_tpu.reparameterization import apply_weight_norm, remove_weight_norm
+from apex_tpu.RNN import GRU, LSTM, RNNReLU, RNNTanh, mLSTM
+
+
+# ---------------------------------------------------------------------------
+# pyprof analogue
+
+
+def test_cost_analysis_reports_matmul_flops():
+    a = jnp.ones((128, 128))
+    ca = cost_analysis(lambda a: a @ a, a)
+    # 2*n^3 = 4.19e6 MACs; XLA reports >= the matmul flops
+    assert ca.get("flops", 0) >= 2 * 128 ** 3 * 0.9
+
+
+def test_summary_and_annotations():
+    a = jnp.ones((64, 64))
+
+    @annotate_function(name="my_matmul")
+    def f(a):
+        with annotate("inner"):
+            return a @ a
+
+    s = summary(f, a, peak_flops=1e12)
+    assert s["flops"] > 0 and s["min_time_s_compute_bound"] > 0
+    np.testing.assert_allclose(np.asarray(f(a)), np.asarray(a @ a))
+
+
+# ---------------------------------------------------------------------------
+# RNN (ref apex/RNN/models.py surface)
+
+
+@pytest.mark.parametrize("factory,carry", [(LSTM, 2), (GRU, 1),
+                                           (RNNTanh, 1), (RNNReLU, 1)])
+def test_rnn_shapes_and_grads(factory, carry):
+    m = factory(input_size=8, hidden_size=16, num_layers=2)
+    x = jax.random.normal(jax.random.PRNGKey(0), (3, 5, 8))
+    params = m.init(jax.random.PRNGKey(1), x)
+    y = m.apply(params, x)
+    assert y.shape == (3, 5, 16)
+    g = jax.grad(lambda p: jnp.sum(m.apply(p, x) ** 2))(params)
+    assert all(np.isfinite(np.asarray(l)).all() for l in jax.tree.leaves(g))
+
+
+def test_rnn_bidirectional_doubles_features():
+    m = LSTM(input_size=8, hidden_size=16, num_layers=1, bidirectional=True)
+    x = jax.random.normal(jax.random.PRNGKey(2), (2, 7, 8))
+    params = m.init(jax.random.PRNGKey(3), x)
+    assert m.apply(params, x).shape == (2, 7, 32)
+
+
+def test_mlstm_runs():
+    m = mLSTM(input_size=8, hidden_size=16)
+    x = jax.random.normal(jax.random.PRNGKey(4), (2, 5, 8))
+    params = m.init(jax.random.PRNGKey(5), x)
+    y, (h, c) = m.apply(params, x)
+    assert y.shape == (2, 5, 16) and h.shape == (2, 16)
+
+
+def test_lstm_state_is_causal():
+    """Output at time t must not depend on inputs after t."""
+    m = LSTM(input_size=4, hidden_size=8)
+    x = jax.random.normal(jax.random.PRNGKey(6), (1, 6, 4))
+    params = m.init(jax.random.PRNGKey(7), x)
+    y1 = m.apply(params, x)
+    x2 = x.at[:, 4:].set(0.0)
+    y2 = m.apply(params, x2)
+    np.testing.assert_allclose(np.asarray(y1[:, :4]), np.asarray(y2[:, :4]),
+                               atol=1e-6)
+    assert not np.allclose(np.asarray(y1[:, 5]), np.asarray(y2[:, 5]))
+
+
+# ---------------------------------------------------------------------------
+# weight norm (ref apex/reparameterization)
+
+
+def test_weight_norm_round_trip_and_direction():
+    params = {"dense": {"kernel": jax.random.normal(jax.random.PRNGKey(8),
+                                                    (6, 4)),
+                        "bias": jnp.zeros((4,))}}
+    wn = apply_weight_norm(params, dim=0)
+    assert set(wn["dense"]["kernel"].keys()) == {"wn_g", "wn_v"}
+    back = remove_weight_norm(wn, dim=0)
+    np.testing.assert_allclose(np.asarray(back["dense"]["kernel"]),
+                               np.asarray(params["dense"]["kernel"]),
+                               rtol=1e-5)
+    # scaling v must not change the recomposed weight (direction-only)
+    wn2 = jax.tree_util.tree_map(lambda x: x, wn)
+    wn2["dense"]["kernel"] = {"wn_g": wn["dense"]["kernel"]["wn_g"],
+                              "wn_v": wn["dense"]["kernel"]["wn_v"] * 3.0}
+    back2 = remove_weight_norm(wn2, dim=0)
+    np.testing.assert_allclose(np.asarray(back2["dense"]["kernel"]),
+                               np.asarray(params["dense"]["kernel"]),
+                               rtol=1e-5)
+
+
+def test_multiproc_initialize_noop_single_process(monkeypatch):
+    from apex_tpu.parallel import multiproc
+
+    monkeypatch.delenv("COORDINATOR_ADDRESS", raising=False)
+    monkeypatch.delenv("WORLD_SIZE", raising=False)
+    multiproc.initialize_distributed()  # must not raise or call jax.distributed
