@@ -1,0 +1,72 @@
+"""Native (C++) runtime components, compiled on first use with g++.
+
+The compute path is JAX/XLA/Pallas; these are the host-side runtime pieces
+the reference also keeps native (SURVEY §2.2 note: "C++ only where an actual
+host-side runtime component is required"). Build: ``build_lib()`` compiles
+``dataloader.cpp`` to a cached ``.so`` with the system g++ (no pybind11 —
+plain C ABI consumed via ctypes). Falls back gracefully: consumers must
+treat ``build_lib() is None`` as "use the numpy path".
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import pathlib
+import subprocess
+import tempfile
+from typing import Optional
+
+_DIR = pathlib.Path(__file__).resolve().parent
+_SRC = _DIR / "dataloader.cpp"
+_lib = None
+_tried = False
+
+
+def _cache_path() -> pathlib.Path:
+    src = _SRC.read_bytes()
+    tag = hashlib.sha256(src).hexdigest()[:16]
+    root = pathlib.Path(os.environ.get("APEX_TPU_NATIVE_CACHE",
+                                       _DIR / "_build"))
+    return root / f"dataloader_{tag}.so"
+
+
+def build_lib() -> Optional[ctypes.CDLL]:
+    """Compile (once) and dlopen the native core; None if no toolchain."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    so = _cache_path()
+    try:
+        if not so.exists():
+            so.parent.mkdir(parents=True, exist_ok=True)
+            with tempfile.TemporaryDirectory() as td:
+                tmp = pathlib.Path(td) / so.name
+                subprocess.run(
+                    ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+                     "-pthread", str(_SRC), "-o", str(tmp)],
+                    check=True, capture_output=True)
+                os.replace(tmp, so)
+        lib = ctypes.CDLL(str(so))
+        lib.al_create.restype = ctypes.c_void_p
+        lib.al_create.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                  ctypes.c_int64, ctypes.c_int, ctypes.c_int]
+        lib.al_submit.restype = ctypes.c_uint64
+        lib.al_submit.argtypes = [ctypes.c_void_p,
+                                  ctypes.POINTER(ctypes.c_int64),
+                                  ctypes.c_int64, ctypes.c_void_p]
+        lib.al_wait.restype = ctypes.c_int
+        lib.al_wait.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.al_normalize_u8_f32.restype = None
+        lib.al_normalize_u8_f32.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int]
+        lib.al_destroy.restype = None
+        lib.al_destroy.argtypes = [ctypes.c_void_p]
+        _lib = lib
+    except Exception:
+        _lib = None
+    return _lib

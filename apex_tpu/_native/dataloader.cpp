@@ -1,0 +1,165 @@
+// Threaded batch-assembly core for the TPU input pipeline.
+//
+// Role (reference context): the reference's training input path is the
+// examples' prefetcher (examples/imagenet/main_amp.py:265 — a CUDA-stream
+// prefetcher that overlaps H2D copy + normalize with compute) plus the NVIDIA
+// DALI ecosystem; its csrc/ runtime pieces (apex_C flatten, multi-tensor
+// bucketing) are likewise native. On TPU the device-side work belongs to XLA,
+// but the HOST side — gathering sample rows into contiguous batches and
+// normalizing uint8 image data to float — is real CPU work that would
+// otherwise serialize with the training loop under the GIL. This core does it
+// in C++ worker threads with a request/ready ring, so Python only moves
+// pointers.
+//
+// C API (ctypes-consumed, see apex_tpu/data/loader.py):
+//   al_create(source, n_items, item_bytes, n_workers, queue_depth)
+//   al_submit(loader, indices, n_idx, out_buffer)   -> ticket id
+//   al_wait(loader, ticket)                         -> 0 on success
+//   al_normalize_u8_f32(src, dst, n, c, mean[c], std[c], n_threads)
+//   al_destroy(loader)
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Request {
+  uint64_t ticket;
+  std::vector<int64_t> indices;
+  uint8_t* out;
+};
+
+struct Loader {
+  const uint8_t* source;
+  int64_t n_items;
+  int64_t item_bytes;
+  std::vector<std::thread> workers;
+  std::deque<Request> queue;
+  std::unordered_map<uint64_t, int> done;  // ticket -> status
+  std::mutex mu;
+  std::condition_variable cv_work;
+  std::condition_variable cv_done;
+  std::atomic<uint64_t> next_ticket{1};
+  bool stopping = false;
+
+  void worker_loop() {
+    for (;;) {
+      Request req;
+      {
+        std::unique_lock<std::mutex> lock(mu);
+        cv_work.wait(lock, [&] { return stopping || !queue.empty(); });
+        if (stopping && queue.empty()) return;
+        req = std::move(queue.front());
+        queue.pop_front();
+      }
+      int status = 0;
+      for (size_t i = 0; i < req.indices.size(); ++i) {
+        int64_t idx = req.indices[i];
+        if (idx < 0 || idx >= n_items) {
+          status = 1;
+          continue;
+        }
+        std::memcpy(req.out + i * item_bytes, source + idx * item_bytes,
+                    static_cast<size_t>(item_bytes));
+      }
+      {
+        std::lock_guard<std::mutex> lock(mu);
+        done[req.ticket] = status;
+      }
+      cv_done.notify_all();
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* al_create(const void* source, int64_t n_items, int64_t item_bytes,
+                int n_workers, int /*queue_depth*/) {
+  auto* l = new Loader();
+  l->source = static_cast<const uint8_t*>(source);
+  l->n_items = n_items;
+  l->item_bytes = item_bytes;
+  if (n_workers < 1) n_workers = 1;
+  for (int i = 0; i < n_workers; ++i) {
+    l->workers.emplace_back([l] { l->worker_loop(); });
+  }
+  return l;
+}
+
+uint64_t al_submit(void* loader, const int64_t* indices, int64_t n_idx,
+                   void* out) {
+  auto* l = static_cast<Loader*>(loader);
+  Request req;
+  req.ticket = l->next_ticket.fetch_add(1);
+  req.indices.assign(indices, indices + n_idx);
+  req.out = static_cast<uint8_t*>(out);
+  {
+    std::lock_guard<std::mutex> lock(l->mu);
+    l->queue.push_back(std::move(req));
+  }
+  l->cv_work.notify_one();
+  return req.ticket;
+}
+
+int al_wait(void* loader, uint64_t ticket) {
+  auto* l = static_cast<Loader*>(loader);
+  std::unique_lock<std::mutex> lock(l->mu);
+  l->cv_done.wait(lock, [&] { return l->done.count(ticket) > 0; });
+  int status = l->done[ticket];
+  l->done.erase(ticket);
+  return status;
+}
+
+// uint8 HWC image block -> float32, (x/255 - mean[c]) / std[c], threaded.
+void al_normalize_u8_f32(const uint8_t* src, float* dst, int64_t n,
+                         int64_t c, const float* mean, const float* stddev,
+                         int n_threads) {
+  if (n_threads < 1) n_threads = 1;
+  std::vector<float> scale(c), shift(c);
+  for (int64_t ch = 0; ch < c; ++ch) {
+    scale[ch] = 1.0f / (255.0f * stddev[ch]);
+    shift[ch] = -mean[ch] / stddev[ch];
+  }
+  int64_t total = n * c;
+  auto work = [&](int64_t begin, int64_t end) {
+    for (int64_t i = begin; i < end; ++i) {
+      int64_t ch = i % c;
+      dst[i] = static_cast<float>(src[i]) * scale[ch] + shift[ch];
+    }
+  };
+  if (n_threads == 1) {
+    work(0, total);
+    return;
+  }
+  std::vector<std::thread> threads;
+  int64_t chunk = (total + n_threads - 1) / n_threads;
+  for (int t = 0; t < n_threads; ++t) {
+    int64_t b = t * chunk;
+    int64_t e = b + chunk < total ? b + chunk : total;
+    if (b >= e) break;
+    threads.emplace_back(work, b, e);
+  }
+  for (auto& th : threads) th.join();
+}
+
+void al_destroy(void* loader) {
+  auto* l = static_cast<Loader*>(loader);
+  {
+    std::lock_guard<std::mutex> lock(l->mu);
+    l->stopping = true;
+  }
+  l->cv_work.notify_all();
+  for (auto& th : l->workers) th.join();
+  delete l;
+}
+
+}  // extern "C"

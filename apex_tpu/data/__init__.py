@@ -1,0 +1,12 @@
+"""Input pipeline (native-threaded prefetcher + normalize).
+
+Reference analogue: the imagenet example's CUDA-stream ``data_prefetcher``
+(``examples/imagenet/main_amp.py:265``) — overlap batch assembly and
+normalization with the training step. Here the host side runs in the C++
+core (``apex_tpu/_native``); device transfer overlap comes from
+``jax.device_put`` on the next batch while the current step executes.
+"""
+
+from apex_tpu.data.loader import BatchLoader, normalize_u8  # noqa: F401
+
+__all__ = ["BatchLoader", "normalize_u8"]

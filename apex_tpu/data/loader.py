@@ -1,0 +1,104 @@
+"""ctypes driver for the native batch-assembly core (see package doc)."""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Iterator, Optional, Sequence
+
+import numpy as np
+
+from apex_tpu._native import build_lib
+
+
+def normalize_u8(images_u8: np.ndarray, mean: Sequence[float],
+                 std: Sequence[float], n_threads: int = 4) -> np.ndarray:
+    """(…, C) uint8 -> float32 ``(x/255 - mean)/std`` in C++ threads
+    (numpy fallback when the toolchain is unavailable)."""
+    assert images_u8.dtype == np.uint8
+    c = images_u8.shape[-1]
+    assert len(mean) == c and len(std) == c
+    lib = build_lib()
+    src = np.ascontiguousarray(images_u8)
+    if lib is None:
+        return ((src.astype(np.float32) / 255.0
+                 - np.asarray(mean, np.float32))
+                / np.asarray(std, np.float32))
+    dst = np.empty(src.shape, np.float32)
+    m = (ctypes.c_float * c)(*[float(x) for x in mean])
+    s = (ctypes.c_float * c)(*[float(x) for x in std])
+    lib.al_normalize_u8_f32(
+        src.ctypes.data_as(ctypes.c_void_p),
+        dst.ctypes.data_as(ctypes.c_void_p),
+        src.size // c, c, m, s, n_threads)
+    return dst
+
+
+class BatchLoader:
+    """Threaded gather of sample rows into batches with one-deep pipelining.
+
+    ``source``: (N, ...) array of samples (any dtype, C-contiguous).
+    ``iterate(index_batches)`` yields assembled batches while the NEXT one is
+    being built by the worker threads — the prefetcher overlap.
+    """
+
+    def __init__(self, source: np.ndarray, n_workers: int = 2):
+        self.source = np.ascontiguousarray(source)
+        self.item_shape = self.source.shape[1:]
+        self.item_bytes = int(self.source[0].nbytes) if len(source) else 0
+        self._lib = build_lib()
+        self._handle = None
+        if self._lib is not None:
+            self._handle = self._lib.al_create(
+                self.source.ctypes.data_as(ctypes.c_void_p),
+                len(self.source), self.item_bytes, n_workers, 4)
+
+    def _submit(self, indices: np.ndarray, out: np.ndarray) -> int:
+        idx = np.ascontiguousarray(indices, np.int64)
+        return self._lib.al_submit(
+            self._handle,
+            idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            len(idx), out.ctypes.data_as(ctypes.c_void_p))
+
+    def gather(self, indices: np.ndarray) -> np.ndarray:
+        """Blocking single-batch assembly."""
+        if self._handle is None:
+            return self.source[np.asarray(indices)]
+        out = np.empty((len(indices),) + self.item_shape, self.source.dtype)
+        ticket = self._submit(indices, out)
+        rc = self._lib.al_wait(self._handle, ticket)
+        if rc != 0:
+            raise IndexError("batch indices out of range")
+        return out
+
+    def iterate(self, index_batches) -> Iterator[np.ndarray]:
+        """Pipelined iteration: batch k+1 assembles while k is consumed."""
+        if self._handle is None:
+            for idx in index_batches:
+                yield self.source[np.asarray(idx)]
+            return
+        pending = None  # (ticket, out)
+        for idx in index_batches:
+            out = np.empty((len(idx),) + self.item_shape, self.source.dtype)
+            ticket = self._submit(np.asarray(idx), out)
+            if pending is not None:
+                p_ticket, p_out = pending
+                if self._lib.al_wait(self._handle, p_ticket) != 0:
+                    raise IndexError("batch indices out of range")
+                yield p_out
+            pending = (ticket, out)
+        if pending is not None:
+            p_ticket, p_out = pending
+            if self._lib.al_wait(self._handle, p_ticket) != 0:
+                raise IndexError("batch indices out of range")
+            yield p_out
+
+    def close(self):
+        if self._handle is not None and self._lib is not None:
+            self._lib.al_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):  # pragma: no cover - destructor timing
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,48 @@
+"""multi_tensor_apply — API-parity shim (ref ``apex/multi_tensor_apply``).
+
+Reference: ``MultiTensorApply.__call__`` (``multi_tensor_apply.py:24-30``)
+dispatches an ``amp_C`` CUDA kernel over chunked tensor lists with a shared
+overflow flag — the fused-sweep machinery every apex optimizer rides on.
+
+TPU re-design: the capability (one fused pass over all params) is what XLA
+does to a jitted ``tree_map``; there is nothing to chunk. This shim keeps
+the call shape for ported code: ``op`` is a per-leaf function, tensor lists
+are pytrees, and the "noop flag" becomes a returned all-finite check.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class MultiTensorApply:
+    """``applier = MultiTensorApply(2048*32); applier(op, noop_flag, lists)``
+    (the chunk size is accepted and ignored — XLA fuses globally)."""
+
+    def __init__(self, chunk_size: int = 2048 * 32):
+        self.chunk_size = chunk_size
+
+    def __call__(self, op: Callable, noop_flag_or_none: Optional[Any],
+                 tensor_lists, *args):
+        """Apply ``op(*leaves, *args)`` across the zipped pytrees in
+        ``tensor_lists``. Returns ``(results, found_inf)`` where found_inf
+        is a f32 0/1 scalar over every INPUT leaf (the overflow-flag
+        contract of ``multi_tensor_scale``)."""
+        outs = jax.tree_util.tree_map(lambda *ls: op(*ls, *args),
+                                      *tensor_lists)
+        leaves = [l for t in tensor_lists
+                  for l in jax.tree_util.tree_leaves(t)]
+        if leaves:
+            finite = jnp.stack(
+                [jnp.all(jnp.isfinite(l)) for l in leaves]).all()
+        else:
+            finite = jnp.asarray(True)
+        return outs, (~finite).astype(jnp.float32)
+
+
+multi_tensor_applier = MultiTensorApply()
+
+__all__ = ["MultiTensorApply", "multi_tensor_applier"]

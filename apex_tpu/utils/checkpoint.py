@@ -1,0 +1,63 @@
+"""Checkpoint save/load for train-state pytrees.
+
+Reference context (SURVEY §5): model checkpointing is delegated to
+``torch.save``; apex only contributes the amp/scaler state-dict entries
+(``frontend.py:361-401``) and fp32 master saving
+(``fp16_optimizer.py:209-270``). The TPU equivalents of those live on their
+owning objects (``amp.state_dict``, ``FP16_Optimizer.state_dict``,
+``LossScaler.state_dict``); this module supplies the ``torch.save`` role:
+orbax when available (sharded-array aware, async-capable), numpy fallback.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+Pytree = Any
+
+
+def save_checkpoint(path: str, state: Pytree, step: Optional[int] = None,
+                    overwrite: bool = True) -> str:
+    """Write ``state`` (any pytree of arrays + scalars) under ``path``.
+    Returns the final checkpoint directory/file path."""
+    try:
+        import orbax.checkpoint as ocp
+
+        p = os.path.abspath(path if step is None else f"{path}_{step}")
+        ckptr = ocp.PyTreeCheckpointer()
+        ckptr.save(p, jax.device_get(state), force=overwrite)
+        return p
+    except ImportError:
+        p = (path if step is None else f"{path}_{step}") + ".npz.pkl"
+        host = jax.tree_util.tree_map(np.asarray, jax.device_get(state))
+        if not overwrite and os.path.exists(p):
+            raise FileExistsError(p)
+        with open(p, "wb") as f:
+            pickle.dump(host, f)
+        return p
+
+
+def load_checkpoint(path: str, target: Optional[Pytree] = None) -> Pytree:
+    """Read a checkpoint written by :func:`save_checkpoint`. ``target``:
+    optional pytree of like-structured arrays used to restore dtypes/
+    structure (orbax restore_args)."""
+    try:
+        import orbax.checkpoint as ocp
+
+        if os.path.isdir(path):
+            ckptr = ocp.PyTreeCheckpointer()
+            restored = ckptr.restore(path)
+            if target is not None:
+                restored = jax.tree_util.tree_map(
+                    lambda t, r: np.asarray(r, dtype=t.dtype), target,
+                    restored)
+            return restored
+    except ImportError:
+        pass
+    with open(path, "rb") as f:
+        return pickle.load(f)

@@ -110,3 +110,30 @@ def test_multiproc_initialize_noop_single_process(monkeypatch):
     monkeypatch.delenv("COORDINATOR_ADDRESS", raising=False)
     monkeypatch.delenv("WORLD_SIZE", raising=False)
     multiproc.initialize_distributed()  # must not raise or call jax.distributed
+
+
+def test_multi_tensor_applier_shim():
+    from apex_tpu.multi_tensor_apply import multi_tensor_applier
+
+    a = [jnp.ones((4,)), jnp.full((2, 2), 2.0)]
+    b = [jnp.full((4,), 3.0), jnp.ones((2, 2))]
+    (out, found) = multi_tensor_applier(lambda x, y, s: x * y * s, None,
+                                        (a, b), 2.0)
+    np.testing.assert_allclose(np.asarray(out[0]), 6.0)
+    np.testing.assert_allclose(np.asarray(out[1]), 4.0)
+    assert float(found) == 0.0
+    bad = [jnp.asarray([jnp.inf, 1.0, 1.0, 1.0]), b[1]]
+    _, found2 = multi_tensor_applier(lambda x, y: x + y, None, (bad, a))
+    assert float(found2) == 1.0
+
+
+def test_checkpoint_round_trip(tmp_path):
+    from apex_tpu.utils.checkpoint import load_checkpoint, save_checkpoint
+
+    state = {"w": jnp.arange(6.0).reshape(2, 3),
+             "opt": {"count": jnp.asarray(3)}}
+    p = save_checkpoint(str(tmp_path / "ckpt"), state, step=7)
+    restored = load_checkpoint(p)
+    np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                  np.asarray(state["w"]))
+    assert int(np.asarray(restored["opt"]["count"])) == 3
