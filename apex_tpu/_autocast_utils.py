@@ -1,0 +1,29 @@
+"""Autocast interop helpers (ref ``apex/_autocast_utils.py:6-23``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import jax.numpy as jnp
+
+
+def _get_autocast_dtypes() -> Sequence[Any]:
+    """Supported half dtypes, preferred first (ref :6-10 — [bf16, fp16] when
+    bf16 is supported). On TPU bf16 is always supported."""
+    return [jnp.bfloat16, jnp.float16]
+
+
+def _get_current_dtype(dtype: Optional[Any] = None) -> Any:
+    """Ref :13-16: the active autocast dtype; here, caller-supplied or bf16."""
+    return jnp.bfloat16 if dtype is None else dtype
+
+
+def _cast_if_autocast_enabled(*args, dtype=jnp.bfloat16):
+    """Ref :19-23: cast float args to the autocast dtype (always 'enabled' —
+    jax has no thread-local autocast; policies are explicit)."""
+    return tuple(
+        a.astype(dtype)
+        if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating)
+        else a
+        for a in args
+    )

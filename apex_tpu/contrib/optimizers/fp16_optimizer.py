@@ -1,0 +1,10 @@
+"""Contrib FP16_Optimizer (ref ``apex/contrib/optimizers/fp16_optimizer.py:4``).
+
+The contrib variant differs from ``apex.fp16_utils.FP16_Optimizer`` only in
+taking explicit grads/output-params for the legacy contrib fused kernels;
+under the functional API both collapse to the same wrapper, re-exported here
+for import parity."""
+
+from apex_tpu.fp16_utils.fp16_optimizer import FP16_Optimizer  # noqa: F401
+
+__all__ = ["FP16_Optimizer"]

@@ -16,7 +16,6 @@ reference, not on shape limits.
 
 from __future__ import annotations
 
-import enum
 from typing import Callable, Optional
 
 import jax
@@ -28,11 +27,7 @@ from apex_tpu.ops.softmax import (
 )
 
 
-class AttnMaskType(enum.Enum):
-    """Ref ``apex/transformer/enums.py`` AttnMaskType."""
-
-    padding = 1
-    causal = 2
+from apex_tpu.transformer.enums import AttnMaskType  # noqa: F401,E402
 
 
 class FusedScaleMaskSoftmax:

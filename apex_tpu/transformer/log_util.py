@@ -1,0 +1,18 @@
+"""Transformer-stack logging helpers (ref ``apex/transformer/log_util.py``)."""
+
+from __future__ import annotations
+
+import logging
+import os
+
+
+def get_transformer_logger(name: str) -> logging.Logger:
+    name_wo_ext = os.path.splitext(name)[0]
+    return logging.getLogger(name_wo_ext)
+
+
+def set_logging_level(verbosity) -> None:
+    """Change the library root logger severity (ref set_logging_level)."""
+    from apex_tpu._logging import get_logger
+
+    get_logger("apex_tpu").setLevel(verbosity)

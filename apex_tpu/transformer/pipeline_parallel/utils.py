@@ -168,3 +168,21 @@ def get_ltor_masks_and_position_ids(
 
     # flip to the reference's "True = masked out" convention (utils.py:365)
     return ~attention_mask, loss_mask, position_ids
+
+
+def print_rank_0(message: str) -> None:
+    """Print once per job (ref ``pipeline_parallel/utils.py:159-168``): under
+    SPMD all devices run one program per host, so "rank 0" = host process 0."""
+    import jax
+
+    if jax.process_index() == 0:
+        print(message, flush=True)
+
+
+def print_rank_last(message: str) -> None:
+    """Ref ``:170-177`` (the reference prints on the last pipeline rank; the
+    natural multi-host analogue is the last host process)."""
+    import jax
+
+    if jax.process_index() == jax.process_count() - 1:
+        print(message, flush=True)

@@ -1,0 +1,79 @@
+"""Megatron-style argument parser for the test/example stack.
+
+Reference: ``apex/transformer/testing/arguments.py`` (808 LoC of Megatron
+flags). The TPU build's source of truth is :class:`GPTConfig`; this parser
+exposes the subset of flags the test stack actually exercises and converts
+them to a config + parallel sizes, so reference-shaped test invocations
+(``--tensor-model-parallel-size 2 --pipeline-model-parallel-size 2 ...``)
+keep working.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+
+from apex_tpu.transformer.testing.standalone_gpt import GPTConfig
+
+
+def parse_args(argv: Optional[Sequence[str]] = None
+               ) -> argparse.Namespace:
+    p = argparse.ArgumentParser(description="apex_tpu transformer test args")
+    g = p.add_argument_group("model")
+    g.add_argument("--num-layers", type=int, default=12)
+    g.add_argument("--hidden-size", type=int, default=768)
+    g.add_argument("--num-attention-heads", type=int, default=12)
+    g.add_argument("--seq-length", type=int, default=1024)
+    g.add_argument("--max-position-embeddings", type=int, default=None)
+    g.add_argument("--vocab-size", type=int, default=50304)
+    g.add_argument("--ffn-hidden-size", type=int, default=None)
+
+    g = p.add_argument_group("parallel")
+    g.add_argument("--tensor-model-parallel-size", type=int, default=1)
+    g.add_argument("--pipeline-model-parallel-size", type=int, default=1)
+    g.add_argument("--virtual-pipeline-model-parallel-size", type=int,
+                   default=None)
+    g.add_argument("--sequence-parallel-size", type=int, default=1)
+
+    g = p.add_argument_group("training")
+    g.add_argument("--micro-batch-size", type=int, default=1)
+    g.add_argument("--global-batch-size", type=int, default=8)
+    g.add_argument("--lr", type=float, default=1e-4)
+    g.add_argument("--fp16", action="store_true")
+    g.add_argument("--bf16", action="store_true")
+    g.add_argument("--no-activation-checkpoint", action="store_true",
+                   dest="no_remat")
+    return p.parse_args(argv)
+
+
+def args_to_config(args: argparse.Namespace) -> GPTConfig:
+    """Namespace -> :class:`GPTConfig` (the dataclass the models consume)."""
+    dtype = jnp.float32
+    if args.bf16:
+        dtype = jnp.bfloat16
+    elif args.fp16:
+        dtype = jnp.float16
+    hidden = args.hidden_size
+    ffn = args.ffn_hidden_size or 4 * hidden
+    if ffn % hidden:
+        raise ValueError("ffn_hidden_size must be a multiple of hidden_size")
+    return GPTConfig(
+        vocab_size=args.vocab_size,
+        max_seq=args.max_position_embeddings or args.seq_length,
+        hidden=hidden,
+        num_layers=args.num_layers,
+        num_heads=args.num_attention_heads,
+        ffn_mult=ffn // hidden,
+        dtype=dtype,
+        remat=not args.no_remat,
+    )
+
+
+def parallel_sizes(args: argparse.Namespace) -> Tuple[int, int, int]:
+    """(tp, pp, sp) from the namespace."""
+    return (args.tensor_model_parallel_size,
+            args.pipeline_model_parallel_size,
+            args.sequence_parallel_size)

@@ -1,0 +1,57 @@
+"""Shared test fixtures for the transformer stack.
+
+Reference: ``apex/transformer/testing/commons.py`` — ``initialize_distributed``
+(:105, TCP init from RANK/WORLD_SIZE) and ``fwd_step_func`` (:60) used by all
+L0 transformer tests.
+
+TPU analogue: "distributed init" is mesh construction (single process, all
+devices — real chips or ``--xla_force_host_platform_device_count`` fakes),
+and the forward-step fixture is a loss closure over the standalone GPT.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.transformer import parallel_state
+from apex_tpu.transformer.testing.standalone_gpt import GPTConfig
+
+
+def initialize_distributed(tp: int = 1, pp: int = 1, sp: int = 1,
+                           vp: Optional[int] = None):
+    """Build the mesh + parallel_state (ref commons.py:105-135; world size =
+    visible devices, env-free)."""
+    return parallel_state.initialize_model_parallel(
+        tensor_model_parallel_size_=tp,
+        pipeline_model_parallel_size_=pp,
+        sequence_parallel_size_=sp,
+        virtual_pipeline_model_parallel_size_=vp,
+    )
+
+
+def set_random_seed(seed: int) -> jax.Array:
+    """Ref commons set_random_seed: one PRNGKey, split per use."""
+    return jax.random.PRNGKey(seed)
+
+
+def make_test_batch(key, cfg: GPTConfig, batch: int
+                    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Random (tokens, shifted-target) pair for LM steps."""
+    tokens = jax.random.randint(key, (batch, cfg.max_seq), 0, cfg.vocab_size)
+    return tokens, jnp.roll(tokens, -1, axis=1)
+
+
+def fwd_step_func(cfg: GPTConfig):
+    """Ref commons.py:60 — returns ``f(params, batch) -> loss`` over the
+    standalone GPT (call inside a mesh program)."""
+    from apex_tpu.transformer.testing.standalone_gpt import gpt_loss
+
+    def f(params, batch):
+        tokens, targets = batch
+        return gpt_loss(params, tokens, targets, cfg)
+
+    return f

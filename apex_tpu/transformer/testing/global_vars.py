@@ -1,0 +1,30 @@
+"""Global args registry for the transformer test stack.
+
+Reference: ``apex/transformer/testing/global_vars.py:270`` — Megatron-style
+singletons (``get_args``/``set_global_variables``). Kept minimal: the real
+configuration system is :class:`apex_tpu.transformer.testing.GPTConfig`
+(SURVEY §5: unify the reference's three config systems into dataclasses);
+this registry only serves ported test code that expects ``get_args()``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+_GLOBAL_ARGS: Optional[Any] = None
+
+
+def set_args(args: Any) -> None:
+    global _GLOBAL_ARGS
+    _GLOBAL_ARGS = args
+
+
+def get_args() -> Any:
+    if _GLOBAL_ARGS is None:
+        raise RuntimeError("global args not initialized (call set_args)")
+    return _GLOBAL_ARGS
+
+
+def destroy_global_vars() -> None:
+    global _GLOBAL_ARGS
+    _GLOBAL_ARGS = None

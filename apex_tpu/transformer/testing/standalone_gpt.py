@@ -285,29 +285,40 @@ def gpt_loss(params, tokens, targets, cfg: GPTConfig):
 # ---------------------------------------------------------------------------
 # pipeline wiring (PipelineSpec contract, schedules/common.py)
 
-def gpt_pipeline_params(rng, cfg: GPTConfig, pp: int) -> Pytree:
+def gpt_pipeline_params(rng, cfg: GPTConfig, pp: int,
+                        vp: Optional[int] = None) -> Pytree:
     """Re-group :func:`init_gpt_params` into the pipeline driver's
-    ``{"embed", "stages" [pp, L/pp, ...], "head"}`` layout. The LM head is
-    untied across stages (ref: the embedding-group grad allreduce; see
-    schedules/common.py docstring for why tying is a non-issue here only when
-    embed and head share a param — across stages they cannot)."""
-    if cfg.num_layers % pp:
-        raise ValueError("num_layers must be divisible by pp")
+    ``{"embed", "stages" [pp, L/pp, ...], "head"}`` layout — or
+    ``[vp, pp, L/(vp·pp), ...]`` for the interleaved schedule (chunk ``v`` on
+    stage ``s`` holds depth block ``v·pp + s``, the Megatron interleaved
+    assignment). The LM head is untied across stages (ref: the
+    embedding-group grad allreduce; see schedules/common.py docstring for why
+    tying is a non-issue here only when embed and head share a param — across
+    stages they cannot)."""
+    chunks = pp * (vp or 1)
+    if cfg.num_layers % chunks:
+        raise ValueError("num_layers must be divisible by pp * vp")
     cfg_untied = dataclasses.replace(cfg, tie_embeddings=False)
     flat = init_gpt_params(rng, cfg_untied)
-    stages = jax.tree.map(
-        lambda x: x.reshape((pp, cfg.num_layers // pp) + x.shape[1:]),
-        flat["layers"])
+    per = cfg.num_layers // chunks
+    if vp is None:
+        stages = jax.tree.map(
+            lambda x: x.reshape((pp, per) + x.shape[1:]), flat["layers"])
+    else:
+        stages = jax.tree.map(
+            lambda x: x.reshape((vp, pp, per) + x.shape[1:]), flat["layers"])
     return {"embed": flat["embed"], "stages": stages, "head": flat["head"]}
 
 
-def gpt_pipeline_specs_tree(cfg: GPTConfig) -> Pytree:
+def gpt_pipeline_specs_tree(cfg: GPTConfig, interleaved: bool = False
+                            ) -> Pytree:
     """PartitionSpecs for :func:`gpt_pipeline_params`."""
     from apex_tpu.parallel.mesh import PP_AXIS
 
+    lead = (None, PP_AXIS) if interleaved else (PP_AXIS,)
     base = gpt_param_specs(
         dataclasses.replace(cfg, tie_embeddings=False),
-        extra_layer_lead=(PP_AXIS,))
+        extra_layer_lead=lead)
     return {"embed": base["embed"], "stages": base["layers"],
             "head": base["head"]}
 

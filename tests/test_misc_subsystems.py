@@ -137,3 +137,39 @@ def test_checkpoint_round_trip(tmp_path):
     np.testing.assert_array_equal(np.asarray(restored["w"]),
                                   np.asarray(state["w"]))
     assert int(np.asarray(restored["opt"]["count"])) == 3
+
+
+def test_arguments_to_config():
+    from apex_tpu.transformer.testing.arguments import (
+        args_to_config, parallel_sizes, parse_args)
+
+    ns = parse_args(["--num-layers", "4", "--hidden-size", "64",
+                     "--num-attention-heads", "4", "--seq-length", "32",
+                     "--vocab-size", "128", "--bf16",
+                     "--tensor-model-parallel-size", "2",
+                     "--pipeline-model-parallel-size", "2"])
+    cfg = args_to_config(ns)
+    assert cfg.num_layers == 4 and cfg.hidden == 64
+    assert cfg.dtype == jnp.bfloat16
+    assert parallel_sizes(ns) == (2, 2, 1)
+
+
+def test_global_vars_registry():
+    from apex_tpu.transformer.testing import global_vars as gv
+
+    gv.destroy_global_vars()
+    with pytest.raises(RuntimeError):
+        gv.get_args()
+    gv.set_args({"x": 1})
+    assert gv.get_args() == {"x": 1}
+    gv.destroy_global_vars()
+
+
+def test_autocast_utils():
+    from apex_tpu._autocast_utils import (
+        _cast_if_autocast_enabled, _get_autocast_dtypes)
+
+    assert _get_autocast_dtypes()[0] == jnp.bfloat16
+    out = _cast_if_autocast_enabled(jnp.ones((2,), jnp.float32),
+                                    jnp.asarray([1], jnp.int32))
+    assert out[0].dtype == jnp.bfloat16 and out[1].dtype == jnp.int32

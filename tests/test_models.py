@@ -193,3 +193,31 @@ def test_gpt_sequence_parallel_matches():
     )(params, tokens, targets)
     l_1 = _loss_on_mesh(build_mesh(tp=1, dp=8), params, tokens, targets)
     np.testing.assert_allclose(float(l_sp), float(l_1), rtol=1e-3)
+
+
+def test_gpt_pipeline_interleaved_matches_sequential():
+    from apex_tpu.transformer.pipeline_parallel.schedules import (
+        forward_backward_pipelining_with_interleaving,
+    )
+
+    cfg = dataclasses.replace(CFG, num_layers=4, tie_embeddings=False)
+    pp, vp = 2, 2
+    params = gpt_pipeline_params(jax.random.PRNGKey(10), cfg, pp=pp, vp=vp)
+    tokens, targets = _batch(jax.random.PRNGKey(11))
+    mesh = build_mesh(tp=2, pp=pp, dp=2)
+    spec = gpt_pipeline_spec(cfg)
+    loss, grads = forward_backward_pipelining_with_interleaving(
+        spec, params, (tokens, targets), num_microbatches=2,
+        virtual_pipeline_size=vp, mesh=mesh,
+        params_specs=gpt_pipeline_specs_tree(cfg, interleaved=True),
+        data_spec=P(None, "dp"), remat=False,
+    )
+    # sequential: depth order is chunk-major (v*pp + s), i.e. reshape back
+    flat_layers = jax.tree.map(
+        lambda x: x.reshape((-1,) + x.shape[3:]), params["stages"])
+    flat = {"embed": params["embed"], "layers": flat_layers,
+            "head": params["head"]}
+    want = _loss_on_mesh(build_mesh(tp=1, dp=8), flat, tokens, targets, cfg)
+    np.testing.assert_allclose(float(loss), float(want), rtol=1e-4)
+    for leaf in jax.tree.leaves(grads):
+        assert np.all(np.isfinite(np.asarray(leaf)))
