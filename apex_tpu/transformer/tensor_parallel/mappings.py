@@ -72,6 +72,25 @@ def _split(x, axis_name: str):
     return lax.dynamic_slice_in_dim(x, rank * chunk, chunk, axis=x.ndim - 1)
 
 
+def pvary_like(w, ref):
+    """Mark ``w`` varying over every mesh axis ``ref`` varies on (identity
+    value-wise; transpose = psum over those axes). Required before feeding a
+    replicated parameter together with sharded activations into a
+    ``custom_vjp`` op: the opaque vjp rule hides the linearity, so
+    shard_map's automatic invariant-input reduction cannot fire — this makes
+    the reduction explicit at the pvary transpose, over exactly the axes the
+    cotangent (which inherits the activations' vma) will carry."""
+    try:
+        want = set(jax.typeof(ref).vma)
+        have = set(jax.typeof(w).vma)
+    except (AttributeError, TypeError):
+        return w
+    missing = tuple(sorted(want - have))
+    if missing:
+        w = lax.pcast(w, missing, to="varying")
+    return w
+
+
 def copy_to_tensor_model_parallel_region(x, axis_name: str = TP_AXIS):
     """Identity fwd / all-reduce bwd (ref _CopyToModelParallelRegion,
     mappings.py:77-92). Feeds activations into a column-parallel matmul."""
