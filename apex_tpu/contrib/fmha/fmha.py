@@ -1,21 +1,27 @@
-"""Segment-masked attention over token-packed batches (see package doc)."""
+"""Packed-varlen attention (see package doc).
+
+Reference driver: ``apex/contrib/fmha/fmha.py:33-76`` — packed ``qkv``
+(total, 3, heads, d) + ``cu_seqlens`` prefix sums, dispatched to the
+``fmhalib`` CUDA kernels (seqlen <= 512 only). Here the packed batch maps
+to the segment-id convention of ``ops/attention_varlen.py``: the Pallas
+kernels mask cross-segment pairs in-tile and skip non-intersecting blocks
+outright, with no sequence-length limit and no dense (total, total) mask.
+"""
 
 from __future__ import annotations
 
-import math
 from typing import Optional
 
 import flax.linen as nn
-import jax
 import jax.numpy as jnp
 
-from apex_tpu.ops.attention import attention_reference
+from apex_tpu.ops.attention_varlen import flash_attention_varlen
 
 
 def cu_seqlens_to_segment_ids(cu_seqlens, total: int):
     """[0, l1, l1+l2, ...] -> per-token sequence index (ref fmha.py cu_seqlens
-    convention). Tokens at/after the last boundary get segment -1 (padding),
-    which matches nothing — including other padding — in the mask."""
+    convention). Tokens at/after the last boundary get segment -1 (padding):
+    they attend to nothing — including other padding — and output zero."""
     positions = jnp.arange(total)
     # segment of token t = number of boundaries <= t, minus 1
     seg = jnp.sum(positions[:, None] >= cu_seqlens[None, :-1], axis=1) - 1
@@ -24,23 +30,21 @@ def cu_seqlens_to_segment_ids(cu_seqlens, total: int):
 
 
 def fmha_packed(qkv, cu_seqlens, *, causal: bool = False,
-                scale: Optional[float] = None):
+                scale: Optional[float] = None,
+                use_pallas: Optional[bool] = None):
     """Attention over a packed batch.
 
     ``qkv``: (total_tokens, 3, heads, head_dim) — the reference's interleaved
     layout (``fmha.py:33``). ``cu_seqlens``: (batch+1,) int32 prefix sums.
-    Returns (total_tokens, heads, head_dim).
+    Returns (total_tokens, heads, head_dim); padding rows are zero.
     """
     total, three, h, d = qkv.shape
     if three != 3:
         raise ValueError(f"qkv must be (total, 3, heads, d), got {qkv.shape}")
-    seg = cu_seqlens_to_segment_ids(cu_seqlens, total)
-    # cross-segment (and any-padding) pairs masked out
-    mask = (seg[:, None] != seg[None, :]) | (seg[:, None] < 0)
-    if causal:
-        mask = mask | (jnp.arange(total)[None, :] > jnp.arange(total)[:, None])
+    seg = cu_seqlens_to_segment_ids(cu_seqlens, total)[None]  # (1, total)
     q, k, v = (qkv[:, i].transpose(1, 0, 2)[None] for i in range(3))
-    o = attention_reference(q, k, v, mask=mask[None, None], scale=scale)
+    o = flash_attention_varlen(q, k, v, seg, causal=causal, scale=scale,
+                               use_pallas=use_pallas)
     return o[0].transpose(1, 0, 2)
 
 

@@ -48,12 +48,14 @@ from apex_tpu.ops._pallas_util import sds as _sds  # noqa: E402
 # for arbitrary masks / unaligned shapes — XLA fuses it into a few loops).
 
 def attention_reference(q, k, v, mask=None, scale: Optional[float] = None,
-                        causal: bool = False):
+                        causal: bool = False, dropout_rate: float = 0.0,
+                        dropout_key=None):
     """Plain softmax(QKᵀ·scale)V in fp32 accumulation.
 
     ``mask``: broadcastable boolean over (..., sq, sk), True = masked OUT
     (the reference convention, ``apex/contrib/fmha/fmha.py`` cu_seqlens
-    padding → masked). Returns q.dtype.
+    padding → masked). Optional probability dropout on the softmax (the
+    reference kernels' fused dropout, here materialized). Returns q.dtype.
     """
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
@@ -69,6 +71,11 @@ def attention_reference(q, k, v, mask=None, scale: Optional[float] = None,
     if mask is not None:
         s = jnp.where(mask, NEG_INF, s)
     p = jax.nn.softmax(s, axis=-1)
+    if dropout_rate > 0.0:
+        if dropout_key is None:
+            raise ValueError("dropout_rate > 0 needs dropout_key")
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_rate, p.shape)
+        p = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
     o = jnp.einsum("...qk,...kd->...qd", p, v32)
     return o.astype(q.dtype)
 
@@ -76,8 +83,19 @@ def attention_reference(q, k, v, mask=None, scale: Optional[float] = None,
 # ---------------------------------------------------------------------------
 # Pallas forward
 
-def _fa_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-                   *, scale, causal, block_q, block_k, nk):
+def _dropout_keep(seed_ref, rate, block_q, block_k, q_i, kv_i):
+    """Deterministic per-(batch*head, q-block, k-block) keep mask; the same
+    seeding in forward and both backward kernels regenerates the identical
+    mask (the philox-counter scheme of the reference's fmhalib dropout)."""
+    pltpu.prng_seed(seed_ref[0], pl.program_id(0), q_i, kv_i)
+    bits = pltpu.prng_random_bits((block_q, block_k))
+    thresh = jnp.uint32(min(int(rate * 4294967296.0), 4294967295))
+    return jax.lax.bitcast_convert_type(bits, jnp.uint32) >= thresh
+
+
+def _fa_fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                   m_scr, l_scr, acc_scr,
+                   *, scale, causal, block_q, block_k, nk, dropout_rate):
     q_i = pl.program_id(1)
     kv_i = pl.program_id(2)
 
@@ -109,7 +127,13 @@ def _fa_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         corr = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new)
+        # l accumulates the UNdropped p: normalization precedes dropout,
+        # so the final divide yields dropout(softmax(s)) @ v exactly
         l_new = corr * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        if dropout_rate > 0.0:
+            keep = _dropout_keep(seed_ref, dropout_rate, block_q, block_k,
+                                 q_i, kv_i)
+            p = jnp.where(keep, p * (1.0 / (1.0 - dropout_rate)), 0.0)
         acc_scr[:] = acc_scr[:] * corr + jax.lax.dot(
             p, v, preferred_element_type=jnp.float32)
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
@@ -128,18 +152,22 @@ def _fa_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         lse_ref[0] = jnp.where(l == 0.0, NEG_INF, m_scr[:, :1] + jnp.log(safe_l))
 
 
-def _fa_fwd(q3, k3, v3, scale, causal, block_q, block_k, interpret):
+def _fa_fwd(q3, k3, v3, scale, causal, block_q, block_k, interpret,
+            dropout_rate=0.0, seed=None):
     bh, sq, d = q3.shape
     sk = k3.shape[1]
     nq = sq // block_q
     nk = sk // block_k
+    if seed is None:
+        seed = jnp.zeros((1,), jnp.int32)
     kernel = functools.partial(
         _fa_fwd_kernel, scale=scale, causal=causal,
-        block_q=block_q, block_k=block_k, nk=nk)
+        block_q=block_q, block_k=block_k, nk=nk, dropout_rate=dropout_rate)
     o, lse = pl.pallas_call(
         kernel,
         grid=(bh, nq, nk),
         in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
@@ -160,7 +188,7 @@ def _fa_fwd(q3, k3, v3, scale, causal, block_q, block_k, interpret):
         compiler_params=None if interpret else pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(q3, k3, v3)
+    )(seed, q3, k3, v3)
     return o, lse
 
 
@@ -170,8 +198,9 @@ def _fa_fwd(q3, k3, v3, scale, causal, block_q, block_k, interpret):
 # saved lse — p = exp(s - lse) is already normalized, so no second pass over
 # the row is needed (the flash-attention backward identity).
 
-def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                      dq_scr, *, scale, causal, block_q, block_k, nk):
+def _fa_bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                      delta_ref, dq_ref, dq_scr,
+                      *, scale, causal, block_q, block_k, nk, dropout_rate):
     q_i = pl.program_id(1)
     kv_i = pl.program_id(2)
 
@@ -201,6 +230,10 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        if dropout_rate > 0.0:
+            keep = _dropout_keep(seed_ref, dropout_rate, block_q, block_k,
+                                 q_i, kv_i)
+            dp = jnp.where(keep, dp * (1.0 / (1.0 - dropout_rate)), 0.0)
         ds = p * (dp - delta) * scale
         dq_scr[:] += jax.lax.dot(ds, k, preferred_element_type=jnp.float32)
 
@@ -209,9 +242,9 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                       dk_ref, dv_ref, dk_scr, dv_scr,
-                       *, scale, causal, block_q, block_k, nq):
+def _fa_bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                       delta_ref, dk_ref, dv_ref, dk_scr, dv_scr,
+                       *, scale, causal, block_q, block_k, nq, dropout_rate):
     kv_i = pl.program_id(1)
     q_i = pl.program_id(2)
 
@@ -240,10 +273,20 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(kpos > qpos, NEG_INF, s)
         p = jnp.exp(s - lse)
+        if dropout_rate > 0.0:
+            keep = _dropout_keep(seed_ref, dropout_rate, block_q, block_k,
+                                 q_i, kv_i)
+            inv = 1.0 / (1.0 - dropout_rate)
+            p_v = jnp.where(keep, p * inv, 0.0)
+        else:
+            p_v = p
         dv_scr[:] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            p_v, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        if dropout_rate > 0.0:
+            dp = jnp.where(keep, dp * inv, 0.0)
         ds = p * (dp - delta) * scale
         dk_scr[:] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
@@ -255,21 +298,24 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _fa_bwd(q3, k3, v3, o3, lse, do3, scale, causal, block_q, block_k,
-            interpret):
+            interpret, dropout_rate=0.0, seed=None):
     bh, sq, d = q3.shape
     sk = k3.shape[1]
     nq = sq // block_q
     nk = sk // block_k
+    if seed is None:
+        seed = jnp.zeros((1,), jnp.int32)
     delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
                     axis=-1, keepdims=True)
 
     dq_kernel = functools.partial(
         _fa_bwd_dq_kernel, scale=scale, causal=causal,
-        block_q=block_q, block_k=block_k, nk=nk)
+        block_q=block_q, block_k=block_k, nk=nk, dropout_rate=dropout_rate)
     dq = pl.pallas_call(
         dq_kernel,
         grid=(bh, nq, nk),
         in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
@@ -283,15 +329,16 @@ def _fa_bwd(q3, k3, v3, o3, lse, do3, scale, causal, block_q, block_k,
         compiler_params=None if interpret else pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(q3, k3, v3, do3, lse, delta)
+    )(seed, q3, k3, v3, do3, lse, delta)
 
     dkv_kernel = functools.partial(
         _fa_bwd_dkv_kernel, scale=scale, causal=causal,
-        block_q=block_q, block_k=block_k, nq=nq)
+        block_q=block_q, block_k=block_k, nq=nq, dropout_rate=dropout_rate)
     dk, dv = pl.pallas_call(
         dkv_kernel,
         grid=(bh, nk, nq),
         in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
@@ -314,28 +361,34 @@ def _fa_bwd(q3, k3, v3, o3, lse, do3, scale, causal, block_q, block_k,
         compiler_params=None if interpret else pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(q3, k3, v3, do3, lse, delta)
+    )(seed, q3, k3, v3, do3, lse, delta)
     return dq, dk, dv
 
 
 # ---------------------------------------------------------------------------
 # custom_vjp plumbing over (bh, seq, d) arrays
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash3(q3, k3, v3, scale, causal, block_q, block_k, interpret):
-    o, _ = _fa_fwd(q3, k3, v3, scale, causal, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _flash3(q3, k3, v3, seed, scale, causal, block_q, block_k, interpret,
+            dropout_rate):
+    o, _ = _fa_fwd(q3, k3, v3, scale, causal, block_q, block_k, interpret,
+                   dropout_rate, seed)
     return o
 
 
-def _flash3_fwd(q3, k3, v3, scale, causal, block_q, block_k, interpret):
-    o, lse = _fa_fwd(q3, k3, v3, scale, causal, block_q, block_k, interpret)
-    return o, (q3, k3, v3, o, lse)
+def _flash3_fwd(q3, k3, v3, seed, scale, causal, block_q, block_k, interpret,
+                dropout_rate):
+    o, lse = _fa_fwd(q3, k3, v3, scale, causal, block_q, block_k, interpret,
+                     dropout_rate, seed)
+    return o, (q3, k3, v3, seed, o, lse)
 
 
-def _flash3_bwd(scale, causal, block_q, block_k, interpret, res, do3):
-    q3, k3, v3, o3, lse = res
-    return _fa_bwd(q3, k3, v3, o3, lse, do3, scale, causal, block_q, block_k,
-                   interpret)
+def _flash3_bwd(scale, causal, block_q, block_k, interpret, dropout_rate,
+                res, do3):
+    q3, k3, v3, seed, o3, lse = res
+    dq, dk, dv = _fa_bwd(q3, k3, v3, o3, lse, do3, scale, causal, block_q,
+                         block_k, interpret, dropout_rate, seed)
+    return dq, dk, dv, None
 
 
 _flash3.defvjp(_flash3_fwd, _flash3_bwd)
@@ -381,6 +434,8 @@ def flash_attention(
     block_q: int = 128,
     block_k: int = 128,
     use_pallas: Optional[bool] = None,
+    dropout_rate: float = 0.0,
+    dropout_seed=None,
 ):
     """Memory-efficient attention over (batch, heads, seq, head_dim).
 
@@ -388,11 +443,21 @@ def flash_attention(
     (ref capability: ``fmhalib`` + ``fast_multihead_attn``, without their
     seqlen ≤ 512 limit); XLA reference path for arbitrary ``mask`` or odd
     shapes. ``mask`` True = masked out.
+
+    ``dropout_rate`` > 0 applies probability dropout to the (normalized)
+    attention weights *inside* the kernel — the counter-based keep mask is
+    regenerated identically in forward and backward from ``dropout_seed``
+    (an int32 scalar/array; required when the rate is nonzero), so training
+    configs with attention dropout stay on the Pallas path. The non-pallas
+    fallback draws its own jax.random mask (same distribution, different
+    stream).
     """
     b, h, sq, d = q.shape
     sk = k.shape[2]
     if scale is None:
         scale = 1.0 / math.sqrt(d)
+    if dropout_rate > 0.0 and dropout_seed is None:
+        raise ValueError("dropout_rate > 0 needs dropout_seed")
     pallas_possible = mask is None and _pallas_ok(
         sq, sk, d, causal, allow_interpret=True)
     if use_pallas is None:
@@ -405,12 +470,20 @@ def flash_attention(
             f"(got q {q.shape}, k {k.shape}, causal={causal}, "
             f"mask={'set' if mask is not None else None})")
     if not use_pallas:
+        key = None
+        if dropout_rate > 0.0:
+            key = jax.random.PRNGKey(jnp.asarray(dropout_seed).reshape(())
+                                     .astype(jnp.uint32))
         return attention_reference(q, k, v, mask=mask, scale=scale,
-                                   causal=causal)
+                                   causal=causal, dropout_rate=dropout_rate,
+                                   dropout_key=key)
     bq = _pick_block(sq, block_q)
     bk = _pick_block(sk, block_k)
     interpret = jax.default_backend() != "tpu"
+    seed = (jnp.zeros((1,), jnp.int32) if dropout_seed is None
+            else jnp.asarray(dropout_seed, jnp.int32).reshape((1,)))
     o3 = _flash3(
         q.reshape(b * h, sq, d), k.reshape(b * h, sk, d),
-        v.reshape(b * h, sk, d), scale, causal, bq, bk, interpret)
+        v.reshape(b * h, sk, d), seed, scale, causal, bq, bk, interpret,
+        float(dropout_rate))
     return o3.reshape(b, h, sq, d)

@@ -36,7 +36,14 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from apex_tpu.ops.attention import NEG_INF, flash_attention
+from apex_tpu.ops.attention import (
+    NEG_INF,
+    _fa_bwd,
+    _fa_fwd,
+    _pallas_ok,
+    _pick_block,
+    flash_attention,
+)
 from apex_tpu.parallel.mesh import SP_AXIS
 
 
@@ -50,6 +57,7 @@ def ring_attention(
     causal: bool = False,
     scale: Optional[float] = None,
     remat_steps: bool = True,
+    impl: str = "auto",
 ):
     """Exact attention over a sequence sharded on ``axis_name``.
 
@@ -59,9 +67,42 @@ def ring_attention(
     (batch, heads, s_local, head_dim) output shard, equal to the
     corresponding slice of dense attention over the gathered sequence.
 
-    Online-softmax accumulation across ring steps: masked score entries are
-    zeroed explicitly (not via exp of -inf) so fully-masked future chunks
-    contribute exactly nothing, keeping finite arithmetic throughout.
+    ``impl``:
+
+    * ``"auto"`` (default) — the chunked-flash ring: a ``custom_vjp`` whose
+      forward merges per-chunk flash attention results by log-sum-exp and
+      whose backward makes a second ring pass, running the flash backward
+      per chunk against the saved *global* lse (so per-chunk probabilities
+      are exact global softmax columns). Causal runs skip entirely-future
+      chunks via ``lax.switch`` — ~2x fewer FLOPs at scale. Chunk math runs
+      in the Pallas kernels on TPU and as einsum elsewhere (same structure,
+      so the mesh tests exercise the real collectives + VJP).
+    * ``"scan"`` — the original einsum online-softmax scan, differentiated
+      by jax AD through the ring (reference implementation).
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if impl == "auto":
+        b, h, s_loc, d = q.shape
+        use_pallas = (jax.default_backend() == "tpu"
+                      and _pallas_ok(s_loc, s_loc, d, causal=False,
+                                     allow_interpret=False))
+        return _ring_flash(q, k, v, axis_name, causal, scale, use_pallas)
+    return _ring_scan(q, k, v, axis_name, causal, scale, remat_steps)
+
+
+def _ring_scan(
+    q, k, v,
+    axis_name: str = SP_AXIS,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    remat_steps: bool = True,
+):
+    """Online-softmax einsum ring (AD-differentiated reference).
+
+    Masked score entries are zeroed explicitly (not via exp of -inf) so
+    fully-masked future chunks contribute exactly nothing, keeping finite
+    arithmetic throughout.
     """
     n = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
@@ -117,6 +158,181 @@ def ring_attention(
         step, (k, v, m0, l0, acc0), jnp.arange(n))
     out = acc / jnp.where(l == 0.0, 1.0, l)
     return out.astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Chunked-flash ring: custom_vjp, per-chunk kernels, global-lse backward.
+
+def _vary_like_inputs(x, *refs, extra=()):
+    """pcast ``x`` to the union of the refs' varying axes plus ``extra`` —
+    scan carries must start with the vma they will acquire."""
+    try:
+        want = set(extra)
+        for r in refs:
+            want |= set(jax.typeof(r).vma)
+        missing = tuple(a for a in want if a not in jax.typeof(x).vma)
+    except (AttributeError, TypeError):
+        return x
+    return lax.pcast(x, missing, to="varying") if missing else x
+
+
+def _chunk_fwd(q, k_c, v_c, scale, causal, use_pallas):
+    """One Q-shard x K/V-chunk attention -> (o [q.dtype], lse fp32)."""
+    b, h, s, d = q.shape
+    if use_pallas:
+        q3 = q.reshape(b * h, s, d)
+        o3, lse3 = _fa_fwd(q3, k_c.reshape(b * h, s, d),
+                           v_c.reshape(b * h, s, d), scale, causal,
+                           _pick_block(s, 128), _pick_block(s, 128),
+                           interpret=False)
+        return o3.reshape(b, h, s, d), lse3[..., 0].reshape(b, h, s)
+    q32 = q.astype(jnp.float32)
+    s_ = jnp.einsum("bhqd,bhkd->bhqk", q32, k_c.astype(jnp.float32)) * scale
+    if causal:
+        pos = jnp.arange(s)
+        s_ = jnp.where(pos[None, :] > pos[:, None], NEG_INF, s_)
+    m = jnp.max(s_, axis=-1, keepdims=True)
+    p = jnp.exp(s_ - m)
+    p = jnp.where(s_ <= NEG_INF / 2, 0.0, p)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, v_c.astype(jnp.float32))
+    o = o / jnp.where(l == 0.0, 1.0, l)
+    lse = jnp.where(l[..., 0] == 0.0, NEG_INF, m[..., 0] + jnp.log(
+        jnp.where(l[..., 0] == 0.0, 1.0, l[..., 0])))
+    return o.astype(q.dtype), lse
+
+
+def _chunk_bwd(q, k_c, v_c, o, lse, do, delta, scale, causal, use_pallas):
+    """Per-chunk flash backward against the *global* lse -> (dq, dk, dv)
+    fp32. ``p = exp(s - lse_global)`` is the exact global softmax restricted
+    to this chunk's columns, so summing chunk contributions reproduces the
+    dense backward."""
+    b, h, s, d = q.shape
+    if use_pallas:
+        sh = (b * h, s, d)
+        dq3, dk3, dv3 = _fa_bwd(
+            q.reshape(sh), k_c.reshape(sh), v_c.reshape(sh), o.reshape(sh),
+            lse.reshape(b * h, s, 1), do.reshape(sh), scale, causal,
+            _pick_block(s, 128), _pick_block(s, 128), interpret=False)
+        return (dq3.reshape(b, h, s, d).astype(jnp.float32),
+                dk3.reshape(b, h, s, d).astype(jnp.float32),
+                dv3.reshape(b, h, s, d).astype(jnp.float32))
+    q32 = q.astype(jnp.float32)
+    k32 = k_c.astype(jnp.float32)
+    v32 = v_c.astype(jnp.float32)
+    do32 = do.astype(jnp.float32)
+    s_ = jnp.einsum("bhqd,bhkd->bhqk", q32, k32) * scale
+    if causal:
+        pos = jnp.arange(s)
+        s_ = jnp.where(pos[None, :] > pos[:, None], NEG_INF, s_)
+    p = jnp.exp(s_ - lse[..., None])
+    p = jnp.where(s_ <= NEG_INF / 2, 0.0, p)
+    dv = jnp.einsum("bhqk,bhqd->bhkd", p, do32)
+    dp = jnp.einsum("bhqd,bhkd->bhqk", do32, v32)
+    ds = p * (dp - delta) * scale
+    dq = jnp.einsum("bhqk,bhkd->bhqd", ds, k32)
+    dk = jnp.einsum("bhqk,bhqd->bhkd", ds, q32)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _ring_flash(q, k, v, axis_name, causal, scale, use_pallas):
+    o, _ = _ring_flash_fwd(q, k, v, axis_name, causal, scale, use_pallas)
+    return o
+
+
+def _branch_idx(origin, my, causal):
+    # 0 = full chunk, 1 = diagonal (in-chunk causal), 2 = entirely future
+    if not causal:
+        return jnp.int32(0)
+    return jnp.where(origin == my, jnp.int32(1),
+                     jnp.where(origin < my, jnp.int32(0), jnp.int32(2)))
+
+
+def _ring_flash_fwd(q, k, v, axis_name, causal, scale, use_pallas):
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    b, h, s_loc, d = q.shape
+
+    def full_f(q, k_c, v_c):
+        return _chunk_fwd(q, k_c, v_c, scale, False, use_pallas)
+
+    def diag_f(q, k_c, v_c):
+        return _chunk_fwd(q, k_c, v_c, scale, True, use_pallas)
+
+    def skip_f(q, k_c, v_c):
+        # match the compute branches' varying axes (switch unifies types)
+        return (_vary_like_inputs(jnp.zeros_like(q), q, k_c),
+                _vary_like_inputs(
+                    jnp.full((b, h, s_loc), NEG_INF, jnp.float32), q, k_c))
+
+    def step(carry, t):
+        k_c, v_c, o_bar, lse_run = carry
+        origin = (my - t) % n
+        o_c, lse_c = lax.switch(_branch_idx(origin, my, causal),
+                                (full_f, diag_f, skip_f), q, k_c, v_c)
+        lse_new = jnp.logaddexp(lse_run, lse_c)
+        w_old = jnp.exp(lse_run - lse_new)[..., None]
+        w_new = jnp.exp(lse_c - lse_new)[..., None]
+        o_bar = o_bar * w_old + o_c.astype(jnp.float32) * w_new
+        k_c = lax.ppermute(k_c, axis_name, _ring_perm(n))
+        v_c = lax.ppermute(v_c, axis_name, _ring_perm(n))
+        return (k_c, v_c, o_bar, lse_new), None
+
+    o0 = _vary_like_inputs(jnp.zeros((b, h, s_loc, d), jnp.float32),
+                           q, k, extra=(axis_name,))
+    lse0 = _vary_like_inputs(jnp.full((b, h, s_loc), NEG_INF, jnp.float32),
+                             q, k, extra=(axis_name,))
+    (_, _, o_bar, lse), _ = lax.scan(step, (k, v, o0, lse0), jnp.arange(n))
+    o = o_bar.astype(q.dtype)
+    return o, (q, k, v, o, lse)
+
+
+def _ring_flash_bwd(axis_name, causal, scale, use_pallas, res, do):
+    q, k, v, o, lse = res
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    b, h, s_loc, d = q.shape
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1, keepdims=True)
+
+    def full_f(q, k_c, v_c):
+        return _chunk_bwd(q, k_c, v_c, o, lse, do, delta, scale, False,
+                          use_pallas)
+
+    def diag_f(q, k_c, v_c):
+        return _chunk_bwd(q, k_c, v_c, o, lse, do, delta, scale, True,
+                          use_pallas)
+
+    def skip_f(q, k_c, v_c):
+        z = _vary_like_inputs(jnp.zeros((b, h, s_loc, d), jnp.float32),
+                              q, k_c, do)
+        return z, z, z
+
+    def step(carry, t):
+        k_c, v_c, dq_acc, dk_acc, dv_acc = carry
+        origin = (my - t) % n
+        dq_c, dk_c, dv_c = lax.switch(_branch_idx(origin, my, causal),
+                                      (full_f, diag_f, skip_f), q, k_c, v_c)
+        dq_acc = dq_acc + dq_c
+        # dk/dv accumulators ride the same rotation as their K/V chunk, so
+        # after n steps each lands back on its owner fully accumulated
+        dk_acc = lax.ppermute(dk_acc + dk_c, axis_name, _ring_perm(n))
+        dv_acc = lax.ppermute(dv_acc + dv_c, axis_name, _ring_perm(n))
+        k_c = lax.ppermute(k_c, axis_name, _ring_perm(n))
+        v_c = lax.ppermute(v_c, axis_name, _ring_perm(n))
+        return (k_c, v_c, dq_acc, dk_acc, dv_acc), None
+
+    def z0():
+        return _vary_like_inputs(jnp.zeros((b, h, s_loc, d), jnp.float32),
+                                 q, k, do, extra=(axis_name,))
+
+    (_, _, dq, dk, dv), _ = lax.scan(
+        step, (k, v, z0(), z0(), z0()), jnp.arange(n))
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
 
 
 def ulysses_attention(

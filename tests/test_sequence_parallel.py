@@ -31,9 +31,18 @@ def _mesh():
     return build_mesh(tp=1, pp=1, sp=8, dp=1)
 
 
+def _ring_auto(q, k, v, causal=False):
+    return ring_attention(q, k, v, causal=causal, impl="auto")
+
+
+def _ring_scan_impl(q, k, v, causal=False):
+    return ring_attention(q, k, v, causal=causal, impl="scan")
+
+
 @pytest.mark.parametrize("causal", [False, True])
-@pytest.mark.parametrize("fn", [ring_attention, ulysses_attention],
-                         ids=["ring", "ulysses"])
+@pytest.mark.parametrize("fn", [_ring_auto, _ring_scan_impl,
+                                ulysses_attention],
+                         ids=["ring-flash", "ring-scan", "ulysses"])
 def test_sp_attention_matches_dense(causal, fn):
     q, k, v = _qkv(jax.random.PRNGKey(0))
     mesh = _mesh()
@@ -48,8 +57,9 @@ def test_sp_attention_matches_dense(causal, fn):
         np.asarray(sharded), np.asarray(dense), atol=2e-5)
 
 
-@pytest.mark.parametrize("fn", [ring_attention, ulysses_attention],
-                         ids=["ring", "ulysses"])
+@pytest.mark.parametrize("fn", [_ring_auto, _ring_scan_impl,
+                                ulysses_attention],
+                         ids=["ring-flash", "ring-scan", "ulysses"])
 def test_sp_attention_grads_match_dense(fn):
     q, k, v = _qkv(jax.random.PRNGKey(1))
     mesh = _mesh()
