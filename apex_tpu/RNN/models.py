@@ -22,7 +22,7 @@ class _Cell(nn.Module):
 
     hidden_size: int
     gates: int
-    step_fn: Callable  # (pre_gates, carry) -> (new_carry, output)
+    step_fn: Callable  # (input_gates, hidden_gates, carry) -> (carry, out)
     carry_size: int = 1  # number of state tensors (h; or h,c)
     dtype: Any = jnp.float32
 
@@ -45,35 +45,39 @@ class _Cell(nn.Module):
 
         def step(carry, xg_t):
             h = carry[0]
-            pre = xg_t + h @ w_h
-            return self.step_fn(pre, carry)
+            # input and hidden gate contributions kept separate: GRU's
+            # candidate gate applies the reset gate to the hidden part only
+            return self.step_fn(xg_t, h @ w_h, carry)
 
         carry, ys = lax.scan(step, init_carry, xg.swapaxes(0, 1))
         return ys.swapaxes(0, 1), carry
 
 
-def _lstm_step(pre, carry):
+def _lstm_step(xg, hg, carry):
     h, c = carry
-    i, f, g, o = jnp.split(pre, 4, axis=-1)
+    i, f, g, o = jnp.split(xg + hg, 4, axis=-1)
     i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
     c_new = f * c + i * jnp.tanh(g)
     h_new = o * jnp.tanh(c_new)
     return (h_new, c_new), h_new
 
 
-def _gru_step(pre, carry):
-    # fused r,z from the joint GEMM; candidate uses the reset gate
+def _gru_step(xg, hg, carry):
+    # torch.nn.GRUCell semantics (the reference re-exports torch's GRU):
+    # r gates only the hidden-path term of the candidate. The single fused
+    # bias lives on the input path (b = b_ih + b_hh for r/z; b_hn ≡ 0).
     (h,) = carry
-    r, z, n = jnp.split(pre, 3, axis=-1)
-    r, z = jax.nn.sigmoid(r), jax.nn.sigmoid(z)
-    n = jnp.tanh(n * r)  # ref cells.py GRU variant: reset applied to pre-act
+    xr, xz, xn = jnp.split(xg, 3, axis=-1)
+    hr, hz, hn = jnp.split(hg, 3, axis=-1)
+    r, z = jax.nn.sigmoid(xr + hr), jax.nn.sigmoid(xz + hz)
+    n = jnp.tanh(xn + r * hn)
     h_new = (1 - z) * n + z * h
     return (h_new,), h_new
 
 
 def _rnn_step(act):
-    def step(pre, carry):
-        h_new = act(pre)
+    def step(xg, hg, carry):
+        h_new = act(xg + hg)
         return (h_new,), h_new
 
     return step
@@ -171,8 +175,7 @@ class _MLSTMCell(nn.Module):
             xg_t, xm_t = inp
             h, c = carry
             m = xm_t * (h @ w_mh)
-            pre = xg_t + m @ w_h
-            return _lstm_step(pre, (h, c))
+            return _lstm_step(xg_t, m @ w_h, (h, c))
 
         carry, ys = lax.scan(step, init_carry,
                              (xg.swapaxes(0, 1), xm.swapaxes(0, 1)))

@@ -76,21 +76,31 @@ class BatchLoader:
             for idx in index_batches:
                 yield self.source[np.asarray(idx)]
             return
-        pending = None  # (ticket, out)
-        for idx in index_batches:
-            out = np.empty((len(idx),) + self.item_shape, self.source.dtype)
-            ticket = self._submit(np.asarray(idx), out)
+        pending = None  # (ticket, out) — out must outlive the ticket
+        try:
+            for idx in index_batches:
+                out = np.empty((len(idx),) + self.item_shape,
+                               self.source.dtype)
+                ticket = self._submit(np.asarray(idx), out)
+                prev, pending = pending, (ticket, out)
+                if prev is not None:
+                    p_ticket, p_out = prev
+                    if self._lib.al_wait(self._handle, p_ticket) != 0:
+                        raise IndexError("batch indices out of range")
+                    yield p_out
             if pending is not None:
                 p_ticket, p_out = pending
+                pending = None
                 if self._lib.al_wait(self._handle, p_ticket) != 0:
                     raise IndexError("batch indices out of range")
                 yield p_out
-            pending = (ticket, out)
-        if pending is not None:
-            p_ticket, p_out = pending
-            if self._lib.al_wait(self._handle, p_ticket) != 0:
-                raise IndexError("batch indices out of range")
-            yield p_out
+        finally:
+            # Consumer abandoned the generator (break / GeneratorExit) or an
+            # index error fired while a worker was still memcpy-ing into the
+            # in-flight buffer: block until it settles so `out` cannot be
+            # freed under the worker's feet.
+            if pending is not None:
+                self._lib.al_wait(self._handle, pending[0])
 
     def close(self):
         if self._handle is not None and self._lib is not None:

@@ -64,11 +64,13 @@ class FP16_Optimizer:
         state: FP16OptimizerState,
         max_grad_norm: Optional[float] = None,
     ) -> Tuple[Pytree, FP16OptimizerState, jnp.ndarray]:
-        """unscale → (clip) → inner step on masters → model-dtype params.
+        """unscale → (clip) → inner step on fp32 masters.
 
-        Returns ``(model_params, new_state, skipped)`` — ``skipped`` is the
-        traced overflow flag (ref "skip step on overflow",
-        fp16_optimizer.py:160-200).
+        Returns ``(master_params, new_state, skipped)`` — the fp32 masters,
+        NOT model-dtype params; call :meth:`model_params` to refresh the
+        half-precision model copy (the ref's explicit
+        ``_master_params_to_model_params`` pass). ``skipped`` is the traced
+        overflow flag (ref "skip step on overflow", fp16_optimizer.py:160-200).
         """
         grads32, found_inf = self.loss_scaler.unscale(
             model_grads, state.scaler)

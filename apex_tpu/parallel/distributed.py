@@ -73,38 +73,6 @@ class DistributedDataParallel:
         self.gradient_predivide_factor = gradient_predivide_factor
         self.allreduce_always_fp32 = allreduce_always_fp32
         self.flat_buckets = flat_buckets
-        self._sync_enabled = True
-
-    # ref distributed.py:275-281 enable/disable_allreduce (no_sync)
-    def enable_allreduce(self):
-        self._sync_enabled = True
-
-    def disable_allreduce(self):
-        self._sync_enabled = False
-
-    class _NoSync:
-        def __init__(self, ddp):
-            self.ddp = ddp
-
-        def __enter__(self):
-            self.ddp.disable_allreduce()
-
-        def __exit__(self, *a):
-            self.ddp.enable_allreduce()
-
-    def no_sync(self):
-        """Context manager: skip the allreduce for grad accumulation
-        (torch-DDP-style ``no_sync``; ref enable/disable_allreduce).
-
-        .. warning:: The flag is read at **trace time**. It must be active
-           while the step function is traced (i.e. wrap the first call /
-           construction of the accumulation step), not around calls to an
-           already-jitted function — a cached executable keeps whichever
-           behavior it was traced with. For a single jitted step that both
-           accumulates and syncs, pass ``enabled`` explicitly to
-           :meth:`average_gradients` and thread it as a static argument so
-           jit specializes both variants."""
-        return DistributedDataParallel._NoSync(self)
 
     def _world(self):
         # inside a mesh program the axis size is static
@@ -125,14 +93,22 @@ class DistributedDataParallel:
             lambda p: lax.pcast(p, self.axis, to="varying"), params
         )
 
-    def average_gradients(self, grads: Any, enabled: Optional[bool] = None) -> Any:
+    def average_gradients(self, grads: Any, enabled: bool = True) -> Any:
         """The allreduce_bucket pipeline (ref ``distributed.py:425-470``):
         [flatten] → [fp32 cast] → predivide → psum → postdivide → unflatten.
         Must be called inside a mesh program with ``self.axis`` bound.
-        ``enabled``: static python bool overriding the no_sync flag (see
-        :meth:`no_sync` for the trace-time caveat)."""
-        if enabled is None:
-            enabled = self._sync_enabled
+
+        ``enabled``: static python bool — the functional form of the ref's
+        ``disable_allreduce``/torch-DDP ``no_sync``. There is deliberately no
+        stateful context-manager variant: under ``jit`` a mutable flag is
+        frozen at trace time, so an accumulate-then-sync loop must instead
+        trace two specializations (``enabled=False`` for accumulation
+        microbatches, ``enabled=True`` for the boundary step) or accumulate
+        on device and allreduce once — see
+        ``pipeline_parallel/schedules/fwd_bwd_no_pipelining.py``."""
+        if not isinstance(enabled, bool):
+            raise TypeError(
+                f"enabled must be a static python bool, got {enabled!r}")
         if not enabled:
             return grads
         leaves, treedef = jax.tree_util.tree_flatten(grads)

@@ -31,23 +31,24 @@ class MegatronPretrainingSampler:
         drop_last: bool = True,
     ):
         if total_samples <= 0:
-            raise RuntimeError(f"no sample to consume: {total_samples}")
+            raise RuntimeError(
+                f"total_samples must be positive, got {total_samples}")
         if consumed_samples >= total_samples:
             raise RuntimeError(
-                f"no samples left to consume: {consumed_samples}, "
-                f"{total_samples}")
+                f"already consumed {consumed_samples} of {total_samples} "
+                f"samples — nothing left to iterate")
         if local_minibatch_size <= 0:
             raise RuntimeError(
-                f"local minibatch size must be greater than 0: "
+                f"local_minibatch_size must be positive, got "
                 f"{local_minibatch_size}")
         if data_parallel_size <= 0:
             raise RuntimeError(
-                f"data parallel size must be greater than 0: "
+                f"data_parallel_size must be positive, got "
                 f"{data_parallel_size}")
         if data_parallel_rank >= data_parallel_size:
             raise RuntimeError(
-                f"data_parallel_rank should be smaller than data size: "
-                f"{data_parallel_rank}, {data_parallel_size}")
+                f"data_parallel_rank {data_parallel_rank} out of range for "
+                f"data_parallel_size {data_parallel_size}")
         self.total_samples = total_samples
         self.consumed_samples = consumed_samples
         self._local_minibatch_size = local_minibatch_size
@@ -99,17 +100,19 @@ class MegatronPretrainingRandomSampler:
     ) -> None:
         if total_samples <= 0:
             raise ValueError(
-                f"no sample to consume: total_samples of {total_samples}")
+                f"total_samples must be positive, got {total_samples}")
         if local_minibatch_size <= 0:
             raise ValueError(
-                f"Invalid local_minibatch_size: {local_minibatch_size}")
+                f"local_minibatch_size must be positive, got "
+                f"{local_minibatch_size}")
         if data_parallel_size <= 0:
             raise ValueError(
-                f"Invalid data_parallel_size: {data_parallel_size}")
+                f"data_parallel_size must be positive, got "
+                f"{data_parallel_size}")
         if data_parallel_rank >= data_parallel_size:
             raise ValueError(
-                f"data_parallel_rank should be smaller than data parallel "
-                f"size: {data_parallel_rank} < {data_parallel_size}")
+                f"data_parallel_rank {data_parallel_rank} out of range for "
+                f"data_parallel_size {data_parallel_size}")
         self.total_samples = total_samples
         self.consumed_samples = consumed_samples
         self._local_minibatch_size = local_minibatch_size

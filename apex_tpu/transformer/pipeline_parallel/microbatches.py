@@ -134,8 +134,8 @@ def build_num_microbatches_calculator(
         )
     if len(rampup_batch_size) != 3:
         raise ValueError(
-            "expected the following format: --rampup-batch-size "
-            "<start batch size> <batch size increment> <ramp-up samples>"
+            f"rampup_batch_size takes exactly three ints — start size, "
+            f"increment, ramp-up samples — got {rampup_batch_size!r}"
         )
     start, increment, samples = (int(v) for v in rampup_batch_size)
     return RampupBatchsizeNumMicroBatches(

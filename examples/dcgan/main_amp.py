@@ -62,31 +62,46 @@ def train(args):
     def step_d(gv, dv, sD, sc0, sc1, real, z):
         fake, g_updates = netG.apply(gv, z, mutable=["batch_stats"])
 
-        def loss_fn(p):
+        # Two losses, each scaled by its own scaler and unscaled by its own
+        # scale before the fp32 sum — the ref's two backward() calls that
+        # accumulate correctly-unscaled grads (main_amp.py loss_id 0/1).
+        def loss_real(p):
             dvars = {"params": p, "batch_stats": dv["batch_stats"]}
             lr_, upd1 = netD.apply(dvars, real, mutable=["batch_stats"])
             errD_real = bce(lr_, jnp.ones(real.shape[0]))
+            return (scalers[0].scale_loss(errD_real, sc0),
+                    (errD_real, upd1["batch_stats"]))
+
+        grads_r, (errD_real, bs1) = jax.grad(
+            loss_real, has_aux=True)(dv["params"])
+        g32r, found0 = scalers[0].unscale(grads_r, sc0)
+
+        def loss_fake(p):
             lf_, upd2 = netD.apply(
-                {"params": p, "batch_stats": upd1["batch_stats"]},
+                {"params": p, "batch_stats": bs1},
                 jax.lax.stop_gradient(fake), mutable=["batch_stats"])
             errD_fake = bce(lf_, jnp.zeros(real.shape[0]))
-            scaled = (scalers[0].scale_loss(errD_real, sc0)
-                      + scalers[1].scale_loss(errD_fake, sc1))
-            return scaled, (errD_real + errD_fake, upd2["batch_stats"])
+            return (scalers[1].scale_loss(errD_fake, sc1),
+                    (errD_fake, upd2["batch_stats"]))
 
-        grads, (errD, new_bs) = jax.grad(loss_fn, has_aux=True)(dv["params"])
-        # combined scale: grads carry sc0.scale + sc1.scale mixture; unscale
-        # conservatively by the max to keep the check meaningful
-        g32, found0 = scalers[0].unscale(
-            grads, sc0._replace(loss_scale=sc0.loss_scale + sc1.loss_scale))
-        new_sc0, skip = scalers[0].update_scale(sc0, found0)
-        new_sc1, _ = scalers[1].update_scale(sc1, found0)
-        updates, new_sD = optD.update(g32, sD, dv["params"])
+        grads_f, (errD_fake, new_bs) = jax.grad(
+            loss_fake, has_aux=True)(dv["params"])
+        g32f, found1 = scalers[1].unscale(grads_f, sc1)
+
+        g32 = jax.tree.map(jnp.add, g32r, g32f)
+        new_sc0, skip0 = scalers[0].update_scale(sc0, found0)
+        new_sc1, skip1 = scalers[1].update_scale(sc1, found1)
+        skip = jnp.logical_or(skip0, skip1)
+        updates, stepped_sD = optD.update(g32, sD, dv["params"])
+        # overflow skip must cover the optimizer moments too, or inf/nan
+        # grads poison Adam m/v for every later step
+        new_sD = jax.tree.map(lambda new, old: jnp.where(skip, old, new),
+                              stepped_sD, sD)
         new_p = jax.tree.map(
             lambda p, u: jnp.where(skip, p, p + u.astype(p.dtype)),
             dv["params"], updates)
         return ({"params": new_p, "batch_stats": new_bs}, new_sD, new_sc0,
-                new_sc1, errD)
+                new_sc1, errD_real + errD_fake)
 
     @jax.jit
     def step_g(gv, dv, sG, sc2, z):
@@ -100,7 +115,9 @@ def train(args):
         grads, (errG, new_bs) = jax.grad(loss_fn, has_aux=True)(gv["params"])
         g32, found = scalers[2].unscale(grads, sc2)
         new_sc2, skip = scalers[2].update_scale(sc2, found)
-        updates, new_sG = optG.update(g32, sG, gv["params"])
+        updates, stepped_sG = optG.update(g32, sG, gv["params"])
+        new_sG = jax.tree.map(lambda new, old: jnp.where(skip, old, new),
+                              stepped_sG, sG)
         new_p = jax.tree.map(
             lambda p, u: jnp.where(skip, p, p + u.astype(p.dtype)),
             gv["params"], updates)
