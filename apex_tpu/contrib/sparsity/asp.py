@@ -37,19 +37,42 @@ class ASP:
     ``restore_pruned_weights``)."""
 
     def __init__(self, mask_calculator: str = "m4n2_1d",
-                 whitelist: Callable[[str, Any], bool] = _default_whitelist):
+                 whitelist: Callable[[str, Any], bool] = _default_whitelist,
+                 allow_permutation: bool = False,
+                 permutation_escape_attempts: int = 10):
         self.pattern = mask_calculator
         self.whitelist = whitelist
+        self.allow_permutation = allow_permutation
+        self.permutation_escape_attempts = permutation_escape_attempts
+        if allow_permutation and mask_calculator != "m4n2_1d":
+            raise ValueError(
+                f"channel-permutation search assumes 2:4 groups (m4n2_1d); "
+                f"got mask_calculator={mask_calculator!r}")
 
     def compute_sparse_masks(self, params: Pytree) -> Pytree:
         """Mask pytree: keep-masks for whitelisted leaves, ``None`` (keep all)
-        elsewhere (ref ``compute_sparse_masks:204``)."""
+        elsewhere (ref ``compute_sparse_masks:204``).
+
+        With ``allow_permutation`` (ref ``init_model_for_pruning``'s
+        ``allow_permutation``), each whitelisted leaf's input channels are
+        permuted by the greedy search of
+        :mod:`apex_tpu.contrib.sparsity.permutation` before pruning and the
+        mask is mapped back — preserving more magnitude than aligned-group
+        pruning on the raw layout."""
         from apex_tpu.amp.frontend import _path_str
 
         def leaf(path, x):
-            if self.whitelist(_path_str(path), x):
-                return create_mask(x, self.pattern)
-            return None
+            if not self.whitelist(_path_str(path), x):
+                return None
+            if self.allow_permutation:
+                from apex_tpu.contrib.sparsity.permutation import (
+                    permute_and_mask,
+                )
+
+                mask, _, _, _ = permute_and_mask(
+                    x, self.permutation_escape_attempts)
+                return jnp.asarray(mask)
+            return create_mask(x, self.pattern)
 
         return jax.tree_util.tree_map_with_path(leaf, params)
 

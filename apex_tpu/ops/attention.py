@@ -110,9 +110,11 @@ def _fa_fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
+        # inputs stay in model dtype: MXU runs bf16 x bf16 -> fp32 natively;
+        # upcasting first would push the matmul onto the (8x slower) fp32 path
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
@@ -135,7 +137,7 @@ def _fa_fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
                                  q_i, kv_i)
             p = jnp.where(keep, p * (1.0 / (1.0 - dropout_rate)), 0.0)
         acc_scr[:] = acc_scr[:] * corr + jax.lax.dot(
-            p, v, preferred_element_type=jnp.float32)
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
 
@@ -212,10 +214,10 @@ def _fa_bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0]
         delta = delta_ref[0]
         s = jax.lax.dot_general(
@@ -235,7 +237,8 @@ def _fa_bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                                  q_i, kv_i)
             dp = jnp.where(keep, dp * (1.0 / (1.0 - dropout_rate)), 0.0)
         ds = p * (dp - delta) * scale
-        dq_scr[:] += jax.lax.dot(ds, k, preferred_element_type=jnp.float32)
+        dq_scr[:] += jax.lax.dot(ds.astype(k.dtype), k,
+                                 preferred_element_type=jnp.float32)
 
     @pl.when(kv_i == nk - 1)
     def _finish():
@@ -257,10 +260,10 @@ def _fa_bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0]
         delta = delta_ref[0]
         s = jax.lax.dot_general(
@@ -281,7 +284,7 @@ def _fa_bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         else:
             p_v = p
         dv_scr[:] += jax.lax.dot_general(
-            p_v, do, (((0,), (0,)), ((), ())),
+            p_v.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
@@ -289,7 +292,8 @@ def _fa_bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
             dp = jnp.where(keep, dp * inv, 0.0)
         ds = p * (dp - delta) * scale
         dk_scr[:] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
     @pl.when(q_i == nq - 1)
     def _finish():
@@ -408,7 +412,7 @@ def flash_attention_with_lse(q3, k3, v3, scale, causal, block_q, block_k,
 # Public API
 
 def _pick_block(seq: int, want: int) -> Optional[int]:
-    for cand in (want, 256, 128, 64, 32, 16, 8):
+    for cand in (want, 512, 256, 128, 64, 32, 16, 8):
         if cand <= want and seq % cand == 0:
             return cand
     return None
@@ -431,8 +435,8 @@ def flash_attention(
     mask=None,
     causal: bool = False,
     scale: Optional[float] = None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 512,
+    block_k: int = 512,
     use_pallas: Optional[bool] = None,
     dropout_rate: float = 0.0,
     dropout_seed=None,

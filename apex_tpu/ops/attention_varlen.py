@@ -119,9 +119,11 @@ def _vl_fwd_kernel(qmin_ref, qmax_ref, kmin_ref, kmax_ref,
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
+        # model dtype straight into the MXU (fp32 upcast would leave the
+        # fast bf16 matmul path); accumulation stays fp32
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
@@ -141,7 +143,7 @@ def _vl_fwd_kernel(qmin_ref, qmax_ref, kmin_ref, kmax_ref,
         corr = jnp.where(m_prev <= NEG_INF / 2, 0.0, corr)
         l_scr[:, :1] = corr * l_scr[:, :1] + jnp.sum(p, axis=1, keepdims=True)
         acc_scr[:] = acc_scr[:] * corr + jax.lax.dot(
-            p, v, preferred_element_type=jnp.float32)
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
 
     @pl.when(kv_i == nk - 1)
@@ -170,10 +172,10 @@ def _vl_bwd_dq_kernel(qmin_ref, qmax_ref, kmin_ref, kmax_ref,
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
-        do = do_ref[0, 0].astype(jnp.float32)
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
         lse = lse_ref[0, 0]
         delta = delta_ref[0, 0]
         s = jax.lax.dot_general(
@@ -192,7 +194,8 @@ def _vl_bwd_dq_kernel(qmin_ref, qmax_ref, kmin_ref, kmax_ref,
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * scale
-        dq_scr[:] += jax.lax.dot(ds, k, preferred_element_type=jnp.float32)
+        dq_scr[:] += jax.lax.dot(ds.astype(k.dtype), k,
+                                 preferred_element_type=jnp.float32)
 
     @pl.when(kv_i == nk - 1)
     def _finish():
@@ -217,10 +220,10 @@ def _vl_bwd_dkv_kernel(qmin_ref, qmax_ref, kmin_ref, kmax_ref,
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
-        do = do_ref[0, 0].astype(jnp.float32)
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
         lse = lse_ref[0, 0]
         delta = delta_ref[0, 0]
         s = jax.lax.dot_general(
@@ -235,12 +238,14 @@ def _vl_bwd_dkv_kernel(qmin_ref, qmax_ref, kmin_ref, kmax_ref,
             allowed = allowed & (kpos <= qpos)
         p = jnp.where(allowed, jnp.exp(s - lse), 0.0)
         dv_scr[:] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * scale
         dk_scr[:] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
     @pl.when(q_i == nq - 1)
     def _finish():

@@ -102,8 +102,10 @@ def _fwd_kernel(t_ref, x_ref, w_ref, lse_ref, pred_ref, m_scr, l_scr, p_scr,
         l_scr[:] = jnp.zeros_like(l_scr)
         p_scr[:] = jnp.zeros_like(p_scr)
 
-    x = x_ref[...].astype(jnp.float32)
-    w = w_ref[...].astype(jnp.float32)
+    # model-dtype inputs straight into the MXU (bf16 x bf16 -> fp32 accum);
+    # an fp32 upcast first would land on the much slower fp32 matmul path
+    x = x_ref[...]
+    w = w_ref[...]
     s = jax.lax.dot_general(x, w, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32)
     col = _col_ids(v_i, block_n, block_v)
@@ -132,13 +134,13 @@ def _dx_kernel(t_ref, g_ref, lse_ref, x_ref, w_ref, dx_ref, dx_scr,
     def _init():
         dx_scr[:] = jnp.zeros_like(dx_scr)
 
-    x = x_ref[...].astype(jnp.float32)
-    w = w_ref[...].astype(jnp.float32)
+    x = x_ref[...]
+    w = w_ref[...]
     col = _col_ids(v_i, block_n, block_v)
     if v_total % block_v:
         # zero padded w rows: dl is 0 there, but 0 x (OOB-pad garbage) = NaN
         row = v_i * block_v + lax.broadcasted_iota(jnp.int32, w.shape, 0)
-        w = jnp.where(row < v_total, w, 0.0)
+        w = jnp.where(row < v_total, w, jnp.zeros_like(w))
     s = jax.lax.dot_general(x, w, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32)
     if v_total % block_v:
@@ -146,7 +148,8 @@ def _dx_kernel(t_ref, g_ref, lse_ref, x_ref, w_ref, dx_ref, dx_scr,
     p = jnp.exp(s - lse_ref[...])  # masked cols -> exp(NEG_INF - lse) = 0
     hit = (col == t_ref[...]).astype(jnp.float32)
     dl = (p - hit) * g_ref[...]
-    dx_scr[:] += jax.lax.dot(dl, w, preferred_element_type=jnp.float32)
+    dx_scr[:] += jax.lax.dot(dl.astype(w.dtype), w,
+                             preferred_element_type=jnp.float32)
 
     @pl.when(v_i == nv - 1)
     def _finish():
@@ -162,8 +165,8 @@ def _dw_kernel(t_ref, g_ref, lse_ref, x_ref, w_ref, dw_ref, dw_scr,
     def _init():
         dw_scr[:] = jnp.zeros_like(dw_scr)
 
-    x = x_ref[...].astype(jnp.float32)
-    w = w_ref[...].astype(jnp.float32)
+    x = x_ref[...]
+    w = w_ref[...]
     s = jax.lax.dot_general(x, w, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32)
     col = _col_ids(v_i, block_n, block_v)
@@ -172,7 +175,8 @@ def _dw_kernel(t_ref, g_ref, lse_ref, x_ref, w_ref, dw_ref, dw_scr,
     p = jnp.exp(s - lse_ref[...])
     hit = (col == t_ref[...]).astype(jnp.float32)
     dl = (p - hit) * g_ref[...]
-    dw_scr[:] += jax.lax.dot_general(dl, x, (((0,), (0,)), ((), ())),
+    dw_scr[:] += jax.lax.dot_general(dl.astype(x.dtype), x,
+                                     (((0,), (0,)), ((), ())),
                                      preferred_element_type=jnp.float32)
 
     @pl.when(n_i == nn - 1)

@@ -22,9 +22,17 @@ from apex_tpu.optimizers.fused_novograd import (  # noqa: F401
 )
 from apex_tpu.optimizers.fused_sgd import FusedSGD, FusedSGDState  # noqa: F401
 from apex_tpu.optimizers._common import apply_updates, global_norm  # noqa: F401
+from apex_tpu.optimizers.grad_accumulation import (  # noqa: F401
+    accumulate_gradients,
+    accumulate_into_main_grads,
+    init_main_grads,
+)
 from apex_tpu.parallel.larc import LARC, larc_transform  # noqa: F401
 
 __all__ = [
+    "accumulate_gradients",
+    "accumulate_into_main_grads",
+    "init_main_grads",
     "FusedAdam",
     "FusedAdagrad",
     "FusedLAMB",

@@ -16,6 +16,9 @@ parts collapse to thin, robust wrappers:
   loadable profile (the nvprof capture).
 * :func:`cost_analysis` — compiled-HLO FLOPs/bytes per executable (the
   ``prof`` FLOP counting, exact instead of per-op formulas).
+* :func:`report` / :func:`op_table` — per-op/per-layer attribution from the
+  compiled HLO: every fused instruction with its ``named_scope`` layer path,
+  FLOPs, bytes, and roofline time estimate (the ``parse``+``prof`` report).
 """
 
 from apex_tpu.pyprof.profiler import (  # noqa: F401
@@ -25,6 +28,11 @@ from apex_tpu.pyprof.profiler import (  # noqa: F401
     summary,
     trace,
 )
+from apex_tpu.pyprof.prof import (  # noqa: F401
+    format_table,
+    op_table,
+    report,
+)
 
 __all__ = ["annotate", "annotate_function", "trace", "cost_analysis",
-           "summary"]
+           "summary", "op_table", "format_table", "report"]

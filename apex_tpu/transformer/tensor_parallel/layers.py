@@ -18,9 +18,10 @@ TPU re-design:
 * The backward collectives come from the :mod:`mappings` custom-VJP functions;
   comm/compute overlap (the "async allreduce") is XLA's latency-hiding
   scheduler reordering the psum against the dW dot — no streams to manage.
-* Gradient-accumulation fusion into fp32 main_grad is the optimizer's
-  accumulator pytree here (see ``apex_tpu.optimizers``); XLA fuses the
-  cast+add into the dW GEMM epilogue.
+* Gradient-accumulation fusion into fp32 main_grad is
+  :mod:`apex_tpu.optimizers.grad_accumulation` — ``accumulate_gradients``
+  scans microbatches adding model-dtype dW into an fp32 accumulator; XLA
+  fuses the cast+add into the dW GEMM epilogue.
 * Weight init is **TP-invariant**: the full (master) weight is initialized
   from a replicated RNG and each rank keeps its slice — the semantics of the
   reference's ``_initialize_affine_weight_cpu`` (:89-120) master-weight path,

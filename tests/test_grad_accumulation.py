@@ -1,0 +1,89 @@
+"""fp32 main-grad accumulation (ref fused_weight_gradient_dense +
+LinearWithGradAccumulationAndAsyncAllreduce's gradient_accumulation_fusion):
+microbatched bf16 training must accumulate weight grads in fp32."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.optimizers import (
+    FusedAdam,
+    accumulate_gradients,
+    accumulate_into_main_grads,
+    init_main_grads,
+)
+
+
+def _loss(params, batch):
+    x, y = batch
+    h = jnp.tanh(x @ params["w1"])
+    pred = h @ params["w2"]
+    return jnp.mean((pred - y).astype(jnp.float32) ** 2)
+
+
+def _data(key, n=64, din=16, dh=32):
+    kx, ky, k1, k2 = jax.random.split(key, 4)
+    params = {
+        "w1": (jax.random.normal(k1, (din, dh)) * 0.3).astype(jnp.bfloat16),
+        "w2": (jax.random.normal(k2, (dh, 1)) * 0.3).astype(jnp.bfloat16),
+    }
+    x = jax.random.normal(kx, (n, din)).astype(jnp.bfloat16)
+    y = jax.random.normal(ky, (n, 1)).astype(jnp.bfloat16)
+    return params, x, y
+
+
+def test_main_grads_are_fp32_and_match_full_batch():
+    params, x, y = _data(jax.random.PRNGKey(0))
+    n_micro = 8
+    mb = (x.reshape(n_micro, -1, x.shape[-1]), y.reshape(n_micro, -1, 1))
+
+    loss, main = jax.jit(
+        lambda p, mb: accumulate_gradients(_loss, p, mb))(params, mb)
+
+    assert all(g.dtype == jnp.float32 for g in jax.tree.leaves(main))
+
+    # reference: fp32 grad of the mean-over-microbatches loss
+    def full(p):
+        losses = jax.vmap(lambda xx, yy: _loss(p, (xx, yy)))(*mb)
+        return jnp.mean(losses)
+
+    ref_loss, ref_grads = jax.value_and_grad(full)(params)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-3)
+    for a, b in zip(jax.tree.leaves(main), jax.tree.leaves(ref_grads)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b, np.float32), rtol=2e-2, atol=1e-3)
+
+
+def test_fp32_accumulation_beats_bf16_accumulation():
+    # accumulate many tiny identical grads: fp32 keeps them, bf16 loses bits
+    g = {"w": jnp.full((4, 4), 1e-3, jnp.bfloat16)}
+    main = init_main_grads(g)
+    half = jnp.zeros((4, 4), jnp.bfloat16)
+    for _ in range(1000):
+        main = accumulate_into_main_grads(main, g)
+        half = half + g["w"]
+    exact = 1e-3 * 1000 * np.float32(jnp.full((), 1e-3, jnp.bfloat16) / 1e-3)
+    fp32_err = abs(float(main["w"][0, 0]) - exact) / exact
+    bf16_err = abs(float(half[0, 0]) - exact) / exact
+    assert fp32_err < 1e-3
+    assert bf16_err > 10 * fp32_err
+
+
+def test_accumulated_grads_drive_optimizer_step():
+    params, x, y = _data(jax.random.PRNGKey(1))
+    mb = (x.reshape(4, -1, x.shape[-1]), y.reshape(4, -1, 1))
+    opt = FusedAdam(lr=1e-2)
+    state = opt.init(params)
+
+    @jax.jit
+    def step(p, s, mb):
+        loss, grads = accumulate_gradients(_loss, p, mb)
+        updates, s = opt.update(grads, s, p)
+        p = jax.tree.map(lambda a, u: (a + u).astype(a.dtype), p, updates)
+        return p, s, loss
+
+    losses = []
+    for _ in range(10):
+        params, state, loss = step(params, state, mb)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
