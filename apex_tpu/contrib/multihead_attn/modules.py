@@ -10,10 +10,10 @@ TPU re-design: one flax module per reference class; the attention core is
 the Pallas flash kernel (``apex_tpu.ops.flash_attention``) — no seqlen≤512
 limit — with the QKV projection as a single fused GEMM (column concat), and
 norm-add as ``ops.layer_norm`` + residual, all fused by XLA around the
-kernel. Dropout on attention probabilities is applied inside the reference
-kernel; here it routes the masked path through the XLA reference attention
-(dropout inside a flash kernel needs per-block philox state — a later perf
-item), matching numerics-by-construction instead.
+kernel. Dropout on attention probabilities runs INSIDE the flash kernel
+(counter-based keep mask regenerated in backward — the reference kernels'
+philox dropout); only arbitrary boolean/additive masks route through the
+XLA reference attention, which the kernel does not model.
 
 Layout note: the reference uses (seq, batch, embed) like fairseq; TPU-native
 is (batch, seq, embed), which is what these modules take.
@@ -71,10 +71,17 @@ def _attend(q, k, v, *, key_padding_mask, attn_mask, mask_additive,
         am = attn_mask[None, None, :, :]
         mask = am if mask is None else (mask | am)
     if dropout_rate > 0.0 and not deterministic:
+        if mask is None:
+            # in-kernel counter-based dropout (ref fast_multihead_attn's
+            # fused philox dropout); stays on the Pallas path
+            seed = jax.random.bits(dropout_rng, dtype=jnp.uint32).astype(
+                jnp.int32)
+            return flash_attention(q, k, v, scale=scale,
+                                   dropout_rate=dropout_rate,
+                                   dropout_seed=seed)
         s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
                        k.astype(jnp.float32)) * scale
-        if mask is not None:
-            s = jnp.where(mask, -1e30, s)
+        s = jnp.where(mask, -1e30, s)
         p = jax.nn.softmax(s, axis=-1)
         keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate, p.shape)
         p = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)

@@ -83,19 +83,39 @@ def attention_reference(q, k, v, mask=None, scale: Optional[float] = None,
 # ---------------------------------------------------------------------------
 # Pallas forward
 
-def _dropout_keep(seed_ref, rate, block_q, block_k, q_i, kv_i):
-    """Deterministic per-(batch*head, q-block, k-block) keep mask; the same
-    seeding in forward and both backward kernels regenerates the identical
-    mask (the philox-counter scheme of the reference's fmhalib dropout)."""
-    pltpu.prng_seed(seed_ref[0], pl.program_id(0), q_i, kv_i)
-    bits = pltpu.prng_random_bits((block_q, block_k))
+def _dropout_keep(seed_ref, rate, block_q, block_k, q_i, kv_i, bh_i):
+    """Deterministic keep mask from a counter-based hash of (seed, batch*head,
+    absolute q position, absolute k position) — the philox-counter scheme of
+    the reference's fmhalib dropout. Position-keyed (not block-keyed), so the
+    identical mask regenerates in forward and both backward kernels even at
+    different block sizes, and plain integer ops keep it portable to pallas
+    interpret mode (pltpu's hardware PRNG is TPU-only). ``bh_i`` must be read
+    at kernel top level (program_id inside a pl.when body does not lower in
+    interpret mode)."""
+    # all-uint32 arithmetic: mixing a signed scalar into the uint32 iota
+    # would promote/wrap and skew the keep probability
+    qpos = ((q_i * block_q).astype(jnp.uint32)
+            + jax.lax.broadcasted_iota(jnp.uint32, (block_q, block_k), 0))
+    kpos = ((kv_i * block_k).astype(jnp.uint32)
+            + jax.lax.broadcasted_iota(jnp.uint32, (block_q, block_k), 1))
+    x = (qpos * jnp.uint32(0x9E3779B1)
+         + kpos * jnp.uint32(0x85EBCA77)
+         + seed_ref[0].astype(jnp.uint32) * jnp.uint32(0xC2B2AE3D)
+         + bh_i.astype(jnp.uint32) * jnp.uint32(0x27D4EB2F))
+    # murmur3 fmix32 finalizer: full-avalanche 32-bit mixing
+    x = x ^ (x >> jnp.uint32(16))
+    x = x * jnp.uint32(0x85EBCA6B)
+    x = x ^ (x >> jnp.uint32(13))
+    x = x * jnp.uint32(0xC2B2AE35)
+    x = x ^ (x >> jnp.uint32(16))
     thresh = jnp.uint32(min(int(rate * 4294967296.0), 4294967295))
-    return jax.lax.bitcast_convert_type(bits, jnp.uint32) >= thresh
+    return x >= thresh
 
 
 def _fa_fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
                    m_scr, l_scr, acc_scr,
                    *, scale, causal, block_q, block_k, nk, dropout_rate):
+    bh_i = pl.program_id(0)
     q_i = pl.program_id(1)
     kv_i = pl.program_id(2)
 
@@ -134,7 +154,7 @@ def _fa_fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         l_new = corr * l_prev + jnp.sum(p, axis=1, keepdims=True)
         if dropout_rate > 0.0:
             keep = _dropout_keep(seed_ref, dropout_rate, block_q, block_k,
-                                 q_i, kv_i)
+                                 q_i, kv_i, bh_i)
             p = jnp.where(keep, p * (1.0 / (1.0 - dropout_rate)), 0.0)
         acc_scr[:] = acc_scr[:] * corr + jax.lax.dot(
             p.astype(v.dtype), v, preferred_element_type=jnp.float32)
@@ -203,6 +223,7 @@ def _fa_fwd(q3, k3, v3, scale, causal, block_q, block_k, interpret,
 def _fa_bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                       delta_ref, dq_ref, dq_scr,
                       *, scale, causal, block_q, block_k, nk, dropout_rate):
+    bh_i = pl.program_id(0)
     q_i = pl.program_id(1)
     kv_i = pl.program_id(2)
 
@@ -234,7 +255,7 @@ def _fa_bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
         if dropout_rate > 0.0:
             keep = _dropout_keep(seed_ref, dropout_rate, block_q, block_k,
-                                 q_i, kv_i)
+                                 q_i, kv_i, bh_i)
             dp = jnp.where(keep, dp * (1.0 / (1.0 - dropout_rate)), 0.0)
         ds = p * (dp - delta) * scale
         dq_scr[:] += jax.lax.dot(ds.astype(k.dtype), k,
@@ -248,6 +269,7 @@ def _fa_bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 def _fa_bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                        delta_ref, dk_ref, dv_ref, dk_scr, dv_scr,
                        *, scale, causal, block_q, block_k, nq, dropout_rate):
+    bh_i = pl.program_id(0)
     kv_i = pl.program_id(1)
     q_i = pl.program_id(2)
 
@@ -278,7 +300,7 @@ def _fa_bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         p = jnp.exp(s - lse)
         if dropout_rate > 0.0:
             keep = _dropout_keep(seed_ref, dropout_rate, block_q, block_k,
-                                 q_i, kv_i)
+                                 q_i, kv_i, bh_i)
             inv = 1.0 / (1.0 - dropout_rate)
             p_v = jnp.where(keep, p * inv, 0.0)
         else:

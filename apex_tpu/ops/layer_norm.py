@@ -138,9 +138,20 @@ def _rms_bwd_kernel(dy_ref, x_ref, rstd_ref, w_ref, dx_ref, dw_ref, *, hidden):
     dw_ref[:] += jnp.sum(dy * xhat, axis=0, keepdims=True)
 
 
-def _pick_block_rows(rows: int) -> Optional[int]:
+# The backward kernel keeps ~7 block-sized fp32 buffers resident (dy, x,
+# xhat, g, dx + weight row + partial-grad row); budget half of a core's
+# ~16 MB VMEM. The reference needs a separate ``fast_layer_norm`` extension
+# for large hidden (up to 65k); here large hidden shrinks the row block and
+# past the budget falls back to the XLA path rather than faulting on VMEM.
+_VMEM_BUDGET_BYTES = 8 * 1024 * 1024
+_BWD_LIVE_BUFFERS = 7
+
+
+def _pick_block_rows(rows: int, hidden: int) -> Optional[int]:
     for cand in (256, 128, 64, 32, 16, 8):
-        if rows % cand == 0:
+        if (rows % cand == 0
+                and cand * hidden * 4 * _BWD_LIVE_BUFFERS
+                <= _VMEM_BUDGET_BYTES):
             return cand
     return None
 
@@ -151,7 +162,7 @@ def _pallas_ok(rows: int, hidden: int, allow_interpret: bool) -> bool:
     therefore opt-in via use_pallas=True (tests do this)."""
     if not _HAS_PALLAS:
         return False
-    if _pick_block_rows(rows) is None:
+    if _pick_block_rows(rows, hidden) is None:
         return False
     if hidden % 128 != 0:
         return False
@@ -173,7 +184,7 @@ def _layer_norm_affine(x2d, w, b, eps):
 
 def _ln_fwd(x2d, w, b, eps):
     rows, hidden = x2d.shape
-    block = _pick_block_rows(rows)
+    block = _pick_block_rows(rows, hidden)
     interpret = _interpret_default()
     kernel = functools.partial(_ln_fwd_kernel, eps=eps, hidden=hidden)
     y, mean, rstd = pl.pallas_call(
@@ -207,7 +218,7 @@ def _layer_norm_affine_fwd(x2d, w, b, eps):
 def _layer_norm_affine_bwd(eps, res, dy):
     x2d, w, mean, rstd = res
     rows, hidden = x2d.shape
-    block = _pick_block_rows(rows)
+    block = _pick_block_rows(rows, hidden)
     kernel = functools.partial(_ln_bwd_kernel, hidden=hidden)
     dx, dw, db = pl.pallas_call(
         kernel,
@@ -245,7 +256,7 @@ def _rms_norm_affine(x2d, w, eps):
 
 def _rms_fwd(x2d, w, eps):
     rows, hidden = x2d.shape
-    block = _pick_block_rows(rows)
+    block = _pick_block_rows(rows, hidden)
     kernel = functools.partial(_rms_fwd_kernel, eps=eps, hidden=hidden)
     y, rstd = pl.pallas_call(
         kernel,
@@ -275,7 +286,7 @@ def _rms_norm_affine_fwd(x2d, w, eps):
 def _rms_norm_affine_bwd(eps, res, dy):
     x2d, w, rstd = res
     rows, hidden = x2d.shape
-    block = _pick_block_rows(rows)
+    block = _pick_block_rows(rows, hidden)
     kernel = functools.partial(_rms_bwd_kernel, hidden=hidden)
     dx, dw = pl.pallas_call(
         kernel,
@@ -325,8 +336,9 @@ def layer_norm(
         use_pallas = _pallas_ok(rows, hidden, allow_interpret=False)
     elif use_pallas and not _pallas_ok(rows, hidden, allow_interpret=True):
         raise ValueError(
-            f"pallas layer_norm requires row count divisible by 8 and hidden "
-            f"% 128 == 0; got shape {x.shape}"
+            f"pallas layer_norm requires row count divisible by 8, hidden "
+            f"% 128 == 0, and a row block fitting VMEM at this hidden size; "
+            f"got shape {x.shape}"
         )
     if not use_pallas or weight is None or bias is None:
         return layer_norm_reference(x, weight, bias, eps)
@@ -347,8 +359,9 @@ def rms_norm(
         use_pallas = _pallas_ok(rows, hidden, allow_interpret=False)
     elif use_pallas and not _pallas_ok(rows, hidden, allow_interpret=True):
         raise ValueError(
-            f"pallas rms_norm requires row count divisible by 8 and hidden "
-            f"% 128 == 0; got shape {x.shape}"
+            f"pallas rms_norm requires row count divisible by 8, hidden "
+            f"% 128 == 0, and a row block fitting VMEM at this hidden size; "
+            f"got shape {x.shape}"
         )
     if not use_pallas or weight is None:
         return rms_norm_reference(x, weight, eps)

@@ -80,6 +80,14 @@ class GPTConfig:
     # Fuse the LM head matmul into the CE loss (ops/lm_head_loss.py) —
     # never materializes the (tokens, vocab) logits.
     fused_loss: bool = True
+    # Ref standalone_gpt.py attention-/hidden-dropout sites (:285-735).
+    # Active only when the caller passes ``dropout_key`` (training); the
+    # attention dropout runs INSIDE the flash kernel with a TP-rank-folded
+    # seed (tensor_parallel/random.py stream semantics), hidden/embedding
+    # dropout on the replicated activations with the unfolded key (same
+    # across the TP group).
+    attention_dropout: float = 0.0
+    hidden_dropout: float = 0.0
 
     @property
     def ffn_hidden(self) -> int:
@@ -186,9 +194,20 @@ def gpt_param_specs(cfg: GPTConfig, extra_layer_lead=()) -> Pytree:
 # ---------------------------------------------------------------------------
 # forward (local shards, inside shard_map)
 
-def _attention(p, x, cfg, heads_local: int, causal: bool = True, mask=None):
-    """Ref ParallelAttention (:285): column-parallel fused QKV, flash core,
-    row-parallel out-proj."""
+def _hidden_dropout(x, rate: float, key):
+    """Dropout on replicated activations (ref hidden-dropout sites): applied
+    with the UNFOLDED key so every TP rank drops the same positions — the
+    activations are TP-replicated, diverging them would break the region."""
+    keep = jax.random.bernoulli(key, 1.0 - rate, x.shape)
+    return jnp.where(keep, x * (1.0 / (1.0 - rate)),
+                     jnp.zeros_like(x)).astype(x.dtype)
+
+
+def _attention(p, x, cfg, heads_local: int, causal: bool = True, mask=None,
+               dropout_key=None):
+    """Ref ParallelAttention (:285): column-parallel fused QKV, flash core
+    (with in-kernel probability dropout when training), row-parallel
+    out-proj."""
     b, s, h = x.shape
     qkv = column_parallel_linear(x, p["qkv_kernel"], p["qkv_bias"],
                                  gather_output=False)
@@ -198,15 +217,34 @@ def _attention(p, x, cfg, heads_local: int, causal: bool = True, mask=None):
         sp = lax.axis_size(SP_AXIS)
     except NameError:
         sp = 1
+    rate = cfg.attention_dropout if dropout_key is not None else 0.0
     if sp > 1:
         # sequence sharded over sp: exact attention via the K/V ring
         if mask is not None:
             raise NotImplementedError(
                 "explicit attention masks are not supported with sp > 1; "
                 "use causal or full attention")
+        if rate > 0.0:
+            raise NotImplementedError(
+                "attention dropout under sequence parallelism needs "
+                "position-consistent masks across ring steps; disable "
+                "attention_dropout with sp > 1")
         from apex_tpu.transformer.sequence_parallel import ring_attention
 
         ctx = ring_attention(q, k, v, causal=causal)
+    elif rate > 0.0:
+        # the attention probabilities live on the TP-sharded heads: fold the
+        # TP rank into the seed so ranks drop independent entries (ref
+        # tensor_parallel/random.py model-parallel stream)
+        from apex_tpu.transformer.tensor_parallel.random import (
+            model_parallel_key,
+        )
+
+        seed = jax.random.bits(
+            model_parallel_key(dropout_key), dtype=jnp.uint32
+        ).astype(jnp.int32)
+        ctx = flash_attention(q, k, v, causal=causal, mask=mask,
+                              dropout_rate=rate, dropout_seed=seed)
     else:
         ctx = flash_attention(q, k, v, causal=causal, mask=mask)
     ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, heads_local * cfg.head_dim)
@@ -223,30 +261,78 @@ def _mlp(p, x):
                                input_is_parallel=True)
 
 
-def _layer(p, x, cfg, heads_local: int, causal: bool = True, mask=None):
-    """Pre-LN transformer layer (ref ParallelTransformerLayer :577)."""
-    x = x + _attention(p, layer_norm(x, p["ln1_w"], p["ln1_b"]), cfg,
-                       heads_local, causal, mask)
-    return x + _mlp(p, layer_norm(x, p["ln2_w"], p["ln2_b"]))
+def _layer(p, x, cfg, heads_local: int, causal: bool = True, mask=None,
+           dropout_key=None):
+    """Pre-LN transformer layer (ref ParallelTransformerLayer :577):
+    attention (+in-kernel attention dropout) -> hidden dropout -> residual;
+    MLP -> hidden dropout -> residual."""
+    if dropout_key is not None:
+        k_attn, k_h1, k_h2 = jax.random.split(dropout_key, 3)
+    else:
+        k_attn = k_h1 = k_h2 = None
+    a = _attention(p, layer_norm(x, p["ln1_w"], p["ln1_b"]), cfg,
+                   heads_local, causal, mask, dropout_key=k_attn)
+    if k_h1 is not None and cfg.hidden_dropout > 0.0:
+        a = _hidden_dropout(a, cfg.hidden_dropout, k_h1)
+    x = x + a
+    m = _mlp(p, layer_norm(x, p["ln2_w"], p["ln2_b"]))
+    if k_h2 is not None and cfg.hidden_dropout > 0.0:
+        m = _hidden_dropout(m, cfg.hidden_dropout, k_h2)
+    return x + m
 
 
-def _layer_stack(layers, x, cfg, causal: bool = True, mask=None):
+def _layer_stack(layers, x, cfg, causal: bool = True, mask=None,
+                 dropout_key=None):
     """scan the stacked layer params over the hidden state."""
     tp = lax.axis_size(TP_AXIS)
     heads_local = cfg.num_heads // tp
 
-    def one(lp, h):
-        return _layer(lp, h, cfg, heads_local, causal, mask)
+    def one(lp, h, key):
+        return _layer(lp, h, cfg, heads_local, causal, mask,
+                      dropout_key=key)
 
     if cfg.remat:
         policy = (jax.checkpoint_policies.dots_with_no_batch_dims_saveable
                   if cfg.remat_policy == "dots" else None)
         one = jax.checkpoint(one, policy=policy)
 
-    def body(h, lp):
-        return one(lp, h), None
+    n_layers = jax.tree_util.tree_leaves(layers)[0].shape[0]
+    if dropout_key is not None:
+        # per-layer keys; under pipelining each stage holds different layer
+        # params but the same local indices — decorrelate by stage rank
+        # (folding axis_index makes the keys pp-varying, so the carry must
+        # be cast to match or scan rejects the type change)
+        try:
+            from apex_tpu.parallel.mesh import PP_AXIS
 
-    out, _ = lax.scan(body, x, layers)
+            pp = lax.axis_size(PP_AXIS)
+        except NameError:
+            pp = 1
+        try:
+            sp = lax.axis_size(SP_AXIS)
+        except NameError:
+            sp = 1
+        if sp > 1 and cfg.hidden_dropout > 0.0:
+            raise NotImplementedError(
+                "hidden dropout under sequence parallelism would reuse the "
+                "same mask on every sequence shard (correlated positions); "
+                "fold an SP-rank stream in before enabling, or disable "
+                "hidden_dropout with sp > 1")
+        base = dropout_key
+        if pp > 1:
+            base = jax.random.fold_in(base, lax.axis_index(PP_AXIS))
+            if PP_AXIS not in jax.typeof(x).vma:
+                x = lax.pcast(x, PP_AXIS, to="varying")
+        keys = jax.vmap(lambda i: jax.random.fold_in(base, i))(
+            jnp.arange(n_layers))
+    else:
+        keys = jnp.zeros((n_layers, 2), jnp.uint32)
+
+    def body(h, lp_key):
+        lp, key = lp_key
+        return one(lp, h, key if dropout_key is not None else None), None
+
+    out, _ = lax.scan(body, x, (layers, keys))
     return out
 
 
@@ -267,11 +353,31 @@ def embed_tokens(embed, tokens):
     return h + pos[None].astype(h.dtype)
 
 
-def gpt_forward(params, tokens, cfg: GPTConfig):
+def _embed_with_dropout(embed, tokens, cfg: GPTConfig, dropout_key):
+    x = embed_tokens(embed, tokens)
+    if dropout_key is not None and cfg.hidden_dropout > 0.0:
+        try:
+            sp = lax.axis_size(SP_AXIS)
+        except NameError:
+            sp = 1
+        if sp > 1:
+            raise NotImplementedError(
+                "hidden dropout under sequence parallelism would reuse the "
+                "same mask on every sequence shard; disable hidden_dropout "
+                "with sp > 1")
+        # ref GPT embedding dropout: same hidden_dropout rate on the
+        # embedding output; distinct stream from the per-layer keys
+        x = _hidden_dropout(x, cfg.hidden_dropout,
+                            jax.random.fold_in(dropout_key, 0x0E0B))
+    return x
+
+
+def gpt_forward(params, tokens, cfg: GPTConfig, dropout_key=None):
     """tokens (b, s) -> vocab-sharded logits (b, s, vocab/tp). Call inside a
-    mesh program (tp axis bound; tp=1 is the degenerate single-chip case)."""
-    x = embed_tokens(params["embed"], tokens)
-    x = _layer_stack(params["layers"], x, cfg)
+    mesh program (tp axis bound; tp=1 is the degenerate single-chip case).
+    ``dropout_key`` activates cfg's dropout rates (training mode)."""
+    x = _embed_with_dropout(params["embed"], tokens, cfg, dropout_key)
+    x = _layer_stack(params["layers"], x, cfg, dropout_key=dropout_key)
     return gpt_head(params, x, cfg)
 
 
@@ -324,19 +430,20 @@ def fused_head_loss(head_rows_w, ln_w, ln_b, x, targets):
     return jnp.mean(lm_head_loss(x, w, targets, axis_name=TP_AXIS))
 
 
-def gpt_loss(params, tokens, targets, cfg: GPTConfig):
+def gpt_loss(params, tokens, targets, cfg: GPTConfig, dropout_key=None):
     """Mean vocab-parallel cross-entropy (ref vocab_parallel_cross_entropy).
 
     With ``cfg.fused_loss`` the head matmul is fused into the loss kernel
     (``ops/lm_head_loss.py``) and the logits are never materialized; the
     unfused path is kept for logits-consuming callers and parity tests.
+    ``dropout_key`` activates cfg's dropout rates (training mode).
     """
     if not _use_fused_loss(cfg, tokens.shape[0] * tokens.shape[1]):
-        logits = gpt_forward(params, tokens, cfg)
+        logits = gpt_forward(params, tokens, cfg, dropout_key=dropout_key)
         # logits stay in model dtype; CE upcasts internally (fused by XLA)
         return jnp.mean(vocab_parallel_cross_entropy(logits, targets))
-    x = embed_tokens(params["embed"], tokens)
-    x = _layer_stack(params["layers"], x, cfg)
+    x = _embed_with_dropout(params["embed"], tokens, cfg, dropout_key)
+    x = _layer_stack(params["layers"], x, cfg, dropout_key=dropout_key)
     head = params["head"]
     w = (params["embed"]["tok"] if cfg.tie_embeddings
          else head["lm"].T)  # (vocab/tp, hidden) rows

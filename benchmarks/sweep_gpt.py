@@ -67,14 +67,14 @@ def measure(remat, remat_policy, fused_loss):
 
     try:
         params, opt_state, loss = train_step(params, opt_state, tok, tgt)
-        jax.block_until_ready(loss)
+        float(loss)  # host-read fence: axon's block_until_ready returns early
     except Exception as e:  # OOM etc.
         return None, f"{type(e).__name__}: {str(e)[:120]}"
 
     t0 = time.perf_counter()
     for _ in range(steps):
         params, opt_state, loss = train_step(params, opt_state, tok, tgt)
-    jax.block_until_ready(loss)
+    float(loss)
     dt = (time.perf_counter() - t0) / steps
     return batch * seq / dt, None
 

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from apex_tpu.amp import frontend as amp
+from apex_tpu.amp.autocast import autocast
 from apex_tpu.models import ResNet18, ResNet50
 from apex_tpu.models.resnet import make_norm
 from apex_tpu.optimizers import FusedSGD
@@ -88,14 +89,24 @@ def train(args) -> List[float]:
     opt_state = tx.init(amp_state.master_params)
     ddp = DistributedDataParallel()
 
+    # O1: per-op autocast transform around the model apply — whitelisted ops
+    # (convs/matmuls) run in the compute dtype, reductions in fp32 (the ref's
+    # monkey-patch casting; without this wrap O1 would train identically to
+    # O0, params and inputs both being fp32)
+    def apply_model(variables, images):
+        return model.apply(variables, images, use_running_average=False,
+                           mutable=["batch_stats"])
+
+    if policy.compute_dtype is not None:
+        apply_model = autocast(apply_model, policy.compute_dtype)
+
     def body(amp_state, opt_state, batch_stats, images, labels):
         def loss_fn(masters):
             model_p = ddp.replicate(amp.cast_params(
                 masters, policy, amp_state.is_norm_param))
-            logits, upd = model.apply(
+            logits, upd = apply_model(
                 {"params": model_p, "batch_stats": batch_stats},
-                amp.cast_inputs(images, policy),
-                use_running_average=False, mutable=["batch_stats"])
+                amp.cast_inputs(images, policy))
             onehot = jax.nn.one_hot(labels, args.num_classes)
             loss = -jnp.mean(jnp.sum(
                 jax.nn.log_softmax(logits.astype(jnp.float32)) * onehot, -1))

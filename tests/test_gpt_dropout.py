@@ -1,0 +1,166 @@
+"""Dropout-complete flagship GPT + in-kernel attention dropout.
+
+Ref: ``standalone_gpt.py:285-735`` attention/hidden dropout sites and
+``apex/contrib/csrc/multihead_attn`` / ``fmhalib`` fused (philox
+counter-based) attention dropout; TP stream semantics from
+``tensor_parallel/random.py`` (attention dropout differs per TP rank,
+hidden dropout agrees across the TP group).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.ops.attention import flash_attention
+from apex_tpu.parallel.mesh import build_mesh
+from apex_tpu.transformer.pipeline_parallel.schedules.common import (
+    replicate_loss,
+)
+from apex_tpu.transformer.testing import (
+    GPTConfig,
+    gpt_loss,
+    gpt_param_specs,
+    init_gpt_params,
+)
+
+CFG = GPTConfig(vocab_size=256, max_seq=64, hidden=64, num_layers=2,
+                num_heads=2, dtype=jnp.float32, remat=True,
+                fused_loss=False, attention_dropout=0.1, hidden_dropout=0.1)
+
+
+def _loss(cfg, tp=1, key=None):
+    mesh = build_mesh(tp=tp, pp=1, sp=1,
+                      devices=jax.devices()[:max(tp, 2) if tp > 1 else 1])
+    params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+    tok = jax.random.randint(jax.random.PRNGKey(1), (2, 64), 0, 256)
+    tgt = jnp.roll(tok, -1, 1)
+    specs = gpt_param_specs(cfg)
+
+    def body(p, tok, tgt):
+        return replicate_loss(
+            gpt_loss(p, tok, tgt, cfg, dropout_key=key), mesh,
+            masked_axis=None)
+
+    return float(jax.jit(jax.shard_map(
+        body, mesh=mesh, in_specs=(specs, P(), P()),
+        out_specs=P()))(params, tok, tgt))
+
+
+def test_dropout_train_step_deterministic_and_key_sensitive():
+    k1, k2 = jax.random.split(jax.random.PRNGKey(42))
+    a = _loss(CFG, key=k1)
+    b = _loss(CFG, key=k1)
+    c = _loss(CFG, key=k2)
+    d = _loss(CFG, key=None)  # eval mode: dropout off
+    assert np.isfinite([a, b, c, d]).all()
+    assert a == b, "same dropout key must replay the same masks"
+    assert a != c, "different dropout keys must differ"
+    assert a != d, "dropout must change the loss vs eval mode"
+
+
+def test_dropout_grads_flow_under_remat():
+    cfg = CFG
+    mesh = build_mesh(tp=1, pp=1, sp=1, devices=jax.devices()[:1])
+    params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+    tok = jax.random.randint(jax.random.PRNGKey(1), (2, 64), 0, 256)
+    tgt = jnp.roll(tok, -1, 1)
+    specs = gpt_param_specs(cfg)
+    key = jax.random.PRNGKey(7)
+
+    def body(p, tok, tgt):
+        return replicate_loss(
+            gpt_loss(p, tok, tgt, cfg, dropout_key=key), mesh,
+            masked_axis=None)
+
+    f = jax.jit(jax.value_and_grad(lambda p: jax.shard_map(
+        body, mesh=mesh, in_specs=(specs, P(), P()),
+        out_specs=P())(p, tok, tgt)))
+    (l1, g1), (l2, g2) = f(params), f(params)
+    assert np.isfinite(float(l1))
+    for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+        assert jnp.all(jnp.isfinite(a))
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_dropout_tp2_runs_and_differs_from_tp1_masks():
+    # tp=2 must execute (attention dropout seeds fold the TP rank); the
+    # resulting loss differs from tp=1 because each rank drops its own
+    # entries — while WITHOUT dropout tp=2 matches tp=1 exactly
+    key = jax.random.PRNGKey(3)
+    with_do_tp2 = _loss(CFG, tp=2, key=key)
+    assert np.isfinite(with_do_tp2)
+    # TP-rank-folded attention seeds: tp=2 drops different entries than tp=1
+    assert with_do_tp2 != _loss(CFG, tp=1, key=key)
+    nodrop = dataclasses.replace(CFG, attention_dropout=0.0,
+                                 hidden_dropout=0.0)
+    np.testing.assert_allclose(
+        _loss(nodrop, tp=1), _loss(nodrop, tp=2), rtol=1e-3)
+
+
+def test_sp_with_attention_dropout_raises():
+    cfg = dataclasses.replace(CFG, hidden_dropout=0.0)
+    mesh = build_mesh(tp=1, pp=1, sp=2, devices=jax.devices()[:2])
+    params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+    tok = jax.random.randint(jax.random.PRNGKey(1), (2, 64), 0, 256)
+    specs = gpt_param_specs(cfg)
+
+    def body(p, tok, tgt):
+        return replicate_loss(
+            gpt_loss(p, tok, tgt, cfg, dropout_key=jax.random.PRNGKey(0)),
+            mesh, masked_axis=None)
+
+    with pytest.raises(NotImplementedError, match="sequence parallelism"):
+        jax.jit(jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(specs, P(None, "sp"), P(None, "sp")),
+            out_specs=P()))(params, tok, jnp.roll(tok, -1, 1))
+
+
+# ---------------------------------------------------------------------------
+# kernel-level dropout (pallas interpret mode)
+
+def test_flash_kernel_dropout_block_size_independent():
+    key = jax.random.PRNGKey(0)
+    q = (jax.random.normal(key, (2, 2, 256, 64)) * 0.5).astype(jnp.float32)
+    f = lambda seed, bq, bk: flash_attention(
+        q, q, q, causal=True, dropout_rate=0.1,
+        dropout_seed=jnp.int32(seed), use_pallas=True,
+        block_q=bq, block_k=bk)
+    a, b = f(7, 256, 256), f(7, 128, 64)
+    # identical masks (position-keyed hash); only accumulation-order noise
+    np.testing.assert_allclose(a, b, atol=5e-3)
+    c = f(8, 256, 256)
+    assert float(jnp.max(jnp.abs(a - c))) > 0.05, "seed must change the mask"
+
+
+def test_flash_kernel_dropout_grad_matches_finite_difference():
+    key = jax.random.PRNGKey(1)
+    q = (jax.random.normal(key, (1, 1, 64, 64)) * 0.5).astype(jnp.float32)
+
+    def loss(qq):
+        return jnp.sum(flash_attention(
+            qq, qq, qq, causal=True, dropout_rate=0.2,
+            dropout_seed=jnp.int32(3), use_pallas=True) ** 2)
+
+    g = jax.grad(loss)(q)
+    eps, idx = 1e-3, (0, 0, 5, 7)
+    fd = (loss(q.at[idx].add(eps)) - loss(q.at[idx].add(-eps))) / (2 * eps)
+    # same counter-based mask in fwd and both bwd kernels
+    np.testing.assert_allclose(float(g[idx]), float(fd), rtol=5e-2)
+
+
+def test_flash_kernel_dropout_keep_rate():
+    # all-equal scores -> uniform attention; with v == 1 the output row is
+    # (kept/(rows attended)) / (1-rate): its mean estimates keep probability
+    s = 512
+    q = jnp.zeros((1, 1, s, 64), jnp.float32)
+    v = jnp.ones((1, 1, s, 64), jnp.float32)
+    rate = 0.3
+    o = flash_attention(q, q, v, causal=False, dropout_rate=rate,
+                        dropout_seed=jnp.int32(11), use_pallas=True)
+    # E[o] = 1 (inverted-dropout rescaling), variance ~ 1/(s * (1-r))
+    assert abs(float(jnp.mean(o)) - 1.0) < 0.02

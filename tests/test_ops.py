@@ -226,3 +226,25 @@ def test_fused_dense_gelu_dense():
     h = 0.5 * h * (1 + jax.lax.erf(h / jnp.sqrt(2.0)))
     want = h @ p["kernel2"] + p["bias2"]
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-6)
+
+
+def test_layer_norm_large_hidden_gate():
+    """Ref fast_layer_norm exists for hidden up to 65k: past the VMEM budget
+    the pallas path must decline (fallback to XLA) instead of faulting."""
+    from apex_tpu.ops.layer_norm import _pick_block_rows, layer_norm
+
+    # bench-scale hidden keeps a healthy block; 65k hidden exceeds budget
+    assert _pick_block_rows(1024, 768) == 256
+    assert _pick_block_rows(1024, 16384) in (8, 16)
+    assert _pick_block_rows(1024, 65536) is None
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (8, 65536), jnp.bfloat16)
+    w = jnp.ones((65536,), jnp.bfloat16)
+    b = jnp.zeros((65536,), jnp.bfloat16)
+    y = layer_norm(x, w, b)  # auto: XLA path
+    from apex_tpu.ops.layer_norm import layer_norm_reference
+    np.testing.assert_allclose(
+        np.asarray(y, np.float32),
+        np.asarray(layer_norm_reference(x, w, b), np.float32), atol=1e-2)
+    with pytest.raises(ValueError, match="VMEM"):
+        layer_norm(x, w, b, use_pallas=True)
