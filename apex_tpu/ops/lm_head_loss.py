@@ -228,6 +228,18 @@ def _run_bwd(x2, w, t_local, lse, g, block_n, block_v, interpret):
     nn, nv = _grids(n, v, block_n, block_v)
     t2, g2, lse2 = t_local[:, None], g[:, None], lse[:, None]
 
+    # dw streams X once per vocab block — the opposite trade from dx, which
+    # streams W once per row block. Tall vocab blocks and short row blocks
+    # minimize dw's X re-reads while the (block_v, h) fp32 accumulator and
+    # the (block_n, block_v) score tile stay inside VMEM.
+    bn_dw = 512 if block_n > 512 and n % 512 == 0 else block_n
+    # only widen the vocab block while the (bv_dw, h) fp32 accumulator stays
+    # within a conservative VMEM budget (cf. layer_norm's _VMEM_BUDGET_BYTES)
+    bv_dw = block_v
+    if block_v < 1024 and 1024 * h * 4 <= 8 * 1024 * 1024:
+        bv_dw = 1024
+    nn_dw, nv_dw = _grids(n, v, bn_dw, bv_dw)
+
     dx = pl.pallas_call(
         functools.partial(_dx_kernel, block_n=block_n, block_v=block_v,
                           nv=nv, v_total=v),
@@ -248,19 +260,19 @@ def _run_bwd(x2, w, t_local, lse, g, block_n, block_v, interpret):
     )(t2, g2, lse2, x2, w)
 
     dw = pl.pallas_call(
-        functools.partial(_dw_kernel, block_n=block_n, block_v=block_v,
-                          nn=nn, v_total=v),
-        grid=(nv, nn),
+        functools.partial(_dw_kernel, block_n=bn_dw, block_v=bv_dw,
+                          nn=nn_dw, v_total=v),
+        grid=(nv_dw, nn_dw),
         in_specs=[
-            pl.BlockSpec((block_n, 1), lambda j, i: (i, 0)),
-            pl.BlockSpec((block_n, 1), lambda j, i: (i, 0)),
-            pl.BlockSpec((block_n, 1), lambda j, i: (i, 0)),
-            pl.BlockSpec((block_n, h), lambda j, i: (i, 0)),
-            pl.BlockSpec((block_v, h), lambda j, i: (j, 0)),
+            pl.BlockSpec((bn_dw, 1), lambda j, i: (i, 0)),
+            pl.BlockSpec((bn_dw, 1), lambda j, i: (i, 0)),
+            pl.BlockSpec((bn_dw, 1), lambda j, i: (i, 0)),
+            pl.BlockSpec((bn_dw, h), lambda j, i: (i, 0)),
+            pl.BlockSpec((bv_dw, h), lambda j, i: (j, 0)),
         ],
-        out_specs=pl.BlockSpec((block_v, h), lambda j, i: (j, 0)),
+        out_specs=pl.BlockSpec((bv_dw, h), lambda j, i: (j, 0)),
         out_shape=_sds((v, h), w.dtype, x2, w, t_local, g),
-        scratch_shapes=[pltpu.VMEM((block_v, h), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((bv_dw, h), jnp.float32)],
         compiler_params=None if interpret else pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
@@ -353,7 +365,11 @@ _lm_head_loss.defvjp(_lm_fwd, _lm_bwd)
 # ---------------------------------------------------------------------------
 # Public API
 
-def pallas_fits(n: int, h: int, block_n: int = 512) -> bool:
+DEFAULT_BLOCK_N = 1024
+DEFAULT_BLOCK_V = 512
+
+
+def pallas_fits(n: int, h: int, block_n: int = DEFAULT_BLOCK_N) -> bool:
     """True when the kernel grid covers (n, h) exactly — callers with an
     unfused alternative (e.g. logits+CE) should check this before choosing
     the fused path, because the shape fallback below is a dense fp32
@@ -368,8 +384,8 @@ def lm_head_loss(
     w,
     targets,
     axis_name: Optional[str] = None,
-    block_n: int = 512,
-    block_v: int = 512,
+    block_n: int = DEFAULT_BLOCK_N,
+    block_v: int = DEFAULT_BLOCK_V,
     use_pallas: Optional[bool] = None,
 ):
     """Per-position CE of the projection ``x @ wᵀ`` without materializing it.

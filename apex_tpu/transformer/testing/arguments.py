@@ -1,35 +1,73 @@
 """Megatron-style argument parser for the test/example stack.
 
-Reference: ``apex/transformer/testing/arguments.py`` (808 LoC of Megatron
-flags). The TPU build's source of truth is :class:`GPTConfig`; this parser
-exposes the subset of flags the test stack actually exercises and converts
-them to a config + parallel sizes, so reference-shaped test invocations
-(``--tensor-model-parallel-size 2 --pipeline-model-parallel-size 2 ...``)
-keep working.
+Reference: ``apex/transformer/testing/arguments.py`` (808 LoC / ~150 flags of
+Megatron surface). The TPU build's source of truth is :class:`GPTConfig`;
+this parser accepts the reference-shaped invocations and converts them to a
+config + parallel sizes + optimizer/schedule settings. Three tiers:
+
+* flags that map onto the TPU stack are parsed and *used* (model shape,
+  parallel sizes, dropout, remat/recompute, precision, loss scaling,
+  batch ramp-up, optimizer hyperparameters, train length, seed);
+* recognized-but-inert reference flags parse without error and are listed in
+  ``namespace.inert_flags`` with a warning (the TPU design makes them
+  meaningless — e.g. ``--distributed-backend``, NCCL/DDP plumbing);
+* unknown flags do NOT abort: ``parse_args`` uses ``parse_known_args`` and
+  warns, so any reference-shaped command line runs (the unknown remainder is
+  in ``namespace.unknown_flags``).
 """
 
 from __future__ import annotations
 
 import argparse
-import dataclasses
+import warnings
 from typing import Optional, Sequence, Tuple
 
 import jax.numpy as jnp
 
 from apex_tpu.transformer.testing.standalone_gpt import GPTConfig
 
+# parsed, accepted, and deliberately inert on TPU (XLA owns the concern).
+_INERT_FLAGS = {
+    "--distributed-backend": str,   # collectives are XLA's, not NCCL/gloo
+    "--DDP-impl": str,              # one DDP: parallel.DistributedDataParallel
+    "--local_rank": int,            # no per-process launcher rank under SPMD
+    "--use-cpu-initialization": None,  # init is TP-invariant by construction
+    "--masked-softmax-fusion": None,   # XLA/Pallas fuse unconditionally
+    "--bias-gelu-fusion": None,
+    "--bias-dropout-fusion": None,
+    "--gradient-accumulation-fusion": None,  # optimizers/grad_accumulation
+    "--num-workers": int,           # data loading is the native loader's job
+    "--dataloader-type": str,
+    "--recompute-method": str,      # scan-over-layers has one method
+    "--recompute-num-layers": int,
+    "--layernorm-epsilon": float,   # GPTConfig pins layer_norm's default eps
+}
 
-def parse_args(argv: Optional[Sequence[str]] = None
-               ) -> argparse.Namespace:
+
+def parse_args(argv: Optional[Sequence[str]] = None,
+               allow_unknown: bool = True) -> argparse.Namespace:
     p = argparse.ArgumentParser(description="apex_tpu transformer test args")
     g = p.add_argument_group("model")
     g.add_argument("--num-layers", type=int, default=12)
     g.add_argument("--hidden-size", type=int, default=768)
     g.add_argument("--num-attention-heads", type=int, default=12)
+    g.add_argument("--kv-channels", type=int, default=None)
     g.add_argument("--seq-length", type=int, default=1024)
     g.add_argument("--max-position-embeddings", type=int, default=None)
     g.add_argument("--vocab-size", type=int, default=50304)
     g.add_argument("--ffn-hidden-size", type=int, default=None)
+    g.add_argument("--untie-embeddings-and-output-weights",
+                   action="store_true")
+
+    g = p.add_argument_group("regularization")
+    g.add_argument("--attention-dropout", type=float, default=0.1)
+    g.add_argument("--hidden-dropout", type=float, default=0.1)
+    g.add_argument("--weight-decay", type=float, default=0.01)
+    g.add_argument("--clip-grad", type=float, default=1.0)
+    g.add_argument("--adam-beta1", type=float, default=0.9)
+    g.add_argument("--adam-beta2", type=float, default=0.999)
+    g.add_argument("--adam-eps", type=float, default=1e-8)
+    g.add_argument("--sgd-momentum", type=float, default=0.9)
 
     g = p.add_argument_group("parallel")
     g.add_argument("--tensor-model-parallel-size", type=int, default=1)
@@ -41,12 +79,83 @@ def parse_args(argv: Optional[Sequence[str]] = None
     g = p.add_argument_group("training")
     g.add_argument("--micro-batch-size", type=int, default=1)
     g.add_argument("--global-batch-size", type=int, default=8)
+    g.add_argument("--rampup-batch-size", nargs=3, type=int, default=None)
+    g.add_argument("--train-iters", type=int, default=None)
+    g.add_argument("--train-samples", type=int, default=None)
+    g.add_argument("--exit-interval", type=int, default=None)
+    g.add_argument("--log-interval", type=int, default=100)
+    g.add_argument("--optimizer", default="adam", choices=["adam", "sgd"])
+    g.add_argument("--seed", type=int, default=1234)
+
+    g = p.add_argument_group("learning rate")
     g.add_argument("--lr", type=float, default=1e-4)
+    g.add_argument("--min-lr", type=float, default=0.0)
+    g.add_argument("--lr-decay-style", default="linear",
+                   choices=["constant", "linear", "cosine"])
+    g.add_argument("--lr-decay-iters", type=int, default=None)
+    g.add_argument("--lr-warmup-fraction", type=float, default=None)
+    g.add_argument("--lr-warmup-iters", type=int, default=0)
+
+    g = p.add_argument_group("checkpointing")
+    g.add_argument("--save", type=str, default=None)
+    g.add_argument("--load", type=str, default=None)
+    g.add_argument("--save-interval", type=int, default=None)
+
+    g = p.add_argument_group("mixed precision")
     g.add_argument("--fp16", action="store_true")
     g.add_argument("--bf16", action="store_true")
+    g.add_argument("--loss-scale", type=float, default=None)
+    g.add_argument("--initial-loss-scale", type=float, default=2 ** 32)
+    g.add_argument("--min-loss-scale", type=float, default=1.0)
+    g.add_argument("--loss-scale-window", type=float, default=1000)
+    g.add_argument("--hysteresis", type=int, default=2)
+    g.add_argument("--accumulate-allreduce-grads-in-fp32",
+                   action="store_true")
+
+    g = p.add_argument_group("activation checkpointing")
     g.add_argument("--no-activation-checkpoint", action="store_true",
                    dest="no_remat")
-    return p.parse_args(argv)
+    g.add_argument("--recompute-granularity", default=None,
+                   choices=["full", "selective"])
+
+    g = p.add_argument_group("accepted-but-inert (reference compat)")
+    for flag, typ in _INERT_FLAGS.items():
+        if typ is None:
+            g.add_argument(flag, action="store_true")
+        else:
+            g.add_argument(flag, type=typ, default=None)
+
+    if allow_unknown:
+        args, unknown = p.parse_known_args(argv)
+        if unknown:
+            warnings.warn(
+                f"ignoring unknown reference flags: {unknown}", stacklevel=2)
+        args.unknown_flags = unknown
+    else:
+        args = p.parse_args(argv)
+        args.unknown_flags = []
+
+    # store_true inert flags read False when absent; typed ones default None
+    # (a set 0 — e.g. --local_rank 0 — must still be reported)
+    inert = []
+    for f in _INERT_FLAGS:
+        val = getattr(args, f.lstrip("-").replace("-", "_"), None)
+        if val is not None and val is not False:
+            inert.append(f)
+    if inert:
+        warnings.warn(
+            f"reference flags parsed but inert on TPU: {inert}", stacklevel=2)
+    args.inert_flags = inert
+
+    if args.fp16 and args.bf16:
+        raise ValueError("--fp16 and --bf16 are mutually exclusive")
+    if (args.kv_channels is not None
+            and args.kv_channels * args.num_attention_heads
+            != args.hidden_size):
+        raise ValueError(
+            "kv-channels * num-attention-heads must equal hidden-size "
+            "(independent head dims are not supported)")
+    return args
 
 
 def args_to_config(args: argparse.Namespace) -> GPTConfig:
@@ -60,6 +169,9 @@ def args_to_config(args: argparse.Namespace) -> GPTConfig:
     ffn = args.ffn_hidden_size or 4 * hidden
     if ffn % hidden:
         raise ValueError("ffn_hidden_size must be a multiple of hidden_size")
+    remat_policy = "full"
+    if args.recompute_granularity == "selective":
+        remat_policy = "dots"
     return GPTConfig(
         vocab_size=args.vocab_size,
         max_seq=args.max_position_embeddings or args.seq_length,
@@ -68,7 +180,11 @@ def args_to_config(args: argparse.Namespace) -> GPTConfig:
         num_heads=args.num_attention_heads,
         ffn_mult=ffn // hidden,
         dtype=dtype,
+        tie_embeddings=not args.untie_embeddings_and_output_weights,
         remat=not args.no_remat,
+        remat_policy=remat_policy,
+        attention_dropout=args.attention_dropout,
+        hidden_dropout=args.hidden_dropout,
     )
 
 
@@ -77,3 +193,36 @@ def parallel_sizes(args: argparse.Namespace) -> Tuple[int, int, int]:
     return (args.tensor_model_parallel_size,
             args.pipeline_model_parallel_size,
             args.sequence_parallel_size)
+
+
+def make_optimizer(args: argparse.Namespace):
+    """Namespace -> fused optimizer + optax LR schedule (ref Megatron
+    optimizer/scheduler construction from the same flags)."""
+    import optax
+
+    from apex_tpu.optimizers import FusedAdam, FusedSGD
+
+    total = args.lr_decay_iters or args.train_iters or 10000
+    warmup = args.lr_warmup_iters
+    if args.lr_warmup_fraction is not None:
+        warmup = int(args.lr_warmup_fraction * total)
+    if args.lr_decay_style == "constant":
+        after = optax.constant_schedule(args.lr)
+    elif args.lr_decay_style == "cosine":
+        after = optax.cosine_decay_schedule(
+            args.lr, max(total - warmup, 1),
+            alpha=args.min_lr / args.lr if args.lr else 0.0)
+    else:  # linear
+        after = optax.linear_schedule(
+            args.lr, args.min_lr, max(total - warmup, 1))
+    if warmup > 0:
+        schedule = optax.join_schedules(
+            [optax.linear_schedule(0.0, args.lr, warmup), after], [warmup])
+    else:
+        schedule = after
+    if args.optimizer == "sgd":
+        return FusedSGD(lr=schedule, momentum=args.sgd_momentum,
+                        weight_decay=args.weight_decay), schedule
+    return FusedAdam(lr=schedule, betas=(args.adam_beta1, args.adam_beta2),
+                     eps=args.adam_eps,
+                     weight_decay=args.weight_decay), schedule

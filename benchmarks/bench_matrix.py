@@ -177,26 +177,23 @@ def bench_ddp_syncbn():
 
 
 def bench_ddp_scaling_virtual():
-    """dp=8 vs dp=1 ResNet-50+SyncBN throughput on the SAME (virtual CPU)
-    platform — isolates the DDP+SyncBN program's scaling shape from chip
-    speed. Runs in the re-exec'd 8-device subprocess."""
+    """ResNet-50+SyncBN throughput on an 8-device virtual CPU mesh (the dp
+    mesh follows the platform's device count). The dp=1 comparison runs in a
+    separate 1-device subprocess; the parent computes the scaling ratio."""
     m = _imagenet()
     per, size, iters = 4, 32, 3
+    n_dev = len(jax.devices())
 
-    def run(batch):
-        argv = ["--arch", "resnet50", "--opt-level", "O2", "--sync_bn",
-                "--batch-size", str(batch), "--image-size", str(size),
-                "--iters", str(iters), "--print-freq", "1000"]
-        m.train(m.parse_args(argv))
-        t0 = time.perf_counter()
-        m.train(m.parse_args(argv))
-        return batch * iters / (time.perf_counter() - t0)
-
-    # dp follows the device count: the mesh builder grabs all 8 virtual
-    # devices; a dp=1 comparison run uses a single-device context
-    ips8 = run(per * 8)
-    _emit("resnet50_ddp_syncbn_scaling_8dev_virtual", ips8, "img/s",
-          note="8 virtual CPU devices; ratio vs single-device below")
+    batch = per * n_dev
+    argv = ["--arch", "resnet50", "--opt-level", "O2", "--sync_bn",
+            "--batch-size", str(batch), "--image-size", str(size),
+            "--iters", str(iters), "--print-freq", "1000"]
+    m.train(m.parse_args(argv))
+    t0 = time.perf_counter()
+    m.train(m.parse_args(argv))
+    ips = batch * iters / (time.perf_counter() - t0)
+    _emit(f"resnet50_ddp_syncbn_{n_dev}dev_virtual", ips, "img/s",
+          devices=n_dev)
 
 
 # ---------------------------------------------------------------------------
@@ -257,8 +254,34 @@ CONFIGS = {
 }
 
 
+def _run_virtual(names, n_devices):
+    """Re-exec the named configs on an n-device virtual CPU platform and
+    forward their JSON lines; returns them parsed."""
+    env = dict(os.environ,
+               APEX_TPU_BENCH_VIRTUAL="1",
+               JAX_PLATFORMS="cpu",
+               XLA_FLAGS=(os.environ.get("XLA_FLAGS", "") +
+                          f" --xla_force_host_platform_device_count"
+                          f"={n_devices}"))
+    proc = subprocess.run([sys.executable, os.path.abspath(__file__)] + names,
+                          env=env, check=False, capture_output=True, text=True)
+    rows = []
+    for line in proc.stdout.splitlines():
+        try:
+            rows.append(json.loads(line))
+            print(line, flush=True)
+        except json.JSONDecodeError:
+            pass
+    return rows
+
+
 def main(argv=None):
     names = list((argv if argv is not None else sys.argv[1:]) or CONFIGS)
+    unknown = [n for n in names if n not in CONFIGS]
+    for n in unknown:
+        _emit(f"{n}_FAILED", float("nan"), "error",
+              error=f"unknown config (choose from {sorted(CONFIGS)})")
+    names = [n for n in names if n in CONFIGS]
     virtual = [n for n in names if CONFIGS[n][1]]
     local = [n for n in names if not CONFIGS[n][1]]
     if os.environ.get("APEX_TPU_BENCH_VIRTUAL"):
@@ -272,13 +295,19 @@ def main(argv=None):
                   error=f"{type(e).__name__}: {str(e)[:200]}")
 
     if virtual:
-        env = dict(os.environ,
-                   APEX_TPU_BENCH_VIRTUAL="1",
-                   JAX_PLATFORMS="cpu",
-                   XLA_FLAGS=(os.environ.get("XLA_FLAGS", "") +
-                              " --xla_force_host_platform_device_count=8"))
-        subprocess.run([sys.executable, os.path.abspath(__file__)] + virtual,
-                       env=env, check=False)
+        rows = _run_virtual(virtual, 8)
+        if "ddp_scaling_virtual" in virtual:
+            # same program on 1 virtual device -> the DP scaling ratio
+            rows1 = _run_virtual(["ddp_scaling_virtual"], 1)
+            v8 = next((r["value"] for r in rows
+                       if r["metric"].startswith("resnet50_ddp_syncbn_8dev")),
+                      None)
+            v1 = next((r["value"] for r in rows1
+                       if r["metric"].startswith("resnet50_ddp_syncbn_1dev")),
+                      None)
+            if v8 and v1:
+                _emit("resnet50_ddp_syncbn_scaling_ratio_8dev_vs_1dev",
+                      v8 / v1, "x", ideal=8.0)
 
 
 if __name__ == "__main__":

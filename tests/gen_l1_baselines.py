@@ -1,0 +1,79 @@
+"""Regenerate tests/l1_baselines.json (ref tests/L1/common/run_test.sh's
+`baselines/` files: per-config stored loss curves the sweep is compared to).
+
+Run: ``PYTHONPATH=. python tests/gen_l1_baselines.py`` after an intentional
+numerics change, and commit the diff. The environment is pinned to the SAME
+8-device virtual CPU platform the test conftest forces — baselines depend on
+the dp degree (DDP averaging, SyncBN statistics).
+"""
+
+import importlib.util
+import json
+import os
+import pathlib
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+
+_ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+# {opt_level x sync_bn x loss_scale} cross-product on a small arch (compile
+# cost), plus one flagship ResNet-50 config (ref runs ResNet-50 throughout).
+CROSS_PRODUCT = [
+    ("resnet18", "O0", False, None),
+    ("resnet18", "O1", False, None),
+    ("resnet18", "O1", False, "128.0"),
+    ("resnet18", "O2", False, None),
+    ("resnet18", "O2", True, None),
+    ("resnet18", "O2", False, "128.0"),
+    ("resnet18", "O3", False, None),
+    ("resnet18", "O3", True, "128.0"),
+    ("resnet50", "O2", True, "128.0"),
+]
+
+# batch 32 over the dp=8 mesh = per-device batch 4. Smaller per-device
+# batches degrade the harness: at 1, BatchNorm over the (1, 1, 1, C)
+# last-stage features degenerates to its bias and erases all conv numerics
+# (O0 == O1 bit-exactly); at 2, the near-singular variance estimates amplify
+# bf16 rounding into chaotic trajectories that no tolerance can pin.
+BASE = ["--iters", "3", "--batch-size", "32", "--image-size", "32",
+        "--num-classes", "10", "--deterministic", "--lr", "0.0001"]
+
+
+def config_key(arch, opt_level, sync_bn, loss_scale):
+    return f"{arch}_{opt_level}_{sync_bn}_{loss_scale}"
+
+
+def config_argv(arch, opt_level, sync_bn, loss_scale):
+    argv = ["--arch", arch, "--opt-level", opt_level] + BASE
+    if sync_bn:
+        argv.append("--sync_bn")
+    if loss_scale is not None:
+        argv += ["--loss-scale", loss_scale]
+    return argv
+
+
+def load_trainer():
+    spec = importlib.util.spec_from_file_location(
+        "imagenet_main_amp", _ROOT / "examples" / "imagenet" / "main_amp.py")
+    m = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(m)
+    return m
+
+
+def main():
+    m = load_trainer()
+    out = {}
+    for cfg in CROSS_PRODUCT:
+        losses = m.train(m.parse_args(config_argv(*cfg)))
+        out[config_key(*cfg)] = [float(x) for x in losses]
+        print(config_key(*cfg), out[config_key(*cfg)], flush=True)
+    path = _ROOT / "tests" / "l1_baselines.json"
+    path.write_text(json.dumps(out, indent=1) + "\n")
+    print("wrote", path)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -173,3 +173,53 @@ def test_autocast_utils():
     out = _cast_if_autocast_enabled(jnp.ones((2,), jnp.float32),
                                     jnp.asarray([1], jnp.int32))
     assert out[0].dtype == jnp.bfloat16 and out[1].dtype == jnp.int32
+
+
+def test_arguments_reference_shaped_invocation():
+    """A realistic Megatron-style command line (ref arguments.py surface):
+    mapped flags are used, inert flags warn but parse, unknown flags warn
+    but do not abort."""
+    import warnings as _w
+
+    from apex_tpu.transformer.testing.arguments import (
+        args_to_config, make_optimizer, parse_args)
+
+    argv = [
+        "--num-layers", "24", "--hidden-size", "1024",
+        "--num-attention-heads", "16", "--seq-length", "512",
+        "--max-position-embeddings", "512", "--vocab-size", "32000",
+        "--attention-dropout", "0.1", "--hidden-dropout", "0.1",
+        "--weight-decay", "0.01", "--adam-beta2", "0.95",
+        "--micro-batch-size", "4", "--global-batch-size", "256",
+        "--rampup-batch-size", "32", "32", "1000",
+        "--train-iters", "1000", "--lr", "3e-4", "--min-lr", "3e-5",
+        "--lr-decay-style", "cosine", "--lr-warmup-fraction", "0.01",
+        "--bf16", "--loss-scale", "4096",
+        "--recompute-granularity", "selective",
+        "--untie-embeddings-and-output-weights",
+        "--tensor-model-parallel-size", "2",
+        "--distributed-backend", "nccl",          # inert on TPU
+        "--some-flag-we-never-heard-of", "7",     # unknown
+    ]
+    with _w.catch_warnings(record=True) as caught:
+        _w.simplefilter("always")
+        ns = parse_args(argv)
+    msgs = "".join(str(c.message) for c in caught)
+    assert "unknown" in msgs and "inert" in msgs
+    assert ns.unknown_flags == ["--some-flag-we-never-heard-of", "7"]
+    assert "--distributed-backend" in ns.inert_flags
+
+    cfg = args_to_config(ns)
+    assert cfg.hidden == 1024 and cfg.num_layers == 24
+    assert cfg.attention_dropout == 0.1 and cfg.hidden_dropout == 0.1
+    assert cfg.remat_policy == "dots"
+    assert not cfg.tie_embeddings
+
+    opt, schedule = make_optimizer(ns)
+    # warmup then cosine decay toward min-lr
+    assert float(schedule(0)) < 1e-6
+    assert abs(float(schedule(10)) - 3e-4) < 1e-5  # end of 10-iter warmup
+    assert float(schedule(1000)) < 3.2e-5 + 1e-6
+    state = opt.init({"w": jnp.ones((4, 4))})
+    u, _ = opt.update({"w": jnp.ones((4, 4))}, state, {"w": jnp.ones((4, 4))})
+    assert jnp.all(jnp.isfinite(u["w"]))
