@@ -26,10 +26,13 @@ import jax.numpy as jnp
 
 
 class LossScalerState(NamedTuple):
-    """Checkpointable scaler state (ref ``scaler.py:33-64`` attributes)."""
+    """Checkpointable scaler state (ref ``scaler.py:33-64`` attributes;
+    ``hysteresis_left`` is the Megatron GradScaler consecutive-overflow
+    tolerance counter, ref ``transformer/amp/grad_scaler.py:61-106``)."""
 
     loss_scale: jnp.ndarray  # f32 scalar
     unskipped: jnp.ndarray  # i32 scalar — clean steps since last growth
+    hysteresis_left: jnp.ndarray  # i32 scalar — overflows until backoff
 
 
 class LossScaler:
@@ -49,6 +52,7 @@ class LossScaler:
         min_loss_scale: Optional[float] = None,
         max_loss_scale: float = 2.0 ** 24,
         backoff_factor: Optional[float] = None,
+        hysteresis: int = 1,
     ):
         if loss_scale == "dynamic":
             self.dynamic = True
@@ -65,12 +69,16 @@ class LossScaler:
         )
         self.min_loss_scale = min_loss_scale if min_loss_scale is not None else 1.0
         self.max_loss_scale = max_loss_scale
+        # N consecutive overflows are tolerated before the scale backs off
+        # (Megatron default 2; 1 = back off immediately, the apex.amp policy)
+        self.hysteresis = int(hysteresis)
 
     # -- state ------------------------------------------------------------
     def init_state(self) -> LossScalerState:
         return LossScalerState(
             loss_scale=jnp.asarray(self._init_scale, jnp.float32),
             unskipped=jnp.asarray(0, jnp.int32),
+            hysteresis_left=jnp.asarray(self.hysteresis, jnp.int32),
         )
 
     def loss_scale(self, state: LossScalerState) -> jnp.ndarray:
@@ -131,8 +139,17 @@ class LossScaler:
 
         new_unskipped = jnp.where(overflow, 0, state.unskipped + 1)
         grow = new_unskipped >= self.scale_window
+        # hysteresis (Megatron-LM DynamicGradScaler semantics): each overflow
+        # spends one credit, backoff fires at zero credits, and credits
+        # refill ONLY when the scale grows after scale_window consecutive
+        # clean steps — a lone clean step between overflows does not reset
+        # the tolerance. hysteresis=1 degenerates to immediate backoff.
+        new_hyst = jnp.where(
+            overflow, state.hysteresis_left - 1,
+            jnp.where(grow, self.hysteresis, state.hysteresis_left))
+        backoff = overflow & (new_hyst <= 0)
         new_scale = jnp.where(
-            overflow,
+            backoff,
             jnp.maximum(state.loss_scale * self.backoff_factor, self.min_loss_scale),
             jnp.where(
                 grow,
@@ -141,7 +158,9 @@ class LossScaler:
             ),
         )
         new_unskipped = jnp.where(grow, 0, new_unskipped)
-        return LossScalerState(new_scale, new_unskipped.astype(jnp.int32)), overflow
+        return LossScalerState(
+            new_scale, new_unskipped.astype(jnp.int32),
+            jnp.maximum(new_hyst, 0).astype(jnp.int32)), overflow
 
     # -- distributed ------------------------------------------------------
     @staticmethod
@@ -159,10 +178,14 @@ class LossScaler:
         return {
             "loss_scale": float(state.loss_scale),
             "unskipped": int(state.unskipped),
+            "hysteresis_left": int(state.hysteresis_left),
         }
 
     def load_state_dict(self, d: dict) -> LossScalerState:
         return LossScalerState(
             loss_scale=jnp.asarray(d["loss_scale"], jnp.float32),
             unskipped=jnp.asarray(d["unskipped"], jnp.int32),
+            # pre-hysteresis checkpoints: full credits (the configured value)
+            hysteresis_left=jnp.asarray(
+                d.get("hysteresis_left", self.hysteresis), jnp.int32),
         )

@@ -236,8 +236,8 @@ def _run_bwd(x2, w, t_local, lse, g, block_n, block_v, interpret):
     # only widen the vocab block while the (bv_dw, h) fp32 accumulator stays
     # within a conservative VMEM budget (cf. layer_norm's _VMEM_BUDGET_BYTES)
     bv_dw = block_v
-    if block_v < 1024 and 1024 * h * 4 <= 8 * 1024 * 1024:
-        bv_dw = 1024
+    if block_v < 1024 <= v and 1024 * h * 4 <= 8 * 1024 * 1024:
+        bv_dw = 1024  # never wider than the vocab shard (caller clamps ≤ v)
     nn_dw, nv_dw = _grids(n, v, bn_dw, bv_dw)
 
     dx = pl.pallas_call(
@@ -367,6 +367,22 @@ _lm_head_loss.defvjp(_lm_fwd, _lm_bwd)
 
 DEFAULT_BLOCK_N = 1024
 DEFAULT_BLOCK_V = 512
+_MIN_BLOCK_N = 128
+
+
+def _resolve_block_n(n: int, block_n: int) -> Optional[int]:
+    """Largest block ≤ ``block_n`` that divides ``n`` (halving steps down to
+    the 128-row floor, sublane-aligned); None when no grid covers ``n``.
+    ``pallas_fits`` and ``lm_head_loss`` both use this, so the gate and the
+    op cannot disagree."""
+    if n <= 0 or n % 8:
+        return None
+    b = min(block_n, n)
+    while b >= _MIN_BLOCK_N:
+        if n % b == 0 and b % 8 == 0:
+            return b
+        b //= 2
+    return n if n < _MIN_BLOCK_N else None
 
 
 def pallas_fits(n: int, h: int, block_n: int = DEFAULT_BLOCK_N) -> bool:
@@ -376,7 +392,7 @@ def pallas_fits(n: int, h: int, block_n: int = DEFAULT_BLOCK_N) -> bool:
     reference, not a tuned kernel."""
     if not _HAS_PALLAS:
         return False
-    return n % block_n == 0 and h % 128 == 0
+    return _resolve_block_n(n, block_n) is not None and h % 128 == 0
 
 
 def lm_head_loss(
@@ -400,14 +416,16 @@ def lm_head_loss(
     x2 = x.reshape(-1, h)
     t1 = targets.reshape(-1)
     n = x2.shape[0]
-    bn = min(block_n, n)
+    bn = _resolve_block_n(n, block_n)
+    fits = _HAS_PALLAS and bn is not None and h % 128 == 0
     if use_pallas is None:
-        use_pallas = (pallas_fits(n, h, bn)
-                      and jax.default_backend() == "tpu")
-    elif use_pallas and not pallas_fits(n, h, bn):
+        use_pallas = fits and jax.default_backend() == "tpu"
+    elif use_pallas and not fits:
         raise ValueError(
-            f"pallas lm_head_loss needs rows ({n}) divisible by block_n "
-            f"({bn}) and hidden ({h}) divisible by 128")
+            f"pallas lm_head_loss needs pallas available, a row block "
+            f"dividing rows ({n}), and hidden ({h}) divisible by 128")
+    if bn is None:
+        bn = n  # dense impl ignores the block size
     if use_pallas:
         impl = ("pallas" if jax.default_backend() == "tpu"
                 else "pallas_interpret")

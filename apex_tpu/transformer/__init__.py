@@ -13,6 +13,8 @@ __all__ = [
     "pipeline_parallel",
     "functional",
     "amp",
+    "moe",
+    "sequence_parallel",
 ]
 
 

@@ -1,0 +1,227 @@
+"""Mixture-of-Experts layer with expert parallelism (EP) — TPU-native.
+
+The reference has no MoE/expert parallelism (SURVEY §2.3 marks EP "not
+present"); this module is the north-star extension that completes the
+parallelism checklist alongside ring/Ulysses sequence parallelism. The
+design follows the GShard/Switch capacity-factor formulation, built the
+TPU way:
+
+* **Static shapes everywhere.** Token→expert assignment uses a fixed
+  per-expert capacity ``C``; overflowing tokens are dropped from the expert
+  path (their output is the zero vector, so the surrounding residual
+  connection passes them through unchanged). No dynamic shapes, no host
+  round-trips — the whole layer is one traced program.
+* **EP rides the data-parallel axis.** Experts are sharded over ``ep``
+  (default: the ``dp`` mesh axis — the standard ep ⊆ dp layout): each rank
+  holds ``E / ep`` experts and routes its local tokens to *global* experts
+  with one ``lax.all_to_all`` each way. On TPU the all-to-all maps onto the
+  ICI torus natively.
+* **TP composes inside the expert.** Expert FFN weights carry the usual
+  Megatron column/row split on the hidden dim; the TP collectives are the
+  same copy/reduce pair as ``tensor_parallel.layers`` (identity-fwd/psum-bwd
+  on entry, psum-fwd/identity-bwd on exit).
+
+Routing math (fp32, regardless of model dtype): top-k gates, normalized
+over the selected k (GShard top-2 convention), position-in-expert by
+priority cumsum (all ranks' top-1 choices outrank top-2), load-balance
+auxiliary loss ``E · Σ_e f_e · p̄_e`` (Switch eq. 4) and router z-loss.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.parallel.mesh import DP_AXIS, TP_AXIS
+from apex_tpu.transformer.tensor_parallel.mappings import (
+    copy_to_tensor_model_parallel_region,
+    reduce_from_tensor_model_parallel_region,
+)
+
+Pytree = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    """Static MoE hyper-parameters (one dataclass, SURVEY §5 config style)."""
+
+    num_experts: int
+    hidden: int
+    ffn_hidden: int
+    top_k: int = 2
+    # capacity per expert = ceil(top_k * tokens / num_experts) * factor
+    capacity_factor: float = 1.25
+    # weight of the load-balance aux loss in `moe_mlp`'s returned aux dict
+    lb_loss_weight: float = 1e-2
+    z_loss_weight: float = 1e-3
+    dtype: Any = jnp.bfloat16
+
+    def capacity(self, tokens_per_rank: int) -> int:
+        per = self.top_k * tokens_per_rank / self.num_experts
+        cap = int(per * self.capacity_factor) + 1
+        # keep the lane dim friendly: round up to 8 (sublane) when roomy
+        return max(8, -(-cap // 8) * 8) if cap > 8 else max(1, cap)
+
+
+def init_moe_params(rng, cfg: MoEConfig, ep: int = 1, tp: int = 1) -> Pytree:
+    """Global-shape parameter pytree. Expert weights lead with the GLOBAL
+    expert dim [E]; :func:`moe_param_specs` shards it over ``ep`` and the
+    ffn dim over ``tp``."""
+    if cfg.num_experts % ep:
+        raise ValueError(
+            f"num_experts ({cfg.num_experts}) not divisible by ep ({ep})")
+    if cfg.ffn_hidden % tp:
+        raise ValueError(
+            f"ffn_hidden ({cfg.ffn_hidden}) not divisible by tp ({tp})")
+    kr, k1, k2 = jax.random.split(rng, 3)
+    e, h, f = cfg.num_experts, cfg.hidden, cfg.ffn_hidden
+    dt = cfg.dtype
+    return {
+        # router stays fp32: its output feeds softmax/top-k decisions
+        "router": jax.random.normal(kr, (h, e), jnp.float32) * 0.02,
+        "fc1_kernel": (jax.random.normal(k1, (e, h, f)) * 0.02).astype(dt),
+        "fc1_bias": jnp.zeros((e, f), dt),
+        "fc2_kernel": (jax.random.normal(k2, (e, f, h)) * 0.02).astype(dt),
+        "fc2_bias": jnp.zeros((e, h), dt),
+    }
+
+
+def moe_param_specs(ep_axis: Optional[str] = DP_AXIS) -> Pytree:
+    """PartitionSpecs for :func:`init_moe_params`: experts over ``ep_axis``,
+    expert FFN dim over tp (Megatron column/row split)."""
+    from jax.sharding import PartitionSpec as P
+
+    return {
+        "router": P(),
+        "fc1_kernel": P(ep_axis, None, TP_AXIS),
+        "fc1_bias": P(ep_axis, TP_AXIS),
+        "fc2_kernel": P(ep_axis, TP_AXIS, None),
+        "fc2_bias": P(ep_axis, None),
+    }
+
+
+# ---------------------------------------------------------------------------
+# routing
+
+
+def _route(logits32, top_k: int, capacity: int):
+    """Token-choice top-k routing with per-expert capacity.
+
+    ``logits32``: (T, E) fp32. Returns ``(dispatch, combine, aux)`` where
+    ``dispatch`` is a boolean (T, E, C) assignment, ``combine`` the fp32
+    gate-weighted version, and ``aux`` carries the load stats.
+    """
+    t, e = logits32.shape
+    probs = jax.nn.softmax(logits32, axis=-1)
+    gate, idx = lax.top_k(probs, top_k)  # (T, k)
+    # GShard: renormalize the selected gates over the k choices
+    gate = gate / jnp.maximum(jnp.sum(gate, axis=-1, keepdims=True), 1e-9)
+
+    onehot = jax.nn.one_hot(idx, e, dtype=jnp.float32)  # (T, k, E)
+    # priority: every token's slot-0 choice outranks any slot-1 choice —
+    # order the cumsum (k, T, E) so rank-0 rows come first
+    sel_kt = onehot.transpose(1, 0, 2).reshape(top_k * t, e)
+    pos_kt = jnp.cumsum(sel_kt, axis=0) - sel_kt  # 0-based slot in expert
+    pos = pos_kt.reshape(top_k, t, e).transpose(1, 0, 2)  # (T, k, E)
+    keep = onehot * (pos < capacity)
+    slot = jnp.sum(pos * keep, axis=-1).astype(jnp.int32)  # (T, k) slot id
+
+    # (T, k, E, C) -> reduce k: a token occupies ≤1 slot per expert
+    slot_oh = jax.nn.one_hot(slot, capacity, dtype=jnp.float32)  # (T, k, C)
+    dispatch = jnp.einsum("tke,tkc->tec", keep, slot_oh)
+    combine = jnp.einsum("tke,tkc,tk->tec", keep, slot_oh, gate)
+
+    # Switch aux loss: E * sum_e (fraction routed to e) * (mean prob of e).
+    # "routed" counts the top-1 assignment before capacity (standard form).
+    frac = jnp.mean(onehot[:, 0, :], axis=0)
+    lb_loss = e * jnp.sum(frac * jnp.mean(probs, axis=0))
+    z = jax.nn.logsumexp(logits32, axis=-1)
+    z_loss = jnp.mean(z * z)
+    kept = jnp.sum(keep) / jnp.maximum(jnp.sum(onehot), 1.0)
+    aux = {"lb_loss": lb_loss, "z_loss": z_loss, "fraction_kept": kept}
+    return dispatch, combine, aux
+
+
+# ---------------------------------------------------------------------------
+# expert compute (local experts, TP-sharded FFN)
+
+
+def _expert_ffn(p, x):
+    """``x``: (E_local, N, h) TP-replicated -> (E_local, N, h). Megatron
+    split on the ffn dim: fc1 column-parallel, gelu, fc2 row-parallel."""
+    x = copy_to_tensor_model_parallel_region(x)
+    y = jnp.einsum("enh,ehf->enf", x, p["fc1_kernel"],
+                   preferred_element_type=jnp.float32).astype(x.dtype)
+    y = y + p["fc1_bias"][:, None, :]
+    y = jax.nn.gelu(y, approximate=True)
+    y = jnp.einsum("enf,efh->enh", y, p["fc2_kernel"],
+                   preferred_element_type=jnp.float32).astype(x.dtype)
+    y = reduce_from_tensor_model_parallel_region(y)
+    return y + p["fc2_bias"][:, None, :]
+
+
+# ---------------------------------------------------------------------------
+# the layer
+
+
+def moe_mlp(params, x, cfg: MoEConfig, ep_axis: Optional[str] = DP_AXIS
+            ) -> Tuple[jax.Array, dict]:
+    """MoE FFN over ``x`` (..., h). Call inside a mesh program; tokens are
+    this rank's local shard, experts are sharded over ``ep_axis`` (pass
+    ``None`` for a single-rank/no-EP layer). Returns ``(out, aux)``;
+    ``aux['loss']`` is the weighted router auxiliary loss (psum-mean it over
+    the data axis alongside the main loss).
+    """
+    lead = x.shape[:-1]
+    h = x.shape[-1]
+    xf = x.reshape(-1, h)
+    t = xf.shape[0]
+    e = cfg.num_experts
+    cap = cfg.capacity(t)
+
+    logits = jnp.dot(xf.astype(jnp.float32), params["router"])
+    dispatch, combine, aux = _route(logits, cfg.top_k, cap)
+
+    # (T, h) -> (E, C, h): zero rows where a slot is unfilled
+    exp_in = jnp.einsum("tec,th->ech", dispatch.astype(x.dtype), xf)
+
+    if ep_axis is not None:
+        ep = lax.axis_size(ep_axis)
+    else:
+        ep = 1
+    if ep > 1:
+        e_local = e // ep
+        # exchange: split global experts over ranks, gather every rank's
+        # contribution for the local experts along the token dim
+        exp_in = lax.all_to_all(exp_in, ep_axis, split_axis=0, concat_axis=1,
+                                tiled=True)  # (E/ep, ep*C, h)
+        exp_out = _expert_ffn(_local_experts(params, ep_axis, e_local),
+                              exp_in)
+        exp_out = lax.all_to_all(exp_out, ep_axis, split_axis=1,
+                                 concat_axis=0, tiled=True)  # (E, C, h)
+    else:
+        exp_out = _expert_ffn(params, exp_in)
+
+    out = jnp.einsum("tec,ech->th", combine.astype(x.dtype), exp_out)
+    aux = dict(aux)
+    aux["loss"] = (cfg.lb_loss_weight * aux["lb_loss"]
+                   + cfg.z_loss_weight * aux["z_loss"])
+    return out.reshape(*lead, h), aux
+
+
+def _local_experts(params, ep_axis: str, e_local: int) -> Pytree:
+    """Slice this rank's expert shard out of params that arrived replicated
+    (inside shard_map the spec normally delivers them pre-sliced; this
+    handles the replicated-params case, e.g. pure-pjit callers)."""
+    fc1 = params["fc1_kernel"]
+    if fc1.shape[0] == e_local:
+        return params  # already the local shard (shard_map + specs)
+    start = lax.axis_index(ep_axis) * e_local
+    return {
+        k: lax.dynamic_slice_in_dim(params[k], start, e_local, 0)
+        for k in ("fc1_kernel", "fc1_bias", "fc2_kernel", "fc2_bias")
+    }

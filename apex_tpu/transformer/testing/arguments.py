@@ -195,6 +195,31 @@ def parallel_sizes(args: argparse.Namespace) -> Tuple[int, int, int]:
             args.sequence_parallel_size)
 
 
+def _iters_from_samples(args: argparse.Namespace) -> Optional[int]:
+    """Iteration count implied by ``--train-samples``, walking the batch
+    ramp-up when active (ramp-phase iterations consume fewer samples each,
+    so a plain samples/global-batch division would end LR decay early)."""
+    if not args.train_samples:
+        return None
+    if args.rampup_batch_size is None:
+        return args.train_samples // args.global_batch_size
+    # mirror RampupBatchsizeNumMicroBatches: batch grows from start by
+    # increment every ramp_samples/num_increments consumed samples
+    start, inc, ramp_samples = (int(v) for v in args.rampup_batch_size)
+    num_inc = max((args.global_batch_size - start) // inc, 1)
+    per_level = ramp_samples / num_inc
+    iters, consumed, batch = 0, 0, start
+    while consumed < min(ramp_samples, args.train_samples):
+        batch = min(start + int(consumed / per_level) * inc,
+                    args.global_batch_size)
+        consumed += batch
+        iters += 1
+    remaining = args.train_samples - consumed
+    if remaining > 0:
+        iters += remaining // args.global_batch_size
+    return iters
+
+
 def make_optimizer(args: argparse.Namespace):
     """Namespace -> fused optimizer + optax LR schedule (ref Megatron
     optimizer/scheduler construction from the same flags)."""
@@ -202,7 +227,8 @@ def make_optimizer(args: argparse.Namespace):
 
     from apex_tpu.optimizers import FusedAdam, FusedSGD
 
-    total = args.lr_decay_iters or args.train_iters or 10000
+    total = (args.lr_decay_iters or args.train_iters
+             or _iters_from_samples(args) or 10000)
     warmup = args.lr_warmup_iters
     if args.lr_warmup_fraction is not None:
         warmup = int(args.lr_warmup_fraction * total)
@@ -226,3 +252,96 @@ def make_optimizer(args: argparse.Namespace):
     return FusedAdam(lr=schedule, betas=(args.adam_beta1, args.adam_beta2),
                      eps=args.adam_eps,
                      weight_decay=args.weight_decay), schedule
+
+
+def make_loss_scaler(args: argparse.Namespace):
+    """Namespace -> :class:`apex_tpu.amp.LossScaler` (ref Megatron
+    ``--loss-scale*``/``--hysteresis`` wiring into its GradScaler). Static
+    scale when ``--loss-scale`` is given, dynamic under ``--fp16``, and None
+    for bf16/fp32 runs (TPU bf16 needs no scaling — the flags would be
+    wasted work, not wrong answers)."""
+    from apex_tpu.amp.scaler import LossScaler
+
+    if args.loss_scale is not None:
+        return LossScaler(args.loss_scale)
+    if args.fp16:
+        return LossScaler(
+            "dynamic",
+            init_scale=args.initial_loss_scale,
+            min_loss_scale=args.min_loss_scale,
+            scale_window=args.loss_scale_window,
+            hysteresis=args.hysteresis,
+        )
+    return None
+
+
+def make_microbatch_calculator(args: argparse.Namespace,
+                               data_parallel_size: int, rank: int = 0):
+    """Namespace -> microbatch calculator (ref ``--rampup-batch-size`` /
+    ``--global-batch-size`` / ``--micro-batch-size`` into
+    ``build_num_microbatches_calculator``)."""
+    from apex_tpu.transformer.pipeline_parallel.microbatches import (
+        build_num_microbatches_calculator,
+    )
+
+    return build_num_microbatches_calculator(
+        rank, args.rampup_batch_size, args.global_batch_size,
+        args.micro_batch_size, data_parallel_size)
+
+
+def ddp_options(args: argparse.Namespace) -> dict:
+    """Namespace -> :class:`parallel.DistributedDataParallel` kwargs
+    (``--accumulate-allreduce-grads-in-fp32`` -> fp32 grad communication,
+    the ref ``allreduce_always_fp32``/``main_grad`` pathway)."""
+    return {"allreduce_always_fp32": args.accumulate_allreduce_grads_in_fp32}
+
+
+class Checkpointer:
+    """``--save``/``--load``/``--save-interval`` wired to
+    ``utils.checkpoint`` (ref Megatron save/load_checkpoint surface)."""
+
+    def __init__(self, save: Optional[str], load: Optional[str],
+                 save_interval: Optional[int]):
+        self.save_dir = save
+        self.load_dir = load if load is not None else save
+        self.save_interval = save_interval
+
+    def load(self, target=None):
+        """Restore the latest checkpoint from ``--load`` (None when absent
+        or the directory is empty)."""
+        import os
+        import re
+
+        from apex_tpu.utils.checkpoint import load_checkpoint
+
+        if not self.load_dir or not os.path.isdir(self.load_dir):
+            return None
+        found = {}
+        for d in os.listdir(self.load_dir):
+            # anchored: orbax temp dirs from an interrupted save
+            # (step_N.orbax-checkpoint-tmp-*) must not shadow step_N
+            m = re.fullmatch(r"step_(\d+)(\.npz\.pkl)?", d)
+            if m:
+                found[int(m.group(1))] = d
+        if not found:
+            return None
+        return load_checkpoint(
+            os.path.join(self.load_dir, found[max(found)]), target)
+
+    def maybe_save(self, state, step: int) -> bool:
+        """Save when ``--save`` is set and ``step`` hits the interval."""
+        import os
+
+        from apex_tpu.utils.checkpoint import save_checkpoint
+
+        if not self.save_dir:
+            return False
+        if self.save_interval and step % self.save_interval:
+            return False
+        os.makedirs(self.save_dir, exist_ok=True)
+        save_checkpoint(os.path.join(self.save_dir, f"step_{step}"), state)
+        return True
+
+
+def make_checkpointer(args: argparse.Namespace) -> Checkpointer:
+    return Checkpointer(args.save, args.load, args.save_interval)

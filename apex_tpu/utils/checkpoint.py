@@ -53,9 +53,12 @@ def load_checkpoint(path: str, target: Optional[Pytree] = None) -> Pytree:
             ckptr = ocp.PyTreeCheckpointer()
             restored = ckptr.restore(path)
             if target is not None:
+                # scalar (non-array) target leaves — e.g. a scaler
+                # state_dict's plain floats/ints — restore as-is
                 restored = jax.tree_util.tree_map(
-                    lambda t, r: np.asarray(r, dtype=t.dtype), target,
-                    restored)
+                    lambda t, r: (np.asarray(r, dtype=t.dtype)
+                                  if hasattr(t, "dtype") else type(t)(r)),
+                    target, restored)
             return restored
     except ImportError:
         pass

@@ -272,6 +272,10 @@ def _run_virtual(names, n_devices):
             print(line, flush=True)
         except json.JSONDecodeError:
             pass
+    if proc.returncode != 0:
+        tail = proc.stderr.strip().splitlines()[-3:]
+        _emit("virtual_subprocess_FAILED", float("nan"), "error",
+              configs=names, rc=proc.returncode, stderr_tail=" | ".join(tail))
     return rows
 
 

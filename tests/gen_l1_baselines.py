@@ -13,9 +13,18 @@ import os
 import pathlib
 import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
-                           + " --xla_force_host_platform_device_count=8")
+
+def _pin_platform():
+    """Force the 8-device virtual CPU platform (same recipe as conftest.py).
+    Called from ``main()`` only — importing this module for its constants
+    (test_l1_determinism does) must not mutate the environment."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    if "xla_force_host_platform_device_count" not in os.environ.get(
+            "XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8").strip()
+
 
 _ROOT = pathlib.Path(__file__).resolve().parent.parent
 
@@ -64,6 +73,7 @@ def load_trainer():
 
 
 def main():
+    _pin_platform()
     m = load_trainer()
     out = {}
     for cfg in CROSS_PRODUCT:

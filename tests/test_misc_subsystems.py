@@ -223,3 +223,107 @@ def test_arguments_reference_shaped_invocation():
     state = opt.init({"w": jnp.ones((4, 4))})
     u, _ = opt.update({"w": jnp.ones((4, 4))}, state, {"w": jnp.ones((4, 4))})
     assert jnp.all(jnp.isfinite(u["w"]))
+
+
+def test_arguments_flag_wiring(tmp_path):
+    """The first-tier flags the docstring claims are *used* must actually
+    construct the subsystem they name: loss scaler, microbatch ramp-up,
+    DDP fp32 comm, checkpointer (previously parsed-but-unconsumed)."""
+    from apex_tpu.amp.scaler import LossScaler
+    from apex_tpu.transformer.pipeline_parallel.microbatches import (
+        RampupBatchsizeNumMicroBatches,
+    )
+    from apex_tpu.transformer.testing.arguments import (
+        ddp_options,
+        make_checkpointer,
+        make_loss_scaler,
+        make_microbatch_calculator,
+        make_optimizer,
+        parse_args,
+    )
+
+    ns = parse_args([
+        "--num-layers", "2", "--hidden-size", "64",
+        "--num-attention-heads", "4", "--seq-length", "32",
+        "--vocab-size", "1024", "--fp16",
+        "--initial-loss-scale", "1024", "--loss-scale-window", "500",
+        "--hysteresis", "2", "--min-loss-scale", "2",
+        "--rampup-batch-size", "16", "16", "640",
+        "--global-batch-size", "64", "--micro-batch-size", "4",
+        "--train-samples", "128000",
+        "--accumulate-allreduce-grads-in-fp32",
+        "--save", str(tmp_path / "ckpt"), "--save-interval", "2",
+    ])
+
+    scaler = make_loss_scaler(ns)
+    assert isinstance(scaler, LossScaler) and scaler.dynamic
+    assert scaler.hysteresis == 2 and scaler.scale_window == 500
+    assert float(scaler.init_state().loss_scale) == 1024.0
+
+    # static scale takes precedence; bf16/fp32 needs none
+    ns_static = parse_args(["--loss-scale", "128"])
+    assert make_loss_scaler(ns_static).dynamic is False
+    assert make_loss_scaler(parse_args(["--bf16"])) is None
+
+    calc = make_microbatch_calculator(ns, data_parallel_size=2)
+    assert isinstance(calc, RampupBatchsizeNumMicroBatches)
+    calc.update(0, consistency_check=False)
+    assert calc.get_current_global_batch_size() == 16
+
+    assert ddp_options(ns) == {"allreduce_always_fp32": True}
+
+    # --train-samples drives the schedule length, walking the batch ramp
+    # (ramp iterations consume fewer samples each, so total > samples/global)
+    from apex_tpu.transformer.testing.arguments import _iters_from_samples
+
+    total = _iters_from_samples(ns)
+    assert total > 128000 // 64
+    _, schedule = make_optimizer(ns)
+    assert abs(float(schedule(total)) - ns.min_lr) < 1e-7
+    assert float(schedule(total // 2)) > ns.min_lr + 1e-6
+
+    ck = make_checkpointer(ns)
+    state = {"w": jnp.arange(4.0), "step": jnp.asarray(3)}
+    assert ck.maybe_save(state, 2) and not ck.maybe_save(state, 3)
+    restored = ck.load(target=state)
+    np.testing.assert_allclose(np.asarray(restored["w"]),
+                               np.asarray(state["w"]))
+
+
+def test_loss_scaler_hysteresis():
+    """Megatron-LM DynamicGradScaler hysteresis semantics: with hysteresis=2
+    the first overflow only spends a credit (scale unchanged, step still
+    skipped); the second overflow — consecutive or not — backs off; credits
+    refill only when the scale grows after scale_window clean steps."""
+    from apex_tpu.amp.scaler import LossScaler
+
+    sc = LossScaler("dynamic", init_scale=1024.0, hysteresis=2,
+                    scale_window=2)
+    s = sc.init_state()
+
+    s, skip = sc.update_scale(s, jnp.asarray(1.0))
+    assert bool(skip) and float(s.loss_scale) == 1024.0  # credit spent
+    s, skip = sc.update_scale(s, jnp.asarray(1.0))
+    assert bool(skip) and float(s.loss_scale) == 512.0  # backoff
+
+    # one clean step does NOT refill: the next overflow backs off again
+    s, skip = sc.update_scale(s, jnp.asarray(0.0))
+    assert not bool(skip)
+    s, _ = sc.update_scale(s, jnp.asarray(1.0))
+    assert float(s.loss_scale) == 256.0
+
+    # scale_window clean steps -> growth AND credit refill; the following
+    # overflow is tolerated again
+    for _ in range(2):
+        s, skip = sc.update_scale(s, jnp.asarray(0.0))
+        assert not bool(skip)
+    assert float(s.loss_scale) == 512.0  # grew
+    s, skip = sc.update_scale(s, jnp.asarray(1.0))
+    assert bool(skip) and float(s.loss_scale) == 512.0  # tolerated
+
+    # state_dict round-trip carries the credits; old dicts default to full
+    d = sc.state_dict(s)
+    assert d["hysteresis_left"] == 1
+    assert int(sc.load_state_dict(d).hysteresis_left) == 1
+    del d["hysteresis_left"]
+    assert int(sc.load_state_dict(d).hysteresis_left) == 2
