@@ -20,8 +20,10 @@ from apex_tpu.transformer.tensor_parallel.layers import (  # noqa: F401
 )
 from apex_tpu.transformer.tensor_parallel.mappings import (  # noqa: F401
     copy_to_tensor_model_parallel_region,
+    gather_from_sequence_parallel_region,
     gather_from_tensor_model_parallel_region,
     reduce_from_tensor_model_parallel_region,
+    reduce_scatter_to_sequence_parallel_region,
     scatter_to_tensor_model_parallel_region,
 )
 from apex_tpu.transformer.tensor_parallel.memory import (  # noqa: F401
@@ -61,6 +63,7 @@ __all__ = [
     "copy_to_tensor_model_parallel_region",
     "data_parallel_key",
     "divide",
+    "gather_from_sequence_parallel_region",
     "gather_from_tensor_model_parallel_region",
     "get_cuda_rng_tracker",
     "get_rng_tracker",
@@ -69,6 +72,7 @@ __all__ = [
     "model_parallel_seed",
     "pipeline_stage_key",
     "reduce_from_tensor_model_parallel_region",
+    "reduce_scatter_to_sequence_parallel_region",
     "row_parallel_linear",
     "scatter_to_tensor_model_parallel_region",
     "set_tensor_model_parallel_attributes",

@@ -40,8 +40,10 @@ from jax import lax
 from apex_tpu.parallel.mesh import TP_AXIS
 from apex_tpu.transformer.tensor_parallel.mappings import (
     copy_to_tensor_model_parallel_region,
+    gather_from_sequence_parallel_region,
     gather_from_tensor_model_parallel_region,
     reduce_from_tensor_model_parallel_region,
+    reduce_scatter_to_sequence_parallel_region,
     scatter_to_tensor_model_parallel_region,
 )
 from apex_tpu.transformer.tensor_parallel.utils import VocabUtility, divide
@@ -118,10 +120,16 @@ def column_parallel_linear(
     *,
     gather_output: bool = True,
     axis_name: str = TP_AXIS,
+    sequence_parallel: bool = False,
 ):
     """Y_i = X @ A_i (+ b_i); A sharded on the output dim (ref forward
-    :443-463). ``kernel``: (in, out/tp)."""
-    x = copy_to_tensor_model_parallel_region(x, axis_name)
+    :443-463). ``kernel``: (in, out/tp). With ``sequence_parallel`` the
+    input is the sequence-local shard (b, s/tp, h) and is all-gathered
+    along seq on entry (Megatron-SP ``g``; reduce-scatter in backward)."""
+    if sequence_parallel:
+        x = gather_from_sequence_parallel_region(x, axis_name)
+    else:
+        x = copy_to_tensor_model_parallel_region(x, axis_name)
     y = jnp.dot(x, kernel, preferred_element_type=jnp.float32).astype(x.dtype)
     if bias is not None:
         y = y + bias
@@ -137,13 +145,19 @@ def row_parallel_linear(
     *,
     input_is_parallel: bool = False,
     axis_name: str = TP_AXIS,
+    sequence_parallel: bool = False,
 ):
     """Y = sum_i X_i @ A_i (+ b); A sharded on the input dim (ref forward
-    :560-576). ``kernel``: (in/tp, out); bias added once, after the reduce."""
+    :560-576). ``kernel``: (in/tp, out); bias added once, after the reduce.
+    With ``sequence_parallel`` the partial sums are reduce-scattered along
+    seq (Megatron-SP ``ḡ``) and the result is the (b, s/tp, out) shard."""
     if not input_is_parallel:
         x = scatter_to_tensor_model_parallel_region(x, axis_name)
     y = jnp.dot(x, kernel, preferred_element_type=jnp.float32).astype(x.dtype)
-    y = reduce_from_tensor_model_parallel_region(y, axis_name)
+    if sequence_parallel:
+        y = reduce_scatter_to_sequence_parallel_region(y, axis_name)
+    else:
+        y = reduce_from_tensor_model_parallel_region(y, axis_name)
     if bias is not None:
         y = y + bias
     return y

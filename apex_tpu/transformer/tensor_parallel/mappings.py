@@ -125,3 +125,33 @@ def _gather_bwd(axis_name, _res, g):
 
 
 gather_from_tensor_model_parallel_region.defvjp(_gather_fwd, _gather_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Megatron-style sequence-parallel region boundaries (Korthikanti et al.,
+# "Reducing Activation Recomputation"; NOT in the reference snapshot — its
+# only SP artifact is activation-shard checkpointing, random.py:244-263).
+# Activations in the LN/dropout/residual regions are sharded along the
+# SEQUENCE dim over the same tp ranks; entering a TP block all-gathers the
+# sequence ("g"), leaving one reduce-scatters it ("ḡ") — the psum a plain
+# row-parallel exit would do, split across ranks. Unlike the replicated
+# copy/gather mappings above, the input here is genuinely rank-varying, so
+# JAX AD's built-in transposes (all_gather ⇄ psum_scatter) are exactly the
+# Megatron backward pair and no custom_vjp is needed.
+
+
+def gather_from_sequence_parallel_region(x, axis_name: str = TP_AXIS,
+                                         seq_axis: int = 1):
+    """Sequence all-gather entering a column-parallel block (fwd ``g``:
+    all_gather; bwd: reduce-scatter). ``x``: the local (b, s/tp, h) shard."""
+    return lax.all_gather(
+        _pvary(x, axis_name), axis_name, axis=seq_axis, tiled=True)
+
+
+def reduce_scatter_to_sequence_parallel_region(x, axis_name: str = TP_AXIS,
+                                               seq_axis: int = 1):
+    """Sequence reduce-scatter leaving a row-parallel block (fwd ``ḡ``:
+    psum_scatter; bwd: all_gather). Returns the local (b, s/tp, h) shard."""
+    return lax.psum_scatter(
+        _pvary(x, axis_name), axis_name, scatter_dimension=seq_axis,
+        tiled=True)

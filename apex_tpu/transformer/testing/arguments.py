@@ -206,6 +206,10 @@ def _iters_from_samples(args: argparse.Namespace) -> Optional[int]:
     # mirror RampupBatchsizeNumMicroBatches: batch grows from start by
     # increment every ramp_samples/num_increments consumed samples
     start, inc, ramp_samples = (int(v) for v in args.rampup_batch_size)
+    if start <= 0 or inc <= 0:
+        raise ValueError(
+            f"--rampup-batch-size needs positive start and increment, got "
+            f"{args.rampup_batch_size}")
     num_inc = max((args.global_batch_size - start) // inc, 1)
     per_level = ramp_samples / num_inc
     iters, consumed, batch = 0, 0, start

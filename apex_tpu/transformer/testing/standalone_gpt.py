@@ -88,6 +88,11 @@ class GPTConfig:
     # across the TP group).
     attention_dropout: float = 0.0
     hidden_dropout: float = 0.0
+    # lax.scan unroll factor for the layer stack: 1 = one compiled layer
+    # body (fast compiles); num_layers = straight-line HLO (cross-layer
+    # fusion, and XLA cost analysis then counts every layer — see
+    # benchmarks/check_mfu_accounting.py).
+    scan_unroll: int = 1
 
     @property
     def ffn_hidden(self) -> int:
@@ -332,7 +337,8 @@ def _layer_stack(layers, x, cfg, causal: bool = True, mask=None,
         lp, key = lp_key
         return one(lp, h, key if dropout_key is not None else None), None
 
-    out, _ = lax.scan(body, x, (layers, keys))
+    out, _ = lax.scan(body, x, (layers, keys),
+                      unroll=min(cfg.scan_unroll, n_layers))
     return out
 
 

@@ -19,9 +19,15 @@ from __future__ import annotations
 
 import functools
 import json
+import os
 import time
 
 import jax
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    # the config flag (not the env var) is what actually bypasses the
+    # image's axon backend hook — see tests/conftest.py
+    jax.config.update("jax_platforms", "cpu")
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
@@ -32,25 +38,32 @@ BATCH, SEQ = 32, 1024
 STEPS = 20
 
 
-def main() -> None:
+def flagship_config(seq: int = SEQ, **overrides):
+    """The benchmark model (GPT-2 124M-class). Shared with
+    benchmarks/check_mfu_accounting.py so the cross-check always validates
+    the same model bench.py times."""
+    from apex_tpu.transformer.testing import GPTConfig
+
+    kw = dict(vocab_size=50304, max_seq=seq, hidden=768, num_layers=12,
+              num_heads=12, dtype=jnp.bfloat16)
+    kw.update(overrides)
+    return GPTConfig(**kw)
+
+
+def build_train_step(cfg, batch: int, seq: int):
+    """Jitted fwd+bwd+FusedAdam step for ``cfg`` on one chip. Returns
+    ``(train_step, params, opt_state, tok, tgt)``."""
     from apex_tpu.optimizers import FusedAdam
     from apex_tpu.parallel.mesh import build_mesh
     from apex_tpu.transformer.pipeline_parallel.schedules.common import (
         replicate_loss,
     )
     from apex_tpu.transformer.testing import (
-        GPTConfig,
         gpt_loss,
         gpt_param_specs,
         init_gpt_params,
     )
 
-    backend = jax.default_backend()
-    on_tpu = backend == "tpu"
-    batch, seq, steps = (BATCH, SEQ, STEPS) if on_tpu else (2, 128, 3)
-
-    cfg = GPTConfig(vocab_size=50304, max_seq=seq, hidden=768, num_layers=12,
-                    num_heads=12, dtype=jnp.bfloat16, remat=True)
     params = init_gpt_params(jax.random.PRNGKey(0), cfg)
     mesh = build_mesh(tp=1, pp=1, sp=1, devices=jax.devices()[:1])
     specs = gpt_param_specs(cfg)
@@ -75,21 +88,63 @@ def main() -> None:
     key = jax.random.PRNGKey(1)
     tok = jax.random.randint(key, (batch, seq), 0, cfg.vocab_size)
     tgt = jnp.roll(tok, -1, axis=1)
+    return train_step, params, opt_state, tok, tgt
 
-    # warmup (compile); the float() host-read is the real execution fence
-    params, opt_state, loss = train_step(params, opt_state, tok, tgt)
-    float(loss)
 
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        params, opt_state, loss = train_step(params, opt_state, tok, tgt)
-    float(loss)  # forces the whole donated-params chain
-    dt = (time.perf_counter() - t0) / steps
-
-    tokens_per_s = batch * seq / dt
+def _measure(remat: bool, remat_policy: str, batch: int, seq: int,
+             steps: int, warm_steps: int = 2):
+    """(tokens/s, n_params, error) of the flagship train step under one
+    remat config; tokens/s is None when it fails (e.g. OOM with remat off).
+    Fresh params each call — donation consumes the previous buffers."""
+    cfg = flagship_config(seq, remat=remat, remat_policy=remat_policy)
+    train_step, params, opt_state, tok, tgt = build_train_step(
+        cfg, batch, seq)
     n_params = sum(x.size for x in jax.tree.leaves(params))
+    try:
+        # warmup (compile); the float() host-read is the real execution fence
+        for _ in range(warm_steps):
+            params, opt_state, loss = train_step(params, opt_state, tok, tgt)
+        float(loss)
+
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            params, opt_state, loss = train_step(params, opt_state, tok, tgt)
+        float(loss)  # forces the whole donated-params chain
+        dt = (time.perf_counter() - t0) / steps
+    except Exception as e:  # OOM etc. — config unusable on this chip
+        return None, n_params, f"{type(e).__name__}: {str(e)[:200]}"
+    return batch * seq / dt, n_params, None
+
+
+def main() -> None:
+    backend = jax.default_backend()
+    on_tpu = backend == "tpu"
+    batch, seq, steps = (BATCH, SEQ, STEPS) if on_tpu else (2, 128, 3)
+
+    # Auto-tune the remat config: no-remat and selective ("dots") avoid
+    # recompute flops that the MFU accounting deliberately does not credit,
+    # but may not fit HBM — measure briefly and keep the fastest.
+    candidates = [(False, "full"), (True, "dots"), (True, "full")]
+    best, best_tps, n_params, last_err = None, 0.0, 0, None
+    for remat, policy in (candidates if on_tpu else candidates[-1:]):
+        tps, n_params, err = _measure(remat, policy, batch, seq,
+                                      steps=3 if on_tpu else 1)
+        if err is not None:
+            last_err = f"remat={remat}/{policy}: {err}"
+        if tps is not None and tps > best_tps:
+            best, best_tps = (remat, policy), tps
+
+    if best is None:
+        raise RuntimeError(f"no remat config ran successfully; last error: "
+                           f"{last_err}")
+    tokens_per_s, n_params, err = _measure(*best, batch, seq, steps)
+    if tokens_per_s is None:
+        raise RuntimeError(f"selected config {best} failed the timed run: "
+                           f"{err}")
     # standard MFU accounting: 6N per token (fwd+bwd) + causal attention
-    # 6*L*hidden*seq per token; remat recompute is NOT credited
+    # 6*L*hidden*seq per token; remat recompute is NOT credited. Cross-
+    # checked against XLA HLO cost analysis by check_mfu_accounting.py.
+    cfg = flagship_config(seq)
     flops_per_token = 6 * n_params + 6 * cfg.num_layers * cfg.hidden * seq
     mfu = tokens_per_s * flops_per_token / PEAK_FLOPS.get(backend, 1e12)
 
@@ -101,6 +156,7 @@ def main() -> None:
         "value": round(tokens_per_s, 1),
         "unit": "tokens/s",
         "vs_baseline": round(mfu / 0.70, 4),
+        "remat_config": {"remat": best[0], "policy": best[1]},
     }))
 
 

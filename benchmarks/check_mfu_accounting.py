@@ -1,0 +1,60 @@
+"""Verify bench.py's MFU flops-per-token constant against XLA's own HLO
+cost analysis (VERDICT r1 weak #9: the denominator was self-graded).
+
+Compiles the exact bench train step (remat OFF, so HLO flops = algorithmic
+flops with no recompute double-counting) at a reduced batch on the current
+backend and compares ``cost_analysis()['flops']`` with the analytic
+``6·N_params + 6·L·hidden·seq`` per-token model. Flops are linear in batch,
+so a small batch checks the same constant the bench divides by.
+
+Run: JAX_PLATFORMS=cpu python benchmarks/check_mfu_accounting.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+
+# the config flag (not the env var) is what actually bypasses the image's
+# axon backend hook — see tests/conftest.py
+jax.config.update("jax_platforms", "cpu")
+
+BATCH, SEQ = 4, 1024
+
+
+def main() -> None:
+    from bench import build_train_step, flagship_config
+
+    # remat=False: no recompute double-counting. scan_unroll=num_layers:
+    # XLA cost analysis counts a rolled scan body ONCE (a while loop has no
+    # static trip count), which under-reports by ~the layer count —
+    # unrolling makes the HLO flops complete. Everything else is exactly
+    # the model/step bench.py times (shared builder).
+    cfg = flagship_config(SEQ, remat=False, scan_unroll=12)
+    train_step, params, opt_state, tok, tgt = build_train_step(
+        cfg, BATCH, SEQ)
+    compiled = train_step.lower(params, opt_state, tok, tgt).compile()
+    ca = compiled.cost_analysis()
+    hlo_flops = float(ca.get("flops", float("nan")))
+
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    tokens = BATCH * SEQ
+    analytic_per_token = 6 * n_params + 6 * cfg.num_layers * cfg.hidden * SEQ
+    analytic = analytic_per_token * tokens
+    print(json.dumps({
+        "metric": "mfu_denominator_check",
+        "hlo_flops": hlo_flops,
+        "analytic_flops": analytic,
+        "hlo_over_analytic": round(hlo_flops / analytic, 4),
+        "batch": BATCH, "seq": SEQ, "n_params": n_params,
+    }))
+
+
+if __name__ == "__main__":
+    main()

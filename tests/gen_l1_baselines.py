@@ -24,6 +24,11 @@ def _pin_platform():
         os.environ["XLA_FLAGS"] = (
             os.environ.get("XLA_FLAGS", "")
             + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+
+    # the config flag (not the env var) is what actually bypasses the
+    # image's axon backend hook — see tests/conftest.py
+    jax.config.update("jax_platforms", "cpu")
 
 
 _ROOT = pathlib.Path(__file__).resolve().parent.parent
