@@ -95,11 +95,14 @@ def sharded_init(
 # Functional cores
 
 
-def vocab_parallel_embedding(ids, weight, axis_name: str = TP_AXIS):
+def vocab_parallel_embedding(ids, weight, axis_name: str = TP_AXIS,
+                             sequence_parallel: bool = False):
     """Lookup into a vocab-sharded embedding table (ref forward :191-215).
 
     ``weight``: (vocab/tp, hidden) local shard. Out-of-range ids contribute a
-    zero row; psum assembles each token's row from its owner rank.
+    zero row; psum assembles each token's row from its owner rank. With
+    ``sequence_parallel`` the psum is a reduce-scatter along seq (Megatron-SP
+    embedding exit) and the result is the (b, s/tp, hidden) shard.
     """
     per_partition = weight.shape[0]
     rank = lax.axis_index(axis_name)
@@ -110,6 +113,8 @@ def vocab_parallel_embedding(ids, weight, axis_name: str = TP_AXIS):
     local_ids = jnp.where(mask, 0, ids - start)
     out = jnp.take(weight, local_ids, axis=0)
     out = jnp.where(mask[..., None], jnp.zeros((), out.dtype), out)
+    if sequence_parallel:
+        return reduce_scatter_to_sequence_parallel_region(out, axis_name)
     return reduce_from_tensor_model_parallel_region(out, axis_name)
 
 

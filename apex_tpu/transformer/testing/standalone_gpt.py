@@ -93,6 +93,14 @@ class GPTConfig:
     # fusion, and XLA cost analysis then counts every layer — see
     # benchmarks/check_mfu_accounting.py).
     scan_unroll: int = 1
+    # Megatron-style sequence parallelism over the tp axis (Korthikanti;
+    # NOT in the reference): LN/dropout/residual regions run on (b, s/tp, h)
+    # shards, TP blocks all_gather on entry and reduce-scatter on exit,
+    # the embedding exit is a reduce-scatter and the LM head entry a
+    # gather. Composes with the ring-attention sp axis (the tp split is
+    # within each sp shard). Cuts the non-TP activation memory by tp× and
+    # shrinks pipeline p2p tensors the same way.
+    megatron_sp: bool = False
 
     @property
     def ffn_hidden(self) -> int:
@@ -114,6 +122,10 @@ class GPTConfig:
             raise ValueError(
                 f"remat_policy must be 'full' or 'dots', "
                 f"got {self.remat_policy!r}")
+        if self.megatron_sp and self.max_seq % tp:
+            raise ValueError(
+                f"megatron_sp needs max_seq ({self.max_seq}) divisible by "
+                f"tp ({tp})")
 
 
 # ---------------------------------------------------------------------------
@@ -212,10 +224,15 @@ def _attention(p, x, cfg, heads_local: int, causal: bool = True, mask=None,
                dropout_key=None):
     """Ref ParallelAttention (:285): column-parallel fused QKV, flash core
     (with in-kernel probability dropout when training), row-parallel
-    out-proj."""
+    out-proj. Under ``cfg.megatron_sp`` ``x`` is the (b, s/tp, h) sequence
+    shard: the QKV entry all-gathers seq, the out-proj exit reduce-scatters
+    it (attention itself always sees the full sp-local sequence)."""
     b, s, h = x.shape
+    if cfg.megatron_sp:
+        s = s * lax.axis_size(TP_AXIS)
     qkv = column_parallel_linear(x, p["qkv_kernel"], p["qkv_bias"],
-                                 gather_output=False)
+                                 gather_output=False,
+                                 sequence_parallel=cfg.megatron_sp)
     qkv = qkv.reshape(b, s, 3, heads_local, cfg.head_dim)
     q, k, v = (qkv[:, :, i].transpose(0, 2, 1, 3) for i in range(3))
     try:
@@ -254,16 +271,35 @@ def _attention(p, x, cfg, heads_local: int, causal: bool = True, mask=None,
         ctx = flash_attention(q, k, v, causal=causal, mask=mask)
     ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, heads_local * cfg.head_dim)
     return row_parallel_linear(ctx, p["out_kernel"], p["out_bias"],
-                               input_is_parallel=True)
+                               input_is_parallel=True,
+                               sequence_parallel=cfg.megatron_sp)
 
 
-def _mlp(p, x):
-    """Ref ParallelMLP (:236): column-parallel FC1 + gelu, row-parallel FC2."""
+def _mlp(p, x, cfg):
+    """Ref ParallelMLP (:236): column-parallel FC1 + gelu, row-parallel FC2.
+    Under ``cfg.megatron_sp`` the FC1 entry gathers seq, the FC2 exit
+    reduce-scatters it."""
     y = column_parallel_linear(x, p["fc1_kernel"], p["fc1_bias"],
-                               gather_output=False)
+                               gather_output=False,
+                               sequence_parallel=cfg.megatron_sp)
     y = jax.nn.gelu(y, approximate=True)
     return row_parallel_linear(y, p["fc2_kernel"], p["fc2_bias"],
-                               input_is_parallel=True)
+                               input_is_parallel=True,
+                               sequence_parallel=cfg.megatron_sp)
+
+
+def _hidden_key(key, cfg):
+    """Hidden-dropout key policy: replicated activations share the unfolded
+    key across the TP group; under megatron_sp each tp rank holds DIFFERENT
+    tokens, so the rank must be folded in (tensor_parallel/random.py
+    model-parallel stream) or shards would reuse one mask."""
+    if key is None or not cfg.megatron_sp:
+        return key
+    from apex_tpu.transformer.tensor_parallel.random import (
+        model_parallel_key,
+    )
+
+    return model_parallel_key(key)
 
 
 def _layer(p, x, cfg, heads_local: int, causal: bool = True, mask=None,
@@ -273,6 +309,7 @@ def _layer(p, x, cfg, heads_local: int, causal: bool = True, mask=None,
     MLP -> hidden dropout -> residual."""
     if dropout_key is not None:
         k_attn, k_h1, k_h2 = jax.random.split(dropout_key, 3)
+        k_h1, k_h2 = _hidden_key(k_h1, cfg), _hidden_key(k_h2, cfg)
     else:
         k_attn = k_h1 = k_h2 = None
     a = _attention(p, layer_norm(x, p["ln1_w"], p["ln1_b"]), cfg,
@@ -280,7 +317,7 @@ def _layer(p, x, cfg, heads_local: int, causal: bool = True, mask=None,
     if k_h1 is not None and cfg.hidden_dropout > 0.0:
         a = _hidden_dropout(a, cfg.hidden_dropout, k_h1)
     x = x + a
-    m = _mlp(p, layer_norm(x, p["ln2_w"], p["ln2_b"]))
+    m = _mlp(p, layer_norm(x, p["ln2_w"], p["ln2_b"]), cfg)
     if k_h2 is not None and cfg.hidden_dropout > 0.0:
         m = _hidden_dropout(m, cfg.hidden_dropout, k_h2)
     return x + m
@@ -342,17 +379,34 @@ def _layer_stack(layers, x, cfg, causal: bool = True, mask=None,
     return out
 
 
-def embed_tokens(embed, tokens):
+def embed_tokens(embed, tokens, megatron_sp: bool = False):
     """Token + position embedding (ref GPT Embedding module). ``tokens`` may
-    be the sp-local sequence shard; positions are offset by the sp rank."""
-    h = vocab_parallel_embedding(tokens, embed["tok"])
+    be the sp-local sequence shard; positions are offset by the sp rank.
+    With ``megatron_sp`` the embedding's tp-psum becomes a reduce-scatter
+    along seq and the result is the (b, s/(sp·tp), h) shard."""
     s_loc = tokens.shape[1]
+    if megatron_sp:
+        tp_size = lax.axis_size(TP_AXIS)
+        if s_loc % tp_size:
+            # validate() can only see max_seq; with a ring-sp axis the
+            # per-rank requirement is (max_seq/sp) % tp — check the actual
+            # shard here where both are known, instead of letting
+            # psum_scatter fail deep in the trace
+            raise ValueError(
+                f"megatron_sp needs the sp-local sequence ({s_loc}) "
+                f"divisible by tp ({tp_size})")
+    h = vocab_parallel_embedding(tokens, embed["tok"],
+                                 sequence_parallel=megatron_sp)
     try:
         sp = lax.axis_size(SP_AXIS)
     except NameError:
         sp = 1
-    if sp > 1:
-        start = lax.axis_index(SP_AXIS) * s_loc
+    start = lax.axis_index(SP_AXIS) * s_loc if sp > 1 else 0
+    if megatron_sp:
+        s_shard = s_loc // lax.axis_size(TP_AXIS)
+        start = start + lax.axis_index(TP_AXIS) * s_shard
+        s_loc = s_shard
+    if sp > 1 or megatron_sp:
         pos = lax.dynamic_slice_in_dim(embed["pos"], start, s_loc, 0)
     else:
         pos = embed["pos"][:s_loc]
@@ -360,7 +414,7 @@ def embed_tokens(embed, tokens):
 
 
 def _embed_with_dropout(embed, tokens, cfg: GPTConfig, dropout_key):
-    x = embed_tokens(embed, tokens)
+    x = embed_tokens(embed, tokens, megatron_sp=cfg.megatron_sp)
     if dropout_key is not None and cfg.hidden_dropout > 0.0:
         try:
             sp = lax.axis_size(SP_AXIS)
@@ -374,7 +428,8 @@ def _embed_with_dropout(embed, tokens, cfg: GPTConfig, dropout_key):
         # ref GPT embedding dropout: same hidden_dropout rate on the
         # embedding output; distinct stream from the per-layer keys
         x = _hidden_dropout(x, cfg.hidden_dropout,
-                            jax.random.fold_in(dropout_key, 0x0E0B))
+                            _hidden_key(jax.random.fold_in(dropout_key,
+                                                           0x0E0B), cfg))
     return x
 
 
@@ -389,9 +444,18 @@ def gpt_forward(params, tokens, cfg: GPTConfig, dropout_key=None):
 
 def gpt_head(params, x, cfg: GPTConfig):
     """Final LN + LM head -> vocab-sharded logits. Tied: logits_i = h @ tok_iᵀ
-    (each rank's vocab shard — the reference's parallel_output=True path)."""
+    (each rank's vocab shard — the reference's parallel_output=True path).
+    Under ``cfg.megatron_sp`` the final LN runs on the sequence shard and
+    the head entry gathers seq (the vocab dim is sharded over the same tp
+    axis, so the head needs the full sequence on every rank)."""
     head = params["head"]
     x = layer_norm(x, head["ln_w"], head["ln_b"])
+    if cfg.megatron_sp:
+        from apex_tpu.transformer.tensor_parallel.mappings import (
+            gather_from_sequence_parallel_region,
+        )
+
+        x = gather_from_sequence_parallel_region(x)
     if cfg.tie_embeddings:
         from apex_tpu.transformer.tensor_parallel.mappings import (
             copy_to_tensor_model_parallel_region,
@@ -417,17 +481,23 @@ def _use_fused_loss(cfg: GPTConfig, n_rows: int) -> bool:
     return True  # CPU/virtual mesh: dense impl, exercised for coverage
 
 
-def fused_head_loss(head_rows_w, ln_w, ln_b, x, targets):
+def fused_head_loss(head_rows_w, ln_w, ln_b, x, targets,
+                    gather_sequence: bool = False):
     """Shared fused LM-head + CE block: final LN -> copy-to-TP-region ->
     pvary (so dw reduces over the data axes) -> fused loss kernel.
-    ``head_rows_w``: (vocab/tp, hidden) projection rows."""
+    ``head_rows_w``: (vocab/tp, hidden) projection rows. With
+    ``gather_sequence`` (megatron_sp) the LN runs on the sequence shard
+    and seq is gathered before the head."""
     from apex_tpu.ops.lm_head_loss import lm_head_loss
     from apex_tpu.transformer.tensor_parallel.mappings import (
         copy_to_tensor_model_parallel_region,
+        gather_from_sequence_parallel_region,
         pvary_like,
     )
 
     x = layer_norm(x, ln_w, ln_b)
+    if gather_sequence:
+        x = gather_from_sequence_parallel_region(x)
     x = copy_to_tensor_model_parallel_region(x)
     # the loss kernel's custom_vjp hides w's linearity from shard_map's
     # invariant-input reduction; vary it explicitly over the activations'
@@ -453,7 +523,8 @@ def gpt_loss(params, tokens, targets, cfg: GPTConfig, dropout_key=None):
     head = params["head"]
     w = (params["embed"]["tok"] if cfg.tie_embeddings
          else head["lm"].T)  # (vocab/tp, hidden) rows
-    return fused_head_loss(w, head["ln_w"], head["ln_b"], x, targets)
+    return fused_head_loss(w, head["ln_w"], head["ln_b"], x, targets,
+                           gather_sequence=cfg.megatron_sp)
 
 
 # ---------------------------------------------------------------------------
@@ -501,15 +572,21 @@ def gpt_pipeline_spec(cfg: GPTConfig) -> PipelineSpec:
     """The three pipeline functions (PipelineSpec contract)."""
 
     def embed_fn(embed, tokens):
-        return embed_tokens(embed, tokens)
+        return embed_tokens(embed, tokens, megatron_sp=cfg.megatron_sp)
 
     def stage_fn(stage_layers, h):
         return _layer_stack(stage_layers, h, cfg)
 
     def loss_fn(head, h, targets):
-        if _use_fused_loss(cfg, h.shape[0] * h.shape[1]):
+        # h is the seq shard under megatron_sp; the fused-loss gate needs
+        # the gathered row count (what the kernel will actually see)
+        rows = h.shape[0] * h.shape[1]
+        if cfg.megatron_sp:
+            rows *= lax.axis_size(TP_AXIS)
+        if _use_fused_loss(cfg, rows):
             return fused_head_loss(head["lm"].T, head["ln_w"], head["ln_b"],
-                                   h, targets)
+                                   h, targets,
+                                   gather_sequence=cfg.megatron_sp)
         logits = gpt_head({"head": head}, h, cfg=dataclasses.replace(
             cfg, tie_embeddings=False))
         return jnp.mean(vocab_parallel_cross_entropy(logits, targets))

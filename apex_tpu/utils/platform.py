@@ -1,0 +1,33 @@
+"""Platform pinning for CPU/virtual-mesh execution.
+
+The image's axon sitecustomize registers the TPU-tunnel backend for every
+interpreter; setting ``JAX_PLATFORMS=cpu`` in the environment does NOT stop
+the hook from initializing (and possibly dialing) that backend — only the
+``jax_platforms`` config flag does. Every CPU-bound entry point (tests,
+virtual-mesh benchmarks, baseline generators) should call
+:func:`pin_cpu_platform` before first device use instead of re-deriving
+this recipe.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def pin_cpu_platform(virtual_devices: int | None = None) -> None:
+    """Force the CPU backend; optionally expose ``virtual_devices`` host
+    devices (the multi-chip simulation used across the test suite).
+
+    Call before the first jax backend use. Safe to call multiple times;
+    an existing ``xla_force_host_platform_device_count`` flag is kept.
+    """
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    if virtual_devices is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count="
+                f"{virtual_devices}").strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

@@ -25,9 +25,9 @@ import time
 import jax
 
 if os.environ.get("JAX_PLATFORMS") == "cpu":
-    # the config flag (not the env var) is what actually bypasses the
-    # image's axon backend hook — see tests/conftest.py
-    jax.config.update("jax_platforms", "cpu")
+    from apex_tpu.utils.platform import pin_cpu_platform
+
+    pin_cpu_platform()
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
@@ -50,9 +50,18 @@ def flagship_config(seq: int = SEQ, **overrides):
     return GPTConfig(**kw)
 
 
+_STEP_CACHE: dict = {}
+
+
 def build_train_step(cfg, batch: int, seq: int):
     """Jitted fwd+bwd+FusedAdam step for ``cfg`` on one chip. Returns
-    ``(train_step, params, opt_state, tok, tgt)``."""
+    ``(train_step, params, opt_state, tok, tgt)``. The jitted step is
+    cached per (cfg, batch, seq) so re-measuring the auto-tuner's winning
+    config reuses its compilation instead of paying a fourth compile."""
+    key = (cfg, batch, seq)
+    if key in _STEP_CACHE:
+        train_step, make_inputs = _STEP_CACHE[key]
+        return (train_step, *make_inputs())
     from apex_tpu.optimizers import FusedAdam
     from apex_tpu.parallel.mesh import build_mesh
     from apex_tpu.transformer.pipeline_parallel.schedules.common import (
@@ -85,10 +94,15 @@ def build_train_step(cfg, batch: int, seq: int):
         params = jax.tree.map(lambda p, u: p + u, params, updates)
         return params, opt_state, loss
 
-    key = jax.random.PRNGKey(1)
-    tok = jax.random.randint(key, (batch, seq), 0, cfg.vocab_size)
-    tgt = jnp.roll(tok, -1, axis=1)
-    return train_step, params, opt_state, tok, tgt
+    def make_inputs():
+        p = init_gpt_params(jax.random.PRNGKey(0), cfg)
+        s = opt.init(p)
+        k = jax.random.PRNGKey(1)
+        tok = jax.random.randint(k, (batch, seq), 0, cfg.vocab_size)
+        return p, s, tok, jnp.roll(tok, -1, axis=1)
+
+    _STEP_CACHE[key] = (train_step, make_inputs)
+    return (train_step, *make_inputs())
 
 
 def _measure(remat: bool, remat_policy: str, batch: int, seq: int,

@@ -35,13 +35,12 @@ import time
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _ROOT)
 
-import jax
-
 if os.environ.get("APEX_TPU_BENCH_VIRTUAL"):
-    # the config flag (not the env var) is what actually bypasses the
-    # image's axon backend hook — see tests/conftest.py; without it the
-    # virtual child dials the TPU tunnel during backend init
-    jax.config.update("jax_platforms", "cpu")
+    # without the pin the virtual child dials the TPU tunnel at backend init
+    from apex_tpu.utils.platform import pin_cpu_platform
+
+    pin_cpu_platform()
+import jax
 import jax.numpy as jnp
 
 

@@ -18,12 +18,10 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-import jax
+from apex_tpu.utils.platform import pin_cpu_platform
 
-# the config flag (not the env var) is what actually bypasses the image's
-# axon backend hook — see tests/conftest.py
-jax.config.update("jax_platforms", "cpu")
+pin_cpu_platform()
+import jax
 
 BATCH, SEQ = 4, 1024
 
@@ -36,7 +34,10 @@ def main() -> None:
     # static trip count), which under-reports by ~the layer count —
     # unrolling makes the HLO flops complete. Everything else is exactly
     # the model/step bench.py times (shared builder).
-    cfg = flagship_config(SEQ, remat=False, scan_unroll=12)
+    import dataclasses
+
+    cfg = flagship_config(SEQ, remat=False)
+    cfg = dataclasses.replace(cfg, scan_unroll=cfg.num_layers)
     train_step, params, opt_state, tok, tgt = build_train_step(
         cfg, BATCH, SEQ)
     compiled = train_step.lower(params, opt_state, tok, tgt).compile()

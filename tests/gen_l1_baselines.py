@@ -18,17 +18,9 @@ def _pin_platform():
     """Force the 8-device virtual CPU platform (same recipe as conftest.py).
     Called from ``main()`` only — importing this module for its constants
     (test_l1_determinism does) must not mutate the environment."""
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    if "xla_force_host_platform_device_count" not in os.environ.get(
-            "XLA_FLAGS", ""):
-        os.environ["XLA_FLAGS"] = (
-            os.environ.get("XLA_FLAGS", "")
-            + " --xla_force_host_platform_device_count=8").strip()
-    import jax
+    from apex_tpu.utils.platform import pin_cpu_platform
 
-    # the config flag (not the env var) is what actually bypasses the
-    # image's axon backend hook — see tests/conftest.py
-    jax.config.update("jax_platforms", "cpu")
+    pin_cpu_platform(virtual_devices=8)
 
 
 _ROOT = pathlib.Path(__file__).resolve().parent.parent
