@@ -80,7 +80,8 @@ def bert_forward(params, tokens, cfg: BertConfig, token_types=None,
     attn_mask = None
     if padding_mask is not None:
         attn_mask = padding_mask[:, None, None, :]
-    x = _layer_stack(params["layers"], x, cfg, causal=False, mask=attn_mask)
+    x, _aux = _layer_stack(params["layers"], x, cfg, causal=False,
+                           mask=attn_mask)
     h = params["head"]
     x = x @ h["dense_kernel"] + h["dense_bias"]
     x = jax.nn.gelu(x, approximate=True)

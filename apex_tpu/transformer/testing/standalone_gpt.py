@@ -101,6 +101,15 @@ class GPTConfig:
     # within each sp shard). Cuts the non-TP activation memory by tp× and
     # shrinks pipeline p2p tensors the same way.
     megatron_sp: bool = False
+    # num_experts > 0 replaces every layer's MLP with a mixture-of-experts
+    # FFN (transformer.moe): top-k capacity routing, experts sharded over
+    # the dp(=ep) mesh axis with all_to_all dispatch, expert FFN weights
+    # TP-split. The router aux loss is averaged over layers and added to
+    # gpt_loss. Not yet supported with megatron_sp or the pipeline
+    # schedules (both raise).
+    num_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
 
     @property
     def ffn_hidden(self) -> int:
@@ -126,6 +135,20 @@ class GPTConfig:
             raise ValueError(
                 f"megatron_sp needs max_seq ({self.max_seq}) divisible by "
                 f"tp ({tp})")
+        if self.num_experts and self.megatron_sp:
+            raise ValueError(
+                "num_experts with megatron_sp is not supported yet: the "
+                "TP-split expert FFN needs TP-replicated tokens (gather "
+                "before / reduce-scatter after the MoE region)")
+
+    @property
+    def moe_config(self):
+        from apex_tpu.transformer.moe import MoEConfig
+
+        return MoEConfig(num_experts=self.num_experts, hidden=self.hidden,
+                         ffn_hidden=self.ffn_hidden, top_k=self.moe_top_k,
+                         capacity_factor=self.moe_capacity_factor,
+                         dtype=self.dtype)
 
 
 # ---------------------------------------------------------------------------
@@ -133,23 +156,38 @@ class GPTConfig:
 
 def _init_layer(rng, cfg: GPTConfig) -> Pytree:
     h, f = cfg.hidden, cfg.ffn_hidden
-    ks = jax.random.split(rng, 4)
+    ks = jax.random.split(rng, 5)
     # Megatron init: normal(0.02) for input projections, output projections
     # scaled by 1/sqrt(2L) (ref standalone_gpt scaled_init_method)
     out_std = 0.02 / math.sqrt(2.0 * cfg.num_layers)
     dt = cfg.dtype
-    return {
+    layer = {
         "ln1_w": jnp.ones((h,), dt), "ln1_b": jnp.zeros((h,), dt),
         "qkv_kernel": (jax.random.normal(ks[0], (h, 3 * h)) * 0.02).astype(dt),
         "qkv_bias": jnp.zeros((3 * h,), dt),
         "out_kernel": (jax.random.normal(ks[1], (h, h)) * out_std).astype(dt),
         "out_bias": jnp.zeros((h,), dt),
         "ln2_w": jnp.ones((h,), dt), "ln2_b": jnp.zeros((h,), dt),
-        "fc1_kernel": (jax.random.normal(ks[2], (h, f)) * 0.02).astype(dt),
-        "fc1_bias": jnp.zeros((f,), dt),
-        "fc2_kernel": (jax.random.normal(ks[3], (f, h)) * out_std).astype(dt),
-        "fc2_bias": jnp.zeros((h,), dt),
     }
+    if cfg.num_experts:
+        e = cfg.num_experts
+        layer.update({
+            "router": jax.random.normal(ks[4], (h, e), jnp.float32) * 0.02,
+            "fc1_kernel": (jax.random.normal(ks[2], (e, h, f))
+                           * 0.02).astype(dt),
+            "fc1_bias": jnp.zeros((e, f), dt),
+            "fc2_kernel": (jax.random.normal(ks[3], (e, f, h))
+                           * out_std).astype(dt),
+            "fc2_bias": jnp.zeros((e, h), dt),
+        })
+    else:
+        layer.update({
+            "fc1_kernel": (jax.random.normal(ks[2], (h, f)) * 0.02).astype(dt),
+            "fc1_bias": jnp.zeros((f,), dt),
+            "fc2_kernel": (jax.random.normal(ks[3], (f, h)) * out_std).astype(dt),
+            "fc2_bias": jnp.zeros((h,), dt),
+        })
+    return layer
 
 
 def init_gpt_params(rng, cfg: GPTConfig) -> Pytree:
@@ -193,11 +231,26 @@ def gpt_param_specs(cfg: GPTConfig, extra_layer_lead=()) -> Pytree:
         "out_kernel": P(*lead, TP_AXIS, None),
         "out_bias": P(*lead),
         "ln2_w": P(*lead), "ln2_b": P(*lead),
-        "fc1_kernel": P(*lead, None, TP_AXIS),
-        "fc1_bias": P(*lead, TP_AXIS),
-        "fc2_kernel": P(*lead, TP_AXIS, None),
-        "fc2_bias": P(*lead),
     }
+    if cfg.num_experts:
+        from apex_tpu.parallel.mesh import DP_AXIS
+
+        # experts sharded over dp(=ep): each rank OWNS E/dp experts — their
+        # grads are per-rank, not dp-reduced (DeepSpeed-MoE layout)
+        layer.update({
+            "router": P(*lead),
+            "fc1_kernel": P(*lead, DP_AXIS, None, TP_AXIS),
+            "fc1_bias": P(*lead, DP_AXIS, TP_AXIS),
+            "fc2_kernel": P(*lead, DP_AXIS, TP_AXIS, None),
+            "fc2_bias": P(*lead, DP_AXIS, None),
+        })
+    else:
+        layer.update({
+            "fc1_kernel": P(*lead, None, TP_AXIS),
+            "fc1_bias": P(*lead, TP_AXIS),
+            "fc2_kernel": P(*lead, TP_AXIS, None),
+            "fc2_bias": P(*lead),
+        })
     specs = {
         "embed": {"tok": P(TP_AXIS, None), "pos": P()},
         "layers": layer,
@@ -278,14 +331,22 @@ def _attention(p, x, cfg, heads_local: int, causal: bool = True, mask=None,
 def _mlp(p, x, cfg):
     """Ref ParallelMLP (:236): column-parallel FC1 + gelu, row-parallel FC2.
     Under ``cfg.megatron_sp`` the FC1 entry gathers seq, the FC2 exit
-    reduce-scatters it."""
+    reduce-scatters it. With ``cfg.num_experts`` the FFN is the MoE layer
+    (experts over dp, router aux loss returned alongside)."""
+    if cfg.num_experts:
+        from apex_tpu.parallel.mesh import DP_AXIS
+        from apex_tpu.transformer.moe import moe_mlp
+
+        out, aux = moe_mlp(p, x, cfg.moe_config, ep_axis=DP_AXIS)
+        return out, aux["loss"]
     y = column_parallel_linear(x, p["fc1_kernel"], p["fc1_bias"],
                                gather_output=False,
                                sequence_parallel=cfg.megatron_sp)
     y = jax.nn.gelu(y, approximate=True)
-    return row_parallel_linear(y, p["fc2_kernel"], p["fc2_bias"],
-                               input_is_parallel=True,
-                               sequence_parallel=cfg.megatron_sp)
+    out = row_parallel_linear(y, p["fc2_kernel"], p["fc2_bias"],
+                              input_is_parallel=True,
+                              sequence_parallel=cfg.megatron_sp)
+    return out, jnp.zeros((), jnp.float32)
 
 
 def _hidden_key(key, cfg):
@@ -317,10 +378,10 @@ def _layer(p, x, cfg, heads_local: int, causal: bool = True, mask=None,
     if k_h1 is not None and cfg.hidden_dropout > 0.0:
         a = _hidden_dropout(a, cfg.hidden_dropout, k_h1)
     x = x + a
-    m = _mlp(p, layer_norm(x, p["ln2_w"], p["ln2_b"]), cfg)
+    m, aux = _mlp(p, layer_norm(x, p["ln2_w"], p["ln2_b"]), cfg)
     if k_h2 is not None and cfg.hidden_dropout > 0.0:
         m = _hidden_dropout(m, cfg.hidden_dropout, k_h2)
-    return x + m
+    return x + m, aux
 
 
 def _layer_stack(layers, x, cfg, causal: bool = True, mask=None,
@@ -370,13 +431,22 @@ def _layer_stack(layers, x, cfg, causal: bool = True, mask=None,
     else:
         keys = jnp.zeros((n_layers, 2), jnp.uint32)
 
+    if cfg.num_experts:
+        # the dp(=ep)-sharded expert weights make the MoE output dp-varying;
+        # cast the carry up front so scan's carry types match
+        from apex_tpu.parallel.mesh import DP_AXIS
+
+        if DP_AXIS not in jax.typeof(x).vma:
+            x = lax.pcast(x, DP_AXIS, to="varying")
+
     def body(h, lp_key):
         lp, key = lp_key
-        return one(lp, h, key if dropout_key is not None else None), None
+        h, aux = one(lp, h, key if dropout_key is not None else None)
+        return h, aux
 
-    out, _ = lax.scan(body, x, (layers, keys),
-                      unroll=min(cfg.scan_unroll, n_layers))
-    return out
+    out, aux_per_layer = lax.scan(body, x, (layers, keys),
+                                  unroll=min(cfg.scan_unroll, n_layers))
+    return out, jnp.mean(aux_per_layer)
 
 
 def embed_tokens(embed, tokens, megatron_sp: bool = False):
@@ -436,9 +506,11 @@ def _embed_with_dropout(embed, tokens, cfg: GPTConfig, dropout_key):
 def gpt_forward(params, tokens, cfg: GPTConfig, dropout_key=None):
     """tokens (b, s) -> vocab-sharded logits (b, s, vocab/tp). Call inside a
     mesh program (tp axis bound; tp=1 is the degenerate single-chip case).
-    ``dropout_key`` activates cfg's dropout rates (training mode)."""
+    ``dropout_key`` activates cfg's dropout rates (training mode). The MoE
+    router aux loss (if any) is dropped here — use :func:`gpt_loss` for
+    training."""
     x = _embed_with_dropout(params["embed"], tokens, cfg, dropout_key)
-    x = _layer_stack(params["layers"], x, cfg, dropout_key=dropout_key)
+    x, _aux = _layer_stack(params["layers"], x, cfg, dropout_key=dropout_key)
     return gpt_head(params, x, cfg)
 
 
@@ -512,19 +584,20 @@ def gpt_loss(params, tokens, targets, cfg: GPTConfig, dropout_key=None):
     With ``cfg.fused_loss`` the head matmul is fused into the loss kernel
     (``ops/lm_head_loss.py``) and the logits are never materialized; the
     unfused path is kept for logits-consuming callers and parity tests.
-    ``dropout_key`` activates cfg's dropout rates (training mode).
+    ``dropout_key`` activates cfg's dropout rates (training mode). With
+    ``cfg.num_experts`` the layer-mean MoE router aux loss is added.
     """
-    if not _use_fused_loss(cfg, tokens.shape[0] * tokens.shape[1]):
-        logits = gpt_forward(params, tokens, cfg, dropout_key=dropout_key)
-        # logits stay in model dtype; CE upcasts internally (fused by XLA)
-        return jnp.mean(vocab_parallel_cross_entropy(logits, targets))
     x = _embed_with_dropout(params["embed"], tokens, cfg, dropout_key)
-    x = _layer_stack(params["layers"], x, cfg, dropout_key=dropout_key)
+    x, aux = _layer_stack(params["layers"], x, cfg, dropout_key=dropout_key)
     head = params["head"]
+    if not _use_fused_loss(cfg, tokens.shape[0] * tokens.shape[1]):
+        logits = gpt_head(params, x, cfg)
+        # logits stay in model dtype; CE upcasts internally (fused by XLA)
+        return jnp.mean(vocab_parallel_cross_entropy(logits, targets)) + aux
     w = (params["embed"]["tok"] if cfg.tie_embeddings
          else head["lm"].T)  # (vocab/tp, hidden) rows
     return fused_head_loss(w, head["ln_w"], head["ln_b"], x, targets,
-                           gather_sequence=cfg.megatron_sp)
+                           gather_sequence=cfg.megatron_sp) + aux
 
 
 # ---------------------------------------------------------------------------
@@ -570,12 +643,18 @@ def gpt_pipeline_specs_tree(cfg: GPTConfig, interleaved: bool = False
 
 def gpt_pipeline_spec(cfg: GPTConfig) -> PipelineSpec:
     """The three pipeline functions (PipelineSpec contract)."""
+    if cfg.num_experts:
+        raise NotImplementedError(
+            "MoE layers under the pipeline schedules need aux-loss "
+            "plumbing through the stage boundary; use the non-pipeline "
+            "path (gpt_loss) for num_experts > 0")
 
     def embed_fn(embed, tokens):
         return embed_tokens(embed, tokens, megatron_sp=cfg.megatron_sp)
 
     def stage_fn(stage_layers, h):
-        return _layer_stack(stage_layers, h, cfg)
+        out, _aux = _layer_stack(stage_layers, h, cfg)
+        return out
 
     def loss_fn(head, h, targets):
         # h is the seq shard under megatron_sp; the fused-loss gate needs

@@ -73,11 +73,9 @@ def build_train_step(cfg, batch: int, seq: int):
         init_gpt_params,
     )
 
-    params = init_gpt_params(jax.random.PRNGKey(0), cfg)
     mesh = build_mesh(tp=1, pp=1, sp=1, devices=jax.devices()[:1])
     specs = gpt_param_specs(cfg)
     opt = FusedAdam(lr=1e-4)
-    opt_state = opt.init(params)
 
     def loss_fn(p, tok, tgt):
         def body(p, tok, tgt):
