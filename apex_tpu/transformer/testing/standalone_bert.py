@@ -64,13 +64,15 @@ def init_bert_params(rng, cfg: BertConfig) -> Pytree:
     }
 
 
-def bert_forward(params, tokens, cfg: BertConfig, token_types=None,
+def _bert_logits(params, tokens, cfg: BertConfig, token_types=None,
                  padding_mask=None):
-    """tokens (b, s) -> vocab-sharded MLM logits (b, s, vocab/tp).
-
-    ``padding_mask``: (b, s) True = pad (masked out of attention both ways).
-    Call inside a mesh program.
-    """
+    """-> (vocab-sharded MLM logits, MoE aux loss). BERT's embedding has
+    no Megatron-SP reduce-scatter exit, so ``cfg.megatron_sp`` is rejected
+    rather than silently gathering an unsharded sequence."""
+    if cfg.megatron_sp:
+        raise NotImplementedError(
+            "megatron_sp is wired for the GPT path only; the BERT "
+            "embedding/head lack the sequence scatter/gather boundaries")
     e = params["embed"]
     x = vocab_parallel_embedding(tokens, e["tok"])
     x = x + e["pos"][None, : tokens.shape[1]].astype(x.dtype)
@@ -80,8 +82,8 @@ def bert_forward(params, tokens, cfg: BertConfig, token_types=None,
     attn_mask = None
     if padding_mask is not None:
         attn_mask = padding_mask[:, None, None, :]
-    x, _aux = _layer_stack(params["layers"], x, cfg, causal=False,
-                           mask=attn_mask)
+    x, aux = _layer_stack(params["layers"], x, cfg, causal=False,
+                          mask=attn_mask)
     h = params["head"]
     x = x @ h["dense_kernel"] + h["dense_bias"]
     x = jax.nn.gelu(x, approximate=True)
@@ -91,14 +93,29 @@ def bert_forward(params, tokens, cfg: BertConfig, token_types=None,
     )
 
     x = copy_to_tensor_model_parallel_region(x)
-    return jnp.einsum("bsh,vh->bsv", x, e["tok"])
+    return jnp.einsum("bsh,vh->bsv", x, e["tok"]), aux
+
+
+def bert_forward(params, tokens, cfg: BertConfig, token_types=None,
+                 padding_mask=None):
+    """tokens (b, s) -> vocab-sharded MLM logits (b, s, vocab/tp).
+
+    ``padding_mask``: (b, s) True = pad (masked out of attention both ways).
+    Call inside a mesh program. The MoE router aux loss (if any) is
+    dropped here — use :func:`bert_mlm_loss` for training.
+    """
+    logits, _aux = _bert_logits(params, tokens, cfg, token_types,
+                                padding_mask)
+    return logits
 
 
 def bert_mlm_loss(params, tokens, targets, loss_mask, cfg: BertConfig,
                   token_types=None, padding_mask=None):
     """Masked-LM loss: vocab-parallel CE on masked positions only (ref
-    standalone_bert loss path). ``loss_mask`` (b, s) 1 = predict here."""
-    logits = bert_forward(params, tokens, cfg, token_types, padding_mask)
+    standalone_bert loss path). ``loss_mask`` (b, s) 1 = predict here.
+    With ``cfg.num_experts`` the layer-mean router aux loss is added."""
+    logits, aux = _bert_logits(params, tokens, cfg, token_types,
+                               padding_mask)
     per_tok = vocab_parallel_cross_entropy(logits, targets)
     m = loss_mask.astype(jnp.float32)
-    return jnp.sum(per_tok * m) / jnp.maximum(jnp.sum(m), 1.0)
+    return jnp.sum(per_tok * m) / jnp.maximum(jnp.sum(m), 1.0) + aux

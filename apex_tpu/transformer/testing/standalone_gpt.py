@@ -234,16 +234,14 @@ def gpt_param_specs(cfg: GPTConfig, extra_layer_lead=()) -> Pytree:
     }
     if cfg.num_experts:
         from apex_tpu.parallel.mesh import DP_AXIS
+        from apex_tpu.transformer.moe import moe_param_specs
 
         # experts sharded over dp(=ep): each rank OWNS E/dp experts — their
-        # grads are per-rank, not dp-reduced (DeepSpeed-MoE layout)
-        layer.update({
-            "router": P(*lead),
-            "fc1_kernel": P(*lead, DP_AXIS, None, TP_AXIS),
-            "fc1_bias": P(*lead, DP_AXIS, TP_AXIS),
-            "fc2_kernel": P(*lead, DP_AXIS, TP_AXIS, None),
-            "fc2_bias": P(*lead, DP_AXIS, None),
-        })
+        # grads are per-rank, not dp-reduced (DeepSpeed-MoE layout). The
+        # layout is moe_param_specs' — one source of truth — with the
+        # stacked-layer lead axes prepended.
+        layer.update({k: P(*lead, *s)
+                      for k, s in moe_param_specs(DP_AXIS).items()})
     else:
         layer.update({
             "fc1_kernel": P(*lead, None, TP_AXIS),
@@ -337,6 +335,13 @@ def _mlp(p, x, cfg):
         from apex_tpu.parallel.mesh import DP_AXIS
         from apex_tpu.transformer.moe import moe_mlp
 
+        if cfg.megatron_sp:
+            # validate() also rejects this, but only init paths call it —
+            # guard the forward so checkpoint-loaded/replaced configs
+            # cannot silently psum different tp ranks' sequence shards
+            raise NotImplementedError(
+                "num_experts with megatron_sp is not supported: the "
+                "TP-split expert FFN needs TP-replicated tokens")
         out, aux = moe_mlp(p, x, cfg.moe_config, ep_axis=DP_AXIS)
         return out, aux["loss"]
     y = column_parallel_linear(x, p["fc1_kernel"], p["fc1_bias"],

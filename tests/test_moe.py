@@ -242,8 +242,6 @@ def test_gpt_moe_ep8_trains(mesh_dp8):
     """Flagship GPT with 8 experts over the dp=8 mesh: expert weights are
     dp-SHARDED (each rank owns one expert), the full train step runs, the
     loss drops, and every grad leaf is finite."""
-    import dataclasses
-
     from apex_tpu.optimizers import FusedAdam
     from apex_tpu.transformer.pipeline_parallel.schedules.common import (
         replicate_loss,
@@ -305,3 +303,65 @@ def test_gpt_moe_rejects_pipeline_and_megatron_sp():
         gpt_pipeline_spec(cfg)
     with _pytest.raises(ValueError, match="megatron_sp"):
         dataclasses.replace(cfg, megatron_sp=True).validate()
+
+
+def test_bert_moe_trains(mesh_dp8):
+    """BERT with MoE layers (shared _layer_stack): MLM loss carries the
+    router aux term, trains finite; megatron_sp on BERT refuses loudly."""
+    import dataclasses
+
+    import pytest as _pytest
+
+    from apex_tpu.transformer.pipeline_parallel.schedules.common import (
+        replicate_loss,
+    )
+    from apex_tpu.transformer.testing import bert_mlm_loss, gpt_param_specs
+    from apex_tpu.transformer.testing.standalone_bert import (
+        BertConfig,
+        init_bert_params,
+    )
+
+    cfg = BertConfig(vocab_size=64, max_seq=16, hidden=32, num_layers=2,
+                     num_heads=4, dtype=jnp.float32, remat=False,
+                     num_experts=8, moe_capacity_factor=2.0)
+    params = init_bert_params(jax.random.PRNGKey(6), cfg)
+    b, s = 8, 16
+    tok = jax.random.randint(jax.random.PRNGKey(7), (b, s), 0, 64)
+    tgt = jax.random.randint(jax.random.PRNGKey(8), (b, s), 0, 64)
+    lm = jnp.ones((b, s), jnp.float32)
+
+    specs = gpt_param_specs(cfg)
+    specs["embed"]["type"] = P()
+    specs["embed"]["ln_w"] = P()
+    specs["embed"]["ln_b"] = P()
+    specs["head"] = {k: P() for k in ("dense_kernel", "dense_bias",
+                                      "ln_w", "ln_b")}
+
+    def loss_fn(p):
+        def body(p, tok, tgt, lm):
+            return replicate_loss(bert_mlm_loss(p, tok, tgt, lm, cfg),
+                                  mesh_dp8, masked_axis=None)
+
+        return shard_map(body, mesh=mesh_dp8,
+                         in_specs=(specs, P("dp"), P("dp"), P("dp")),
+                         out_specs=P())(p, tok, tgt, lm)
+
+    loss, grads = jax.jit(jax.value_and_grad(loss_fn))(params)
+    assert np.isfinite(float(loss))
+    assert all(np.all(np.isfinite(np.asarray(g)))
+               for g in jax.tree.leaves(grads))
+    # router grads exist (aux loss is wired through bert_mlm_loss)
+    assert np.any(np.asarray(grads["layers"]["router"]) != 0.0)
+
+    with _pytest.raises(NotImplementedError, match="BERT"):
+        bad = dataclasses.replace(cfg, num_experts=0, megatron_sp=True)
+        loss_cfg = bad
+
+        def body2(p, tok, tgt, lm):
+            return replicate_loss(
+                bert_mlm_loss(p, tok, tgt, lm, loss_cfg),
+                mesh_dp8, masked_axis=None)
+
+        shard_map(body2, mesh=mesh_dp8,
+                  in_specs=(specs, P("dp"), P("dp"), P("dp")),
+                  out_specs=P())(params, tok, tgt, lm)
