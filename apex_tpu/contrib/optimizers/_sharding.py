@@ -56,8 +56,21 @@ def slice_leaf(x, axis_name: str):
     return lax.dynamic_slice_in_dim(flat, rank * k, k, 0)
 
 
-def gather_leaf(shard, shape, dtype, axis_name: str):
-    """all-gather + unpad + reshape: (k,) -> shape (the param all-gather)."""
+def gather_leaf(shard, shape, dtype, axis_name: str, transport_dtype=None):
+    """all-gather + unpad + reshape: (k,) -> shape (the param all-gather).
+
+    ``transport_dtype``: optional narrow dtype for the wire — e.g.
+    ``jnp.float8_e5m2`` halves the all-gather bytes (the reference's
+    ``e5m2_allgather`` option). The shard is first rounded to the model
+    ``dtype`` so the only extra loss is the e5m2 truncation the reference
+    also pays; the sharded fp32 master stays exact.
+    """
+    if transport_dtype is not None:
+        # saturate instead of overflow: float8_e5m2 maxes at 57344 and a
+        # plain cast of anything larger becomes inf on every rank
+        lim = float(jnp.finfo(transport_dtype).max)
+        shard = jnp.clip(shard.astype(jnp.float32), -lim, lim)
+        shard = shard.astype(dtype).astype(transport_dtype)
     full = lax.all_gather(shard, axis_name, axis=0, tiled=True)
     n = 1
     for d in shape:

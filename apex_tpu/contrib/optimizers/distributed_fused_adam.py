@@ -58,6 +58,10 @@ class DistributedFusedAdam:
     adam_w_mode: bool = True
     max_grad_norm: Optional[float] = None  # ref clip_grad_norm
     axis_name: str = DP_AXIS
+    # ref ``e5m2_allgather`` dwu option: ship the updated param shards as
+    # float8_e5m2 (half the all-gather bytes); masters stay fp32-exact,
+    # only the replicated model copy carries the e5m2 rounding
+    e5m2_allgather: bool = False
 
     def init(self, params: Pytree) -> DistAdamState:
         """Shard fp32 masters + zero moments (call inside the mesh program;
@@ -123,7 +127,9 @@ class DistributedFusedAdam:
         mu = jax.tree.map(lambda o: o[1], out, is_leaf=is3)
         nu = jax.tree.map(lambda o: o[2], out, is_leaf=is3)
 
+        transport = jnp.float8_e5m2 if self.e5m2_allgather else None
         new_params = jax.tree.map(
-            lambda m, p: gather_leaf(m, p.shape, p.dtype, self.axis_name),
+            lambda m, p: gather_leaf(m, p.shape, p.dtype, self.axis_name,
+                                     transport_dtype=transport),
             master, params)
         return new_params, DistAdamState(count, master, mu, nu)

@@ -52,6 +52,8 @@ class DistributedFusedLAMB:
     max_grad_norm: Optional[float] = 1.0
     use_nvlamb: bool = False  # apply trust ratio even with wd == 0
     axis_name: str = DP_AXIS
+    # ref e5m2 compressed all-gather (see DistributedFusedAdam)
+    e5m2_allgather: bool = False
 
     def init(self, params: Pytree) -> DistLambState:
         master = jax.tree.map(
@@ -113,6 +115,9 @@ class DistributedFusedLAMB:
         mu = jax.tree.map(lambda o: o[1], out, is_leaf=is3)
         nu = jax.tree.map(lambda o: o[2], out, is_leaf=is3)
         new_params = jax.tree.map(
-            lambda m, p: gather_leaf(m, p.shape, p.dtype, self.axis_name),
+            lambda m, p: gather_leaf(
+                m, p.shape, p.dtype, self.axis_name,
+                transport_dtype=(jnp.float8_e5m2 if self.e5m2_allgather
+                                 else None)),
             master, params)
         return new_params, DistLambState(count, master, mu, nu)

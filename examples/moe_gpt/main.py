@@ -1,0 +1,123 @@
+"""Expert-parallel GPT pretraining example (north-star extension).
+
+No reference counterpart (NVIDIA Apex has no MoE); this is the usage
+pattern for the TPU-native additions: ``GPTConfig.num_experts`` routes
+every layer's FFN through ``transformer.moe`` (top-k capacity routing,
+experts sharded over the dp(=ep) mesh axis via ``all_to_all``, TP-split
+expert weights), with the router load-balance loss added by ``gpt_loss``.
+
+Run (8 virtual devices, synthetic data):
+
+    JAX_PLATFORMS=cpu python examples/moe_gpt/main.py --steps 20
+
+On a real slice drop the platform pin and set --tp to taste; experts ride
+the dp axis, so dp * tp = chip count and num_experts % dp == 0.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    from apex_tpu.utils.platform import pin_cpu_platform
+
+    pin_cpu_platform(virtual_devices=8)
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.optimizers import FusedAdam
+from apex_tpu.parallel.mesh import build_mesh
+from apex_tpu.transformer.pipeline_parallel.schedules.common import (
+    replicate_loss,
+)
+from apex_tpu.transformer.testing import (
+    GPTConfig,
+    gpt_loss,
+    gpt_param_specs,
+    init_gpt_params,
+)
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--batch", type=int, default=16)
+    p.add_argument("--seq", type=int, default=64)
+    p.add_argument("--hidden", type=int, default=64)
+    p.add_argument("--layers", type=int, default=2)
+    p.add_argument("--experts", type=int, default=0,
+                   help="0 = one expert per dp rank")
+    p.add_argument("--top-k", type=int, default=2)
+    p.add_argument("--lr", type=float, default=1e-3)
+    return p.parse_args(argv)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    mesh = build_mesh(tp=args.tp, pp=1, sp=1)
+    dp = mesh.shape["dp"]
+    experts = args.experts or dp
+    cfg = GPTConfig(vocab_size=1024, max_seq=args.seq, hidden=args.hidden,
+                    num_layers=args.layers,
+                    num_heads=max(args.hidden // 16, 1),
+                    dtype=jnp.float32, num_experts=experts,
+                    moe_top_k=args.top_k, hidden_dropout=0.1)
+    cfg.validate(tp=args.tp)
+    if experts % dp:
+        raise SystemExit(f"--experts ({experts}) must divide dp ({dp})")
+
+    params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+    specs = gpt_param_specs(cfg)
+    opt = FusedAdam(lr=args.lr)
+    opt_state = opt.init(params)
+
+    def loss_fn(p, tok, tgt, dkey):
+        # dkey is shared across dp ranks on purpose: the reference's RNG
+        # policy gives data-parallel ranks the SAME dropout stream (only
+        # tp/pp ranks diverge, tensor_parallel/random.py) — rank r's i-th
+        # sample shares a mask with rank q's i-th sample, which Megatron
+        # accepts as benign cross-sample correlation.
+        def body(p, tok, tgt):
+            return replicate_loss(gpt_loss(p, tok, tgt, cfg,
+                                           dropout_key=dkey),
+                                  mesh, masked_axis=None)
+
+        return shard_map(body, mesh=mesh,
+                         in_specs=(specs, P("dp"), P("dp")),
+                         out_specs=P())(p, tok, tgt)
+
+    @jax.jit
+    def train_step(params, opt_state, tok, tgt, dkey):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tok, tgt, dkey)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = jax.tree.map(lambda p, u: p + u, params, updates)
+        return params, opt_state, loss
+
+    key = jax.random.PRNGKey(1)
+    print(f"mesh dp={dp} tp={args.tp}; {experts} experts "
+          f"({experts // dp}/rank), top-{args.top_k}")
+    t0 = time.perf_counter()
+    for step in range(args.steps):
+        key, kd, kb = jax.random.split(key, 3)
+        tok = jax.random.randint(kb, (args.batch, args.seq), 0,
+                                 cfg.vocab_size)
+        tgt = jnp.roll(tok, -1, axis=1)
+        params, opt_state, loss = train_step(params, opt_state, tok, tgt, kd)
+        if step % 5 == 0 or step == args.steps - 1:
+            print(f"step {step:4d}  loss {float(loss):.4f}  "
+                  f"({time.perf_counter() - t0:.1f}s)", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
