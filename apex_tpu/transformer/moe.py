@@ -60,6 +60,12 @@ class MoEConfig:
     z_loss_weight: float = 1e-3
     dtype: Any = jnp.bfloat16
 
+    def __post_init__(self):
+        if self.top_k > self.num_experts:
+            raise ValueError(
+                f"top_k ({self.top_k}) cannot exceed num_experts "
+                f"({self.num_experts})")
+
     def capacity(self, tokens_per_rank: int) -> int:
         per = self.top_k * tokens_per_rank / self.num_experts
         cap = int(per * self.capacity_factor) + 1

@@ -14,6 +14,30 @@ from __future__ import annotations
 import os
 
 
+def probe_backend(timeout_s: int = 240) -> int:
+    """Device count of the default backend, probed in a KILLABLE
+    subprocess; 0 when init hangs or fails. The axon tunnel blocks forever
+    inside backend init when its relay is down (observed in round 2) — a
+    parent process's own first backend touch would hang with it, so this
+    is the only safe way to ask."""
+    import subprocess
+    import sys
+
+    code = ("import jax, jax.numpy as jnp; "
+            "x = jnp.ones((128, 128), jnp.bfloat16); "
+            "assert float((x @ x).sum()) > 0; "
+            "print(len(jax.devices()))")
+    try:
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True,
+                              timeout=timeout_s)
+        if proc.returncode != 0:
+            return 0
+        return int(proc.stdout.strip().splitlines()[-1])
+    except (subprocess.TimeoutExpired, ValueError, IndexError):
+        return 0
+
+
 def pin_cpu_platform(virtual_devices: int | None = None) -> None:
     """Force the CPU backend; optionally expose ``virtual_devices`` host
     devices (the multi-chip simulation used across the test suite).

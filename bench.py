@@ -129,6 +129,13 @@ def _measure(remat: bool, remat_policy: str, batch: int, seq: int,
 
 
 def main() -> None:
+    from apex_tpu.utils.platform import pin_cpu_platform, probe_backend
+
+    if (os.environ.get("JAX_PLATFORMS") != "cpu"
+            and probe_backend() == 0):
+        # fall back to the CPU protocol (flagged metric name) instead of
+        # hanging the driver on a dead tunnel
+        pin_cpu_platform()
     backend = jax.default_backend()
     on_tpu = backend == "tpu"
     batch, seq, steps = (BATCH, SEQ, STEPS) if on_tpu else (2, 128, 3)

@@ -67,6 +67,11 @@ def main(argv=None):
     mesh = build_mesh(tp=args.tp, pp=1, sp=1)
     dp = mesh.shape["dp"]
     experts = args.experts or dp
+    if args.top_k > experts:
+        raise SystemExit(
+            f"--top-k ({args.top_k}) cannot exceed the expert count "
+            f"({experts}); on a {dp}-way dp mesh pass --experts explicitly "
+            f"or lower --top-k")
     cfg = GPTConfig(vocab_size=1024, max_seq=args.seq, hidden=args.hidden,
                     num_layers=args.layers,
                     num_heads=max(args.hidden // 16, 1),
