@@ -140,6 +140,8 @@ class GPTConfig:
                 "num_experts with megatron_sp is not supported yet: the "
                 "TP-split expert FFN needs TP-replicated tokens (gather "
                 "before / reduce-scatter after the MoE region)")
+        if self.num_experts:
+            self.moe_config  # MoEConfig.__post_init__ owns the MoE checks
 
     @property
     def moe_config(self):

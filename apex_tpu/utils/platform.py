@@ -19,9 +19,27 @@ def probe_backend(timeout_s: int = 240) -> int:
     subprocess; 0 when init hangs or fails. The axon tunnel blocks forever
     inside backend init when its relay is down (observed in round 2) — a
     parent process's own first backend touch would hang with it, so this
-    is the only safe way to ask."""
+    is the only safe way to ask. Healthy-platform cost: one extra backend
+    dial in the child (~tens of seconds on a tunnel); a dead tunnel costs
+    the full timeout once.
+
+    When this process has ALREADY initialized its backends, asking jax
+    directly is hang-safe and also sidesteps exclusive-device locks the
+    child could trip over (e.g. the driver holding the TPU after
+    ``entry()``) — do that instead of spawning.
+    """
     import subprocess
     import sys
+
+    try:
+        from jax._src import xla_bridge
+
+        if xla_bridge.backends_are_initialized():
+            import jax
+
+            return len(jax.devices())
+    except (ImportError, AttributeError):
+        pass  # fall through to the subprocess probe
 
     code = ("import jax, jax.numpy as jnp; "
             "x = jnp.ones((128, 128), jnp.bfloat16); "

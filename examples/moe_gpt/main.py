@@ -67,17 +67,17 @@ def main(argv=None):
     mesh = build_mesh(tp=args.tp, pp=1, sp=1)
     dp = mesh.shape["dp"]
     experts = args.experts or dp
-    if args.top_k > experts:
-        raise SystemExit(
-            f"--top-k ({args.top_k}) cannot exceed the expert count "
-            f"({experts}); on a {dp}-way dp mesh pass --experts explicitly "
-            f"or lower --top-k")
     cfg = GPTConfig(vocab_size=1024, max_seq=args.seq, hidden=args.hidden,
                     num_layers=args.layers,
                     num_heads=max(args.hidden // 16, 1),
                     dtype=jnp.float32, num_experts=experts,
                     moe_top_k=args.top_k, hidden_dropout=0.1)
-    cfg.validate(tp=args.tp)
+    try:
+        cfg.validate(tp=args.tp)  # MoEConfig owns top_k/expert checks
+    except ValueError as e:
+        raise SystemExit(
+            f"{e} (on a {dp}-way dp mesh the default expert count is {dp}; "
+            f"pass --experts / --top-k explicitly)") from e
     if experts % dp:
         raise SystemExit(f"--experts ({experts}) must divide dp ({dp})")
 

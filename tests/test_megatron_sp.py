@@ -109,10 +109,13 @@ def test_megatron_sp_validates_divisibility():
         cfg.validate(tp=4)
 
 
-def test_megatron_sp_pipeline_matches_plain():
-    """pp=2 × tp=2 1F1B with megatron_sp == the same schedule without it
-    (inter-stage tensors are the seq shards — tp× smaller p2p)."""
+@pytest.mark.parametrize("interleaved", [False, True])
+def test_megatron_sp_pipeline_matches_plain(interleaved):
+    """pp=2 × tp=2 with megatron_sp == the same schedule without it, for
+    both the 1F1B and the interleaved virtual-stage schedule (inter-stage
+    tensors are the seq shards — tp× smaller p2p)."""
     from apex_tpu.transformer.pipeline_parallel.schedules import (
+        forward_backward_pipelining_with_interleaving,
         forward_backward_pipelining_without_interleaving,
     )
     from apex_tpu.transformer.testing import (
@@ -121,26 +124,33 @@ def test_megatron_sp_pipeline_matches_plain():
         gpt_pipeline_specs_tree,
     )
 
+    pp, tp = 2, 2
+    vp = 2 if interleaved else None
+
     def run(megatron_sp):
-        cfg = dataclasses.replace(CFG, megatron_sp=megatron_sp)
-        pp, tp = 2, 2
+        cfg = dataclasses.replace(
+            CFG, num_layers=pp * (vp or 1), megatron_sp=megatron_sp)
         mesh = build_mesh(tp=tp, pp=pp, sp=1)
-        params = gpt_pipeline_params(jax.random.PRNGKey(0), cfg, pp=pp)
+        params = gpt_pipeline_params(jax.random.PRNGKey(0), cfg, pp=pp,
+                                     vp=vp)
         spec = gpt_pipeline_spec(cfg)
-        specs_tree = gpt_pipeline_specs_tree(cfg)
-        key = jax.random.PRNGKey(1)
+        specs_tree = gpt_pipeline_specs_tree(cfg, interleaved=interleaved)
         nmb = 2
-        b = 2 * nmb
-        tok = jax.random.randint(key, (b, cfg.max_seq), 0, cfg.vocab_size)
+        tok = jax.random.randint(jax.random.PRNGKey(1), (2 * nmb,
+                                                         cfg.max_seq),
+                                 0, cfg.vocab_size)
         tgt = jnp.roll(tok, -1, axis=1)
+        kw = dict(num_microbatches=nmb, mesh=mesh, params_specs=specs_tree,
+                  data_spec=P(None, "dp", "sp"))
 
         def step(params):
+            if interleaved:
+                return forward_backward_pipelining_with_interleaving(
+                    spec, params, (tok, tgt), virtual_pipeline_size=vp, **kw)
             return forward_backward_pipelining_without_interleaving(
-                spec, params, (tok, tgt), num_microbatches=nmb, mesh=mesh,
-                params_specs=specs_tree, data_spec=P(None, "dp", "sp"))
+                spec, params, (tok, tgt), **kw)
 
-        loss, grads = jax.jit(step)(params)
-        return loss, grads
+        return jax.jit(step)(params)
 
     l0, g0 = run(False)
     l1, g1 = run(True)

@@ -231,8 +231,8 @@ def test_gpt_moe_single_expert_matches_dense(mesh_dp8):
             body, mesh=mesh1, in_specs=(gpt_param_specs(cfg), P(), P()),
             out_specs=P())(params, tok, tgt))
 
-    aux_expected = MoEConfig(num_experts=1, hidden=32,
-                             ffn_hidden=128).lb_loss_weight * 1.0
+    aux_expected = MoEConfig(num_experts=1, hidden=32, ffn_hidden=128,
+                             top_k=1).lb_loss_weight * 1.0
     l_moe, l_dense = run(moe_cfg, moe), run(dense_cfg, dense)
     np.testing.assert_allclose(l_moe - aux_expected, l_dense,
                                rtol=1e-5, atol=1e-6)
