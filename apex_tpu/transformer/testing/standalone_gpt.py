@@ -105,8 +105,9 @@ class GPTConfig:
     # FFN (transformer.moe): top-k capacity routing, experts sharded over
     # the dp(=ep) mesh axis with all_to_all dispatch, expert FFN weights
     # TP-split. The router aux loss is averaged over layers and added to
-    # gpt_loss. Not yet supported with megatron_sp or the pipeline
-    # schedules (both raise).
+    # gpt_loss. Composes with megatron_sp (the MoE region gathers the
+    # sequence and slices the shard back out); the pipeline schedules
+    # still raise (aux-loss stage plumbing).
     num_experts: int = 0
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
@@ -135,11 +136,6 @@ class GPTConfig:
             raise ValueError(
                 f"megatron_sp needs max_seq ({self.max_seq}) divisible by "
                 f"tp ({tp})")
-        if self.num_experts and self.megatron_sp:
-            raise ValueError(
-                "num_experts with megatron_sp is not supported yet: the "
-                "TP-split expert FFN needs TP-replicated tokens (gather "
-                "before / reduce-scatter after the MoE region)")
         if self.num_experts:
             self.moe_config  # MoEConfig.__post_init__ owns the MoE checks
 
@@ -336,15 +332,28 @@ def _mlp(p, x, cfg):
     if cfg.num_experts:
         from apex_tpu.parallel.mesh import DP_AXIS
         from apex_tpu.transformer.moe import moe_mlp
+        from apex_tpu.transformer.tensor_parallel.mappings import (
+            gather_from_sequence_parallel_region,
+        )
 
         if cfg.megatron_sp:
-            # validate() also rejects this, but only init paths call it —
-            # guard the forward so checkpoint-loaded/replaced configs
-            # cannot silently psum different tp ranks' sequence shards
-            raise NotImplementedError(
-                "num_experts with megatron_sp is not supported: the "
-                "TP-split expert FFN needs TP-replicated tokens")
+            # the TP-split expert FFN psums partial outputs over tp, which
+            # requires every tp rank to hold the SAME tokens: gather the
+            # sequence for the MoE region, slice the own shard back out.
+            # Backward is exactly right by transposition: the rank-indexed
+            # slice of the tp-invariant MoE output transposes to a psum of
+            # zero-padded shard cotangents — every rank recovers the FULL
+            # per-token cotangent, so each rank's own ffn-dim weight slice
+            # (tp-SPLIT, not replicated) accumulates all tokens'
+            # contributions locally, and the gather's transpose
+            # reduce-scatters dx back to the sequence shard.
+            x = gather_from_sequence_parallel_region(x)
         out, aux = moe_mlp(p, x, cfg.moe_config, ep_axis=DP_AXIS)
+        if cfg.megatron_sp:
+            tp_size = lax.axis_size(TP_AXIS)
+            s_shard = out.shape[1] // tp_size
+            out = lax.dynamic_slice_in_dim(
+                out, lax.axis_index(TP_AXIS) * s_shard, s_shard, 1)
         return out, aux["loss"]
     y = column_parallel_linear(x, p["fc1_kernel"], p["fc1_bias"],
                                gather_output=False,

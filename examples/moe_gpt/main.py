@@ -75,9 +75,10 @@ def main(argv=None):
     try:
         cfg.validate(tp=args.tp)  # MoEConfig owns top_k/expert checks
     except ValueError as e:
-        raise SystemExit(
-            f"{e} (on a {dp}-way dp mesh the default expert count is {dp}; "
-            f"pass --experts / --top-k explicitly)") from e
+        hint = (f" (on a {dp}-way dp mesh the default expert count is {dp}; "
+                f"pass --experts / --top-k explicitly)"
+                if "top_k" in str(e) else "")
+        raise SystemExit(f"{e}{hint}") from e
     if experts % dp:
         raise SystemExit(f"--experts ({experts}) must divide dp ({dp})")
 

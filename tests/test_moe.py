@@ -289,9 +289,7 @@ def test_gpt_moe_ep8_trains(mesh_dp8):
         assert np.all(np.isfinite(np.asarray(g))), f"non-finite at {path}"
 
 
-def test_gpt_moe_rejects_pipeline_and_megatron_sp():
-    import dataclasses
-
+def test_gpt_moe_rejects_pipeline():
     import pytest as _pytest
 
     from apex_tpu.transformer.testing import GPTConfig
@@ -301,8 +299,49 @@ def test_gpt_moe_rejects_pipeline_and_megatron_sp():
                     num_heads=4, num_experts=4)
     with _pytest.raises(NotImplementedError, match="aux-loss"):
         gpt_pipeline_spec(cfg)
-    with _pytest.raises(ValueError, match="megatron_sp"):
-        dataclasses.replace(cfg, megatron_sp=True).validate()
+
+
+def test_gpt_moe_megatron_sp_matches_plain(mesh_dp4_tp2):
+    """MoE under megatron_sp (gather -> MoE -> shard slice) == MoE on the
+    plain TP path — loss AND grads, tp=2 x dp(=ep)=4."""
+    import dataclasses
+
+    from apex_tpu.transformer.pipeline_parallel.schedules.common import (
+        replicate_loss,
+    )
+    from apex_tpu.transformer.testing import (
+        GPTConfig,
+        gpt_loss,
+        gpt_param_specs,
+        init_gpt_params,
+    )
+
+    base = GPTConfig(vocab_size=96, max_seq=16, hidden=32, num_layers=2,
+                     num_heads=4, dtype=jnp.float32, num_experts=4,
+                     moe_capacity_factor=4.0)
+    tok = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0, 96)
+    tgt = jnp.roll(tok, -1, 1)
+
+    def run(cfg):
+        params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+        specs = gpt_param_specs(cfg)
+
+        def loss_fn(p):
+            def body(p, t, g):
+                return replicate_loss(gpt_loss(p, t, g, cfg), mesh_dp4_tp2,
+                                      masked_axis=None)
+
+            return shard_map(body, mesh=mesh_dp4_tp2,
+                             in_specs=(specs, P("dp"), P("dp")),
+                             out_specs=P())(p, tok, tgt)
+
+        return jax.jit(jax.value_and_grad(loss_fn))(params)
+
+    l0, g0 = run(base)
+    l1, g1 = run(dataclasses.replace(base, megatron_sp=True))
+    np.testing.assert_allclose(float(l1), float(l0), rtol=1e-5, atol=1e-6)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5), g1, g0)
 
 
 def test_bert_moe_trains(mesh_dp8):
