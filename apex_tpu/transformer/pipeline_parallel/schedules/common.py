@@ -56,11 +56,18 @@ class PipelineSpec:
     loss_fn(head_params, hidden, targets_mb) -> scalar
         The ``post_process`` half: final norm + head + loss for ONE
         microbatch, already averaged over the microbatch's tokens.
+    stage_aux
+        When True, ``stage_fn`` returns ``(hidden, aux_scalar)`` — a
+        per-stage side loss (e.g. the MoE router aux). The schedules
+        accumulate it over real (non-fill/drain) ticks, average over
+        microbatches and stages, and ADD it to the returned loss, so its
+        gradients reach the stage params through the same AD sweep.
     """
 
     embed_fn: Callable[[Pytree, Pytree], Pytree]
     stage_fn: Callable[[Pytree, Pytree], Pytree]
     loss_fn: Callable[[Pytree, Pytree, Pytree], jnp.ndarray]
+    stage_aux: bool = False
 
 
 def build_model(

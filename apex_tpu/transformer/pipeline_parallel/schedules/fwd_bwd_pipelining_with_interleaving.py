@@ -64,10 +64,14 @@ def pipeline_ring_interleaved(
     virtual_pipeline_size: int,
     axis_name: str = PP_AXIS,
     remat: bool = True,
+    returns_aux: bool = False,
 ) -> Pytree:
     """Circular ring inside a mesh program. ``chunk_params`` is this stage's
     ``[vp, ...]`` chunk stack (pp axis already squeezed). Returns ``[M, ...]``
-    final-chunk outputs, valid on the last stage."""
+    final-chunk outputs, valid on the last stage. With ``returns_aux`` the
+    stage function yields ``(h, aux_scalar)`` and the result is
+    ``(outputs, aux_mean)``: the stage's aux averaged over its real
+    (microbatch, chunk) ticks."""
     pp = lax.axis_size(axis_name)
     rank = lax.axis_index(axis_name)
     M, vp = num_microbatches, virtual_pipeline_size
@@ -84,6 +88,7 @@ def pipeline_ring_interleaved(
     axes = _mesh_axis_names()
 
     def tick(carry, t):
+        h, aux_sum = carry
         u = jnp.clip(t - rank, 0, work - 1)
         g = u // (pp * vp)
         w = u % (pp * vp)
@@ -91,13 +96,22 @@ def pipeline_ring_interleaved(
         i = w % pp
         x0 = _tree_index(h_mb, jnp.clip(g * pp + i, 0, M - 1))
         take_new = (rank == 0) & (r == 0)
-        inp = _tree_where(take_new, x0, carry)
+        inp = _tree_where(take_new, x0, h)
         p_r = _tree_index(chunk_params, r)
-        out = fn(p_r, inp)
-        return _pvary_all(_ring_shift(out, axis_name), axes), out
+        if returns_aux:
+            out, aux = fn(p_r, inp)
+            valid = (t >= rank) & (t - rank <= work - 1)
+            aux_sum = aux_sum + jnp.where(valid, aux, 0.0)
+        else:
+            out = fn(p_r, inp)
+        return (_pvary_all(_ring_shift(out, axis_name), axes),
+                _pvary_all(aux_sum, axes)), out
 
-    init = _pvary_all(jax.tree.map(lambda a: jnp.zeros_like(a[0]), h_mb), axes)
-    _, ys = lax.scan(tick, init, jnp.arange(T))
+    init = (
+        _pvary_all(jax.tree.map(lambda a: jnp.zeros_like(a[0]), h_mb), axes),
+        _pvary_all(jnp.zeros((), jnp.float32), axes),
+    )
+    (_, aux_sum), ys = lax.scan(tick, init, jnp.arange(T))
     # microbatch m = g*pp+i finishes chunk vp-1 on the last stage at tick
     # g*pp*vp + (vp-1)*pp + i + (pp-1)
     idx = np.asarray(
@@ -105,7 +119,10 @@ def pipeline_ring_interleaved(
          for g in range(G) for i in range(pp)],
         dtype=np.int32,
     )
-    return jax.tree.map(lambda a: a[idx], ys)
+    outs = jax.tree.map(lambda a: a[idx], ys)
+    if returns_aux:
+        return outs, aux_sum / work
+    return outs
 
 
 def _pipeline_body(
@@ -129,14 +146,22 @@ def _pipeline_body(
         num_microbatches=num_microbatches,
         virtual_pipeline_size=virtual_pipeline_size,
         remat=remat,
+        returns_aux=spec.stage_aux,
     )
+    aux = None
+    if spec.stage_aux:
+        ys, aux = ys
     losses = jax.vmap(spec.loss_fn, in_axes=(None, 0, 0))(
         params["head"], ys, targets_mb
     )
     pp = lax.axis_size(PP_AXIS)
     is_last = lax.axis_index(PP_AXIS) == pp - 1
     local = jnp.where(is_last, jnp.mean(losses), 0.0)
-    return replicate_loss(local, mesh)
+    total = replicate_loss(local, mesh)
+    if aux is not None:
+        # per-stage (chunk-mean) aux -> model-wide layer mean (psum/pp)
+        total = total + replicate_loss(aux, mesh, masked_axis=None)
+    return total
 
 
 def forward_backward_pipelining_with_interleaving(

@@ -93,6 +93,7 @@ def pipeline_ring(
     num_microbatches: int,
     axis_name: str = PP_AXIS,
     remat: bool = True,
+    returns_aux: bool = False,
 ) -> Pytree:
     """Run ``num_microbatches`` activations through the pp-stage ring.
 
@@ -100,7 +101,10 @@ def pipeline_ring(
     local params (stage axis already squeezed); ``h_mb`` is ``[M, ...]``
     stage-0 inputs (present on every device, consumed at stage 0). Returns
     ``[M, ...]`` outputs, valid on the LAST stage (garbage elsewhere — mask
-    before use).
+    before use). With ``returns_aux`` the stage function yields
+    ``(h, aux_scalar)`` and the result is ``(outputs, aux_mean)`` where
+    ``aux_mean`` averages the stage's aux over its real microbatch ticks
+    (fill/drain garbage is masked out).
     """
     pp = lax.axis_size(axis_name)
     rank = lax.axis_index(axis_name)
@@ -110,15 +114,29 @@ def pipeline_ring(
     axes = _mesh_axis_names()
 
     def tick(carry, t):
+        h, aux_sum = carry
         x0 = _tree_index(h_mb, jnp.clip(t, 0, M - 1))
-        inp = _tree_where(rank == 0, x0, carry)
-        out = fn(stage_params, inp)
-        return _pvary_all(_ring_shift(out, axis_name), axes), out
+        inp = _tree_where(rank == 0, x0, h)
+        if returns_aux:
+            out, aux = fn(stage_params, inp)
+            # stage `rank` holds microbatch t-rank at tick t
+            valid = (t >= rank) & (t - rank <= M - 1)
+            aux_sum = aux_sum + jnp.where(valid, aux, 0.0)
+        else:
+            out = fn(stage_params, inp)
+        return (_pvary_all(_ring_shift(out, axis_name), axes),
+                _pvary_all(aux_sum, axes)), out
 
-    init = _pvary_all(jax.tree.map(lambda a: jnp.zeros_like(a[0]), h_mb), axes)
-    _, ys = lax.scan(tick, init, jnp.arange(M + pp - 1))
+    init = (
+        _pvary_all(jax.tree.map(lambda a: jnp.zeros_like(a[0]), h_mb), axes),
+        _pvary_all(jnp.zeros((), jnp.float32), axes),
+    )
+    (_, aux_sum), ys = lax.scan(tick, init, jnp.arange(M + pp - 1))
     # tick pp-1+i holds microbatch i's final output on the last stage
-    return jax.tree.map(lambda a: a[pp - 1:], ys)
+    outs = jax.tree.map(lambda a: a[pp - 1:], ys)
+    if returns_aux:
+        return outs, aux_sum / M
+    return outs
 
 
 def _pipeline_body(
@@ -139,14 +157,23 @@ def _pipeline_body(
         h_mb,
         num_microbatches=num_microbatches,
         remat=remat,
+        returns_aux=spec.stage_aux,
     )
+    aux = None
+    if spec.stage_aux:
+        ys, aux = ys
     losses = jax.vmap(spec.loss_fn, in_axes=(None, 0, 0))(
         params["head"], ys, targets_mb
     )
     pp = lax.axis_size(PP_AXIS)
     is_last = lax.axis_index(PP_AXIS) == pp - 1
     local = jnp.where(is_last, jnp.mean(losses), 0.0)
-    return replicate_loss(local, mesh)
+    total = replicate_loss(local, mesh)
+    if aux is not None:
+        # per-stage layer-mean aux -> model-wide layer mean (psum/pp), same
+        # dp averaging as the main loss
+        total = total + replicate_loss(aux, mesh, masked_axis=None)
+    return total
 
 
 def forward_backward_pipelining_without_interleaving(

@@ -24,6 +24,7 @@ from apex_tpu.transformer.tensor_parallel.mappings import (  # noqa: F401
     gather_from_tensor_model_parallel_region,
     reduce_from_tensor_model_parallel_region,
     reduce_scatter_to_sequence_parallel_region,
+    scatter_to_sequence_parallel_region,
     scatter_to_tensor_model_parallel_region,
 )
 from apex_tpu.transformer.tensor_parallel.memory import (  # noqa: F401
@@ -73,6 +74,7 @@ __all__ = [
     "pipeline_stage_key",
     "reduce_from_tensor_model_parallel_region",
     "reduce_scatter_to_sequence_parallel_region",
+    "scatter_to_sequence_parallel_region",
     "row_parallel_linear",
     "scatter_to_tensor_model_parallel_region",
     "set_tensor_model_parallel_attributes",

@@ -155,3 +155,19 @@ def reduce_scatter_to_sequence_parallel_region(x, axis_name: str = TP_AXIS,
     return lax.psum_scatter(
         _pvary(x, axis_name), axis_name, scatter_dimension=seq_axis,
         tiled=True)
+
+
+def scatter_to_sequence_parallel_region(x, axis_name: str = TP_AXIS,
+                                        seq_axis: int = 1):
+    """Rank-indexed sequence slice of an axis-invariant (fully reduced)
+    tensor — the no-reduction exit from a region where every rank computed
+    the full sequence (e.g. the MoE block under Megatron-SP). Backward is
+    exact by transposition: slicing an invariant tensor at the rank index
+    transposes to a psum of zero-padded shard cotangents, so every rank
+    recovers the FULL per-token cotangent. Use
+    :func:`reduce_scatter_to_sequence_parallel_region` instead when the
+    input still carries per-rank partial sums."""
+    world = lax.axis_size(axis_name)
+    chunk = divide(x.shape[seq_axis], world)
+    rank = lax.axis_index(axis_name)
+    return lax.dynamic_slice_in_dim(x, rank * chunk, chunk, axis=seq_axis)

@@ -106,8 +106,8 @@ class GPTConfig:
     # the dp(=ep) mesh axis with all_to_all dispatch, expert FFN weights
     # TP-split. The router aux loss is averaged over layers and added to
     # gpt_loss. Composes with megatron_sp (the MoE region gathers the
-    # sequence and slices the shard back out); the pipeline schedules
-    # still raise (aux-loss stage plumbing).
+    # sequence and slices the shard back out) and with the pipeline
+    # schedules (PipelineSpec.stage_aux carries the router aux per stage).
     num_experts: int = 0
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
@@ -332,28 +332,23 @@ def _mlp(p, x, cfg):
     if cfg.num_experts:
         from apex_tpu.parallel.mesh import DP_AXIS
         from apex_tpu.transformer.moe import moe_mlp
-        from apex_tpu.transformer.tensor_parallel.mappings import (
-            gather_from_sequence_parallel_region,
-        )
 
         if cfg.megatron_sp:
             # the TP-split expert FFN psums partial outputs over tp, which
             # requires every tp rank to hold the SAME tokens: gather the
-            # sequence for the MoE region, slice the own shard back out.
-            # Backward is exactly right by transposition: the rank-indexed
-            # slice of the tp-invariant MoE output transposes to a psum of
-            # zero-padded shard cotangents — every rank recovers the FULL
-            # per-token cotangent, so each rank's own ffn-dim weight slice
-            # (tp-SPLIT, not replicated) accumulates all tokens'
-            # contributions locally, and the gather's transpose
-            # reduce-scatters dx back to the sequence shard.
+            # sequence for the MoE region, then take the own shard back out
+            # (the scatter mapping's transpose restores the full per-token
+            # cotangent on every rank — see its docstring).
+            from apex_tpu.transformer.tensor_parallel.mappings import (
+                gather_from_sequence_parallel_region,
+                scatter_to_sequence_parallel_region,
+            )
+
             x = gather_from_sequence_parallel_region(x)
-        out, aux = moe_mlp(p, x, cfg.moe_config, ep_axis=DP_AXIS)
-        if cfg.megatron_sp:
-            tp_size = lax.axis_size(TP_AXIS)
-            s_shard = out.shape[1] // tp_size
-            out = lax.dynamic_slice_in_dim(
-                out, lax.axis_index(TP_AXIS) * s_shard, s_shard, 1)
+            out, aux = moe_mlp(p, x, cfg.moe_config, ep_axis=DP_AXIS)
+            out = scatter_to_sequence_parallel_region(out)
+        else:
+            out, aux = moe_mlp(p, x, cfg.moe_config, ep_axis=DP_AXIS)
         return out, aux["loss"]
     y = column_parallel_linear(x, p["fc1_kernel"], p["fc1_bias"],
                                gather_output=False,
@@ -658,18 +653,17 @@ def gpt_pipeline_specs_tree(cfg: GPTConfig, interleaved: bool = False
 
 
 def gpt_pipeline_spec(cfg: GPTConfig) -> PipelineSpec:
-    """The three pipeline functions (PipelineSpec contract)."""
-    if cfg.num_experts:
-        raise NotImplementedError(
-            "MoE layers under the pipeline schedules need aux-loss "
-            "plumbing through the stage boundary; use the non-pipeline "
-            "path (gpt_loss) for num_experts > 0")
+    """The three pipeline functions (PipelineSpec contract). With
+    ``cfg.num_experts`` the stage function also yields its layers' router
+    aux loss (``stage_aux=True``) — the schedules accumulate and add it."""
 
     def embed_fn(embed, tokens):
         return embed_tokens(embed, tokens, megatron_sp=cfg.megatron_sp)
 
     def stage_fn(stage_layers, h):
-        out, _aux = _layer_stack(stage_layers, h, cfg)
+        out, aux = _layer_stack(stage_layers, h, cfg)
+        if cfg.num_experts:
+            return out, aux
         return out
 
     def loss_fn(head, h, targets):
@@ -686,4 +680,5 @@ def gpt_pipeline_spec(cfg: GPTConfig) -> PipelineSpec:
             cfg, tie_embeddings=False))
         return jnp.mean(vocab_parallel_cross_entropy(logits, targets))
 
-    return PipelineSpec(embed_fn=embed_fn, stage_fn=stage_fn, loss_fn=loss_fn)
+    return PipelineSpec(embed_fn=embed_fn, stage_fn=stage_fn, loss_fn=loss_fn,
+                        stage_aux=bool(cfg.num_experts))

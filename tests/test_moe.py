@@ -289,18 +289,6 @@ def test_gpt_moe_ep8_trains(mesh_dp8):
         assert np.all(np.isfinite(np.asarray(g))), f"non-finite at {path}"
 
 
-def test_gpt_moe_rejects_pipeline():
-    import pytest as _pytest
-
-    from apex_tpu.transformer.testing import GPTConfig
-    from apex_tpu.transformer.testing.standalone_gpt import gpt_pipeline_spec
-
-    cfg = GPTConfig(vocab_size=96, max_seq=16, hidden=32, num_layers=2,
-                    num_heads=4, num_experts=4)
-    with _pytest.raises(NotImplementedError, match="aux-loss"):
-        gpt_pipeline_spec(cfg)
-
-
 def test_gpt_moe_megatron_sp_matches_plain(mesh_dp4_tp2):
     """MoE under megatron_sp (gather -> MoE -> shard slice) == MoE on the
     plain TP path — loss AND grads, tp=2 x dp(=ep)=4."""
@@ -404,3 +392,114 @@ def test_bert_moe_trains(mesh_dp8):
         shard_map(body2, mesh=mesh_dp8,
                   in_specs=(specs, P("dp"), P("dp"), P("dp")),
                   out_specs=P())(params, tok, tgt, lm)
+
+
+def test_gpt_moe_pipeline_matches_sequential():
+    """MoE through the 1F1B pipeline: the schedules accumulate the router
+    aux loss per stage (stage_aux) and the total equals the non-pipeline
+    gpt_loss on the flattened params; router/expert grads are nonzero."""
+    import dataclasses
+
+    from apex_tpu.transformer.pipeline_parallel.schedules import (
+        forward_backward_pipelining_without_interleaving,
+    )
+    from apex_tpu.transformer.pipeline_parallel.schedules.common import (
+        replicate_loss,
+    )
+    from apex_tpu.transformer.testing import (
+        GPTConfig,
+        gpt_loss,
+        gpt_param_specs,
+    )
+    from apex_tpu.transformer.testing.standalone_gpt import (
+        gpt_pipeline_params,
+        gpt_pipeline_spec,
+        gpt_pipeline_specs_tree,
+    )
+
+    # experts must divide BOTH meshes' dp: pipeline dp=4, sequential dp=8
+    cfg = GPTConfig(vocab_size=96, max_seq=16, hidden=32, num_layers=2,
+                    num_heads=4, dtype=jnp.float32, tie_embeddings=False,
+                    num_experts=8, moe_capacity_factor=8.0)
+    pp = 2
+    params = gpt_pipeline_params(jax.random.PRNGKey(0), cfg, pp=pp)
+    mesh = build_mesh(tp=1, pp=pp, sp=1)  # dp=4 (= ep for the schedule)
+    tok = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0, 96)
+    tgt = jnp.roll(tok, -1, 1)
+
+    loss, grads = forward_backward_pipelining_without_interleaving(
+        gpt_pipeline_spec(cfg), params, (tok, tgt), num_microbatches=2,
+        mesh=mesh, params_specs=gpt_pipeline_specs_tree(cfg),
+        data_spec=P(None, "dp"), remat=False)
+
+    # sequential reference on a dp-only mesh with the same (untied) params
+    flat_layers = jax.tree.map(
+        lambda x: x.reshape((-1,) + x.shape[2:]), params["stages"])
+    flat = {"embed": params["embed"], "layers": flat_layers,
+            "head": params["head"]}
+    mesh_dp = build_mesh(tp=1, pp=1, sp=1)  # dp=8
+
+    def body(p, t, g):
+        return replicate_loss(gpt_loss(p, t, g, cfg), mesh_dp,
+                              masked_axis=None)
+
+    want = shard_map(body, mesh=mesh_dp,
+                     in_specs=(gpt_param_specs(cfg), P("dp"), P("dp")),
+                     out_specs=P())(flat, tok, tgt)
+    np.testing.assert_allclose(float(loss), float(want), rtol=1e-4)
+    assert np.any(np.asarray(grads["stages"]["router"]) != 0.0)
+    assert all(np.all(np.isfinite(np.asarray(g)))
+               for g in jax.tree.leaves(grads))
+
+
+def test_gpt_moe_interleaved_pipeline_matches_sequential():
+    """MoE aux through the interleaved schedule (vp=2): equals the
+    sequential loss on the chunk-major-flattened params."""
+    from apex_tpu.transformer.pipeline_parallel.schedules import (
+        forward_backward_pipelining_with_interleaving,
+    )
+    from apex_tpu.transformer.pipeline_parallel.schedules.common import (
+        replicate_loss,
+    )
+    from apex_tpu.transformer.testing import (
+        GPTConfig,
+        gpt_loss,
+        gpt_param_specs,
+    )
+    from apex_tpu.transformer.testing.standalone_gpt import (
+        gpt_pipeline_params,
+        gpt_pipeline_spec,
+        gpt_pipeline_specs_tree,
+    )
+
+    cfg = GPTConfig(vocab_size=96, max_seq=16, hidden=32, num_layers=4,
+                    num_heads=4, dtype=jnp.float32, tie_embeddings=False,
+                    num_experts=8, moe_capacity_factor=8.0)
+    pp, vp = 2, 2
+    params = gpt_pipeline_params(jax.random.PRNGKey(0), cfg, pp=pp, vp=vp)
+    mesh = build_mesh(tp=1, pp=pp, sp=1)  # dp=4
+    tok = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0, 96)
+    tgt = jnp.roll(tok, -1, 1)
+
+    loss, grads = forward_backward_pipelining_with_interleaving(
+        gpt_pipeline_spec(cfg), params, (tok, tgt), num_microbatches=2,
+        virtual_pipeline_size=vp, mesh=mesh,
+        params_specs=gpt_pipeline_specs_tree(cfg, interleaved=True),
+        data_spec=P(None, "dp"), remat=False)
+
+    # depth order is chunk-major (v*pp + s): plain reshape restores it
+    flat_layers = jax.tree.map(
+        lambda x: x.reshape((-1,) + x.shape[3:]), params["stages"])
+    flat = {"embed": params["embed"], "layers": flat_layers,
+            "head": params["head"]}
+    mesh_dp = build_mesh(tp=1, pp=1, sp=1)  # dp=8
+
+    def body(p, t, g):
+        return replicate_loss(gpt_loss(p, t, g, cfg), mesh_dp,
+                              masked_axis=None)
+
+    want = shard_map(body, mesh=mesh_dp,
+                     in_specs=(gpt_param_specs(cfg), P("dp"), P("dp")),
+                     out_specs=P())(flat, tok, tgt)
+    np.testing.assert_allclose(float(loss), float(want), rtol=1e-4)
+    assert np.any(np.asarray(grads["stages"]["router"]) != 0.0)
