@@ -35,8 +35,11 @@ import time
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _ROOT)
 
-if os.environ.get("APEX_TPU_BENCH_VIRTUAL"):
-    # without the pin the virtual child dials the TPU tunnel at backend init
+if (os.environ.get("APEX_TPU_BENCH_VIRTUAL")
+        or os.environ.get("JAX_PLATFORMS") == "cpu"):
+    # the env var alone does NOT stop the image's axon backend hook — only
+    # the config-flag pin does (utils/platform.py); without it the virtual
+    # child (or an explicit JAX_PLATFORMS=cpu run) dials the TPU tunnel
     from apex_tpu.utils.platform import pin_cpu_platform
 
     pin_cpu_platform()
@@ -295,6 +298,13 @@ def main(argv=None):
     local = [n for n in names if not CONFIGS[n][1]]
     if os.environ.get("APEX_TPU_BENCH_VIRTUAL"):
         local, virtual = names, []  # we ARE the subprocess
+    elif os.environ.get("JAX_PLATFORMS") != "cpu":
+        from apex_tpu.utils.platform import pin_cpu_platform, probe_backend
+
+        if probe_backend() == 0:
+            # dead tunnel: run the local configs on the CPU protocol
+            # instead of hanging on first backend touch (see bench.py)
+            pin_cpu_platform()
 
     for n in local:
         try:

@@ -398,8 +398,6 @@ def test_gpt_moe_pipeline_matches_sequential():
     """MoE through the 1F1B pipeline: the schedules accumulate the router
     aux loss per stage (stage_aux) and the total equals the non-pipeline
     gpt_loss on the flattened params; router/expert grads are nonzero."""
-    import dataclasses
-
     from apex_tpu.transformer.pipeline_parallel.schedules import (
         forward_backward_pipelining_without_interleaving,
     )
