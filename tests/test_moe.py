@@ -183,6 +183,32 @@ def test_moe_grads_flow_and_aux_loss(mesh_dp8):
 # MoE inside the flagship GPT (GPTConfig.num_experts)
 
 
+def _pipeline_sequential_reference(cfg, params, tok, tgt, ref_mesh,
+                                   interleaved=False):
+    """Sequential gpt_loss on the pipeline params flattened back to one
+    layer stack (interleaved depth order is chunk-major v*pp + s, which a
+    plain reshape restores) — the shared ground truth for the pipeline
+    parity tests."""
+    from apex_tpu.transformer.pipeline_parallel.schedules.common import (
+        replicate_loss,
+    )
+    from apex_tpu.transformer.testing import gpt_loss, gpt_param_specs
+
+    lead = 3 if interleaved else 2
+    flat_layers = jax.tree.map(
+        lambda x: x.reshape((-1,) + x.shape[lead:]), params["stages"])
+    flat = {"embed": params["embed"], "layers": flat_layers,
+            "head": params["head"]}
+
+    def body(p, t, g):
+        return replicate_loss(gpt_loss(p, t, g, cfg), ref_mesh,
+                              masked_axis=None)
+
+    return shard_map(body, mesh=ref_mesh,
+                     in_specs=(gpt_param_specs(cfg), P("dp"), P("dp")),
+                     out_specs=P())(flat, tok, tgt)
+
+
 def test_gpt_moe_single_expert_matches_dense(mesh_dp8):
     """A 1-expert MoE GPT with a zeroed router and ample capacity is the
     dense GPT plus a known constant aux loss (lb=1 exactly at E=1, z=0
@@ -401,14 +427,7 @@ def test_gpt_moe_pipeline_matches_sequential():
     from apex_tpu.transformer.pipeline_parallel.schedules import (
         forward_backward_pipelining_without_interleaving,
     )
-    from apex_tpu.transformer.pipeline_parallel.schedules.common import (
-        replicate_loss,
-    )
-    from apex_tpu.transformer.testing import (
-        GPTConfig,
-        gpt_loss,
-        gpt_param_specs,
-    )
+    from apex_tpu.transformer.testing import GPTConfig
     from apex_tpu.transformer.testing.standalone_gpt import (
         gpt_pipeline_params,
         gpt_pipeline_spec,
@@ -430,20 +449,8 @@ def test_gpt_moe_pipeline_matches_sequential():
         mesh=mesh, params_specs=gpt_pipeline_specs_tree(cfg),
         data_spec=P(None, "dp"), remat=False)
 
-    # sequential reference on a dp-only mesh with the same (untied) params
-    flat_layers = jax.tree.map(
-        lambda x: x.reshape((-1,) + x.shape[2:]), params["stages"])
-    flat = {"embed": params["embed"], "layers": flat_layers,
-            "head": params["head"]}
-    mesh_dp = build_mesh(tp=1, pp=1, sp=1)  # dp=8
-
-    def body(p, t, g):
-        return replicate_loss(gpt_loss(p, t, g, cfg), mesh_dp,
-                              masked_axis=None)
-
-    want = shard_map(body, mesh=mesh_dp,
-                     in_specs=(gpt_param_specs(cfg), P("dp"), P("dp")),
-                     out_specs=P())(flat, tok, tgt)
+    want = _pipeline_sequential_reference(
+        cfg, params, tok, tgt, build_mesh(tp=1, pp=1, sp=1))  # dp=8
     np.testing.assert_allclose(float(loss), float(want), rtol=1e-4)
     assert np.any(np.asarray(grads["stages"]["router"]) != 0.0)
     assert all(np.all(np.isfinite(np.asarray(g)))
@@ -456,14 +463,7 @@ def test_gpt_moe_interleaved_pipeline_matches_sequential():
     from apex_tpu.transformer.pipeline_parallel.schedules import (
         forward_backward_pipelining_with_interleaving,
     )
-    from apex_tpu.transformer.pipeline_parallel.schedules.common import (
-        replicate_loss,
-    )
-    from apex_tpu.transformer.testing import (
-        GPTConfig,
-        gpt_loss,
-        gpt_param_specs,
-    )
+    from apex_tpu.transformer.testing import GPTConfig
     from apex_tpu.transformer.testing.standalone_gpt import (
         gpt_pipeline_params,
         gpt_pipeline_spec,
@@ -485,19 +485,47 @@ def test_gpt_moe_interleaved_pipeline_matches_sequential():
         params_specs=gpt_pipeline_specs_tree(cfg, interleaved=True),
         data_spec=P(None, "dp"), remat=False)
 
-    # depth order is chunk-major (v*pp + s): plain reshape restores it
-    flat_layers = jax.tree.map(
-        lambda x: x.reshape((-1,) + x.shape[3:]), params["stages"])
-    flat = {"embed": params["embed"], "layers": flat_layers,
-            "head": params["head"]}
-    mesh_dp = build_mesh(tp=1, pp=1, sp=1)  # dp=8
-
-    def body(p, t, g):
-        return replicate_loss(gpt_loss(p, t, g, cfg), mesh_dp,
-                              masked_axis=None)
-
-    want = shard_map(body, mesh=mesh_dp,
-                     in_specs=(gpt_param_specs(cfg), P("dp"), P("dp")),
-                     out_specs=P())(flat, tok, tgt)
+    want = _pipeline_sequential_reference(
+        cfg, params, tok, tgt, build_mesh(tp=1, pp=1, sp=1),
+        interleaved=True)  # dp=8
     np.testing.assert_allclose(float(loss), float(want), rtol=1e-4)
     assert np.any(np.asarray(grads["stages"]["router"]) != 0.0)
+
+
+def test_gpt_moe_pipeline_megatron_sp_triple_composition():
+    """Everything at once: pp=2 x tp=2 x megatron_sp x MoE(ep=dp=2) through
+    the 1F1B schedule equals the sequential gpt_loss — the full parallelism
+    stack in one program (stage_aux + seq gather/scatter + tp-split
+    experts + ppermute ring)."""
+    from apex_tpu.transformer.pipeline_parallel.schedules import (
+        forward_backward_pipelining_without_interleaving,
+    )
+    from apex_tpu.transformer.testing import GPTConfig
+    from apex_tpu.transformer.testing.standalone_gpt import (
+        gpt_pipeline_params,
+        gpt_pipeline_spec,
+        gpt_pipeline_specs_tree,
+    )
+
+    cfg = GPTConfig(vocab_size=96, max_seq=16, hidden=32, num_layers=2,
+                    num_heads=4, dtype=jnp.float32, tie_embeddings=False,
+                    num_experts=2, moe_capacity_factor=8.0,
+                    megatron_sp=True)
+    pp, tp = 2, 2
+    mesh = build_mesh(tp=tp, pp=pp, sp=1)  # dp=2 = ep
+    params = gpt_pipeline_params(jax.random.PRNGKey(0), cfg, pp=pp)
+    tok = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, 96)
+    tgt = jnp.roll(tok, -1, 1)
+
+    loss, grads = forward_backward_pipelining_without_interleaving(
+        gpt_pipeline_spec(cfg), params, (tok, tgt), num_microbatches=2,
+        mesh=mesh, params_specs=gpt_pipeline_specs_tree(cfg),
+        data_spec=P(None, "dp"), remat=False)
+
+    want = _pipeline_sequential_reference(
+        cfg, params, tok, tgt,
+        build_mesh(tp=2, pp=1, sp=1, devices=jax.devices()[:4]))  # dp=2
+    np.testing.assert_allclose(float(loss), float(want), rtol=1e-4)
+    assert np.any(np.asarray(grads["stages"]["router"]) != 0.0)
+    assert all(np.all(np.isfinite(np.asarray(g)))
+               for g in jax.tree.leaves(grads))
