@@ -40,6 +40,7 @@ from apex_tpu.parallel.mesh import (
 _MESH: Optional[Mesh] = None
 _VIRTUAL_PP_SIZE: Optional[int] = None
 _VIRTUAL_PP_RANK: Optional[int] = None
+_PP_SPLIT_RANK: Optional[int] = None
 
 
 def initialize_model_parallel(
@@ -47,11 +48,29 @@ def initialize_model_parallel(
     pipeline_model_parallel_size_: int = 1,
     virtual_pipeline_model_parallel_size_: Optional[int] = None,
     sequence_parallel_size_: int = 1,
+    pipeline_model_parallel_split_rank_: Optional[int] = None,
     *,
     devices=None,
 ) -> Mesh:
-    """Build and install the global mesh (ref parallel_state.py:57-185)."""
-    global _MESH, _VIRTUAL_PP_SIZE, _VIRTUAL_PP_RANK
+    """Build and install the global mesh (ref parallel_state.py:57-185).
+
+    ``pipeline_model_parallel_split_rank_`` (ref parallel_state.py:61,113)
+    records the encoder/decoder boundary for ``ModelType.encoder_and_decoder``
+    models. Here it is bookkeeping for API parity only: the TPU enc-dec
+    schedule runs encoder and decoder as two full-ring phases, so every stage
+    holds one chunk of each and no device partition exists to balance (see
+    ``schedules/fwd_bwd_enc_dec.py``).
+    """
+    global _MESH, _VIRTUAL_PP_SIZE, _VIRTUAL_PP_RANK, _PP_SPLIT_RANK
+    if pipeline_model_parallel_split_rank_ is not None and not (
+        0 < pipeline_model_parallel_split_rank_ < pipeline_model_parallel_size_
+    ):
+        # upper bound strict: split == pp would leave zero decoder stages
+        raise ValueError(
+            f"pipeline_model_parallel_split_rank_="
+            f"{pipeline_model_parallel_split_rank_} outside "
+            f"(0, pp={pipeline_model_parallel_size_})"
+        )
     _MESH = build_mesh(
         tp=tensor_model_parallel_size_,
         pp=pipeline_model_parallel_size_,
@@ -60,6 +79,7 @@ def initialize_model_parallel(
     )
     _VIRTUAL_PP_SIZE = virtual_pipeline_model_parallel_size_
     _VIRTUAL_PP_RANK = 0 if virtual_pipeline_model_parallel_size_ else None
+    _PP_SPLIT_RANK = pipeline_model_parallel_split_rank_
     return _MESH
 
 
@@ -78,10 +98,11 @@ def get_mesh() -> Mesh:
 
 def destroy_model_parallel() -> None:
     """Ref parallel_state.py:440-465."""
-    global _MESH, _VIRTUAL_PP_SIZE, _VIRTUAL_PP_RANK
+    global _MESH, _VIRTUAL_PP_SIZE, _VIRTUAL_PP_RANK, _PP_SPLIT_RANK
     _MESH = None
     _VIRTUAL_PP_SIZE = None
     _VIRTUAL_PP_RANK = None
+    _PP_SPLIT_RANK = None
 
 
 def get_mesh_axes_str() -> str:
@@ -161,6 +182,56 @@ def is_pipeline_last_stage(ignore_virtual: bool = False):
         if _VIRTUAL_PP_RANK != _VIRTUAL_PP_SIZE - 1:
             return last & False
     return last
+
+
+# ---------------------------------------------------------------------------
+# Encoder/decoder split bookkeeping (ref parallel_state.py:251-286,345-354).
+# The split rank is a host-level int; the before/after predicates are traced
+# booleans like is_pipeline_first_stage, valid inside mesh programs only.
+
+
+def get_pipeline_model_parallel_split_rank() -> Optional[int]:
+    return _PP_SPLIT_RANK
+
+
+def set_pipeline_model_parallel_split_rank(rank: int) -> None:
+    global _PP_SPLIT_RANK
+    _PP_SPLIT_RANK = rank
+
+
+def is_pipeline_stage_before_split(rank=None):
+    """True if this stage executes encoder blocks for an enc-dec model
+    (ref parallel_state.py:251-263). Always True when pp == 1 or no split
+    rank is set, as in the reference."""
+    if get_pipeline_model_parallel_world_size() == 1 or _PP_SPLIT_RANK is None:
+        return True
+    if rank is None:
+        rank = get_pipeline_model_parallel_rank()
+    return rank < _PP_SPLIT_RANK
+
+
+def is_pipeline_stage_after_split(rank=None):
+    """True if this stage executes decoder blocks for an enc-dec model
+    (ref parallel_state.py:266-278)."""
+    if get_pipeline_model_parallel_world_size() == 1 or _PP_SPLIT_RANK is None:
+        return True
+    if rank is None:
+        rank = get_pipeline_model_parallel_rank()
+    return rank >= _PP_SPLIT_RANK
+
+
+def is_pipeline_stage_at_split():
+    """True on the last encoder stage (the next stage runs decoder blocks;
+    ref parallel_state.py:281-286). Host-level ``False`` when pp == 1 or no
+    split rank is set — those cases have no enc/dec boundary, and reading a
+    traced rank for them would make the predicate unusable outside mesh
+    programs where its siblings still work."""
+    if get_pipeline_model_parallel_world_size() == 1 or _PP_SPLIT_RANK is None:
+        return False
+    rank = get_pipeline_model_parallel_rank()
+    return is_pipeline_stage_before_split(rank) & is_pipeline_stage_after_split(
+        rank + 1
+    )
 
 
 # ---------------------------------------------------------------------------

@@ -8,6 +8,12 @@ from apex_tpu.transformer.pipeline_parallel.schedules.common import (  # noqa: F
     split_microbatches,
     stage_params_spec,
 )
+from apex_tpu.transformer.pipeline_parallel.schedules.fwd_bwd_enc_dec import (  # noqa: F401
+    EncDecPipelineSpec,
+    broadcast_from_last_stage,
+    decoder_ring,
+    forward_backward_pipelining_enc_dec,
+)
 from apex_tpu.transformer.pipeline_parallel.schedules.fwd_bwd_no_pipelining import (  # noqa: F401
     forward_backward_no_pipelining,
 )

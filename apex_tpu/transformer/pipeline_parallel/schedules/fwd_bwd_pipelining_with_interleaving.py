@@ -180,6 +180,18 @@ def forward_backward_pipelining_with_interleaving(
     """Driver (ref :25). Same contract as the non-interleaved driver except
     ``params["stages"]`` carries leading ``[vp, pp]`` axes (see
     ``common.build_model``)."""
+    from apex_tpu.transformer.pipeline_parallel.schedules.fwd_bwd_enc_dec import (
+        EncDecPipelineSpec,
+    )
+
+    if isinstance(spec, EncDecPipelineSpec):
+        # Matches the reference: the interleaved schedule rejects
+        # ModelType.encoder_and_decoder (ref schedules/__init__.py guard).
+        raise ValueError(
+            "the interleaved schedule supports encoder-or-decoder models "
+            "only; use forward_backward_pipelining_without_interleaving for "
+            "encoder-decoder specs"
+        )
     if mesh is None:
         from apex_tpu.transformer import parallel_state
 

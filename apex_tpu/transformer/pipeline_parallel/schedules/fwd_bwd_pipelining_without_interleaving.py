@@ -86,7 +86,7 @@ def _mesh_axis_names():
 
 
 def pipeline_ring(
-    stage_fn: Callable[[Pytree, Pytree], Pytree],
+    stage_fn: Callable[..., Pytree],
     stage_params: Pytree,
     h_mb: Pytree,
     *,
@@ -94,6 +94,7 @@ def pipeline_ring(
     axis_name: str = PP_AXIS,
     remat: bool = True,
     returns_aux: bool = False,
+    extra_mb: Optional[Pytree] = None,
 ) -> Pytree:
     """Run ``num_microbatches`` activations through the pp-stage ring.
 
@@ -105,6 +106,13 @@ def pipeline_ring(
     ``(h, aux_scalar)`` and the result is ``(outputs, aux_mean)`` where
     ``aux_mean`` averages the stage's aux over its real microbatch ticks
     (fill/drain garbage is masked out).
+
+    ``extra_mb`` is an optional ``[M, ...]`` per-microbatch side operand
+    valid on EVERY device (e.g. encoder memory for a decoder ring); when
+    given, the stage function is called ``stage_fn(params, h, extra_t)``
+    with ``extra_t`` the entry for the microbatch this stage processes at
+    this tick (``t - rank``, clipped on fill/drain ticks whose outputs are
+    masked downstream).
     """
     pp = lax.axis_size(axis_name)
     rank = lax.axis_index(axis_name)
@@ -117,13 +125,16 @@ def pipeline_ring(
         h, aux_sum = carry
         x0 = _tree_index(h_mb, jnp.clip(t, 0, M - 1))
         inp = _tree_where(rank == 0, x0, h)
-        if returns_aux:
-            out, aux = fn(stage_params, inp)
+        args = (stage_params, inp)
+        if extra_mb is not None:
             # stage `rank` holds microbatch t-rank at tick t
+            args += (_tree_index(extra_mb, jnp.clip(t - rank, 0, M - 1)),)
+        if returns_aux:
+            out, aux = fn(*args)
             valid = (t >= rank) & (t - rank <= M - 1)
             aux_sum = aux_sum + jnp.where(valid, aux, 0.0)
         else:
-            out = fn(stage_params, inp)
+            out = fn(*args)
         return (_pvary_all(_ring_shift(out, axis_name), axes),
                 _pvary_all(aux_sum, axes)), out
 
@@ -198,6 +209,25 @@ def forward_backward_pipelining_without_interleaving(
     shards onto the mesh). ``data_spec`` shards the microbatched data
     ``[M, B, ...]``; the default splits the per-microbatch batch dim over dp.
     """
+    from apex_tpu.transformer.pipeline_parallel.schedules.fwd_bwd_enc_dec import (
+        EncDecPipelineSpec,
+        forward_backward_pipelining_enc_dec,
+    )
+
+    if isinstance(spec, EncDecPipelineSpec):
+        # ModelType.encoder_and_decoder routing (ref common.py:80-103): the
+        # same driver name serves both model types, as in the reference.
+        return forward_backward_pipelining_enc_dec(
+            spec,
+            params,
+            batch,
+            num_microbatches=num_microbatches,
+            mesh=mesh,
+            params_specs=params_specs,
+            data_spec=data_spec,
+            loss_scale=loss_scale,
+            remat=remat,
+        )
     if mesh is None:
         from apex_tpu.transformer import parallel_state
 
