@@ -210,6 +210,13 @@ def _iters_from_samples(args: argparse.Namespace) -> Optional[int]:
         raise ValueError(
             f"--rampup-batch-size needs positive start and increment, got "
             f"{args.rampup_batch_size}")
+    if (args.global_batch_size - start) % inc != 0:
+        # mirror RampupBatchsizeNumMicroBatches' consistency check: a
+        # non-dividing increment would silently floor num_increments here
+        # while the microbatch calculator rejects the same config
+        raise ValueError(
+            f"--rampup-batch-size: global batch {args.global_batch_size} "
+            f"minus start {start} must be a multiple of increment {inc}")
     num_inc = max((args.global_batch_size - start) // inc, 1)
     per_level = ramp_samples / num_inc
     iters, consumed, batch = 0, 0, start
@@ -326,7 +333,11 @@ class Checkpointer:
             # (step_N.orbax-checkpoint-tmp-*) must not shadow step_N
             m = re.fullmatch(r"step_(\d+)(\.npz\.pkl)?", d)
             if m:
-                found[int(m.group(1))] = d
+                n = int(m.group(1))
+                # when both an orbax dir and a pickle exist for one step,
+                # prefer the orbax dir regardless of listdir order
+                if n not in found or m.group(2) is None:
+                    found[n] = d
         if not found:
             return None
         return load_checkpoint(

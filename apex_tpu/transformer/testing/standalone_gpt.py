@@ -108,6 +108,13 @@ class GPTConfig:
     # gpt_loss. Composes with megatron_sp (the MoE region gathers the
     # sequence and slices the shard back out) and with the pipeline
     # schedules (PipelineSpec.stage_aux carries the router aux per stage).
+    # COST of the megatron_sp composition: every TP rank gathers the full
+    # sequence and runs the whole router+dispatch+expert block redundantly
+    # (tp-fold duplicate MoE compute and all_to_all traffic), and the SP
+    # activation saving is forfeited inside the MoE region. A
+    # sequence-sharded dispatch (route only the local s/tp tokens with
+    # capacity scaled to the shard) would remove the duplication; see
+    # PERF.md "MoE under Megatron-SP".
     num_experts: int = 0
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
