@@ -19,6 +19,11 @@ parts collapse to thin, robust wrappers:
 * :func:`report` / :func:`op_table` — per-op/per-layer attribution from the
   compiled HLO: every fused instruction with its ``named_scope`` layer path,
   FLOPs, bytes, and roofline time estimate (the ``parse``+``prof`` report).
+* :func:`measured_report` / :func:`measured_op_table` — the MEASURED
+  analogue: runs the step under ``jax.profiler``, parses the trace, and
+  joins per-instruction measured time with the HLO flops/bytes (the
+  reference's parse→prof kernel-time join, ``parse/kernel.py`` +
+  ``prof/output.py``).
 """
 
 from apex_tpu.pyprof.profiler import (  # noqa: F401
@@ -33,6 +38,14 @@ from apex_tpu.pyprof.prof import (  # noqa: F401
     op_table,
     report,
 )
+from apex_tpu.pyprof.parse import (  # noqa: F401
+    format_measured_table,
+    load_trace_events,
+    measured_op_table,
+    measured_report,
+)
 
 __all__ = ["annotate", "annotate_function", "trace", "cost_analysis",
-           "summary", "op_table", "format_table", "report"]
+           "summary", "op_table", "format_table", "report",
+           "measured_op_table", "format_measured_table", "measured_report",
+           "load_trace_events"]

@@ -1,0 +1,70 @@
+"""Measured per-op profile of the flagship GPT train step.
+
+The round's MFU question — *which op eats the step time?* — answered by
+the measured-time join (``apex_tpu.pyprof.measured_op_table``): run the
+bench.py train step under ``jax.profiler``, join per-instruction measured
+time with HLO flops/bytes, print the table PERF.md quotes.
+
+Run: ``python benchmarks/profile_step.py [--steps N] [--top N]``.
+Uses the real TPU when the tunnel answers (full bench shape); otherwise
+falls back to the CPU protocol at a small shape, flagged in the header.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=5)
+    ap.add_argument("--top", type=int, default=30)
+    ap.add_argument("--depth", type=int, default=3)
+    ap.add_argument("--remat", action="store_true",
+                    help="profile the remat=dots config instead of no-remat")
+    args = ap.parse_args()
+
+    from apex_tpu.utils.platform import pin_cpu_platform, probe_backend
+
+    if os.environ.get("JAX_PLATFORMS") != "cpu" and probe_backend() == 0:
+        pin_cpu_platform()
+    backend = jax.default_backend()
+    on_tpu = backend == "tpu"
+
+    import bench
+    from apex_tpu.pyprof import format_measured_table, measured_op_table
+
+    batch, seq = (bench.BATCH, bench.SEQ) if on_tpu else (2, 128)
+    cfg = bench.flagship_config(
+        seq, remat=args.remat, remat_policy="dots" if args.remat else "full")
+    train_step, params, opt_state, tok, tgt = bench.build_train_step(
+        cfg, batch, seq)
+
+    # measured_op_table re-jits its fn argument; wrapping the jitted step
+    # inlines it WITHOUT the donate_argnums annotation, so the repeated
+    # profiled calls reuse the same param buffers instead of consuming
+    # them. Everything the step produces is returned — returning only the
+    # loss would let XLA dead-code-eliminate the optimizer update.
+    def step(params, opt_state, tok, tgt):
+        return train_step(params, opt_state, tok, tgt)
+
+    peak = bench.PEAK_FLOPS.get(backend, 1e12)
+    header = (f"flagship GPT step profile | backend={backend}"
+              f"{'' if on_tpu else ' (CPU_FALLBACK)'} | batch={batch} "
+              f"seq={seq} remat={args.remat}")
+    print(header)
+    res = measured_op_table(step, params, opt_state, tok, tgt,
+                            steps=args.steps, depth=args.depth,
+                            peak_flops=peak)
+    print(format_measured_table(res, top=args.top))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
