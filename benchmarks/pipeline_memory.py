@@ -1,0 +1,153 @@
+"""Quantify the pipeline schedules' memory/recompute cost vs (M, pp).
+
+The 1F1B ring-scan design (``fwd_bwd_pipelining_without_interleaving``)
+deliberately trades the Megatron 1F1B memory property (≤ pp in-flight
+microbatches, no interior recompute) for one-``lax.scan`` uniformity: it
+saves ONE stage-boundary tensor per tick over ``M + pp - 1`` ticks and
+remats stage interiors in the backward sweep. This script measures that
+trade with XLA's own buffer assignment (``compiled.memory_analysis()``)
+and cost model (``cost_analysis()``) instead of asserting it:
+
+* temp bytes vs M at fixed pp → the O(M) boundary-save slope;
+* temp bytes for interleaved (vp=2) vs 1F1B at the same (M, pp);
+* flops(remat) / flops(no-remat) → the recompute factor (≤ one extra
+  forward ≈ 4/3 of fwd+bwd);
+* the pp=1, remat-off ring (≡ plain grad accumulation) as the ideal
+  baseline.
+
+Numbers are WHOLE-MESH totals over the 8 virtual CPU devices (virtual
+devices share one buffer assignment); per-device HBM is total/8 for
+evenly-sharded programs. Run: ``python benchmarks/pipeline_memory.py``.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from apex_tpu.utils.platform import pin_cpu_platform
+
+pin_cpu_platform(virtual_devices=8)
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.parallel.mesh import build_mesh
+from apex_tpu.transformer.pipeline_parallel.schedules import (
+    forward_backward_pipelining_with_interleaving,
+    forward_backward_pipelining_without_interleaving,
+)
+from apex_tpu.transformer.testing import (
+    GPTConfig,
+    gpt_pipeline_params,
+    gpt_pipeline_spec,
+    gpt_pipeline_specs_tree,
+)
+
+HID, SEQ, HEADS, LAYERS = 64, 64, 4, 4
+B_PER_MB = 2  # per-dp-shard microbatch rows: fixed as M varies
+
+
+def build_case(pp: int, M: int, *, remat: bool, vp=None):
+    """-> (compiled, meta) for one schedule config on the 8-device mesh."""
+    dp = 8 // pp
+    mesh = build_mesh(tp=1, pp=pp, sp=1, dp=dp)
+    cfg = GPTConfig(vocab_size=64, max_seq=SEQ, hidden=HID,
+                    num_layers=LAYERS, num_heads=HEADS, dtype=jnp.float32,
+                    tie_embeddings=False, remat=False)  # remat at ring level
+    params = gpt_pipeline_params(jax.random.PRNGKey(0), cfg, pp=pp, vp=vp)
+    spec = gpt_pipeline_spec(cfg)
+    specs_tree = gpt_pipeline_specs_tree(cfg, interleaved=vp is not None)
+
+    b_global = B_PER_MB * dp * M
+    tokens = jnp.zeros((b_global, SEQ), jnp.int32)
+    targets = jnp.zeros((b_global, SEQ), jnp.int32)
+
+    if vp is None:
+        def step(params, tokens, targets):
+            return forward_backward_pipelining_without_interleaving(
+                spec, params, (tokens, targets), num_microbatches=M,
+                mesh=mesh, params_specs=specs_tree, remat=remat)
+    else:
+        def step(params, tokens, targets):
+            return forward_backward_pipelining_with_interleaving(
+                spec, params, (tokens, targets), num_microbatches=M,
+                virtual_pipeline_size=vp, mesh=mesh,
+                params_specs=specs_tree, remat=remat)
+
+    compiled = jax.jit(step).lower(params, tokens, targets).compile()
+    return compiled
+
+
+def measure(pp, M, *, remat=True, vp=None):
+    c = build_case(pp, M, remat=remat, vp=vp)
+    ma = c.memory_analysis()
+    ca = c.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    return {
+        "schedule": ("interleaved" if vp else
+                     ("1F1B" if pp > 1 else "grad-accum")),
+        "pp": pp, "vp": vp or 1, "M": M, "remat": remat,
+        "temp_mb": ma.temp_size_in_bytes / 1e6,
+        "peak_mb": ma.peak_memory_in_bytes / 1e6,
+        "arg_mb": ma.argument_size_in_bytes / 1e6,
+        "gflops": float(ca.get("flops", 0.0)) / 1e9,
+    }
+
+
+GRID = [
+    dict(pp=1, M=4, remat=False),            # ideal: grad accum, no remat
+    dict(pp=1, M=4, remat=True),
+    dict(pp=2, M=4, remat=False),
+    dict(pp=2, M=4, remat=True),
+    dict(pp=2, M=8, remat=True),
+    dict(pp=2, M=16, remat=True),
+    dict(pp=4, M=4, remat=True),
+    dict(pp=4, M=8, remat=True),
+    dict(pp=2, M=4, remat=True, vp=2),
+    dict(pp=2, M=8, remat=True, vp=2),
+]
+
+
+def main() -> int:
+    rows = []
+    for kw in GRID:
+        r = measure(**kw)
+        rows.append(r)
+        print(f"{r['schedule']:>11s} pp={r['pp']} vp={r['vp']} M={r['M']:>2d} "
+              f"remat={int(r['remat'])} | temp {r['temp_mb']:8.1f} MB | "
+              f"peak {r['peak_mb']:8.1f} MB | args {r['arg_mb']:6.1f} MB | "
+              f"{r['gflops']:8.2f} GFLOP", flush=True)
+
+    by = {(r["schedule"], r["pp"], r["M"], r["remat"], r["vp"]): r
+          for r in rows}
+    f11b_4 = by[("1F1B", 2, 4, True, 1)]
+    f11b_8 = by[("1F1B", 2, 8, True, 1)]
+    f11b_16 = by[("1F1B", 2, 16, True, 1)]
+    slope_lo = (f11b_8["temp_mb"] - f11b_4["temp_mb"]) / 4
+    slope_hi = (f11b_16["temp_mb"] - f11b_8["temp_mb"]) / 8
+    # boundary tensor per tick per device: [B_PER_MB, SEQ, HID] f32; the
+    # scan stacks M+pp-1 of them per device for the backward sweep, summed
+    # over the 8 virtual devices in these whole-mesh numbers
+    boundary_mb = B_PER_MB * SEQ * HID * 4 * 8 / 1e6
+    ideal = by[("grad-accum", 1, 4, False, 1)]
+    print()
+    print(f"1F1B temp slope: {slope_lo:.2f} (M 4→8) / {slope_hi:.2f} "
+          f"(M 8→16) MB per microbatch; boundary-save prediction "
+          f"~{boundary_mb:.2f} MB/mb (whole mesh)")
+    print(f"recompute factor pp=2 M=4: "
+          f"{by[('1F1B', 2, 4, True, 1)]['gflops'] / by[('1F1B', 2, 4, False, 1)]['gflops']:.3f} "
+          f"(remat on/off); ideal-vs-1F1B flops overhead: "
+          f"{by[('1F1B', 2, 4, False, 1)]['gflops'] / ideal['gflops']:.3f} "
+          f"(fill/drain ticks)")
+    print(f"interleaved vp=2 vs 1F1B temp at pp=2 M=8: "
+          f"{by[('interleaved', 2, 8, True, 2)]['temp_mb']:.1f} vs "
+          f"{f11b_8['temp_mb']:.1f} MB")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
