@@ -174,13 +174,27 @@ def _expert_ffn(p, x):
 # the layer
 
 
-def moe_mlp(params, x, cfg: MoEConfig, ep_axis: Optional[str] = DP_AXIS
-            ) -> Tuple[jax.Array, dict]:
+def moe_mlp(params, x, cfg: MoEConfig, ep_axis: Optional[str] = DP_AXIS,
+            seq_shard_axis: Optional[str] = None) -> Tuple[jax.Array, dict]:
     """MoE FFN over ``x`` (..., h). Call inside a mesh program; tokens are
     this rank's local shard, experts are sharded over ``ep_axis`` (pass
     ``None`` for a single-rank/no-EP layer). Returns ``(out, aux)``;
     ``aux['loss']`` is the weighted router auxiliary loss (psum-mean it over
     the data axis alongside the main loss).
+
+    ``seq_shard_axis`` enables the sequence-sharded dispatch for callers
+    whose tokens are sharded over that axis (Megatron-SP regions, sharded
+    over tp): each rank routes only its LOCAL tokens with a per-shard
+    capacity ``C/axis_size``, the kept expert slots — not the raw sequence
+    — are all-gathered along the capacity dim (the expert FFN's TP split
+    needs replicated inputs for its row-parallel psum), and each rank
+    combines only its own slot block back out. Versus gathering the full
+    sequence first, router/dispatch/combine einsum FLOPs drop by the axis
+    size, the all_to_all bytes are unchanged, and the output STAYS
+    sequence-sharded (the SP activation saving is kept). Semantics note:
+    capacity is enforced per sequence shard, so under skewed load the drop
+    pattern differs from the full-sequence path; with ample capacity the
+    outputs are bitwise the gathered path's (tested).
     """
     lead = x.shape[:-1]
     h = x.shape[-1]
@@ -194,6 +208,10 @@ def moe_mlp(params, x, cfg: MoEConfig, ep_axis: Optional[str] = DP_AXIS
 
     # (T, h) -> (E, C, h): zero rows where a slot is unfilled
     exp_in = jnp.einsum("tec,th->ech", dispatch.astype(x.dtype), xf)
+
+    if seq_shard_axis is not None:
+        # kept slots from every sequence shard, stacked on the capacity dim
+        exp_in = lax.all_gather(exp_in, seq_shard_axis, axis=1, tiled=True)
 
     if ep_axis is not None:
         ep = lax.axis_size(ep_axis)
@@ -211,6 +229,11 @@ def moe_mlp(params, x, cfg: MoEConfig, ep_axis: Optional[str] = DP_AXIS
                                  concat_axis=0, tiled=True)  # (E, C, h)
     else:
         exp_out = _expert_ffn(params, exp_in)
+
+    if seq_shard_axis is not None:
+        # this rank's slot block back out of the gathered capacity dim
+        exp_out = lax.dynamic_slice_in_dim(
+            exp_out, lax.axis_index(seq_shard_axis) * cap, cap, axis=1)
 
     out = jnp.einsum("tec,ech->th", combine.astype(x.dtype), exp_out)
     aux = dict(aux)

@@ -108,16 +108,21 @@ class GPTConfig:
     # gpt_loss. Composes with megatron_sp (the MoE region gathers the
     # sequence and slices the shard back out) and with the pipeline
     # schedules (PipelineSpec.stage_aux carries the router aux per stage).
-    # COST of the megatron_sp composition: every TP rank gathers the full
-    # sequence and runs the whole router+dispatch+expert block redundantly
-    # (tp-fold duplicate MoE compute and all_to_all traffic), and the SP
-    # activation saving is forfeited inside the MoE region. A
-    # sequence-sharded dispatch (route only the local s/tp tokens with
-    # capacity scaled to the shard) would remove the duplication; see
-    # PERF.md "MoE under Megatron-SP".
+    # COST of the default megatron_sp composition: every TP rank gathers
+    # the full sequence and runs the whole router+dispatch block
+    # redundantly (tp-fold duplicate compute), forfeiting the SP
+    # activation saving inside the MoE region. Set ``moe_seq_dispatch``
+    # to use the sequence-sharded dispatch instead; see PERF.md
+    # "MoE under Megatron-SP".
     num_experts: int = 0
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
+    # Under megatron_sp, dispatch from the LOCAL sequence shard instead of
+    # gathering the full sequence per TP rank: tp-fold less router/dispatch
+    # compute, SP activation saving kept. Capacity becomes per-shard, so
+    # tight-capacity drop patterns differ from the gathered path (exact
+    # match when capacity is ample — see moe_mlp docstring).
+    moe_seq_dispatch: bool = False
 
     @property
     def ffn_hidden(self) -> int:
@@ -340,7 +345,17 @@ def _mlp(p, x, cfg):
         from apex_tpu.parallel.mesh import DP_AXIS
         from apex_tpu.transformer.moe import moe_mlp
 
-        if cfg.megatron_sp:
+        if cfg.megatron_sp and cfg.moe_seq_dispatch:
+            # sequence-sharded dispatch: route only the local s/tp tokens,
+            # all-gather the kept expert SLOTS (the TP-split expert FFN
+            # still needs replicated inputs for its psum), combine locally.
+            # Removes the tp-fold router/dispatch duplication and keeps the
+            # SP activation saving; capacity is per shard (see moe_mlp).
+            from apex_tpu.parallel.mesh import TP_AXIS
+
+            out, aux = moe_mlp(p, x, cfg.moe_config, ep_axis=DP_AXIS,
+                               seq_shard_axis=TP_AXIS)
+        elif cfg.megatron_sp:
             # the TP-split expert FFN psums partial outputs over tp, which
             # requires every tp rank to hold the SAME tokens: gather the
             # sequence for the MoE region, then take the own shard back out

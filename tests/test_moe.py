@@ -420,6 +420,7 @@ def test_bert_moe_trains(mesh_dp8):
                   out_specs=P())(params, tok, tgt, lm)
 
 
+@pytest.mark.slow
 def test_gpt_moe_pipeline_matches_sequential():
     """MoE through the 1F1B pipeline: the schedules accumulate the router
     aux loss per stage (stage_aux) and the total equals the non-pipeline
@@ -457,6 +458,7 @@ def test_gpt_moe_pipeline_matches_sequential():
                for g in jax.tree.leaves(grads))
 
 
+@pytest.mark.slow
 def test_gpt_moe_interleaved_pipeline_matches_sequential():
     """MoE aux through the interleaved schedule (vp=2): equals the
     sequential loss on the chunk-major-flattened params."""
@@ -529,3 +531,83 @@ def test_gpt_moe_pipeline_megatron_sp_triple_composition():
     assert np.any(np.asarray(grads["stages"]["router"]) != 0.0)
     assert all(np.all(np.isfinite(np.asarray(g)))
                for g in jax.tree.leaves(grads))
+
+
+def test_gpt_moe_seq_dispatch_matches_plain(mesh_dp4_tp2):
+    """Sequence-sharded MoE dispatch (route local s/tp tokens, all-gather
+    kept SLOTS, combine locally) == the plain path, loss AND grads, at
+    ample capacity where the per-shard capacity semantics cannot drop
+    differently. Removes the tp-fold router/dispatch duplication the
+    gathered path pays (PERF.md "MoE under Megatron-SP")."""
+    import dataclasses
+
+    from apex_tpu.transformer.pipeline_parallel.schedules.common import (
+        replicate_loss,
+    )
+    from apex_tpu.transformer.testing import (
+        GPTConfig,
+        gpt_loss,
+        gpt_param_specs,
+        init_gpt_params,
+    )
+
+    base = GPTConfig(vocab_size=96, max_seq=16, hidden=32, num_layers=2,
+                     num_heads=4, dtype=jnp.float32, num_experts=4,
+                     moe_capacity_factor=4.0)
+    tok = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0, 96)
+    tgt = jnp.roll(tok, -1, 1)
+
+    def run(cfg):
+        params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+        specs = gpt_param_specs(cfg)
+
+        def loss_fn(p):
+            def body(p, t, g):
+                return replicate_loss(gpt_loss(p, t, g, cfg), mesh_dp4_tp2,
+                                      masked_axis=None)
+
+            return shard_map(body, mesh=mesh_dp4_tp2,
+                             in_specs=(specs, P("dp"), P("dp")),
+                             out_specs=P())(p, tok, tgt)
+
+        return jax.jit(jax.value_and_grad(loss_fn))(params)
+
+    l0, g0 = run(base)
+    l1, g1 = run(dataclasses.replace(base, megatron_sp=True,
+                                     moe_seq_dispatch=True))
+    # the aux (load-balance) loss becomes a per-sequence-shard estimate
+    # under the sharded dispatch — the same approximation class dp-local
+    # aux already makes — so loss/grads agree to aux-sized tolerance, not
+    # bitwise; the dispatch/combine math itself is exact
+    # (test_moe_seq_dispatch_exact_vs_gathered).
+    np.testing.assert_allclose(float(l1), float(l0), rtol=5e-4)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=2e-2, atol=5e-4), g1, g0)
+
+
+def test_moe_seq_dispatch_exact_vs_gathered(mesh_dp4_tp2):
+    """The sequence-sharded dispatch/combine math is EXACT vs the
+    replicated-token path at ample capacity (aux weights zeroed: the aux
+    loss legitimately becomes a per-shard estimate — same approximation
+    class as dp-local aux — and is covered by the GPT-level test)."""
+    cfg = _cfg(num_experts=4, lb_loss_weight=0.0, z_loss_weight=0.0)
+    params = init_moe_params(jax.random.PRNGKey(0), cfg, ep=4, tp=2)
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 6, HID), jnp.float32)
+
+    def plain(p, xb):
+        out, _ = moe_mlp(p, xb, cfg, ep_axis="dp")
+        return out
+
+    def seq_sharded(p, xb):
+        out, _ = moe_mlp(p, xb, cfg, ep_axis="dp", seq_shard_axis="tp")
+        return out
+
+    specs = moe_param_specs("dp")
+    out_plain = shard_map(
+        plain, mesh=mesh_dp4_tp2, in_specs=(specs, P("dp", None, None)),
+        out_specs=P("dp", None, None))(params, x)
+    out_seq = shard_map(
+        seq_sharded, mesh=mesh_dp4_tp2, in_specs=(specs, P("dp", "tp", None)),
+        out_specs=P("dp", "tp", None))(params, x)
+    np.testing.assert_allclose(np.asarray(out_seq), np.asarray(out_plain),
+                               rtol=1e-6, atol=1e-6)
