@@ -76,18 +76,37 @@ def _imagenet():
 # ---------------------------------------------------------------------------
 # 1. ResNet-50 amp O1 single chip — drives the example trainer itself
 
-def bench_resnet50_o1():
-    m = _imagenet()
-    batch, size, iters = (64, 160, 8) if _on_tpu() else (8, 32, 2)
-    argv = ["--arch", "resnet50", "--opt-level", "O1",
-            "--batch-size", str(batch), "--image-size", str(size),
-            "--iters", str(iters), "--print-freq", "1000"]
+def _timed_train(m, argv, iters):
+    """(img_or_tok per sec denominator dt). First train() compiles (the
+    example trainer caches its jitted step per config), second is pure
+    steady state."""
     m.train(m.parse_args(argv))  # compile
     t0 = time.perf_counter()
-    m.train(m.parse_args(argv))  # steady state (jit cache)
-    dt = (time.perf_counter() - t0) / iters
-    _emit(_suffix("resnet50_imagenet_ampO1_img_per_sec_chip"),
-          batch / dt, "img/s", batch=batch, image_size=size)
+    m.train(m.parse_args(argv))  # steady state (jit cache hit)
+    return (time.perf_counter() - t0) / iters
+
+
+def bench_resnet50_o1():
+    m = _imagenet()
+    # reference operating point: image 224, per-device batch 224 at O1
+    # (examples/imagenet/README.md:30-60); walk the batch down on OOM
+    batches, size, iters = ([224, 128, 64], 224, 8) if _on_tpu() \
+        else ([8], 32, 2)
+    for batch in batches:
+        argv = ["--arch", "resnet50", "--opt-level", "O1",
+                "--batch-size", str(batch), "--image-size", str(size),
+                "--iters", str(iters), "--print-freq", "1000"]
+        try:
+            dt = _timed_train(m, argv, iters)
+        except Exception as e:  # OOM at this batch — try the next
+            if batch == batches[-1]:
+                raise
+            print(f"# resnet50_o1 batch {batch} failed "
+                  f"({type(e).__name__}); retrying smaller", flush=True)
+            continue
+        _emit(_suffix("resnet50_imagenet_ampO1_img_per_sec_chip"),
+              batch / dt, "img/s", batch=batch, image_size=size)
+        return
 
 
 # ---------------------------------------------------------------------------
@@ -172,16 +191,23 @@ def bench_ddp_syncbn():
     same platform — the scaling ratio the ICI allreduce must beat)."""
     m = _imagenet()
     n_dev = len(jax.devices())
-    batch, size, iters = (32 * n_dev, 160, 6) if _on_tpu() else (8, 32, 2)
-    argv = ["--arch", "resnet50", "--opt-level", "O2", "--sync_bn",
-            "--batch-size", str(batch), "--image-size", str(size),
-            "--iters", str(iters), "--print-freq", "1000"]
-    m.train(m.parse_args(argv))
-    t0 = time.perf_counter()
-    m.train(m.parse_args(argv))
-    dt = (time.perf_counter() - t0) / iters
-    _emit(_suffix("resnet50_ddp_syncbn_img_per_sec"), batch / dt, "img/s",
-          devices=n_dev, batch=batch)
+    batches, size, iters = ([128 * n_dev, 64 * n_dev], 224, 6) \
+        if _on_tpu() else ([8], 32, 2)
+    for batch in batches:
+        argv = ["--arch", "resnet50", "--opt-level", "O2", "--sync_bn",
+                "--batch-size", str(batch), "--image-size", str(size),
+                "--iters", str(iters), "--print-freq", "1000"]
+        try:
+            dt = _timed_train(m, argv, iters)
+        except Exception as e:
+            if batch == batches[-1]:
+                raise
+            print(f"# ddp_syncbn batch {batch} failed "
+                  f"({type(e).__name__}); retrying smaller", flush=True)
+            continue
+        _emit(_suffix("resnet50_ddp_syncbn_img_per_sec"), batch / dt,
+              "img/s", devices=n_dev, batch=batch)
+        return
 
 
 def bench_ddp_scaling_virtual():
