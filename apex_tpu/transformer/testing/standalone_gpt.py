@@ -117,6 +117,14 @@ class GPTConfig:
     num_experts: int = 0
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
+    # Pallas kernel block sizes (benchmarks/tune_blocks.py sweeps these on
+    # hardware; 0 = the kernel's own default). Attention blocks trade VMEM
+    # residency vs grid parallelism; LM-head blocks trade the vocab-tile
+    # streaming pattern.
+    attn_block_q: int = 512
+    attn_block_k: int = 512
+    lm_block_n: int = 0
+    lm_block_v: int = 0
     # Under megatron_sp, dispatch from the LOCAL sequence shard instead of
     # gathering the full sequence per TP rank: tp-fold less router/dispatch
     # compute, SP activation saving kept. Capacity becomes per-shard, so
@@ -327,9 +335,13 @@ def _attention(p, x, cfg, heads_local: int, causal: bool = True, mask=None,
             model_parallel_key(dropout_key), dtype=jnp.uint32
         ).astype(jnp.int32)
         ctx = flash_attention(q, k, v, causal=causal, mask=mask,
+                              block_q=cfg.attn_block_q,
+                              block_k=cfg.attn_block_k,
                               dropout_rate=rate, dropout_seed=seed)
     else:
-        ctx = flash_attention(q, k, v, causal=causal, mask=mask)
+        ctx = flash_attention(q, k, v, causal=causal, mask=mask,
+                              block_q=cfg.attn_block_q,
+                              block_k=cfg.attn_block_k)
     ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, heads_local * cfg.head_dim)
     return row_parallel_linear(ctx, p["out_kernel"], p["out_bias"],
                                input_is_parallel=True,
@@ -587,7 +599,8 @@ def _use_fused_loss(cfg: GPTConfig, n_rows: int) -> bool:
 
 
 def fused_head_loss(head_rows_w, ln_w, ln_b, x, targets,
-                    gather_sequence: bool = False):
+                    gather_sequence: bool = False,
+                    block_n: int = 0, block_v: int = 0):
     """Shared fused LM-head + CE block: final LN -> copy-to-TP-region ->
     pvary (so dw reduces over the data axes) -> fused loss kernel.
     ``head_rows_w``: (vocab/tp, hidden) projection rows. With
@@ -608,7 +621,12 @@ def fused_head_loss(head_rows_w, ln_w, ln_b, x, targets,
     # invariant-input reduction; vary it explicitly over the activations'
     # axes so dw is psum'd over the data axes at the pvary transpose
     w = pvary_like(head_rows_w, x)
-    return jnp.mean(lm_head_loss(x, w, targets, axis_name=TP_AXIS))
+    kw = {}
+    if block_n:
+        kw["block_n"] = block_n
+    if block_v:
+        kw["block_v"] = block_v
+    return jnp.mean(lm_head_loss(x, w, targets, axis_name=TP_AXIS, **kw))
 
 
 def gpt_loss(params, tokens, targets, cfg: GPTConfig, dropout_key=None):
@@ -630,7 +648,9 @@ def gpt_loss(params, tokens, targets, cfg: GPTConfig, dropout_key=None):
     w = (params["embed"]["tok"] if cfg.tie_embeddings
          else head["lm"].T)  # (vocab/tp, hidden) rows
     return fused_head_loss(w, head["ln_w"], head["ln_b"], x, targets,
-                           gather_sequence=cfg.megatron_sp) + aux
+                           gather_sequence=cfg.megatron_sp,
+                           block_n=cfg.lm_block_n,
+                           block_v=cfg.lm_block_v) + aux
 
 
 # ---------------------------------------------------------------------------
@@ -697,7 +717,9 @@ def gpt_pipeline_spec(cfg: GPTConfig) -> PipelineSpec:
         if _use_fused_loss(cfg, rows):
             return fused_head_loss(head["lm"].T, head["ln_w"], head["ln_b"],
                                    h, targets,
-                                   gather_sequence=cfg.megatron_sp)
+                                   gather_sequence=cfg.megatron_sp,
+                                   block_n=cfg.lm_block_n,
+                                   block_v=cfg.lm_block_v)
         logits = gpt_head({"head": head}, h, cfg=dataclasses.replace(
             cfg, tie_embeddings=False))
         return jnp.mean(vocab_parallel_cross_entropy(logits, targets))

@@ -1,0 +1,141 @@
+"""Sweep Pallas kernel block sizes on hardware at the bench shape.
+
+Round-3 task: close the MFU gap by tuning the knobs the kernels expose —
+flash attention ``block_q``/``block_k`` and fused LM-head
+``block_n``/``block_v`` (plus ``scan_unroll`` at the step level, which
+bench.py's remat auto-tune already covers). This script times each
+candidate on the real chip with the value-transfer fence and prints the
+winner as the GPTConfig overrides to commit.
+
+Run: ``python benchmarks/tune_blocks.py [--steps N]``. Refuses to sweep
+on a non-TPU backend (interpret-mode timings would be meaningless) and
+prints the shapes it would have swept.
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+# flagship bench shape (bench.py): GPT-2 124M, batch 32, seq 1024
+B, S, HEADS, HEAD_DIM, HIDDEN, VOCAB = 32, 1024, 12, 64, 768, 50304
+
+
+def _fence(x):
+    leaves = jax.tree.leaves(x)
+    jax.block_until_ready(leaves)
+    float(jax.numpy.sum(leaves[0].ravel()[:1]))
+
+
+def _time(fn, *args, steps=5):
+    fn(*args)  # compile
+    _fence(fn(*args))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = fn(*args)
+    _fence(out)
+    return (time.perf_counter() - t0) / steps
+
+
+def sweep_attention(steps: int):
+    import jax.numpy as jnp
+
+    from apex_tpu.ops.attention import flash_attention
+
+    k = jax.random.PRNGKey(0)
+    q = jax.random.normal(k, (B, HEADS, S, HEAD_DIM), jnp.bfloat16)
+    kk = jax.random.normal(jax.random.fold_in(k, 1), q.shape, jnp.bfloat16)
+    v = jax.random.normal(jax.random.fold_in(k, 2), q.shape, jnp.bfloat16)
+
+    results = []
+    for bq, bk in itertools.product((128, 256, 512, 1024), repeat=2):
+        def fwd_bwd(q, kk, v, bq=bq, bk=bk):
+            def loss(q, kk, v):
+                return jnp.sum(flash_attention(
+                    q, kk, v, causal=True, use_pallas=True,
+                    block_q=bq, block_k=bk).astype(jnp.float32) ** 2)
+
+            return jax.grad(loss, argnums=(0, 1, 2))(q, kk, v)
+
+        try:
+            dt = _time(jax.jit(fwd_bwd), q, kk, v, steps=steps)
+        except Exception as e:  # block combo invalid/OOM on this chip
+            print(f"attn bq={bq:4d} bk={bk:4d}  FAILED "
+                  f"{type(e).__name__}", flush=True)
+            continue
+        print(f"attn bq={bq:4d} bk={bk:4d}  {dt * 1e3:8.3f} ms", flush=True)
+        results.append((dt, bq, bk))
+    if results:
+        dt, bq, bk = min(results)
+        print(f"BEST attention: attn_block_q={bq}, attn_block_k={bk} "
+              f"({dt * 1e3:.3f} ms fwd+bwd)")
+    return results
+
+
+def sweep_lm_head(steps: int):
+    import jax.numpy as jnp
+
+    from apex_tpu.ops.lm_head_loss import lm_head_loss
+
+    k = jax.random.PRNGKey(0)
+    n = B * S
+    x = jax.random.normal(k, (n, HIDDEN), jnp.bfloat16) * 0.1
+    w = jax.random.normal(jax.random.fold_in(k, 1), (VOCAB, HIDDEN),
+                          jnp.bfloat16) * 0.02
+    t = jax.random.randint(jax.random.fold_in(k, 2), (n,), 0, VOCAB)
+
+    results = []
+    for bn, bv in itertools.product((256, 512, 1024), (1024, 2048, 4096)):
+        def fwd_bwd(x, w, bn=bn, bv=bv):
+            def loss(x, w):
+                return jnp.mean(lm_head_loss(x, w, t, use_pallas=True,
+                                             block_n=bn, block_v=bv))
+
+            return jax.grad(loss, argnums=(0, 1))(x, w)
+
+        try:
+            dt = _time(jax.jit(fwd_bwd), x, w, steps=steps)
+        except Exception as e:
+            print(f"lm_head bn={bn:4d} bv={bv:4d}  FAILED "
+                  f"{type(e).__name__}", flush=True)
+            continue
+        print(f"lm_head bn={bn:4d} bv={bv:4d}  {dt * 1e3:8.3f} ms",
+              flush=True)
+        results.append((dt, bn, bv))
+    if results:
+        dt, bn, bv = min(results)
+        print(f"BEST lm_head: lm_block_n={bn}, lm_block_v={bv} "
+              f"({dt * 1e3:.3f} ms fwd+bwd)")
+    return results
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=5)
+    args = ap.parse_args()
+
+    from apex_tpu.utils.platform import probe_backend
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu" or probe_backend() == 0:
+        print(f"tune_blocks: needs the real TPU (would sweep attention "
+              f"(b={B}, h={HEADS}, s={S}, d={HEAD_DIM}) bf16 and lm_head "
+              f"(n={B * S}, h={HIDDEN}, v={VOCAB}); backend unavailable)")
+        return 0
+    if jax.default_backend() != "tpu":
+        print(f"tune_blocks: backend is {jax.default_backend()}, not tpu; "
+              f"refusing to sweep (interpret timings are meaningless)")
+        return 0
+    sweep_attention(args.steps)
+    sweep_lm_head(args.steps)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
