@@ -58,6 +58,20 @@ def parse_args(argv=None):
     return p.parse_args(argv)
 
 
+# jitted-step cache keyed by every config knob the traced program depends
+# on: repeat runs of one config (the L1 determinism double-run, the
+# O0-vs-O2 comparison, baseline regeneration) reuse the SAME jit object and
+# pay zero recompiles. Initial state is rebuilt per call (deterministic
+# from the seed), so cached-step runs return identical losses.
+_STEP_CACHE = {}
+
+
+def _step_key(args):
+    return (args.arch, args.batch_size, args.image_size, args.num_classes,
+            args.lr, args.momentum, args.weight_decay, args.opt_level,
+            args.loss_scale, args.keep_batchnorm_fp32, args.sync_bn)
+
+
 def train(args) -> List[float]:
     """Run the loop; returns the per-iteration loss list (the L1 contract)."""
     mesh = build_mesh(tp=1, pp=1, sp=1)
@@ -88,6 +102,10 @@ def train(args) -> List[float]:
                   weight_decay=args.weight_decay)
     opt_state = tx.init(amp_state.master_params)
     ddp = DistributedDataParallel()
+
+    cached = _STEP_CACHE.get(_step_key(args))
+    if cached is not None:
+        return _run_loop(args, cached, amp_state, opt_state, batch_stats)
 
     # O1: per-op autocast transform around the model apply — whitelisted ops
     # (convs/matmuls) run in the compute dtype, reductions in fp32 (the ref's
@@ -142,6 +160,11 @@ def train(args) -> List[float]:
                    P()),
     ))
 
+    _STEP_CACHE[_step_key(args)] = step
+    return _run_loop(args, step, amp_state, opt_state, batch_stats)
+
+
+def _run_loop(args, step, amp_state, opt_state, batch_stats) -> List[float]:
     losses = []
     data_rng = jax.random.PRNGKey(args.seed + 1)
     t0 = time.perf_counter()

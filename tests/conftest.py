@@ -24,6 +24,30 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_addoption(parser):
+    parser.addoption(
+        "--runslow", action="store_true", default=False,
+        help="run tests marked slow (the full-coverage suite)")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: heavy compile-bound test excluded from the default fast "
+        "suite; enable with --runslow or RUN_SLOW=1")
+
+
+def pytest_collection_modifyitems(config, items):
+    """Default run = fast subset (the ref's L0 sanity tier); --runslow or
+    RUN_SLOW=1 = full cross-product (the ref's L1 nightly tier)."""
+    if config.getoption("--runslow") or os.environ.get("RUN_SLOW") == "1":
+        return
+    skip = pytest.mark.skip(reason="slow: use --runslow or RUN_SLOW=1")
+    for item in items:
+        if "slow" in item.keywords:
+            item.add_marker(skip)
+
+
 @pytest.fixture
 def mesh8():
     """A dp=8 mesh over the 8 virtual devices."""

@@ -61,12 +61,23 @@ def config_argv(arch, opt_level, sync_bn, loss_scale):
     return argv
 
 
+_TRAINER_CACHE = None
+
+
 def load_trainer():
-    spec = importlib.util.spec_from_file_location(
-        "imagenet_main_amp", _ROOT / "examples" / "imagenet" / "main_amp.py")
-    m = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(m)
-    return m
+    """Import the example trainer ONCE per process: every fresh
+    exec_module would discard the module's jit caches, forcing each test
+    that shares a config (e.g. the determinism double-run and the
+    O0-vs-O2 comparison) to recompile the whole ResNet train step."""
+    global _TRAINER_CACHE
+    if _TRAINER_CACHE is None:
+        spec = importlib.util.spec_from_file_location(
+            "imagenet_main_amp",
+            _ROOT / "examples" / "imagenet" / "main_amp.py")
+        m = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(m)
+        _TRAINER_CACHE = m
+    return _TRAINER_CACHE
 
 
 def main():

@@ -128,7 +128,11 @@ def _sequential_reference(spec, params, batch, num_microbatches, pp):
     return jax.value_and_grad(loss_of)(params)
 
 
-@pytest.mark.parametrize("pp,M", [(2, 4), (4, 4), (4, 8)])
+@pytest.mark.parametrize("pp,M", [
+    pytest.param(2, 4, marks=pytest.mark.slow),
+    (4, 4),
+    pytest.param(4, 8, marks=pytest.mark.slow),
+])
 def test_enc_dec_pipeline_matches_sequential(pp, M):
     mesh = parallel_state.initialize_model_parallel(
         pipeline_model_parallel_size_=pp,
@@ -180,6 +184,7 @@ def test_interleaved_rejects_enc_dec():
         )
 
 
+@pytest.mark.slow
 def test_loss_scale_scales_grads_only():
     mesh = parallel_state.initialize_model_parallel(pipeline_model_parallel_size_=2)
     spec = _spec()

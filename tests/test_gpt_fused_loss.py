@@ -2,6 +2,8 @@
 
 import dataclasses
 
+import pytest
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -65,6 +67,7 @@ def test_remat_dots_policy_matches_full():
     _assert_tree_close(gf, gu, rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_remat_off_matches_on():
     lf, gf = _loss_and_grads(dataclasses.replace(CFG, remat=False))
     lu, gu = _loss_and_grads(dataclasses.replace(CFG, remat=True))

@@ -102,6 +102,7 @@ def test_gpt_trains_tp_dp():
     assert float(loss) < first * 0.9, (first, float(loss))
 
 
+@pytest.mark.slow
 def test_gpt_pipeline_1f1b_matches_tp_only():
     cfg = dataclasses.replace(CFG, tie_embeddings=False)
     pp = 2
@@ -195,6 +196,7 @@ def test_gpt_sequence_parallel_matches():
     np.testing.assert_allclose(float(l_sp), float(l_1), rtol=1e-3)
 
 
+@pytest.mark.slow
 def test_gpt_pipeline_interleaved_matches_sequential():
     from apex_tpu.transformer.pipeline_parallel.schedules import (
         forward_backward_pipelining_with_interleaving,

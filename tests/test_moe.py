@@ -494,6 +494,7 @@ def test_gpt_moe_interleaved_pipeline_matches_sequential():
     assert np.any(np.asarray(grads["stages"]["router"]) != 0.0)
 
 
+@pytest.mark.slow
 def test_gpt_moe_pipeline_megatron_sp_triple_composition():
     """Everything at once: pp=2 x tp=2 x megatron_sp x MoE(ep=dp=2) through
     the 1F1B schedule equals the sequential gpt_loss — the full parallelism

@@ -156,7 +156,7 @@ def test_no_pipelining_matches_plain_grad():
     _assert_tree_close(grads, ref_g)
 
 
-@pytest.mark.parametrize("num_microbatches", [4, 8])
+@pytest.mark.parametrize("num_microbatches", [4, pytest.param(8, marks=pytest.mark.slow)])
 def test_1f1b_matches_sequential(num_microbatches):
     mesh = parallel_state.initialize_model_parallel(
         pipeline_model_parallel_size_=4
@@ -192,7 +192,7 @@ def test_1f1b_with_dp():
     _assert_tree_close(grads, ref_g)
 
 
-@pytest.mark.parametrize("vp", [2, 3])
+@pytest.mark.parametrize("vp", [pytest.param(2, marks=pytest.mark.slow), 3])
 def test_interleaved_matches_sequential(vp):
     mesh = parallel_state.initialize_model_parallel(
         pipeline_model_parallel_size_=2,
