@@ -3,7 +3,9 @@
 ``standalone_gpt`` / ``standalone_bert`` are the fixtures the reference's L0
 transformer suite trains through TP+PP (``standalone_gpt.py:1440``,
 ``standalone_bert.py``); here they double as the flagship models for the
-benchmark harness.
+benchmark harness. ``standalone_t5`` adds the encoder-decoder consumer the
+reference specifies (ModelType.encoder_and_decoder) but never shipped a
+fixture for.
 """
 
 from apex_tpu.transformer.testing.standalone_gpt import (  # noqa: F401
@@ -21,4 +23,13 @@ from apex_tpu.transformer.testing.standalone_bert import (  # noqa: F401
     bert_forward,
     bert_mlm_loss,
     init_bert_params,
+)
+from apex_tpu.transformer.testing.standalone_t5 import (  # noqa: F401
+    T5Config,
+    init_t5_params,
+    t5_enc_dec_spec,
+    t5_loss,
+    t5_param_specs,
+    t5_pipeline_params,
+    t5_pipeline_specs_tree,
 )

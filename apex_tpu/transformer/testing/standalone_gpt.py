@@ -302,8 +302,14 @@ def _attention(p, x, cfg, heads_local: int, causal: bool = True, mask=None,
     qkv = column_parallel_linear(x, p["qkv_kernel"], p["qkv_bias"],
                                  gather_output=False,
                                  sequence_parallel=cfg.megatron_sp)
-    qkv = qkv.reshape(b, s, 3, heads_local, cfg.head_dim)
-    q, k, v = (qkv[:, :, i].transpose(0, 2, 1, 3) for i in range(3))
+    # per-head interleaved packing — column c of the global qkv kernel is
+    # (head, {q,k,v}, head_dim): a contiguous TP column split then assigns
+    # whole heads with their q, k, v together, so the computed function is
+    # EXACTLY invariant to the TP degree. The flat (3, heads, head_dim)
+    # order would make a tp split hand rank 0 "q of heads 0..H/2 but k of
+    # heads H/2..H", silently mixing regions across degrees.
+    qkv = qkv.reshape(b, s, heads_local, 3, cfg.head_dim)
+    q, k, v = (qkv[:, :, :, i].transpose(0, 2, 1, 3) for i in range(3))
     try:
         sp = lax.axis_size(SP_AXIS)
     except NameError:

@@ -63,8 +63,9 @@ def test_gpt_tp_matches_single_device():
     mesh_1 = build_mesh(tp=1, dp=8)
     l_tp = _loss_on_mesh(mesh_tp, params, tokens, targets)
     l_1 = _loss_on_mesh(mesh_1, params, tokens, targets)
-    # tp=4 splits the GEMM/CE reductions -> different summation order
-    np.testing.assert_allclose(float(l_tp), float(l_1), rtol=1e-3)
+    # per-head interleaved qkv packing makes the computed function exactly
+    # TP-degree invariant; only reduction-order noise remains
+    np.testing.assert_allclose(float(l_tp), float(l_1), rtol=1e-5)
 
 
 def test_gpt_trains_tp_dp():
