@@ -15,11 +15,22 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+import pathlib  # noqa: E402
+
 import jax  # noqa: E402
 
 # The image's sitecustomize pins JAX_PLATFORMS to the one-chip 'axon' TPU
 # tunnel at interpreter startup; the config flag takes precedence over it.
 jax.config.update("jax_platforms", "cpu")
+
+# Persistent compilation cache: the suite is compile-bound on this box
+# (hundreds of small shard_map programs), and the cache is keyed by HLO
+# hash, so re-runs of unchanged tests skip XLA entirely. min_entry_size
+# -1 is required for entries to be written on the CPU backend.
+_CACHE_DIR = pathlib.Path(__file__).resolve().parent.parent / ".jax_cache"
+jax.config.update("jax_compilation_cache_dir", str(_CACHE_DIR))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 
 import pytest  # noqa: E402
 

@@ -52,6 +52,7 @@ def test_fused_loss_matches_unfused_tied():
     _assert_tree_close(gf, gu, rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_fused_loss_matches_unfused_untied_tp2():
     cfg = dataclasses.replace(CFG, tie_embeddings=False)
     lf, gf = _loss_and_grads(dataclasses.replace(cfg, fused_loss=True), tp=2)
@@ -60,6 +61,7 @@ def test_fused_loss_matches_unfused_untied_tp2():
     _assert_tree_close(gf, gu, rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_remat_dots_policy_matches_full():
     lf, gf = _loss_and_grads(dataclasses.replace(CFG, remat_policy="dots"))
     lu, gu = _loss_and_grads(dataclasses.replace(CFG, remat_policy="full"))

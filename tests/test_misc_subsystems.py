@@ -139,6 +139,30 @@ def test_checkpoint_round_trip(tmp_path):
     assert int(np.asarray(restored["opt"]["count"])) == 3
 
 
+def test_checkpointer_prefers_orbax_dir_over_pickle(tmp_path):
+    """When both an orbax dir step_N and a pickle step_N.npz.pkl exist for
+    one step, the restore must deterministically pick the orbax dir
+    regardless of listdir order (round-2 advisor finding)."""
+    from apex_tpu.transformer.testing.arguments import Checkpointer
+    from apex_tpu.utils.checkpoint import save_checkpoint
+
+    state = {"w": jnp.arange(4.0)}
+    # orbax save produces the step_3 dir (or .npz.pkl fallback if orbax is
+    # absent — then this test degenerates to single-format and still holds)
+    p = save_checkpoint(str(tmp_path / "step"), state, step=3)
+    if p.endswith(".npz.pkl"):
+        pytest.skip("orbax unavailable; only one format exists")
+    # plant a DIFFERENT pickle for the same step
+    import pickle
+
+    with open(tmp_path / "step_3.npz.pkl", "wb") as f:
+        pickle.dump({"w": np.zeros(4)}, f)
+    ck = Checkpointer(None, str(tmp_path), None)
+    restored = ck.load()
+    np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                  np.arange(4.0))
+
+
 def test_arguments_to_config():
     from apex_tpu.transformer.testing.arguments import (
         args_to_config, parallel_sizes, parse_args)
