@@ -140,23 +140,28 @@ def main() -> None:
     on_tpu = backend == "tpu"
     batch, seq, steps = (BATCH, SEQ, STEPS) if on_tpu else (2, 128, 3)
 
-    # Auto-tune the remat config: no-remat and selective ("dots") avoid
-    # recompute flops that the MFU accounting deliberately does not credit,
-    # but may not fit HBM — measure briefly and keep the fastest.
-    candidates = [(False, "full"), (True, "dots"), (True, "full")]
+    # Auto-tune (batch, remat) jointly: no-remat and selective ("dots")
+    # avoid recompute flops that the MFU accounting deliberately does not
+    # credit, but may not fit HBM at the full batch — a smaller batch with
+    # remat OFF can beat a bigger batch that pays recompute (tokens/s is
+    # batch-fair). Measure each briefly and keep the fastest.
+    candidates = [(batch, False, "full"), (batch // 2, False, "full"),
+                  (batch, True, "dots"), (batch, True, "full")]
     best, best_tps, n_params, last_err = None, 0.0, 0, None
-    for remat, policy in (candidates if on_tpu else candidates[-1:]):
-        tps, n_params, err = _measure(remat, policy, batch, seq,
+    for cand_batch, remat, policy in (candidates if on_tpu
+                                      else candidates[-1:]):
+        tps, n_params, err = _measure(remat, policy, cand_batch, seq,
                                       steps=3 if on_tpu else 1)
         if err is not None:
-            last_err = f"remat={remat}/{policy}: {err}"
+            last_err = f"batch={cand_batch} remat={remat}/{policy}: {err}"
         if tps is not None and tps > best_tps:
-            best, best_tps = (remat, policy), tps
+            best, best_tps = (cand_batch, remat, policy), tps
 
     if best is None:
-        raise RuntimeError(f"no remat config ran successfully; last error: "
+        raise RuntimeError(f"no bench config ran successfully; last error: "
                            f"{last_err}")
-    tokens_per_s, n_params, err = _measure(*best, batch, seq, steps)
+    batch, remat, policy = best
+    tokens_per_s, n_params, err = _measure(remat, policy, batch, seq, steps)
     if tokens_per_s is None:
         raise RuntimeError(f"selected config {best} failed the timed run: "
                            f"{err}")
@@ -175,7 +180,7 @@ def main() -> None:
         "value": round(tokens_per_s, 1),
         "unit": "tokens/s",
         "vs_baseline": round(mfu / 0.70, 4),
-        "remat_config": {"remat": best[0], "policy": best[1]},
+        "tuned_config": {"batch": batch, "remat": remat, "policy": policy},
     }))
 
 
