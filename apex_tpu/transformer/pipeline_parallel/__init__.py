@@ -15,6 +15,8 @@ from apex_tpu.transformer.pipeline_parallel.schedules import (  # noqa: F401
 )
 from apex_tpu.transformer.pipeline_parallel.utils import (  # noqa: F401
     average_losses_across_data_parallel_group,
+    calc_params_l2_norm,
+    clip_grad_norm,
     get_current_global_batch_size,
     get_ltor_masks_and_position_ids,
     get_micro_batch_size,

@@ -82,17 +82,66 @@ def average_losses_across_data_parallel_group(losses: Sequence[jnp.ndarray],
     return lax.pmean(stacked, axis_name)
 
 
-def calc_params_l2_norm(params: Any, model_parallel_axes: Sequence[str] = ()):
-    """Global parameter L2 norm (ref utils.py:213-240): sum of squares over
-    the local pytree, psum over the model-parallel axes (each rank holds a
-    distinct shard), sqrt."""
-    sq = sum(
-        jnp.sum(jnp.square(p.astype(jnp.float32)))
-        for p in jax.tree.leaves(params)
+def _spec_axes(spec) -> set:
+    """Mesh axis names a PartitionSpec entry shards over."""
+    out = set()
+    for entry in (spec or ()):
+        if entry is None:
+            continue
+        for a in (entry if isinstance(entry, tuple) else (entry,)):
+            if a is not None:
+                out.add(a)
+    return out
+
+
+def calc_params_l2_norm(params: Any, model_parallel_axes: Sequence[str] = (),
+                        specs: Any = None):
+    """Global parameter L2 norm (ref utils.py:213-240).
+
+    Without ``specs``: sum of squares over the local pytree, psum over ALL
+    ``model_parallel_axes`` (assumes every leaf is sharded over each axis).
+
+    With ``specs`` (a PartitionSpec pytree matching ``params``): each
+    leaf's square-sum is psum'd only over the model-parallel axes that
+    actually shard THAT leaf, so TP-replicated leaves (LayerNorm weights,
+    row-parallel biases) are counted once instead of tp times — the
+    reference's ``param_is_not_tensor_parallel_duplicate`` dedup
+    (ref tensor_parallel/layers.py:55-58).
+    """
+    from apex_tpu.transformer.pipeline_parallel.schedules.common import (
+        _pvary,
     )
-    for a in model_parallel_axes:
-        sq = lax.psum(sq, a)
-    return jnp.sqrt(sq)
+
+    mp = set(model_parallel_axes)
+
+    def leaf_sq(p, spec):
+        sq = jnp.sum(jnp.square(p.astype(jnp.float32)))
+        for a in sorted(_spec_axes(spec) & mp if specs is not None else mp):
+            sq = lax.psum(_pvary(sq, a), a)
+        return sq
+
+    if specs is None:
+        sqs = [leaf_sq(p, None) for p in jax.tree.leaves(params)]
+    else:
+        sqs = jax.tree.leaves(jax.tree.map(leaf_sq, params, specs))
+    total = sum(sqs)
+    # make the result invariant over the remaining axes for downstream use
+    for a in sorted(mp):
+        total = lax.pmax(_pvary(total, a), a)
+    return jnp.sqrt(total)
+
+
+def clip_grad_norm(grads: Any, max_norm: float,
+                   model_parallel_axes: Sequence[str] = (),
+                   specs: Any = None):
+    """Megatron-style global-norm gradient clipping (the reference pairs
+    ``calc_params_l2_norm``-class dedup with ``clip_grad_norm_fp32``; apex
+    surfaces it as ``fp16_utils.clip_grad_norm`` and the ZeRO optimizers'
+    ``max_grad_norm``). Returns ``(clipped_grads, global_norm)``; the same
+    ``specs`` dedup rules as :func:`calc_params_l2_norm` apply."""
+    norm = calc_params_l2_norm(grads, model_parallel_axes, specs)
+    scale = jnp.minimum(1.0, max_norm / (norm + 1e-6))
+    return jax.tree.map(lambda g: (g * scale).astype(g.dtype), grads), norm
 
 
 def report_memory(name: str = "") -> str:

@@ -86,3 +86,55 @@ def test_virtual_pipeline_bookkeeping():
     assert parallel_state.get_virtual_pipeline_model_parallel_rank() == 0
     parallel_state.set_virtual_pipeline_model_parallel_rank(1)
     assert parallel_state.get_virtual_pipeline_model_parallel_rank() == 1
+
+
+def test_params_l2_norm_tp_dedup():
+    """With a specs tree, TP-replicated leaves (LN weights) are counted
+    once, TP-sharded leaves psum across ranks — the reference's
+    param_is_not_tensor_parallel_duplicate dedup
+    (ref tensor_parallel/layers.py:55-58, pipeline_parallel/utils.py:213)."""
+    from apex_tpu.transformer.pipeline_parallel.utils import (
+        calc_params_l2_norm,
+        clip_grad_norm,
+    )
+
+    mesh = parallel_state.initialize_model_parallel(
+        tensor_model_parallel_size_=2)
+    params = {
+        "w_col": jnp.arange(8.0).reshape(2, 4),  # sharded over tp cols
+        "ln": jnp.arange(3.0),                   # replicated
+    }
+    specs = {"w_col": P(None, "tp"), "ln": P()}
+    true_norm = float(jnp.sqrt(sum(jnp.sum(x * x)
+                                   for x in jax.tree.leaves(params))))
+
+    def body(p):
+        return calc_params_l2_norm(p, model_parallel_axes=("tp",),
+                                   specs=specs)
+
+    norm = shard_map(body, mesh=mesh, in_specs=(specs,), out_specs=P())(
+        params)
+    np.testing.assert_allclose(float(norm), true_norm, rtol=1e-6)
+
+    # without specs the replicated leaf is double-counted (documented
+    # all-sharded assumption) — the dedup is what specs adds
+    def body_nospecs(p):
+        return calc_params_l2_norm(p, model_parallel_axes=("tp",))
+
+    norm2 = shard_map(body_nospecs, mesh=mesh, in_specs=(specs,),
+                      out_specs=P())(params)
+    ln_sq = float(jnp.sum(params["ln"] ** 2))
+    np.testing.assert_allclose(float(norm2) ** 2,
+                               true_norm ** 2 + ln_sq, rtol=1e-5)
+
+    # clip: scaled grads have exactly max_norm when over the limit
+    def body_clip(p):
+        clipped, n = clip_grad_norm(p, max_norm=1.0,
+                                    model_parallel_axes=("tp",),
+                                    specs=specs)
+        return calc_params_l2_norm(clipped, ("tp",), specs), n
+
+    cn, n = shard_map(body_clip, mesh=mesh, in_specs=(specs,),
+                      out_specs=(P(), P()))(params)
+    np.testing.assert_allclose(float(n), true_norm, rtol=1e-6)
+    np.testing.assert_allclose(float(cn), 1.0, rtol=1e-4)
