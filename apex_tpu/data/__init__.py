@@ -8,5 +8,6 @@ core (``apex_tpu/_native``); device transfer overlap comes from
 """
 
 from apex_tpu.data.loader import BatchLoader, normalize_u8  # noqa: F401
+from apex_tpu.data.prefetch import prefetch_to_device  # noqa: F401
 
-__all__ = ["BatchLoader", "normalize_u8"]
+__all__ = ["BatchLoader", "normalize_u8", "prefetch_to_device"]

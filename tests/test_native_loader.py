@@ -61,3 +61,34 @@ def test_loader_numpy_fallback(monkeypatch):
     np.testing.assert_array_equal(bl.gather(idx), src[idx])
     out = list(bl.iterate([idx, np.asarray([0, 9])]))
     np.testing.assert_array_equal(out[1], src[[0, 9]])
+
+
+def test_prefetch_to_device_order_and_sharding():
+    """prefetch_to_device preserves order/values, lands leaves on device
+    with the requested sharding, and drains fully (ref data_prefetcher
+    semantics: same batches, just in flight early)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from apex_tpu.data import prefetch_to_device
+    from apex_tpu.parallel.mesh import build_mesh
+
+    batches = [{"x": np.full((8, 4), i, np.float32), "i": np.int32(i)}
+               for i in range(5)]
+    mesh = build_mesh(tp=1)
+    shard = NamedSharding(mesh, P("dp"))
+
+    seen = list(prefetch_to_device(
+        iter(batches), size=3,
+        sharding=None))
+    assert [int(b["i"]) for b in seen] == list(range(5))
+
+    sharded = list(prefetch_to_device(
+        (b["x"] for b in batches), size=2, sharding=shard))
+    assert len(sharded) == 5
+    for i, x in enumerate(sharded):
+        assert x.sharding == shard
+        np.testing.assert_array_equal(np.asarray(x), batches[i]["x"])
+
+    with pytest.raises(ValueError):
+        next(prefetch_to_device(iter(batches), size=0))
