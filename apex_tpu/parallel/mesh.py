@@ -78,6 +78,57 @@ def build_mesh(
     return Mesh(dev_array, axis_names=AXIS_ORDER)
 
 
+def build_hybrid_mesh(
+    tp: int = 1,
+    pp: int = 1,
+    sp: int = 1,
+    dp_per_slice: int = -1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """DCN×ICI hybrid mesh for multi-slice / multi-host pods.
+
+    Layout follows the standard scaling recipe: data parallelism is the
+    ONLY axis that crosses the slice (DCN) boundary — its collectives are
+    one bandwidth-tolerant psum per step — while tp/pp/sp stay inside a
+    slice riding ICI. The reference reaches the same goal with NCCL
+    process groups laid out host-major (``parallel_state.py:76-90``'s
+    "adjacent ranks on the same DGX box" note); here
+    ``mesh_utils.create_hybrid_device_mesh`` encodes it against the real
+    slice topology (``device.slice_index``).
+
+    ``dp_per_slice=-1`` means all remaining devices within each slice. On
+    a single slice (or a simulation whose devices carry no slice index)
+    this degrades to :func:`build_mesh` — same axes, ICI-only placement.
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    slice_ids = sorted({getattr(d, "slice_index", 0) for d in devices})
+    num_slices = len(slice_ids)
+    if num_slices <= 1:
+        return build_mesh(tp=tp, pp=pp, sp=sp, dp=dp_per_slice,
+                          devices=devices)
+    per_slice = len(devices) // num_slices
+    model = tp * pp * sp
+    if dp_per_slice == -1:
+        if per_slice % model:
+            raise ValueError(
+                f"devices per slice ({per_slice}) not divisible by "
+                f"tp*pp*sp = {model}")
+        dp_per_slice = per_slice // model
+    if dp_per_slice * model != per_slice:
+        raise ValueError(
+            f"dp_per_slice={dp_per_slice} x tp*pp*sp={model} != devices "
+            f"per slice ({per_slice})")
+    from jax.experimental import mesh_utils
+
+    dev_array = mesh_utils.create_hybrid_device_mesh(
+        mesh_shape=(dp_per_slice, pp, sp, tp),
+        dcn_mesh_shape=(num_slices, 1, 1, 1),
+        devices=devices)
+    return Mesh(dev_array, axis_names=AXIS_ORDER)
+
+
 def axis_size(mesh: Mesh, axis: str) -> int:
     return mesh.shape[axis]
 
