@@ -104,11 +104,12 @@ def build_train_step(cfg, batch: int, seq: int):
 
 
 def _measure(remat: bool, remat_policy: str, batch: int, seq: int,
-             steps: int, warm_steps: int = 2):
+             steps: int, warm_steps: int = 2, unroll: int = 1):
     """(tokens/s, n_params, error) of the flagship train step under one
-    remat config; tokens/s is None when it fails (e.g. OOM with remat off).
+    config; tokens/s is None when it fails (e.g. OOM with remat off).
     Fresh params each call — donation consumes the previous buffers."""
-    cfg = flagship_config(seq, remat=remat, remat_policy=remat_policy)
+    cfg = flagship_config(seq, remat=remat, remat_policy=remat_policy,
+                          scan_unroll=unroll)
     train_step, params, opt_state, tok, tgt = build_train_step(
         cfg, batch, seq)
     n_params = sum(x.size for x in jax.tree.leaves(params))
@@ -140,28 +141,34 @@ def main() -> None:
     on_tpu = backend == "tpu"
     batch, seq, steps = (BATCH, SEQ, STEPS) if on_tpu else (2, 128, 3)
 
-    # Auto-tune (batch, remat) jointly: no-remat and selective ("dots")
-    # avoid recompute flops that the MFU accounting deliberately does not
-    # credit, but may not fit HBM at the full batch — a smaller batch with
-    # remat OFF can beat a bigger batch that pays recompute (tokens/s is
-    # batch-fair). Measure each briefly and keep the fastest.
-    candidates = [(batch, False, "full"), (batch // 2, False, "full"),
-                  (batch, True, "dots"), (batch, True, "full")]
+    # Auto-tune (batch, remat, scan_unroll) jointly: no-remat and
+    # selective ("dots") avoid recompute flops the MFU accounting does not
+    # credit but may not fit HBM at the full batch; a smaller batch with
+    # remat OFF can beat a bigger batch paying recompute (tokens/s is
+    # batch-fair); unrolling the layer scan gives XLA straight-line HLO to
+    # fuse across layer boundaries at ~12x the layer-compile cost.
+    # Measure each briefly and keep the fastest.
+    candidates = [(batch, False, "full", 1), (batch // 2, False, "full", 1),
+                  (batch, True, "dots", 1), (batch, True, "full", 1),
+                  (batch, False, "full", 12), (batch, True, "dots", 12)]
     best, best_tps, n_params, last_err = None, 0.0, 0, None
-    for cand_batch, remat, policy in (candidates if on_tpu
-                                      else candidates[-1:]):
+    for cand_batch, remat, policy, unroll in (candidates if on_tpu
+                                              else candidates[3:4]):
         tps, n_params, err = _measure(remat, policy, cand_batch, seq,
-                                      steps=3 if on_tpu else 1)
+                                      steps=3 if on_tpu else 1,
+                                      unroll=unroll)
         if err is not None:
-            last_err = f"batch={cand_batch} remat={remat}/{policy}: {err}"
+            last_err = (f"batch={cand_batch} remat={remat}/{policy} "
+                        f"unroll={unroll}: {err}")
         if tps is not None and tps > best_tps:
-            best, best_tps = (cand_batch, remat, policy), tps
+            best, best_tps = (cand_batch, remat, policy, unroll), tps
 
     if best is None:
         raise RuntimeError(f"no bench config ran successfully; last error: "
                            f"{last_err}")
-    batch, remat, policy = best
-    tokens_per_s, n_params, err = _measure(remat, policy, batch, seq, steps)
+    batch, remat, policy, unroll = best
+    tokens_per_s, n_params, err = _measure(remat, policy, batch, seq, steps,
+                                           unroll=unroll)
     if tokens_per_s is None:
         raise RuntimeError(f"selected config {best} failed the timed run: "
                            f"{err}")
@@ -180,7 +187,8 @@ def main() -> None:
         "value": round(tokens_per_s, 1),
         "unit": "tokens/s",
         "vs_baseline": round(mfu / 0.70, 4),
-        "tuned_config": {"batch": batch, "remat": remat, "policy": policy},
+        "tuned_config": {"batch": batch, "remat": remat, "policy": policy,
+                         "scan_unroll": unroll},
     }))
 
 
