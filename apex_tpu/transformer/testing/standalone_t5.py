@@ -66,6 +66,12 @@ class T5Config:
     fused_loss: bool = True
     attn_block_q: int = 512
     attn_block_k: int = 512
+    # Megatron-SP over the tp axis (same design as GPTConfig.megatron_sp):
+    # LN/residual regions run on (b, s/tp, h) sequence shards, TP blocks
+    # gather on entry and reduce-scatter on exit. In the enc-dec pipeline
+    # this also shrinks the ring p2p tensors AND the cross-attention
+    # memory broadcast by tp.
+    megatron_sp: bool = False
 
     @property
     def ffn_hidden(self) -> int:
@@ -83,6 +89,11 @@ class T5Config:
                           ("ffn_hidden", self.ffn_hidden)):
             if dim % tp:
                 raise ValueError(f"{name} ({dim}) not divisible by tp ({tp})")
+        if self.megatron_sp and (self.max_seq_enc % tp
+                                 or self.max_seq_dec % tp):
+            raise ValueError(
+                f"megatron_sp needs max_seq_enc ({self.max_seq_enc}) and "
+                f"max_seq_dec ({self.max_seq_dec}) divisible by tp ({tp})")
 
 
 # ---------------------------------------------------------------------------
@@ -215,10 +226,12 @@ def _bhsd(x, heads_local: int, head_dim: int):
 
 
 def _self_attention(p, x, cfg: T5Config, causal: bool):
-    b, s, _ = x.shape
+    b = x.shape[0]
     hl = _heads_local(cfg)
     qkv = column_parallel_linear(x, p["qkv_kernel"], p["qkv_bias"],
-                                 gather_output=False)
+                                 gather_output=False,
+                                 sequence_parallel=cfg.megatron_sp)
+    s = qkv.shape[1]  # full sequence after the SP gather
     # per-head interleaved packing (head, {q,k,v}, head_dim) — TP-degree
     # invariant under contiguous column splits (see standalone_gpt)
     qkv = qkv.reshape(b, s, hl, 3, cfg.head_dim)
@@ -227,7 +240,8 @@ def _self_attention(p, x, cfg: T5Config, causal: bool):
                           block_q=cfg.attn_block_q, block_k=cfg.attn_block_k)
     ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, hl * cfg.head_dim)
     return row_parallel_linear(ctx, p["out_kernel"], p["out_bias"],
-                               input_is_parallel=True)
+                               input_is_parallel=True,
+                               sequence_parallel=cfg.megatron_sp)
 
 
 def _cross_attention(p, x, mem, cfg: T5Config):
@@ -235,33 +249,39 @@ def _cross_attention(p, x, mem, cfg: T5Config):
     Q column-parallel from the decoder stream, fused KV column-parallel
     from the encoder memory, row-parallel output (ref
     ``ParallelAttention(attention_type=cross_attn)``)."""
-    b, s, _ = x.shape
+    b = x.shape[0]
     hl = _heads_local(cfg)
     q = column_parallel_linear(x, p["q_kernel"], p["q_bias"],
-                               gather_output=False)
+                               gather_output=False,
+                               sequence_parallel=cfg.megatron_sp)
     kv = column_parallel_linear(mem, p["kv_kernel"], p["kv_bias"],
-                                gather_output=False)
-    kv = kv.reshape(b, mem.shape[1], hl, 2, cfg.head_dim)
+                                gather_output=False,
+                                sequence_parallel=cfg.megatron_sp)
+    s = q.shape[1]  # full decoder sequence after the SP gather
+    kv = kv.reshape(b, kv.shape[1], hl, 2, cfg.head_dim)
     k, v = (kv[:, :, :, i].transpose(0, 2, 1, 3) for i in range(2))
     ctx = flash_attention(_bhsd(q, hl, cfg.head_dim), k, v, causal=False,
                           block_q=cfg.attn_block_q, block_k=cfg.attn_block_k)
     ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, hl * cfg.head_dim)
     return row_parallel_linear(ctx, p["xout_kernel"], p["xout_bias"],
-                               input_is_parallel=True)
+                               input_is_parallel=True,
+                               sequence_parallel=cfg.megatron_sp)
 
 
-def _mlp(p, x):
+def _mlp(p, x, cfg: T5Config):
     y = column_parallel_linear(x, p["fc1_kernel"], p["fc1_bias"],
-                               gather_output=False)
+                               gather_output=False,
+                               sequence_parallel=cfg.megatron_sp)
     y = jax.nn.gelu(y, approximate=True)
     return row_parallel_linear(y, p["fc2_kernel"], p["fc2_bias"],
-                               input_is_parallel=True)
+                               input_is_parallel=True,
+                               sequence_parallel=cfg.megatron_sp)
 
 
 def enc_layer_fn(p, x, cfg: T5Config):
     x = x + _self_attention(p, layer_norm(x, p["ln1_w"], p["ln1_b"]), cfg,
                             causal=False)
-    return x + _mlp(p, layer_norm(x, p["ln2_w"], p["ln2_b"]))
+    return x + _mlp(p, layer_norm(x, p["ln2_w"], p["ln2_b"]), cfg)
 
 
 def dec_layer_fn(p, x, mem, cfg: T5Config):
@@ -269,7 +289,7 @@ def dec_layer_fn(p, x, mem, cfg: T5Config):
                             causal=True)
     x = x + _cross_attention(p, layer_norm(x, p["ln2_w"], p["ln2_b"]), mem,
                              cfg)
-    return x + _mlp(p, layer_norm(x, p["ln3_w"], p["ln3_b"]))
+    return x + _mlp(p, layer_norm(x, p["ln3_w"], p["ln3_b"]), cfg)
 
 
 def _scan_layers(layer_fn, layer_params, x, cfg, *extra):
@@ -290,19 +310,28 @@ def _scan_layers(layer_fn, layer_params, x, cfg, *extra):
     return out
 
 
-def _embed(embed, tokens, pos_table):
-    h = vocab_parallel_embedding(tokens, embed["tok"])
-    return h + pos_table[: tokens.shape[1]][None, :, :].astype(h.dtype)
+def _embed(embed, tokens, pos_table, megatron_sp: bool = False):
+    h = vocab_parallel_embedding(tokens, embed["tok"],
+                                 sequence_parallel=megatron_sp)
+    s_loc = tokens.shape[1]
+    pos = pos_table[:s_loc]
+    if megatron_sp:
+        shard = s_loc // lax.axis_size(TP_AXIS)
+        pos = lax.dynamic_slice_in_dim(
+            pos, lax.axis_index(TP_AXIS) * shard, shard, 0)
+    return h + pos[None, :, :].astype(h.dtype)
 
 
 def t5_encode(params, enc_tokens, cfg: T5Config):
-    x = _embed(params["embed"], enc_tokens, params["embed"]["pos_enc"])
+    x = _embed(params["embed"], enc_tokens, params["embed"]["pos_enc"],
+               cfg.megatron_sp)
     return _scan_layers(lambda lp, h, c: enc_layer_fn(lp, h, c),
                         params["enc_layers"], x, cfg)
 
 
 def t5_decode(params, dec_tokens, mem, cfg: T5Config):
-    x = _embed(params["embed"], dec_tokens, params["embed"]["pos_dec"])
+    x = _embed(params["embed"], dec_tokens, params["embed"]["pos_dec"],
+               cfg.megatron_sp)
     return _scan_layers(lambda lp, h, m, c: dec_layer_fn(lp, h, m, c),
                         params["dec_layers"], x, cfg, mem)
 
@@ -319,12 +348,16 @@ def t5_loss(params, enc_tokens, dec_tokens, targets, cfg: T5Config):
         )
 
         return fused_head_loss(params["embed"]["tok"], head["ln_w"],
-                               head["ln_b"], x, targets)
+                               head["ln_b"], x, targets,
+                               gather_sequence=cfg.megatron_sp)
     from apex_tpu.transformer.tensor_parallel.mappings import (
         copy_to_tensor_model_parallel_region,
+        gather_from_sequence_parallel_region,
     )
 
     x = layer_norm(x, head["ln_w"], head["ln_b"])
+    if cfg.megatron_sp:
+        x = gather_from_sequence_parallel_region(x)
     x = copy_to_tensor_model_parallel_region(x)
     logits = jnp.einsum("bsh,vh->bsv", x, params["embed"]["tok"])
     return jnp.mean(vocab_parallel_cross_entropy(logits, targets))
@@ -371,14 +404,14 @@ def t5_pipeline_specs_tree(cfg: T5Config) -> Pytree:
 
 def t5_enc_dec_spec(cfg: T5Config) -> EncDecPipelineSpec:
     def enc_embed_fn(embed, enc_tokens):
-        return _embed(embed, enc_tokens, embed["pos_enc"])
+        return _embed(embed, enc_tokens, embed["pos_enc"], cfg.megatron_sp)
 
     def enc_stage_fn(stage_params, h):
         return _scan_layers(lambda lp, x, c: enc_layer_fn(lp, x, c),
                             stage_params, h, cfg)
 
     def dec_embed_fn(embed, dec_tokens):
-        return _embed(embed, dec_tokens, embed["pos_dec"])
+        return _embed(embed, dec_tokens, embed["pos_dec"], cfg.megatron_sp)
 
     def dec_stage_fn(stage_params, h, mem):
         return _scan_layers(lambda lp, x, m, c: dec_layer_fn(lp, x, m, c),
@@ -389,9 +422,12 @@ def t5_enc_dec_spec(cfg: T5Config) -> EncDecPipelineSpec:
         # (see t5_pipeline_params for why the pipeline fixture unties)
         from apex_tpu.transformer.tensor_parallel.mappings import (
             copy_to_tensor_model_parallel_region,
+            gather_from_sequence_parallel_region,
         )
 
         x = layer_norm(h, head["ln_w"], head["ln_b"])
+        if cfg.megatron_sp:
+            x = gather_from_sequence_parallel_region(x)
         x = copy_to_tensor_model_parallel_region(x)
         logits = jnp.einsum("bsh,vh->bsv", x, head["lm_rows"])
         return jnp.mean(vocab_parallel_cross_entropy(logits, targets))
