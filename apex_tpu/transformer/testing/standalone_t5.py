@@ -311,14 +311,25 @@ def _scan_layers(layer_fn, layer_params, x, cfg, *extra):
 
 
 def _embed(embed, tokens, pos_table, megatron_sp: bool = False):
+    s_loc = tokens.shape[1]
+    if megatron_sp:
+        tp_size = lax.axis_size(TP_AXIS)
+        if s_loc % tp_size:
+            # validate() only sees max_seq; check the actual sequence here
+            # instead of letting psum_scatter fail deep in the trace (the
+            # standalone_gpt.embed_tokens guard)
+            raise ValueError(
+                f"megatron_sp needs the sequence length ({s_loc}) "
+                f"divisible by tp ({tp_size})")
     h = vocab_parallel_embedding(tokens, embed["tok"],
                                  sequence_parallel=megatron_sp)
-    s_loc = tokens.shape[1]
     pos = pos_table[:s_loc]
     if megatron_sp:
-        shard = s_loc // lax.axis_size(TP_AXIS)
-        pos = lax.dynamic_slice_in_dim(
-            pos, lax.axis_index(TP_AXIS) * shard, shard, 0)
+        from apex_tpu.transformer.tensor_parallel.mappings import (
+            scatter_to_sequence_parallel_region,
+        )
+
+        pos = scatter_to_sequence_parallel_region(pos, seq_axis=0)
     return h + pos[None, :, :].astype(h.dtype)
 
 
