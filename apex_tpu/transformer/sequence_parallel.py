@@ -177,20 +177,23 @@ def _vary_like_inputs(x, *refs, extra=()):
 
 
 def _chunk_fwd(q, k_c, v_c, scale, causal, use_pallas):
-    """One Q-shard x K/V-chunk attention -> (o [q.dtype], lse fp32)."""
+    """One Q-shard x K/V-chunk attention -> (o [q.dtype], lse fp32).
+    ``k_c``/``v_c`` may have a different sequence length than ``q``
+    (cross-attention rings); the causal mask is only meaningful square."""
     b, h, s, d = q.shape
+    sk = k_c.shape[2]
     if use_pallas:
         q3 = q.reshape(b * h, s, d)
-        o3, lse3 = _fa_fwd(q3, k_c.reshape(b * h, s, d),
-                           v_c.reshape(b * h, s, d), scale, causal,
-                           _pick_block(s, 128), _pick_block(s, 128),
+        o3, lse3 = _fa_fwd(q3, k_c.reshape(b * h, sk, d),
+                           v_c.reshape(b * h, sk, d), scale, causal,
+                           _pick_block(s, 128), _pick_block(sk, 128),
                            interpret=False)
         return o3.reshape(b, h, s, d), lse3[..., 0].reshape(b, h, s)
     q32 = q.astype(jnp.float32)
     s_ = jnp.einsum("bhqd,bhkd->bhqk", q32, k_c.astype(jnp.float32)) * scale
     if causal:
-        pos = jnp.arange(s)
-        s_ = jnp.where(pos[None, :] > pos[:, None], NEG_INF, s_)
+        s_ = jnp.where(jnp.arange(sk)[None, :] > jnp.arange(s)[:, None],
+                       NEG_INF, s_)
     m = jnp.max(s_, axis=-1, keepdims=True)
     p = jnp.exp(s_ - m)
     p = jnp.where(s_ <= NEG_INF / 2, 0.0, p)
@@ -208,23 +211,25 @@ def _chunk_bwd(q, k_c, v_c, o, lse, do, delta, scale, causal, use_pallas):
     to this chunk's columns, so summing chunk contributions reproduces the
     dense backward."""
     b, h, s, d = q.shape
+    sk = k_c.shape[2]
     if use_pallas:
         sh = (b * h, s, d)
+        shk = (b * h, sk, d)
         dq3, dk3, dv3 = _fa_bwd(
-            q.reshape(sh), k_c.reshape(sh), v_c.reshape(sh), o.reshape(sh),
+            q.reshape(sh), k_c.reshape(shk), v_c.reshape(shk), o.reshape(sh),
             lse.reshape(b * h, s, 1), do.reshape(sh), scale, causal,
-            _pick_block(s, 128), _pick_block(s, 128), interpret=False)
+            _pick_block(s, 128), _pick_block(sk, 128), interpret=False)
         return (dq3.reshape(b, h, s, d).astype(jnp.float32),
-                dk3.reshape(b, h, s, d).astype(jnp.float32),
-                dv3.reshape(b, h, s, d).astype(jnp.float32))
+                dk3.reshape(b, h, sk, d).astype(jnp.float32),
+                dv3.reshape(b, h, sk, d).astype(jnp.float32))
     q32 = q.astype(jnp.float32)
     k32 = k_c.astype(jnp.float32)
     v32 = v_c.astype(jnp.float32)
     do32 = do.astype(jnp.float32)
     s_ = jnp.einsum("bhqd,bhkd->bhqk", q32, k32) * scale
     if causal:
-        pos = jnp.arange(s)
-        s_ = jnp.where(pos[None, :] > pos[:, None], NEG_INF, s_)
+        s_ = jnp.where(jnp.arange(sk)[None, :] > jnp.arange(s)[:, None],
+                       NEG_INF, s_)
     p = jnp.exp(s_ - lse[..., None])
     p = jnp.where(s_ <= NEG_INF / 2, 0.0, p)
     dv = jnp.einsum("bhqk,bhqd->bhkd", p, do32)
@@ -305,9 +310,11 @@ def _ring_flash_bwd(axis_name, causal, scale, use_pallas, res, do):
                           use_pallas)
 
     def skip_f(q, k_c, v_c):
-        z = _vary_like_inputs(jnp.zeros((b, h, s_loc, d), jnp.float32),
-                              q, k_c, do)
-        return z, z, z
+        zq = _vary_like_inputs(jnp.zeros((b, h, s_loc, d), jnp.float32),
+                               q, k_c, do)
+        zk = _vary_like_inputs(
+            jnp.zeros((b, h, k_c.shape[2], d), jnp.float32), q, k_c, do)
+        return zq, zk, zk
 
     def step(carry, t):
         k_c, v_c, dq_acc, dk_acc, dv_acc = carry
@@ -323,12 +330,14 @@ def _ring_flash_bwd(axis_name, causal, scale, use_pallas, res, do):
         v_c = lax.ppermute(v_c, axis_name, _ring_perm(n))
         return (k_c, v_c, dq_acc, dk_acc, dv_acc), None
 
-    def z0():
-        return _vary_like_inputs(jnp.zeros((b, h, s_loc, d), jnp.float32),
-                                 q, k, do, extra=(axis_name,))
+    def z0(seq_len):
+        return _vary_like_inputs(
+            jnp.zeros((b, h, seq_len, d), jnp.float32),
+            q, k, do, extra=(axis_name,))
 
+    sk_loc = k.shape[2]
     (_, _, dq, dk, dv), _ = lax.scan(
-        step, (k, v, z0(), z0(), z0()), jnp.arange(n))
+        step, (k, v, z0(s_loc), z0(sk_loc), z0(sk_loc)), jnp.arange(n))
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 

@@ -37,7 +37,7 @@ from jax.sharding import PartitionSpec as P
 
 from apex_tpu.ops.attention import flash_attention
 from apex_tpu.ops.layer_norm import layer_norm
-from apex_tpu.parallel.mesh import PP_AXIS, TP_AXIS
+from apex_tpu.parallel.mesh import PP_AXIS, SP_AXIS, TP_AXIS
 from apex_tpu.transformer.pipeline_parallel.schedules import EncDecPipelineSpec
 from apex_tpu.transformer.tensor_parallel.cross_entropy import (
     vocab_parallel_cross_entropy,
@@ -220,6 +220,13 @@ def _heads_local(cfg: T5Config) -> int:
     return cfg.num_heads // lax.axis_size(TP_AXIS)
 
 
+def _sp_size() -> int:
+    try:
+        return lax.axis_size(SP_AXIS)
+    except NameError:
+        return 1
+
+
 def _bhsd(x, heads_local: int, head_dim: int):
     b, s, _ = x.shape
     return x.reshape(b, s, heads_local, head_dim).transpose(0, 2, 1, 3)
@@ -236,8 +243,16 @@ def _self_attention(p, x, cfg: T5Config, causal: bool):
     # invariant under contiguous column splits (see standalone_gpt)
     qkv = qkv.reshape(b, s, hl, 3, cfg.head_dim)
     q, k, v = (qkv[:, :, :, i].transpose(0, 2, 1, 3) for i in range(3))
-    ctx = flash_attention(q, k, v, causal=causal,
-                          block_q=cfg.attn_block_q, block_k=cfg.attn_block_k)
+    if _sp_size() > 1:
+        # sequence sharded over the sp axis: exact attention via the K/V
+        # ring (the standalone_gpt long-context path)
+        from apex_tpu.transformer.sequence_parallel import ring_attention
+
+        ctx = ring_attention(q, k, v, causal=causal)
+    else:
+        ctx = flash_attention(q, k, v, causal=causal,
+                              block_q=cfg.attn_block_q,
+                              block_k=cfg.attn_block_k)
     ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, hl * cfg.head_dim)
     return row_parallel_linear(ctx, p["out_kernel"], p["out_bias"],
                                input_is_parallel=True,
@@ -260,8 +275,17 @@ def _cross_attention(p, x, mem, cfg: T5Config):
     s = q.shape[1]  # full decoder sequence after the SP gather
     kv = kv.reshape(b, kv.shape[1], hl, 2, cfg.head_dim)
     k, v = (kv[:, :, :, i].transpose(0, 2, 1, 3) for i in range(2))
-    ctx = flash_attention(_bhsd(q, hl, cfg.head_dim), k, v, causal=False,
-                          block_q=cfg.attn_block_q, block_k=cfg.attn_block_k)
+    if _sp_size() > 1:
+        # decoder shard attends to every encoder-memory shard via the K/V
+        # ring — the rectangular (s_dec x s_enc) ring the chunked-flash
+        # implementation supports since round 3
+        from apex_tpu.transformer.sequence_parallel import ring_attention
+
+        ctx = ring_attention(_bhsd(q, hl, cfg.head_dim), k, v, causal=False)
+    else:
+        ctx = flash_attention(_bhsd(q, hl, cfg.head_dim), k, v, causal=False,
+                              block_q=cfg.attn_block_q,
+                              block_k=cfg.attn_block_k)
     ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, hl * cfg.head_dim)
     return row_parallel_linear(ctx, p["xout_kernel"], p["xout_bias"],
                                input_is_parallel=True,
@@ -323,7 +347,10 @@ def _embed(embed, tokens, pos_table, megatron_sp: bool = False):
                 f"divisible by tp ({tp_size})")
     h = vocab_parallel_embedding(tokens, embed["tok"],
                                  sequence_parallel=megatron_sp)
-    pos = pos_table[:s_loc]
+    sp = _sp_size()
+    start = lax.axis_index(SP_AXIS) * s_loc if sp > 1 else 0
+    pos = lax.dynamic_slice_in_dim(pos_table, start, s_loc, 0) \
+        if sp > 1 else pos_table[:s_loc]
     if megatron_sp:
         from apex_tpu.transformer.tensor_parallel.mappings import (
             scatter_to_sequence_parallel_region,

@@ -198,3 +198,35 @@ def test_t5_pipeline_composes_with_megatron_sp():
     np.testing.assert_allclose(l1, l0, rtol=1e-5)
     jax.tree.map(lambda a, b: np.testing.assert_allclose(
         np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-5), g1, g0)
+
+
+def test_t5_ring_sp_matches_dense():
+    """T5 over the sp (ring) axis: encoder self-attn, causal decoder
+    self-attn, and the rectangular cross-attention all ride the K/V ring;
+    loss+grads match the sp=1 run. Exercises the rectangular flash-ring
+    (s_dec x s_enc chunks) end to end."""
+    params = init_t5_params(jax.random.PRNGKey(0), CFG)
+    batch = _batch(jax.random.PRNGKey(1))
+
+    def run(mesh, sharded_seq):
+        enc_tok, dec_tok, tgt = batch
+        data_spec = P("dp", "sp") if sharded_seq else P("dp")
+
+        def loss_fn(p):
+            def body(p, e, d, t):
+                return replicate_loss(t5_loss(p, e, d, t, CFG), mesh,
+                                      masked_axis=None)
+
+            return shard_map(
+                body, mesh=mesh,
+                in_specs=(t5_param_specs(CFG), data_spec, data_spec,
+                          data_spec),
+                out_specs=P())(p, enc_tok, dec_tok, tgt)
+
+        return jax.jit(jax.value_and_grad(loss_fn))(params)
+
+    l0, g0 = run(build_mesh(tp=1, sp=1), sharded_seq=False)
+    l1, g1 = run(build_mesh(tp=1, sp=2), sharded_seq=True)
+    np.testing.assert_allclose(float(l1), float(l0), rtol=1e-5)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-5), g1, g0)
