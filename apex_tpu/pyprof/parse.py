@@ -105,7 +105,6 @@ def measured_op_table(
     log_dir: Optional[str] = None,
     depth: int = 2,
     peak_flops: float = 197e12,
-    hbm_bandwidth: float = 819e9,
     **kwargs: Any,
 ) -> Dict[str, Any]:
     """Run ``steps`` executions of ``jit(fn)(*args)`` under the profiler and
@@ -145,7 +144,7 @@ def measured_op_table(
 
     dur_us, total_us = load_trace_events(log_dir)
 
-    comps, entry = _parse_hlo(compiled.as_text())
+    comps, _ = _parse_hlo(compiled.as_text())
     shapes = {i.name: i.type_str for instrs in comps.values() for i in instrs}
 
     # HLO instruction names are module-unique, so the join spans ALL
@@ -254,12 +253,11 @@ def format_measured_table(result: Dict[str, Any], top: int = 25,
 
 def measured_report(fn: Callable, *args: Any, steps: int = 3, top: int = 25,
                     depth: int = 2, peak_flops: float = 197e12,
-                    hbm_bandwidth: float = 819e9, **kwargs: Any) -> str:
+                    **kwargs: Any) -> str:
     """One command: measured per-op table for a jittable step (printed +
     returned). The measured analogue of :func:`apex_tpu.pyprof.report`."""
     table = format_measured_table(
         measured_op_table(fn, *args, steps=steps, depth=depth,
-                          peak_flops=peak_flops,
-                          hbm_bandwidth=hbm_bandwidth, **kwargs), top=top)
+                          peak_flops=peak_flops, **kwargs), top=top)
     print(table)
     return table
