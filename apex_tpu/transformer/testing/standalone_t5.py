@@ -66,6 +66,14 @@ class T5Config:
     fused_loss: bool = True
     attn_block_q: int = 512
     attn_block_k: int = 512
+    # Ref attention-/hidden-dropout sites, same RNG policy as
+    # standalone_gpt: active only when the caller passes ``dropout_key``;
+    # attention dropout runs INSIDE the flash kernel with a TP-rank-folded
+    # seed (tp ranks drop independent entries of their own heads), hidden/
+    # embedding dropout uses the unfolded key (same across the TP group —
+    # the activations are TP-replicated).
+    attention_dropout: float = 0.0
+    hidden_dropout: float = 0.0
     # Megatron-SP over the tp axis (same design as GPTConfig.megatron_sp):
     # LN/residual regions run on (b, s/tp, h) sequence shards, TP blocks
     # gather on entry and reduce-scatter on exit. In the enc-dec pipeline
@@ -232,7 +240,38 @@ def _bhsd(x, heads_local: int, head_dim: int):
     return x.reshape(b, s, heads_local, head_dim).transpose(0, 2, 1, 3)
 
 
-def _self_attention(p, x, cfg: T5Config, causal: bool):
+def _attn_core(q, k, v, cfg: T5Config, causal: bool, dropout_key):
+    """Shared attention core: ring over sp shards, flash otherwise,
+    with in-kernel probability dropout (TP-folded seed) when training.
+    """
+    rate = cfg.attention_dropout if dropout_key is not None else 0.0
+    if _sp_size() > 1:
+        if rate > 0.0:
+            raise NotImplementedError(
+                "attention dropout under sequence parallelism needs "
+                "position-consistent masks across ring steps; disable "
+                "attention_dropout with sp > 1")
+        from apex_tpu.transformer.sequence_parallel import ring_attention
+
+        return ring_attention(q, k, v, causal=causal)
+    if rate > 0.0:
+        from apex_tpu.transformer.tensor_parallel.random import (
+            model_parallel_key,
+        )
+
+        seed = jax.random.bits(
+            model_parallel_key(dropout_key), dtype=jnp.uint32
+        ).astype(jnp.int32)
+        return flash_attention(q, k, v, causal=causal,
+                               block_q=cfg.attn_block_q,
+                               block_k=cfg.attn_block_k,
+                               dropout_rate=rate, dropout_seed=seed)
+    return flash_attention(q, k, v, causal=causal,
+                           block_q=cfg.attn_block_q,
+                           block_k=cfg.attn_block_k)
+
+
+def _self_attention(p, x, cfg: T5Config, causal: bool, dropout_key=None):
     b = x.shape[0]
     hl = _heads_local(cfg)
     qkv = column_parallel_linear(x, p["qkv_kernel"], p["qkv_bias"],
@@ -243,23 +282,14 @@ def _self_attention(p, x, cfg: T5Config, causal: bool):
     # invariant under contiguous column splits (see standalone_gpt)
     qkv = qkv.reshape(b, s, hl, 3, cfg.head_dim)
     q, k, v = (qkv[:, :, :, i].transpose(0, 2, 1, 3) for i in range(3))
-    if _sp_size() > 1:
-        # sequence sharded over the sp axis: exact attention via the K/V
-        # ring (the standalone_gpt long-context path)
-        from apex_tpu.transformer.sequence_parallel import ring_attention
-
-        ctx = ring_attention(q, k, v, causal=causal)
-    else:
-        ctx = flash_attention(q, k, v, causal=causal,
-                              block_q=cfg.attn_block_q,
-                              block_k=cfg.attn_block_k)
+    ctx = _attn_core(q, k, v, cfg, causal, dropout_key)
     ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, hl * cfg.head_dim)
     return row_parallel_linear(ctx, p["out_kernel"], p["out_bias"],
                                input_is_parallel=True,
                                sequence_parallel=cfg.megatron_sp)
 
 
-def _cross_attention(p, x, mem, cfg: T5Config):
+def _cross_attention(p, x, mem, cfg: T5Config, dropout_key=None):
     """Decoder cross-attention: rectangular (s_dec × s_enc) flash core,
     Q column-parallel from the decoder stream, fused KV column-parallel
     from the encoder memory, row-parallel output (ref
@@ -275,17 +305,9 @@ def _cross_attention(p, x, mem, cfg: T5Config):
     s = q.shape[1]  # full decoder sequence after the SP gather
     kv = kv.reshape(b, kv.shape[1], hl, 2, cfg.head_dim)
     k, v = (kv[:, :, :, i].transpose(0, 2, 1, 3) for i in range(2))
-    if _sp_size() > 1:
-        # decoder shard attends to every encoder-memory shard via the K/V
-        # ring — the rectangular (s_dec x s_enc) ring the chunked-flash
-        # implementation supports since round 3
-        from apex_tpu.transformer.sequence_parallel import ring_attention
-
-        ctx = ring_attention(_bhsd(q, hl, cfg.head_dim), k, v, causal=False)
-    else:
-        ctx = flash_attention(_bhsd(q, hl, cfg.head_dim), k, v, causal=False,
-                              block_q=cfg.attn_block_q,
-                              block_k=cfg.attn_block_k)
+    # cross-attention rides the rectangular (s_dec x s_enc) ring under sp
+    ctx = _attn_core(_bhsd(q, hl, cfg.head_dim), k, v, cfg, False,
+                     dropout_key)
     ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, hl * cfg.head_dim)
     return row_parallel_linear(ctx, p["xout_kernel"], p["xout_bias"],
                                input_is_parallel=True,
@@ -302,35 +324,67 @@ def _mlp(p, x, cfg: T5Config):
                                sequence_parallel=cfg.megatron_sp)
 
 
-def enc_layer_fn(p, x, cfg: T5Config):
-    x = x + _self_attention(p, layer_norm(x, p["ln1_w"], p["ln1_b"]), cfg,
-                            causal=False)
-    return x + _mlp(p, layer_norm(x, p["ln2_w"], p["ln2_b"]), cfg)
+def _maybe_hidden_dropout(x, cfg: T5Config, key, salt: int):
+    if key is None or cfg.hidden_dropout <= 0.0:
+        return x
+    from apex_tpu.transformer.testing.standalone_gpt import _hidden_dropout
+
+    return _hidden_dropout(x, cfg.hidden_dropout, jax.random.fold_in(key,
+                                                                     salt))
 
 
-def dec_layer_fn(p, x, mem, cfg: T5Config):
-    x = x + _self_attention(p, layer_norm(x, p["ln1_w"], p["ln1_b"]), cfg,
-                            causal=True)
-    x = x + _cross_attention(p, layer_norm(x, p["ln2_w"], p["ln2_b"]), mem,
-                             cfg)
-    return x + _mlp(p, layer_norm(x, p["ln3_w"], p["ln3_b"]), cfg)
+def enc_layer_fn(p, x, cfg: T5Config, dropout_key=None):
+    k = dropout_key
+    a = _self_attention(p, layer_norm(x, p["ln1_w"], p["ln1_b"]), cfg,
+                        causal=False,
+                        dropout_key=None if k is None
+                        else jax.random.fold_in(k, 0))
+    x = x + _maybe_hidden_dropout(a, cfg, k, 1)
+    m = _mlp(p, layer_norm(x, p["ln2_w"], p["ln2_b"]), cfg)
+    return x + _maybe_hidden_dropout(m, cfg, k, 2)
 
 
-def _scan_layers(layer_fn, layer_params, x, cfg, *extra):
+def dec_layer_fn(p, x, mem, cfg: T5Config, dropout_key=None):
+    k = dropout_key
+    a = _self_attention(p, layer_norm(x, p["ln1_w"], p["ln1_b"]), cfg,
+                        causal=True,
+                        dropout_key=None if k is None
+                        else jax.random.fold_in(k, 0))
+    x = x + _maybe_hidden_dropout(a, cfg, k, 1)
+    c = _cross_attention(p, layer_norm(x, p["ln2_w"], p["ln2_b"]), mem, cfg,
+                         dropout_key=None if k is None
+                         else jax.random.fold_in(k, 3))
+    x = x + _maybe_hidden_dropout(c, cfg, k, 4)
+    m = _mlp(p, layer_norm(x, p["ln3_w"], p["ln3_b"]), cfg)
+    return x + _maybe_hidden_dropout(m, cfg, k, 2)
+
+
+def _scan_layers(layer_fn, layer_params, x, cfg, *extra, dropout_key=None):
     """scan the [L]-stacked layer params (remat per layer, the
     standalone_gpt recipe). ``cfg`` is closed over, NOT passed through the
     checkpoint boundary — jax.checkpoint would flatten it as a traced
-    argument."""
+    argument. With ``dropout_key``, each layer gets a fold_in-derived key
+    (the standalone_gpt per-layer stream)."""
+    has_drop = dropout_key is not None
 
-    def apply(lp, h, *ex):
-        return layer_fn(lp, h, *ex, cfg)
+    def apply(lp, h, key, *ex):
+        return layer_fn(lp, h, *ex, cfg,
+                        dropout_key=key if has_drop else None)
 
     fn = jax.checkpoint(apply) if cfg.remat else apply
 
-    def body(h, lp):
-        return fn(lp, h, *extra), None
+    n_layers = jax.tree.leaves(layer_params)[0].shape[0]
+    if has_drop:
+        keys = jax.vmap(lambda i: jax.random.fold_in(dropout_key, i))(
+            jnp.arange(n_layers))
+    else:
+        keys = jnp.zeros((n_layers, 2), jnp.uint32)
 
-    out, _ = lax.scan(body, x, layer_params)
+    def body(h, lp_key):
+        lp, key = lp_key
+        return fn(lp, h, key, *extra), None
+
+    out, _ = lax.scan(body, x, (layer_params, keys))
     return out
 
 
@@ -360,25 +414,42 @@ def _embed(embed, tokens, pos_table, megatron_sp: bool = False):
     return h + pos[None, :, :].astype(h.dtype)
 
 
-def t5_encode(params, enc_tokens, cfg: T5Config):
+def t5_encode(params, enc_tokens, cfg: T5Config, dropout_key=None):
     x = _embed(params["embed"], enc_tokens, params["embed"]["pos_enc"],
                cfg.megatron_sp)
-    return _scan_layers(lambda lp, h, c: enc_layer_fn(lp, h, c),
-                        params["enc_layers"], x, cfg)
+    x = _maybe_hidden_dropout(
+        x, cfg, None if dropout_key is None
+        else jax.random.fold_in(dropout_key, 100), 0)
+    return _scan_layers(
+        lambda lp, h, c, dropout_key=None: enc_layer_fn(
+            lp, h, c, dropout_key=dropout_key),
+        params["enc_layers"], x, cfg, dropout_key=dropout_key)
 
 
-def t5_decode(params, dec_tokens, mem, cfg: T5Config):
+def t5_decode(params, dec_tokens, mem, cfg: T5Config, dropout_key=None):
     x = _embed(params["embed"], dec_tokens, params["embed"]["pos_dec"],
                cfg.megatron_sp)
-    return _scan_layers(lambda lp, h, m, c: dec_layer_fn(lp, h, m, c),
-                        params["dec_layers"], x, cfg, mem)
+    x = _maybe_hidden_dropout(
+        x, cfg, None if dropout_key is None
+        else jax.random.fold_in(dropout_key, 101), 0)
+    return _scan_layers(
+        lambda lp, h, m, c, dropout_key=None: dec_layer_fn(
+            lp, h, m, c, dropout_key=dropout_key),
+        params["dec_layers"], x, cfg, mem, dropout_key=dropout_key)
 
 
-def t5_loss(params, enc_tokens, dec_tokens, targets, cfg: T5Config):
+def t5_loss(params, enc_tokens, dec_tokens, targets, cfg: T5Config,
+            dropout_key=None):
     """Sequential (non-pipelined) enc-dec loss; the ground truth the
-    pipeline schedule is tested against, and the TP-only training path."""
-    mem = t5_encode(params, enc_tokens, cfg)
-    x = t5_decode(params, dec_tokens, mem, cfg)
+    pipeline schedule is tested against, and the TP-only training path.
+    ``dropout_key`` activates cfg's dropout rates (training mode), with
+    distinct per-side/per-layer streams."""
+    ke = kd = None
+    if dropout_key is not None:
+        ke = jax.random.fold_in(dropout_key, 0)
+        kd = jax.random.fold_in(dropout_key, 1)
+    mem = t5_encode(params, enc_tokens, cfg, dropout_key=ke)
+    x = t5_decode(params, dec_tokens, mem, cfg, dropout_key=kd)
     head = params["head"]
     if cfg.fused_loss:
         from apex_tpu.transformer.testing.standalone_gpt import (
@@ -445,15 +516,17 @@ def t5_enc_dec_spec(cfg: T5Config) -> EncDecPipelineSpec:
         return _embed(embed, enc_tokens, embed["pos_enc"], cfg.megatron_sp)
 
     def enc_stage_fn(stage_params, h):
-        return _scan_layers(lambda lp, x, c: enc_layer_fn(lp, x, c),
-                            stage_params, h, cfg)
+        return _scan_layers(
+            lambda lp, x, c, dropout_key=None: enc_layer_fn(lp, x, c),
+            stage_params, h, cfg)
 
     def dec_embed_fn(embed, dec_tokens):
         return _embed(embed, dec_tokens, embed["pos_dec"], cfg.megatron_sp)
 
     def dec_stage_fn(stage_params, h, mem):
-        return _scan_layers(lambda lp, x, m, c: dec_layer_fn(lp, x, m, c),
-                            stage_params, h, cfg, mem)
+        return _scan_layers(
+            lambda lp, x, m, c, dropout_key=None: dec_layer_fn(lp, x, m, c),
+            stage_params, h, cfg, mem)
 
     def loss_fn(head, h, targets):
         # per-microbatch mean vocab-parallel CE over the untied head rows

@@ -230,3 +230,36 @@ def test_t5_ring_sp_matches_dense():
     np.testing.assert_allclose(float(l1), float(l0), rtol=1e-5)
     jax.tree.map(lambda a, b: np.testing.assert_allclose(
         np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-5), g1, g0)
+
+
+def test_t5_dropout_deterministic_and_key_sensitive():
+    """T5 dropout follows the GPT RNG policy: same key -> identical loss,
+    different key -> different loss, no key == rate 0; rates actually
+    drop (train loss differs from eval)."""
+    cfg_d = dataclasses.replace(CFG, attention_dropout=0.2,
+                                hidden_dropout=0.2)
+    params = init_t5_params(jax.random.PRNGKey(0), cfg_d)
+    enc_tok, dec_tok, tgt = _batch(jax.random.PRNGKey(1))
+    mesh = build_mesh(tp=2)
+
+    def loss(cfg, key):
+        def body(p, e, d, t):
+            return replicate_loss(
+                t5_loss(p, e, d, t, cfg, dropout_key=key), mesh,
+                masked_axis=None)
+
+        return float(jax.jit(lambda p: shard_map(
+            body, mesh=mesh,
+            in_specs=(t5_param_specs(cfg), P("dp"), P("dp"), P("dp")),
+            out_specs=P())(p, enc_tok, dec_tok, tgt))(params))
+
+    k = jax.random.PRNGKey(7)
+    l_a = loss(cfg_d, k)
+    l_b = loss(cfg_d, k)
+    l_c = loss(cfg_d, jax.random.PRNGKey(8))
+    l_eval = loss(cfg_d, None)
+    l_plain = loss(CFG, None)
+    assert l_a == l_b, "same dropout key must be deterministic"
+    assert l_a != l_c, "different dropout key must change the loss"
+    assert l_a != l_eval, "dropout must actually drop in train mode"
+    np.testing.assert_allclose(l_eval, l_plain, rtol=1e-6)
