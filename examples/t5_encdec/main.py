@@ -50,6 +50,9 @@ def parse_args(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--steps", type=int, default=10)
     p.add_argument("--pp", type=int, default=2)
+    p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--megatron-sp", action="store_true",
+                   help="sequence-sharded activation regions over tp")
     p.add_argument("--microbatches", type=int, default=2)
     p.add_argument("--batch", type=int, default=0,
                    help="global batch (0 = 2 * dp * microbatches)")
@@ -65,6 +68,7 @@ def parse_args(argv=None):
 def main(argv=None):
     args = parse_args(argv)
     mesh = parallel_state.initialize_model_parallel(
+        tensor_model_parallel_size_=args.tp,
         pipeline_model_parallel_size_=args.pp,
         pipeline_model_parallel_split_rank_=max(args.pp // 2, 1),
     )
@@ -73,7 +77,9 @@ def main(argv=None):
                    num_heads=max(args.hidden // 16, 1),
                    enc_layers=args.enc_layers, dec_layers=args.dec_layers,
                    max_seq_enc=args.seq_enc, max_seq_dec=args.seq_dec,
-                   dtype=jnp.float32, fused_loss=False)
+                   dtype=jnp.float32, fused_loss=False,
+                   megatron_sp=args.megatron_sp)
+    cfg.validate(tp=args.tp)
     params = t5_pipeline_params(jax.random.PRNGKey(0), cfg, pp=args.pp)
     spec = t5_enc_dec_spec(cfg)
     specs_tree = t5_pipeline_specs_tree(cfg)
@@ -92,7 +98,9 @@ def main(argv=None):
         return params, opt_state, loss
 
     key = jax.random.PRNGKey(1)
-    print(f"mesh dp={dp} pp={args.pp}; enc {cfg.enc_layers}L / dec "
+    print(f"mesh dp={dp} pp={args.pp} tp={args.tp}"
+          f"{' +megatron_sp' if args.megatron_sp else ''}; "
+          f"enc {cfg.enc_layers}L / dec "
           f"{cfg.dec_layers}L, {M} microbatches, batch {batch}")
     t0 = time.perf_counter()
     for step in range(args.steps):
