@@ -130,6 +130,18 @@ def _measure(remat: bool, remat_policy: str, batch: int, seq: int,
 
 
 def main() -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="skip the auto-tune sweep: time the last-known-good"
+                         " config with few steps (the watcher's stage-1 shot"
+                         " that must bank a number inside a short tunnel"
+                         " window)")
+    ap.add_argument("--out", default=None,
+                    help="also persist the JSON line to this path")
+    args = ap.parse_args()
+
     from apex_tpu.utils.platform import pin_cpu_platform, probe_backend
 
     if (os.environ.get("JAX_PLATFORMS") != "cpu"
@@ -141,19 +153,28 @@ def main() -> None:
     on_tpu = backend == "tpu"
     batch, seq, steps = (BATCH, SEQ, STEPS) if on_tpu else (2, 128, 3)
 
-    # Auto-tune (batch, remat, scan_unroll) jointly: no-remat and
-    # selective ("dots") avoid recompute flops the MFU accounting does not
-    # credit but may not fit HBM at the full batch; a smaller batch with
-    # remat OFF can beat a bigger batch paying recompute (tokens/s is
-    # batch-fair); unrolling the layer scan gives XLA straight-line HLO to
-    # fuse across layer boundaries at ~12x the layer-compile cost.
-    # Measure each briefly and keep the fastest.
-    candidates = [(batch, False, "full", 1), (batch // 2, False, "full", 1),
-                  (batch, True, "dots", 1), (batch, True, "full", 1),
-                  (batch, False, "full", 12), (batch, True, "dots", 12)]
+    if args.quick:
+        # Last-known-good config (ran on the real chip in round 1):
+        # guaranteed-fit remat-full at the full batch. One compile, short
+        # timed run.
+        candidates = [(batch, True, "full", 1)]
+        steps = min(steps, 8)
+    else:
+        # Auto-tune (batch, remat, scan_unroll) jointly: no-remat and
+        # selective ("dots") avoid recompute flops the MFU accounting does
+        # not credit but may not fit HBM at the full batch; a smaller batch
+        # with remat OFF can beat a bigger batch paying recompute (tokens/s
+        # is batch-fair); unrolling the layer scan gives XLA straight-line
+        # HLO to fuse across layer boundaries at ~12x the layer-compile
+        # cost. Measure each briefly and keep the fastest.
+        candidates = [(batch, False, "full", 1),
+                      (batch // 2, False, "full", 1),
+                      (batch, True, "dots", 1), (batch, True, "full", 1),
+                      (batch, False, "full", 12), (batch, True, "dots", 12)]
+    if not on_tpu:
+        candidates = [(batch, True, "full", 1)]  # CPU: one cheap config
     best, best_tps, n_params, last_err = None, 0.0, 0, None
-    for cand_batch, remat, policy, unroll in (candidates if on_tpu
-                                              else candidates[3:4]):
+    for cand_batch, remat, policy, unroll in candidates:
         tps, n_params, err = _measure(remat, policy, cand_batch, seq,
                                       steps=3 if on_tpu else 1,
                                       unroll=unroll)
@@ -182,14 +203,18 @@ def main() -> None:
     name = "gpt2_124m_bf16_train_tokens_per_sec_chip"
     if not on_tpu:
         name += "_CPU_FALLBACK"
-    print(json.dumps({
+    line = json.dumps({
         "metric": name,
         "value": round(tokens_per_s, 1),
         "unit": "tokens/s",
         "vs_baseline": round(mfu / 0.70, 4),
         "tuned_config": {"batch": batch, "remat": remat, "policy": policy,
                          "scan_unroll": unroll},
-    }))
+    })
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
 
 
 if __name__ == "__main__":

@@ -36,12 +36,16 @@ def _results():
     k = jax.random.PRNGKey(0)
     out = []
 
-    def record(name, fn):
+    def record(name, fn, tol=5e-2):
+        # ok requires err WITHIN the per-kernel tolerance (advisor r3): a
+        # finite-but-large error vs the XLA reference must fail the gate,
+        # not pass it. tol=0.0 demands bitwise equality (dropout determinism).
         t0 = time.perf_counter()
         try:
             err = float(fn())
-            out.append({"kernel": name, "ok": bool(np.isfinite(err)),
-                        "max_err": err,
+            out.append({"kernel": name,
+                        "ok": bool(np.isfinite(err) and err <= tol),
+                        "max_err": err, "tol": tol,
                         "seconds": round(time.perf_counter() - t0, 2)})
         except Exception as e:  # noqa: BLE001 — record, keep smoking
             out.append({"kernel": name, "ok": False,
@@ -85,7 +89,7 @@ def _results():
         # same seed -> bitwise equal; different seed -> visibly different
         return same if differs > 1e-3 else float("nan")
 
-    record("flash_attention_inkernel_dropout", dropout_determinism)
+    record("flash_attention_inkernel_dropout", dropout_determinism, tol=0.0)
 
     from apex_tpu.ops.attention_varlen import (
         attention_varlen_reference,
@@ -176,7 +180,7 @@ def _results():
         jax.block_until_ready((y, l1))
         return max(e1, float(jnp.abs(l1 - l2)))
 
-    record("scaled_softmax_and_xentropy", softmax_xent)
+    record("scaled_softmax_and_xentropy", softmax_xent, tol=1e-4)
 
     return {"backend": jax.default_backend(), "on_tpu": on_tpu,
             "kernels": out}

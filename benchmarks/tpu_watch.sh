@@ -1,0 +1,99 @@
+#!/bin/bash
+# TPU tunnel watcher v3 — STAGED fire (VERDICT r3 task 2).
+#
+# Rounds 2 and 3 both died with a dead tunnel and no real-TPU number on
+# disk. v3's contract: even a ~5-minute healthy window banks a headline —
+# stage 1 is a no-tune quick bench that persists BENCH_watch.json before
+# anything heavier starts, and every later stage writes its own artifact
+# the moment it finishes. A kill mid-suite loses only the stages after it.
+#
+# Launch DETACHED (the Bash tool kills its own background children at the
+# 10-min cap):   setsid nohup bash benchmarks/tpu_watch.sh &
+#
+# Stages on a healthy probe:
+#   1 quick headline  bench.py --quick      -> BENCH_watch.json      (~3 min)
+#   2 kernel smoke    smoke_tpu.py          -> SMOKE_TPU.json        (~2 min)
+#   3 tuned headline  bench.py (full sweep) -> BENCH_watch.json      (~15 min)
+#   4 step profile    profile_step.py       -> PROFILE_TPU.txt
+#   5 block tuner     tune_blocks.py        -> TUNE_TPU.txt
+#   6 baseline matrix bench_matrix.py       -> BENCH_MATRIX_TPU.txt
+# After all six, later healthy probes only refresh stage 1+3 (hourly) so
+# the banked number tracks the latest code.
+cd /root/repo || exit 1
+export APEX_TPU_PROBE_NO_CACHE=1
+LOG=/tmp/tpu_health.log
+STATE=/tmp/tpu_watch_stage   # highest completed stage, survives restarts
+[ -f "$STATE" ] || echo 0 > "$STATE"
+last_refresh=0
+
+note() { echo "$(date '+%F %T') $*" >> "$LOG"; }
+
+run_stage() {  # run_stage <n> <timeout> <artifact-check-file> <cmd...>
+  local n=$1 to=$2 art=$3; shift 3
+  note "STAGE$n START: $*"
+  timeout "$to" "$@" > "/tmp/tpu_stage$n.out" 2> "/tmp/tpu_stage$n.err"
+  local rc=$?
+  note "STAGE$n EXIT=$rc"
+  if [ $rc -eq 0 ] && { [ -z "$art" ] || [ -s "$art" ]; }; then
+    [ "$(cat "$STATE")" -lt "$n" ] && echo "$n" > "$STATE"
+    return 0
+  fi
+  return 1
+}
+
+bench_stage() {  # bench_stage <n> <timeout> [extra bench.py args...]
+  # Bench to a temp file; promote to BENCH_watch.json ONLY when the metric
+  # is real-TPU — if the tunnel dies between our probe and bench.py's,
+  # bench.py banks a CPU_FALLBACK line that must never clobber a banked
+  # real-chip number. State advances only on promotion.
+  local n=$1 to=$2; shift 2
+  note "STAGE$n START: bench.py $*"
+  rm -f /tmp/bench_try.json
+  timeout "$to" python bench.py "$@" --out /tmp/bench_try.json \
+    > "/tmp/tpu_stage$n.out" 2> "/tmp/tpu_stage$n.err"
+  local rc=$?
+  note "STAGE$n EXIT=$rc"
+  [ $rc -eq 0 ] && [ -s /tmp/bench_try.json ] || return 1
+  if grep -q CPU_FALLBACK /tmp/bench_try.json; then
+    note "STAGE$n got CPU_FALLBACK, not promoting"
+    return 1
+  fi
+  cp /tmp/bench_try.json BENCH_watch.json
+  [ "$(cat "$STATE")" -lt "$n" ] && echo "$n" > "$STATE"
+  note "STAGE$n PROMOTED $(cat BENCH_watch.json)"
+  return 0
+}
+
+while true; do
+  if timeout 240 python -c "import jax, jax.numpy as jnp; assert jax.default_backend()=='tpu'; x=jnp.ones((128,128),jnp.bfloat16); assert float((x@x).sum())>0" > /tmp/tpu_watch_probe.log 2>&1; then
+    note HEALTHY
+    done_stage=$(cat "$STATE")
+    now=$(date +%s)
+    if [ "$done_stage" -ge 6 ]; then
+      # full suite already banked: refresh the headline at most hourly
+      if [ $((now - last_refresh)) -ge 3600 ]; then
+        bench_stage 1 600 --quick
+        bench_stage 3 2400
+        last_refresh=$now
+      fi
+    else
+      [ "$done_stage" -lt 1 ] && bench_stage 1 600 --quick
+      [ "$(cat "$STATE")" -ge 1 ] && [ "$done_stage" -lt 2 ] && \
+        run_stage 2 900 SMOKE_TPU.json \
+        python benchmarks/smoke_tpu.py --out SMOKE_TPU.json
+      [ "$(cat "$STATE")" -ge 1 ] && [ "$done_stage" -lt 3 ] && \
+        bench_stage 3 2400
+      [ "$(cat "$STATE")" -ge 3 ] && run_stage 4 1200 PROFILE_TPU.txt \
+        bash -c "python benchmarks/profile_step.py --steps 5 > PROFILE_TPU.txt"
+      [ "$(cat "$STATE")" -ge 4 ] && run_stage 5 1800 TUNE_TPU.txt \
+        bash -c "python benchmarks/tune_blocks.py > TUNE_TPU.txt"
+      [ "$(cat "$STATE")" -ge 5 ] && run_stage 6 3600 BENCH_MATRIX_TPU.txt \
+        bash -c "python benchmarks/bench_matrix.py > BENCH_MATRIX_TPU.txt"
+      last_refresh=$now
+    fi
+    sleep 120
+  else
+    note DEAD
+    sleep 240
+  fi
+done
