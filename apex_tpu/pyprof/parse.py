@@ -95,6 +95,14 @@ def load_trace_events(
         t, c = dur.get(name, (0.0, 0))
         dur[name] = (t + d, c + 1)
         total += d
+    if len(device_pids) > 1:
+        # every device row carries its own copy of an SPMD op's span;
+        # report the per-device MEAN of both time AND exec count so
+        # ms/step and the flops/bytes scaling downstream (MFU%, GB/s)
+        # both describe one chip, not the sum over all chips (advisor r3)
+        n = float(len(device_pids))
+        dur = {k: (t / n, max(1, round(c / n))) for k, (t, c) in dur.items()}
+        total /= n
     return dur, total
 
 

@@ -21,8 +21,11 @@ _PROBE_CACHE_DEAD_TTL_S = 60
 
 
 def _probe_cache_path() -> str:
-    """Per-boot cache file for the probe verdict (the boot id keys it so a
-    stale file from a previous machine boot can never answer)."""
+    """Per-boot, per-user cache file for the probe verdict. The boot id
+    keys it so a stale file from a previous machine boot can never answer;
+    the uid keeps the path out of reach of other users on a shared host
+    (advisor r3: a world-shared /tmp name could be pre-created or
+    symlinked by another user)."""
     import tempfile
 
     try:
@@ -30,7 +33,9 @@ def _probe_cache_path() -> str:
             boot = f.read().strip().replace("-", "")
     except OSError:
         boot = "noboot"
-    return os.path.join(tempfile.gettempdir(), f"apex_tpu_probe_{boot}")
+    uid = os.getuid() if hasattr(os, "getuid") else 0
+    return os.path.join(tempfile.gettempdir(),
+                        f"apex_tpu_probe_u{uid}_{boot}")
 
 
 def probe_backend(timeout_s: int = 240) -> int:
@@ -96,8 +101,16 @@ def probe_backend(timeout_s: int = 240) -> int:
         verdict = 0
     if use_cache:
         try:
-            with open(cache, "w") as f:
+            # atomic rename of a private temp file: concurrent probers
+            # never see a half-written verdict, and an attacker-placed
+            # symlink at the final path is replaced, not followed
+            import tempfile as _tf
+
+            fd, tmp = _tf.mkstemp(dir=os.path.dirname(cache),
+                                  prefix=".apex_tpu_probe_")
+            with os.fdopen(fd, "w") as f:
                 f.write(str(verdict))
+            os.replace(tmp, cache)
         except OSError:
             pass
     return verdict

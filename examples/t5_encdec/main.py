@@ -70,7 +70,9 @@ def main(argv=None):
     mesh = parallel_state.initialize_model_parallel(
         tensor_model_parallel_size_=args.tp,
         pipeline_model_parallel_size_=args.pp,
-        pipeline_model_parallel_split_rank_=max(args.pp // 2, 1),
+        # pp=1 runs encoder+decoder on the one stage: no split rank exists
+        # (0 < split < pp is unsatisfiable), so pass None
+        pipeline_model_parallel_split_rank_=(args.pp // 2 or None),
     )
     dp = mesh.shape["dp"]
     cfg = T5Config(vocab_size=1024, hidden=args.hidden,
