@@ -49,13 +49,15 @@ from apex_tpu.ops._pallas_util import sds as _sds  # noqa: E402
 
 def attention_reference(q, k, v, mask=None, scale: Optional[float] = None,
                         causal: bool = False, dropout_rate: float = 0.0,
-                        dropout_key=None):
-    """Plain softmax(QKᵀ·scale)V in fp32 accumulation.
+                        dropout_key=None, bias=None):
+    """Plain softmax(QKᵀ·scale + bias)V in fp32 accumulation.
 
     ``mask``: broadcastable boolean over (..., sq, sk), True = masked OUT
     (the reference convention, ``apex/contrib/fmha/fmha.py`` cu_seqlens
-    padding → masked). Optional probability dropout on the softmax (the
-    reference kernels' fused dropout, here materialized). Returns q.dtype.
+    padding → masked). ``bias``: additive logit bias broadcastable over
+    (..., sq, sk) — e.g. T5 relative position bias (heads, sq, sk).
+    Optional probability dropout on the softmax (the reference kernels'
+    fused dropout, here materialized). Returns q.dtype.
     """
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
@@ -63,6 +65,8 @@ def attention_reference(q, k, v, mask=None, scale: Optional[float] = None,
     k32 = k.astype(jnp.float32)
     v32 = v.astype(jnp.float32)
     s = jnp.einsum("...qd,...kd->...qk", q32, k32) * scale
+    if bias is not None:
+        s = s + bias.astype(jnp.float32)
     if causal:
         sq, sk = s.shape[-2], s.shape[-1]
         qpos = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
@@ -112,9 +116,14 @@ def _dropout_keep(seed_ref, rate, block_q, block_k, q_i, kv_i, bh_i):
     return x >= thresh
 
 
-def _fa_fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
-                   m_scr, l_scr, acc_scr,
-                   *, scale, causal, block_q, block_k, nk, dropout_rate):
+def _fa_fwd_kernel(seed_ref, q_ref, k_ref, v_ref, *refs,
+                   scale, causal, block_q, block_k, nk, dropout_rate,
+                   has_bias=False):
+    if has_bias:
+        bias_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
+    else:
+        bias_ref = None
+        o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
     bh_i = pl.program_id(0)
     q_i = pl.program_id(1)
     kv_i = pl.program_id(2)
@@ -138,6 +147,8 @@ def _fa_fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
+        if has_bias:
+            s = s + bias_ref[0].astype(jnp.float32)
         if causal:
             qpos = q_i * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
@@ -174,26 +185,41 @@ def _fa_fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse_ref[0] = jnp.where(l == 0.0, NEG_INF, m_scr[:, :1] + jnp.log(safe_l))
 
 
+def _bias_spec(num_heads, block_q, block_k):
+    """BlockSpec for a batch-shared (heads, sq, sk) bias: grid dim 0 is the
+    flattened b*h (b-major), so the head index is bh mod heads."""
+    return pl.BlockSpec(
+        (1, block_q, block_k),
+        lambda b, i, j: (jax.lax.rem(b, num_heads), i, j))
+
+
 def _fa_fwd(q3, k3, v3, scale, causal, block_q, block_k, interpret,
-            dropout_rate=0.0, seed=None):
+            dropout_rate=0.0, seed=None, bias=None):
     bh, sq, d = q3.shape
     sk = k3.shape[1]
     nq = sq // block_q
     nk = sk // block_k
     if seed is None:
         seed = jnp.zeros((1,), jnp.int32)
+    has_bias = bias is not None
     kernel = functools.partial(
         _fa_fwd_kernel, scale=scale, causal=causal,
-        block_q=block_q, block_k=block_k, nk=nk, dropout_rate=dropout_rate)
+        block_q=block_q, block_k=block_k, nk=nk, dropout_rate=dropout_rate,
+        has_bias=has_bias)
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+    ]
+    inputs = [seed, q3, k3, v3]
+    if has_bias:
+        in_specs.append(_bias_spec(bias.shape[0], block_q, block_k))
+        inputs.append(bias)
     o, lse = pl.pallas_call(
         kernel,
         grid=(bh, nq, nk),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
@@ -210,7 +236,7 @@ def _fa_fwd(q3, k3, v3, scale, causal, block_q, block_k, interpret,
         compiler_params=None if interpret else pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(seed, q3, k3, v3)
+    )(*inputs)
     return o, lse
 
 
@@ -221,8 +247,14 @@ def _fa_fwd(q3, k3, v3, scale, causal, block_q, block_k, interpret,
 # the row is needed (the flash-attention backward identity).
 
 def _fa_bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
-                      delta_ref, dq_ref, dq_scr,
-                      *, scale, causal, block_q, block_k, nk, dropout_rate):
+                      delta_ref, *refs,
+                      scale, causal, block_q, block_k, nk, dropout_rate,
+                      has_bias=False):
+    if has_bias:
+        bias_ref, dq_ref, dq_scr = refs
+    else:
+        bias_ref = None
+        dq_ref, dq_scr = refs
     bh_i = pl.program_id(0)
     q_i = pl.program_id(1)
     kv_i = pl.program_id(2)
@@ -244,6 +276,8 @@ def _fa_bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
+        if has_bias:
+            s = s + bias_ref[0].astype(jnp.float32)
         if causal:
             qpos = q_i * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
@@ -267,8 +301,14 @@ def _fa_bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
 
 def _fa_bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
-                       delta_ref, dk_ref, dv_ref, dk_scr, dv_scr,
-                       *, scale, causal, block_q, block_k, nq, dropout_rate):
+                       delta_ref, *refs,
+                       scale, causal, block_q, block_k, nq, dropout_rate,
+                       has_bias=False):
+    if has_bias:
+        bias_ref, dk_ref, dv_ref, dk_scr, dv_scr = refs
+    else:
+        bias_ref = None
+        dk_ref, dv_ref, dk_scr, dv_scr = refs
     bh_i = pl.program_id(0)
     kv_i = pl.program_id(1)
     q_i = pl.program_id(2)
@@ -291,6 +331,8 @@ def _fa_bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
+        if has_bias:
+            s = s + bias_ref[0].astype(jnp.float32)
         if causal:
             qpos = q_i * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
@@ -323,55 +365,123 @@ def _fa_bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
+def _fa_bwd_dbias_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                         delta_ref, bias_ref, db_ref, db_scr,
+                         *, scale, causal, block_q, block_k, nb, num_heads,
+                         dropout_rate):
+    """dL/dbias for a batch-shared (heads, sq, sk) bias: recompute ds
+    blockwise (the flash backward identity) and accumulate over the batch
+    (innermost grid dim). dL/ds excludes the q·kᵀ ``scale`` — bias enters
+    the logits after scaling."""
+    h_i = pl.program_id(0)
+    q_i = pl.program_id(1)
+    kv_i = pl.program_id(2)
+    b_i = pl.program_id(3)
+    bh_i = b_i * num_heads + h_i
+
+    @pl.when(b_i == 0)
+    def _init():
+        db_scr[:] = jnp.zeros_like(db_scr)
+
+    run = (kv_i * block_k <= q_i * block_q + block_q - 1) if causal else True
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0]
+        delta = delta_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        s = s + bias_ref[0].astype(jnp.float32)
+        if causal:
+            qpos = q_i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = kv_i * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(kpos > qpos, NEG_INF, s)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        if dropout_rate > 0.0:
+            keep = _dropout_keep(seed_ref, dropout_rate, block_q, block_k,
+                                 q_i, kv_i, bh_i)
+            dp = jnp.where(keep, dp * (1.0 / (1.0 - dropout_rate)), 0.0)
+        db_scr[:] += p * (dp - delta)
+
+    @pl.when(b_i == nb - 1)
+    def _finish():
+        db_ref[0] = db_scr[:].astype(db_ref.dtype)
+
+
 def _fa_bwd(q3, k3, v3, o3, lse, do3, scale, causal, block_q, block_k,
-            interpret, dropout_rate=0.0, seed=None):
+            interpret, dropout_rate=0.0, seed=None, bias=None):
     bh, sq, d = q3.shape
     sk = k3.shape[1]
     nq = sq // block_q
     nk = sk // block_k
     if seed is None:
         seed = jnp.zeros((1,), jnp.int32)
+    has_bias = bias is not None
     delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
                     axis=-1, keepdims=True)
 
     dq_kernel = functools.partial(
         _fa_bwd_dq_kernel, scale=scale, causal=causal,
-        block_q=block_q, block_k=block_k, nk=nk, dropout_rate=dropout_rate)
+        block_q=block_q, block_k=block_k, nk=nk, dropout_rate=dropout_rate,
+        has_bias=has_bias)
+    dq_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+    ]
+    dq_inputs = [seed, q3, k3, v3, do3, lse, delta]
+    if has_bias:
+        dq_specs.append(_bias_spec(bias.shape[0], block_q, block_k))
+        dq_inputs.append(bias)
     dq = pl.pallas_call(
         dq_kernel,
         grid=(bh, nq, nk),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
-        ],
+        in_specs=dq_specs,
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=_sds((bh, sq, d), q3.dtype, q3, k3, v3, do3),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         compiler_params=None if interpret else pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(seed, q3, k3, v3, do3, lse, delta)
+    )(*dq_inputs)
 
     dkv_kernel = functools.partial(
         _fa_bwd_dkv_kernel, scale=scale, causal=causal,
-        block_q=block_q, block_k=block_k, nq=nq, dropout_rate=dropout_rate)
+        block_q=block_q, block_k=block_k, nq=nq, dropout_rate=dropout_rate,
+        has_bias=has_bias)
+    dkv_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),
+        pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+        pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
+        pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
+    ]
+    dkv_inputs = [seed, q3, k3, v3, do3, lse, delta]
+    if has_bias:
+        num_heads = bias.shape[0]
+        dkv_specs.append(pl.BlockSpec(
+            (1, block_q, block_k),
+            lambda b, j, i: (jax.lax.rem(b, num_heads), i, j)))
+        dkv_inputs.append(bias)
     dk, dv = pl.pallas_call(
         dkv_kernel,
         grid=(bh, nk, nq),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
-        ],
+        in_specs=dkv_specs,
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
@@ -387,8 +497,48 @@ def _fa_bwd(q3, k3, v3, o3, lse, do3, scale, causal, block_q, block_k,
         compiler_params=None if interpret else pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(seed, q3, k3, v3, do3, lse, delta)
-    return dq, dk, dv
+    )(*dkv_inputs)
+
+    if not has_bias:
+        return dq, dk, dv, None
+
+    num_heads = bias.shape[0]
+    nb = bh // num_heads
+    dbias_kernel = functools.partial(
+        _fa_bwd_dbias_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, nb=nb, num_heads=num_heads,
+        dropout_rate=dropout_rate)
+    db = pl.pallas_call(
+        dbias_kernel,
+        # batch innermost ("arbitrary"): the (h, q, k) tile accumulates
+        # its batch sum in scratch and writes once at the last batch item
+        grid=(num_heads, nq, nk, nb),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, block_q, d),
+                         lambda h, i, j, b: (b * num_heads + h, i, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda h, i, j, b: (b * num_heads + h, j, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda h, i, j, b: (b * num_heads + h, j, 0)),
+            pl.BlockSpec((1, block_q, d),
+                         lambda h, i, j, b: (b * num_heads + h, i, 0)),
+            pl.BlockSpec((1, block_q, 1),
+                         lambda h, i, j, b: (b * num_heads + h, i, 0)),
+            pl.BlockSpec((1, block_q, 1),
+                         lambda h, i, j, b: (b * num_heads + h, i, 0)),
+            pl.BlockSpec((1, block_q, block_k), lambda h, i, j, b: (h, i, j)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, block_k),
+                               lambda h, i, j, b: (h, i, j)),
+        out_shape=_sds((num_heads, sq, sk), jnp.float32, q3, k3, v3, do3),
+        scratch_shapes=[pltpu.VMEM((block_q, block_k), jnp.float32)],
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(seed, q3, k3, v3, do3, lse, delta, bias)
+    return dq, dk, dv, db
 
 
 # ---------------------------------------------------------------------------
@@ -412,12 +562,43 @@ def _flash3_fwd(q3, k3, v3, seed, scale, causal, block_q, block_k, interpret,
 def _flash3_bwd(scale, causal, block_q, block_k, interpret, dropout_rate,
                 res, do3):
     q3, k3, v3, seed, o3, lse = res
-    dq, dk, dv = _fa_bwd(q3, k3, v3, o3, lse, do3, scale, causal, block_q,
-                         block_k, interpret, dropout_rate, seed)
+    dq, dk, dv, _ = _fa_bwd(q3, k3, v3, o3, lse, do3, scale, causal, block_q,
+                            block_k, interpret, dropout_rate, seed)
     return dq, dk, dv, None
 
 
 _flash3.defvjp(_flash3_fwd, _flash3_bwd)
+
+
+# Bias-carrying variant: same kernels with the additive (heads, sq, sk)
+# logit bias (T5 relative position bias) threaded through forward and all
+# three backward kernels; the extra dbias kernel batch-reduces dL/ds.
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
+def _flash3_bias(q3, k3, v3, bias, seed, scale, causal, block_q, block_k,
+                 interpret, dropout_rate):
+    o, _ = _fa_fwd(q3, k3, v3, scale, causal, block_q, block_k, interpret,
+                   dropout_rate, seed, bias=bias)
+    return o
+
+
+def _flash3_bias_fwd(q3, k3, v3, bias, seed, scale, causal, block_q, block_k,
+                     interpret, dropout_rate):
+    o, lse = _fa_fwd(q3, k3, v3, scale, causal, block_q, block_k, interpret,
+                     dropout_rate, seed, bias=bias)
+    return o, (q3, k3, v3, bias, seed, o, lse)
+
+
+def _flash3_bias_bwd(scale, causal, block_q, block_k, interpret, dropout_rate,
+                     res, do3):
+    q3, k3, v3, bias, seed, o3, lse = res
+    dq, dk, dv, db = _fa_bwd(q3, k3, v3, o3, lse, do3, scale, causal,
+                             block_q, block_k, interpret, dropout_rate, seed,
+                             bias=bias)
+    return dq, dk, dv, db.astype(bias.dtype), None
+
+
+_flash3_bias.defvjp(_flash3_bias_fwd, _flash3_bias_bwd)
 
 
 def flash_attention_with_lse(q3, k3, v3, scale, causal, block_q, block_k,
@@ -462,6 +643,7 @@ def flash_attention(
     use_pallas: Optional[bool] = None,
     dropout_rate: float = 0.0,
     dropout_seed=None,
+    bias=None,
 ):
     """Memory-efficient attention over (batch, heads, seq, head_dim).
 
@@ -469,6 +651,14 @@ def flash_attention(
     (ref capability: ``fmhalib`` + ``fast_multihead_attn``, without their
     seqlen ≤ 512 limit); XLA reference path for arbitrary ``mask`` or odd
     shapes. ``mask`` True = masked out.
+
+    ``bias``: optional batch-shared additive logit bias of shape
+    (heads, sq, sk) — the T5 relative-position-bias contract. It rides the
+    Pallas path (added to the score tile inside all kernels; its gradient
+    comes from a dedicated batch-reducing kernel) and is differentiable.
+    Note the compiled TPU path tiles the bias (block_q, block_k), so sk
+    must be a multiple of 128 or fit one block; the reference fallback has
+    no such limit.
 
     ``dropout_rate`` > 0 applies probability dropout to the (normalized)
     attention weights *inside* the kernel — the counter-based keep mask is
@@ -484,6 +674,10 @@ def flash_attention(
         scale = 1.0 / math.sqrt(d)
     if dropout_rate > 0.0 and dropout_seed is None:
         raise ValueError("dropout_rate > 0 needs dropout_seed")
+    if bias is not None and bias.shape != (h, sq, sk):
+        raise ValueError(
+            f"bias must be batch-shared (heads, sq, sk) = {(h, sq, sk)}, "
+            f"got {bias.shape}")
     pallas_possible = mask is None and _pallas_ok(
         sq, sk, d, causal, allow_interpret=True)
     if use_pallas is None:
@@ -502,12 +696,18 @@ def flash_attention(
                                      .astype(jnp.uint32))
         return attention_reference(q, k, v, mask=mask, scale=scale,
                                    causal=causal, dropout_rate=dropout_rate,
-                                   dropout_key=key)
+                                   dropout_key=key, bias=bias)
     bq = _pick_block(sq, block_q)
     bk = _pick_block(sk, block_k)
     interpret = jax.default_backend() != "tpu"
     seed = (jnp.zeros((1,), jnp.int32) if dropout_seed is None
             else jnp.asarray(dropout_seed, jnp.int32).reshape((1,)))
+    if bias is not None:
+        o3 = _flash3_bias(
+            q.reshape(b * h, sq, d), k.reshape(b * h, sk, d),
+            v.reshape(b * h, sk, d), bias, seed, scale, causal, bq, bk,
+            interpret, float(dropout_rate))
+        return o3.reshape(b, h, sq, d)
     o3 = _flash3(
         q.reshape(b * h, sq, d), k.reshape(b * h, sk, d),
         v.reshape(b * h, sk, d), seed, scale, causal, bq, bk, interpret,

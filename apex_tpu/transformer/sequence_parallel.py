@@ -215,7 +215,7 @@ def _chunk_bwd(q, k_c, v_c, o, lse, do, delta, scale, causal, use_pallas):
     if use_pallas:
         sh = (b * h, s, d)
         shk = (b * h, sk, d)
-        dq3, dk3, dv3 = _fa_bwd(
+        dq3, dk3, dv3, _ = _fa_bwd(
             q.reshape(sh), k_c.reshape(shk), v_c.reshape(shk), o.reshape(sh),
             lse.reshape(b * h, s, 1), do.reshape(sh), scale, causal,
             _pick_block(s, 128), _pick_block(sk, 128), interpret=False)

@@ -12,8 +12,11 @@ global-shape parameter pytree, Megatron TP layout (column-parallel QKV/FC1
 and cross-attention Q/KV, row-parallel out-proj/FC2, vocab-parallel shared
 embedding + loss), flash-attention cores (causal for decoder self-attn,
 rectangular ``s_dec × s_enc`` for cross-attn), pre-LN residual blocks.
-Simplifications vs T5-the-paper, documented not hidden: learned absolute
-positions instead of relative position biases, and no encoder-final
+Position scheme: learned absolute positions by default, or T5's real
+bucketed relative position biases with ``relative_position_bias=True``
+(bias added to the logits inside the flash kernel — encoder bidirectional,
+decoder causal, none on cross-attention, per-stack tables). Remaining
+simplification vs T5-the-paper, documented not hidden: no encoder-final
 LayerNorm (the memory leaves the last encoder stage un-normalized so the
 pipeline ring stays shape-uniform; decoder cross-attention learns the
 scale).
@@ -80,6 +83,16 @@ class T5Config:
     # this also shrinks the ring p2p tensors AND the cross-attention
     # memory broadcast by tp.
     megatron_sp: bool = False
+    # T5's signature position scheme (opt-in): bucketed relative position
+    # biases added to the attention logits INSIDE the flash kernel
+    # (ops/attention.py bias path) — bidirectional buckets for encoder
+    # self-attention, causal buckets for decoder self-attention, none for
+    # cross-attention, one (buckets, heads) table per stack shared across
+    # its layers (the T5 layout; heads split over tp). When enabled the
+    # learned absolute position tables are skipped (T5 has none).
+    relative_position_bias: bool = False
+    rel_pos_buckets: int = 32
+    rel_pos_max_distance: int = 128
 
     @property
     def ffn_hidden(self) -> int:
@@ -102,6 +115,75 @@ class T5Config:
             raise ValueError(
                 f"megatron_sp needs max_seq_enc ({self.max_seq_enc}) and "
                 f"max_seq_dec ({self.max_seq_dec}) divisible by tp ({tp})")
+        if self.relative_position_bias:
+            if self.rel_pos_buckets % 2:
+                raise ValueError("rel_pos_buckets must be even (half the "
+                                 "buckets serve each direction in the "
+                                 "bidirectional encoder scheme)")
+            if self.rel_pos_max_distance <= self.rel_pos_buckets // 2:
+                # the log-spaced range needs max_distance > max_exact for
+                # BOTH schemes (decoder max_exact = buckets/2); at or
+                # below it the bucket formula divides by log(<=1)
+                raise ValueError(
+                    f"rel_pos_max_distance ({self.rel_pos_max_distance}) "
+                    f"must exceed rel_pos_buckets/2 "
+                    f"({self.rel_pos_buckets // 2})")
+
+
+# ---------------------------------------------------------------------------
+# relative position bias (T5 scheme: log-spaced distance buckets)
+
+def _rel_pos_bucket(rel, *, bidirectional: bool, num_buckets: int,
+                    max_distance: int):
+    """Bucket index for ``rel = k_pos - q_pos`` (int32 array).
+
+    The T5 bucketing (paper §2.1): exact buckets for small distances, one
+    log-spaced bucket per range up to ``max_distance``, everything farther
+    in the last bucket; bidirectional splits the buckets between the two
+    sign halves, unidirectional (decoder) buckets only the past.
+    """
+    ret = jnp.zeros_like(rel)
+    if bidirectional:
+        num_buckets //= 2
+        ret = ret + (rel > 0).astype(jnp.int32) * num_buckets
+        rel = jnp.abs(rel)
+    else:
+        rel = -jnp.minimum(rel, 0)
+    max_exact = num_buckets // 2
+    is_small = rel < max_exact
+    # log-spaced: position max_exact..max_distance maps onto the remaining
+    # buckets; the +1e-6 keeps log finite at rel == 0 (masked by is_small)
+    val_large = max_exact + (
+        jnp.log(rel.astype(jnp.float32) / max_exact + 1e-6)
+        / math.log(max_distance / max_exact)
+        * (num_buckets - max_exact)).astype(jnp.int32)
+    val_large = jnp.minimum(val_large, num_buckets - 1)
+    return ret + jnp.where(is_small, rel, val_large)
+
+
+def t5_relative_bias(table_local, sq: int, sk: int, *, bidirectional: bool,
+                     cfg: T5Config):
+    """(heads_local, sq, sk) fp32 additive logit bias from the local
+    (buckets, heads_local) table shard — feeds ``flash_attention(bias=)``.
+    Inside shard_map the table param is already the TP head shard, so each
+    rank builds exactly its own heads' bias."""
+    qpos = jnp.arange(sq, dtype=jnp.int32)
+    kpos = jnp.arange(sk, dtype=jnp.int32)
+    buckets = _rel_pos_bucket(
+        kpos[None, :] - qpos[:, None], bidirectional=bidirectional,
+        num_buckets=cfg.rel_pos_buckets,
+        max_distance=cfg.rel_pos_max_distance)
+    return table_local.astype(jnp.float32)[buckets].transpose(2, 0, 1)
+
+
+def _init_rel_tables(rng, cfg: T5Config) -> Pytree:
+    dt = cfg.dtype
+    kq, kk = jax.random.split(rng)
+    shape = (cfg.rel_pos_buckets, cfg.num_heads)
+    return {
+        "rel_enc": (jax.random.normal(kq, shape) * 0.02).astype(dt),
+        "rel_dec": (jax.random.normal(kk, shape) * 0.02).astype(dt),
+    }
 
 
 # ---------------------------------------------------------------------------
@@ -167,17 +249,22 @@ def init_t5_params(rng, cfg: T5Config) -> Pytree:
         _init_dec_layer(k, cfg)
         for k in jax.random.split(kdec, cfg.dec_layers)])
     dt = cfg.dtype
+    embed = {
+        "tok": (jax.random.normal(ke, (cfg.vocab_size, cfg.hidden))
+                * 0.02).astype(dt),
+    }
+    if cfg.relative_position_bias:
+        # T5 proper: no absolute positions; one rel-bias table per stack
+        embed.update(_init_rel_tables(jax.random.fold_in(ke, 3), cfg))
+    else:
+        embed["pos_enc"] = (jax.random.normal(
+            jax.random.fold_in(ke, 1), (cfg.max_seq_enc, cfg.hidden))
+            * 0.02).astype(dt)
+        embed["pos_dec"] = (jax.random.normal(
+            jax.random.fold_in(ke, 2), (cfg.max_seq_dec, cfg.hidden))
+            * 0.02).astype(dt)
     return {
-        "embed": {
-            "tok": (jax.random.normal(ke, (cfg.vocab_size, cfg.hidden))
-                    * 0.02).astype(dt),
-            "pos_enc": (jax.random.normal(jax.random.fold_in(ke, 1),
-                                          (cfg.max_seq_enc, cfg.hidden))
-                        * 0.02).astype(dt),
-            "pos_dec": (jax.random.normal(jax.random.fold_in(ke, 2),
-                                          (cfg.max_seq_dec, cfg.hidden))
-                        * 0.02).astype(dt),
-        },
+        "embed": embed,
         "enc_layers": enc,
         "dec_layers": dec,
         "head": {
@@ -213,8 +300,16 @@ def t5_param_specs(cfg: T5Config, extra_layer_lead=()) -> Pytree:
                 "fc2_kernel", "fc2_bias")
     dec_keys = enc_keys + ("q_kernel", "q_bias", "kv_kernel", "kv_bias",
                            "xout_kernel", "xout_bias", "ln3_w", "ln3_b")
+    embed = {"tok": P(TP_AXIS, None)}
+    if cfg.relative_position_bias:
+        # heads axis TP-split: each rank holds its own heads' bias columns
+        embed["rel_enc"] = P(None, TP_AXIS)
+        embed["rel_dec"] = P(None, TP_AXIS)
+    else:
+        embed["pos_enc"] = P()
+        embed["pos_dec"] = P()
     return {
-        "embed": {"tok": P(TP_AXIS, None), "pos_enc": P(), "pos_dec": P()},
+        "embed": embed,
         "enc_layers": _layer_specs(enc_keys, lead),
         "dec_layers": _layer_specs(dec_keys, lead),
         "head": {"ln_w": P(), "ln_b": P()},
@@ -240,9 +335,12 @@ def _bhsd(x, heads_local: int, head_dim: int):
     return x.reshape(b, s, heads_local, head_dim).transpose(0, 2, 1, 3)
 
 
-def _attn_core(q, k, v, cfg: T5Config, causal: bool, dropout_key):
+def _attn_core(q, k, v, cfg: T5Config, causal: bool, dropout_key,
+               bias=None):
     """Shared attention core: ring over sp shards, flash otherwise,
-    with in-kernel probability dropout (TP-folded seed) when training.
+    with in-kernel probability dropout (TP-folded seed) when training
+    and an optional additive logit bias (relative position bias) fed to
+    the kernel's bias path.
     """
     rate = cfg.attention_dropout if dropout_key is not None else 0.0
     if _sp_size() > 1:
@@ -251,6 +349,12 @@ def _attn_core(q, k, v, cfg: T5Config, causal: bool, dropout_key):
                 "attention dropout under sequence parallelism needs "
                 "position-consistent masks across ring steps; disable "
                 "attention_dropout with sp > 1")
+        if bias is not None:
+            raise NotImplementedError(
+                "relative position bias under ring sequence parallelism "
+                "needs per-ring-step bias slices; use megatron_sp (full "
+                "sequence inside attention) with "
+                "relative_position_bias=True")
         from apex_tpu.transformer.sequence_parallel import ring_attention
 
         return ring_attention(q, k, v, causal=causal)
@@ -265,13 +369,15 @@ def _attn_core(q, k, v, cfg: T5Config, causal: bool, dropout_key):
         return flash_attention(q, k, v, causal=causal,
                                block_q=cfg.attn_block_q,
                                block_k=cfg.attn_block_k,
-                               dropout_rate=rate, dropout_seed=seed)
+                               dropout_rate=rate, dropout_seed=seed,
+                               bias=bias)
     return flash_attention(q, k, v, causal=causal,
                            block_q=cfg.attn_block_q,
-                           block_k=cfg.attn_block_k)
+                           block_k=cfg.attn_block_k, bias=bias)
 
 
-def _self_attention(p, x, cfg: T5Config, causal: bool, dropout_key=None):
+def _self_attention(p, x, cfg: T5Config, causal: bool, dropout_key=None,
+                    rel_bias=None):
     b = x.shape[0]
     hl = _heads_local(cfg)
     qkv = column_parallel_linear(x, p["qkv_kernel"], p["qkv_bias"],
@@ -282,7 +388,7 @@ def _self_attention(p, x, cfg: T5Config, causal: bool, dropout_key=None):
     # invariant under contiguous column splits (see standalone_gpt)
     qkv = qkv.reshape(b, s, hl, 3, cfg.head_dim)
     q, k, v = (qkv[:, :, :, i].transpose(0, 2, 1, 3) for i in range(3))
-    ctx = _attn_core(q, k, v, cfg, causal, dropout_key)
+    ctx = _attn_core(q, k, v, cfg, causal, dropout_key, bias=rel_bias)
     ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, hl * cfg.head_dim)
     return row_parallel_linear(ctx, p["out_kernel"], p["out_bias"],
                                input_is_parallel=True,
@@ -333,24 +439,27 @@ def _maybe_hidden_dropout(x, cfg: T5Config, key, salt: int):
                                                                      salt))
 
 
-def enc_layer_fn(p, x, cfg: T5Config, dropout_key=None):
+def enc_layer_fn(p, x, cfg: T5Config, dropout_key=None, rel_bias=None):
     k = dropout_key
     a = _self_attention(p, layer_norm(x, p["ln1_w"], p["ln1_b"]), cfg,
                         causal=False,
                         dropout_key=None if k is None
-                        else jax.random.fold_in(k, 0))
+                        else jax.random.fold_in(k, 0),
+                        rel_bias=rel_bias)
     x = x + _maybe_hidden_dropout(a, cfg, k, 1)
     m = _mlp(p, layer_norm(x, p["ln2_w"], p["ln2_b"]), cfg)
     return x + _maybe_hidden_dropout(m, cfg, k, 2)
 
 
-def dec_layer_fn(p, x, mem, cfg: T5Config, dropout_key=None):
+def dec_layer_fn(p, x, mem, cfg: T5Config, dropout_key=None, rel_bias=None):
     k = dropout_key
     a = _self_attention(p, layer_norm(x, p["ln1_w"], p["ln1_b"]), cfg,
                         causal=True,
                         dropout_key=None if k is None
-                        else jax.random.fold_in(k, 0))
+                        else jax.random.fold_in(k, 0),
+                        rel_bias=rel_bias)
     x = x + _maybe_hidden_dropout(a, cfg, k, 1)
+    # cross-attention carries NO position bias (the T5 scheme)
     c = _cross_attention(p, layer_norm(x, p["ln2_w"], p["ln2_b"]), mem, cfg,
                          dropout_key=None if k is None
                          else jax.random.fold_in(k, 3))
@@ -389,6 +498,9 @@ def _scan_layers(layer_fn, layer_params, x, cfg, *extra, dropout_key=None):
 
 
 def _embed(embed, tokens, pos_table, megatron_sp: bool = False):
+    """Token (+ optional absolute position) embedding. ``pos_table`` is
+    None under ``relative_position_bias`` — T5 proper has no absolute
+    positions; the layers add bucketed logit biases instead."""
     s_loc = tokens.shape[1]
     if megatron_sp:
         tp_size = lax.axis_size(TP_AXIS)
@@ -401,6 +513,8 @@ def _embed(embed, tokens, pos_table, megatron_sp: bool = False):
                 f"divisible by tp ({tp_size})")
     h = vocab_parallel_embedding(tokens, embed["tok"],
                                  sequence_parallel=megatron_sp)
+    if pos_table is None:
+        return h
     sp = _sp_size()
     start = lax.axis_index(SP_AXIS) * s_loc if sp > 1 else 0
     pos = lax.dynamic_slice_in_dim(pos_table, start, s_loc, 0) \
@@ -415,27 +529,39 @@ def _embed(embed, tokens, pos_table, megatron_sp: bool = False):
 
 
 def t5_encode(params, enc_tokens, cfg: T5Config, dropout_key=None):
-    x = _embed(params["embed"], enc_tokens, params["embed"]["pos_enc"],
+    rel_on = cfg.relative_position_bias
+    x = _embed(params["embed"], enc_tokens,
+               None if rel_on else params["embed"]["pos_enc"],
                cfg.megatron_sp)
     x = _maybe_hidden_dropout(
         x, cfg, None if dropout_key is None
         else jax.random.fold_in(dropout_key, 100), 0)
+    s = enc_tokens.shape[1]  # full sequence (megatron_sp scatters inside)
+    rel = (t5_relative_bias(params["embed"]["rel_enc"], s, s,
+                            bidirectional=True, cfg=cfg)
+           if rel_on else None)
     return _scan_layers(
-        lambda lp, h, c, dropout_key=None: enc_layer_fn(
-            lp, h, c, dropout_key=dropout_key),
-        params["enc_layers"], x, cfg, dropout_key=dropout_key)
+        lambda lp, h, rel_bias, c, dropout_key=None: enc_layer_fn(
+            lp, h, c, dropout_key=dropout_key, rel_bias=rel_bias),
+        params["enc_layers"], x, cfg, rel, dropout_key=dropout_key)
 
 
 def t5_decode(params, dec_tokens, mem, cfg: T5Config, dropout_key=None):
-    x = _embed(params["embed"], dec_tokens, params["embed"]["pos_dec"],
+    rel_on = cfg.relative_position_bias
+    x = _embed(params["embed"], dec_tokens,
+               None if rel_on else params["embed"]["pos_dec"],
                cfg.megatron_sp)
     x = _maybe_hidden_dropout(
         x, cfg, None if dropout_key is None
         else jax.random.fold_in(dropout_key, 101), 0)
+    s = dec_tokens.shape[1]
+    rel = (t5_relative_bias(params["embed"]["rel_dec"], s, s,
+                            bidirectional=False, cfg=cfg)
+           if rel_on else None)
     return _scan_layers(
-        lambda lp, h, m, c, dropout_key=None: dec_layer_fn(
-            lp, h, m, c, dropout_key=dropout_key),
-        params["dec_layers"], x, cfg, mem, dropout_key=dropout_key)
+        lambda lp, h, m, rel_bias, c, dropout_key=None: dec_layer_fn(
+            lp, h, m, c, dropout_key=dropout_key, rel_bias=rel_bias),
+        params["dec_layers"], x, cfg, mem, rel, dropout_key=dropout_key)
 
 
 def t5_loss(params, enc_tokens, dec_tokens, targets, cfg: T5Config,
@@ -489,12 +615,28 @@ def t5_pipeline_params(rng, cfg: T5Config, pp: int) -> Pytree:
     # fixture unties the LM projection (initialized from the shared table —
     # the grads then flow separately, as with GPT's untied pipeline head)
     head["lm_rows"] = p["embed"]["tok"]
+    enc_stages = jax.tree.map(
+        lambda a: regroup(a, cfg.enc_layers), p["enc_layers"])
+    dec_stages = jax.tree.map(
+        lambda a: regroup(a, cfg.dec_layers), p["dec_layers"])
+    embed = p["embed"]
+    if cfg.relative_position_bias:
+        # stage functions can't reach the embed group, so each stage gets
+        # its own copy of its stack's rel table (initialized equal) — the
+        # same untying the pipeline fixture applies to the LM head: exact
+        # forward parity with the sequential model, per-stage gradients.
+        # The embed copies are dropped (they would sit in optimizer state
+        # and checkpoints as frozen dead weights).
+        tile = lambda a: jnp.broadcast_to(  # noqa: E731
+            a[None], (pp,) + a.shape).copy()
+        enc_stages = {"layers": enc_stages, "rel": tile(embed["rel_enc"])}
+        dec_stages = {"layers": dec_stages, "rel": tile(embed["rel_dec"])}
+        embed = {k: v for k, v in embed.items()
+                 if k not in ("rel_enc", "rel_dec")}
     return {
-        "embed": p["embed"],
-        "enc_stages": jax.tree.map(
-            lambda a: regroup(a, cfg.enc_layers), p["enc_layers"]),
-        "dec_stages": jax.tree.map(
-            lambda a: regroup(a, cfg.dec_layers), p["dec_layers"]),
+        "embed": embed,
+        "enc_stages": enc_stages,
+        "dec_stages": dec_stages,
         "head": head,
     }
 
@@ -503,27 +645,57 @@ def t5_pipeline_specs_tree(cfg: T5Config) -> Pytree:
     specs = t5_param_specs(cfg, extra_layer_lead=(PP_AXIS,))
     head = dict(specs["head"])
     head["lm_rows"] = P(TP_AXIS, None)
+    enc_stages, dec_stages = specs["enc_layers"], specs["dec_layers"]
+    embed = specs["embed"]
+    if cfg.relative_position_bias:
+        rel_spec = P(PP_AXIS, None, TP_AXIS)
+        enc_stages = {"layers": enc_stages, "rel": rel_spec}
+        dec_stages = {"layers": dec_stages, "rel": rel_spec}
+        embed = {k: v for k, v in embed.items()
+                 if k not in ("rel_enc", "rel_dec")}
     return {
-        "embed": specs["embed"],
-        "enc_stages": specs["enc_layers"],
-        "dec_stages": specs["dec_layers"],
+        "embed": embed,
+        "enc_stages": enc_stages,
+        "dec_stages": dec_stages,
         "head": head,
     }
 
 
 def t5_enc_dec_spec(cfg: T5Config) -> EncDecPipelineSpec:
+    rel_on = cfg.relative_position_bias
+
     def enc_embed_fn(embed, enc_tokens):
-        return _embed(embed, enc_tokens, embed["pos_enc"], cfg.megatron_sp)
+        return _embed(embed, enc_tokens,
+                      None if rel_on else embed["pos_enc"], cfg.megatron_sp)
 
     def enc_stage_fn(stage_params, h):
+        if rel_on:
+            s = h.shape[1] * (lax.axis_size(TP_AXIS) if cfg.megatron_sp
+                              else 1)
+            rel = t5_relative_bias(stage_params["rel"], s, s,
+                                   bidirectional=True, cfg=cfg)
+            return _scan_layers(
+                lambda lp, x, rb, c, dropout_key=None: enc_layer_fn(
+                    lp, x, c, rel_bias=rb),
+                stage_params["layers"], h, cfg, rel)
         return _scan_layers(
             lambda lp, x, c, dropout_key=None: enc_layer_fn(lp, x, c),
             stage_params, h, cfg)
 
     def dec_embed_fn(embed, dec_tokens):
-        return _embed(embed, dec_tokens, embed["pos_dec"], cfg.megatron_sp)
+        return _embed(embed, dec_tokens,
+                      None if rel_on else embed["pos_dec"], cfg.megatron_sp)
 
     def dec_stage_fn(stage_params, h, mem):
+        if rel_on:
+            s = h.shape[1] * (lax.axis_size(TP_AXIS) if cfg.megatron_sp
+                              else 1)
+            rel = t5_relative_bias(stage_params["rel"], s, s,
+                                   bidirectional=False, cfg=cfg)
+            return _scan_layers(
+                lambda lp, x, m, rb, c, dropout_key=None: dec_layer_fn(
+                    lp, x, m, c, rel_bias=rb),
+                stage_params["layers"], h, cfg, mem, rel)
         return _scan_layers(
             lambda lp, x, m, c, dropout_key=None: dec_layer_fn(lp, x, m, c),
             stage_params, h, cfg, mem)
