@@ -58,6 +58,7 @@ def ring_attention(
     scale: Optional[float] = None,
     remat_steps: bool = True,
     impl: str = "auto",
+    bias_strip=None,
 ):
     """Exact attention over a sequence sharded on ``axis_name``.
 
@@ -66,6 +67,12 @@ def ring_attention(
     index order. Must run inside a mesh program. Returns this device's
     (batch, heads, s_local, head_dim) output shard, equal to the
     corresponding slice of dense attention over the gathered sequence.
+
+    ``bias_strip``: optional batch-shared additive logit bias for THIS
+    device's Q rows against ALL global key columns — shape (heads,
+    s_local, sp × sk_local), e.g. a T5 relative-position-bias strip. Each
+    ring step slices the arriving chunk's columns; the strip is
+    differentiable (its grad flows back into the table that built it).
 
     ``impl``:
 
@@ -87,7 +94,18 @@ def ring_attention(
         use_pallas = (jax.default_backend() == "tpu"
                       and _pallas_ok(s_loc, s_loc, d, causal=False,
                                      allow_interpret=False))
+        if bias_strip is not None:
+            n = lax.axis_size(axis_name)
+            want = (h, s_loc, n * k.shape[2])
+            if bias_strip.shape != want:
+                raise ValueError(
+                    f"bias_strip must be (heads, s_local, sp*sk_local) = "
+                    f"{want}, got {bias_strip.shape}")
+            return _ring_flash_biased(q, k, v, bias_strip, axis_name,
+                                      causal, scale, use_pallas)
         return _ring_flash(q, k, v, axis_name, causal, scale, use_pallas)
+    if bias_strip is not None:
+        raise NotImplementedError("bias_strip needs impl='auto'")
     return _ring_scan(q, k, v, axis_name, causal, scale, remat_steps)
 
 
@@ -176,10 +194,12 @@ def _vary_like_inputs(x, *refs, extra=()):
     return lax.pcast(x, missing, to="varying") if missing else x
 
 
-def _chunk_fwd(q, k_c, v_c, scale, causal, use_pallas):
+def _chunk_fwd(q, k_c, v_c, scale, causal, use_pallas, bias_c=None):
     """One Q-shard x K/V-chunk attention -> (o [q.dtype], lse fp32).
     ``k_c``/``v_c`` may have a different sequence length than ``q``
-    (cross-attention rings); the causal mask is only meaningful square."""
+    (cross-attention rings); the causal mask is only meaningful square.
+    ``bias_c``: optional batch-shared (h, s, sk) additive logit bias for
+    this chunk's columns (T5 relative position bias under ring SP)."""
     b, h, s, d = q.shape
     sk = k_c.shape[2]
     if use_pallas:
@@ -187,10 +207,12 @@ def _chunk_fwd(q, k_c, v_c, scale, causal, use_pallas):
         o3, lse3 = _fa_fwd(q3, k_c.reshape(b * h, sk, d),
                            v_c.reshape(b * h, sk, d), scale, causal,
                            _pick_block(s, 128), _pick_block(sk, 128),
-                           interpret=False)
+                           interpret=False, bias=bias_c)
         return o3.reshape(b, h, s, d), lse3[..., 0].reshape(b, h, s)
     q32 = q.astype(jnp.float32)
     s_ = jnp.einsum("bhqd,bhkd->bhqk", q32, k_c.astype(jnp.float32)) * scale
+    if bias_c is not None:
+        s_ = s_ + bias_c.astype(jnp.float32)
     if causal:
         s_ = jnp.where(jnp.arange(sk)[None, :] > jnp.arange(s)[:, None],
                        NEG_INF, s_)
@@ -205,28 +227,34 @@ def _chunk_fwd(q, k_c, v_c, scale, causal, use_pallas):
     return o.astype(q.dtype), lse
 
 
-def _chunk_bwd(q, k_c, v_c, o, lse, do, delta, scale, causal, use_pallas):
-    """Per-chunk flash backward against the *global* lse -> (dq, dk, dv)
-    fp32. ``p = exp(s - lse_global)`` is the exact global softmax restricted
-    to this chunk's columns, so summing chunk contributions reproduces the
-    dense backward."""
+def _chunk_bwd(q, k_c, v_c, o, lse, do, delta, scale, causal, use_pallas,
+               bias_c=None, want_dbias=False):
+    """Per-chunk flash backward against the *global* lse -> (dq, dk, dv[,
+    dbias]) fp32. ``p = exp(s - lse_global)`` is the exact global softmax
+    restricted to this chunk's columns, so summing chunk contributions
+    reproduces the dense backward; dbias (batch-reduced, no q·kᵀ scale)
+    is returned when ``want_dbias``."""
     b, h, s, d = q.shape
     sk = k_c.shape[2]
     if use_pallas:
         sh = (b * h, s, d)
         shk = (b * h, sk, d)
-        dq3, dk3, dv3, _ = _fa_bwd(
+        dq3, dk3, dv3, db = _fa_bwd(
             q.reshape(sh), k_c.reshape(shk), v_c.reshape(shk), o.reshape(sh),
             lse.reshape(b * h, s, 1), do.reshape(sh), scale, causal,
-            _pick_block(s, 128), _pick_block(sk, 128), interpret=False)
-        return (dq3.reshape(b, h, s, d).astype(jnp.float32),
-                dk3.reshape(b, h, sk, d).astype(jnp.float32),
-                dv3.reshape(b, h, sk, d).astype(jnp.float32))
+            _pick_block(s, 128), _pick_block(sk, 128), interpret=False,
+            bias=bias_c)
+        out = (dq3.reshape(b, h, s, d).astype(jnp.float32),
+               dk3.reshape(b, h, sk, d).astype(jnp.float32),
+               dv3.reshape(b, h, sk, d).astype(jnp.float32))
+        return out + (db,) if want_dbias else out
     q32 = q.astype(jnp.float32)
     k32 = k_c.astype(jnp.float32)
     v32 = v_c.astype(jnp.float32)
     do32 = do.astype(jnp.float32)
     s_ = jnp.einsum("bhqd,bhkd->bhqk", q32, k32) * scale
+    if bias_c is not None:
+        s_ = s_ + bias_c.astype(jnp.float32)
     if causal:
         s_ = jnp.where(jnp.arange(sk)[None, :] > jnp.arange(s)[:, None],
                        NEG_INF, s_)
@@ -234,16 +262,13 @@ def _chunk_bwd(q, k_c, v_c, o, lse, do, delta, scale, causal, use_pallas):
     p = jnp.where(s_ <= NEG_INF / 2, 0.0, p)
     dv = jnp.einsum("bhqk,bhqd->bhkd", p, do32)
     dp = jnp.einsum("bhqd,bhkd->bhqk", do32, v32)
-    ds = p * (dp - delta) * scale
+    ds_pre = p * (dp - delta)  # dL/ds before the q·kᵀ scale chain
+    ds = ds_pre * scale
     dq = jnp.einsum("bhqk,bhkd->bhqd", ds, k32)
     dk = jnp.einsum("bhqk,bhqd->bhkd", ds, q32)
+    if want_dbias:
+        return dq, dk, dv, jnp.sum(ds_pre, axis=0)
     return dq, dk, dv
-
-
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _ring_flash(q, k, v, axis_name, causal, scale, use_pallas):
-    o, _ = _ring_flash_fwd(q, k, v, axis_name, causal, scale, use_pallas)
-    return o
 
 
 def _branch_idx(origin, my, causal):
@@ -254,18 +279,34 @@ def _branch_idx(origin, my, causal):
                      jnp.where(origin < my, jnp.int32(0), jnp.int32(2)))
 
 
-def _ring_flash_fwd(q, k, v, axis_name, causal, scale, use_pallas):
+def _bias_chunk(bias_strip, origin, sk_loc):
+    return lax.dynamic_slice_in_dim(bias_strip, origin * sk_loc, sk_loc,
+                                    axis=2)
+
+
+# One shared fwd/bwd ring implementation, parameterized by an optional
+# per-device bias STRIP — this device's Q rows against ALL global key
+# columns, shape (heads, s_loc, n * sk_loc) — sliced per ring step at the
+# chunk origin. Two thin custom_vjp entry points wrap it: the strip must
+# be an explicit custom_vjp argument when present (a closure over the T5
+# rel table would be an illegal captured tracer), and the unbiased path
+# must not carry a dummy strip (it would cost O(s²/n) memory for nothing).
+
+def _ring_fwd_impl(q, k, v, bias_strip, axis_name, causal, scale,
+                   use_pallas):
     n = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     b, h, s_loc, d = q.shape
+    sk_loc = k.shape[2]
+    has_bias = bias_strip is not None
 
-    def full_f(q, k_c, v_c):
-        return _chunk_fwd(q, k_c, v_c, scale, False, use_pallas)
+    def full_f(q, k_c, v_c, bias_c=None):
+        return _chunk_fwd(q, k_c, v_c, scale, False, use_pallas, bias_c)
 
-    def diag_f(q, k_c, v_c):
-        return _chunk_fwd(q, k_c, v_c, scale, True, use_pallas)
+    def diag_f(q, k_c, v_c, bias_c=None):
+        return _chunk_fwd(q, k_c, v_c, scale, True, use_pallas, bias_c)
 
-    def skip_f(q, k_c, v_c):
+    def skip_f(q, k_c, v_c, bias_c=None):
         # match the compute branches' varying axes (switch unifies types)
         return (_vary_like_inputs(jnp.zeros_like(q), q, k_c),
                 _vary_like_inputs(
@@ -274,8 +315,11 @@ def _ring_flash_fwd(q, k, v, axis_name, causal, scale, use_pallas):
     def step(carry, t):
         k_c, v_c, o_bar, lse_run = carry
         origin = (my - t) % n
+        args = (q, k_c, v_c)
+        if has_bias:
+            args += (_bias_chunk(bias_strip, origin, sk_loc),)
         o_c, lse_c = lax.switch(_branch_idx(origin, my, causal),
-                                (full_f, diag_f, skip_f), q, k_c, v_c)
+                                (full_f, diag_f, skip_f), *args)
         lse_new = jnp.logaddexp(lse_run, lse_c)
         w_old = jnp.exp(lse_run - lse_new)[..., None]
         w_new = jnp.exp(lse_c - lse_new)[..., None]
@@ -289,59 +333,127 @@ def _ring_flash_fwd(q, k, v, axis_name, causal, scale, use_pallas):
     lse0 = _vary_like_inputs(jnp.full((b, h, s_loc), NEG_INF, jnp.float32),
                              q, k, extra=(axis_name,))
     (_, _, o_bar, lse), _ = lax.scan(step, (k, v, o0, lse0), jnp.arange(n))
-    o = o_bar.astype(q.dtype)
-    return o, (q, k, v, o, lse)
+    return o_bar.astype(q.dtype), lse
 
 
-def _ring_flash_bwd(axis_name, causal, scale, use_pallas, res, do):
-    q, k, v, o, lse = res
+def _ring_bwd_impl(q, k, v, bias_strip, o, lse, do, axis_name, causal,
+                   scale, use_pallas):
+    """-> (dq, dk, dv[, dbias_strip]) — the last only when biased."""
     n = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     b, h, s_loc, d = q.shape
+    sk_loc = k.shape[2]
+    has_bias = bias_strip is not None
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1, keepdims=True)
 
-    def full_f(q, k_c, v_c):
+    def full_f(q, k_c, v_c, bias_c=None):
         return _chunk_bwd(q, k_c, v_c, o, lse, do, delta, scale, False,
-                          use_pallas)
+                          use_pallas, bias_c, want_dbias=has_bias)
 
-    def diag_f(q, k_c, v_c):
+    def diag_f(q, k_c, v_c, bias_c=None):
         return _chunk_bwd(q, k_c, v_c, o, lse, do, delta, scale, True,
-                          use_pallas)
+                          use_pallas, bias_c, want_dbias=has_bias)
 
-    def skip_f(q, k_c, v_c):
+    def skip_f(q, k_c, v_c, bias_c=None):
         zq = _vary_like_inputs(jnp.zeros((b, h, s_loc, d), jnp.float32),
                                q, k_c, do)
         zk = _vary_like_inputs(
-            jnp.zeros((b, h, k_c.shape[2], d), jnp.float32), q, k_c, do)
-        return zq, zk, zk
+            jnp.zeros((b, h, sk_loc, d), jnp.float32), q, k_c, do)
+        if not has_bias:
+            return zq, zk, zk
+        zb = _vary_like_inputs(
+            jnp.zeros((h, s_loc, sk_loc), jnp.float32), q, k_c, do)
+        return zq, zk, zk, zb
 
     def step(carry, t):
-        k_c, v_c, dq_acc, dk_acc, dv_acc = carry
+        if has_bias:
+            k_c, v_c, dq_acc, dk_acc, dv_acc, db_strip = carry
+        else:
+            k_c, v_c, dq_acc, dk_acc, dv_acc = carry
         origin = (my - t) % n
-        dq_c, dk_c, dv_c = lax.switch(_branch_idx(origin, my, causal),
-                                      (full_f, diag_f, skip_f), q, k_c, v_c)
-        dq_acc = dq_acc + dq_c
+        args = (q, k_c, v_c)
+        if has_bias:
+            args += (_bias_chunk(bias_strip, origin, sk_loc),)
+        out = lax.switch(_branch_idx(origin, my, causal),
+                         (full_f, diag_f, skip_f), *args)
+        dq_acc = dq_acc + out[0]
+        if has_bias:
+            # each origin is visited exactly once, so the strip columns
+            # are written once (zeros elsewhere)
+            db_strip = lax.dynamic_update_slice_in_dim(
+                db_strip, out[3].astype(jnp.float32), origin * sk_loc,
+                axis=2)
         # dk/dv accumulators ride the same rotation as their K/V chunk, so
         # after n steps each lands back on its owner fully accumulated
-        dk_acc = lax.ppermute(dk_acc + dk_c, axis_name, _ring_perm(n))
-        dv_acc = lax.ppermute(dv_acc + dv_c, axis_name, _ring_perm(n))
+        dk_acc = lax.ppermute(dk_acc + out[1], axis_name, _ring_perm(n))
+        dv_acc = lax.ppermute(dv_acc + out[2], axis_name, _ring_perm(n))
         k_c = lax.ppermute(k_c, axis_name, _ring_perm(n))
         v_c = lax.ppermute(v_c, axis_name, _ring_perm(n))
-        return (k_c, v_c, dq_acc, dk_acc, dv_acc), None
+        new = (k_c, v_c, dq_acc, dk_acc, dv_acc)
+        return (new + (db_strip,) if has_bias else new), None
 
     def z0(seq_len):
         return _vary_like_inputs(
             jnp.zeros((b, h, seq_len, d), jnp.float32),
             q, k, do, extra=(axis_name,))
 
-    sk_loc = k.shape[2]
-    (_, _, dq, dk, dv), _ = lax.scan(
-        step, (k, v, z0(s_loc), z0(sk_loc), z0(sk_loc)), jnp.arange(n))
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    carry0 = (k, v, z0(s_loc), z0(sk_loc), z0(sk_loc))
+    if has_bias:
+        carry0 += (_vary_like_inputs(
+            jnp.zeros((h, s_loc, n * sk_loc), jnp.float32),
+            q, k, do, extra=(axis_name,)),)
+    carry, _ = lax.scan(step, carry0, jnp.arange(n))
+    dq, dk, dv = carry[2], carry[3], carry[4]
+    out = (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype))
+    if has_bias:
+        out += (carry[5].astype(bias_strip.dtype),)
+    return out
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _ring_flash(q, k, v, axis_name, causal, scale, use_pallas):
+    o, _ = _ring_flash_fwd(q, k, v, axis_name, causal, scale, use_pallas)
+    return o
+
+
+def _ring_flash_fwd(q, k, v, axis_name, causal, scale, use_pallas):
+    o, lse = _ring_fwd_impl(q, k, v, None, axis_name, causal, scale,
+                            use_pallas)
+    return o, (q, k, v, o, lse)
+
+
+def _ring_flash_bwd(axis_name, causal, scale, use_pallas, res, do):
+    q, k, v, o, lse = res
+    return _ring_bwd_impl(q, k, v, None, o, lse, do, axis_name, causal,
+                          scale, use_pallas)
 
 
 _ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _ring_flash_biased(q, k, v, bias_strip, axis_name, causal, scale,
+                       use_pallas):
+    o, _ = _ring_flash_biased_fwd(q, k, v, bias_strip, axis_name, causal,
+                                  scale, use_pallas)
+    return o
+
+
+def _ring_flash_biased_fwd(q, k, v, bias_strip, axis_name, causal, scale,
+                           use_pallas):
+    o, lse = _ring_fwd_impl(q, k, v, bias_strip, axis_name, causal, scale,
+                            use_pallas)
+    return o, (q, k, v, bias_strip, o, lse)
+
+
+def _ring_flash_biased_bwd(axis_name, causal, scale, use_pallas, res, do):
+    q, k, v, bias_strip, o, lse = res
+    return _ring_bwd_impl(q, k, v, bias_strip, o, lse, do, axis_name,
+                          causal, scale, use_pallas)
+
+
+_ring_flash_biased.defvjp(_ring_flash_biased_fwd, _ring_flash_biased_bwd)
 
 
 def ulysses_attention(

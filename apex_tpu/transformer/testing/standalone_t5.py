@@ -161,14 +161,19 @@ def _rel_pos_bucket(rel, *, bidirectional: bool, num_buckets: int,
     return ret + jnp.where(is_small, rel, val_large)
 
 
-def t5_relative_bias(table_local, sq: int, sk: int, *, bidirectional: bool,
-                     cfg: T5Config):
+def t5_relative_bias(table_local, sq: int | None = None,
+                     sk: int | None = None, *, bidirectional: bool,
+                     cfg: T5Config, qpos=None, kpos=None):
     """(heads_local, sq, sk) fp32 additive logit bias from the local
     (buckets, heads_local) table shard — feeds ``flash_attention(bias=)``.
     Inside shard_map the table param is already the TP head shard, so each
-    rank builds exactly its own heads' bias."""
-    qpos = jnp.arange(sq, dtype=jnp.int32)
-    kpos = jnp.arange(sk, dtype=jnp.int32)
+    rank builds exactly its own heads' bias. Pass explicit ``qpos``/
+    ``kpos`` (global position arrays) instead of ``sq``/``sk`` to build a
+    ring-SP strip — this device's Q rows against all global key columns."""
+    if qpos is None:
+        qpos = jnp.arange(sq, dtype=jnp.int32)
+    if kpos is None:
+        kpos = jnp.arange(sk, dtype=jnp.int32)
     buckets = _rel_pos_bucket(
         kpos[None, :] - qpos[:, None], bidirectional=bidirectional,
         num_buckets=cfg.rel_pos_buckets,
@@ -349,15 +354,12 @@ def _attn_core(q, k, v, cfg: T5Config, causal: bool, dropout_key,
                 "attention dropout under sequence parallelism needs "
                 "position-consistent masks across ring steps; disable "
                 "attention_dropout with sp > 1")
-        if bias is not None:
-            raise NotImplementedError(
-                "relative position bias under ring sequence parallelism "
-                "needs per-ring-step bias slices; use megatron_sp (full "
-                "sequence inside attention) with "
-                "relative_position_bias=True")
         from apex_tpu.transformer.sequence_parallel import ring_attention
 
-        return ring_attention(q, k, v, causal=causal)
+        # bias here is the ring STRIP (heads_local, s_loc, sp*s_loc) built
+        # from global positions by t5_encode/t5_decode; each ring step
+        # slices the arriving chunk's columns
+        return ring_attention(q, k, v, causal=causal, bias_strip=bias)
     if rate > 0.0:
         from apex_tpu.transformer.tensor_parallel.random import (
             model_parallel_key,
@@ -528,6 +530,37 @@ def _embed(embed, tokens, pos_table, megatron_sp: bool = False):
     return h + pos[None, :, :].astype(h.dtype)
 
 
+def _match_vma(x, ref):
+    """pcast ``x`` to also vary over ``ref``'s manual axes — a bias passed
+    into the layer scan must start with the varying-axis set its cotangent
+    will come back with (dp via the attention inputs), or the transposed
+    scan's carry check trips."""
+    try:
+        want = set(jax.typeof(ref).vma)
+        missing = tuple(a for a in want if a not in jax.typeof(x).vma)
+    except (AttributeError, TypeError):
+        return x
+    return lax.pcast(x, missing, to="varying") if missing else x
+
+
+def _rel_or_strip(table_local, s_tok: int, *, bidirectional: bool,
+                  cfg: T5Config):
+    """Build the layer-shared rel bias once per stack: the square
+    (hl, s, s) bias at sp == 1 (``s_tok`` is the full sequence there —
+    Megatron-SP scatters inside the TP layers), or the ring STRIP
+    (hl, s_loc, sp*s_loc) from this shard's global positions at sp > 1
+    (``s_tok`` is the local shard)."""
+    sp = _sp_size()
+    if sp == 1:
+        return t5_relative_bias(table_local, s_tok, s_tok,
+                                bidirectional=bidirectional, cfg=cfg)
+    my = lax.axis_index(SP_AXIS)
+    qpos = my * s_tok + jnp.arange(s_tok, dtype=jnp.int32)
+    kpos = jnp.arange(sp * s_tok, dtype=jnp.int32)
+    return t5_relative_bias(table_local, bidirectional=bidirectional,
+                            cfg=cfg, qpos=qpos, kpos=kpos)
+
+
 def t5_encode(params, enc_tokens, cfg: T5Config, dropout_key=None):
     rel_on = cfg.relative_position_bias
     x = _embed(params["embed"], enc_tokens,
@@ -536,9 +569,9 @@ def t5_encode(params, enc_tokens, cfg: T5Config, dropout_key=None):
     x = _maybe_hidden_dropout(
         x, cfg, None if dropout_key is None
         else jax.random.fold_in(dropout_key, 100), 0)
-    s = enc_tokens.shape[1]  # full sequence (megatron_sp scatters inside)
-    rel = (t5_relative_bias(params["embed"]["rel_enc"], s, s,
-                            bidirectional=True, cfg=cfg)
+    rel = (_match_vma(_rel_or_strip(params["embed"]["rel_enc"],
+                                    enc_tokens.shape[1],
+                                    bidirectional=True, cfg=cfg), x)
            if rel_on else None)
     return _scan_layers(
         lambda lp, h, rel_bias, c, dropout_key=None: enc_layer_fn(
@@ -554,9 +587,9 @@ def t5_decode(params, dec_tokens, mem, cfg: T5Config, dropout_key=None):
     x = _maybe_hidden_dropout(
         x, cfg, None if dropout_key is None
         else jax.random.fold_in(dropout_key, 101), 0)
-    s = dec_tokens.shape[1]
-    rel = (t5_relative_bias(params["embed"]["rel_dec"], s, s,
-                            bidirectional=False, cfg=cfg)
+    rel = (_match_vma(_rel_or_strip(params["embed"]["rel_dec"],
+                                    dec_tokens.shape[1],
+                                    bidirectional=False, cfg=cfg), x)
            if rel_on else None)
     return _scan_layers(
         lambda lp, h, m, rel_bias, c, dropout_key=None: dec_layer_fn(
@@ -672,8 +705,8 @@ def t5_enc_dec_spec(cfg: T5Config) -> EncDecPipelineSpec:
         if rel_on:
             s = h.shape[1] * (lax.axis_size(TP_AXIS) if cfg.megatron_sp
                               else 1)
-            rel = t5_relative_bias(stage_params["rel"], s, s,
-                                   bidirectional=True, cfg=cfg)
+            rel = _match_vma(_rel_or_strip(stage_params["rel"], s,
+                                           bidirectional=True, cfg=cfg), h)
             return _scan_layers(
                 lambda lp, x, rb, c, dropout_key=None: enc_layer_fn(
                     lp, x, c, rel_bias=rb),
@@ -690,8 +723,8 @@ def t5_enc_dec_spec(cfg: T5Config) -> EncDecPipelineSpec:
         if rel_on:
             s = h.shape[1] * (lax.axis_size(TP_AXIS) if cfg.megatron_sp
                               else 1)
-            rel = t5_relative_bias(stage_params["rel"], s, s,
-                                   bidirectional=False, cfg=cfg)
+            rel = _match_vma(_rel_or_strip(stage_params["rel"], s,
+                                           bidirectional=False, cfg=cfg), h)
             return _scan_layers(
                 lambda lp, x, m, rb, c, dropout_key=None: dec_layer_fn(
                     lp, x, m, c, rel_bias=rb),

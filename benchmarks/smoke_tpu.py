@@ -91,6 +91,28 @@ def _results():
 
     record("flash_attention_inkernel_dropout", dropout_determinism, tol=0.0)
 
+    def bias_fwd_bwd():
+        # T5 relative-position-bias contract: batch-shared (h, sq, sk)
+        # additive logit bias, grads for q/k/v AND the bias (the
+        # batch-reducing dbias kernel) vs the XLA reference
+        bias = jax.random.normal(jax.random.fold_in(k, 9), (h, s, s))
+
+        def loss(q, kk, v, bias):
+            return jnp.sum(flash_attention(q, kk, v, causal=True,
+                                           use_pallas=force, bias=bias) ** 2)
+
+        def loss_ref(q, kk, v, bias):
+            return jnp.sum(attention_reference(q, kk, v, causal=True,
+                                               bias=bias) ** 2)
+
+        g = jax.jit(jax.grad(loss, argnums=(0, 1, 2, 3)))(q, kk, v, bias)
+        gr = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2, 3)))(q, kk, v, bias)
+        jax.block_until_ready(g)
+        return max(float(jnp.max(jnp.abs(a - b_) / (jnp.abs(b_) + 1e-3)))
+                   for a, b_ in zip(g, gr))
+
+    record("flash_attention_additive_bias", bias_fwd_bwd)
+
     from apex_tpu.ops.attention_varlen import (
         attention_varlen_reference,
         flash_attention_varlen,
