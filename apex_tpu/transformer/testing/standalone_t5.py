@@ -15,11 +15,13 @@ rectangular ``s_dec × s_enc`` for cross-attn), pre-LN residual blocks.
 Position scheme: learned absolute positions by default, or T5's real
 bucketed relative position biases with ``relative_position_bias=True``
 (bias added to the logits inside the flash kernel — encoder bidirectional,
-decoder causal, none on cross-attention, per-stack tables). Remaining
-simplification vs T5-the-paper, documented not hidden: no encoder-final
-LayerNorm (the memory leaves the last encoder stage un-normalized so the
-pipeline ring stays shape-uniform; decoder cross-attention learns the
-scale).
+decoder causal, none on cross-attention, per-stack tables; rides ring SP
+via per-shard bias strips). ``encoder_final_ln=True`` restores T5's
+encoder-exit LayerNorm, applied equivalently at the decoder's memory
+consumption so the enc pipeline ring keeps its uniform stage function.
+With both flags on the fixture is architecturally T5-the-paper (modulo
+LayerNorm-with-bias vs T5's bias-free RMSNorm, a config choice the
+normalization module supports either way).
 
 Pipeline wiring: :func:`t5_enc_dec_spec` + :func:`t5_pipeline_params`
 feed ``schedules.fwd_bwd_enc_dec`` — encoder ring over all pp stages,
@@ -93,6 +95,13 @@ class T5Config:
     relative_position_bias: bool = False
     rel_pos_buckets: int = 32
     rel_pos_max_distance: int = 128
+    # T5's encoder-final LayerNorm (opt-in). Applied to the memory at the
+    # point of decoder consumption rather than inside the encoder ring:
+    # every decoder layer reads the same broadcast memory, so normalizing
+    # it once before the decoder stack is EXACTLY the paper's
+    # normalize-at-encoder-exit — while the enc pipeline ring keeps its
+    # uniform stage function (the reason the trim existed).
+    encoder_final_ln: bool = False
 
     @property
     def ffn_hidden(self) -> int:
@@ -258,6 +267,9 @@ def init_t5_params(rng, cfg: T5Config) -> Pytree:
         "tok": (jax.random.normal(ke, (cfg.vocab_size, cfg.hidden))
                 * 0.02).astype(dt),
     }
+    if cfg.encoder_final_ln:
+        embed["enc_ln_w"] = jnp.ones((cfg.hidden,), dt)
+        embed["enc_ln_b"] = jnp.zeros((cfg.hidden,), dt)
     if cfg.relative_position_bias:
         # T5 proper: no absolute positions; one rel-bias table per stack
         embed.update(_init_rel_tables(jax.random.fold_in(ke, 3), cfg))
@@ -306,6 +318,9 @@ def t5_param_specs(cfg: T5Config, extra_layer_lead=()) -> Pytree:
     dec_keys = enc_keys + ("q_kernel", "q_bias", "kv_kernel", "kv_bias",
                            "xout_kernel", "xout_bias", "ln3_w", "ln3_b")
     embed = {"tok": P(TP_AXIS, None)}
+    if cfg.encoder_final_ln:
+        embed["enc_ln_w"] = P()
+        embed["enc_ln_b"] = P()
     if cfg.relative_position_bias:
         # heads axis TP-split: each rank holds its own heads' bias columns
         embed["rel_enc"] = P(None, TP_AXIS)
@@ -534,13 +549,11 @@ def _match_vma(x, ref):
     """pcast ``x`` to also vary over ``ref``'s manual axes — a bias passed
     into the layer scan must start with the varying-axis set its cotangent
     will come back with (dp via the attention inputs), or the transposed
-    scan's carry check trips."""
-    try:
-        want = set(jax.typeof(ref).vma)
-        missing = tuple(a for a in want if a not in jax.typeof(x).vma)
-    except (AttributeError, TypeError):
-        return x
-    return lax.pcast(x, missing, to="varying") if missing else x
+    scan's carry check trips. Thin alias over the ring module's helper so
+    the vma-alignment logic lives in one place."""
+    from apex_tpu.transformer.sequence_parallel import _vary_like_inputs
+
+    return _vary_like_inputs(x, ref)
 
 
 def _rel_or_strip(table_local, s_tok: int, *, bidirectional: bool,
@@ -581,6 +594,11 @@ def t5_encode(params, enc_tokens, cfg: T5Config, dropout_key=None):
 
 def t5_decode(params, dec_tokens, mem, cfg: T5Config, dropout_key=None):
     rel_on = cfg.relative_position_bias
+    if cfg.encoder_final_ln:
+        # normalize the memory at the point of consumption — exactly the
+        # paper's encoder-exit LayerNorm (see T5Config.encoder_final_ln)
+        mem = layer_norm(mem, params["embed"]["enc_ln_w"],
+                         params["embed"]["enc_ln_b"])
     x = _embed(params["embed"], dec_tokens,
                None if rel_on else params["embed"]["pos_dec"],
                cfg.megatron_sp)
@@ -653,19 +671,27 @@ def t5_pipeline_params(rng, cfg: T5Config, pp: int) -> Pytree:
     dec_stages = jax.tree.map(
         lambda a: regroup(a, cfg.dec_layers), p["dec_layers"])
     embed = p["embed"]
+    # stage functions can't reach the embed group, so stage-consumed
+    # extras (rel tables, the encoder-final LN) become per-stage copies
+    # (initialized equal) — the same untying the pipeline fixture applies
+    # to the LM head: exact forward parity with the sequential model,
+    # per-stage gradients. The embed copies are dropped (they would sit
+    # in optimizer state and checkpoints as frozen dead weights).
+    tile = lambda a: jnp.broadcast_to(  # noqa: E731
+        a[None], (pp,) + a.shape).copy()
+    drop = []
     if cfg.relative_position_bias:
-        # stage functions can't reach the embed group, so each stage gets
-        # its own copy of its stack's rel table (initialized equal) — the
-        # same untying the pipeline fixture applies to the LM head: exact
-        # forward parity with the sequential model, per-stage gradients.
-        # The embed copies are dropped (they would sit in optimizer state
-        # and checkpoints as frozen dead weights).
-        tile = lambda a: jnp.broadcast_to(  # noqa: E731
-            a[None], (pp,) + a.shape).copy()
         enc_stages = {"layers": enc_stages, "rel": tile(embed["rel_enc"])}
         dec_stages = {"layers": dec_stages, "rel": tile(embed["rel_dec"])}
-        embed = {k: v for k, v in embed.items()
-                 if k not in ("rel_enc", "rel_dec")}
+        drop += ["rel_enc", "rel_dec"]
+    if cfg.encoder_final_ln:
+        if not cfg.relative_position_bias:  # not already {"layers", ...}
+            dec_stages = {"layers": dec_stages}
+        dec_stages["enc_ln_w"] = tile(embed["enc_ln_w"])
+        dec_stages["enc_ln_b"] = tile(embed["enc_ln_b"])
+        drop += ["enc_ln_w", "enc_ln_b"]
+    if drop:
+        embed = {k: v for k, v in embed.items() if k not in drop}
     return {
         "embed": embed,
         "enc_stages": enc_stages,
@@ -680,12 +706,20 @@ def t5_pipeline_specs_tree(cfg: T5Config) -> Pytree:
     head["lm_rows"] = P(TP_AXIS, None)
     enc_stages, dec_stages = specs["enc_layers"], specs["dec_layers"]
     embed = specs["embed"]
+    drop = []
     if cfg.relative_position_bias:
         rel_spec = P(PP_AXIS, None, TP_AXIS)
         enc_stages = {"layers": enc_stages, "rel": rel_spec}
         dec_stages = {"layers": dec_stages, "rel": rel_spec}
-        embed = {k: v for k, v in embed.items()
-                 if k not in ("rel_enc", "rel_dec")}
+        drop += ["rel_enc", "rel_dec"]
+    if cfg.encoder_final_ln:
+        if not cfg.relative_position_bias:  # not already {"layers", ...}
+            dec_stages = {"layers": dec_stages}
+        dec_stages["enc_ln_w"] = P(PP_AXIS, None)
+        dec_stages["enc_ln_b"] = P(PP_AXIS, None)
+        drop += ["enc_ln_w", "enc_ln_b"]
+    if drop:
+        embed = {k: v for k, v in embed.items() if k not in drop}
     return {
         "embed": embed,
         "enc_stages": enc_stages,
@@ -720,6 +754,12 @@ def t5_enc_dec_spec(cfg: T5Config) -> EncDecPipelineSpec:
                       None if rel_on else embed["pos_dec"], cfg.megatron_sp)
 
     def dec_stage_fn(stage_params, h, mem):
+        if cfg.encoder_final_ln:
+            # every stage normalizes the same broadcast memory with its
+            # copy of the encoder-final LN — identical to normalizing
+            # once at encoder exit (see T5Config.encoder_final_ln)
+            mem = layer_norm(mem, stage_params["enc_ln_w"],
+                             stage_params["enc_ln_b"])
         if rel_on:
             s = h.shape[1] * (lax.axis_size(TP_AXIS) if cfg.megatron_sp
                               else 1)
@@ -729,9 +769,11 @@ def t5_enc_dec_spec(cfg: T5Config) -> EncDecPipelineSpec:
                 lambda lp, x, m, rb, c, dropout_key=None: dec_layer_fn(
                     lp, x, m, c, rel_bias=rb),
                 stage_params["layers"], h, cfg, mem, rel)
+        layers = (stage_params["layers"] if cfg.encoder_final_ln
+                  else stage_params)
         return _scan_layers(
             lambda lp, x, m, c, dropout_key=None: dec_layer_fn(lp, x, m, c),
-            stage_params, h, cfg, mem)
+            layers, h, cfg, mem)
 
     def loss_fn(head, h, targets):
         # per-microbatch mean vocab-parallel CE over the untied head rows

@@ -53,6 +53,13 @@ def parse_args(argv=None):
     p.add_argument("--tp", type=int, default=1)
     p.add_argument("--megatron-sp", action="store_true",
                    help="sequence-sharded activation regions over tp")
+    p.add_argument("--relative-position-bias", action="store_true",
+                   help="T5's bucketed relative position biases (in-kernel "
+                        "flash-attention bias path) instead of learned "
+                        "absolute positions")
+    p.add_argument("--encoder-final-ln", action="store_true",
+                   help="T5's encoder-exit LayerNorm (applied at decoder "
+                        "memory consumption)")
     p.add_argument("--microbatches", type=int, default=2)
     p.add_argument("--batch", type=int, default=0,
                    help="global batch (0 = 2 * dp * microbatches)")
@@ -80,7 +87,9 @@ def main(argv=None):
                    enc_layers=args.enc_layers, dec_layers=args.dec_layers,
                    max_seq_enc=args.seq_enc, max_seq_dec=args.seq_dec,
                    dtype=jnp.float32, fused_loss=False,
-                   megatron_sp=args.megatron_sp)
+                   megatron_sp=args.megatron_sp,
+                   relative_position_bias=args.relative_position_bias,
+                   encoder_final_ln=args.encoder_final_ln)
     cfg.validate(tp=args.tp)
     params = t5_pipeline_params(jax.random.PRNGKey(0), cfg, pp=args.pp)
     spec = t5_enc_dec_spec(cfg)

@@ -409,3 +409,39 @@ def test_t5_relbias_ring_sp_matches_dense():
         np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-5), g1, g0)
     for k in ("rel_enc", "rel_dec"):
         assert float(jnp.vdot(g1["embed"][k], g1["embed"][k])) > 0
+
+
+def test_t5_encoder_final_ln_pipeline_matches_sequential():
+    """encoder_final_ln: normalizing the broadcast memory in every decoder
+    stage (per-stage LN copies) == the sequential encoder-exit LayerNorm;
+    the sequential LN grad equals the sum of the per-stage copies' grads,
+    and the LN actually changes the function."""
+    cfg = dataclasses.replace(CFG, encoder_final_ln=True)
+    pp = 2
+    mesh = parallel_state.initialize_model_parallel(
+        pipeline_model_parallel_size_=pp,
+        pipeline_model_parallel_split_rank_=1,
+    )
+    spec = t5_enc_dec_spec(cfg)
+    params = t5_pipeline_params(jax.random.PRNGKey(0), cfg, pp=pp)
+    assert "enc_ln_w" not in params["embed"]  # untied into dec stages
+    enc_tok, dec_tok, tgt = _batch(jax.random.PRNGKey(1), b=16)
+
+    loss, grads = jax.jit(lambda p: forward_backward_pipelining_enc_dec(
+        spec, p, (enc_tok, dec_tok, tgt), num_microbatches=4,
+        mesh=mesh, params_specs=t5_pipeline_specs_tree(cfg)))(params)
+
+    flat_params = init_t5_params(jax.random.PRNGKey(0), cfg)
+    ref_loss, ref_grads = _loss_and_grads(
+        build_mesh(tp=1), cfg, flat_params, (enc_tok, dec_tok, tgt))
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    for k in ("enc_ln_w", "enc_ln_b"):
+        np.testing.assert_allclose(
+            np.asarray(grads["dec_stages"][k]).sum(0),
+            np.asarray(ref_grads["embed"][k]), rtol=2e-3, atol=1e-5)
+
+    # the LN must reach the function: plain CFG differs
+    plain_loss, _ = _loss_and_grads(
+        build_mesh(tp=1), CFG, init_t5_params(jax.random.PRNGKey(0), CFG),
+        (enc_tok, dec_tok, tgt))
+    assert float(ref_loss) != float(plain_loss)
