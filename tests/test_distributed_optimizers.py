@@ -41,12 +41,12 @@ def test_dist_adam_matches_fused_adam():
         assert state.mu["w"].shape == (12,)  # ceil(91/8)
         return p
 
-    got = jax.shard_map(
+    got = jax.jit(jax.shard_map(
         run, mesh=mesh,
         in_specs=(jax.tree.map(lambda _: P(), params),) * 2,
         out_specs=jax.tree.map(lambda _: P(), params),
         check_vma=False,  # replicated-by-construction all-gather output
-    )(params, grads)
+    ))(params, grads)
 
     ref_opt = FusedAdam(lr=1e-2, weight_decay=0.01)
     ref_state = ref_opt.init(params)
@@ -75,12 +75,12 @@ def test_dist_adam_sums_grads_over_dp():
         p, state = opt.step(g, state, p)
         return p
 
-    got = jax.shard_map(
+    got = jax.jit(jax.shard_map(
         run, mesh=mesh,
         in_specs=({"w": P()}, {"w": P("dp")}),
         out_specs={"w": P()},
         check_vma=False,
-    )(params, {"w": per_rank_g})
+    ))(params, {"w": per_rank_g})
     # beta1=0: update direction = sign-ish mhat/sqrt(vhat); with identical
     # entries everywhere the update must be identical too — and nonzero
     v = np.asarray(got["w"])
@@ -100,12 +100,12 @@ def test_dist_lamb_matches_fused_lamb():
             p, state = opt.step(g, state, p)
         return p
 
-    got = jax.shard_map(
+    got = jax.jit(jax.shard_map(
         run, mesh=mesh,
         in_specs=(jax.tree.map(lambda _: P(), params),) * 2,
         out_specs=jax.tree.map(lambda _: P(), params),
         check_vma=False,
-    )(params, grads)
+    ))(params, grads)
 
     ref_opt = FusedLAMB(lr=1e-2, weight_decay=0.01, max_grad_norm=0.0)
     ref_state = ref_opt.init(params)
@@ -129,10 +129,10 @@ def test_dist_adam_grad_clipping_and_scale():
         p2, _ = opt.step(g, state, p, scale=jnp.asarray(2.0))
         return p2
 
-    got = jax.shard_map(
+    got = jax.jit(jax.shard_map(
         run, mesh=mesh, in_specs=({"w": P()}, {"w": P()}),
         out_specs={"w": P()}, check_vma=False,
-    )(params, big)
+    ))(params, big)
     # huge grads clipped to norm 1 -> bounded first step
     delta = np.abs(np.asarray(got["w"]) - 1.0).max()
     assert 0 < delta < 0.05
@@ -155,13 +155,13 @@ def test_dist_adam_e5m2_allgather():
                 p, state = opt.step(g, state, p)
             return p, state.master
 
-        return jax.shard_map(
+        return jax.jit(jax.shard_map(
             body, mesh=mesh,
             in_specs=(jax.tree.map(lambda _: P(), params),) * 2,
             out_specs=(jax.tree.map(lambda _: P(), params),
                        jax.tree.map(lambda _: P("dp"), params)),
             check_vma=False,
-        )(params, grads)
+        ))(params, grads)
 
     p_c, m_c = run(True)
     p_u, m_u = run(False)

@@ -125,7 +125,7 @@ def _sequential_reference(spec, params, batch, num_microbatches, pp):
             jax.vmap(one_mb)(split(enc_inputs), split(dec_inputs), split(targets))
         )
 
-    return jax.value_and_grad(loss_of)(params)
+    return jax.jit(jax.value_and_grad(loss_of))(params)
 
 
 @pytest.mark.parametrize("pp,M", [
@@ -142,9 +142,8 @@ def test_enc_dec_pipeline_matches_sequential(pp, M):
     params = _params(jax.random.PRNGKey(0), pp)
     batch = _batch(jax.random.PRNGKey(1))
 
-    loss, grads = forward_backward_pipelining_enc_dec(
-        spec, params, batch, num_microbatches=M, mesh=mesh
-    )
+    loss, grads = jax.jit(lambda p: forward_backward_pipelining_enc_dec(
+        spec, p, batch, num_microbatches=M, mesh=mesh))(params)
     ref_loss, ref_grads = _sequential_reference(spec, params, batch, M, pp)
 
     np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
@@ -165,9 +164,9 @@ def test_enc_dec_dispatch_through_uniform_driver():
     spec = _spec()
     params = _params(jax.random.PRNGKey(0), 2)
     batch = _batch(jax.random.PRNGKey(1))
-    loss, _ = forward_backward_pipelining_without_interleaving(
-        spec, params, batch, num_microbatches=4, mesh=mesh
-    )
+    loss, _ = jax.jit(
+        lambda p: forward_backward_pipelining_without_interleaving(
+            spec, p, batch, num_microbatches=4, mesh=mesh))(params)
     ref_loss, _ = _sequential_reference(spec, params, batch, 4, 2)
     np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
 
@@ -190,13 +189,12 @@ def test_loss_scale_scales_grads_only():
     spec = _spec()
     params = _params(jax.random.PRNGKey(0), 2)
     batch = _batch(jax.random.PRNGKey(1))
-    loss1, g1 = forward_backward_pipelining_enc_dec(
-        spec, params, batch, num_microbatches=4, mesh=mesh
-    )
-    loss2, g2 = forward_backward_pipelining_enc_dec(
-        spec, params, batch, num_microbatches=4, mesh=mesh,
-        loss_scale=jnp.float32(64.0),
-    )
+    loss1, g1 = jax.jit(lambda p: forward_backward_pipelining_enc_dec(
+        spec, p, batch, num_microbatches=4, mesh=mesh))(params)
+    loss2, g2 = jax.jit(
+        lambda p, s: forward_backward_pipelining_enc_dec(
+            spec, p, batch, num_microbatches=4, mesh=mesh, loss_scale=s))(
+        params, jnp.float32(64.0))
     np.testing.assert_allclose(float(loss1), float(loss2), rtol=1e-6)
     jax.tree.map(
         lambda a, b: np.testing.assert_allclose(

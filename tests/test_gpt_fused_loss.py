@@ -37,7 +37,10 @@ def _loss_and_grads(cfg, tp=1):
         return jax.shard_map(body, mesh=mesh, in_specs=(specs, P(), P()),
                              out_specs=P())(p, tok, tgt)
 
-    return jax.value_and_grad(loss_fn)(params)
+    # jit the whole grad program: eager shard_map dispatches op-by-op
+    # through the 8-device SPMD interpreter (~30x slower on this box) and
+    # never hits the persistent compile cache
+    return jax.jit(jax.value_and_grad(loss_fn))(params)
 
 
 def _assert_tree_close(a, b, rtol, atol):

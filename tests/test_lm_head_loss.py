@@ -39,8 +39,8 @@ def test_fused_matches_dense_and_grads(n, v, h, bn, bv):
     def dense(x2, w):
         return jnp.mean(_dense_loss(x2, w, t))
 
-    lf, (dxf, dwf) = jax.value_and_grad(fused, argnums=(0, 1))(x2, w)
-    ld, (dxd, dwd) = jax.value_and_grad(dense, argnums=(0, 1))(x2, w)
+    lf, (dxf, dwf) = jax.jit(jax.value_and_grad(fused, argnums=(0, 1)))(x2, w)
+    ld, (dxd, dwd) = jax.jit(jax.value_and_grad(dense, argnums=(0, 1)))(x2, w)
     np.testing.assert_allclose(lf, ld, rtol=1e-5, atol=1e-5)
     np.testing.assert_allclose(dxf, dxd, rtol=1e-4, atol=1e-5)
     np.testing.assert_allclose(dwf, dwd, rtol=1e-4, atol=1e-5)
@@ -86,8 +86,8 @@ def test_vocab_parallel_fused_matches_dense():
     def dense(x, w):
         return jnp.mean(_dense_loss(x, w, t))
 
-    lf, (dxf, dwf) = jax.value_and_grad(sharded, argnums=(0, 1))(x, w)
-    ld, (dxd, dwd) = jax.value_and_grad(dense, argnums=(0, 1))(x, w)
+    lf, (dxf, dwf) = jax.jit(jax.value_and_grad(sharded, argnums=(0, 1)))(x, w)
+    ld, (dxd, dwd) = jax.jit(jax.value_and_grad(dense, argnums=(0, 1)))(x, w)
     np.testing.assert_allclose(lf, ld, rtol=1e-5, atol=1e-5)
     np.testing.assert_allclose(dxf, dxd, rtol=1e-4, atol=1e-5)
     np.testing.assert_allclose(dwf, dwd, rtol=1e-4, atol=1e-5)
@@ -104,7 +104,7 @@ def test_dense_impl_matches_pallas_interpret_unsharded():
     def f(impl):
         def loss(x2, w):
             return jnp.mean(_lm_head_loss(x2, w, t, None, bn, bv, impl))
-        l, grads = jax.value_and_grad(loss, argnums=(0, 1))(x2, w)
+        l, grads = jax.jit(jax.value_and_grad(loss, argnums=(0, 1)))(x2, w)
         return l, grads
 
     lp, (dxp, dwp) = f("pallas_interpret")

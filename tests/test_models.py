@@ -49,11 +49,11 @@ def _loss_on_mesh(mesh, params, tokens, targets, cfg=CFG):
         return replicate_loss(gpt_loss(p, tok, tgt, cfg), mesh,
                               masked_axis=None)
 
-    return jax.shard_map(
+    return jax.jit(jax.shard_map(
         body, mesh=mesh,
         in_specs=(gpt_param_specs(cfg), P(DP := "dp"), P(DP)),
         out_specs=P(),
-    )(params, tokens, targets)
+    ))(params, tokens, targets)
 
 
 def test_gpt_tp_matches_single_device():
@@ -188,11 +188,11 @@ def test_gpt_sequence_parallel_matches():
         return replicate_loss(gpt_loss(p, tok, tgt, CFG), mesh_sp,
                               masked_axis=None)
 
-    l_sp = jax.shard_map(
+    l_sp = jax.jit(jax.shard_map(
         body, mesh=mesh_sp,
         in_specs=(gpt_param_specs(CFG), P("dp", "sp"), P("dp", "sp")),
         out_specs=P(),
-    )(params, tokens, targets)
+    ))(params, tokens, targets)
     l_1 = _loss_on_mesh(build_mesh(tp=1, dp=8), params, tokens, targets)
     np.testing.assert_allclose(float(l_sp), float(l_1), rtol=1e-3)
 

@@ -39,9 +39,9 @@ def _run(mesh, cfg, params, x, ep_axis="dp"):
         return out, aux["loss"][None]
 
     specs = moe_param_specs(ep_axis if mesh.shape.get("dp", 1) > 1 else None)
-    return shard_map(
+    return jax.jit(shard_map(
         body, mesh=mesh, in_specs=(specs, P("dp", None, None)),
-        out_specs=(P("dp", None, None), P("dp")))(params, x)
+        out_specs=(P("dp", None, None), P("dp"))))(params, x)
 
 
 def _dense_reference(params, x, cfg):
@@ -99,10 +99,10 @@ def test_ep8_matches_ep1(mesh_dp8):
 
     mesh1 = build_mesh(tp=1, pp=1, sp=1, devices=jax.devices())
     # same per-rank token batches, experts replicated (no EP exchange)
-    out_ref = shard_map(
+    out_ref = jax.jit(shard_map(
         body_local, mesh=mesh1,
         in_specs=(moe_param_specs(None), P("dp", None, None)),
-        out_specs=P("dp", None, None))(params, x)
+        out_specs=P("dp", None, None)))(params, x)
     np.testing.assert_allclose(np.asarray(out_ep), np.asarray(out_ref),
                                rtol=1e-5, atol=1e-5)
 
@@ -158,7 +158,7 @@ def test_moe_grads_flow_and_aux_loss(mesh_dp8):
                         out_specs=P("dp"))(p, x)
         return jnp.sum(per)
 
-    grads = jax.grad(loss_fn)(params)
+    grads = jax.jit(jax.grad(loss_fn))(params)
     for path, g in jax.tree_util.tree_flatten_with_path(grads)[0]:
         a = np.asarray(g)
         assert np.all(np.isfinite(a)), f"non-finite grad at {path}"
@@ -173,9 +173,9 @@ def test_moe_grads_flow_and_aux_loss(mesh_dp8):
         _, aux = moe_mlp(p, xb, cfgu)
         return aux["lb_loss"][None]
 
-    lb = shard_map(body, mesh=mesh_dp8,
+    lb = jax.jit(shard_map(body, mesh=mesh_dp8,
                    in_specs=(moe_param_specs("dp"), P("dp", None, None)),
-                   out_specs=P("dp"))(pu, x)
+                   out_specs=P("dp")))(pu, x)
     np.testing.assert_allclose(np.asarray(lb), 1.0, rtol=1e-5)
 
 
@@ -204,9 +204,9 @@ def _pipeline_sequential_reference(cfg, params, tok, tgt, ref_mesh,
         return replicate_loss(gpt_loss(p, t, g, cfg), ref_mesh,
                               masked_axis=None)
 
-    return shard_map(body, mesh=ref_mesh,
+    return jax.jit(shard_map(body, mesh=ref_mesh,
                      in_specs=(gpt_param_specs(cfg), P("dp"), P("dp")),
-                     out_specs=P())(flat, tok, tgt)
+                     out_specs=P()))(flat, tok, tgt)
 
 
 def test_gpt_moe_single_expert_matches_dense(mesh_dp8):
@@ -253,9 +253,9 @@ def test_gpt_moe_single_expert_matches_dense(mesh_dp8):
             return replicate_loss(gpt_loss(p, t, g, cfg), mesh1,
                                   masked_axis=None)
 
-        return float(shard_map(
+        return float(jax.jit(shard_map(
             body, mesh=mesh1, in_specs=(gpt_param_specs(cfg), P(), P()),
-            out_specs=P())(params, tok, tgt))
+            out_specs=P()))(params, tok, tgt))
 
     aux_expected = MoEConfig(num_experts=1, hidden=32, ffn_hidden=128,
                              top_k=1).lb_loss_weight * 1.0
@@ -604,11 +604,11 @@ def test_moe_seq_dispatch_exact_vs_gathered(mesh_dp4_tp2):
         return out
 
     specs = moe_param_specs("dp")
-    out_plain = shard_map(
+    out_plain = jax.jit(shard_map(
         plain, mesh=mesh_dp4_tp2, in_specs=(specs, P("dp", None, None)),
-        out_specs=P("dp", None, None))(params, x)
-    out_seq = shard_map(
+        out_specs=P("dp", None, None)))(params, x)
+    out_seq = jax.jit(shard_map(
         seq_sharded, mesh=mesh_dp4_tp2, in_specs=(specs, P("dp", "tp", None)),
-        out_specs=P("dp", "tp", None))(params, x)
+        out_specs=P("dp", "tp", None)))(params, x)
     np.testing.assert_allclose(np.asarray(out_seq), np.asarray(out_plain),
                                rtol=1e-6, atol=1e-6)

@@ -32,7 +32,7 @@ def test_ddp_average_matches_full_batch_grad(mesh8):
     def step(W, x, y):
         # canonical pattern: differentiate w.r.t. per-replica params so the
         # gradients come back unreduced, then DDP does the single allreduce
-        g = jax.grad(loss)(ddp.replicate(W), x, y)
+        g = jax.jit(jax.grad(loss))(ddp.replicate(W), x, y)
         return ddp.average_gradients(g)
 
     f = shard_map(
@@ -164,8 +164,8 @@ def test_syncbn_backward_matches_full_batch(mesh8):
         y = bn1.apply(params, x, use_running_average=False)
         return jnp.sum(jnp.sin(y))
 
-    g1 = jax.grad(sharded_loss)(params, x)
-    g2 = jax.grad(full_loss)(params, x)
+    g1 = jax.jit(jax.grad(sharded_loss))(params, x)
+    g2 = jax.jit(jax.grad(full_loss))(params, x)
     np.testing.assert_allclose(
         np.asarray(g1["params"]["scale"]), np.asarray(g2["params"]["scale"]), atol=1e-4
     )

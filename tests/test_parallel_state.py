@@ -112,7 +112,7 @@ def test_params_l2_norm_tp_dedup():
         return calc_params_l2_norm(p, model_parallel_axes=("tp",),
                                    specs=specs)
 
-    norm = shard_map(body, mesh=mesh, in_specs=(specs,), out_specs=P())(
+    norm = jax.jit(shard_map(body, mesh=mesh, in_specs=(specs,), out_specs=P()))(
         params)
     np.testing.assert_allclose(float(norm), true_norm, rtol=1e-6)
 

@@ -96,7 +96,7 @@ def _chunk_order_reference(spec, params, batch, num_microbatches, pp, vp):
         ts = targets.reshape((M, nb // M) + targets.shape[1:])
         return jnp.mean(jax.vmap(one_mb)(xs, ts))
 
-    return jax.value_and_grad(loss_of)(params)
+    return jax.jit(jax.value_and_grad(loss_of))(params)
 
 
 def _flat_reference(spec, params, batch, num_microbatches, pp):
@@ -116,7 +116,7 @@ def _flat_reference(spec, params, batch, num_microbatches, pp):
         ts = targets.reshape((M, nb // M) + targets.shape[1:])
         return jnp.mean(jax.vmap(one_mb)(xs, ts))
 
-    return jax.value_and_grad(loss_of)(params)
+    return jax.jit(jax.value_and_grad(loss_of))(params)
 
 
 def _assert_tree_close(a, b, atol=1e-5):
@@ -166,9 +166,10 @@ def test_1f1b_matches_sequential(num_microbatches):
     params = _params(rng, 4)
     batch = _batch(jax.random.PRNGKey(3), b=16)
 
-    loss, grads = forward_backward_pipelining_without_interleaving(
-        spec, params, batch, num_microbatches=num_microbatches, mesh=mesh
-    )
+    loss, grads = jax.jit(
+        lambda p: forward_backward_pipelining_without_interleaving(
+            spec, p, batch, num_microbatches=num_microbatches, mesh=mesh))(
+        params)
     ref_loss, ref_g = _flat_reference(spec, params, batch, num_microbatches, 4)
     np.testing.assert_allclose(float(loss), float(ref_loss), atol=1e-5)
     _assert_tree_close(grads, ref_g)
@@ -184,9 +185,9 @@ def test_1f1b_with_dp():
     params = _params(rng, 4)
     batch = _batch(jax.random.PRNGKey(5))
 
-    loss, grads = forward_backward_pipelining_without_interleaving(
-        spec, params, batch, num_microbatches=2, mesh=mesh
-    )
+    loss, grads = jax.jit(
+        lambda p: forward_backward_pipelining_without_interleaving(
+            spec, p, batch, num_microbatches=2, mesh=mesh))(params)
     ref_loss, ref_g = _flat_reference(spec, params, batch, 2, 4)
     np.testing.assert_allclose(float(loss), float(ref_loss), atol=1e-5)
     _assert_tree_close(grads, ref_g)
@@ -203,10 +204,10 @@ def test_interleaved_matches_sequential(vp):
     params = _params(rng, 2, vp=vp)
     batch = _batch(jax.random.PRNGKey(7), b=16)
 
-    loss, grads = forward_backward_pipelining_with_interleaving(
-        spec, params, batch, num_microbatches=4, virtual_pipeline_size=vp,
-        mesh=mesh,
-    )
+    loss, grads = jax.jit(
+        lambda p: forward_backward_pipelining_with_interleaving(
+            spec, p, batch, num_microbatches=4, virtual_pipeline_size=vp,
+            mesh=mesh))(params)
     ref_loss, ref_g = _chunk_order_reference(spec, params, batch, 4, 2, vp)
     np.testing.assert_allclose(float(loss), float(ref_loss), atol=1e-5)
     _assert_tree_close(grads, ref_g)
@@ -219,13 +220,13 @@ def test_loss_scale_scales_grads():
     spec = _spec()
     params = _params(jax.random.PRNGKey(8), 4)
     batch = _batch(jax.random.PRNGKey(9))
-    loss1, g1 = forward_backward_pipelining_without_interleaving(
-        spec, params, batch, num_microbatches=4, mesh=mesh
-    )
-    loss2, g2 = forward_backward_pipelining_without_interleaving(
-        spec, params, batch, num_microbatches=4, mesh=mesh,
-        loss_scale=jnp.asarray(8.0),
-    )
+    loss1, g1 = jax.jit(
+        lambda p: forward_backward_pipelining_without_interleaving(
+            spec, p, batch, num_microbatches=4, mesh=mesh))(params)
+    loss2, g2 = jax.jit(
+        lambda p, s: forward_backward_pipelining_without_interleaving(
+            spec, p, batch, num_microbatches=4, mesh=mesh, loss_scale=s))(
+        params, jnp.asarray(8.0))
     np.testing.assert_allclose(float(loss1), float(loss2), atol=1e-6)
     _assert_tree_close(g2, jax.tree.map(lambda x: 8.0 * x, g1))
 

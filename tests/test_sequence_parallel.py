@@ -76,8 +76,8 @@ def test_sp_attention_grads_match_dense(fn):
     def dense_loss(q, k, v):
         return jnp.sum(jnp.sin(attention_reference(q, k, v, causal=True)))
 
-    g1 = jax.grad(sharded_loss, argnums=(0, 1, 2))(q, k, v)
-    g2 = jax.grad(dense_loss, argnums=(0, 1, 2))(q, k, v)
+    g1 = jax.jit(jax.grad(sharded_loss, argnums=(0, 1, 2)))(q, k, v)
+    g2 = jax.jit(jax.grad(dense_loss, argnums=(0, 1, 2)))(q, k, v)
     for a, e, name in zip(g1, g2, "qkv"):
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(e), atol=2e-4, err_msg=name)

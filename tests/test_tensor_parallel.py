@@ -97,7 +97,7 @@ def test_column_row_composition_matches_dense(mesh_tp2):
             y = tp.row_parallel_linear(h, w2_shard, b2, input_is_parallel=True)
             return jnp.sum(y)
 
-        val, grads = jax.value_and_grad(loss, argnums=(0, 1, 2))(
+        val, grads = jax.jit(jax.value_and_grad(loss, argnums=(0, 1, 2)))(
             w1_shard, w2_shard, b2
         )
         return val, grads
@@ -382,8 +382,10 @@ def test_sequence_parallel_block_matches_tp(mesh_tp2):
         def loss(p, x):
             return jnp.sum(jnp.sin(f(p, x)))
 
-        val, grads = jax.value_and_grad(loss, argnums=(0, 1))(w, x)
-        out = f(w, x)
+        # jit: eager shard_map grad dispatches op-by-op through the
+        # 8-device SPMD interpreter (was the slowest test in the suite)
+        val, grads = jax.jit(jax.value_and_grad(loss, argnums=(0, 1)))(w, x)
+        out = jax.jit(f)(w, x)
         return out, val, grads
 
     out_sp, val_sp, g_sp = run(True)
