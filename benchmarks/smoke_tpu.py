@@ -51,6 +51,7 @@ def _results():
             out.append({"kernel": name, "ok": False,
                         "error": f"{type(e).__name__}: {str(e)[:300]}",
                         "seconds": round(time.perf_counter() - t0, 2)})
+        print(json.dumps(out[-1]), file=sys.stderr, flush=True)
 
     from apex_tpu.ops.attention import attention_reference, flash_attention
 
