@@ -170,14 +170,27 @@ def main() -> None:
         candidates = [(batch, False, "full", 1),
                       (batch // 2, False, "full", 1),
                       (batch, True, "dots", 1), (batch, True, "full", 1),
-                      (batch, False, "full", 12), (batch, True, "dots", 12)]
+                      (batch, False, "full", 12), (batch, True, "dots", 12),
+                      # double batch amortizes fixed per-step cost; OOM is
+                      # caught and skipped, so probing above the estimated
+                      # HBM fit is free
+                      (batch * 2, True, "dots", 1),
+                      (batch * 2, False, "full", 1)]
     if not on_tpu:
         candidates = [(batch, True, "full", 1)]  # CPU: one cheap config
+    import sys
+
     best, best_tps, n_params, last_err = None, 0.0, 0, None
     for cand_batch, remat, policy, unroll in candidates:
         tps, n_params, err = _measure(remat, policy, cand_batch, seq,
                                       steps=3 if on_tpu else 1,
                                       unroll=unroll)
+        # per-candidate line on stderr: one tunnel window yields the whole
+        # tuning picture even if a later candidate hangs the run
+        print(f"# candidate batch={cand_batch} remat={remat}/{policy} "
+              f"unroll={unroll}: "
+              + (f"{tps:.1f} tokens/s" if tps is not None else f"FAIL {err}"),
+              file=sys.stderr, flush=True)
         if err is not None:
             last_err = (f"batch={cand_batch} remat={remat}/{policy} "
                         f"unroll={unroll}: {err}")
