@@ -185,12 +185,23 @@ def _fa_fwd_kernel(seed_ref, q_ref, k_ref, v_ref, *refs,
         lse_ref[0] = jnp.where(l == 0.0, NEG_INF, m_scr[:, :1] + jnp.log(safe_l))
 
 
-def _bias_spec(num_heads, block_q, block_k):
+def _kv_lim(i, block_q, block_k):
+    """Last K/V block index the causal mask leaves live for q block ``i``."""
+    return (i * block_q + block_q - 1) // block_k
+
+
+def _bias_spec(num_heads, block_q, block_k, causal=False):
     """BlockSpec for a batch-shared (heads, sq, sk) bias: grid dim 0 is the
-    flattened b*h (b-major), so the head index is bh mod heads."""
-    return pl.BlockSpec(
-        (1, block_q, block_k),
-        lambda b, i, j: (jax.lax.rem(b, num_heads), i, j))
+    flattened b*h (b-major), so the head index is bh mod heads. Under
+    ``causal`` the kv coordinate is clamped at the diagonal (see
+    ``_fa_fwd``)."""
+
+    def index(b, i, j):
+        if causal:
+            j = jnp.minimum(j, _kv_lim(i, block_q, block_k))
+        return (jax.lax.rem(b, num_heads), i, j)
+
+    return pl.BlockSpec((1, block_q, block_k), index)
 
 
 def _fa_fwd(q3, k3, v3, scale, causal, block_q, block_k, interpret,
@@ -206,15 +217,26 @@ def _fa_fwd(q3, k3, v3, scale, causal, block_q, block_k, interpret,
         _fa_fwd_kernel, scale=scale, causal=causal,
         block_q=block_q, block_k=block_k, nk=nk, dropout_rate=dropout_rate,
         has_bias=has_bias)
+
+    # Causal: clamp the K/V fetch at the diagonal. The ``run`` predicate
+    # already skips the compute for blocks above it; clamping the index map
+    # makes those iterations re-request the diagonal block, and Mosaic
+    # elides a copy whose block index matches the previous iteration —
+    # halving K/V HBM traffic instead of fetching masked-out blocks.
+    def kv_index(b, i, j):
+        if causal:
+            j = jnp.minimum(j, _kv_lim(i, block_q, block_k))
+        return (b, j, 0)
+
     in_specs = [
         pl.BlockSpec(memory_space=pltpu.SMEM),
         pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_k, d), kv_index),
+        pl.BlockSpec((1, block_k, d), kv_index),
     ]
     inputs = [seed, q3, k3, v3]
     if has_bias:
-        in_specs.append(_bias_spec(bias.shape[0], block_q, block_k))
+        in_specs.append(_bias_spec(bias.shape[0], block_q, block_k, causal))
         inputs.append(bias)
     o, lse = pl.pallas_call(
         kernel,
@@ -433,18 +455,24 @@ def _fa_bwd(q3, k3, v3, o3, lse, do3, scale, causal, block_q, block_k,
         _fa_bwd_dq_kernel, scale=scale, causal=causal,
         block_q=block_q, block_k=block_k, nk=nk, dropout_rate=dropout_rate,
         has_bias=has_bias)
+    # same causal diagonal clamp as the forward (elide masked-block DMA)
+    def kv_index(b, i, j):
+        if causal:
+            j = jnp.minimum(j, _kv_lim(i, block_q, block_k))
+        return (b, j, 0)
+
     dq_specs = [
         pl.BlockSpec(memory_space=pltpu.SMEM),
         pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_k, d), kv_index),
+        pl.BlockSpec((1, block_k, d), kv_index),
         pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
         pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
     ]
     dq_inputs = [seed, q3, k3, v3, do3, lse, delta]
     if has_bias:
-        dq_specs.append(_bias_spec(bias.shape[0], block_q, block_k))
+        dq_specs.append(_bias_spec(bias.shape[0], block_q, block_k, causal))
         dq_inputs.append(bias)
     dq = pl.pallas_call(
         dq_kernel,
@@ -462,21 +490,27 @@ def _fa_bwd(q3, k3, v3, o3, lse, do3, scale, causal, block_q, block_k,
         _fa_bwd_dkv_kernel, scale=scale, causal=causal,
         block_q=block_q, block_k=block_k, nq=nq, dropout_rate=dropout_rate,
         has_bias=has_bias)
+    # dK/dV mirror clamp: for kv block j the first live q block is
+    # (j*block_k)//block_q; earlier (masked-out) iterations re-request it,
+    # eliding their q/do/lse/delta DMA
+    def q_clamp(i, j):
+        return jnp.maximum(i, (j * block_k) // block_q) if causal else i
+
     dkv_specs = [
         pl.BlockSpec(memory_space=pltpu.SMEM),
-        pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+        pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, q_clamp(i, j), 0)),
         pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
         pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-        pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
-        pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
-        pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
+        pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, q_clamp(i, j), 0)),
+        pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, q_clamp(i, j), 0)),
+        pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, q_clamp(i, j), 0)),
     ]
     dkv_inputs = [seed, q3, k3, v3, do3, lse, delta]
     if has_bias:
         num_heads = bias.shape[0]
         dkv_specs.append(pl.BlockSpec(
             (1, block_q, block_k),
-            lambda b, j, i: (jax.lax.rem(b, num_heads), i, j)))
+            lambda b, j, i: (jax.lax.rem(b, num_heads), q_clamp(i, j), j)))
         dkv_inputs.append(bias)
     dk, dv = pl.pallas_call(
         dkv_kernel,
@@ -508,6 +542,13 @@ def _fa_bwd(q3, k3, v3, o3, lse, do3, scale, causal, block_q, block_k,
         _fa_bwd_dbias_kernel, scale=scale, causal=causal,
         block_q=block_q, block_k=block_k, nb=nb, num_heads=num_heads,
         dropout_rate=dropout_rate)
+    def b_live(i, j, b):
+        # tiles above the causal diagonal never compute: pin their batch
+        # fetch to item 0 so the repeated index elides the per-b DMA
+        if not causal:
+            return b
+        return jnp.where(j * block_k <= i * block_q + block_q - 1, b, 0)
+
     db = pl.pallas_call(
         dbias_kernel,
         # batch innermost ("arbitrary"): the (h, q, k) tile accumulates
@@ -516,17 +557,23 @@ def _fa_bwd(q3, k3, v3, o3, lse, do3, scale, causal, block_q, block_k,
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec((1, block_q, d),
-                         lambda h, i, j, b: (b * num_heads + h, i, 0)),
+                         lambda h, i, j, b: (b_live(i, j, b) * num_heads + h,
+                                             i, 0)),
             pl.BlockSpec((1, block_k, d),
-                         lambda h, i, j, b: (b * num_heads + h, j, 0)),
+                         lambda h, i, j, b: (b_live(i, j, b) * num_heads + h,
+                                             j, 0)),
             pl.BlockSpec((1, block_k, d),
-                         lambda h, i, j, b: (b * num_heads + h, j, 0)),
+                         lambda h, i, j, b: (b_live(i, j, b) * num_heads + h,
+                                             j, 0)),
             pl.BlockSpec((1, block_q, d),
-                         lambda h, i, j, b: (b * num_heads + h, i, 0)),
+                         lambda h, i, j, b: (b_live(i, j, b) * num_heads + h,
+                                             i, 0)),
             pl.BlockSpec((1, block_q, 1),
-                         lambda h, i, j, b: (b * num_heads + h, i, 0)),
+                         lambda h, i, j, b: (b_live(i, j, b) * num_heads + h,
+                                             i, 0)),
             pl.BlockSpec((1, block_q, 1),
-                         lambda h, i, j, b: (b * num_heads + h, i, 0)),
+                         lambda h, i, j, b: (b_live(i, j, b) * num_heads + h,
+                                             i, 0)),
             pl.BlockSpec((1, block_q, block_k), lambda h, i, j, b: (h, i, j)),
         ],
         out_specs=pl.BlockSpec((1, block_q, block_k),
