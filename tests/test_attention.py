@@ -43,12 +43,15 @@ def test_flash_cross_attention_rectangular():
 
 
 @pytest.mark.parametrize("causal", [False, True])
-def test_flash_backward_matches_reference(causal):
+@pytest.mark.parametrize("block_q,block_k", [(32, 32), (32, 16), (16, 32)])
+def test_flash_backward_matches_reference(causal, block_q, block_k):
+    # unequal blocks exercise both directions of the causal-diagonal index
+    # clamp ((i*bq+bq-1)//bk forward, (j*bk)//bq in dK/dV)
     q, k, v = _qkv(jax.random.PRNGKey(2), 1, 2, 64, 64, 32)
 
     def loss_flash(q, k, v):
         o = flash_attention(q, k, v, causal=causal, use_pallas=True,
-                            block_q=32, block_k=32)
+                            block_q=block_q, block_k=block_k)
         return jnp.sum(jnp.sin(o))
 
     def loss_ref(q, k, v):
