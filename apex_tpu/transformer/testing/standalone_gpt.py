@@ -131,6 +131,12 @@ class GPTConfig:
     # tight-capacity drop patterns differ from the gathered path (exact
     # match when capacity is ample — see moe_mlp docstring).
     moe_seq_dispatch: bool = False
+    # LayerNorm implementation override: None = layer_norm's own auto
+    # (Pallas kernel on TPU when shapes allow), True/False forces it.
+    # benchmarks/tune_blocks.py A/Bs the full step both ways — a Pallas
+    # call is an XLA fusion barrier, so at small hidden the fused XLA LN
+    # can win despite the kernel's fewer HBM passes.
+    ln_pallas: Optional[bool] = None
 
     @property
     def ffn_hidden(self) -> int:
@@ -424,12 +430,14 @@ def _layer(p, x, cfg, heads_local: int, causal: bool = True, mask=None,
         k_h1, k_h2 = _hidden_key(k_h1, cfg), _hidden_key(k_h2, cfg)
     else:
         k_attn = k_h1 = k_h2 = None
-    a = _attention(p, layer_norm(x, p["ln1_w"], p["ln1_b"]), cfg,
+    a = _attention(p, layer_norm(x, p["ln1_w"], p["ln1_b"],
+                             use_pallas=cfg.ln_pallas), cfg,
                    heads_local, causal, mask, dropout_key=k_attn)
     if k_h1 is not None and cfg.hidden_dropout > 0.0:
         a = _hidden_dropout(a, cfg.hidden_dropout, k_h1)
     x = x + a
-    m, aux = _mlp(p, layer_norm(x, p["ln2_w"], p["ln2_b"]), cfg)
+    m, aux = _mlp(p, layer_norm(x, p["ln2_w"], p["ln2_b"],
+                            use_pallas=cfg.ln_pallas), cfg)
     if k_h2 is not None and cfg.hidden_dropout > 0.0:
         m = _hidden_dropout(m, cfg.hidden_dropout, k_h2)
     return x + m, aux
@@ -572,7 +580,8 @@ def gpt_head(params, x, cfg: GPTConfig):
     the head entry gathers seq (the vocab dim is sharded over the same tp
     axis, so the head needs the full sequence on every rank)."""
     head = params["head"]
-    x = layer_norm(x, head["ln_w"], head["ln_b"])
+    x = layer_norm(x, head["ln_w"], head["ln_b"],
+                   use_pallas=cfg.ln_pallas)
     if cfg.megatron_sp:
         from apex_tpu.transformer.tensor_parallel.mappings import (
             gather_from_sequence_parallel_region,
@@ -606,7 +615,8 @@ def _use_fused_loss(cfg: GPTConfig, n_rows: int) -> bool:
 
 def fused_head_loss(head_rows_w, ln_w, ln_b, x, targets,
                     gather_sequence: bool = False,
-                    block_n: int = 0, block_v: int = 0):
+                    block_n: int = 0, block_v: int = 0,
+                    ln_use_pallas=None):
     """Shared fused LM-head + CE block: final LN -> copy-to-TP-region ->
     pvary (so dw reduces over the data axes) -> fused loss kernel.
     ``head_rows_w``: (vocab/tp, hidden) projection rows. With
@@ -619,7 +629,7 @@ def fused_head_loss(head_rows_w, ln_w, ln_b, x, targets,
         pvary_like,
     )
 
-    x = layer_norm(x, ln_w, ln_b)
+    x = layer_norm(x, ln_w, ln_b, use_pallas=ln_use_pallas)
     if gather_sequence:
         x = gather_from_sequence_parallel_region(x)
     x = copy_to_tensor_model_parallel_region(x)
@@ -656,7 +666,8 @@ def gpt_loss(params, tokens, targets, cfg: GPTConfig, dropout_key=None):
     return fused_head_loss(w, head["ln_w"], head["ln_b"], x, targets,
                            gather_sequence=cfg.megatron_sp,
                            block_n=cfg.lm_block_n,
-                           block_v=cfg.lm_block_v) + aux
+                           block_v=cfg.lm_block_v,
+                           ln_use_pallas=cfg.ln_pallas) + aux
 
 
 # ---------------------------------------------------------------------------
@@ -725,7 +736,8 @@ def gpt_pipeline_spec(cfg: GPTConfig) -> PipelineSpec:
                                    h, targets,
                                    gather_sequence=cfg.megatron_sp,
                                    block_n=cfg.lm_block_n,
-                                   block_v=cfg.lm_block_v)
+                                   block_v=cfg.lm_block_v,
+                                   ln_use_pallas=cfg.ln_pallas)
         logits = gpt_head({"head": head}, h, cfg=dataclasses.replace(
             cfg, tie_embeddings=False))
         return jnp.mean(vocab_parallel_cross_entropy(logits, targets))

@@ -116,6 +116,45 @@ def sweep_lm_head(steps: int):
     return results
 
 
+def sweep_ln_impl(steps: int):
+    """Full-step A/B of the LayerNorm implementation (GPTConfig.ln_pallas).
+
+    Isolated LN timing cannot answer this one: a Pallas call is an XLA
+    fusion barrier, so the kernel's fewer HBM passes compete against the
+    fusions XLA gives up around it. Time the whole flagship train step
+    both ways at the quick-bench config and print the winner."""
+    import bench
+
+    results = []
+    for ln_pallas in (True, False):
+        cfg = bench.flagship_config(bench.SEQ, remat=True,
+                                    remat_policy="full",
+                                    ln_pallas=ln_pallas)
+        train_step, params, opt_state, tok, tgt = bench.build_train_step(
+            cfg, bench.BATCH, bench.SEQ)
+        try:
+            for _ in range(2):  # compile + one warm step
+                params, opt_state, loss = train_step(params, opt_state,
+                                                     tok, tgt)
+            float(loss)
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                params, opt_state, loss = train_step(params, opt_state,
+                                                     tok, tgt)
+            float(loss)
+            dt = (time.perf_counter() - t0) / steps
+        except Exception as e:
+            print(f"ln_pallas={ln_pallas}  FAILED {type(e).__name__}",
+                  flush=True)
+            continue
+        print(f"ln_pallas={ln_pallas}  {dt * 1e3:8.3f} ms/step", flush=True)
+        results.append((dt, ln_pallas))
+    if results:
+        dt, ln_pallas = min(results)
+        print(f"BEST ln impl: ln_pallas={ln_pallas} ({dt * 1e3:.3f} ms/step)")
+    return results
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=5)
@@ -134,6 +173,7 @@ def main() -> int:
         return 0
     sweep_attention(args.steps)
     sweep_lm_head(args.steps)
+    sweep_ln_impl(args.steps)
     return 0
 
 
