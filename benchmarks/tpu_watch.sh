@@ -53,14 +53,28 @@ bench_stage() {  # bench_stage <n> <timeout> [extra bench.py args...]
     > "/tmp/tpu_stage$n.out" 2> "/tmp/tpu_stage$n.err"
   local rc=$?
   note "STAGE$n EXIT=$rc"
-  [ $rc -eq 0 ] && [ -s /tmp/bench_try.json ] || return 1
+  [ -s /tmp/bench_try.json ] || return 1
   if grep -q CPU_FALLBACK /tmp/bench_try.json; then
     note "STAGE$n got CPU_FALLBACK, not promoting"
     return 1
   fi
+  # bench.py banks a best-so-far line after every improving candidate, so
+  # even a timeout mid-sweep leaves a real (provisional) number. A clean
+  # exit always promotes (tracks latest code); a partial only promotes if
+  # it beats the banked number (never clobber a full result with a
+  # truncated sweep's slower best-so-far).
+  if [ $rc -ne 0 ] && [ -s BENCH_watch.json ]; then
+    python - <<'PY' || { note "STAGE$n partial not better, keeping banked"; return 1; }
+import json, sys
+new = json.load(open("/tmp/bench_try.json"))
+old = json.load(open("BENCH_watch.json"))
+sys.exit(0 if new.get("value", 0) > old.get("value", 0) else 1)
+PY
+  fi
   cp /tmp/bench_try.json BENCH_watch.json
-  [ "$(cat "$STATE")" -lt "$n" ] && echo "$n" > "$STATE"
   note "STAGE$n PROMOTED $(cat BENCH_watch.json)"
+  [ $rc -eq 0 ] || return 1
+  [ "$(cat "$STATE")" -lt "$n" ] && echo "$n" > "$STATE"
   return 0
 }
 
