@@ -100,7 +100,7 @@ def _skip(qmin_ref, qmax_ref, kmin_ref, kmax_ref, b_i, q_i, kv_i,
     return run
 
 
-def _vl_fwd_kernel(qmin_ref, qmax_ref, kmin_ref, kmax_ref,
+def _vl_fwd_kernel(qmin_ref, qmax_ref, kmin_ref, kmax_ref, jlo_ref, jhi_ref,
                    seg_q_ref, seg_k_ref, q_ref, k_ref, v_ref,
                    o_ref, lse_ref, m_scr, l_scr, acc_scr,
                    *, scale, causal, block_q, block_k, nk):
@@ -155,9 +155,9 @@ def _vl_fwd_kernel(qmin_ref, qmax_ref, kmin_ref, kmax_ref,
             l == 0.0, NEG_INF, m_scr[:, :1] + jnp.log(safe_l))
 
 
-def _vl_bwd_dq_kernel(qmin_ref, qmax_ref, kmin_ref, kmax_ref,
-                      seg_q_ref, seg_k_ref, q_ref, k_ref, v_ref, do_ref,
-                      lse_ref, delta_ref, dq_ref, dq_scr,
+def _vl_bwd_dq_kernel(qmin_ref, qmax_ref, kmin_ref, kmax_ref, jlo_ref,
+                      jhi_ref, seg_q_ref, seg_k_ref, q_ref, k_ref, v_ref,
+                      do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
                       *, scale, causal, block_q, block_k, nk):
     b_i = pl.program_id(0)
     q_i = pl.program_id(2)
@@ -202,10 +202,10 @@ def _vl_bwd_dq_kernel(qmin_ref, qmax_ref, kmin_ref, kmax_ref,
         dq_ref[0, 0] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _vl_bwd_dkv_kernel(qmin_ref, qmax_ref, kmin_ref, kmax_ref,
-                       seg_q_ref, seg_k_ref, q_ref, k_ref, v_ref, do_ref,
-                       lse_ref, delta_ref, dk_ref, dv_ref, dk_scr, dv_scr,
-                       *, scale, causal, block_q, block_k, nq):
+def _vl_bwd_dkv_kernel(qmin_ref, qmax_ref, kmin_ref, kmax_ref, ilo_ref,
+                       ihi_ref, seg_q_ref, seg_k_ref, q_ref, k_ref, v_ref,
+                       do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_scr,
+                       dv_scr, *, scale, causal, block_q, block_k, nq):
     b_i = pl.program_id(0)
     kv_i = pl.program_id(2)
     q_i = pl.program_id(3)
@@ -263,6 +263,33 @@ def _block_ranges(seg, block):
     return r.min(axis=2), r.max(axis=2)
 
 
+def _interact_matrix(qmin, qmax, kmin, kmax, causal, block_q, block_k):
+    """(b, nq, nk) bool: can q block i and kv block j interact at all?
+    Mirrors the kernel-side ``_skip`` predicate exactly."""
+    inter = ((qmin[:, :, None] <= kmax[:, None, :])
+             & (qmax[:, :, None] >= kmin[:, None, :])
+             & (qmax[:, :, None] >= 0) & (kmax[:, None, :] >= 0))
+    if causal:
+        nq, nk = qmin.shape[1], kmin.shape[1]
+        i = jnp.arange(nq)[None, :, None]
+        j = jnp.arange(nk)[None, None, :]
+        inter = inter & (j * block_k <= i * block_q + block_q - 1)
+    return inter
+
+
+def _live_range(inter, axis):
+    """First/last True index along ``axis`` of the interact matrix (0 when
+    the row is empty — the clamp target is arbitrary for rows the kernel's
+    ``run`` predicate skips entirely)."""
+    n = inter.shape[axis]
+    any_ = inter.any(axis=axis)
+    lo = jnp.where(any_, jnp.argmax(inter, axis=axis), 0)
+    hi = jnp.where(any_,
+                   n - 1 - jnp.argmax(jnp.flip(inter, axis=axis), axis=axis),
+                   0)
+    return lo.astype(jnp.int32), hi.astype(jnp.int32)
+
+
 def _vl_call(q, k, v, seg_q, seg_k, scale, causal, block_q, block_k,
              interpret):
     b, h, sq, d = q.shape
@@ -270,18 +297,32 @@ def _vl_call(q, k, v, seg_q, seg_k, scale, causal, block_q, block_k,
     nq, nk = sq // block_q, sk // block_k
     qmin, qmax = _block_ranges(seg_q, block_q)
     kmin, kmax = _block_ranges(seg_k, block_k)
+    # per-q-block live kv range: index maps clamp the kv fetch into it so
+    # skipped iterations re-request an edge block (Mosaic elides the
+    # repeated copy) instead of streaming dead K/V
+    inter = _interact_matrix(qmin, qmax, kmin, kmax, causal,
+                             block_q, block_k)
+    jlo, jhi = _live_range(inter, axis=2)
+
+    def kv_index(b, h, i, j, qmn, qmx, kmn, kmx, jlo, jhi):
+        jc = jnp.clip(j, jlo[b, i], jhi[b, i])
+        return (b, h, jc, 0)
+
+    def segk_index(b, h, i, j, qmn, qmx, kmn, kmx, jlo, jhi):
+        return (b, jnp.clip(j, jlo[b, i], jhi[b, i]))
+
     kernel = functools.partial(
         _vl_fwd_kernel, scale=scale, causal=causal,
         block_q=block_q, block_k=block_k, nk=nk)
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=4,
+        num_scalar_prefetch=6,
         grid=(b, h, nq, nk),
         in_specs=[
             pl.BlockSpec((1, block_q), lambda b, h, i, j, *_: (b, i)),
-            pl.BlockSpec((1, block_k), lambda b, h, i, j, *_: (b, j)),
+            pl.BlockSpec((1, block_k), segk_index),
             pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j, *_: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_k, d), lambda b, h, i, j, *_: (b, h, j, 0)),
-            pl.BlockSpec((1, 1, block_k, d), lambda b, h, i, j, *_: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, block_k, d), kv_index),
+            pl.BlockSpec((1, 1, block_k, d), kv_index),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j, *_: (b, h, i, 0)),
@@ -304,7 +345,7 @@ def _vl_call(q, k, v, seg_q, seg_k, scale, causal, block_q, block_k,
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
-    )(qmin, qmax, kmin, kmax, seg_q, seg_k, q, k, v)
+    )(qmin, qmax, kmin, kmax, jlo, jhi, seg_q, seg_k, q, k, v)
     return o, lse
 
 
@@ -315,21 +356,31 @@ def _vl_bwd_call(q, k, v, seg_q, seg_k, o, lse, do, scale, causal,
     nq, nk = sq // block_q, sk // block_k
     qmin, qmax = _block_ranges(seg_q, block_q)
     kmin, kmax = _block_ranges(seg_k, block_k)
+    inter = _interact_matrix(qmin, qmax, kmin, kmax, causal,
+                             block_q, block_k)
+    jlo, jhi = _live_range(inter, axis=2)  # per q block: live kv range
+    ilo, ihi = _live_range(inter, axis=1)  # per kv block: live q range
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1, keepdims=True)
+
+    def kv_index(b, h, i, j, qmn, qmx, kmn, kmx, jlo, jhi):
+        return (b, h, jnp.clip(j, jlo[b, i], jhi[b, i]), 0)
+
+    def segk_index(b, h, i, j, qmn, qmx, kmn, kmx, jlo, jhi):
+        return (b, jnp.clip(j, jlo[b, i], jhi[b, i]))
 
     dq = pl.pallas_call(
         functools.partial(_vl_bwd_dq_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k, nk=nk),
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=4,
+            num_scalar_prefetch=6,
             grid=(b, h, nq, nk),
             in_specs=[
                 pl.BlockSpec((1, block_q), lambda b, h, i, j, *_: (b, i)),
-                pl.BlockSpec((1, block_k), lambda b, h, i, j, *_: (b, j)),
+                pl.BlockSpec((1, block_k), segk_index),
                 pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j, *_: (b, h, i, 0)),
-                pl.BlockSpec((1, 1, block_k, d), lambda b, h, i, j, *_: (b, h, j, 0)),
-                pl.BlockSpec((1, 1, block_k, d), lambda b, h, i, j, *_: (b, h, j, 0)),
+                pl.BlockSpec((1, 1, block_k, d), kv_index),
+                pl.BlockSpec((1, 1, block_k, d), kv_index),
                 pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j, *_: (b, h, i, 0)),
                 pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j, *_: (b, h, i, 0)),
                 pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j, *_: (b, h, i, 0)),
@@ -343,23 +394,32 @@ def _vl_bwd_call(q, k, v, seg_q, seg_k, o, lse, do, scale, causal,
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
-    )(qmin, qmax, kmin, kmax, seg_q, seg_k, q, k, v, do, lse, delta)
+    )(qmin, qmax, kmin, kmax, jlo, jhi, seg_q, seg_k, q, k, v, do, lse, delta)
+
+    def q_index(b, h, j, i, qmn, qmx, kmn, kmx, ilo, ihi):
+        return (b, h, jnp.clip(i, ilo[b, j], ihi[b, j]), 0)
+
+    def q1_index(b, h, j, i, qmn, qmx, kmn, kmx, ilo, ihi):
+        return (b, h, jnp.clip(i, ilo[b, j], ihi[b, j]), 0)
+
+    def segq_index(b, h, j, i, qmn, qmx, kmn, kmx, ilo, ihi):
+        return (b, jnp.clip(i, ilo[b, j], ihi[b, j]))
 
     dk, dv = pl.pallas_call(
         functools.partial(_vl_bwd_dkv_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k, nq=nq),
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=4,
+            num_scalar_prefetch=6,
             grid=(b, h, nk, nq),
             in_specs=[
-                pl.BlockSpec((1, block_q), lambda b, h, j, i, *_: (b, i)),
+                pl.BlockSpec((1, block_q), segq_index),
                 pl.BlockSpec((1, block_k), lambda b, h, j, i, *_: (b, j)),
-                pl.BlockSpec((1, 1, block_q, d), lambda b, h, j, i, *_: (b, h, i, 0)),
+                pl.BlockSpec((1, 1, block_q, d), q_index),
                 pl.BlockSpec((1, 1, block_k, d), lambda b, h, j, i, *_: (b, h, j, 0)),
                 pl.BlockSpec((1, 1, block_k, d), lambda b, h, j, i, *_: (b, h, j, 0)),
-                pl.BlockSpec((1, 1, block_q, d), lambda b, h, j, i, *_: (b, h, i, 0)),
-                pl.BlockSpec((1, 1, block_q, 1), lambda b, h, j, i, *_: (b, h, i, 0)),
-                pl.BlockSpec((1, 1, block_q, 1), lambda b, h, j, i, *_: (b, h, i, 0)),
+                pl.BlockSpec((1, 1, block_q, d), q_index),
+                pl.BlockSpec((1, 1, block_q, 1), q1_index),
+                pl.BlockSpec((1, 1, block_q, 1), q1_index),
             ],
             out_specs=[
                 pl.BlockSpec((1, 1, block_k, d),
@@ -380,7 +440,7 @@ def _vl_bwd_call(q, k, v, seg_q, seg_k, o, lse, do, scale, causal,
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
-    )(qmin, qmax, kmin, kmax, seg_q, seg_k, q, k, v, do, lse, delta)
+    )(qmin, qmax, kmin, kmax, ilo, ihi, seg_q, seg_k, q, k, v, do, lse, delta)
     return dq, dk, dv
 
 
