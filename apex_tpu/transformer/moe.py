@@ -160,12 +160,14 @@ def _expert_ffn(p, x):
     """``x``: (E_local, N, h) TP-replicated -> (E_local, N, h). Megatron
     split on the ffn dim: fc1 column-parallel, gelu, fc2 row-parallel."""
     x = copy_to_tensor_model_parallel_region(x)
-    y = jnp.einsum("enh,ehf->enf", x, p["fc1_kernel"],
-                   preferred_element_type=jnp.float32).astype(x.dtype)
+    # input-dtype einsum: keeps backward cotangents bf16 (see
+    # tensor_parallel/layers.py) — fp32 MXU accumulation either way
+    y = jnp.einsum("enh,ehf->enf", x,
+                   p["fc1_kernel"].astype(x.dtype))
     y = y + p["fc1_bias"][:, None, :]
     y = jax.nn.gelu(y, approximate=True)
-    y = jnp.einsum("enf,efh->enh", y, p["fc2_kernel"],
-                   preferred_element_type=jnp.float32).astype(x.dtype)
+    y = jnp.einsum("enf,efh->enh", y,
+                   p["fc2_kernel"].astype(x.dtype))
     y = reduce_from_tensor_model_parallel_region(y)
     return y + p["fc2_bias"][:, None, :]
 

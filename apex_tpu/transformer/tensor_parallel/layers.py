@@ -135,7 +135,12 @@ def column_parallel_linear(
         x = gather_from_sequence_parallel_region(x, axis_name)
     else:
         x = copy_to_tensor_model_parallel_region(x, axis_name)
-    y = jnp.dot(x, kernel, preferred_element_type=jnp.float32).astype(x.dtype)
+    # dot in the input dtype: the MXU accumulates bf16 x bf16 in fp32
+    # regardless, so the result equals the explicit preferred-fp32 +
+    # round-to-bf16 form — but a bf16 OUTPUT keeps the backward's
+    # cotangents bf16, so dX/dW also ride the fast MXU path instead
+    # of fp32 dots (~4x slower); with fp32 params nothing changes
+    y = jnp.dot(x, kernel.astype(x.dtype))
     if bias is not None:
         y = y + bias
     if gather_output:
@@ -158,7 +163,12 @@ def row_parallel_linear(
     seq (Megatron-SP ``ḡ``) and the result is the (b, s/tp, out) shard."""
     if not input_is_parallel:
         x = scatter_to_tensor_model_parallel_region(x, axis_name)
-    y = jnp.dot(x, kernel, preferred_element_type=jnp.float32).astype(x.dtype)
+    # dot in the input dtype: the MXU accumulates bf16 x bf16 in fp32
+    # regardless, so the result equals the explicit preferred-fp32 +
+    # round-to-bf16 form — but a bf16 OUTPUT keeps the backward's
+    # cotangents bf16, so dX/dW also ride the fast MXU path instead
+    # of fp32 dots (~4x slower); with fp32 params nothing changes
+    y = jnp.dot(x, kernel.astype(x.dtype))
     if sequence_parallel:
         y = reduce_scatter_to_sequence_parallel_region(y, axis_name)
     else:
