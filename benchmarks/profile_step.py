@@ -32,7 +32,11 @@ def main() -> int:
 
     from apex_tpu.utils.platform import pin_cpu_platform, probe_backend
 
-    if os.environ.get("JAX_PLATFORMS") != "cpu" and probe_backend() == 0:
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # the env var alone does not stop the image's axon backend hook
+        # from dialing the (possibly dead) tunnel — pin explicitly
+        pin_cpu_platform()
+    elif probe_backend() == 0:
         pin_cpu_platform()
     backend = jax.default_backend()
     on_tpu = backend == "tpu"
@@ -41,18 +45,37 @@ def main() -> int:
     from apex_tpu.pyprof import format_measured_table, measured_op_table
 
     batch, seq = (bench.BATCH, bench.SEQ) if on_tpu else (2, 128)
-    cfg = bench.flagship_config(
-        seq, remat=args.remat, remat_policy="dots" if args.remat else "full")
-    train_step, params, opt_state, tok, tgt = bench.build_train_step(
-        cfg, batch, seq)
+    # profile the lightest remat that fits: no-remat (the MFU operating
+    # point) unless it OOMs, then selective-dots, then full — a failed
+    # stage-4 fire must not waste a tunnel window. The probe runs through
+    # the same non-donating wrapper the profiler jits (wrapping the jitted
+    # step inlines it WITHOUT donate_argnums, so repeated profiled calls
+    # reuse the param buffers; same function object -> same jit cache
+    # entry, so the probe's compile is the profiler's compile).
+    tries = ([(True, "dots"), (True, "full")] if args.remat
+             else [(False, "full"), (True, "dots"), (True, "full")])
+    last = None
+    for remat, policy in tries:
+        cfg = bench.flagship_config(seq, remat=remat, remat_policy=policy)
+        train_step, params, opt_state, tok, tgt = bench.build_train_step(
+            cfg, batch, seq)
 
-    # measured_op_table re-jits its fn argument; wrapping the jitted step
-    # inlines it WITHOUT the donate_argnums annotation, so the repeated
-    # profiled calls reuse the same param buffers instead of consuming
-    # them. Everything the step produces is returned — returning only the
-    # loss would let XLA dead-code-eliminate the optimizer update.
-    def step(params, opt_state, tok, tgt):
-        return train_step(params, opt_state, tok, tgt)
+        # everything the step produces is returned — returning only the
+        # loss would let XLA dead-code-eliminate the optimizer update
+        def step(params, opt_state, tok, tgt, _ts=train_step):
+            return _ts(params, opt_state, tok, tgt)
+
+        try:
+            out = jax.jit(step)(params, opt_state, tok, tgt)
+            jax.block_until_ready(jax.tree.leaves(out)[0])
+            args.remat = remat
+            break
+        except Exception as e:  # OOM at this config — drop a tier
+            last = e
+            print(f"# remat={remat}/{policy} failed "
+                  f"({type(e).__name__}), trying next", flush=True)
+    else:
+        raise RuntimeError(f"no profiling config fit: {last}")
 
     peak = bench.PEAK_FLOPS.get(backend, 1e12)
     header = (f"flagship GPT step profile | backend={backend}"
