@@ -51,12 +51,14 @@ from apex_tpu.transformer.testing import (
 HID, HEADS, LAYERS, VOCAB, BATCH = 768, 12, 2, 1024, 1
 
 
-def build_case(seq: int, sp: int):
-    """-> compiled fwd+bwd loss for the GPT stack at (seq, sp)."""
-    mesh = build_mesh(tp=1, pp=1, sp=sp, dp=8 // sp)
+def build_case(seq: int, sp: int, tp: int = 1, megatron_sp: bool = False,
+               remat: bool = True):
+    """-> compiled fwd+bwd loss for the GPT stack at (seq, sp, tp)."""
+    mesh = build_mesh(tp=tp, pp=1, sp=sp, dp=8 // (sp * tp))
     cfg = GPTConfig(vocab_size=VOCAB, max_seq=seq, hidden=HID,
                     num_layers=LAYERS, num_heads=HEADS, dtype=jnp.bfloat16,
-                    tie_embeddings=True, remat=True)
+                    tie_embeddings=True, remat=remat,
+                    megatron_sp=megatron_sp)
     params = init_gpt_params(jax.random.PRNGKey(0), cfg)
     tokens = jnp.zeros((BATCH, seq), jnp.int32)
     targets = jnp.zeros((BATCH, seq), jnp.int32)
@@ -77,11 +79,13 @@ def build_case(seq: int, sp: int):
     return jax.jit(step).lower(params, tokens, targets).compile()
 
 
-def measure(seq: int, sp: int):
-    c = build_case(seq, sp)
+def measure(seq: int, sp: int, tp: int = 1, megatron_sp: bool = False,
+            remat: bool = True):
+    c = build_case(seq, sp, tp=tp, megatron_sp=megatron_sp, remat=remat)
     ma = c.memory_analysis()
     return {
-        "seq": seq, "sp": sp,
+        "seq": seq, "sp": sp, "tp": tp, "megatron_sp": megatron_sp,
+        "remat": remat,
         "temp_mb": round(ma.temp_size_in_bytes / 1e6, 1),
         "peak_mb": round(ma.peak_memory_in_bytes / 1e6, 1),
         "temp_mb_per_dev": round(ma.temp_size_in_bytes / 8 / 1e6, 1),
@@ -90,10 +94,19 @@ def measure(seq: int, sp: int):
 
 def main() -> int:
     rows = []
-    for seq, sp in ((4096, 1), (4096, 8), (8192, 1), (8192, 8),
-                    (16384, 8), (32768, 8)):
+    for seq, sp, kw in ((4096, 1, {}), (4096, 8, {}), (8192, 1, {}),
+                        (8192, 8, {}), (16384, 8, {}), (32768, 8, {}),
+                        # Megatron-SP A/B at ring sp=4 x tp=2, remat OFF
+                        # so saved activations (what Megatron-SP shards:
+                        # LN/dropout/residual regions run on
+                        # (b, s/(sp*tp), h) shards instead of
+                        # tp-replicated (b, s/sp, h)) dominate the temps;
+                        # under full remat the delta is noise
+                        (8192, 4, {"tp": 2, "remat": False}),
+                        (8192, 4, {"tp": 2, "remat": False,
+                                   "megatron_sp": True})):
         try:
-            r = measure(seq, sp)
+            r = measure(seq, sp, **kw)
         except Exception as e:  # dense legs can exhaust the compiler
             r = {"seq": seq, "sp": sp,
                  "error": f"{type(e).__name__}: {str(e)[:120]}"}
