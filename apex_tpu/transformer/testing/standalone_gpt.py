@@ -447,6 +447,12 @@ def _layer_stack(layers, x, cfg, causal: bool = True, mask=None,
                  dropout_key=None):
     """scan the stacked layer params over the hidden state."""
     tp = lax.axis_size(TP_AXIS)
+    if cfg.num_heads % tp:
+        # init_gpt_params can't see tp (global shapes); check here at trace
+        # time instead of failing with a QKV reshape error mid-layer
+        raise ValueError(
+            f"num_heads ({cfg.num_heads}) not divisible by tp ({tp}); "
+            f"see GPTConfig.validate(tp=...)")
     heads_local = cfg.num_heads // tp
 
     def one(lp, h, key):
