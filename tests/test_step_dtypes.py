@@ -1,0 +1,86 @@
+"""Regression guard for the round-4 fp32-backward-matmul find (PERF.md).
+
+The projection layers once computed ``dot(..., preferred_element_type=
+f32).astype(bf16)``; the forward was equivalent to a bf16 dot (the MXU
+accumulates in fp32 either way) but the f32 intermediate made every
+backward cotangent f32, so all dX/dW matmuls ran as f32(-mixed) dots —
+the slow MXU path, ~2/3 of step flops. The signature of that bug class is
+a *mixed-dtype* dot: a bf16 parameter (or activation) meeting an f32
+cotangent. This test walks the flagship train-step jaxpr and asserts no
+mixed dot exists — the CPU-fallback attention/LM-head reference dots are
+legitimately pure-f32 and allowed.
+"""
+
+import collections
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import PartitionSpec as P
+
+
+def _dot_dtypes(jaxpr):
+    found = collections.Counter()
+
+    def walk(jx):
+        for eqn in jx.eqns:
+            if eqn.primitive.name == "dot_general":
+                found[tuple(sorted(str(v.aval.dtype)
+                                   for v in eqn.invars))] += 1
+            for p in eqn.params.values():
+                if hasattr(p, "jaxpr"):
+                    sub = p.jaxpr
+                    walk(sub if hasattr(sub, "eqns") else sub.jaxpr)
+                elif hasattr(p, "eqns"):
+                    walk(p)
+                elif isinstance(p, (list, tuple)):
+                    for q in p:
+                        if hasattr(q, "jaxpr"):
+                            walk(q.jaxpr)
+                        elif hasattr(q, "eqns"):
+                            walk(q)
+
+    walk(jaxpr.jaxpr)
+    return found
+
+
+@pytest.mark.parametrize("remat", [False, True])
+def test_no_mixed_dtype_dots_in_train_step(remat):
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.parallel.mesh import build_mesh
+    from apex_tpu.transformer.testing import (
+        GPTConfig,
+        gpt_loss,
+        gpt_param_specs,
+        init_gpt_params,
+    )
+
+    cfg = GPTConfig(vocab_size=256, max_seq=128, hidden=128, num_layers=2,
+                    num_heads=2, dtype=jnp.bfloat16, remat=remat)
+    mesh = build_mesh(tp=1, pp=1, sp=1, devices=jax.devices()[:1])
+    params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+    opt = FusedAdam(lr=1e-4)
+    opt_state = opt.init(params)
+    tok = jnp.zeros((2, 128), jnp.int32)
+
+    def loss_fn(p, tok, tgt):
+        return jax.shard_map(
+            lambda p, t, y: gpt_loss(p, t, y, cfg), mesh=mesh,
+            in_specs=(gpt_param_specs(cfg), P(), P()),
+            out_specs=P())(p, tok, tgt)
+
+    def train_step(params, opt_state, tok, tgt):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tok, tgt)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = jax.tree.map(lambda p, u: p + u, params, updates)
+        return params, opt_state, loss
+
+    jaxpr = jax.make_jaxpr(train_step)(params, opt_state, tok, tok)
+    dots = _dot_dtypes(jaxpr)
+
+    mixed = {k: c for k, c in dots.items() if len(set(k)) > 1}
+    assert not mixed, (
+        f"mixed-dtype dots reintroduce the fp32-backward-matmul bug: {mixed}"
+    )
+    # the projection matmuls (4/layer fwd + their backwards) must be bf16
+    assert dots.get(("bfloat16", "bfloat16"), 0) >= 12, dots
