@@ -67,6 +67,34 @@ def test_tp_program_collective_budget():
     assert c["all-gather"] == 0 and c["reduce-scatter"] == 0, c
 
 
+def test_moe_dispatch_rides_all_to_all():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    mesh = build_mesh(tp=2, pp=1, sp=1, dp=4)
+    cfg = dataclasses.replace(BASE, num_experts=4, moe_top_k=2)
+    params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+    tok = jnp.zeros((4, 64), jnp.int32)
+
+    def loss(p, t, y):
+        def body(p, a, b):
+            return replicate_loss(gpt_loss(p, a, b, cfg), mesh,
+                                  masked_axis=None)
+
+        return jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(gpt_param_specs(cfg), P("dp"), P("dp")),
+            out_specs=P())(p, t, y)
+
+    txt = jax.jit(jax.grad(loss)).lower(params, tok, tok).compile().as_text()
+    c = {k: len(re.findall(k, txt)) for k in
+         ("all-gather", "all-to-all")}
+    # expert dispatch/combine must be all_to_all over the ep(=dp) axis —
+    # a fallback to gather-everything would be a silent traffic blow-up
+    assert c["all-to-all"] >= 4, c
+    assert c["all-to-all"] <= 44, c
+    assert c["all-gather"] == 0, c
+
+
 def test_megatron_sp_uses_gather_scatter_pairs():
     c = _counts(megatron_sp=True)
     # the feature itself: TP-block entry all-gathers + exit reduce-scatters
