@@ -2,7 +2,36 @@
 
 from __future__ import annotations
 
+import contextlib
+
 import jax
+
+_FORCE_COMPILED = False
+
+
+@contextlib.contextmanager
+def force_compiled():
+    """Treat the current backend as TPU for kernel dispatch: every Pallas
+    entry point selects its compiled (non-interpret) Mosaic path.
+
+    Exists for the AOT TPU-lowering regression guard
+    (``tests/test_tpu_lowering.py``): ``jit(f).trace(args).lower(
+    lowering_platforms=("tpu",))`` runs Mosaic's block-shape/layout
+    verification on a CPU-only box — interpret mode skips exactly those
+    checks, which is how a kernel that lowers nowhere can pass the whole
+    CPU suite (the varlen seg-block bug, round 4)."""
+    global _FORCE_COMPILED
+    prev = _FORCE_COMPILED
+    _FORCE_COMPILED = True
+    try:
+        yield
+    finally:
+        _FORCE_COMPILED = prev
+
+
+def compiled_backend() -> bool:
+    """True when kernel dispatch should pick the compiled Mosaic path."""
+    return _FORCE_COMPILED or jax.default_backend() == "tpu"
 
 
 def sds(shape, dtype, *like):

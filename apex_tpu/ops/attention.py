@@ -41,6 +41,7 @@ NEG_INF = -1e30
 
 
 from apex_tpu.ops._pallas_util import sds as _sds  # noqa: E402
+from apex_tpu.ops._pallas_util import compiled_backend as _compiled_backend
 
 
 # ---------------------------------------------------------------------------
@@ -677,7 +678,7 @@ def _pallas_ok(sq, sk, d, causal, allow_interpret):
         return False
     if causal and sq != sk:
         return False
-    return allow_interpret or jax.default_backend() == "tpu"
+    return allow_interpret or _compiled_backend()
 
 
 def flash_attention(
@@ -691,6 +692,7 @@ def flash_attention(
     dropout_rate: float = 0.0,
     dropout_seed=None,
     bias=None,
+    interpret: Optional[bool] = None,
 ):
     """Memory-efficient attention over (batch, heads, seq, head_dim).
 
@@ -737,6 +739,11 @@ def flash_attention(
             f"(got q {q.shape}, k {k.shape}, causal={causal}, "
             f"mask={'set' if mask is not None else None})")
     if not use_pallas:
+        if interpret is not None:
+            raise ValueError(
+                "interpret= only applies to the Pallas path; this call "
+                "resolved to the reference (pass use_pallas=True to force "
+                "the kernel, or drop interpret=)")
         key = None
         if dropout_rate > 0.0:
             key = jax.random.PRNGKey(jnp.asarray(dropout_seed).reshape(())
@@ -746,7 +753,8 @@ def flash_attention(
                                    dropout_key=key, bias=bias)
     bq = _pick_block(sq, block_q)
     bk = _pick_block(sk, block_k)
-    interpret = jax.default_backend() != "tpu"
+    if interpret is None:
+        interpret = not _compiled_backend()
     seed = (jnp.zeros((1,), jnp.int32) if dropout_seed is None
             else jnp.asarray(dropout_seed, jnp.int32).reshape((1,)))
     if bias is not None:

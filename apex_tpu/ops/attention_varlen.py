@@ -43,6 +43,7 @@ except Exception:  # pragma: no cover
     _HAS_PALLAS = False
 
 from apex_tpu.ops._pallas_util import sds as _sds
+from apex_tpu.ops._pallas_util import compiled_backend as _compiled_backend
 from apex_tpu.ops.attention import NEG_INF, _pick_block
 
 
@@ -82,12 +83,45 @@ def attention_varlen_reference(q, k, v, seg_q, seg_k=None,
 # Kernels. Grid (b, h, nq, nk) — batch and head split so the scalar-prefetch
 # block ranges (b, nq)/(b, nk) index directly by the first grid dim.
 
+# Mosaic requires a block's last two dims to be (8k, 128k)-divisible or
+# equal to the full array dims; a (1, block) slice of a (b, s) id array is
+# neither. Widen host-side instead (the jax.experimental flash kernel's
+# scheme): q ids broadcast along a 128-lane axis -> (b, sq, 128) so a
+# (1, block_q, 128) block is tile-legal and column 0 is the id column;
+# kv ids broadcast along an 8-sublane axis -> (b, 8, sk) so a
+# (1, 8, block_k) block is legal and row 0 is the id row.
+_SEG_LANES = 128
+_SEG_SUBLANES = 8
+
+
+def _pick_kv_block(sk: int, want: int):
+    """KV block size whose seg-id block is Mosaic-legal: the (1, 8, block_k)
+    seg_k tile has block_k on the LANE dim, so it must be a multiple of 128
+    — or one full-seq block (block == array dim is always legal; sublane
+    rules still need sk % 8 == 0). Returns None when neither exists
+    (callers fall back to the dense reference)."""
+    cand = _pick_block(sk, want)
+    if cand is not None and cand % 128 == 0:
+        return cand
+    if sk % 8 == 0 and sk <= 2048:  # one block; cap keeps K/V tiles in VMEM
+        return sk
+    return None
+
+
+def _seg_wide(seg_q, seg_k):
+    """(b, sq)/(b, sk) int32 ids -> tile-legal (b, sq, 128) / (b, 8, sk)."""
+    b, sq = seg_q.shape
+    sk = seg_k.shape[1]
+    segq3 = jax.lax.broadcast_in_dim(seg_q, (b, sq, _SEG_LANES), (0, 1))
+    segk3 = jax.lax.broadcast_in_dim(seg_k, (b, _SEG_SUBLANES, sk), (0, 2))
+    return segq3, segk3
+
+
 def _seg_tile(seg_q_ref, seg_k_ref):
-    """(1, bq) x (1, bk) segment blocks -> (bq, bk) allowed mask."""
-    sq = seg_q_ref[...]  # (1, bq)
-    sk = seg_k_ref[...]  # (1, bk)
-    sq_col = jnp.swapaxes(sq, 0, 1)  # (bq, 1)
-    return (sq_col == sk) & (sq_col >= 0)
+    """(1, bq, 128) x (1, 8, bk) segment blocks -> (bq, bk) allowed mask."""
+    sq_col = seg_q_ref[0, :, :1]  # (bq, 1)
+    sk_row = seg_k_ref[0, :1, :]  # (1, bk)
+    return (sq_col == sk_row) & (sq_col >= 0)
 
 
 def _skip(qmin_ref, qmax_ref, kmin_ref, kmax_ref, b_i, q_i, kv_i,
@@ -309,8 +343,9 @@ def _vl_call(q, k, v, seg_q, seg_k, scale, causal, block_q, block_k,
         return (b, h, jc, 0)
 
     def segk_index(b, h, i, j, qmn, qmx, kmn, kmx, jlo, jhi):
-        return (b, jnp.clip(j, jlo[b, i], jhi[b, i]))
+        return (b, 0, jnp.clip(j, jlo[b, i], jhi[b, i]))
 
+    seg_q3, seg_k3 = _seg_wide(seg_q, seg_k)
     kernel = functools.partial(
         _vl_fwd_kernel, scale=scale, causal=causal,
         block_q=block_q, block_k=block_k, nk=nk)
@@ -318,8 +353,9 @@ def _vl_call(q, k, v, seg_q, seg_k, scale, causal, block_q, block_k,
         num_scalar_prefetch=6,
         grid=(b, h, nq, nk),
         in_specs=[
-            pl.BlockSpec((1, block_q), lambda b, h, i, j, *_: (b, i)),
-            pl.BlockSpec((1, block_k), segk_index),
+            pl.BlockSpec((1, block_q, _SEG_LANES),
+                         lambda b, h, i, j, *_: (b, i, 0)),
+            pl.BlockSpec((1, _SEG_SUBLANES, block_k), segk_index),
             pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j, *_: (b, h, i, 0)),
             pl.BlockSpec((1, 1, block_k, d), kv_index),
             pl.BlockSpec((1, 1, block_k, d), kv_index),
@@ -345,7 +381,7 @@ def _vl_call(q, k, v, seg_q, seg_k, scale, causal, block_q, block_k,
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
-    )(qmin, qmax, kmin, kmax, jlo, jhi, seg_q, seg_k, q, k, v)
+    )(qmin, qmax, kmin, kmax, jlo, jhi, seg_q3, seg_k3, q, k, v)
     return o, lse
 
 
@@ -367,7 +403,9 @@ def _vl_bwd_call(q, k, v, seg_q, seg_k, o, lse, do, scale, causal,
         return (b, h, jnp.clip(j, jlo[b, i], jhi[b, i]), 0)
 
     def segk_index(b, h, i, j, qmn, qmx, kmn, kmx, jlo, jhi):
-        return (b, jnp.clip(j, jlo[b, i], jhi[b, i]))
+        return (b, 0, jnp.clip(j, jlo[b, i], jhi[b, i]))
+
+    seg_q3, seg_k3 = _seg_wide(seg_q, seg_k)
 
     dq = pl.pallas_call(
         functools.partial(_vl_bwd_dq_kernel, scale=scale, causal=causal,
@@ -376,8 +414,9 @@ def _vl_bwd_call(q, k, v, seg_q, seg_k, o, lse, do, scale, causal,
             num_scalar_prefetch=6,
             grid=(b, h, nq, nk),
             in_specs=[
-                pl.BlockSpec((1, block_q), lambda b, h, i, j, *_: (b, i)),
-                pl.BlockSpec((1, block_k), segk_index),
+                pl.BlockSpec((1, block_q, _SEG_LANES),
+                             lambda b, h, i, j, *_: (b, i, 0)),
+                pl.BlockSpec((1, _SEG_SUBLANES, block_k), segk_index),
                 pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j, *_: (b, h, i, 0)),
                 pl.BlockSpec((1, 1, block_k, d), kv_index),
                 pl.BlockSpec((1, 1, block_k, d), kv_index),
@@ -394,7 +433,7 @@ def _vl_bwd_call(q, k, v, seg_q, seg_k, o, lse, do, scale, causal,
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
-    )(qmin, qmax, kmin, kmax, jlo, jhi, seg_q, seg_k, q, k, v, do, lse, delta)
+    )(qmin, qmax, kmin, kmax, jlo, jhi, seg_q3, seg_k3, q, k, v, do, lse, delta)
 
     def q_index(b, h, j, i, qmn, qmx, kmn, kmx, ilo, ihi):
         return (b, h, jnp.clip(i, ilo[b, j], ihi[b, j]), 0)
@@ -403,7 +442,7 @@ def _vl_bwd_call(q, k, v, seg_q, seg_k, o, lse, do, scale, causal,
         return (b, h, jnp.clip(i, ilo[b, j], ihi[b, j]), 0)
 
     def segq_index(b, h, j, i, qmn, qmx, kmn, kmx, ilo, ihi):
-        return (b, jnp.clip(i, ilo[b, j], ihi[b, j]))
+        return (b, jnp.clip(i, ilo[b, j], ihi[b, j]), 0)
 
     dk, dv = pl.pallas_call(
         functools.partial(_vl_bwd_dkv_kernel, scale=scale, causal=causal,
@@ -412,8 +451,9 @@ def _vl_bwd_call(q, k, v, seg_q, seg_k, o, lse, do, scale, causal,
             num_scalar_prefetch=6,
             grid=(b, h, nk, nq),
             in_specs=[
-                pl.BlockSpec((1, block_q), segq_index),
-                pl.BlockSpec((1, block_k), lambda b, h, j, i, *_: (b, j)),
+                pl.BlockSpec((1, block_q, _SEG_LANES), segq_index),
+                pl.BlockSpec((1, _SEG_SUBLANES, block_k),
+                             lambda b, h, j, i, *_: (b, 0, j)),
                 pl.BlockSpec((1, 1, block_q, d), q_index),
                 pl.BlockSpec((1, 1, block_k, d), lambda b, h, j, i, *_: (b, h, j, 0)),
                 pl.BlockSpec((1, 1, block_k, d), lambda b, h, j, i, *_: (b, h, j, 0)),
@@ -440,7 +480,7 @@ def _vl_bwd_call(q, k, v, seg_q, seg_k, o, lse, do, scale, causal,
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
-    )(qmin, qmax, kmin, kmax, ilo, ihi, seg_q, seg_k, q, k, v, do, lse, delta)
+    )(qmin, qmax, kmin, kmax, ilo, ihi, seg_q3, seg_k3, q, k, v, do, lse, delta)
     return dq, dk, dv
 
 
@@ -479,11 +519,21 @@ def flash_attention_varlen(
     block_q: int = 128,
     block_k: int = 128,
     use_pallas: Optional[bool] = None,
+    interpret: Optional[bool] = None,
 ):
     """Packed-varlen attention over (b, h, s, d) with (b, s) segment ids.
 
     Pads (seg < 0) attend to nothing and output zero. Pallas kernels with
     block-level segment skipping on TPU; dense masked reference elsewhere.
+    ``block_k`` is a hint, not a contract: the widened seg-id lane layout
+    makes sub-128 kv blocks Mosaic-illegal, so a request that resolves to
+    one is coerced to the nearest legal size (a 128-multiple dividing the
+    seq, else one full-seq block — which also disables block skipping).
+    ``interpret`` selects interpret vs compiled Mosaic execution of the
+    Pallas path and therefore only applies when that path is taken; pass
+    ``use_pallas=True`` alongside it (``interpret=False`` + the
+    ``force_compiled()`` context is how the AOT TPU-lowering guard runs
+    Mosaic verification on a CPU box), else ValueError.
     """
     if seg_k is None:
         seg_k = seg_q
@@ -492,18 +542,26 @@ def flash_attention_varlen(
     if scale is None:
         scale = 1.0 / math.sqrt(d)
     bq = _pick_block(sq, block_q)
-    bk = _pick_block(sk, block_k)
+    bk = _pick_kv_block(sk, block_k)
     fits = (_HAS_PALLAS and bq is not None and bk is not None
             and d % 8 == 0)
     if use_pallas is None:
-        use_pallas = fits and jax.default_backend() == "tpu"
+        use_pallas = fits and _compiled_backend()
     elif use_pallas and not fits:
         raise ValueError(
             f"pallas flash_attention_varlen needs seq divisible by a block "
-            f"size and head_dim % 8 == 0 (got q {q.shape}, k {k.shape})")
+            f"size (kv: a 128-multiple block, or one 8-aligned full-seq "
+            f"block — the widened seg-id lane layout requires it) and "
+            f"head_dim % 8 == 0 (got q {q.shape}, k {k.shape})")
     if not use_pallas:
+        if interpret is not None:
+            raise ValueError(
+                "interpret= only applies to the Pallas path; this call "
+                "resolved to the dense reference (pass use_pallas=True "
+                "to force the kernel, or drop interpret=)")
         return attention_varlen_reference(q, k, v, seg_q, seg_k,
                                           causal=causal, scale=scale)
-    interpret = jax.default_backend() != "tpu"
+    if interpret is None:
+        interpret = not _compiled_backend()
     return _varlen(q, k, v, seg_q.astype(jnp.int32), seg_k.astype(jnp.int32),
                    scale, causal, bq, bk, interpret)

@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 
 from apex_tpu.ops._pallas_util import sds as _sds
+from apex_tpu.ops._pallas_util import compiled_backend as _compiled_backend
 
 try:  # Pallas is part of jax, but keep import-failure graceful (CPU-only envs)
     from jax.experimental import pallas as pl
@@ -166,11 +167,11 @@ def _pallas_ok(rows: int, hidden: int, allow_interpret: bool) -> bool:
         return False
     if hidden % 128 != 0:
         return False
-    return allow_interpret or jax.default_backend() == "tpu"
+    return allow_interpret or _compiled_backend()
 
 
 def _interpret_default() -> bool:
-    return jax.default_backend() != "tpu"
+    return not _compiled_backend()
 
 
 # ---------------------------------------------------------------------------

@@ -46,6 +46,7 @@ except Exception:  # pragma: no cover
     _HAS_PALLAS = False
 
 from apex_tpu.ops._pallas_util import sds as _sds
+from apex_tpu.ops._pallas_util import compiled_backend as _compiled_backend
 
 NEG_INF = -1e30
 
@@ -419,7 +420,7 @@ def lm_head_loss(
     bn = _resolve_block_n(n, block_n)
     fits = _HAS_PALLAS and bn is not None and h % 128 == 0
     if use_pallas is None:
-        use_pallas = fits and jax.default_backend() == "tpu"
+        use_pallas = fits and _compiled_backend()
     elif use_pallas and not fits:
         raise ValueError(
             f"pallas lm_head_loss needs pallas available, a row block "
@@ -427,7 +428,7 @@ def lm_head_loss(
     if bn is None:
         bn = n  # dense impl ignores the block size
     if use_pallas:
-        impl = ("pallas" if jax.default_backend() == "tpu"
+        impl = ("pallas" if _compiled_backend()
                 else "pallas_interpret")
     else:
         impl = "dense"

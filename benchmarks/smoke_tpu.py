@@ -10,8 +10,9 @@ LM-head+CE, scaled softmax, and label-smoothing CE. Target < 2 min.
 Run: ``python benchmarks/smoke_tpu.py [--out smoke.json]``. Each kernel
 records pass/fail + max-error vs the XLA reference; exit code 1 if any
 fail. On a non-TPU backend the same drives run with ``use_pallas`` left
-to its default (interpret/reference), flagged in the JSON — a dry
-rehearsal of the harness, not kernel evidence.
+to its default (reference fallback), flagged in the JSON: every
+Pallas-kernel row there is marked NOT ok — a dry rehearsal exercises the
+harness, it is not kernel evidence, and the exit code says so.
 """
 
 from __future__ import annotations
@@ -36,17 +37,40 @@ def _results():
     k = jax.random.PRNGKey(0)
     out = []
 
-    def record(name, fn, tol=5e-2):
+    def record(name, fn, tol=5e-2, zero_is_fallback=False,
+               pallas_row=False):
         # ok requires err WITHIN the per-kernel tolerance (advisor r3): a
         # finite-but-large error vs the XLA reference must fail the gate,
         # not pass it. tol=0.0 demands bitwise equality (dropout determinism).
+        # zero_is_fallback: a kernel compared against a separately-computed
+        # matmul-precision-highest reference cannot be bitwise equal —
+        # err == 0.0 means the Pallas path silently fell back and the row
+        # compared the reference against itself (round-4 find: the first
+        # committed smoke's attention rows were exactly this, and the
+        # CPU-rehearsal artifact later overwrote the real one looking all
+        # green). Such a row is not kernel evidence on ANY backend, so it
+        # must FAIL, not pass — which also makes the CPU rehearsal's exit
+        # code honest (the harness ran; the kernels were not exercised).
         t0 = time.perf_counter()
         try:
             err = float(fn())
-            out.append({"kernel": name,
-                        "ok": bool(np.isfinite(err) and err <= tol),
-                        "max_err": err, "tol": tol,
-                        "seconds": round(time.perf_counter() - t0, 2)})
+            ok = bool(np.isfinite(err) and err <= tol)
+            row = {"kernel": name, "ok": ok, "max_err": err, "tol": tol,
+                   "seconds": round(time.perf_counter() - t0, 2)}
+            if zero_is_fallback and err == 0.0:
+                row["ok"] = False
+                row["error"] = ("err == 0.0: kernel-vs-reference cannot be "
+                                "bitwise equal; the Pallas path fell back "
+                                "(not kernel evidence)")
+            if pallas_row and not on_tpu:
+                # off-TPU the drive runs reference fallbacks whose rows can
+                # still look green (reviewer find: the dropout fallback is
+                # also seed-deterministic, the dense LM-head is ~1e-7 from
+                # loss_ref) — a rehearsal row is never kernel evidence
+                row["ok"] = False
+                row.setdefault("error", "CPU rehearsal: reference fallback, "
+                                        "not kernel evidence")
+            out.append(row)
         except Exception as e:  # noqa: BLE001 — record, keep smoking
             out.append({"kernel": name, "ok": False,
                         "error": f"{type(e).__name__}: {str(e)[:300]}",
@@ -55,26 +79,50 @@ def _results():
 
     from apex_tpu.ops.attention import attention_reference, flash_attention
 
+    # Attention runs in bf16 — the model dtype the kernels exist for. The
+    # reference is traced under matmul precision "highest" so its fp32
+    # einsums are true fp32 even on TPU (the default lowers fp32 dots to
+    # one bf16 MXU pass, making the *reference* bf16-accurate — round-4
+    # find: per-element relative error between two bf16-class results on
+    # near-zero outputs read as O(1) "failures" on a correct kernel).
+    # Error metric: max |a-b| normalized by the reference's max |b| —
+    # scale-relative, stable at near-zero entries.
     b, h, s, d = 2, 4, 1024, 64
-    q = jax.random.normal(k, (b, h, s, d), jnp.float32)
-    kk = jax.random.normal(jax.random.fold_in(k, 1), (b, h, s, d), jnp.float32)
-    v = jax.random.normal(jax.random.fold_in(k, 2), (b, h, s, d), jnp.float32)
+    q = jax.random.normal(k, (b, h, s, d), jnp.bfloat16)
+    kk = jax.random.normal(jax.random.fold_in(k, 1), (b, h, s, d),
+                           jnp.bfloat16)
+    v = jax.random.normal(jax.random.fold_in(k, 2), (b, h, s, d),
+                          jnp.bfloat16)
+
+    def nerr(got, want):
+        """max-abs error normalized by the reference tensor's scale."""
+        return max(
+            float(jnp.max(jnp.abs(a.astype(jnp.float32)
+                                  - b_.astype(jnp.float32)))
+                  / (jnp.max(jnp.abs(b_.astype(jnp.float32))) + 1e-12))
+            for a, b_ in zip(got, want))
+
+    def ref_grad(loss_ref, argnums, *args):
+        with jax.default_matmul_precision("highest"):
+            return jax.jit(jax.grad(loss_ref, argnums=argnums))(*args)
 
     def flash_fwd_bwd():
         def loss(q, kk, v):
             return jnp.sum(flash_attention(q, kk, v, causal=True,
-                                           use_pallas=force) ** 2)
+                                           use_pallas=force)
+                           .astype(jnp.float32) ** 2)
 
         def loss_ref(q, kk, v):
-            return jnp.sum(attention_reference(q, kk, v, causal=True) ** 2)
+            return jnp.sum(attention_reference(q, kk, v, causal=True)
+                           .astype(jnp.float32) ** 2)
 
         g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(q, kk, v)
-        gr = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))(q, kk, v)
+        gr = ref_grad(loss_ref, (0, 1, 2), q, kk, v)
         jax.block_until_ready(g)
-        return max(float(jnp.max(jnp.abs(a - b_) / (jnp.abs(b_) + 1e-3)))
-                   for a, b_ in zip(g, gr))
+        return nerr(g, gr)
 
-    record("flash_attention_fwd_bwd_causal", flash_fwd_bwd)
+    record("flash_attention_fwd_bwd_causal", flash_fwd_bwd, tol=2e-2,
+           zero_is_fallback=True, pallas_row=True)
 
     def dropout_determinism():
         f = jax.jit(lambda q, kk, v: flash_attention(
@@ -90,7 +138,8 @@ def _results():
         # same seed -> bitwise equal; different seed -> visibly different
         return same if differs > 1e-3 else float("nan")
 
-    record("flash_attention_inkernel_dropout", dropout_determinism, tol=0.0)
+    record("flash_attention_inkernel_dropout", dropout_determinism, tol=0.0,
+           pallas_row=True)
 
     def bias_fwd_bwd():
         # T5 relative-position-bias contract: batch-shared (h, sq, sk)
@@ -100,19 +149,21 @@ def _results():
 
         def loss(q, kk, v, bias):
             return jnp.sum(flash_attention(q, kk, v, causal=True,
-                                           use_pallas=force, bias=bias) ** 2)
+                                           use_pallas=force, bias=bias)
+                           .astype(jnp.float32) ** 2)
 
         def loss_ref(q, kk, v, bias):
             return jnp.sum(attention_reference(q, kk, v, causal=True,
-                                               bias=bias) ** 2)
+                                               bias=bias)
+                           .astype(jnp.float32) ** 2)
 
         g = jax.jit(jax.grad(loss, argnums=(0, 1, 2, 3)))(q, kk, v, bias)
-        gr = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2, 3)))(q, kk, v, bias)
+        gr = ref_grad(loss_ref, (0, 1, 2, 3), q, kk, v, bias)
         jax.block_until_ready(g)
-        return max(float(jnp.max(jnp.abs(a - b_) / (jnp.abs(b_) + 1e-3)))
-                   for a, b_ in zip(g, gr))
+        return nerr(g, gr)
 
-    record("flash_attention_additive_bias", bias_fwd_bwd)
+    record("flash_attention_additive_bias", bias_fwd_bwd, tol=2e-2,
+           zero_is_fallback=True, pallas_row=True)
 
     from apex_tpu.ops.attention_varlen import (
         attention_varlen_reference,
@@ -126,19 +177,20 @@ def _results():
     def varlen_fwd_bwd():
         def loss(q, kk, v):
             return jnp.sum(flash_attention_varlen(
-                q, kk, v, seg, causal=True, use_pallas=force) ** 2)
+                q, kk, v, seg, causal=True, use_pallas=force)
+                .astype(jnp.float32) ** 2)
 
         def loss_ref(q, kk, v):
             return jnp.sum(attention_varlen_reference(
-                q, kk, v, seg, causal=True) ** 2)
+                q, kk, v, seg, causal=True).astype(jnp.float32) ** 2)
 
         g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(q, kk, v)
-        gr = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))(q, kk, v)
+        gr = ref_grad(loss_ref, (0, 1, 2), q, kk, v)
         jax.block_until_ready(g)
-        return max(float(jnp.max(jnp.abs(a - b_) / (jnp.abs(b_) + 1e-3)))
-                   for a, b_ in zip(g, gr))
+        return nerr(g, gr)
 
-    record("flash_attention_varlen_block_skip", varlen_fwd_bwd)
+    record("flash_attention_varlen_block_skip", varlen_fwd_bwd,
+           tol=2e-2, zero_is_fallback=True, pallas_row=True)
 
     from apex_tpu.ops.layer_norm import layer_norm, layer_norm_reference
 
@@ -160,7 +212,8 @@ def _results():
             return max(float(jnp.max(jnp.abs(a - b_) / (jnp.abs(b_) + 1e-2)))
                        for a, b_ in zip(g, gr))
 
-        record(f"pallas_layer_norm_h{tag}", ln_fwd_bwd)
+        record(f"pallas_layer_norm_h{tag}", ln_fwd_bwd,
+               zero_is_fallback=True, pallas_row=True)
 
     from apex_tpu.ops.lm_head_loss import lm_head_loss
 
@@ -183,7 +236,7 @@ def _results():
         return max(float(jnp.max(jnp.abs(a - b_) / (jnp.abs(b_) + 1e-4)))
                    for a, b_ in zip(g, gr))
 
-    record("fused_lm_head_cross_entropy", fused_head)
+    record("fused_lm_head_cross_entropy", fused_head, pallas_row=True)
 
     from apex_tpu.ops.softmax import scaled_upper_triang_masked_softmax
     from apex_tpu.ops.xentropy import softmax_cross_entropy_loss

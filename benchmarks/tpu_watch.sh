@@ -78,23 +78,56 @@ PY
   return 0
 }
 
+smoke_green() {
+  # banked smoke is real-TPU and all-pass
+  [ -s SMOKE_TPU.json ] && grep -q '"on_tpu": true' SMOKE_TPU.json \
+    && ! grep -q '"ok": false' SMOKE_TPU.json
+}
+
+smoke_stage() {
+  # Smoke to a temp file; promote ANY real-TPU artifact (a failing kernel
+  # on the chip is exactly the evidence we must bank) but never a CPU
+  # rehearsal (whose fallback rows intentionally fail) — if the tunnel
+  # dies between our probe and the smoke, SMOKE_TPU.json keeps the last
+  # on-chip run. State advances only on an all-pass TPU run.
+  note "STAGE2 START: smoke_tpu.py"
+  rm -f /tmp/smoke_try.json
+  timeout 900 python benchmarks/smoke_tpu.py --out /tmp/smoke_try.json \
+    > /tmp/tpu_stage2.out 2> /tmp/tpu_stage2.err
+  local rc=$?
+  note "STAGE2 EXIT=$rc"
+  [ -s /tmp/smoke_try.json ] || return 1
+  if ! grep -q '"on_tpu": true' /tmp/smoke_try.json; then
+    note "STAGE2 got CPU rehearsal, not promoting"
+    return 1
+  fi
+  cp /tmp/smoke_try.json SMOKE_TPU.json
+  note "STAGE2 PROMOTED (rc=$rc)"
+  [ $rc -eq 0 ] || return 1
+  [ "$(cat "$STATE")" -lt 2 ] && echo 2 > "$STATE"
+  return 0
+}
+
 while true; do
   if timeout 240 python -c "import jax, jax.numpy as jnp; assert jax.default_backend()=='tpu'; x=jnp.ones((128,128),jnp.bfloat16); assert float((x@x).sum())>0" > /tmp/tpu_watch_probe.log 2>&1; then
     note HEALTHY
     done_stage=$(cat "$STATE")
     now=$(date +%s)
     if [ "$done_stage" -ge 6 ]; then
-      # full suite already banked: refresh the headline at most hourly
+      # full suite already banked: refresh the headline at most hourly.
+      # A non-green smoke retries on the same hourly cadence (kernel
+      # fixes land while the tunnel is down, so a failed on-chip smoke
+      # must not be the permanent record — but a genuinely failing
+      # kernel must not burn every 120 s iteration re-proving it)
       if [ $((now - last_refresh)) -ge 3600 ]; then
+        smoke_green || smoke_stage
         bench_stage 1 600 --quick
         bench_stage 3 2400
         last_refresh=$now
       fi
     else
       [ "$done_stage" -lt 1 ] && bench_stage 1 600 --quick
-      [ "$(cat "$STATE")" -ge 1 ] && [ "$done_stage" -lt 2 ] && \
-        run_stage 2 900 SMOKE_TPU.json \
-        python benchmarks/smoke_tpu.py --out SMOKE_TPU.json
+      [ "$(cat "$STATE")" -ge 1 ] && ! smoke_green && smoke_stage
       [ "$(cat "$STATE")" -ge 1 ] && [ "$done_stage" -lt 3 ] && \
         bench_stage 3 2400
       [ "$(cat "$STATE")" -ge 3 ] && run_stage 4 1200 PROFILE_TPU.txt \

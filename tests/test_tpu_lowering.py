@@ -1,0 +1,196 @@
+"""AOT TPU-lowering guard for every compiled Pallas kernel path.
+
+``jit(f).trace(args).lower(lowering_platforms=("tpu",))`` runs the full
+Pallas→Mosaic lowering — block-shape tiling rules, layout checks, scalar
+prefetch plumbing — on a CPU-only box, with no TPU attached. Interpret
+mode (what the rest of the CPU suite exercises) skips exactly those
+checks, which is how the varlen kernels' seg-id block shape
+(``(1, block)`` slice of a ``(b, s)`` array — sublane dim neither
+8-divisible nor full) passed 300+ tests while being unlowerable on
+hardware (round-4 find; fixed by the jax-flash-style widened id layout,
+``attention_varlen._seg_wide``).
+
+Every kernel the TPU smoke (``benchmarks/smoke_tpu.py``) executes on the
+chip must lower here first. Reference parity note: the reference compiles
+its CUDA kernels at build time (``setup.py:119-630``) so an unbuildable
+kernel fails CI without a GPU; this is the TPU analogue.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from apex_tpu.ops._pallas_util import force_compiled
+
+
+def _lower_tpu(f, *args):
+    return jax.jit(f).trace(*args).lower(lowering_platforms=("tpu",))
+
+
+B, H, S, D = 2, 4, 1024, 64
+
+
+@pytest.fixture()
+def qkv():
+    k = jax.random.PRNGKey(0)
+    q = jax.random.normal(k, (B, H, S, D), jnp.bfloat16)
+    kk = jax.random.normal(jax.random.fold_in(k, 1), (B, H, S, D),
+                           jnp.bfloat16)
+    v = jax.random.normal(jax.random.fold_in(k, 2), (B, H, S, D),
+                          jnp.bfloat16)
+    return q, kk, v
+
+
+def test_flash_attention_fwd_bwd_causal(qkv):
+    from apex_tpu.ops.attention import flash_attention
+
+    q, k, v = qkv
+
+    def loss(q, k, v):
+        o = flash_attention(q, k, v, causal=True, use_pallas=True,
+                            interpret=False)
+        return jnp.sum(o.astype(jnp.float32) ** 2)
+
+    with force_compiled():
+        _lower_tpu(jax.grad(loss, argnums=(0, 1, 2)), q, k, v)
+
+
+def test_flash_attention_dropout(qkv):
+    from apex_tpu.ops.attention import flash_attention
+
+    q, k, v = qkv
+
+    def loss(q, k, v):
+        o = flash_attention(q, k, v, causal=True, use_pallas=True,
+                            interpret=False, dropout_rate=0.1,
+                            dropout_seed=jnp.int32(7))
+        return jnp.sum(o.astype(jnp.float32) ** 2)
+
+    with force_compiled():
+        _lower_tpu(jax.grad(loss, argnums=(0, 1, 2)), q, k, v)
+
+
+def test_flash_attention_bias(qkv):
+    from apex_tpu.ops.attention import flash_attention
+
+    q, k, v = qkv
+    bias = jnp.zeros((H, S, S), jnp.float32)
+
+    def loss(q, k, v, bias):
+        o = flash_attention(q, k, v, causal=True, bias=bias,
+                            use_pallas=True, interpret=False)
+        return jnp.sum(o.astype(jnp.float32) ** 2)
+
+    with force_compiled():
+        _lower_tpu(jax.grad(loss, argnums=(0, 1, 2, 3)), q, k, v, bias)
+
+
+def test_flash_attention_unequal_blocks(qkv):
+    from apex_tpu.ops.attention import flash_attention
+
+    q, k, v = qkv
+
+    def loss(q, k, v):
+        o = flash_attention(q, k, v, causal=True, use_pallas=True,
+                            interpret=False, block_q=256, block_k=512)
+        return jnp.sum(o.astype(jnp.float32) ** 2)
+
+    with force_compiled():
+        _lower_tpu(jax.grad(loss, argnums=(0, 1, 2)), q, k, v)
+
+
+def test_varlen_fwd_bwd(qkv):
+    from apex_tpu.ops.attention_varlen import flash_attention_varlen
+
+    q, k, v = qkv
+    # two packed sequences + trailing pad per row
+    seg = jnp.where(jnp.arange(S) < 600, 0,
+                    jnp.where(jnp.arange(S) < 1000, 1, -1))
+    seg = jnp.broadcast_to(seg, (B, S)).astype(jnp.int32)
+
+    def loss(q, k, v):
+        o = flash_attention_varlen(q, k, v, seg, causal=True,
+                                   use_pallas=True, interpret=False)
+        return jnp.sum(o.astype(jnp.float32) ** 2)
+
+    with force_compiled():
+        _lower_tpu(jax.grad(loss, argnums=(0, 1, 2)), q, k, v)
+
+
+def test_varlen_sub128_seq_lowers_or_falls_back():
+    """seqs divisible by 8 but not 128 (reviewer repro: s=192): the widened
+    seg-id lane layout forbids sub-128 kv blocks, so the picker must choose
+    one full-seq block (legal: block == array dim) — and the forced Pallas
+    path must lower."""
+    from apex_tpu.ops.attention_varlen import flash_attention_varlen
+
+    s = 192
+    q = jnp.zeros((B, H, s, D), jnp.bfloat16)
+    seg = jnp.zeros((B, s), jnp.int32)
+
+    def loss(q):
+        o = flash_attention_varlen(q, q, q, seg, causal=True,
+                                   use_pallas=True, interpret=False)
+        return jnp.sum(o.astype(jnp.float32) ** 2)
+
+    with force_compiled():
+        _lower_tpu(jax.grad(loss), q)
+
+
+def test_varlen_unalignable_seq_raises_when_forced():
+    from apex_tpu.ops.attention_varlen import flash_attention_varlen
+
+    s = 100  # not divisible by 8: no legal block at all
+    q = jnp.zeros((B, H, s, D), jnp.bfloat16)
+    seg = jnp.zeros((B, s), jnp.int32)
+    with pytest.raises(ValueError, match="pallas flash_attention_varlen"):
+        flash_attention_varlen(q, q, q, seg, use_pallas=True)
+
+
+def test_interpret_arg_rejected_on_reference_path():
+    """interpret= silently ignored on the fallback path was the round-4
+    silent-fallback trap; both entry points must reject it loudly."""
+    from apex_tpu.ops.attention import flash_attention
+    from apex_tpu.ops.attention_varlen import flash_attention_varlen
+
+    q = jnp.zeros((B, H, 256, D), jnp.bfloat16)
+    seg = jnp.zeros((B, 256), jnp.int32)
+    with pytest.raises(ValueError, match="interpret= only applies"):
+        flash_attention(q, q, q, mask=jnp.zeros((256, 256), bool),
+                        interpret=False)
+    with pytest.raises(ValueError, match="interpret= only applies"):
+        flash_attention_varlen(q, q, q, seg, use_pallas=False,
+                               interpret=False)
+
+
+@pytest.mark.parametrize("hidden", [1024, 16384])
+def test_layer_norm(hidden):
+    from apex_tpu.ops.layer_norm import layer_norm
+
+    x = jnp.ones((256, hidden), jnp.bfloat16)
+    w = jnp.ones((hidden,), jnp.float32)
+    b = jnp.zeros((hidden,), jnp.float32)
+
+    def loss(x, w, b):
+        return jnp.sum(layer_norm(x, w, b, use_pallas=True)
+                       .astype(jnp.float32) ** 2)
+
+    with force_compiled():
+        _lower_tpu(jax.grad(loss, argnums=(0, 1, 2)), x, w, b)
+
+
+def test_lm_head_loss():
+    from apex_tpu.ops.lm_head_loss import lm_head_loss
+
+    n, h, vocab = 512, 768, 50304
+    x = jnp.ones((n, h), jnp.bfloat16)
+    w = jnp.ones((vocab, h), jnp.bfloat16)
+    t = jnp.zeros((n,), jnp.int32)
+
+    def loss(x, w):
+        return jnp.sum(lm_head_loss(x, w, t, use_pallas=True))
+
+    with force_compiled():
+        _lower_tpu(jax.grad(loss, argnums=(0, 1)), x, w)
