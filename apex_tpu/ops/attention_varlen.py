@@ -98,11 +98,14 @@ def _pick_kv_block(sk: int, want: int):
     """KV block size whose seg-id block is Mosaic-legal: the (1, 8, block_k)
     seg_k tile has block_k on the LANE dim, so it must be a multiple of 128
     — or one full-seq block (block == array dim is always legal; sublane
-    rules still need sk % 8 == 0). Returns None when neither exists
-    (callers fall back to the dense reference)."""
-    cand = _pick_block(sk, want)
-    if cand is not None and cand % 128 == 0:
-        return cand
+    rules still need sk % 8 == 0). A sub-128 ``want`` is coerced UP to the
+    smallest legal size (128) rather than down: 128 divides every seq a
+    sub-128 power-of-two block would have divided more often than not, and
+    honoring the hint exactly is impossible. Returns None when nothing is
+    legal (callers fall back to the dense reference)."""
+    for cand in (1024, 512, 256, 128):
+        if cand <= max(want, 128) and cand <= sk and sk % cand == 0:
+            return cand
     if sk % 8 == 0 and sk <= 2048:  # one block; cap keeps K/V tiles in VMEM
         return sk
     return None

@@ -90,8 +90,10 @@ def ring_attention(
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     if impl == "auto":
+        from apex_tpu.ops._pallas_util import compiled_backend
+
         b, h, s_loc, d = q.shape
-        use_pallas = (jax.default_backend() == "tpu"
+        use_pallas = (compiled_backend()
                       and _pallas_ok(s_loc, s_loc, d, causal=False,
                                      allow_interpret=False))
         if bias_strip is not None:

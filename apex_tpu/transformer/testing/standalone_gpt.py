@@ -610,11 +610,10 @@ def _use_fused_loss(cfg: GPTConfig, n_rows: int) -> bool:
     than the unfused bf16 logits + CE path."""
     if not cfg.fused_loss:
         return False
-    import jax as _jax
-
+    from apex_tpu.ops._pallas_util import compiled_backend
     from apex_tpu.ops.lm_head_loss import pallas_fits
 
-    if _jax.default_backend() == "tpu":
+    if compiled_backend():
         return pallas_fits(n_rows, cfg.hidden)
     return True  # CPU/virtual mesh: dense impl, exercised for coverage
 

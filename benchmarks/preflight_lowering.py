@@ -1,0 +1,108 @@
+"""Pre-flight: AOT-lower the flagship bench path for TPU from a CPU box.
+
+A healthy tunnel window is scarce (rounds 2-3 had none; round 4's two
+windows totalled ~30 min). Every Pallas/Mosaic lowering failure found
+here instead of on the chip saves window minutes for measurement. This
+traces bench.py's OWN ``build_train_step`` (same model, same code path
+the headline times) for every auto-tune sweep configuration plus the
+ring-attention long-context step, and lowers each for the TPU target —
+the full Mosaic tiling/layout verification, no chip needed
+(``tests/test_tpu_lowering.py`` guards single kernels; this guards the
+composed programs).
+
+Run: ``JAX_PLATFORMS=cpu python benchmarks/preflight_lowering.py``
+Exit 1 if any configuration fails to lower.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+
+from apex_tpu.ops._pallas_util import force_compiled
+
+
+def _lower(tag, f, *args, min_kernels=1):
+    """Lower for TPU and require >= min_kernels Mosaic custom calls in the
+    module — a preflight that silently lowers the reference fallback
+    (because some dispatch site checks the live backend instead of
+    ``compiled_backend()``) would de-risk nothing."""
+    t0 = time.perf_counter()
+    try:
+        with force_compiled():
+            lo = jax.jit(f).trace(*args).lower(lowering_platforms=("tpu",))
+        n = lo.as_text().count("tpu_custom_call")
+        if n < min_kernels:
+            print(f"FAIL {tag}: only {n} tpu_custom_call(s) in the lowered "
+                  f"module (expected >= {min_kernels}) — a kernel dispatch "
+                  f"site fell back to the reference", flush=True)
+            return False
+        print(f"OK   {tag}  ({n} kernels, {time.perf_counter() - t0:.1f}s)",
+              flush=True)
+        return True
+    except Exception as e:  # noqa: BLE001 — report, keep going
+        print(f"FAIL {tag}: {type(e).__name__}: {str(e)[:300]}", flush=True)
+        return False
+
+
+def main() -> int:
+    ok = True
+
+    # --- the flagship train step, every sweep configuration -------------
+    # bench.py sweeps (remat, policy, scan_unroll); batch does not change
+    # lowering legality, so lower each distinct program shape once at a
+    # small batch to keep tracing fast.
+    import bench
+
+    seq = 1024
+    for remat, policy, unroll in [(False, "full", 1), (True, "full", 1),
+                                  (True, "dots", 1), (False, "full", 12),
+                                  (True, "dots", 12)]:
+        cfg = bench.flagship_config(
+            seq, remat=remat, remat_policy=policy, scan_unroll=unroll)
+        step, params, opt_state, tok, tgt = bench.build_train_step(
+            cfg, batch=2, seq=seq)
+        ok &= _lower(
+            f"train_step remat={remat}/{policy} unroll={unroll}",
+            step, params, opt_state, tok, tgt, min_kernels=4)
+
+    # --- ring attention (long-context SP path), fwd + bwd ---------------
+    from apex_tpu.parallel.mesh import build_mesh
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu.transformer.sequence_parallel import ring_attention
+
+    n = min(4, len(jax.devices()))
+    mesh = build_mesh(tp=1, pp=1, sp=n, devices=jax.devices()[:n])
+    b, h, s, d = 1, 4, 512 * n, 64
+    q = jnp.zeros((b, h, s, d), jnp.bfloat16)
+
+    def ring_loss(q, k, v):
+        def body(q, k, v):
+            o = ring_attention(q, k, v, axis_name="sp", causal=True)
+            return jnp.sum(o.astype(jnp.float32) ** 2)
+
+        f = jax.shard_map(body, mesh=mesh,
+                          in_specs=(P(None, None, "sp"),) * 3,
+                          out_specs=P(), check_vma=False)
+        return jnp.sum(f(q, k, v))
+
+    ok &= _lower("ring_attention sp fwd+bwd",
+                 jax.grad(ring_loss, argnums=(0, 1, 2)), q, q, q,
+                 min_kernels=2)
+
+    print("PREFLIGHT", "PASS" if ok else "FAIL", flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

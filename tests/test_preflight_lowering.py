@@ -1,0 +1,27 @@
+"""The composed-program lowering preflight must stay green: every bench
+sweep configuration of the flagship train step and the ring-attention SP
+step AOT-lower for TPU with their Mosaic kernels present (not the
+reference fallbacks). Complements tests/test_tpu_lowering.py (single
+kernels) at the program level bench.py actually times.
+
+Runs in a subprocess: the preflight pins the process to the CPU platform
+at import time, which must not leak into the pytest process (reviewer
+find — collection-order-dependent backend state)."""
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def test_preflight_lowering_passes():
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "benchmarks" / "preflight_lowering.py")],
+        capture_output=True, text=True, timeout=540, env=env, cwd=REPO)
+    assert proc.returncode == 0, (
+        f"preflight failed:\n{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}")
+    assert "PREFLIGHT PASS" in proc.stdout
