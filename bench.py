@@ -104,12 +104,15 @@ def build_train_step(cfg, batch: int, seq: int):
 
 
 def _measure(remat: bool, remat_policy: str, batch: int, seq: int,
-             steps: int, warm_steps: int = 2, unroll: int = 1):
+             steps: int, warm_steps: int = 2, unroll: int = 1,
+             **cfg_overrides):
     """(tokens/s, n_params, error) of the flagship train step under one
     config; tokens/s is None when it fails (e.g. OOM with remat off).
-    Fresh params each call — donation consumes the previous buffers."""
+    Fresh params each call — donation consumes the previous buffers.
+    ``cfg_overrides`` go straight to flagship_config (fused_loss,
+    ln_pallas, ...) so A/B sweeps share this one fence/timing protocol."""
     cfg = flagship_config(seq, remat=remat, remat_policy=remat_policy,
-                          scan_unroll=unroll)
+                          scan_unroll=unroll, **cfg_overrides)
     train_step, params, opt_state, tok, tgt = build_train_step(
         cfg, batch, seq)
     n_params = sum(x.size for x in jax.tree.leaves(params))
@@ -157,7 +160,7 @@ def main() -> None:
         # Last-known-good config (ran on the real chip in round 1):
         # guaranteed-fit remat-full at the full batch. One compile, short
         # timed run.
-        candidates = [(batch, True, "full", 1)]
+        candidates = [(batch, True, "full", 1, True)]
         steps = min(steps, 8)
     else:
         # Auto-tune (batch, remat, scan_unroll) jointly: no-remat and
@@ -171,14 +174,20 @@ def main() -> None:
         # per-candidate provisional banking degrade gracefully. Double
         # batch amortizes fixed per-step cost; OOM is caught and skipped,
         # so probing above the estimated HBM fit only costs its compile.
-        candidates = [(batch, False, "full", 1),
-                      (batch * 2, False, "full", 1),
-                      (batch, True, "dots", 1),
-                      (batch, False, "full", 12),
-                      (batch, True, "dots", 12),
-                      (batch * 2, True, "dots", 1),
-                      (batch, True, "full", 1),
-                      (batch // 2, False, "full", 1)]
+        # the trailing bool is GPTConfig.fused_loss: the Pallas fused
+        # LM-head+CE avoids the 3.2 GB logits but its matmul must keep up
+        # with XLA's near-peak native head matmul — the sweep answers it
+        # empirically rather than assuming the kernel wins
+        candidates = [(batch, False, "full", 1, True),
+                      (batch, False, "full", 1, False),
+                      (batch * 2, False, "full", 1, True),
+                      (batch, True, "dots", 1, True),
+                      (batch, False, "full", 12, True),
+                      (batch, True, "dots", 12, True),
+                      (batch, True, "full", 1, False),
+                      (batch * 2, True, "dots", 1, True),
+                      (batch, True, "full", 1, True),
+                      (batch // 2, False, "full", 1, True)]
         # the watcher's banked winner (BENCH_watch.json tuned_config) goes
         # first: when the staged watcher already tuned on this chip, the
         # sweep opens with the known-best config and the budget spends the
@@ -188,17 +197,18 @@ def main() -> None:
                     __file__)), "BENCH_watch.json")) as f:
                 tc = json.load(f).get("tuned_config")
             cand = (tc["batch"], tc["remat"], tc["policy"],
-                    tc.get("scan_unroll", 1))
+                    tc.get("scan_unroll", 1), tc.get("fused", True))
             if cand in candidates:
                 candidates.remove(cand)
             candidates.insert(0, cand)
         except Exception:
             pass
     if not on_tpu:
-        candidates = [(batch, True, "full", 1)]  # CPU: one cheap config
+        candidates = [(batch, True, "full", 1, True)]  # CPU: one cheap config
     import sys
 
-    def emit(tokens_per_s, batch, remat, policy, unroll, provisional):
+    def emit(tokens_per_s, batch, remat, policy, unroll, fused,
+             provisional):
         cfg = flagship_config(seq)
         fpt = 6 * n_params + 6 * cfg.num_layers * cfg.hidden * seq
         mfu = tokens_per_s * fpt / PEAK_FLOPS.get(backend, 1e12)
@@ -211,7 +221,8 @@ def main() -> None:
             "unit": "tokens/s",
             "vs_baseline": round(mfu / 0.70, 4),
             "tuned_config": {"batch": batch, "remat": remat,
-                             "policy": policy, "scan_unroll": unroll},
+                             "policy": policy, "scan_unroll": unroll,
+                             "fused": fused},
         }
         if provisional:
             rec["provisional"] = True  # best-so-far from the short sweep
@@ -229,43 +240,43 @@ def main() -> None:
     t_start = time.perf_counter()
 
     best, best_tps, n_params, last_err = None, 0.0, 0, None
-    for cand_batch, remat, policy, unroll in candidates:
+    for cand_batch, remat, policy, unroll, fused in candidates:
         if best is not None and time.perf_counter() - t_start > budget_s:
             print(f"# sweep budget ({budget_s:.0f}s) reached, finalizing "
                   f"with best so far", file=sys.stderr, flush=True)
             break
         tps, n_params, err = _measure(remat, policy, cand_batch, seq,
                                       steps=3 if on_tpu else 1,
-                                      unroll=unroll)
+                                      unroll=unroll, fused_loss=fused)
         # per-candidate line on stderr: one tunnel window yields the whole
         # tuning picture even if a later candidate hangs the run
         print(f"# candidate batch={cand_batch} remat={remat}/{policy} "
-              f"unroll={unroll}: "
+              f"unroll={unroll} fused={fused}: "
               + (f"{tps:.1f} tokens/s" if tps is not None else f"FAIL {err}"),
               file=sys.stderr, flush=True)
         if err is not None:
             last_err = (f"batch={cand_batch} remat={remat}/{policy} "
-                        f"unroll={unroll}: {err}")
+                        f"unroll={unroll} fused={fused}: {err}")
         if tps is not None and tps > best_tps:
-            best, best_tps = (cand_batch, remat, policy, unroll), tps
+            best, best_tps = (cand_batch, remat, policy, unroll, fused), tps
             # bank the best-so-far to --out: a timeout mid-sweep (the
             # watcher's staged-fire contract) still leaves a real number
-            emit(best_tps, cand_batch, remat, policy, unroll,
+            emit(best_tps, cand_batch, remat, policy, unroll, fused,
                  provisional=True)
 
     if best is None:
         raise RuntimeError(f"no bench config ran successfully; last error: "
                            f"{last_err}")
-    batch, remat, policy, unroll = best
+    batch, remat, policy, unroll, fused = best
     tokens_per_s, n_params, err = _measure(remat, policy, batch, seq, steps,
-                                           unroll=unroll)
+                                           unroll=unroll, fused_loss=fused)
     if tokens_per_s is None:
         raise RuntimeError(f"selected config {best} failed the timed run: "
                            f"{err}")
     # standard MFU accounting: 6N per token (fwd+bwd) + causal attention
     # 6*L*hidden*seq per token; remat recompute is NOT credited. Cross-
     # checked against XLA HLO cost analysis by check_mfu_accounting.py.
-    print(emit(tokens_per_s, batch, remat, policy, unroll,
+    print(emit(tokens_per_s, batch, remat, policy, unroll, fused,
                provisional=False))
 
 

@@ -64,15 +64,19 @@ def main() -> int:
     import bench
 
     seq = 1024
-    for remat, policy, unroll in [(False, "full", 1), (True, "full", 1),
-                                  (True, "dots", 1), (False, "full", 12),
-                                  (True, "dots", 12)]:
+    for remat, policy, unroll, fused in [
+            (False, "full", 1, True), (True, "full", 1, True),
+            (True, "dots", 1, True), (False, "full", 12, True),
+            (True, "dots", 12, True), (False, "full", 1, False),
+            (True, "full", 1, False)]:
         cfg = bench.flagship_config(
-            seq, remat=remat, remat_policy=policy, scan_unroll=unroll)
+            seq, remat=remat, remat_policy=policy, scan_unroll=unroll,
+            fused_loss=fused)
         step, params, opt_state, tok, tgt = bench.build_train_step(
             cfg, batch=2, seq=seq)
         ok &= _lower(
-            f"train_step remat={remat}/{policy} unroll={unroll}",
+            f"train_step remat={remat}/{policy} unroll={unroll} "
+            f"fused={fused}",
             step, params, opt_state, tok, tgt, min_kernels=4)
 
     # --- ring attention (long-context SP path), fwd + bwd ---------------

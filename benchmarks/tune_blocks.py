@@ -113,7 +113,80 @@ def sweep_lm_head(steps: int):
         dt, bn, bv = min(results)
         print(f"BEST lm_head: lm_block_n={bn}, lm_block_v={bv} "
               f"({dt * 1e3:.3f} ms fwd+bwd)")
+
+    # The head is ~30% of the flagship step's flops and XLA's native
+    # (32768, 768) x (768, 50304) matmul is a near-peak MXU workload —
+    # the fused kernel's win (never materializing the 3.2 GB logits)
+    # only pays if its matmul efficiency is close. Time the REAL unfused
+    # path (what GPTConfig.fused_loss=False runs: bf16 logits into
+    # vocab_parallel_cross_entropy, standalone_gpt.py:666-668) at the
+    # same shape so the comparison is on the record against the actual
+    # alternative, not a heavier fp32 strawman.
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu.parallel.mesh import build_mesh
+    from apex_tpu.transformer.tensor_parallel.cross_entropy import (
+        vocab_parallel_cross_entropy,
+    )
+
+    # the real path runs under shard_map with a (size-1 here) tp axis —
+    # vocab_parallel_cross_entropy's pmax needs the axis to exist
+    mesh1 = build_mesh(tp=1, pp=1, sp=1, devices=jax.devices()[:1])
+
+    def unfused(x, w):
+        def body(x, w):
+            def loss(x, w):
+                lg = jnp.dot(x, w.T)  # model dtype; CE upcasts internally
+                return jnp.mean(vocab_parallel_cross_entropy(lg, t))
+
+            return jax.grad(loss, argnums=(0, 1))(x, w)
+
+        return jax.shard_map(body, mesh=mesh1, in_specs=(P(), P()),
+                             out_specs=(P(), P()), check_vma=False)(x, w)
+
+    try:
+        dt_un = _time(jax.jit(unfused), x, w, steps=steps)
+        print(f"lm_head UNFUSED (XLA logits+CE)  {dt_un * 1e3:8.3f} ms",
+              flush=True)
+        if results and dt_un < min(results)[0]:
+            print(f"NOTE: unfused beats the fused kernel by "
+                  f"{min(results)[0] / dt_un:.2f}x — set "
+                  f"GPTConfig.fused_loss=False", flush=True)
+    except Exception as e:
+        print(f"lm_head UNFUSED  FAILED {type(e).__name__} "
+              f"(likely logits OOM — which is the fused kernel's point)",
+              flush=True)
     return results
+
+
+def _full_step_ab(steps: int, knob: str, values):
+    """Full-step A/B of one GPTConfig knob at the quick-bench config,
+    timed by bench._measure — ONE copy of the compile/warm/fence/timing
+    protocol (the value-transfer fence has been fixed once already for
+    the axon tunnel; a fix must not need re-applying in three sweeps)."""
+    import bench
+
+    results = []
+    for v in values:
+        tps, _, err = bench._measure(True, "full", bench.BATCH, bench.SEQ,
+                                     steps, **{knob: v})
+        if tps is None:
+            print(f"{knob}={v}  FAILED {err}", flush=True)
+            continue
+        dt = bench.BATCH * bench.SEQ / tps
+        print(f"{knob}={v}  {dt * 1e3:8.3f} ms/step", flush=True)
+        results.append((dt, v))
+    if results:
+        dt, v = min(results)
+        print(f"BEST {knob}: {v} ({dt * 1e3:.3f} ms/step)")
+    return results
+
+
+def sweep_fused_loss(steps: int):
+    """Full-step A/B of GPTConfig.fused_loss — the in-context answer
+    (interacts with remat and XLA's scheduling) to the same question
+    sweep_lm_head's unfused row answers in isolation."""
+    return _full_step_ab(steps, "fused_loss", (True, False))
 
 
 def sweep_ln_impl(steps: int):
@@ -121,38 +194,8 @@ def sweep_ln_impl(steps: int):
 
     Isolated LN timing cannot answer this one: a Pallas call is an XLA
     fusion barrier, so the kernel's fewer HBM passes compete against the
-    fusions XLA gives up around it. Time the whole flagship train step
-    both ways at the quick-bench config and print the winner."""
-    import bench
-
-    results = []
-    for ln_pallas in (True, False):
-        cfg = bench.flagship_config(bench.SEQ, remat=True,
-                                    remat_policy="full",
-                                    ln_pallas=ln_pallas)
-        train_step, params, opt_state, tok, tgt = bench.build_train_step(
-            cfg, bench.BATCH, bench.SEQ)
-        try:
-            for _ in range(2):  # compile + one warm step
-                params, opt_state, loss = train_step(params, opt_state,
-                                                     tok, tgt)
-            float(loss)
-            t0 = time.perf_counter()
-            for _ in range(steps):
-                params, opt_state, loss = train_step(params, opt_state,
-                                                     tok, tgt)
-            float(loss)
-            dt = (time.perf_counter() - t0) / steps
-        except Exception as e:
-            print(f"ln_pallas={ln_pallas}  FAILED {type(e).__name__}",
-                  flush=True)
-            continue
-        print(f"ln_pallas={ln_pallas}  {dt * 1e3:8.3f} ms/step", flush=True)
-        results.append((dt, ln_pallas))
-    if results:
-        dt, ln_pallas = min(results)
-        print(f"BEST ln impl: ln_pallas={ln_pallas} ({dt * 1e3:.3f} ms/step)")
-    return results
+    fusions XLA gives up around it."""
+    return _full_step_ab(steps, "ln_pallas", (True, False))
 
 
 def main() -> int:
@@ -174,6 +217,7 @@ def main() -> int:
     sweep_attention(args.steps)
     sweep_lm_head(args.steps)
     sweep_ln_impl(args.steps)
+    sweep_fused_loss(args.steps)
     return 0
 
 
