@@ -480,17 +480,20 @@ def _layer_stack(layers, x, cfg, causal: bool = True, mask=None,
             sp = lax.axis_size(SP_AXIS)
         except NameError:
             sp = 1
-        if sp > 1 and cfg.hidden_dropout > 0.0:
-            raise NotImplementedError(
-                "hidden dropout under sequence parallelism would reuse the "
-                "same mask on every sequence shard (correlated positions); "
-                "fold an SP-rank stream in before enabling, or disable "
-                "hidden_dropout with sp > 1")
         base = dropout_key
         if pp > 1:
             base = jax.random.fold_in(base, lax.axis_index(PP_AXIS))
             if PP_AXIS not in jax.typeof(x).vma:
                 x = lax.pcast(x, PP_AXIS, to="varying")
+        if sp > 1:
+            # each sp rank holds DIFFERENT tokens of the sequence: fold the
+            # shard rank in so shards drop independent positions (same
+            # stream model as the pp fold above; without it every shard
+            # would reuse one mask, correlating dropped positions across
+            # the sequence with period s/sp)
+            base = jax.random.fold_in(base, lax.axis_index(SP_AXIS))
+            if SP_AXIS not in jax.typeof(x).vma:
+                x = lax.pcast(x, SP_AXIS, to="varying")
         keys = jax.vmap(lambda i: jax.random.fold_in(base, i))(
             jnp.arange(n_layers))
     else:
@@ -555,16 +558,16 @@ def _embed_with_dropout(embed, tokens, cfg: GPTConfig, dropout_key):
             sp = lax.axis_size(SP_AXIS)
         except NameError:
             sp = 1
-        if sp > 1:
-            raise NotImplementedError(
-                "hidden dropout under sequence parallelism would reuse the "
-                "same mask on every sequence shard; disable hidden_dropout "
-                "with sp > 1")
         # ref GPT embedding dropout: same hidden_dropout rate on the
-        # embedding output; distinct stream from the per-layer keys
-        x = _hidden_dropout(x, cfg.hidden_dropout,
-                            _hidden_key(jax.random.fold_in(dropout_key,
-                                                           0x0E0B), cfg))
+        # embedding output; distinct stream from the per-layer keys. Each
+        # sp rank holds different tokens, so the shard rank is folded in
+        # (same decorrelation as the per-layer keys in _layer_stack).
+        key = jax.random.fold_in(dropout_key, 0x0E0B)
+        if sp > 1:
+            key = jax.random.fold_in(key, lax.axis_index(SP_AXIS))
+            if SP_AXIS not in jax.typeof(x).vma:
+                x = lax.pcast(x, SP_AXIS, to="varying")
+        x = _hidden_dropout(x, cfg.hidden_dropout, _hidden_key(key, cfg))
     return x
 
 

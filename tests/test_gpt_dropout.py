@@ -101,6 +101,105 @@ def test_dropout_tp2_runs_and_differs_from_tp1_masks():
         _loss(nodrop, tp=1), _loss(nodrop, tp=2), rtol=1e-3)
 
 
+def _sp_loss(cfg, key, sp=2):
+    mesh = build_mesh(tp=1, pp=1, sp=sp, devices=jax.devices()[:sp])
+    params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+    tok = jax.random.randint(jax.random.PRNGKey(1), (2, 64), 0, 256)
+    specs = gpt_param_specs(cfg)
+
+    def body(p, tok, tgt):
+        return replicate_loss(
+            gpt_loss(p, tok, tgt, cfg, dropout_key=key),
+            mesh, masked_axis=None)
+
+    return float(jax.jit(jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(specs, P(None, "sp"), P(None, "sp")),
+        out_specs=P()))(params, tok, jnp.roll(tok, -1, 1)))
+
+
+def test_sp_hidden_dropout_trains_and_is_key_sensitive():
+    """Hidden dropout now runs under ring-SP (SP-rank-folded keys): the
+    step executes, replays for a fixed key, and the masks are live."""
+    cfg = dataclasses.replace(CFG, attention_dropout=0.0,
+                              hidden_dropout=0.2)
+    k1, k2 = jax.random.split(jax.random.PRNGKey(42))
+    a, b, c = _sp_loss(cfg, k1), _sp_loss(cfg, k1), _sp_loss(cfg, k2)
+    d = _sp_loss(cfg, None)  # eval mode
+    assert np.isfinite([a, b, c, d]).all()
+    assert a == b, "same dropout key must replay the same masks"
+    assert a != c, "different dropout keys must differ"
+    assert a != d, "dropout must change the loss vs eval mode"
+
+
+def test_sp_hidden_dropout_shards_decorrelated():
+    """The bug the old guard protected against: without the SP-rank fold
+    every shard reuses ONE mask. Silence attention (zero out-proj) and
+    feed identical activations to both shards — the only cross-shard
+    difference left is the hidden-dropout mask, so differing shard
+    outputs prove decorrelation (and the no-dropout control proves the
+    harness: shards identical when masks are off)."""
+    from apex_tpu.transformer.testing.standalone_gpt import _layer_stack
+
+    cfg = dataclasses.replace(CFG, num_layers=1, attention_dropout=0.0,
+                              hidden_dropout=0.5)
+    mesh = build_mesh(tp=1, pp=1, sp=2, devices=jax.devices()[:2])
+    layers = dict(init_gpt_params(jax.random.PRNGKey(0), cfg)["layers"])
+    layers["out_kernel"] = jnp.zeros_like(layers["out_kernel"])
+    layers["out_bias"] = jnp.zeros_like(layers["out_bias"])
+    # same non-constant feature vector at every position (constant-vector
+    # inputs would LN to zero and hide the masks behind a zero MLP output)
+    x = jnp.broadcast_to(
+        jax.random.normal(jax.random.PRNGKey(2), (cfg.hidden,)),
+        (1, 64, cfg.hidden)).astype(jnp.float32)
+
+    def run(key):
+        def body(lp, x):
+            out, _ = _layer_stack(lp, x, cfg, dropout_key=key)
+            return out
+
+        return np.asarray(jax.jit(jax.shard_map(
+            body, mesh=mesh, in_specs=(P(), P(None, "sp", None)),
+            out_specs=P(None, "sp", None)))(layers, x))
+
+    out = run(jax.random.PRNGKey(5))
+    assert np.isfinite(out).all()
+    assert not np.array_equal(out[:, :32], out[:, 32:]), \
+        "sp shards must drop independent positions"
+    control = run(None)
+    np.testing.assert_array_equal(control[:, :32], control[:, 32:])
+
+
+def test_sp_embedding_dropout_shards_decorrelated():
+    """The embedding-site fold (_embed_with_dropout): identical tokens on
+    both shards + zero position table -> identical embeddings per shard;
+    distinct shard outputs isolate the embedding dropout mask."""
+    from apex_tpu.transformer.testing.standalone_gpt import (
+        _embed_with_dropout,
+    )
+
+    cfg = dataclasses.replace(CFG, attention_dropout=0.0,
+                              hidden_dropout=0.5)
+    mesh = build_mesh(tp=1, pp=1, sp=2, devices=jax.devices()[:2])
+    embed = dict(init_gpt_params(jax.random.PRNGKey(0), cfg)["embed"])
+    embed["pos"] = jnp.zeros_like(embed["pos"])
+    tok = jnp.tile(jnp.arange(32, dtype=jnp.int32), 2)[None]  # shard halves equal
+
+    def run(key):
+        def body(e, tok):
+            return _embed_with_dropout(e, tok, cfg, key)
+
+        return np.asarray(jax.jit(jax.shard_map(
+            body, mesh=mesh, in_specs=(P(), P(None, "sp")),
+            out_specs=P(None, "sp", None)))(embed, tok))
+
+    out = run(jax.random.PRNGKey(9))
+    assert not np.array_equal(out[:, :32], out[:, 32:]), \
+        "sp shards must drop independent embedding positions"
+    control = run(None)
+    np.testing.assert_array_equal(control[:, :32], control[:, 32:])
+
+
 def test_sp_with_attention_dropout_raises():
     cfg = dataclasses.replace(CFG, hidden_dropout=0.0)
     mesh = build_mesh(tp=1, pp=1, sp=2, devices=jax.devices()[:2])
