@@ -5,7 +5,8 @@ from apex_tpu.contrib.transducer.transducer import (  # noqa: F401
     TransducerLoss,
     transducer_joint,
     transducer_loss,
+    unpack_transducer_input,
 )
 
 __all__ = ["TransducerJoint", "TransducerLoss", "transducer_joint",
-           "transducer_loss"]
+           "transducer_loss", "unpack_transducer_input"]

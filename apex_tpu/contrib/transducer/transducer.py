@@ -25,11 +25,53 @@ from jax import lax
 NEG = -1e30
 
 
+def _packed_cell_coords(batch_offset, per_batch_len, packed_batch: int):
+    """Map packed row r -> (b, local) under the reference layout: batch b's
+    cells occupy rows [offset[b-1], offset[b]) with ``per_batch_len[b]``
+    cells each (ref ``TransducerJoint.forward:43-66`` batch_offset
+    contract). Returns (b, local, valid) for every static row index."""
+    r = jnp.arange(packed_batch)
+    b = jnp.searchsorted(batch_offset, r, side="right")
+    total = batch_offset[-1]
+    b_safe = jnp.clip(b, 0, batch_offset.shape[0] - 1)
+    start = batch_offset[b_safe] - per_batch_len[b_safe]
+    local = r - start
+    return b_safe, local, r < total
+
+
 def transducer_joint(f, g, f_len=None, g_len=None, *, relu: bool = False,
-                     dropout_rate: float = 0.0, dropout_rng=None):
+                     dropout_rate: float = 0.0, dropout_rng=None,
+                     pack_output: bool = False, batch_offset=None,
+                     packed_batch: int = 0):
     """Broadcast joint: ``f`` (B, T, H) + ``g`` (B, U, H) -> (B, T, U, H)
-    (ref ``TransducerJoint.forward:5-66``; packing omitted — masked lattice
-    cells simply carry zeros)."""
+    (ref ``TransducerJoint.forward:5-66``).
+
+    With ``pack_output`` the don't-care lattice cells are removed and the
+    result is (packed_batch, H): batch b's valid (t, u) cells sit at rows
+    ``batch_offset[b-1] + t * g_len[b] + u`` (``batch_offset =
+    cumsum(f_len * g_len)``, the reference's contract). The CUDA original
+    packs by copying the dense output; on TPU the packed rows are computed
+    DIRECTLY — a searchsorted row->cell gather feeds one static-shape
+    broadcast add, so the dense (B, T, U, H) lattice never materializes.
+    ``packed_batch`` must be a static int (>= batch_offset[-1]); surplus
+    rows are zeroed."""
+    if pack_output:
+        if batch_offset is None or packed_batch == 0 or f_len is None \
+                or g_len is None:
+            raise ValueError(
+                "pack_output needs f_len, g_len, batch_offset "
+                "(= cumsum(f_len * g_len)) and a static packed_batch")
+        b, local, valid = _packed_cell_coords(
+            batch_offset, f_len * g_len, packed_batch)
+        t, u = local // g_len[b], local % g_len[b]
+        out = f[b, t] + g[b, u]  # (packed_batch, H)
+        if relu:
+            out = jax.nn.relu(out)
+        if dropout_rate > 0.0 and dropout_rng is not None:
+            keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate,
+                                        out.shape)
+            out = jnp.where(keep, out / (1.0 - dropout_rate), 0.0)
+        return out * valid[:, None]
     out = f[:, :, None, :] + g[:, None, :, :]
     if relu:
         out = jax.nn.relu(out)
@@ -43,6 +85,23 @@ def transducer_joint(f, g, f_len=None, g_len=None, *, relu: bool = False,
         u_mask = jnp.arange(g.shape[1])[None, :] < g_len[:, None]
         out = out * u_mask[:, None, :, None]
     return out
+
+
+def unpack_transducer_input(x_packed, f_len, y_len, batch_offset,
+                            max_f_len: int, max_u1: int):
+    """Packed loss input (packed_batch, V) -> dense (B, max_f_len, max_u1,
+    V). Layout: batch b's cell (t, u) at row ``batch_offset[b-1] +
+    t * (y_len[b] + 1) + u`` (ref ``TransducerLoss.forward:96-110``
+    batch_offset contract). Invalid cells gather-fill with 0 — the alpha
+    recursion never reads them into a valid terminal cell."""
+    t = jnp.arange(max_f_len)[None, :, None]
+    u = jnp.arange(max_u1)[None, None, :]
+    u1 = (y_len + 1)[:, None, None]
+    start = (batch_offset - f_len * (y_len + 1))[:, None, None]
+    rows = start + t * u1 + u
+    valid = (t < f_len[:, None, None]) & (u < u1)
+    rows = jnp.clip(rows, 0, x_packed.shape[0] - 1)
+    return jnp.where(valid[..., None], x_packed[rows], 0.0)
 
 
 def transducer_loss(x, label, f_len, y_len, blank_idx: int = 0):
@@ -119,18 +178,17 @@ class TransducerJoint:
 
     def __init__(self, pack_output: bool = False, relu: bool = False,
                  dropout: float = 0.0):
-        if pack_output:
-            raise NotImplementedError(
-                "pack_output is a CUDA memory-layout optimization; the TPU "
-                "path keeps the dense masked lattice")
+        self.pack_output = pack_output
         self.relu = relu
         self.dropout = dropout
 
-    def __call__(self, f, g, f_len=None, g_len=None, dropout_rng=None):
+    def __call__(self, f, g, f_len=None, g_len=None, dropout_rng=None,
+                 batch_offset=None, packed_batch: int = 0):
         return transducer_joint(
             f, g, f_len, g_len, relu=self.relu,
             dropout_rate=self.dropout if dropout_rng is not None else 0.0,
-            dropout_rng=dropout_rng)
+            dropout_rng=dropout_rng, pack_output=self.pack_output,
+            batch_offset=batch_offset, packed_batch=packed_batch)
 
 
 class TransducerLoss:
@@ -138,13 +196,26 @@ class TransducerLoss:
 
     def __init__(self, fuse_softmax_backward: bool = True,
                  packed_input: bool = False):
-        if packed_input:
-            raise NotImplementedError("packed input not supported on TPU")
         self.fuse_softmax = fuse_softmax_backward
+        self.packed_input = packed_input
 
-    def __call__(self, x, label, f_len, y_len, blank_idx: int = 0):
+    def __call__(self, x, label, f_len, y_len, blank_idx: int = 0,
+                 batch_offset=None, max_f_len: Optional[int] = None):
         """``x``: raw joint activations; log-softmax applied here (the
         reference fuses softmax backward into the loss backward — autodiff
-        through ``log_softmax`` does the same)."""
+        through ``log_softmax`` does the same). With ``packed_input``,
+        ``x`` is the (packed_batch, V) lattice from a ``pack_output``
+        joint (``batch_offset = cumsum(f_len * (y_len + 1))``, static
+        ``max_f_len`` required); log-softmax runs on the packed rows and a
+        gather restores the dense lattice for the alpha recursion —
+        autodiff scatters the cotangent back to packed form."""
         logp = jax.nn.log_softmax(x.astype(jnp.float32), axis=-1)
+        if self.packed_input:
+            if batch_offset is None or max_f_len is None:
+                raise ValueError(
+                    "packed_input needs batch_offset "
+                    "(= cumsum(f_len * (y_len + 1))) and a static max_f_len")
+            logp = unpack_transducer_input(
+                logp, f_len, y_len, batch_offset, max_f_len,
+                label.shape[1] + 1)
         return transducer_loss(logp, label, f_len, y_len, blank_idx)

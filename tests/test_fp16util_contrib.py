@@ -193,6 +193,63 @@ def test_transducer_joint():
     np.testing.assert_allclose(np.asarray(relu_out), 0.0)
 
 
+def test_transducer_joint_packed_matches_dense():
+    """pack_output parity (ref TransducerJoint packing contract:
+    batch_offset = cumsum(f_len * g_len), batch b's cell (t, u) at row
+    offset[b-1] + t * g_len[b] + u)."""
+    rng = np.random.RandomState(5)
+    B, T, U, H = 3, 5, 4, 8
+    f = rng.randn(B, T, H).astype(np.float32)
+    g = rng.randn(B, U, H).astype(np.float32)
+    f_len = np.asarray([5, 3, 4])
+    g_len = np.asarray([4, 2, 3])
+    offset = np.cumsum(f_len * g_len)
+    packed_batch = int(offset[-1]) + 3  # surplus rows must zero-fill
+    packed = jax.jit(lambda *a: transducer_joint(
+        *a, relu=True, pack_output=True,
+        batch_offset=jnp.asarray(offset), packed_batch=packed_batch))(
+        jnp.asarray(f), jnp.asarray(g), jnp.asarray(f_len),
+        jnp.asarray(g_len))
+    assert packed.shape == (packed_batch, H)
+    dense = np.maximum(f[:, :, None, :] + g[:, None, :, :], 0.0)
+    want = np.concatenate([
+        dense[b, :f_len[b], :g_len[b]].reshape(-1, H) for b in range(B)])
+    np.testing.assert_allclose(np.asarray(packed[:offset[-1]]), want,
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(packed[offset[-1]:]), 0.0)
+
+
+def test_transducer_loss_packed_matches_dense():
+    """packed_input parity incl. gradients (ref TransducerLoss packing
+    contract: batch_offset = cumsum(f_len * (y_len + 1)))."""
+    rng = np.random.RandomState(6)
+    B, T, U, V = 3, 5, 4, 7
+    x = rng.randn(B, T, U + 1, V).astype(np.float32)
+    label = rng.randint(1, V, (B, U))
+    f_len = np.asarray([5, 4, 3])
+    y_len = np.asarray([4, 2, 3])
+    offset = np.cumsum(f_len * (y_len + 1))
+    x_packed = np.concatenate([
+        x[b, :f_len[b], :y_len[b] + 1].reshape(-1, V) for b in range(B)])
+
+    dense_loss = TransducerLoss()
+    packed_loss = TransducerLoss(packed_input=True)
+    args = (jnp.asarray(label), jnp.asarray(f_len), jnp.asarray(y_len))
+    want, g_dense = jax.value_and_grad(
+        lambda x: jnp.sum(dense_loss(x, *args)))(jnp.asarray(x))
+    got, g_packed = jax.jit(jax.value_and_grad(
+        lambda x: jnp.sum(packed_loss(
+            x, *args, batch_offset=jnp.asarray(offset), max_f_len=T))))(
+        jnp.asarray(x_packed))
+    np.testing.assert_allclose(float(got), float(want), rtol=1e-5)
+    # the packed cotangent must equal the dense cotangent's valid cells
+    g_dense_packed = np.concatenate([
+        np.asarray(g_dense)[b, :f_len[b], :y_len[b] + 1].reshape(-1, V)
+        for b in range(B)])
+    np.testing.assert_allclose(np.asarray(g_packed), g_dense_packed,
+                               rtol=1e-5, atol=1e-6)
+
+
 # ---------------------------------------------------------------------------
 # batch samplers (ref run_transformer/test_batch_sampler.py)
 
