@@ -3,10 +3,12 @@
 from __future__ import annotations
 
 import contextlib
+import contextvars
 
 import jax
 
-_FORCE_COMPILED = False
+_FORCE_COMPILED = contextvars.ContextVar("apex_tpu_force_compiled",
+                                         default=False)
 
 
 @contextlib.contextmanager
@@ -19,19 +21,23 @@ def force_compiled():
     lowering_platforms=("tpu",))`` runs Mosaic's block-shape/layout
     verification on a CPU-only box — interpret mode skips exactly those
     checks, which is how a kernel that lowers nowhere can pass the whole
-    CPU suite (the varlen seg-block bug, round 4)."""
-    global _FORCE_COMPILED
-    prev = _FORCE_COMPILED
-    _FORCE_COMPILED = True
+    CPU suite (the varlen seg-block bug, round 4).
+
+    AOT-lowering-only: wrap ``.trace(...).lower(...)`` calls, never code
+    that EXECUTES on CPU — jit would cache the trace with
+    ``interpret=False`` baked in and later executions of that cached
+    callable off-chip would fail. The flag is a ``contextvars.ContextVar``
+    so concurrent threads/tasks see independent values."""
+    token = _FORCE_COMPILED.set(True)
     try:
         yield
     finally:
-        _FORCE_COMPILED = prev
+        _FORCE_COMPILED.reset(token)
 
 
 def compiled_backend() -> bool:
     """True when kernel dispatch should pick the compiled Mosaic path."""
-    return _FORCE_COMPILED or jax.default_backend() == "tpu"
+    return _FORCE_COMPILED.get() or jax.default_backend() == "tpu"
 
 
 def sds(shape, dtype, *like):

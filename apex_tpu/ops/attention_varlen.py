@@ -546,16 +546,41 @@ def flash_attention_varlen(
         scale = 1.0 / math.sqrt(d)
     bq = _pick_block(sq, block_q)
     bk = _pick_kv_block(sk, block_k)
+    if (_HAS_PALLAS and d % 8 == 0 and (bq is None or bk is None)
+            and (use_pallas or (use_pallas is None and _compiled_backend()))):
+        # seq lengths with no legal block (e.g. sk = 2056: 8-aligned but
+        # not 128-divisible and past the one-block VMEM cap) would
+        # otherwise drop to the dense O(s^2) reference exactly at the long
+        # seqs where the kernel matters most. Pad to the next 128-multiple
+        # with seg = -1 instead: padded keys match nothing, padded query
+        # rows output zero and are sliced back off.
+        pq = (-sq) % 128 if bq is None else 0
+        pk = (-sk) % 128 if bk is None else 0
+        if pq or pk:
+            out = flash_attention_varlen(
+                jnp.pad(q, ((0, 0), (0, 0), (0, pq), (0, 0))),
+                jnp.pad(k, ((0, 0), (0, 0), (0, pk), (0, 0))),
+                jnp.pad(v, ((0, 0), (0, 0), (0, pk), (0, 0))),
+                jnp.pad(seg_q, ((0, 0), (0, pq)), constant_values=-1),
+                jnp.pad(seg_k, ((0, 0), (0, pk)), constant_values=-1),
+                causal=causal, scale=scale, block_q=block_q,
+                block_k=block_k, use_pallas=use_pallas,
+                interpret=interpret)
+            return out[:, :, :sq]
+        # pq == pk == 0: the seq is already aligned and the block pick
+        # still failed (a block hint < 8 on an aligned seq) — padding
+        # cannot fix that; fall through to the error/fallback below
     fits = (_HAS_PALLAS and bq is not None and bk is not None
             and d % 8 == 0)
     if use_pallas is None:
         use_pallas = fits and _compiled_backend()
     elif use_pallas and not fits:
         raise ValueError(
-            f"pallas flash_attention_varlen needs seq divisible by a block "
-            f"size (kv: a 128-multiple block, or one 8-aligned full-seq "
-            f"block — the widened seg-id lane layout requires it) and "
-            f"head_dim % 8 == 0 (got q {q.shape}, k {k.shape})")
+            f"pallas flash_attention_varlen unavailable for q {q.shape}, "
+            f"k {k.shape}, block_q={block_q}, block_k={block_k}: needs "
+            f"Pallas importable, head_dim % 8 == 0, and a usable block "
+            f"hint (>= 8; misaligned seq lengths are padded "
+            f"automatically, a too-small hint on an aligned seq is not)")
     if not use_pallas:
         if interpret is not None:
             raise ValueError(

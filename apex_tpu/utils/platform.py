@@ -133,3 +133,25 @@ def pin_cpu_platform(virtual_devices: int | None = None) -> None:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+
+
+def pin_cpu_if_requested() -> None:
+    """CLI entry-point preamble: honor an explicit ``JAX_PLATFORMS=cpu``
+    request. The axon sitecustomize hook ignores the env var alone — only
+    the jax config flag keeps the process off the tunnel — so every
+    benchmark script calls this before its first jax backend use instead
+    of re-deriving the recipe."""
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        pin_cpu_platform()
+
+
+def pin_cpu_if_tunnel_dead() -> bool:
+    """CLI entry-point fallback: when CPU was not explicitly requested,
+    probe the default backend in a killable subprocess and pin CPU if it
+    is unresponsive (the dead-tunnel path), instead of hanging the caller
+    on backend init. Returns True when it pinned."""
+    if (os.environ.get("JAX_PLATFORMS") != "cpu"
+            and probe_backend() == 0):
+        pin_cpu_platform()
+        return True
+    return False

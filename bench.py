@@ -24,10 +24,9 @@ import time
 
 import jax
 
-if os.environ.get("JAX_PLATFORMS") == "cpu":
-    from apex_tpu.utils.platform import pin_cpu_platform
+from apex_tpu.utils.platform import pin_cpu_if_requested
 
-    pin_cpu_platform()
+pin_cpu_if_requested()
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
@@ -157,13 +156,11 @@ def main() -> None:
                     help="also persist the JSON line to this path")
     args = ap.parse_args()
 
-    from apex_tpu.utils.platform import pin_cpu_platform, probe_backend
+    from apex_tpu.utils.platform import pin_cpu_if_tunnel_dead
 
-    if (os.environ.get("JAX_PLATFORMS") != "cpu"
-            and probe_backend() == 0):
-        # fall back to the CPU protocol (flagged metric name) instead of
-        # hanging the driver on a dead tunnel
-        pin_cpu_platform()
+    # fall back to the CPU protocol (flagged metric name) instead of
+    # hanging the driver on a dead tunnel
+    pin_cpu_if_tunnel_dead()
     backend = jax.default_backend()
     on_tpu = backend == "tpu"
     batch, seq, steps = (BATCH, SEQ, STEPS) if on_tpu else (2, 128, 3)

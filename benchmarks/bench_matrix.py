@@ -324,13 +324,12 @@ def main(argv=None):
     local = [n for n in names if not CONFIGS[n][1]]
     if os.environ.get("APEX_TPU_BENCH_VIRTUAL"):
         local, virtual = names, []  # we ARE the subprocess
-    elif os.environ.get("JAX_PLATFORMS") != "cpu":
-        from apex_tpu.utils.platform import pin_cpu_platform, probe_backend
+    else:
+        from apex_tpu.utils.platform import pin_cpu_if_tunnel_dead
 
-        if probe_backend() == 0:
-            # dead tunnel: run the local configs on the CPU protocol
-            # instead of hanging on first backend touch (see bench.py)
-            pin_cpu_platform()
+        # dead tunnel: run the local configs on the CPU protocol instead
+        # of hanging on first backend touch (see bench.py)
+        pin_cpu_if_tunnel_dead()
 
     for n in local:
         try:

@@ -30,14 +30,13 @@ def main() -> int:
                     help="profile the remat=dots config instead of no-remat")
     args = ap.parse_args()
 
-    from apex_tpu.utils.platform import pin_cpu_platform, probe_backend
+    from apex_tpu.utils.platform import (
+        pin_cpu_if_requested,
+        pin_cpu_if_tunnel_dead,
+    )
 
-    if os.environ.get("JAX_PLATFORMS") == "cpu":
-        # the env var alone does not stop the image's axon backend hook
-        # from dialing the (possibly dead) tunnel — pin explicitly
-        pin_cpu_platform()
-    elif probe_backend() == 0:
-        pin_cpu_platform()
+    pin_cpu_if_requested()
+    pin_cpu_if_tunnel_dead()
     backend = jax.default_backend()
     on_tpu = backend == "tpu"
 

@@ -13,10 +13,9 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-if os.environ.get("JAX_PLATFORMS") == "cpu":
-    from apex_tpu.utils.platform import pin_cpu_platform
+from apex_tpu.utils.platform import pin_cpu_if_requested
 
-    pin_cpu_platform()  # the axon hook ignores the env var alone
+pin_cpu_if_requested()
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P

@@ -97,6 +97,41 @@ def test_fmha_packed_matches_reference_and_zero_pads():
     np.testing.assert_array_equal(np.asarray(out[40:]), 0.0)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_varlen_misaligned_seq_pads_into_kernel(causal):
+    """Seqs with no Mosaic-legal block (s=130: not even 8-aligned) used to
+    drop silently to the dense reference; the dispatcher now pads to the
+    next 128-multiple with seg=-1 and slices back — use_pallas=True must
+    take the kernel, and numerics must match the unpadded reference."""
+    b, h, s, d = 1, 2, 130, 8
+    ks = jax.random.split(jax.random.PRNGKey(4), 4)
+    q = jax.random.normal(ks[0], (b, h, s, d))
+    k = jax.random.normal(ks[1], (b, h, s, d))
+    v = jax.random.normal(ks[2], (b, h, s, d))
+    seg = jnp.concatenate([jnp.zeros((1, 70), jnp.int32),
+                           jnp.ones((1, 50), jnp.int32),
+                           jnp.full((1, 10), -1, jnp.int32)], axis=1)
+
+    def fused(q, k, v):
+        o = flash_attention_varlen(q, k, v, seg, causal=causal,
+                                   use_pallas=True, interpret=True)
+        return jnp.sum(jnp.sin(o)), o
+
+    def dense(q, k, v):
+        o = attention_varlen_reference(q, k, v, seg, causal=causal)
+        return jnp.sum(jnp.sin(o)), o
+
+    (_, of), gf = jax.value_and_grad(fused, argnums=(0, 1, 2),
+                                     has_aux=True)(q, k, v)
+    (_, od), gd = jax.value_and_grad(dense, argnums=(0, 1, 2),
+                                     has_aux=True)(q, k, v)
+    assert of.shape == (b, h, s, d)
+    np.testing.assert_allclose(of, od, atol=2e-5)
+    for a, e, name in zip(gf, gd, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(e), atol=2e-4,
+                                   err_msg=name)
+
+
 def test_varlen_long_sequence_beyond_reference_limit():
     """The reference kernels cap at seqlen 512; ours must not."""
     b, h, s, d = 1, 1, 1024, 8

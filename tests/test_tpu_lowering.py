@@ -139,14 +139,45 @@ def test_varlen_sub128_seq_lowers_or_falls_back():
         _lower_tpu(jax.grad(loss), q)
 
 
-def test_varlen_unalignable_seq_raises_when_forced():
+@pytest.mark.parametrize("s", [100, 2056])
+def test_varlen_misaligned_seq_pads_and_lowers(s):
+    """Seqs with no legal block pad to the next 128-multiple with seg=-1
+    instead of raising (s=100) or silently falling back to the dense
+    O(s^2) reference (s=2056: 8-aligned, not 128-divisible, past the
+    one-block VMEM cap — the advisor's repro). The padded dispatch must
+    lower for TPU end to end, fwd + bwd."""
     from apex_tpu.ops.attention_varlen import flash_attention_varlen
 
-    s = 100  # not divisible by 8: no legal block at all
     q = jnp.zeros((B, H, s, D), jnp.bfloat16)
     seg = jnp.zeros((B, s), jnp.int32)
-    with pytest.raises(ValueError, match="pallas flash_attention_varlen"):
+
+    def loss(q):
+        o = flash_attention_varlen(q, q, q, seg, causal=True,
+                                   use_pallas=True, interpret=False)
+        return jnp.sum(o.astype(jnp.float32) ** 2)
+
+    with force_compiled():
+        _lower_tpu(jax.grad(loss), q)
+
+
+def test_varlen_bad_head_dim_raises_when_forced():
+    from apex_tpu.ops.attention_varlen import flash_attention_varlen
+
+    q = jnp.zeros((B, H, 256, 12), jnp.bfloat16)  # head_dim % 8 != 0
+    seg = jnp.zeros((B, 256), jnp.int32)
+    with pytest.raises(ValueError, match="head_dim"):
         flash_attention_varlen(q, q, q, seg, use_pallas=True)
+
+
+def test_varlen_unfixable_block_hint_raises_not_recurses():
+    """Padding cannot fix a block hint < 8 on an already-aligned seq; the
+    dispatcher must raise (reviewer find: it used to recurse forever)."""
+    from apex_tpu.ops.attention_varlen import flash_attention_varlen
+
+    q = jnp.zeros((B, H, 256, D), jnp.bfloat16)
+    seg = jnp.zeros((B, 256), jnp.int32)
+    with pytest.raises(ValueError, match="block"):
+        flash_attention_varlen(q, q, q, seg, use_pallas=True, block_q=7)
 
 
 def test_interpret_arg_rejected_on_reference_path():
