@@ -1,0 +1,259 @@
+"""Long-sequence attention rows on the real chip (VERDICT r4 task 4).
+
+The ring-SP memory study (``tests/test_ring_memory.py``, PERF.md) argues
+32k-token attention fits per-device by buffer-assignment arithmetic; this
+script converts that extrapolation into measurements. Single-chip scope
+per the verdict: the ring collective itself is dryrun-covered, so the
+chip evidence is the KERNEL at ring-shard shapes — causal flash and
+varlen block-skip, compiled, long seq, fwd + bwd.
+
+Rows:
+- parity (tol-gated, scale-normalized error vs a matmul-precision-highest
+  dense reference) at s=4096 — the longest shape where the dense
+  reference's (s, s) score materialization is still reasonable;
+- timed kernel-only rows at s=8192/16384/32768 (b=1, h=8, d=64, bf16,
+  fwd+bwd, value-transfer fence) where the dense path cannot run at all —
+  each reports wall ms, achieved TFLOP/s (accounting documented at
+  ``_causal_flops``), and the device's ``peak_bytes_in_use``;
+- a varlen block-skip row at s=32768 packed as 8x4096 segments: the
+  skip must realize (within overheads) the 8x score-work reduction vs
+  the causal full row.
+
+Run: ``python benchmarks/long_seq_tpu.py [--out LONGSEQ_TPU.json]``.
+Exit 0 all-ok on TPU, 1 on-chip failure, 2 off-chip rehearsal (reference
+fallbacks exercise the harness but are never kernel evidence — same
+contract as ``smoke_tpu.py``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from apex_tpu.utils.platform import pin_cpu_if_requested
+
+pin_cpu_if_requested()
+
+import jax
+
+TIMED_STEPS = 10
+
+
+def _causal_flops(b, h, s, d):
+    """Credited fwd+bwd flops of causal attention per (b, h): fwd runs two
+    s x s x d matmuls (QK^T, PV) = 2 * 2*s^2*d flops, halved by causality;
+    bwd recomputes scores and runs the dV/dP/dQ/dK matmuls, ~2.5x fwd
+    (flash-attention standard accounting) -> total 3.5x fwd."""
+    fwd = 2 * (2.0 * s * s * d) / 2.0  # two matmuls, causal half
+    return 3.5 * fwd * b * h
+
+
+def _mem_row():
+    try:
+        st = jax.local_devices()[0].memory_stats() or {}
+        return {"bytes_in_use": int(st.get("bytes_in_use", -1)),
+                "peak_bytes_in_use": int(st.get("peak_bytes_in_use", -1))}
+    except Exception:
+        return {}
+
+
+def _results():
+    import jax.numpy as jnp
+    import numpy as np
+
+    from apex_tpu.ops.attention import attention_reference, flash_attention
+    from apex_tpu.ops.attention_varlen import (
+        attention_varlen_reference,
+        flash_attention_varlen,
+    )
+
+    on_tpu = jax.default_backend() == "tpu"
+    force = True if on_tpu else None
+    key = jax.random.PRNGKey(0)
+    out = []
+
+    def record(name, fn, tol=None):
+        """tol=None: timed row (ok = ran + finite); else parity row."""
+        t0 = time.perf_counter()
+        try:
+            row = fn()
+            row.update(kernel=name,
+                       seconds=round(time.perf_counter() - t0, 2))
+            if tol is not None:
+                err = row["max_err"]
+                row["tol"] = tol
+                row["ok"] = bool(np.isfinite(err) and 0.0 < err <= tol)
+                if err == 0.0:
+                    row["ok"] = False
+                    row["error"] = ("err == 0.0: the Pallas path fell back "
+                                    "(not kernel evidence)")
+            else:
+                row.setdefault("ok", True)
+            if not on_tpu:
+                row["ok"] = False
+                row.setdefault("error", "CPU rehearsal: reference fallback, "
+                                        "not kernel evidence")
+            out.append(row)
+        except Exception as e:  # noqa: BLE001 — record, keep going
+            out.append({"kernel": name, "ok": False,
+                        "error": f"{type(e).__name__}: {str(e)[:300]}",
+                        "seconds": round(time.perf_counter() - t0, 2)})
+        print(json.dumps(out[-1]), file=sys.stderr, flush=True)
+
+    def qkv(b, h, s, d, kk=key):
+        mk = lambda i: jax.random.normal(jax.random.fold_in(kk, i),
+                                         (b, h, s, d), jnp.bfloat16)
+        return mk(0), mk(1), mk(2)
+
+    def nerr(got, want):
+        return max(
+            float(jnp.max(jnp.abs(a.astype(jnp.float32)
+                                  - b_.astype(jnp.float32)))
+                  / (jnp.max(jnp.abs(b_.astype(jnp.float32))) + 1e-12))
+            for a, b_ in zip(got, want))
+
+    # ---- parity at s=4096 (dense reference still materializes 64 MB/head)
+    def causal_parity():
+        b, h, s, d = 1, 2, 4096, 64
+        q, k, v = qkv(b, h, s, d)
+
+        def loss(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, causal=True,
+                                           use_pallas=force)
+                           .astype(jnp.float32) ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(attention_reference(q, k, v, causal=True)
+                           .astype(jnp.float32) ** 2)
+
+        g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
+        with jax.default_matmul_precision("highest"):
+            gr = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))(q, k, v)
+        jax.block_until_ready(g)
+        return {"max_err": nerr(g, gr)}
+
+    record("flash_causal_s4096_parity_fwd_bwd", causal_parity, tol=2e-2)
+
+    def varlen_parity():
+        b, h, s, d = 1, 2, 4096, 64
+        q, k, v = qkv(b, h, s, d, jax.random.fold_in(key, 7))
+        seg = (jnp.arange(s) // 1024).astype(jnp.int32)[None]  # 4 segments
+
+        def loss(q, k, v):
+            return jnp.sum(flash_attention_varlen(
+                q, k, v, seg, causal=True, use_pallas=force)
+                .astype(jnp.float32) ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(attention_varlen_reference(q, k, v, seg,
+                                                      causal=True)
+                           .astype(jnp.float32) ** 2)
+
+        g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
+        with jax.default_matmul_precision("highest"):
+            gr = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))(q, k, v)
+        jax.block_until_ready(g)
+        return {"max_err": nerr(g, gr)}
+
+    record("varlen_s4096_parity_fwd_bwd", varlen_parity, tol=2e-2)
+
+    # ---- timed kernel-only rows (value-transfer fence, no dense possible)
+    def timed(step_fn, flops):
+        loss = step_fn()  # compile + warm
+        float(loss)
+        t0 = time.perf_counter()
+        for _ in range(TIMED_STEPS):
+            loss = step_fn()
+        last = float(loss)  # the only trustworthy fence on this tunnel
+        dt = (time.perf_counter() - t0) / TIMED_STEPS
+        row = {"ms": round(dt * 1e3, 3),
+               "tflops_per_s": round(flops / dt / 1e12, 2),
+               "finite": bool(np.isfinite(last))}
+        if not row["finite"]:
+            row["ok"] = False
+            row["error"] = "non-finite loss"
+        row.update(_mem_row())
+        return row
+
+    def make_causal_timed(s):
+        def run():
+            b, h, d = 1, 8, 64
+            q, k, v = qkv(b, h, s, d, jax.random.fold_in(key, s))
+
+            def loss(q, k, v):
+                return jnp.sum(flash_attention(q, k, v, causal=True,
+                                               use_pallas=force)
+                               .astype(jnp.float32) ** 2)
+
+            g = jax.jit(jax.value_and_grad(loss, argnums=(0, 1, 2)))
+            return timed(lambda: g(q, k, v)[0],
+                         _causal_flops(b, h, s, d))
+        return run
+
+    # off-chip the kernel rows fall back to the DENSE reference: a 32k
+    # rehearsal would materialize a (32k, 32k) score matrix per head —
+    # rehearse the harness at small shapes instead (rows are marked not-ok
+    # off-chip either way)
+    timed_shapes = (8192, 16384, 32768) if on_tpu else (512, 1024)
+    for s in timed_shapes:
+        record(f"flash_causal_s{s}_timed_fwd_bwd", make_causal_timed(s))
+    full_name = f"flash_causal_s{timed_shapes[-1]}_timed_fwd_bwd"
+
+    def varlen_skip_timed():
+        b, h, d = 1, 8, 64
+        s, seg_len = (32768, 4096) if on_tpu else (1024, 128)
+        q, k, v = qkv(b, h, s, d, jax.random.fold_in(key, 99))
+        seg = (jnp.arange(s) // seg_len).astype(jnp.int32)[None]
+
+        def loss(q, k, v):
+            return jnp.sum(flash_attention_varlen(
+                q, k, v, seg, causal=True, use_pallas=force)
+                .astype(jnp.float32) ** 2)
+
+        g = jax.jit(jax.value_and_grad(loss, argnums=(0, 1, 2)))
+        # credited work: 8 independent causal segments of 4096 = 1/8 of
+        # the full-causal score work at s=32k
+        n_seg = s // seg_len
+        row = timed(lambda: g(q, k, v)[0],
+                    n_seg * _causal_flops(b, h, seg_len, d))
+        full = next((r for r in out
+                     if r["kernel"] == full_name and "ms" in r), None)
+        if full:
+            row["speedup_vs_causal_full"] = round(full["ms"] / row["ms"], 2)
+        return row
+
+    record("varlen_blockskip_8seg_timed_fwd_bwd", varlen_skip_timed)
+
+    return {"backend": jax.default_backend(), "on_tpu": on_tpu,
+            "timed_steps": TIMED_STEPS, "rows": out}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    from apex_tpu.utils.platform import pin_cpu_if_tunnel_dead
+
+    pin_cpu_if_tunnel_dead()
+
+    t0 = time.perf_counter()
+    res = _results()
+    res["total_seconds"] = round(time.perf_counter() - t0, 1)
+    text = json.dumps(res, indent=1)
+    print(text)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+    if all(r["ok"] for r in res["rows"]):
+        return 0
+    return 1 if res["on_tpu"] else 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

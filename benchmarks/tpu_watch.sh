@@ -17,14 +17,16 @@
 #   4 step profile    profile_step.py       -> PROFILE_TPU.txt
 #   5 block tuner     tune_blocks.py        -> TUNE_TPU.txt
 #   6 baseline matrix bench_matrix.py       -> BENCH_MATRIX_TPU.txt
-# After all six, later healthy probes only refresh stage 1+3 (hourly) so
-# the banked number tracks the latest code.
+#   7 long-seq rows   long_seq_tpu.py       -> LONGSEQ_TPU.json
+# After all seven, later healthy probes only refresh stage 1+3 (hourly)
+# so the banked number tracks the latest code.
 cd /root/repo || exit 1
 export APEX_TPU_PROBE_NO_CACHE=1
 LOG=/tmp/tpu_health.log
 STATE=/tmp/tpu_watch_stage   # highest completed stage, survives restarts
 [ -f "$STATE" ] || echo 0 > "$STATE"
 last_refresh=0
+last_longseq=-3600  # first stage-7 attempt immediate, retries hourly
 
 note() { echo "$(date '+%F %T') $*" >> "$LOG"; }
 
@@ -84,6 +86,28 @@ smoke_green() {
     && ! grep -q '"ok": false' SMOKE_TPU.json
 }
 
+longseq_stage() {
+  # same promotion contract as smoke_stage: bank ANY on-chip artifact
+  # (a failing kernel on the chip is evidence), never a CPU rehearsal;
+  # state advances only on an all-pass run
+  note "STAGE7 START: long_seq_tpu.py"
+  rm -f /tmp/longseq_try.json
+  timeout 1800 python benchmarks/long_seq_tpu.py --out /tmp/longseq_try.json \
+    > /tmp/tpu_stage7.out 2> /tmp/tpu_stage7.err
+  local rc=$?
+  note "STAGE7 EXIT=$rc"
+  [ -s /tmp/longseq_try.json ] || return 1
+  if ! grep -q '"on_tpu": true' /tmp/longseq_try.json; then
+    note "STAGE7 got CPU rehearsal, not promoting"
+    return 1
+  fi
+  cp /tmp/longseq_try.json LONGSEQ_TPU.json
+  note "STAGE7 PROMOTED (rc=$rc)"
+  [ $rc -eq 0 ] || return 1
+  [ "$(cat "$STATE")" -lt 7 ] && echo 7 > "$STATE"
+  return 0
+}
+
 smoke_stage() {
   # Smoke to a temp file; promote ANY real-TPU artifact (a failing kernel
   # on the chip is exactly the evidence we must bank) but never a CPU
@@ -113,7 +137,7 @@ while true; do
     note HEALTHY
     done_stage=$(cat "$STATE")
     now=$(date +%s)
-    if [ "$done_stage" -ge 6 ]; then
+    if [ "$done_stage" -ge 7 ]; then
       # full suite already banked: refresh the headline at most hourly.
       # A non-green smoke retries on the same hourly cadence (kernel
       # fixes land while the tunnel is down, so a failed on-chip smoke
@@ -130,12 +154,21 @@ while true; do
       [ "$(cat "$STATE")" -ge 1 ] && ! smoke_green && smoke_stage
       [ "$(cat "$STATE")" -ge 1 ] && [ "$done_stage" -lt 3 ] && \
         bench_stage 3 2400
-      [ "$(cat "$STATE")" -ge 3 ] && run_stage 4 1200 PROFILE_TPU.txt \
+      # each catch-up stage gates on its OWN completion too (reviewer
+      # find: a later stage failing must not re-run hours of finished
+      # profile/tune/matrix work every 120 s iteration)
+      [ "$(cat "$STATE")" -eq 3 ] && run_stage 4 1200 PROFILE_TPU.txt \
         bash -c "python benchmarks/profile_step.py --steps 5 > PROFILE_TPU.txt"
-      [ "$(cat "$STATE")" -ge 4 ] && run_stage 5 1800 TUNE_TPU.txt \
+      [ "$(cat "$STATE")" -eq 4 ] && run_stage 5 1800 TUNE_TPU.txt \
         bash -c "python benchmarks/tune_blocks.py > TUNE_TPU.txt"
-      [ "$(cat "$STATE")" -ge 5 ] && run_stage 6 3600 BENCH_MATRIX_TPU.txt \
+      [ "$(cat "$STATE")" -eq 5 ] && run_stage 6 3600 BENCH_MATRIX_TPU.txt \
         bash -c "python benchmarks/bench_matrix.py > BENCH_MATRIX_TPU.txt"
+      # a failing on-chip long-seq run retries hourly, not every 120 s
+      if [ "$(cat "$STATE")" -eq 6 ] \
+          && [ $((now - last_longseq)) -ge 3600 ]; then
+        longseq_stage
+        last_longseq=$now
+      fi
       last_refresh=$now
     fi
     sleep 120
