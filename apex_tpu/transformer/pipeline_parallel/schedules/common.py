@@ -68,6 +68,38 @@ class PipelineSpec:
     stage_fn: Callable[[Pytree, Pytree], Pytree]
     loss_fn: Callable[[Pytree, Pytree, Pytree], jnp.ndarray]
     stage_aux: bool = False
+    # True: embed_fn/stage_fn take a trailing per-microbatch PRNG key arg
+    # (training dropout). The schedules derive one key per microbatch from
+    # their ``dropout_key`` argument and route it alongside the microbatch
+    # (the stage/pp/sp decorrelation folds live inside the model, see
+    # standalone_gpt._layer_stack); passing dropout_key to a schedule
+    # requires a spec built with this flag and vice versa.
+    takes_dropout_key: bool = False
+
+
+def check_dropout_spec(spec: "PipelineSpec", dropout_key) -> None:
+    """Validate the spec/dropout_key pairing in BOTH directions before
+    tracing: a mismatch otherwise fails with an opaque arity TypeError
+    deep inside shard_map/vmap."""
+    if dropout_key is not None and not spec.takes_dropout_key:
+        raise ValueError(
+            "dropout_key given but the PipelineSpec was built without "
+            "takes_dropout_key (e.g. gpt_pipeline_spec(cfg, dropout=True))")
+    if dropout_key is None and spec.takes_dropout_key:
+        raise ValueError(
+            "the PipelineSpec was built with takes_dropout_key but no "
+            "dropout_key was passed; pass one (training) or build the "
+            "spec without dropout (eval)")
+
+
+def derive_microbatch_keys(dropout_key, num_microbatches: int):
+    """One PRNG key per microbatch (``fold_in(dropout_key, m)``), or None.
+    The single derivation every schedule driver shares — test sequential
+    references replay exactly this."""
+    if dropout_key is None:
+        return None
+    return jax.vmap(lambda i: jax.random.fold_in(dropout_key, i))(
+        jnp.arange(num_microbatches))
 
 
 def build_model(

@@ -21,6 +21,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from apex_tpu.transformer.pipeline_parallel.schedules.common import (
+    derive_microbatch_keys,
     split_microbatches,
 )
 
@@ -35,33 +36,46 @@ def forward_backward_no_pipelining(
     num_microbatches: int,
     loss_scale: Optional[jnp.ndarray] = None,
     unroll: int = 1,
+    dropout_key: Optional[jax.Array] = None,
 ) -> Tuple[jnp.ndarray, Pytree]:
     """Returns ``(mean_unscaled_loss, grads)``; grads are of
     ``mean(loss) * loss_scale`` summed over microbatches (ref common.py:226-256
     scales each microbatch loss by 1/num_microbatches before backward).
 
     ``forward_step_func(params, microbatch) -> scalar loss`` is the analogue
-    of the reference's ``forward_step_func(batch, model)``.
+    of the reference's ``forward_step_func(batch, model)``. With
+    ``dropout_key`` it is called ``forward_step_func(params, microbatch,
+    key)`` with a per-microbatch key (microbatches must drop independent
+    positions, matching the reference's stateful per-call RNG advance).
     """
     mb = split_microbatches(batch, num_microbatches)
     scale = 1.0 if loss_scale is None else loss_scale
+    keys_mb = derive_microbatch_keys(dropout_key, num_microbatches)
 
-    def scaled(p, m):
-        loss = forward_step_func(p, m)
+    def scaled(p, m, key):
+        loss = (forward_step_func(p, m) if key is None
+                else forward_step_func(p, m, key))
         return loss * scale / num_microbatches, loss
 
     vg = jax.value_and_grad(scaled, has_aux=True)
 
-    def body(acc, m):
+    def body(acc, m_key):
+        m, key = m_key
         loss_sum, grad_sum = acc
-        (_, loss), g = vg(params, m)
+        (_, loss), g = vg(params, m, key)
         return (
             loss_sum + loss,
             jax.tree.map(jnp.add, grad_sum, g),
         ), None
 
     zeros = jax.tree.map(jnp.zeros_like, params)
-    (loss_sum, grads), _ = lax.scan(
-        body, (jnp.zeros(()), zeros), mb, unroll=unroll
-    )
+    if keys_mb is not None:
+        (loss_sum, grads), _ = lax.scan(
+            body, (jnp.zeros(()), zeros), (mb, keys_mb), unroll=unroll
+        )
+    else:
+        (loss_sum, grads), _ = lax.scan(
+            lambda acc, m: body(acc, (m, None)),
+            (jnp.zeros(()), zeros), mb, unroll=unroll
+        )
     return loss_sum / num_microbatches, grads

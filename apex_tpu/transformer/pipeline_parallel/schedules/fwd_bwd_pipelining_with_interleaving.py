@@ -40,6 +40,8 @@ from jax.sharding import PartitionSpec as P
 from apex_tpu.parallel.mesh import DP_AXIS, PP_AXIS
 from apex_tpu.transformer.pipeline_parallel.schedules.common import (
     PipelineSpec,
+    check_dropout_spec,
+    derive_microbatch_keys,
     replicate_loss,
     split_microbatches,
     stage_params_spec,
@@ -65,13 +67,20 @@ def pipeline_ring_interleaved(
     axis_name: str = PP_AXIS,
     remat: bool = True,
     returns_aux: bool = False,
+    keys_mb: Optional[jax.Array] = None,
 ) -> Pytree:
     """Circular ring inside a mesh program. ``chunk_params`` is this stage's
     ``[vp, ...]`` chunk stack (pp axis already squeezed). Returns ``[M, ...]``
     final-chunk outputs, valid on the last stage. With ``returns_aux`` the
     stage function yields ``(h, aux_scalar)`` and the result is
     ``(outputs, aux_mean)``: the stage's aux averaged over its real
-    (microbatch, chunk) ticks."""
+    (microbatch, chunk) ticks.
+
+    ``keys_mb`` ([M]-stacked PRNG keys) activates dropout routing: the
+    stage function is called ``stage_fn(params, h, key)`` with the
+    microbatch's key folded by the CHUNK index — chunks on one stage share
+    its pp rank, so without the fold chunk r and r' would reuse the same
+    per-layer mask streams."""
     pp = lax.axis_size(axis_name)
     rank = lax.axis_index(axis_name)
     M, vp = num_microbatches, virtual_pipeline_size
@@ -94,16 +103,21 @@ def pipeline_ring_interleaved(
         w = u % (pp * vp)
         r = w // pp
         i = w % pp
-        x0 = _tree_index(h_mb, jnp.clip(g * pp + i, 0, M - 1))
+        m = jnp.clip(g * pp + i, 0, M - 1)
+        x0 = _tree_index(h_mb, m)
         take_new = (rank == 0) & (r == 0)
         inp = _tree_where(take_new, x0, h)
         p_r = _tree_index(chunk_params, r)
+        args = (p_r, inp)
+        if keys_mb is not None:
+            key_m = lax.dynamic_index_in_dim(keys_mb, m, 0, keepdims=False)
+            args += (jax.random.fold_in(key_m, r),)
         if returns_aux:
-            out, aux = fn(p_r, inp)
+            out, aux = fn(*args)
             valid = (t >= rank) & (t - rank <= work - 1)
             aux_sum = aux_sum + jnp.where(valid, aux, 0.0)
         else:
-            out = fn(p_r, inp)
+            out = fn(*args)
         return (_pvary_all(_ring_shift(out, axis_name), axes),
                 _pvary_all(aux_sum, axes)), out
 
@@ -129,6 +143,7 @@ def _pipeline_body(
     params: Pytree,
     inputs_mb: Pytree,
     targets_mb: Pytree,
+    keys_mb: Optional[jax.Array] = None,
     *,
     spec: PipelineSpec,
     num_microbatches: int,
@@ -138,7 +153,12 @@ def _pipeline_body(
 ):
     # stages leaves are [vp, 1, ...] locally (pp axis sharded at dim 1)
     chunk_local = jax.tree.map(lambda a: a[:, 0], params["stages"])
-    h_mb = jax.vmap(spec.embed_fn, in_axes=(None, 0))(params["embed"], inputs_mb)
+    if keys_mb is not None:
+        h_mb = jax.vmap(spec.embed_fn, in_axes=(None, 0, 0))(
+            params["embed"], inputs_mb, keys_mb)
+    else:
+        h_mb = jax.vmap(spec.embed_fn, in_axes=(None, 0))(params["embed"],
+                                                          inputs_mb)
     ys = pipeline_ring_interleaved(
         spec.stage_fn,
         chunk_local,
@@ -147,6 +167,7 @@ def _pipeline_body(
         virtual_pipeline_size=virtual_pipeline_size,
         remat=remat,
         returns_aux=spec.stage_aux,
+        keys_mb=keys_mb,
     )
     aux = None
     if spec.stage_aux:
@@ -176,10 +197,12 @@ def forward_backward_pipelining_with_interleaving(
     data_spec: P = P(None, DP_AXIS),
     loss_scale: Optional[jnp.ndarray] = None,
     remat: bool = True,
+    dropout_key: Optional[jax.Array] = None,
 ) -> Tuple[jnp.ndarray, Pytree]:
     """Driver (ref :25). Same contract as the non-interleaved driver except
     ``params["stages"]`` carries leading ``[vp, pp]`` axes (see
-    ``common.build_model``)."""
+    ``common.build_model``). ``dropout_key`` as in the non-interleaved
+    driver, with the chunk index additionally folded per tick."""
     from apex_tpu.transformer.pipeline_parallel.schedules.fwd_bwd_enc_dec import (
         EncDecPipelineSpec,
     )
@@ -205,6 +228,8 @@ def forward_backward_pipelining_with_interleaving(
     inputs, targets = batch
     inputs_mb = split_microbatches(inputs, num_microbatches)
     targets_mb = split_microbatches(targets, num_microbatches)
+    check_dropout_spec(spec, dropout_key)
+    keys_mb = derive_microbatch_keys(dropout_key, num_microbatches)
 
     body = functools.partial(
         _pipeline_body,
@@ -214,21 +239,26 @@ def forward_backward_pipelining_with_interleaving(
         mesh=mesh,
         remat=remat,
     )
+    in_specs = [
+        params_specs,
+        jax.tree.map(lambda _: data_spec, inputs_mb),
+        jax.tree.map(lambda _: data_spec, targets_mb),
+    ]
+    args = [inputs_mb, targets_mb]
+    if keys_mb is not None:
+        in_specs.append(P())  # keys replicated; model folds the axes
+        args.append(keys_mb)
     sharded = shard_map(
         body,
         mesh=mesh,
-        in_specs=(
-            params_specs,
-            jax.tree.map(lambda _: data_spec, inputs_mb),
-            jax.tree.map(lambda _: data_spec, targets_mb),
-        ),
+        in_specs=tuple(in_specs),
         out_specs=P(),
     )
 
     scale = 1.0 if loss_scale is None else loss_scale
 
     def scaled(p):
-        loss = sharded(p, inputs_mb, targets_mb)
+        loss = sharded(p, *args)
         return loss * scale, loss
 
     (_, loss), grads = jax.value_and_grad(scaled, has_aux=True)(params)

@@ -37,6 +37,8 @@ from jax.sharding import PartitionSpec as P
 from apex_tpu.parallel.mesh import DP_AXIS, PP_AXIS
 from apex_tpu.transformer.pipeline_parallel.schedules.common import (
     PipelineSpec,
+    check_dropout_spec,
+    derive_microbatch_keys,
     replicate_loss,
     split_microbatches,
     stage_params_spec,
@@ -154,6 +156,7 @@ def _pipeline_body(
     params: Pytree,
     inputs_mb: Pytree,
     targets_mb: Pytree,
+    keys_mb: Optional[Pytree] = None,
     *,
     spec: PipelineSpec,
     num_microbatches: int,
@@ -161,7 +164,12 @@ def _pipeline_body(
     remat: bool,
 ):
     stage_local = jax.tree.map(lambda a: a[0], params["stages"])
-    h_mb = jax.vmap(spec.embed_fn, in_axes=(None, 0))(params["embed"], inputs_mb)
+    if keys_mb is not None:
+        h_mb = jax.vmap(spec.embed_fn, in_axes=(None, 0, 0))(
+            params["embed"], inputs_mb, keys_mb)
+    else:
+        h_mb = jax.vmap(spec.embed_fn, in_axes=(None, 0))(params["embed"],
+                                                          inputs_mb)
     ys = pipeline_ring(
         spec.stage_fn,
         stage_local,
@@ -169,6 +177,7 @@ def _pipeline_body(
         num_microbatches=num_microbatches,
         remat=remat,
         returns_aux=spec.stage_aux,
+        extra_mb=keys_mb,
     )
     aux = None
     if spec.stage_aux:
@@ -198,6 +207,7 @@ def forward_backward_pipelining_without_interleaving(
     data_spec: P = P(None, DP_AXIS),
     loss_scale: Optional[jnp.ndarray] = None,
     remat: bool = True,
+    dropout_key: Optional[jax.Array] = None,
 ) -> Tuple[jnp.ndarray, Pytree]:
     """The driver (ref :155). ``batch = (inputs, targets)`` pytrees with a
     leading global-batch dim. Returns ``(mean_unscaled_loss, grads)``; grads
@@ -208,6 +218,13 @@ def forward_backward_pipelining_without_interleaving(
     embed/head replicated, stages ``P("pp")`` — supply your own to lay TP
     shards onto the mesh). ``data_spec`` shards the microbatched data
     ``[M, B, ...]``; the default splits the per-microbatch batch dim over dp.
+
+    ``dropout_key`` (training mode; requires a spec built with
+    ``takes_dropout_key``) derives one key per microbatch and routes it to
+    the embed/stage functions through the ring's per-microbatch side
+    channel, so microbatches drop independent positions; stage/sp
+    decorrelation is the model's own axis-fold (ref ParallelTransformer
+    trains with dropout under every schedule).
     """
     from apex_tpu.transformer.pipeline_parallel.schedules.fwd_bwd_enc_dec import (
         EncDecPipelineSpec,
@@ -215,6 +232,10 @@ def forward_backward_pipelining_without_interleaving(
     )
 
     if isinstance(spec, EncDecPipelineSpec):
+        if dropout_key is not None:
+            raise NotImplementedError(
+                "dropout_key through the enc-dec schedule is not wired "
+                "yet; dropping it silently would train without dropout")
         # ModelType.encoder_and_decoder routing (ref common.py:80-103): the
         # same driver name serves both model types, as in the reference.
         return forward_backward_pipelining_enc_dec(
@@ -241,6 +262,8 @@ def forward_backward_pipelining_without_interleaving(
     inputs, targets = batch
     inputs_mb = split_microbatches(inputs, num_microbatches)
     targets_mb = split_microbatches(targets, num_microbatches)
+    check_dropout_spec(spec, dropout_key)
+    keys_mb = derive_microbatch_keys(dropout_key, num_microbatches)
 
     body = functools.partial(
         _pipeline_body,
@@ -249,21 +272,26 @@ def forward_backward_pipelining_without_interleaving(
         mesh=mesh,
         remat=remat,
     )
+    in_specs = [
+        params_specs,
+        jax.tree.map(lambda _: data_spec, inputs_mb),
+        jax.tree.map(lambda _: data_spec, targets_mb),
+    ]
+    args = [inputs_mb, targets_mb]
+    if keys_mb is not None:
+        in_specs.append(P())  # keys replicated; model folds the axes
+        args.append(keys_mb)
     sharded = shard_map(
         body,
         mesh=mesh,
-        in_specs=(
-            params_specs,
-            jax.tree.map(lambda _: data_spec, inputs_mb),
-            jax.tree.map(lambda _: data_spec, targets_mb),
-        ),
+        in_specs=tuple(in_specs),
         out_specs=P(),
     )
 
     scale = 1.0 if loss_scale is None else loss_scale
 
     def scaled(p):
-        loss = sharded(p, inputs_mb, targets_mb)
+        loss = sharded(p, *args)
         return loss * scale, loss
 
     (_, loss), grads = jax.value_and_grad(scaled, has_aux=True)(params)

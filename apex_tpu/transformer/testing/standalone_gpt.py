@@ -719,19 +719,33 @@ def gpt_pipeline_specs_tree(cfg: GPTConfig, interleaved: bool = False
             "head": base["head"]}
 
 
-def gpt_pipeline_spec(cfg: GPTConfig) -> PipelineSpec:
+def gpt_pipeline_spec(cfg: GPTConfig, dropout: bool = False) -> PipelineSpec:
     """The three pipeline functions (PipelineSpec contract). With
     ``cfg.num_experts`` the stage function also yields its layers' router
-    aux loss (``stage_aux=True``) — the schedules accumulate and add it."""
+    aux loss (``stage_aux=True``) — the schedules accumulate and add it.
+    With ``dropout`` the embed/stage functions take the schedules'
+    per-microbatch PRNG key (``takes_dropout_key``) and apply cfg's
+    dropout rates — the ref ParallelTransformerLayer trains with dropout
+    under every schedule; pass ``dropout_key=`` to the schedule driver."""
 
-    def embed_fn(embed, tokens):
-        return embed_tokens(embed, tokens, megatron_sp=cfg.megatron_sp)
+    if dropout:
+        def embed_fn(embed, tokens, key):
+            return _embed_with_dropout(embed, tokens, cfg, key)
 
-    def stage_fn(stage_layers, h):
-        out, aux = _layer_stack(stage_layers, h, cfg)
-        if cfg.num_experts:
-            return out, aux
-        return out
+        def stage_fn(stage_layers, h, key):
+            out, aux = _layer_stack(stage_layers, h, cfg, dropout_key=key)
+            if cfg.num_experts:
+                return out, aux
+            return out
+    else:
+        def embed_fn(embed, tokens):
+            return embed_tokens(embed, tokens, megatron_sp=cfg.megatron_sp)
+
+        def stage_fn(stage_layers, h):
+            out, aux = _layer_stack(stage_layers, h, cfg)
+            if cfg.num_experts:
+                return out, aux
+            return out
 
     def loss_fn(head, h, targets):
         # h is the seq shard under megatron_sp; the fused-loss gate needs
@@ -751,4 +765,5 @@ def gpt_pipeline_spec(cfg: GPTConfig) -> PipelineSpec:
         return jnp.mean(vocab_parallel_cross_entropy(logits, targets))
 
     return PipelineSpec(embed_fn=embed_fn, stage_fn=stage_fn, loss_fn=loss_fn,
-                        stage_aux=bool(cfg.num_experts))
+                        stage_aux=bool(cfg.num_experts),
+                        takes_dropout_key=dropout)
