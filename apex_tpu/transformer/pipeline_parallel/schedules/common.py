@@ -102,6 +102,25 @@ def derive_microbatch_keys(dropout_key, num_microbatches: int):
         jnp.arange(num_microbatches))
 
 
+def embed_microbatches(embed_fn, embed_params, inputs_mb, keys_mb=None):
+    """vmap a spec's embed_fn over the microbatch axis, threading the
+    per-microbatch keys when dropout is active — one routing shared by
+    every pipelined driver."""
+    if keys_mb is not None:
+        return jax.vmap(embed_fn, in_axes=(None, 0, 0))(
+            embed_params, inputs_mb, keys_mb)
+    return jax.vmap(embed_fn, in_axes=(None, 0))(embed_params, inputs_mb)
+
+
+def append_dropout_operand(in_specs: list, args: list, keys_mb) -> None:
+    """Append the replicated per-microbatch keys operand to a driver's
+    shard_map spec/arg lists (no-op without dropout; the model folds the
+    mesh axes itself)."""
+    if keys_mb is not None:
+        in_specs.append(P())
+        args.append(keys_mb)
+
+
 def build_model(
     stage_init_fn: Callable[[jax.Array, int], Pytree],
     rng: jax.Array,

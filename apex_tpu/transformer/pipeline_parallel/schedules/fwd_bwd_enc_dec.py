@@ -53,6 +53,10 @@ from jax.sharding import PartitionSpec as P
 from apex_tpu.parallel.mesh import DP_AXIS, PP_AXIS
 from apex_tpu.transformer.pipeline_parallel.schedules.common import (
     _pvary,
+    append_dropout_operand,
+    check_dropout_spec,
+    derive_microbatch_keys,
+    embed_microbatches,
     replicate_loss,
     split_microbatches,
     stage_params_spec,
@@ -92,6 +96,12 @@ class EncDecPipelineSpec:
     dec_embed_fn: Callable[[Pytree, Pytree], Pytree]
     dec_stage_fn: Callable[[Pytree, Pytree, Pytree], Pytree]
     loss_fn: Callable[[Pytree, Pytree, Pytree], jnp.ndarray]
+    # True: embed and stage functions take a trailing per-microbatch PRNG
+    # key — ``enc_embed_fn(p, tok, key)`` / ``enc_stage_fn(p, h, key)`` /
+    # ``dec_stage_fn(p, h, memory, key)`` — so embedding dropout matches
+    # the sequential path (t5_encode/t5_decode apply it, salts 100/101).
+    # Per-side / per-stage decorrelation is the model's job.
+    takes_dropout_key: bool = False
 
 
 def broadcast_from_last_stage(x: Pytree, axis_name: str = PP_AXIS) -> Pytree:
@@ -120,6 +130,7 @@ def decoder_ring(
     num_microbatches: int,
     axis_name: str = PP_AXIS,
     remat: bool = True,
+    keys_mb: Optional[jax.Array] = None,
 ) -> Pytree:
     """``pipeline_ring`` with a per-tick cross-attention memory operand.
 
@@ -129,15 +140,23 @@ def decoder_ring(
     ``mem_mb[t - r]``; fill/drain ticks index a clipped microbatch and are
     masked out of the loss downstream, contributing exactly-zero cotangents
     to ``mem_mb`` through the finite stage math.
+
+    ``keys_mb`` ([M]-stacked PRNG keys) rides the same per-microbatch side
+    channel, arriving as ``dec_fn(params, h, memory, key)``.
     """
+    fn = dec_fn
+    extra = mem_mb
+    if keys_mb is not None:
+        extra = (mem_mb, keys_mb)
+        fn = lambda p, h, mem_key: dec_fn(p, h, *mem_key)  # noqa: E731
     return pipeline_ring(
-        dec_fn,
+        fn,
         stage_params,
         h_mb,
         num_microbatches=num_microbatches,
         axis_name=axis_name,
         remat=remat,
-        extra_mb=mem_mb,
+        extra_mb=extra,
     )
 
 
@@ -146,6 +165,7 @@ def _enc_dec_body(
     enc_inputs_mb: Pytree,
     dec_inputs_mb: Pytree,
     targets_mb: Pytree,
+    keys_mb: Optional[jax.Array] = None,
     *,
     spec: EncDecPipelineSpec,
     num_microbatches: int,
@@ -156,22 +176,21 @@ def _enc_dec_body(
     dec_local = jax.tree.map(lambda a: a[0], params["dec_stages"])
 
     # Phase 1: encoder ring over all pp stages.
-    h_enc_mb = jax.vmap(spec.enc_embed_fn, in_axes=(None, 0))(
-        params["embed"], enc_inputs_mb
-    )
+    h_enc_mb = embed_microbatches(spec.enc_embed_fn, params["embed"],
+                                  enc_inputs_mb, keys_mb)
     enc_out_mb = pipeline_ring(
         spec.enc_stage_fn,
         enc_local,
         h_enc_mb,
         num_microbatches=num_microbatches,
         remat=remat,
+        extra_mb=keys_mb,
     )
     mem_mb = broadcast_from_last_stage(enc_out_mb)
 
     # Phase 2: decoder ring, cross-attending to the broadcast memory.
-    h_dec_mb = jax.vmap(spec.dec_embed_fn, in_axes=(None, 0))(
-        params["embed"], dec_inputs_mb
-    )
+    h_dec_mb = embed_microbatches(spec.dec_embed_fn, params["embed"],
+                                  dec_inputs_mb, keys_mb)
     ys = decoder_ring(
         spec.dec_stage_fn,
         dec_local,
@@ -179,6 +198,7 @@ def _enc_dec_body(
         mem_mb,
         num_microbatches=num_microbatches,
         remat=remat,
+        keys_mb=keys_mb,
     )
     losses = jax.vmap(spec.loss_fn, in_axes=(None, 0, 0))(
         params["head"], ys, targets_mb
@@ -200,6 +220,7 @@ def forward_backward_pipelining_enc_dec(
     data_spec: P = P(None, DP_AXIS),
     loss_scale: Optional[jnp.ndarray] = None,
     remat: bool = True,
+    dropout_key: Optional[jax.Array] = None,
 ) -> Tuple[jnp.ndarray, Pytree]:
     """Encoder-decoder 1F1B driver. ``batch = (enc_inputs, dec_inputs,
     targets)`` pytrees with a leading global-batch dim. Returns
@@ -209,6 +230,12 @@ def forward_backward_pipelining_enc_dec(
     <[pp] axis>, "head": ...}`` — each device holds one encoder AND one
     decoder chunk (see module docstring for why this beats the reference's
     split-rank device partition on TPU).
+
+    ``dropout_key`` (requires ``spec.takes_dropout_key``) derives one key
+    per microbatch, delivered to BOTH rings' stage functions through the
+    per-microbatch side channel (``enc_stage_fn(p, h, key)`` /
+    ``dec_stage_fn(p, h, mem, key)``); per-side and per-stage
+    decorrelation is the model's fold (see ``t5_enc_dec_spec``).
     """
     if mesh is None:
         from apex_tpu.transformer import parallel_state
@@ -225,6 +252,8 @@ def forward_backward_pipelining_enc_dec(
     enc_mb = split_microbatches(enc_inputs, num_microbatches)
     dec_mb = split_microbatches(dec_inputs, num_microbatches)
     tgt_mb = split_microbatches(targets, num_microbatches)
+    check_dropout_spec(spec, dropout_key)
+    keys_mb = derive_microbatch_keys(dropout_key, num_microbatches)
 
     body = functools.partial(
         _enc_dec_body,
@@ -233,22 +262,25 @@ def forward_backward_pipelining_enc_dec(
         mesh=mesh,
         remat=remat,
     )
+    in_specs = [
+        params_specs,
+        jax.tree.map(lambda _: data_spec, enc_mb),
+        jax.tree.map(lambda _: data_spec, dec_mb),
+        jax.tree.map(lambda _: data_spec, tgt_mb),
+    ]
+    args = [enc_mb, dec_mb, tgt_mb]
+    append_dropout_operand(in_specs, args, keys_mb)
     sharded = shard_map(
         body,
         mesh=mesh,
-        in_specs=(
-            params_specs,
-            jax.tree.map(lambda _: data_spec, enc_mb),
-            jax.tree.map(lambda _: data_spec, dec_mb),
-            jax.tree.map(lambda _: data_spec, tgt_mb),
-        ),
+        in_specs=tuple(in_specs),
         out_specs=P(),
     )
 
     scale = 1.0 if loss_scale is None else loss_scale
 
     def scaled(p):
-        loss = sharded(p, enc_mb, dec_mb, tgt_mb)
+        loss = sharded(p, *args)
         return loss * scale, loss
 
     (_, loss), grads = jax.value_and_grad(scaled, has_aux=True)(params)

@@ -50,6 +50,23 @@ def forward_backward_no_pipelining(
     """
     mb = split_microbatches(batch, num_microbatches)
     scale = 1.0 if loss_scale is None else loss_scale
+    if dropout_key is not None:
+        # fail loudly before tracing: a 2-arg step func with dropout_key
+        # would otherwise die with an opaque arity TypeError inside scan
+        import inspect
+
+        try:
+            sig = inspect.signature(forward_step_func)
+        except (TypeError, ValueError):
+            sig = None  # uninspectable (C callable etc.) — let it through
+        if sig is not None:
+            try:
+                sig.bind(object(), object(), object())
+            except TypeError:
+                raise ValueError(
+                    "dropout_key given but forward_step_func does not "
+                    "accept a third per-microbatch key argument "
+                    "(params, microbatch, key)") from None
     keys_mb = derive_microbatch_keys(dropout_key, num_microbatches)
 
     def scaled(p, m, key):

@@ -40,8 +40,10 @@ from jax.sharding import PartitionSpec as P
 from apex_tpu.parallel.mesh import DP_AXIS, PP_AXIS
 from apex_tpu.transformer.pipeline_parallel.schedules.common import (
     PipelineSpec,
+    append_dropout_operand,
     check_dropout_spec,
     derive_microbatch_keys,
+    embed_microbatches,
     replicate_loss,
     split_microbatches,
     stage_params_spec,
@@ -153,12 +155,8 @@ def _pipeline_body(
 ):
     # stages leaves are [vp, 1, ...] locally (pp axis sharded at dim 1)
     chunk_local = jax.tree.map(lambda a: a[:, 0], params["stages"])
-    if keys_mb is not None:
-        h_mb = jax.vmap(spec.embed_fn, in_axes=(None, 0, 0))(
-            params["embed"], inputs_mb, keys_mb)
-    else:
-        h_mb = jax.vmap(spec.embed_fn, in_axes=(None, 0))(params["embed"],
-                                                          inputs_mb)
+    h_mb = embed_microbatches(spec.embed_fn, params["embed"], inputs_mb,
+                              keys_mb)
     ys = pipeline_ring_interleaved(
         spec.stage_fn,
         chunk_local,
@@ -245,9 +243,7 @@ def forward_backward_pipelining_with_interleaving(
         jax.tree.map(lambda _: data_spec, targets_mb),
     ]
     args = [inputs_mb, targets_mb]
-    if keys_mb is not None:
-        in_specs.append(P())  # keys replicated; model folds the axes
-        args.append(keys_mb)
+    append_dropout_operand(in_specs, args, keys_mb)
     sharded = shard_map(
         body,
         mesh=mesh,

@@ -37,8 +37,10 @@ from jax.sharding import PartitionSpec as P
 from apex_tpu.parallel.mesh import DP_AXIS, PP_AXIS
 from apex_tpu.transformer.pipeline_parallel.schedules.common import (
     PipelineSpec,
+    append_dropout_operand,
     check_dropout_spec,
     derive_microbatch_keys,
+    embed_microbatches,
     replicate_loss,
     split_microbatches,
     stage_params_spec,
@@ -164,12 +166,8 @@ def _pipeline_body(
     remat: bool,
 ):
     stage_local = jax.tree.map(lambda a: a[0], params["stages"])
-    if keys_mb is not None:
-        h_mb = jax.vmap(spec.embed_fn, in_axes=(None, 0, 0))(
-            params["embed"], inputs_mb, keys_mb)
-    else:
-        h_mb = jax.vmap(spec.embed_fn, in_axes=(None, 0))(params["embed"],
-                                                          inputs_mb)
+    h_mb = embed_microbatches(spec.embed_fn, params["embed"], inputs_mb,
+                              keys_mb)
     ys = pipeline_ring(
         spec.stage_fn,
         stage_local,
@@ -232,10 +230,6 @@ def forward_backward_pipelining_without_interleaving(
     )
 
     if isinstance(spec, EncDecPipelineSpec):
-        if dropout_key is not None:
-            raise NotImplementedError(
-                "dropout_key through the enc-dec schedule is not wired "
-                "yet; dropping it silently would train without dropout")
         # ModelType.encoder_and_decoder routing (ref common.py:80-103): the
         # same driver name serves both model types, as in the reference.
         return forward_backward_pipelining_enc_dec(
@@ -248,6 +242,7 @@ def forward_backward_pipelining_without_interleaving(
             data_spec=data_spec,
             loss_scale=loss_scale,
             remat=remat,
+            dropout_key=dropout_key,
         )
     if mesh is None:
         from apex_tpu.transformer import parallel_state
@@ -278,9 +273,7 @@ def forward_backward_pipelining_without_interleaving(
         jax.tree.map(lambda _: data_spec, targets_mb),
     ]
     args = [inputs_mb, targets_mb]
-    if keys_mb is not None:
-        in_specs.append(P())  # keys replicated; model folds the axes
-        args.append(keys_mb)
+    append_dropout_operand(in_specs, args, keys_mb)
     sharded = shard_map(
         body,
         mesh=mesh,

@@ -450,10 +450,19 @@ def _mlp(p, x, cfg: T5Config):
 def _maybe_hidden_dropout(x, cfg: T5Config, key, salt: int):
     if key is None or cfg.hidden_dropout <= 0.0:
         return x
-    from apex_tpu.transformer.testing.standalone_gpt import _hidden_dropout
+    from apex_tpu.transformer.testing.standalone_gpt import (
+        _hidden_dropout,
+        _hidden_key,
+    )
 
-    return _hidden_dropout(x, cfg.hidden_dropout, jax.random.fold_in(key,
-                                                                     salt))
+    # _hidden_key folds the TP rank under megatron_sp, and the SP rank is
+    # folded here under ring-sp — each rank holds a DIFFERENT seq shard,
+    # so an unfolded key would repeat one mask across the sequence with
+    # period s/tp resp. s/sp (the standalone_gpt policy)
+    key = jax.random.fold_in(key, salt)
+    if _sp_size() > 1:
+        key = jax.random.fold_in(key, lax.axis_index(SP_AXIS))
+    return _hidden_dropout(x, cfg.hidden_dropout, _hidden_key(key, cfg))
 
 
 def enc_layer_fn(p, x, cfg: T5Config, dropout_key=None, rel_bias=None):
@@ -728,14 +737,30 @@ def t5_pipeline_specs_tree(cfg: T5Config) -> Pytree:
     }
 
 
-def t5_enc_dec_spec(cfg: T5Config) -> EncDecPipelineSpec:
+def t5_enc_dec_spec(cfg: T5Config, dropout: bool = False) \
+        -> EncDecPipelineSpec:
+    """With ``dropout`` the stage functions take the schedule's
+    per-microbatch key (``takes_dropout_key``): the side salt (enc 0 /
+    dec 1, mirroring ``t5_loss``) and the PP rank are folded here —
+    encoder and decoder chunks share a stage's pp rank, and stage-local
+    layer indices restart at 0 per stage."""
     rel_on = cfg.relative_position_bias
 
-    def enc_embed_fn(embed, enc_tokens):
-        return _embed(embed, enc_tokens,
-                      None if rel_on else embed["pos_enc"], cfg.megatron_sp)
+    def _stage_key(key, side_salt: int):
+        key = jax.random.fold_in(key, side_salt)
+        return jax.random.fold_in(key, lax.axis_index(PP_AXIS))
 
-    def enc_stage_fn(stage_params, h):
+    def _enc_embed(embed, enc_tokens, key=None):
+        x = _embed(embed, enc_tokens,
+                   None if rel_on else embed["pos_enc"], cfg.megatron_sp)
+        # same embedding-dropout stream as the sequential path
+        # (t5_encode, salt 100)
+        return _maybe_hidden_dropout(
+            x, cfg, None if key is None
+            else jax.random.fold_in(key, 100), 0)
+
+    def _enc_stage(stage_params, h, key=None):
+        dk = None if key is None else _stage_key(key, 0)
         if rel_on:
             s = h.shape[1] * (lax.axis_size(TP_AXIS) if cfg.megatron_sp
                               else 1)
@@ -743,17 +768,23 @@ def t5_enc_dec_spec(cfg: T5Config) -> EncDecPipelineSpec:
                                            bidirectional=True, cfg=cfg), h)
             return _scan_layers(
                 lambda lp, x, rb, c, dropout_key=None: enc_layer_fn(
-                    lp, x, c, rel_bias=rb),
-                stage_params["layers"], h, cfg, rel)
+                    lp, x, c, dropout_key=dropout_key, rel_bias=rb),
+                stage_params["layers"], h, cfg, rel, dropout_key=dk)
         return _scan_layers(
-            lambda lp, x, c, dropout_key=None: enc_layer_fn(lp, x, c),
-            stage_params, h, cfg)
+            lambda lp, x, c, dropout_key=None: enc_layer_fn(
+                lp, x, c, dropout_key=dropout_key),
+            stage_params, h, cfg, dropout_key=dk)
 
-    def dec_embed_fn(embed, dec_tokens):
-        return _embed(embed, dec_tokens,
-                      None if rel_on else embed["pos_dec"], cfg.megatron_sp)
+    def _dec_embed(embed, dec_tokens, key=None):
+        x = _embed(embed, dec_tokens,
+                   None if rel_on else embed["pos_dec"], cfg.megatron_sp)
+        # t5_decode's embedding-dropout stream (salt 101)
+        return _maybe_hidden_dropout(
+            x, cfg, None if key is None
+            else jax.random.fold_in(key, 101), 0)
 
-    def dec_stage_fn(stage_params, h, mem):
+    def _dec_stage(stage_params, h, mem, key=None):
+        dk = None if key is None else _stage_key(key, 1)
         if cfg.encoder_final_ln:
             # every stage normalizes the same broadcast memory with its
             # copy of the encoder-final LN — identical to normalizing
@@ -767,13 +798,30 @@ def t5_enc_dec_spec(cfg: T5Config) -> EncDecPipelineSpec:
                                            bidirectional=False, cfg=cfg), h)
             return _scan_layers(
                 lambda lp, x, m, rb, c, dropout_key=None: dec_layer_fn(
-                    lp, x, m, c, rel_bias=rb),
-                stage_params["layers"], h, cfg, mem, rel)
+                    lp, x, m, c, dropout_key=dropout_key, rel_bias=rb),
+                stage_params["layers"], h, cfg, mem, rel, dropout_key=dk)
         layers = (stage_params["layers"] if cfg.encoder_final_ln
                   else stage_params)
         return _scan_layers(
-            lambda lp, x, m, c, dropout_key=None: dec_layer_fn(lp, x, m, c),
-            layers, h, cfg, mem)
+            lambda lp, x, m, c, dropout_key=None: dec_layer_fn(
+                lp, x, m, c, dropout_key=dropout_key),
+            layers, h, cfg, mem, dropout_key=dk)
+
+    if dropout:
+        enc_embed_fn, dec_embed_fn = _enc_embed, _dec_embed
+        enc_stage_fn, dec_stage_fn = _enc_stage, _dec_stage
+    else:
+        def enc_embed_fn(embed, enc_tokens):
+            return _enc_embed(embed, enc_tokens)
+
+        def dec_embed_fn(embed, dec_tokens):
+            return _dec_embed(embed, dec_tokens)
+
+        def enc_stage_fn(stage_params, h):
+            return _enc_stage(stage_params, h)
+
+        def dec_stage_fn(stage_params, h, mem):
+            return _dec_stage(stage_params, h, mem)
 
     def loss_fn(head, h, targets):
         # per-microbatch mean vocab-parallel CE over the untied head rows
@@ -791,4 +839,5 @@ def t5_enc_dec_spec(cfg: T5Config) -> EncDecPipelineSpec:
         return jnp.mean(vocab_parallel_cross_entropy(logits, targets))
 
     return EncDecPipelineSpec(enc_embed_fn, enc_stage_fn, dec_embed_fn,
-                              dec_stage_fn, loss_fn)
+                              dec_stage_fn, loss_fn,
+                              takes_dropout_key=dropout)

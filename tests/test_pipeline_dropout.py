@@ -195,6 +195,132 @@ def test_dropout_key_spec_pairing_validated_both_ways():
             _dropout_spec(), params, batch, num_microbatches=2, mesh=mesh)
 
 
+def test_enc_dec_dropout_matches_sequential():
+    """Enc-dec routing parity: both rings deliver the same per-microbatch
+    key (side/stage folds are the model's job — the toy folds a side salt
+    itself so encoder and decoder masks differ)."""
+    from apex_tpu.transformer.pipeline_parallel.schedules.fwd_bwd_enc_dec import (
+        EncDecPipelineSpec,
+        forward_backward_pipelining_enc_dec,
+    )
+
+    pp, M = 2, 4
+
+    def enc_embed(ep, x, key):
+        keep = jax.random.bernoulli(jax.random.fold_in(key, 4), KEEP,
+                                    x.shape)
+        return (x * keep) @ ep["w"]
+
+    def enc_stage(sp_, h, key):
+        keep = jax.random.bernoulli(jax.random.fold_in(key, 2), KEEP,
+                                    h.shape)
+        return jnp.tanh((h * keep) @ sp_["w"] + sp_["b"])
+
+    def dec_embed(ep, x, key):
+        keep = jax.random.bernoulli(jax.random.fold_in(key, 5), KEEP,
+                                    x.shape)
+        return (x * keep) @ ep["w"]
+
+    def dec_stage(sp_, h, mem, key):
+        keep = jax.random.bernoulli(jax.random.fold_in(key, 3), KEEP,
+                                    h.shape)
+        return jnp.tanh((h * keep + mem) @ sp_["w"] + sp_["b"])
+
+    def loss_fn(hp, h, tgt):
+        return jnp.mean((h @ hp["w"] - tgt) ** 2)
+
+    spec = EncDecPipelineSpec(enc_embed, enc_stage, dec_embed, dec_stage,
+                              loss_fn, takes_dropout_key=True)
+    p_enc = _params(jax.random.PRNGKey(0), pp)
+    p_dec = _params(jax.random.PRNGKey(1), pp)
+    params = {"embed": p_enc["embed"], "enc_stages": p_enc["stages"],
+              "dec_stages": p_dec["stages"], "head": p_dec["head"]}
+    enc_in, _ = _batch(jax.random.PRNGKey(2))
+    dec_in, tgt = _batch(jax.random.PRNGKey(3))
+    key = jax.random.PRNGKey(13)
+    mesh = build_mesh(tp=1, pp=pp, sp=1, devices=jax.devices()[:pp])
+    loss, grads = forward_backward_pipelining_enc_dec(
+        spec, params, (enc_in, dec_in, tgt), num_microbatches=M,
+        mesh=mesh, dropout_key=key)
+
+    def loss_of(p):
+        def one_mb(ex, dx, t, m):
+            key_m = jax.random.fold_in(key, m)
+            h = enc_embed(p["embed"], ex, key_m)
+            for s in range(pp):
+                h = enc_stage(jax.tree.map(lambda a: a[s],
+                                           p["enc_stages"]), h, key_m)
+            mem = h
+            h = dec_embed(p["embed"], dx, key_m)
+            for s in range(pp):
+                h = dec_stage(jax.tree.map(lambda a: a[s],
+                                           p["dec_stages"]), h, mem, key_m)
+            return loss_fn(p["head"], h, t)
+
+        nb = enc_in.shape[0]
+        sh = lambda a: a.reshape((M, nb // M) + a.shape[1:])
+        return jnp.mean(jax.vmap(one_mb)(sh(enc_in), sh(dec_in), sh(tgt),
+                                         jnp.arange(M)))
+
+    want, gref = jax.jit(jax.value_and_grad(loss_of))(params)
+    np.testing.assert_allclose(float(loss), float(want), rtol=1e-5,
+                               atol=1e-6)
+    _assert_tree_close(grads, gref)
+
+
+def test_t5_enc_dec_pipeline_trains_with_dropout():
+    """T5 through the enc-dec schedule with hidden dropout: runs,
+    deterministic replay, key-sensitive."""
+    from apex_tpu.transformer import parallel_state
+    from apex_tpu.transformer.testing import (
+        T5Config,
+        t5_enc_dec_spec,
+        t5_pipeline_params,
+        t5_pipeline_specs_tree,
+    )
+    from apex_tpu.transformer.pipeline_parallel.schedules import (
+        forward_backward_pipelining_enc_dec,
+    )
+
+    pp, M = 2, 2
+    mesh = parallel_state.initialize_model_parallel(
+        pipeline_model_parallel_size_=pp,
+        pipeline_model_parallel_split_rank_=1,
+        devices=jax.devices()[:2])
+    try:
+        cfg = T5Config(vocab_size=64, hidden=32, num_heads=4, enc_layers=2,
+                       dec_layers=2, max_seq_enc=16, max_seq_dec=8,
+                       dtype=jnp.float32, fused_loss=False,
+                       hidden_dropout=0.2, attention_dropout=0.0)
+        params = t5_pipeline_params(jax.random.PRNGKey(4), cfg, pp=pp)
+        spec = t5_enc_dec_spec(cfg, dropout=True)
+        st = t5_pipeline_specs_tree(cfg)
+        k = jax.random.PRNGKey(5)
+        enc_tok = jax.random.randint(k, (2 * M, cfg.max_seq_enc), 0,
+                                     cfg.vocab_size)
+        dec_tok = jax.random.randint(jax.random.fold_in(k, 1),
+                                     (2 * M, cfg.max_seq_dec), 0,
+                                     cfg.vocab_size)
+        tgt = jnp.roll(dec_tok, -1, 1)
+
+        @jax.jit
+        def step(params, key):
+            return forward_backward_pipelining_enc_dec(
+                spec, params, (enc_tok, dec_tok, tgt), num_microbatches=M,
+                mesh=mesh, params_specs=st, dropout_key=key)
+
+        l1, g1 = step(params, jax.random.PRNGKey(6))
+        l2, _ = step(params, jax.random.PRNGKey(6))
+        l3, _ = step(params, jax.random.PRNGKey(7))
+        assert np.isfinite(float(l1))
+        assert float(l1) == float(l2)
+        assert float(l3) != float(l1)
+        assert any(np.abs(np.asarray(g)).max() > 0
+                   for g in jax.tree.leaves(g1))
+    finally:
+        parallel_state.destroy_model_parallel()
+
+
 def test_gpt_pipeline_trains_with_dropout_under_pp_sp():
     """The flagship fixture end-to-end: pp=2 x sp=2 1F1B with hidden
     dropout active — runs, deterministic for a fixed key, key-sensitive
@@ -237,3 +363,12 @@ def test_gpt_pipeline_trains_with_dropout_under_pp_sp():
     l3, _ = run(jax.random.PRNGKey(4))
     assert l3 != l1, "different key must change the loss"
     assert any(np.abs(np.asarray(g)).max() > 0 for g in jax.tree.leaves(g1))
+
+
+def test_no_pipelining_dropout_arity_checked():
+    params = _params(jax.random.PRNGKey(0), 2)
+    with pytest.raises(ValueError, match="third per-microbatch key"):
+        forward_backward_no_pipelining(
+            lambda p, m: jnp.zeros(()), _batch(jax.random.PRNGKey(1)),
+            params, num_microbatches=2,
+            dropout_key=jax.random.PRNGKey(0))

@@ -445,3 +445,41 @@ def test_t5_encoder_final_ln_pipeline_matches_sequential():
         build_mesh(tp=1), CFG, init_t5_params(jax.random.PRNGKey(0), CFG),
         (enc_tok, dec_tok, tgt))
     assert float(ref_loss) != float(plain_loss)
+
+
+# ---------------------------------------------------------------------------
+# hidden-dropout shard decorrelation (round-5 fixes: unfolded keys reused
+# one mask across seq shards under megatron_sp / ring-sp)
+
+
+def _hidden_dropout_shards(cfg, mesh, axis):
+    """Gather _maybe_hidden_dropout's output on identical per-shard inputs
+    — differing shard halves prove decorrelated masks."""
+    from apex_tpu.transformer.testing.standalone_t5 import (
+        _maybe_hidden_dropout,
+    )
+
+    def body():
+        x = jnp.broadcast_to(
+            jax.random.normal(jax.random.PRNGKey(2), (cfg.hidden,)),
+            (1, 16, cfg.hidden))
+        return _maybe_hidden_dropout(x, cfg, jax.random.PRNGKey(0), 1)
+
+    return np.asarray(jax.jit(shard_map(
+        body, mesh=mesh, in_specs=(),
+        out_specs=P(None, axis, None), check_vma=False))())
+
+
+def test_t5_megatron_sp_hidden_dropout_decorrelated():
+    cfg = dataclasses.replace(CFG, megatron_sp=True, hidden_dropout=0.5)
+    out = _hidden_dropout_shards(cfg, build_mesh(tp=2), "tp")
+    assert out.shape[1] == 32
+    assert not np.array_equal(out[:, :16], out[:, 16:]), \
+        "tp seq shards must drop independent positions under megatron_sp"
+
+
+def test_t5_ring_sp_hidden_dropout_decorrelated():
+    cfg = dataclasses.replace(CFG, hidden_dropout=0.5)
+    out = _hidden_dropout_shards(cfg, build_mesh(tp=1, sp=2), "sp")
+    assert not np.array_equal(out[:, :16], out[:, 16:]), \
+        "sp seq shards must drop independent positions under ring-sp"
