@@ -90,23 +90,39 @@ def attention_reference(q, k, v, mask=None, scale: Optional[float] = None,
 
 def _dropout_keep(seed_ref, rate, block_q, block_k, q_i, kv_i, bh_i):
     """Deterministic keep mask from a counter-based hash of (seed, batch*head,
-    absolute q position, absolute k position) — the philox-counter scheme of
+    GLOBAL q position, GLOBAL k position) — the philox-counter scheme of
     the reference's fmhalib dropout. Position-keyed (not block-keyed), so the
     identical mask regenerates in forward and both backward kernels even at
     different block sizes, and plain integer ops keep it portable to pallas
     interpret mode (pltpu's hardware PRNG is TPU-only). ``bh_i`` must be read
     at kernel top level (program_id inside a pl.when body does not lower in
-    interpret mode)."""
+    interpret mode).
+
+    ``seed_ref`` is the SMEM operand ``[seed, q_off, k_off]``: the offsets
+    translate kernel-local positions to global sequence positions, so a
+    seq-sharded call (ring attention's per-chunk kernels) regenerates
+    EXACTLY the corresponding slice of the dense global mask — sharding is
+    invisible to the dropout stream."""
     # all-uint32 arithmetic: mixing a signed scalar into the uint32 iota
     # would promote/wrap and skew the keep probability
-    qpos = ((q_i * block_q).astype(jnp.uint32)
+    qpos = (seed_ref[1].astype(jnp.uint32)
+            + (q_i * block_q).astype(jnp.uint32)
             + jax.lax.broadcasted_iota(jnp.uint32, (block_q, block_k), 0))
-    kpos = ((kv_i * block_k).astype(jnp.uint32)
+    kpos = (seed_ref[2].astype(jnp.uint32)
+            + (kv_i * block_k).astype(jnp.uint32)
             + jax.lax.broadcasted_iota(jnp.uint32, (block_q, block_k), 1))
+    return _hash_keep(qpos, kpos, seed_ref[0].astype(jnp.uint32),
+                      bh_i.astype(jnp.uint32), rate)
+
+
+def _hash_keep(qpos, kpos, seed_u32, bh_u32, rate: float):
+    """The ONE mask derivation both the Pallas kernels and the dense/ring
+    einsum paths share — any drift between copies would silently break the
+    ring-equals-dense dropout invariant. All operands uint32."""
     x = (qpos * jnp.uint32(0x9E3779B1)
          + kpos * jnp.uint32(0x85EBCA77)
-         + seed_ref[0].astype(jnp.uint32) * jnp.uint32(0xC2B2AE3D)
-         + bh_i.astype(jnp.uint32) * jnp.uint32(0x27D4EB2F))
+         + seed_u32 * jnp.uint32(0xC2B2AE3D)
+         + bh_u32 * jnp.uint32(0x27D4EB2F))
     # murmur3 fmix32 finalizer: full-avalanche 32-bit mixing
     x = x ^ (x >> jnp.uint32(16))
     x = x * jnp.uint32(0x85EBCA6B)
@@ -115,6 +131,35 @@ def _dropout_keep(seed_ref, rate, block_q, block_k, q_i, kv_i, bh_i):
     x = x ^ (x >> jnp.uint32(16))
     thresh = jnp.uint32(min(int(rate * 4294967296.0), 4294967295))
     return x >= thresh
+
+
+def _seed3(seed):
+    """Normalize the dropout SMEM operand to ``[seed, q_off, k_off]``;
+    scalar/(1,) legacy callers get zero offsets."""
+    if seed is None:
+        return jnp.zeros((3,), jnp.int32)
+    seed = jnp.asarray(seed, jnp.int32).reshape(-1)
+    if seed.shape[0] == 1:
+        return jnp.concatenate([seed, jnp.zeros((2,), jnp.int32)])
+    if seed.shape[0] != 3:
+        raise ValueError(f"dropout seed operand must be scalar, (1,) or "
+                         f"(3,) [seed, q_off, k_off]; got {seed.shape}")
+    return seed
+
+
+def attention_dropout_mask(seed, rate: float, bh: int, sq: int, sk: int,
+                           q_off=0, k_off=0):
+    """(bh, sq, sk) keep mask — bit-identical to what the Pallas kernels
+    regenerate from ``(seed, batch*head, global positions)``. Used by the
+    ring-SP einsum chunk path and parity tests: with the right offsets a
+    seq shard's mask IS the corresponding slice of the dense mask."""
+    qpos = (jnp.asarray(q_off).astype(jnp.uint32)
+            + jnp.arange(sq, dtype=jnp.uint32))[None, :, None]
+    kpos = (jnp.asarray(k_off).astype(jnp.uint32)
+            + jnp.arange(sk, dtype=jnp.uint32))[None, None, :]
+    bh_i = jnp.arange(bh, dtype=jnp.uint32)[:, None, None]
+    return _hash_keep(qpos, kpos, jnp.asarray(seed).astype(jnp.uint32),
+                      bh_i, rate)
 
 
 def _fa_fwd_kernel(seed_ref, q_ref, k_ref, v_ref, *refs,
@@ -211,8 +256,7 @@ def _fa_fwd(q3, k3, v3, scale, causal, block_q, block_k, interpret,
     sk = k3.shape[1]
     nq = sq // block_q
     nk = sk // block_k
-    if seed is None:
-        seed = jnp.zeros((1,), jnp.int32)
+    seed = _seed3(seed)
     has_bias = bias is not None
     kernel = functools.partial(
         _fa_fwd_kernel, scale=scale, causal=causal,
@@ -446,8 +490,7 @@ def _fa_bwd(q3, k3, v3, o3, lse, do3, scale, causal, block_q, block_k,
     sk = k3.shape[1]
     nq = sq // block_q
     nk = sk // block_k
-    if seed is None:
-        seed = jnp.zeros((1,), jnp.int32)
+    seed = _seed3(seed)
     has_bias = bias is not None
     delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
                     axis=-1, keepdims=True)

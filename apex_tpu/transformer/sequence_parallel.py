@@ -42,6 +42,7 @@ from apex_tpu.ops.attention import (
     _fa_fwd,
     _pallas_ok,
     _pick_block,
+    attention_dropout_mask,
     flash_attention,
 )
 from apex_tpu.parallel.mesh import SP_AXIS
@@ -59,6 +60,8 @@ def ring_attention(
     remat_steps: bool = True,
     impl: str = "auto",
     bias_strip=None,
+    dropout_rate: float = 0.0,
+    dropout_seed=None,
 ):
     """Exact attention over a sequence sharded on ``axis_name``.
 
@@ -86,9 +89,20 @@ def ring_attention(
       so the mesh tests exercise the real collectives + VJP).
     * ``"scan"`` — the original einsum online-softmax scan, differentiated
       by jax AD through the ring (reference implementation).
+
+    ``dropout_rate`` > 0 (requires ``dropout_seed`` and ``impl='auto'``)
+    applies probability dropout to the normalized attention weights with
+    the flash kernels' GLOBAL-position-keyed counter hash: every chunk
+    regenerates the slice of the dense mask its global (q, k) coordinates
+    select, so the ring result equals a dense ``flash_attention`` call
+    with the same seed — sharding is invisible to the dropout stream, and
+    the mask is identical in forward and the second (backward) ring pass.
+    Pass the same seed on every sp rank (positions decorrelate shards).
     """
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
+    if dropout_rate > 0.0 and dropout_seed is None:
+        raise ValueError("dropout_rate > 0 needs dropout_seed")
     if impl == "auto":
         from apex_tpu.ops._pallas_util import compiled_backend
 
@@ -96,6 +110,8 @@ def ring_attention(
         use_pallas = (compiled_backend()
                       and _pallas_ok(s_loc, s_loc, d, causal=False,
                                      allow_interpret=False))
+        seed = (jnp.zeros((), jnp.int32) if dropout_seed is None
+                else jnp.asarray(dropout_seed, jnp.int32).reshape(()))
         if bias_strip is not None:
             n = lax.axis_size(axis_name)
             want = (h, s_loc, n * k.shape[2])
@@ -103,11 +119,15 @@ def ring_attention(
                 raise ValueError(
                     f"bias_strip must be (heads, s_local, sp*sk_local) = "
                     f"{want}, got {bias_strip.shape}")
-            return _ring_flash_biased(q, k, v, bias_strip, axis_name,
-                                      causal, scale, use_pallas)
-        return _ring_flash(q, k, v, axis_name, causal, scale, use_pallas)
+            return _ring_flash_biased(q, k, v, bias_strip, seed, axis_name,
+                                      causal, scale, use_pallas,
+                                      float(dropout_rate))
+        return _ring_flash(q, k, v, seed, axis_name, causal, scale,
+                           use_pallas, float(dropout_rate))
     if bias_strip is not None:
         raise NotImplementedError("bias_strip needs impl='auto'")
+    if dropout_rate > 0.0:
+        raise NotImplementedError("attention dropout needs impl='auto'")
     return _ring_scan(q, k, v, axis_name, causal, scale, remat_steps)
 
 
@@ -196,20 +216,45 @@ def _vary_like_inputs(x, *refs, extra=()):
     return lax.pcast(x, missing, to="varying") if missing else x
 
 
-def _chunk_fwd(q, k_c, v_c, scale, causal, use_pallas, bias_c=None):
+def _chunk_keep(dropout, b, h, s, sk):
+    """(b, h, s, sk) keep mask for one ring chunk — the kernels' global
+    hash at this chunk's offsets, so the einsum path and a dense global
+    call drop identical entries. ``dropout = (rate, seed, q_off, k_off)``
+    or None."""
+    rate, seed, q_off, k_off = dropout
+    return attention_dropout_mask(seed, rate, b * h, s, sk, q_off,
+                                  k_off).reshape(b, h, s, sk)
+
+
+def _chunk_seed3(dropout):
+    rate, seed, q_off, k_off = dropout
+    return jnp.stack([jnp.asarray(seed, jnp.int32).reshape(()),
+                      jnp.asarray(q_off, jnp.int32).reshape(()),
+                      jnp.asarray(k_off, jnp.int32).reshape(())])
+
+
+def _chunk_fwd(q, k_c, v_c, scale, causal, use_pallas, bias_c=None,
+               dropout=None):
     """One Q-shard x K/V-chunk attention -> (o [q.dtype], lse fp32).
     ``k_c``/``v_c`` may have a different sequence length than ``q``
     (cross-attention rings); the causal mask is only meaningful square.
     ``bias_c``: optional batch-shared (h, s, sk) additive logit bias for
-    this chunk's columns (T5 relative position bias under ring SP)."""
+    this chunk's columns (T5 relative position bias under ring SP).
+    ``dropout``: optional ``(rate, seed, q_off, k_off)`` — probability
+    dropout on the normalized weights with the kernels' global-position
+    hash, offsets mapping this chunk into the global mask."""
     b, h, s, d = q.shape
     sk = k_c.shape[2]
+    rate = dropout[0] if dropout is not None else 0.0
     if use_pallas:
         q3 = q.reshape(b * h, s, d)
         o3, lse3 = _fa_fwd(q3, k_c.reshape(b * h, sk, d),
                            v_c.reshape(b * h, sk, d), scale, causal,
                            _pick_block(s, 128), _pick_block(sk, 128),
-                           interpret=False, bias=bias_c)
+                           interpret=False, bias=bias_c,
+                           dropout_rate=rate,
+                           seed=None if dropout is None
+                           else _chunk_seed3(dropout))
         return o3.reshape(b, h, s, d), lse3[..., 0].reshape(b, h, s)
     q32 = q.astype(jnp.float32)
     s_ = jnp.einsum("bhqd,bhkd->bhqk", q32, k_c.astype(jnp.float32)) * scale
@@ -221,7 +266,12 @@ def _chunk_fwd(q, k_c, v_c, scale, causal, use_pallas, bias_c=None):
     m = jnp.max(s_, axis=-1, keepdims=True)
     p = jnp.exp(s_ - m)
     p = jnp.where(s_ <= NEG_INF / 2, 0.0, p)
+    # l accumulates the UNdropped p (normalization precedes dropout) —
+    # identical to the kernel's accumulation order
     l = jnp.sum(p, axis=-1, keepdims=True)
+    if rate > 0.0:
+        keep = _chunk_keep(dropout, b, h, s, sk)
+        p = jnp.where(keep, p * (1.0 / (1.0 - rate)), 0.0)
     o = jnp.einsum("bhqk,bhkd->bhqd", p, v_c.astype(jnp.float32))
     o = o / jnp.where(l == 0.0, 1.0, l)
     lse = jnp.where(l[..., 0] == 0.0, NEG_INF, m[..., 0] + jnp.log(
@@ -230,14 +280,16 @@ def _chunk_fwd(q, k_c, v_c, scale, causal, use_pallas, bias_c=None):
 
 
 def _chunk_bwd(q, k_c, v_c, o, lse, do, delta, scale, causal, use_pallas,
-               bias_c=None, want_dbias=False):
+               bias_c=None, want_dbias=False, dropout=None):
     """Per-chunk flash backward against the *global* lse -> (dq, dk, dv[,
     dbias]) fp32. ``p = exp(s - lse_global)`` is the exact global softmax
     restricted to this chunk's columns, so summing chunk contributions
     reproduces the dense backward; dbias (batch-reduced, no q·kᵀ scale)
-    is returned when ``want_dbias``."""
+    is returned when ``want_dbias``. ``dropout`` as in :func:`_chunk_fwd`
+    — the mask regenerates from the same global hash."""
     b, h, s, d = q.shape
     sk = k_c.shape[2]
+    rate = dropout[0] if dropout is not None else 0.0
     if use_pallas:
         sh = (b * h, s, d)
         shk = (b * h, sk, d)
@@ -245,7 +297,8 @@ def _chunk_bwd(q, k_c, v_c, o, lse, do, delta, scale, causal, use_pallas,
             q.reshape(sh), k_c.reshape(shk), v_c.reshape(shk), o.reshape(sh),
             lse.reshape(b * h, s, 1), do.reshape(sh), scale, causal,
             _pick_block(s, 128), _pick_block(sk, 128), interpret=False,
-            bias=bias_c)
+            bias=bias_c, dropout_rate=rate,
+            seed=None if dropout is None else _chunk_seed3(dropout))
         out = (dq3.reshape(b, h, s, d).astype(jnp.float32),
                dk3.reshape(b, h, sk, d).astype(jnp.float32),
                dv3.reshape(b, h, sk, d).astype(jnp.float32))
@@ -262,8 +315,18 @@ def _chunk_bwd(q, k_c, v_c, o, lse, do, delta, scale, causal, use_pallas,
                        NEG_INF, s_)
     p = jnp.exp(s_ - lse[..., None])
     p = jnp.where(s_ <= NEG_INF / 2, 0.0, p)
-    dv = jnp.einsum("bhqk,bhqd->bhkd", p, do32)
     dp = jnp.einsum("bhqd,bhkd->bhqk", do32, v32)
+    if rate > 0.0:
+        # mirror the kernels exactly: dv from the DROPPED+rescaled p, dp
+        # masked+rescaled before the ds chain (dropout is elementwise on
+        # the normalized weights, so its transpose masks the cotangent)
+        keep = _chunk_keep(dropout, b, h, s, sk)
+        inv = 1.0 / (1.0 - rate)
+        p_v = jnp.where(keep, p * inv, 0.0)
+        dp = jnp.where(keep, dp * inv, 0.0)
+    else:
+        p_v = p
+    dv = jnp.einsum("bhqk,bhqd->bhkd", p_v, do32)
     ds_pre = p * (dp - delta)  # dL/ds before the q·kᵀ scale chain
     ds = ds_pre * scale
     dq = jnp.einsum("bhqk,bhkd->bhqd", ds, k32)
@@ -295,20 +358,28 @@ def _bias_chunk(bias_strip, origin, sk_loc):
 # must not carry a dummy strip (it would cost O(s²/n) memory for nothing).
 
 def _ring_fwd_impl(q, k, v, bias_strip, axis_name, causal, scale,
-                   use_pallas):
+                   use_pallas, dropout_rate=0.0, seed=None):
     n = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     b, h, s_loc, d = q.shape
     sk_loc = k.shape[2]
     has_bias = bias_strip is not None
+    q_off = my * s_loc  # global row offset of this device's Q shard
 
-    def full_f(q, k_c, v_c, bias_c=None):
-        return _chunk_fwd(q, k_c, v_c, scale, False, use_pallas, bias_c)
+    def _dropout(k_off):
+        if dropout_rate <= 0.0:
+            return None
+        return (dropout_rate, seed, q_off, k_off)
 
-    def diag_f(q, k_c, v_c, bias_c=None):
-        return _chunk_fwd(q, k_c, v_c, scale, True, use_pallas, bias_c)
+    def full_f(q, k_c, v_c, k_off, bias_c=None):
+        return _chunk_fwd(q, k_c, v_c, scale, False, use_pallas, bias_c,
+                          dropout=_dropout(k_off))
 
-    def skip_f(q, k_c, v_c, bias_c=None):
+    def diag_f(q, k_c, v_c, k_off, bias_c=None):
+        return _chunk_fwd(q, k_c, v_c, scale, True, use_pallas, bias_c,
+                          dropout=_dropout(k_off))
+
+    def skip_f(q, k_c, v_c, k_off, bias_c=None):
         # match the compute branches' varying axes (switch unifies types)
         return (_vary_like_inputs(jnp.zeros_like(q), q, k_c),
                 _vary_like_inputs(
@@ -317,7 +388,7 @@ def _ring_fwd_impl(q, k, v, bias_strip, axis_name, causal, scale,
     def step(carry, t):
         k_c, v_c, o_bar, lse_run = carry
         origin = (my - t) % n
-        args = (q, k_c, v_c)
+        args = (q, k_c, v_c, origin * sk_loc)
         if has_bias:
             args += (_bias_chunk(bias_strip, origin, sk_loc),)
         o_c, lse_c = lax.switch(_branch_idx(origin, my, causal),
@@ -339,25 +410,33 @@ def _ring_fwd_impl(q, k, v, bias_strip, axis_name, causal, scale,
 
 
 def _ring_bwd_impl(q, k, v, bias_strip, o, lse, do, axis_name, causal,
-                   scale, use_pallas):
+                   scale, use_pallas, dropout_rate=0.0, seed=None):
     """-> (dq, dk, dv[, dbias_strip]) — the last only when biased."""
     n = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     b, h, s_loc, d = q.shape
     sk_loc = k.shape[2]
     has_bias = bias_strip is not None
+    q_off = my * s_loc
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1, keepdims=True)
 
-    def full_f(q, k_c, v_c, bias_c=None):
+    def _dropout(k_off):
+        if dropout_rate <= 0.0:
+            return None
+        return (dropout_rate, seed, q_off, k_off)
+
+    def full_f(q, k_c, v_c, k_off, bias_c=None):
         return _chunk_bwd(q, k_c, v_c, o, lse, do, delta, scale, False,
-                          use_pallas, bias_c, want_dbias=has_bias)
+                          use_pallas, bias_c, want_dbias=has_bias,
+                          dropout=_dropout(k_off))
 
-    def diag_f(q, k_c, v_c, bias_c=None):
+    def diag_f(q, k_c, v_c, k_off, bias_c=None):
         return _chunk_bwd(q, k_c, v_c, o, lse, do, delta, scale, True,
-                          use_pallas, bias_c, want_dbias=has_bias)
+                          use_pallas, bias_c, want_dbias=has_bias,
+                          dropout=_dropout(k_off))
 
-    def skip_f(q, k_c, v_c, bias_c=None):
+    def skip_f(q, k_c, v_c, k_off, bias_c=None):
         zq = _vary_like_inputs(jnp.zeros((b, h, s_loc, d), jnp.float32),
                                q, k_c, do)
         zk = _vary_like_inputs(
@@ -374,7 +453,7 @@ def _ring_bwd_impl(q, k, v, bias_strip, o, lse, do, axis_name, causal,
         else:
             k_c, v_c, dq_acc, dk_acc, dv_acc = carry
         origin = (my - t) % n
-        args = (q, k_c, v_c)
+        args = (q, k_c, v_c, origin * sk_loc)
         if has_bias:
             args += (_bias_chunk(bias_strip, origin, sk_loc),)
         out = lax.switch(_branch_idx(origin, my, causal),
@@ -413,46 +492,55 @@ def _ring_bwd_impl(q, k, v, bias_strip, o, lse, do, axis_name, causal,
     return out
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _ring_flash(q, k, v, axis_name, causal, scale, use_pallas):
-    o, _ = _ring_flash_fwd(q, k, v, axis_name, causal, scale, use_pallas)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _ring_flash(q, k, v, seed, axis_name, causal, scale, use_pallas,
+                dropout_rate):
+    o, _ = _ring_flash_fwd(q, k, v, seed, axis_name, causal, scale,
+                           use_pallas, dropout_rate)
     return o
 
 
-def _ring_flash_fwd(q, k, v, axis_name, causal, scale, use_pallas):
+def _ring_flash_fwd(q, k, v, seed, axis_name, causal, scale, use_pallas,
+                    dropout_rate):
     o, lse = _ring_fwd_impl(q, k, v, None, axis_name, causal, scale,
-                            use_pallas)
-    return o, (q, k, v, o, lse)
+                            use_pallas, dropout_rate, seed)
+    return o, (q, k, v, seed, o, lse)
 
 
-def _ring_flash_bwd(axis_name, causal, scale, use_pallas, res, do):
-    q, k, v, o, lse = res
-    return _ring_bwd_impl(q, k, v, None, o, lse, do, axis_name, causal,
-                          scale, use_pallas)
+def _ring_flash_bwd(axis_name, causal, scale, use_pallas, dropout_rate,
+                    res, do):
+    q, k, v, seed, o, lse = res
+    dq, dk, dv = _ring_bwd_impl(q, k, v, None, o, lse, do, axis_name,
+                                causal, scale, use_pallas, dropout_rate,
+                                seed)
+    return dq, dk, dv, None
 
 
 _ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
-def _ring_flash_biased(q, k, v, bias_strip, axis_name, causal, scale,
-                       use_pallas):
-    o, _ = _ring_flash_biased_fwd(q, k, v, bias_strip, axis_name, causal,
-                                  scale, use_pallas)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _ring_flash_biased(q, k, v, bias_strip, seed, axis_name, causal, scale,
+                       use_pallas, dropout_rate):
+    o, _ = _ring_flash_biased_fwd(q, k, v, bias_strip, seed, axis_name,
+                                  causal, scale, use_pallas, dropout_rate)
     return o
 
 
-def _ring_flash_biased_fwd(q, k, v, bias_strip, axis_name, causal, scale,
-                           use_pallas):
+def _ring_flash_biased_fwd(q, k, v, bias_strip, seed, axis_name, causal,
+                           scale, use_pallas, dropout_rate):
     o, lse = _ring_fwd_impl(q, k, v, bias_strip, axis_name, causal, scale,
-                            use_pallas)
-    return o, (q, k, v, bias_strip, o, lse)
+                            use_pallas, dropout_rate, seed)
+    return o, (q, k, v, bias_strip, seed, o, lse)
 
 
-def _ring_flash_biased_bwd(axis_name, causal, scale, use_pallas, res, do):
-    q, k, v, bias_strip, o, lse = res
-    return _ring_bwd_impl(q, k, v, bias_strip, o, lse, do, axis_name,
-                          causal, scale, use_pallas)
+def _ring_flash_biased_bwd(axis_name, causal, scale, use_pallas,
+                           dropout_rate, res, do):
+    q, k, v, bias_strip, seed, o, lse = res
+    dq, dk, dv, db = _ring_bwd_impl(q, k, v, bias_strip, o, lse, do,
+                                    axis_name, causal, scale, use_pallas,
+                                    dropout_rate, seed)
+    return dq, dk, dv, db, None
 
 
 _ring_flash_biased.defvjp(_ring_flash_biased_fwd, _ring_flash_biased_bwd)

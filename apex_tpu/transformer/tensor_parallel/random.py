@@ -60,6 +60,19 @@ def data_parallel_key(key):
     return key
 
 
+def attention_dropout_seed(key, axis_name: str = TP_AXIS):
+    """int32 seed for the flash kernels' counter-based attention dropout:
+    the TP-folded stream (attention probabilities live on TP-sharded
+    heads, so ranks must drop independent entries) reduced to the scalar
+    the kernels take. The ONE policy shared by the dense and ring-SP
+    attention paths in the GPT/T5 fixtures — the ring's global-position
+    hash decorrelates sp shards itself, so sp deliberately does not enter."""
+    import jax.numpy as jnp
+
+    return jax.random.bits(model_parallel_key(key, axis_name),
+                           dtype=jnp.uint32).astype(jnp.int32)
+
+
 def pipeline_stage_key(key, axis_name: str = PP_AXIS):
     """Distinct per pipeline stage — used to decorrelate dropout across
     stages when one traced program serves every stage."""

@@ -327,25 +327,24 @@ def _attention(p, x, cfg, heads_local: int, causal: bool = True, mask=None,
             raise NotImplementedError(
                 "explicit attention masks are not supported with sp > 1; "
                 "use causal or full attention")
-        if rate > 0.0:
-            raise NotImplementedError(
-                "attention dropout under sequence parallelism needs "
-                "position-consistent masks across ring steps; disable "
-                "attention_dropout with sp > 1")
         from apex_tpu.transformer.sequence_parallel import ring_attention
 
-        ctx = ring_attention(q, k, v, causal=causal)
+        if rate > 0.0:
+            from apex_tpu.transformer.tensor_parallel.random import (
+                attention_dropout_seed,
+            )
+
+            ctx = ring_attention(
+                q, k, v, causal=causal, dropout_rate=rate,
+                dropout_seed=attention_dropout_seed(dropout_key))
+        else:
+            ctx = ring_attention(q, k, v, causal=causal)
     elif rate > 0.0:
-        # the attention probabilities live on the TP-sharded heads: fold the
-        # TP rank into the seed so ranks drop independent entries (ref
-        # tensor_parallel/random.py model-parallel stream)
         from apex_tpu.transformer.tensor_parallel.random import (
-            model_parallel_key,
+            attention_dropout_seed,
         )
 
-        seed = jax.random.bits(
-            model_parallel_key(dropout_key), dtype=jnp.uint32
-        ).astype(jnp.int32)
+        seed = attention_dropout_seed(dropout_key)
         ctx = flash_attention(q, k, v, causal=causal, mask=mask,
                               block_q=cfg.attn_block_q,
                               block_k=cfg.attn_block_k,

@@ -364,25 +364,27 @@ def _attn_core(q, k, v, cfg: T5Config, causal: bool, dropout_key,
     """
     rate = cfg.attention_dropout if dropout_key is not None else 0.0
     if _sp_size() > 1:
-        if rate > 0.0:
-            raise NotImplementedError(
-                "attention dropout under sequence parallelism needs "
-                "position-consistent masks across ring steps; disable "
-                "attention_dropout with sp > 1")
         from apex_tpu.transformer.sequence_parallel import ring_attention
 
         # bias here is the ring STRIP (heads_local, s_loc, sp*s_loc) built
         # from global positions by t5_encode/t5_decode; each ring step
         # slices the arriving chunk's columns
+        if rate > 0.0:
+            from apex_tpu.transformer.tensor_parallel.random import (
+                attention_dropout_seed,
+            )
+
+            return ring_attention(
+                q, k, v, causal=causal, bias_strip=bias,
+                dropout_rate=rate,
+                dropout_seed=attention_dropout_seed(dropout_key))
         return ring_attention(q, k, v, causal=causal, bias_strip=bias)
     if rate > 0.0:
         from apex_tpu.transformer.tensor_parallel.random import (
-            model_parallel_key,
+            attention_dropout_seed,
         )
 
-        seed = jax.random.bits(
-            model_parallel_key(dropout_key), dtype=jnp.uint32
-        ).astype(jnp.int32)
+        seed = attention_dropout_seed(dropout_key)
         return flash_attention(q, k, v, causal=causal,
                                block_q=cfg.attn_block_q,
                                block_k=cfg.attn_block_k,

@@ -110,3 +110,81 @@ def test_ulysses_rejects_bad_head_count():
             in_specs=P(None, None, "sp", None),
             out_specs=P(None, None, "sp", None),
         )(q)
+
+
+# ---------------------------------------------------------------------------
+# attention dropout under ring-SP (round 5): the kernels' global-position
+# counter hash makes sharding invisible to the dropout stream, so the ring
+# result must EQUAL the dense flash kernel with the same seed.
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_dropout_matches_dense_kernel(causal):
+    from apex_tpu.ops.attention import flash_attention
+
+    q, k, v = _qkv(jax.random.PRNGKey(3))
+    mesh = _mesh()
+    rate, seed = 0.3, 1234
+    sharded = jax.shard_map(
+        lambda q, k, v: ring_attention(q, k, v, causal=causal,
+                                       dropout_rate=rate,
+                                       dropout_seed=seed),
+        mesh=mesh,
+        in_specs=P(None, None, "sp", None),
+        out_specs=P(None, None, "sp", None),
+    )(q, k, v)
+    dense = flash_attention(q, k, v, causal=causal, dropout_rate=rate,
+                            dropout_seed=seed, use_pallas=True,
+                            interpret=True)
+    np.testing.assert_allclose(np.asarray(sharded), np.asarray(dense),
+                               atol=2e-5)
+
+
+def test_ring_dropout_grads_match_dense_kernel():
+    from apex_tpu.ops.attention import flash_attention
+
+    q, k, v = _qkv(jax.random.PRNGKey(4))
+    mesh = _mesh()
+    rate, seed = 0.2, 77
+
+    def sharded_loss(q, k, v):
+        o = jax.shard_map(
+            lambda q, k, v: ring_attention(q, k, v, causal=True,
+                                           dropout_rate=rate,
+                                           dropout_seed=seed),
+            mesh=mesh,
+            in_specs=P(None, None, "sp", None),
+            out_specs=P(None, None, "sp", None),
+        )(q, k, v)
+        return jnp.sum(jnp.sin(o))
+
+    def dense_loss(q, k, v):
+        return jnp.sum(jnp.sin(flash_attention(
+            q, k, v, causal=True, dropout_rate=rate, dropout_seed=seed,
+            use_pallas=True, interpret=True)))
+
+    g1 = jax.jit(jax.grad(sharded_loss, argnums=(0, 1, 2)))(q, k, v)
+    g2 = jax.jit(jax.grad(dense_loss, argnums=(0, 1, 2)))(q, k, v)
+    for a, e, name in zip(g1, g2, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(e), atol=2e-4, err_msg=name)
+
+
+def test_ring_dropout_seed_sensitive_and_requires_seed():
+    q, k, v = _qkv(jax.random.PRNGKey(5))
+    mesh = _mesh()
+
+    def run(seed):
+        return np.asarray(jax.shard_map(
+            lambda q, k, v: ring_attention(q, k, v, causal=True,
+                                           dropout_rate=0.3,
+                                           dropout_seed=seed),
+            mesh=mesh,
+            in_specs=P(None, None, "sp", None),
+            out_specs=P(None, None, "sp", None),
+        )(q, k, v))
+
+    a, b_, c = run(1), run(1), run(2)
+    np.testing.assert_array_equal(a, b_)  # same seed replays the mask
+    assert np.abs(a - c).max() > 1e-3  # different seed, different mask
+    with pytest.raises(ValueError, match="dropout_seed"):
+        ring_attention(q, k, v, dropout_rate=0.3)

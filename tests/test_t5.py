@@ -483,3 +483,31 @@ def test_t5_ring_sp_hidden_dropout_decorrelated():
     out = _hidden_dropout_shards(cfg, build_mesh(tp=1, sp=2), "sp")
     assert not np.array_equal(out[:, :16], out[:, 16:]), \
         "sp seq shards must drop independent positions under ring-sp"
+
+
+def test_t5_ring_sp_attention_dropout_trains():
+    """Attention dropout under ring-SP (round 5): encoder, causal decoder,
+    and the rectangular cross-attention rings all drop with the
+    global-position-keyed masks — runs, replays, key-sensitive."""
+    cfg = dataclasses.replace(CFG, attention_dropout=0.2,
+                              hidden_dropout=0.1)
+    params = init_t5_params(jax.random.PRNGKey(0), cfg)
+    enc_tok, dec_tok, tgt = _batch(jax.random.PRNGKey(1))
+    mesh = build_mesh(tp=1, sp=2)
+
+    def loss(key):
+        def body(p, e, d, t):
+            return replicate_loss(
+                t5_loss(p, e, d, t, cfg, dropout_key=key), mesh,
+                masked_axis=None)
+
+        return float(jax.jit(shard_map(
+            body, mesh=mesh,
+            in_specs=(t5_param_specs(cfg), P("dp", "sp"), P("dp", "sp"),
+                      P("dp", "sp")),
+            out_specs=P()))(params, enc_tok, dec_tok, tgt))
+
+    k1, k2 = jax.random.split(jax.random.PRNGKey(8))
+    a, b, c, d = loss(k1), loss(k1), loss(k2), loss(None)
+    assert np.isfinite([a, b, c, d]).all()
+    assert a == b and a != c and a != d
