@@ -50,7 +50,7 @@ from apex_tpu.ops._pallas_util import compiled_backend as _compiled_backend
 
 def attention_reference(q, k, v, mask=None, scale: Optional[float] = None,
                         causal: bool = False, dropout_rate: float = 0.0,
-                        dropout_key=None, bias=None):
+                        dropout_key=None, bias=None, dropout_keep=None):
     """Plain softmax(QKᵀ·scale + bias)V in fp32 accumulation.
 
     ``mask``: broadcastable boolean over (..., sq, sk), True = masked OUT
@@ -58,7 +58,10 @@ def attention_reference(q, k, v, mask=None, scale: Optional[float] = None,
     padding → masked). ``bias``: additive logit bias broadcastable over
     (..., sq, sk) — e.g. T5 relative position bias (heads, sq, sk).
     Optional probability dropout on the softmax (the reference kernels'
-    fused dropout, here materialized). Returns q.dtype.
+    fused dropout, here materialized); ``dropout_keep`` supplies an
+    explicit keep mask instead of the ``dropout_key`` draw (how
+    ``flash_attention``'s fallback stays on the kernels' counter-hash
+    stream). Returns q.dtype.
     """
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
@@ -77,10 +80,12 @@ def attention_reference(q, k, v, mask=None, scale: Optional[float] = None,
         s = jnp.where(mask, NEG_INF, s)
     p = jax.nn.softmax(s, axis=-1)
     if dropout_rate > 0.0:
-        if dropout_key is None:
-            raise ValueError("dropout_rate > 0 needs dropout_key")
-        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_rate, p.shape)
-        p = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
+        if dropout_keep is None:
+            if dropout_key is None:
+                raise ValueError("dropout_rate > 0 needs dropout_key")
+            dropout_keep = jax.random.bernoulli(dropout_key,
+                                                1.0 - dropout_rate, p.shape)
+        p = jnp.where(dropout_keep, p / (1.0 - dropout_rate), 0.0)
     o = jnp.einsum("...qk,...kd->...qd", p, v32)
     return o.astype(q.dtype)
 
@@ -757,8 +762,8 @@ def flash_attention(
     regenerated identically in forward and backward from ``dropout_seed``
     (an int32 scalar/array; required when the rate is nonzero), so training
     configs with attention dropout stay on the Pallas path. The non-pallas
-    fallback draws its own jax.random mask (same distribution, different
-    stream).
+    fallback materializes the SAME counter-hash mask, so the result does
+    not depend on which dispatch path ran.
     """
     b, h, sq, d = q.shape
     sk = k.shape[2]
@@ -787,13 +792,18 @@ def flash_attention(
                 "interpret= only applies to the Pallas path; this call "
                 "resolved to the reference (pass use_pallas=True to force "
                 "the kernel, or drop interpret=)")
-        key = None
+        keep = None
         if dropout_rate > 0.0:
-            key = jax.random.PRNGKey(jnp.asarray(dropout_seed).reshape(())
-                                     .astype(jnp.uint32))
+            # the kernels' counter-hash stream, NOT a jax.random draw: the
+            # fallback must drop the same entries as the compiled kernel
+            # (and the ring's chunks) for the same seed, or results change
+            # with the dispatch path
+            keep = attention_dropout_mask(
+                jnp.asarray(dropout_seed).reshape(()), float(dropout_rate),
+                b * h, sq, sk).reshape(b, h, sq, sk)
         return attention_reference(q, k, v, mask=mask, scale=scale,
                                    causal=causal, dropout_rate=dropout_rate,
-                                   dropout_key=key, bias=bias)
+                                   dropout_keep=keep, bias=bias)
     bq = _pick_block(sq, block_q)
     bk = _pick_block(sk, block_k)
     if interpret is None:

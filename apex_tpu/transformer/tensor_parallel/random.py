@@ -66,9 +66,8 @@ def attention_dropout_seed(key, axis_name: str = TP_AXIS):
     heads, so ranks must drop independent entries) reduced to the scalar
     the kernels take. The ONE policy shared by the dense and ring-SP
     attention paths in the GPT/T5 fixtures — the ring's global-position
-    hash decorrelates sp shards itself, so sp deliberately does not enter."""
-    import jax.numpy as jnp
-
+    hash decorrelates sp shards itself, so sp deliberately does not enter
+    (callers must pass an sp-invariant key)."""
     return jax.random.bits(model_parallel_key(key, axis_name),
                            dtype=jnp.uint32).astype(jnp.int32)
 

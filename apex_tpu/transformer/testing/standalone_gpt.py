@@ -409,8 +409,20 @@ def _hidden_key(key, cfg):
     """Hidden-dropout key policy: replicated activations share the unfolded
     key across the TP group; under megatron_sp each tp rank holds DIFFERENT
     tokens, so the rank must be folded in (tensor_parallel/random.py
-    model-parallel stream) or shards would reuse one mask."""
-    if key is None or not cfg.megatron_sp:
+    model-parallel stream), and under ring-sp the SP rank likewise — or
+    shards would reuse one mask. The folds live HERE, at the hidden-dropout
+    sites only: the per-layer base keys stay sp-invariant so the attention
+    dropout stream (global-position-keyed in the ring) is identical across
+    sharding layouts."""
+    if key is None:
+        return key
+    try:
+        sp = lax.axis_size(SP_AXIS)
+    except NameError:
+        sp = 1
+    if sp > 1:
+        key = jax.random.fold_in(key, lax.axis_index(SP_AXIS))
+    if not cfg.megatron_sp:
         return key
     from apex_tpu.transformer.tensor_parallel.random import (
         model_parallel_key,
@@ -485,12 +497,10 @@ def _layer_stack(layers, x, cfg, causal: bool = True, mask=None,
             if PP_AXIS not in jax.typeof(x).vma:
                 x = lax.pcast(x, PP_AXIS, to="varying")
         if sp > 1:
-            # each sp rank holds DIFFERENT tokens of the sequence: fold the
-            # shard rank in so shards drop independent positions (same
-            # stream model as the pp fold above; without it every shard
-            # would reuse one mask, correlating dropped positions across
-            # the sequence with period s/sp)
-            base = jax.random.fold_in(base, lax.axis_index(SP_AXIS))
+            # the SP-rank fold itself lives in _hidden_key (hidden-dropout
+            # sites only — folding it here would leak into the attention
+            # seed and break the attention stream's layout invariance);
+            # the hidden masks still make the carry sp-varying, so cast it
             if SP_AXIS not in jax.typeof(x).vma:
                 x = lax.pcast(x, SP_AXIS, to="varying")
         keys = jax.vmap(lambda i: jax.random.fold_in(base, i))(
@@ -558,15 +568,13 @@ def _embed_with_dropout(embed, tokens, cfg: GPTConfig, dropout_key):
         except NameError:
             sp = 1
         # ref GPT embedding dropout: same hidden_dropout rate on the
-        # embedding output; distinct stream from the per-layer keys. Each
-        # sp rank holds different tokens, so the shard rank is folded in
-        # (same decorrelation as the per-layer keys in _layer_stack).
-        key = jax.random.fold_in(dropout_key, 0x0E0B)
-        if sp > 1:
-            key = jax.random.fold_in(key, lax.axis_index(SP_AXIS))
-            if SP_AXIS not in jax.typeof(x).vma:
-                x = lax.pcast(x, SP_AXIS, to="varying")
-        x = _hidden_dropout(x, cfg.hidden_dropout, _hidden_key(key, cfg))
+        # embedding output; distinct stream from the per-layer keys. The
+        # SP/TP shard decorrelation is _hidden_key's fold.
+        if sp > 1 and SP_AXIS not in jax.typeof(x).vma:
+            x = lax.pcast(x, SP_AXIS, to="varying")
+        x = _hidden_dropout(x, cfg.hidden_dropout,
+                            _hidden_key(jax.random.fold_in(dropout_key,
+                                                           0x0E0B), cfg))
     return x
 
 

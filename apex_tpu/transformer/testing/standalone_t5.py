@@ -457,14 +457,12 @@ def _maybe_hidden_dropout(x, cfg: T5Config, key, salt: int):
         _hidden_key,
     )
 
-    # _hidden_key folds the TP rank under megatron_sp, and the SP rank is
-    # folded here under ring-sp — each rank holds a DIFFERENT seq shard,
-    # so an unfolded key would repeat one mask across the sequence with
-    # period s/tp resp. s/sp (the standalone_gpt policy)
-    key = jax.random.fold_in(key, salt)
-    if _sp_size() > 1:
-        key = jax.random.fold_in(key, lax.axis_index(SP_AXIS))
-    return _hidden_dropout(x, cfg.hidden_dropout, _hidden_key(key, cfg))
+    # _hidden_key is the ONE shard-decorrelation site: it folds the SP
+    # rank under ring-sp and the TP rank under megatron_sp — each rank
+    # holds a DIFFERENT seq shard, so an unfolded key would repeat one
+    # mask across the sequence with period s/sp resp. s/tp
+    return _hidden_dropout(x, cfg.hidden_dropout,
+                           _hidden_key(jax.random.fold_in(key, salt), cfg))
 
 
 def enc_layer_fn(p, x, cfg: T5Config, dropout_key=None, rel_bias=None):

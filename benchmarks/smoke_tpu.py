@@ -145,6 +145,52 @@ def _results():
     record("flash_attention_inkernel_dropout", dropout_determinism, tol=0.0,
            pallas_row=True)
 
+    def dropout_global_offsets():
+        # the ring-SP dropout contract, single-chip: a dense kernel call
+        # must equal the same computation CHUNKED with global position
+        # offsets (the [seed, q_off, k_off] SMEM operand) — non-causal so
+        # every chunk is the plain kernel, merged by the ring's lse rule
+        from apex_tpu.ops.attention import _fa_fwd
+
+        seed = jnp.int32(4242)
+        rate = 0.2
+        # dense side pinned to the KERNEL (interpret off-chip): the
+        # reference fallback draws a different stream, and the row's
+        # claim is kernel-vs-chunked-kernel mask identity
+        dense = jax.jit(lambda q, kk, v: flash_attention(
+            q, kk, v, causal=False, use_pallas=True,
+            interpret=None if on_tpu else True, dropout_rate=rate,
+            dropout_seed=seed))(q, kk, v)
+
+        def chunked(q, kk, v):
+            half = s // 2
+            q3 = q.reshape(b * h, s, d)
+            outs = []
+            for k_off in (0, half):
+                k3 = kk[:, :, k_off:k_off + half].reshape(b * h, half, d)
+                v3 = v[:, :, k_off:k_off + half].reshape(b * h, half, d)
+                sv = jnp.stack([seed, jnp.int32(0), jnp.int32(k_off)])
+                o3, lse3 = _fa_fwd(q3, k3, v3, 1.0 / d ** 0.5, False,
+                                   128, 128, interpret=not on_tpu,
+                                   dropout_rate=rate, seed=sv)
+                outs.append((o3, lse3[..., 0]))
+            (o1, l1), (o2, l2) = outs
+            lse = jnp.logaddexp(l1, l2)
+            o = (o1.astype(jnp.float32) * jnp.exp(l1 - lse)[..., None]
+                 + o2.astype(jnp.float32) * jnp.exp(l2 - lse)[..., None])
+            return o.reshape(b, h, s, d)
+
+        got = jax.jit(chunked)(q, kk, v)
+        jax.block_until_ready(got)
+        err = float(jnp.max(jnp.abs(got - dense.astype(jnp.float32)))
+                    / (jnp.max(jnp.abs(dense.astype(jnp.float32)))
+                       + 1e-12))
+        # identical masks by construction; only bf16 merge rounding
+        return err
+
+    record("flash_attention_dropout_global_offsets", dropout_global_offsets,
+           tol=2e-2, pallas_row=True)
+
     def bias_fwd_bwd():
         # T5 relative-position-bias contract: batch-shared (h, sq, sk)
         # additive logit bias, grads for q/k/v AND the bias (the

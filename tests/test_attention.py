@@ -139,3 +139,46 @@ def test_flash_bias_shape_validated():
     q, k, v = _qkv(jax.random.PRNGKey(10), 2, 2, 16, 16, 8)
     with pytest.raises(ValueError, match="batch-shared"):
         flash_attention(q, k, v, bias=jnp.zeros((2, 2, 16, 16)))
+
+
+def test_flash_bwd_kernels_respect_global_offsets():
+    """The [seed, q_off, k_off] operand in the BACKWARD kernels (reviewer
+    find: only the forward had off-TPU offset coverage): chunked _fa_bwd
+    calls against the global lse with per-chunk k offsets must reproduce
+    the dense kernel's gradients — the ring-SP backward contract, in
+    interpret mode."""
+    from apex_tpu.ops.attention import _fa_bwd, _fa_fwd, flash_attention
+
+    b, h, s, d = 1, 2, 256, 16
+    rate, seed, scale = 0.3, 99, 1.0 / d ** 0.5
+    ks = jax.random.split(jax.random.PRNGKey(11), 3)
+    q, k, v = (jax.random.normal(kk, (b, h, s, d)) for kk in ks)
+
+    def loss(q, k, v):
+        return jnp.sum(flash_attention(
+            q, k, v, causal=False, dropout_rate=rate,
+            dropout_seed=jnp.int32(seed), use_pallas=True,
+            interpret=True) ** 2)
+
+    g_dense = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+    bh, half = b * h, s // 2
+    q3, k3, v3 = (x.reshape(bh, s, d) for x in (q, k, v))
+    sv = lambda k_off: jnp.asarray([seed, 0, k_off], jnp.int32)
+    o3, lse3 = _fa_fwd(q3, k3, v3, scale, False, 128, 128, interpret=True,
+                       dropout_rate=rate, seed=sv(0))
+    do3 = (2.0 * o3.astype(jnp.float32)).astype(o3.dtype)
+    dq_sum, dks, dvs = 0.0, [], []
+    for k_off in (0, half):
+        dq_c, dk_c, dv_c, _ = _fa_bwd(
+            q3, k3[:, k_off:k_off + half], v3[:, k_off:k_off + half],
+            o3, lse3, do3, scale, False, 128, 128, interpret=True,
+            dropout_rate=rate, seed=sv(k_off))
+        dq_sum = dq_sum + dq_c
+        dks.append(dk_c)
+        dvs.append(dv_c)
+    got = (dq_sum, jnp.concatenate(dks, 1), jnp.concatenate(dvs, 1))
+    for a, e, name in zip(got, g_dense, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a).reshape(b, h, s, d), np.asarray(e), atol=2e-4,
+            err_msg=name)

@@ -219,6 +219,19 @@ def test_sp_full_dropout_config_trains():
         "attention dropout must actually drop under sp"
 
 
+def test_sp_attention_dropout_layout_invariant():
+    """The round-5 headline invariant at the MODEL level: with hidden
+    dropout off, the attention dropout stream is keyed by global
+    positions and an sp-invariant seed, so the sp=2 (ring) loss EQUALS
+    the sp=1 (dense kernel) loss for the same key — sharding is
+    invisible to the mask (reviewer find: an sp fold leaking into the
+    attention seed silently broke this)."""
+    cfg = dataclasses.replace(CFG, hidden_dropout=0.0, dtype=jnp.float32)
+    key = jax.random.PRNGKey(33)
+    np.testing.assert_allclose(_sp_loss(cfg, key, sp=2),
+                               _sp_loss(cfg, key, sp=1), rtol=1e-5)
+
+
 # ---------------------------------------------------------------------------
 # kernel-level dropout (pallas interpret mode)
 
