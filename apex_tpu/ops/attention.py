@@ -80,6 +80,11 @@ def attention_reference(q, k, v, mask=None, scale: Optional[float] = None,
         s = jnp.where(mask, NEG_INF, s)
     p = jax.nn.softmax(s, axis=-1)
     if dropout_rate > 0.0:
+        if dropout_keep is not None and dropout_key is not None:
+            raise ValueError(
+                "pass either dropout_key (draw a mask) or dropout_keep "
+                "(explicit mask), not both — the key would be silently "
+                "ignored")
         if dropout_keep is None:
             if dropout_key is None:
                 raise ValueError("dropout_rate > 0 needs dropout_key")

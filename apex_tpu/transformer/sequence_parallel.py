@@ -552,6 +552,8 @@ def ulysses_attention(
     causal: bool = False,
     scale: Optional[float] = None,
     use_pallas: Optional[bool] = None,
+    dropout_rate: float = 0.0,
+    dropout_seed=None,
 ):
     """All-to-all ("Ulysses") sequence parallelism.
 
@@ -560,14 +562,25 @@ def ulysses_attention(
     head_dim) so each device runs *dense* local attention (the flash kernel)
     over the full sequence for its head slice, then re-sharded back.
     Requires ``heads % sp_size == 0``.
+
+    ``dropout_rate`` > 0 (requires ``dropout_seed``) drops on the local
+    head slice with the sp RANK folded into the seed: each rank's heads
+    draw an independent stream (the Megatron-TP decorrelation model).
+    Unlike :func:`ring_attention` the masks are NOT layout-invariant —
+    the head->device assignment enters the stream; use the ring when
+    bitwise sp-invariance matters.
     """
     n = lax.axis_size(axis_name)
     b, h, s_loc, d = q.shape
     if h % n != 0:
         raise ValueError(f"ulysses needs heads ({h}) % sp ({n}) == 0")
+    if dropout_rate > 0.0 and dropout_seed is None:
+        raise ValueError("dropout_rate > 0 needs dropout_seed")
     if n == 1:
         return flash_attention(q, k, v, causal=causal, scale=scale,
-                               use_pallas=use_pallas)
+                               use_pallas=use_pallas,
+                               dropout_rate=dropout_rate,
+                               dropout_seed=dropout_seed)
 
     def to_heads(x):
         # [b, h, s_loc, d] -> [b, h/n, n*s_loc, d]: split heads across the
@@ -579,6 +592,13 @@ def ulysses_attention(
         return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
                               tiled=True)
 
+    seed = dropout_seed
+    if dropout_rate > 0.0:
+        # decorrelate the per-rank head slices (local bh indices repeat
+        # on every rank; an unfolded seed would reuse one mask per slot)
+        seed = (jnp.asarray(dropout_seed, jnp.int32).reshape(())
+                + jnp.int32(0x9E37) * lax.axis_index(axis_name))
     o = flash_attention(to_heads(q), to_heads(k), to_heads(v),
-                        causal=causal, scale=scale, use_pallas=use_pallas)
+                        causal=causal, scale=scale, use_pallas=use_pallas,
+                        dropout_rate=dropout_rate, dropout_seed=seed)
     return to_seq(o)

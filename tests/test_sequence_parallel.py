@@ -188,3 +188,30 @@ def test_ring_dropout_seed_sensitive_and_requires_seed():
     assert np.abs(a - c).max() > 1e-3  # different seed, different mask
     with pytest.raises(ValueError, match="dropout_seed"):
         ring_attention(q, k, v, dropout_rate=0.3)
+
+
+def test_ulysses_dropout_runs_deterministic_rank_decorrelated():
+    """Ulysses dropout: per-rank-folded seeds — replays for a seed,
+    changes across seeds, and the distinct head slices actually drop
+    (output differs from no-dropout)."""
+    q, k, v = _qkv(jax.random.PRNGKey(6))
+    mesh = _mesh()
+
+    def run(seed, rate=0.3):
+        return np.asarray(jax.shard_map(
+            lambda q, k, v: ulysses_attention(q, k, v, causal=True,
+                                              dropout_rate=rate,
+                                              dropout_seed=seed),
+            mesh=mesh,
+            in_specs=P(None, None, "sp", None),
+            out_specs=P(None, None, "sp", None),
+        )(q, k, v))
+
+    a, b_, c = run(5), run(5), run(6)
+    np.testing.assert_array_equal(a, b_)
+    assert np.abs(a - c).max() > 1e-3
+    nodrop = run(5, rate=0.0)
+    assert np.abs(a - nodrop).max() > 1e-3
+    # every head must see live dropout (rank-folded seeds cover all slices)
+    per_head = np.abs(a - nodrop).reshape(B, H, -1).max(-1)
+    assert (per_head > 1e-4).all(), per_head
