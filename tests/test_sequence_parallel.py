@@ -215,3 +215,26 @@ def test_ulysses_dropout_runs_deterministic_rank_decorrelated():
     # every head must see live dropout (rank-folded seeds cover all slices)
     per_head = np.abs(a - nodrop).reshape(B, H, -1).max(-1)
     assert (per_head > 1e-4).all(), per_head
+
+
+def test_ulysses_dropout_ranks_draw_independent_masks():
+    """The rank fold itself (reviewer find: the basic test passes without
+    it): with IDENTICAL data in every head, only the mask distinguishes
+    head outputs. H=8 over sp=8 puts each head at local slot 0 of a
+    different rank — without the fold all 8 would share one mask and be
+    bitwise equal."""
+    mesh = _mesh()
+    base = jax.random.normal(jax.random.PRNGKey(7), (B, 1, S, D))
+    q = jnp.broadcast_to(base, (B, H, S, D))
+
+    out = np.asarray(jax.shard_map(
+        lambda q: ulysses_attention(q, q, q, causal=True, dropout_rate=0.3,
+                                    dropout_seed=9),
+        mesh=mesh,
+        in_specs=P(None, None, "sp", None),
+        out_specs=P(None, None, "sp", None),
+    )(q))
+    for g1 in range(H):
+        for g2 in range(g1 + 1, H):
+            assert not np.array_equal(out[:, g1], out[:, g2]), \
+                f"heads {g1} and {g2} shared a dropout mask"
