@@ -101,21 +101,47 @@ def test_dropout_tp2_runs_and_differs_from_tp1_masks():
         _loss(nodrop, tp=1), _loss(nodrop, tp=2), rtol=1e-3)
 
 
+_SP_LOSS_CACHE = {}
+
+
 def _sp_loss(cfg, key, sp=2):
-    mesh = build_mesh(tp=1, pp=1, sp=sp, devices=jax.devices()[:sp])
+    """Loss of the sp-sharded GPT; the jitted program is cached per
+    (cfg, sp, dropout-on) so repeated calls with different key VALUES
+    share one compile."""
+    ck = (cfg, sp, key is not None)
+    if ck not in _SP_LOSS_CACHE:
+        mesh = build_mesh(tp=1, pp=1, sp=sp, devices=jax.devices()[:sp])
+        specs = gpt_param_specs(cfg)
+
+        if key is not None:
+            def f(p, tok, tgt, key):
+                def body(p, tok, tgt, key):
+                    return replicate_loss(
+                        gpt_loss(p, tok, tgt, cfg, dropout_key=key),
+                        mesh, masked_axis=None)
+
+                return jax.shard_map(
+                    body, mesh=mesh,
+                    in_specs=(specs, P(None, "sp"), P(None, "sp"), P()),
+                    out_specs=P())(p, tok, tgt, key)
+        else:
+            def f(p, tok, tgt):
+                def body(p, tok, tgt):
+                    return replicate_loss(
+                        gpt_loss(p, tok, tgt, cfg), mesh,
+                        masked_axis=None)
+
+                return jax.shard_map(
+                    body, mesh=mesh,
+                    in_specs=(specs, P(None, "sp"), P(None, "sp")),
+                    out_specs=P())(p, tok, tgt)
+        _SP_LOSS_CACHE[ck] = jax.jit(f)
     params = init_gpt_params(jax.random.PRNGKey(0), cfg)
     tok = jax.random.randint(jax.random.PRNGKey(1), (2, 64), 0, 256)
-    specs = gpt_param_specs(cfg)
-
-    def body(p, tok, tgt):
-        return replicate_loss(
-            gpt_loss(p, tok, tgt, cfg, dropout_key=key),
-            mesh, masked_axis=None)
-
-    return float(jax.jit(jax.shard_map(
-        body, mesh=mesh,
-        in_specs=(specs, P(None, "sp"), P(None, "sp")),
-        out_specs=P()))(params, tok, jnp.roll(tok, -1, 1)))
+    args = (params, tok, jnp.roll(tok, -1, 1))
+    if key is not None:
+        args += (key,)
+    return float(_SP_LOSS_CACHE[ck](*args))
 
 
 def test_sp_hidden_dropout_trains_and_is_key_sensitive():

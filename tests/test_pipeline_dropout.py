@@ -139,8 +139,10 @@ def test_no_pipelining_dropout_key_per_microbatch():
     _assert_tree_close(grads, gref)
 
 
-@pytest.mark.parametrize("M", [2, 4])
+@pytest.mark.parametrize("M", [2, pytest.param(4, marks=pytest.mark.slow)])
 def test_1f1b_dropout_matches_sequential(M):
+    # (key-sensitivity is covered by the GPT integration test below — a
+    # second uncached pipelined compile here would double the test cost)
     pp = 2
     mesh = build_mesh(tp=1, pp=pp, sp=1, devices=jax.devices()[:pp])
     spec = _dropout_spec()
@@ -154,14 +156,13 @@ def test_1f1b_dropout_matches_sequential(M):
     np.testing.assert_allclose(float(loss), float(want), rtol=1e-5,
                                atol=1e-6)
     _assert_tree_close(grads, gref)
-    # key-sensitivity: a different key changes the loss
-    loss2, _ = forward_backward_pipelining_without_interleaving(
-        spec, params, batch, num_microbatches=M, mesh=mesh,
-        dropout_key=jax.random.PRNGKey(8))
-    assert float(loss2) != float(loss)
 
 
+@pytest.mark.slow
 def test_interleaved_dropout_matches_sequential():
+    # slow tier: the interleaved SCHEDULE parity (no dropout) runs in the
+    # default tier (test_pipeline_parallel); this adds the chunk-fold
+    # routing proof on top
     pp, vp, M = 2, 2, 4
     mesh = build_mesh(tp=1, pp=pp, sp=1, devices=jax.devices()[:pp])
     spec = _dropout_spec()
@@ -195,10 +196,12 @@ def test_dropout_key_spec_pairing_validated_both_ways():
             _dropout_spec(), params, batch, num_microbatches=2, mesh=mesh)
 
 
+@pytest.mark.slow
 def test_enc_dec_dropout_matches_sequential():
     """Enc-dec routing parity: both rings deliver the same per-microbatch
     key (side/stage folds are the model's job — the toy folds a side salt
-    itself so encoder and decoder masks differ)."""
+    itself so encoder and decoder masks differ). Slow tier: the default
+    tier's enc-dec dropout coverage is the T5 integration test below."""
     from apex_tpu.transformer.pipeline_parallel.schedules.fwd_bwd_enc_dec import (
         EncDecPipelineSpec,
         forward_backward_pipelining_enc_dec,

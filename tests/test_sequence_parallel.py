@@ -197,20 +197,34 @@ def test_ulysses_dropout_runs_deterministic_rank_decorrelated():
     q, k, v = _qkv(jax.random.PRNGKey(6))
     mesh = _mesh()
 
-    def run(seed, rate=0.3):
-        return np.asarray(jax.shard_map(
+    # ONE jitted callable with the seed traced: three seed values share a
+    # single compile (eager shard_map would recompile per call)
+    @jax.jit
+    def run_drop(q, k, v, seed):
+        return jax.shard_map(
             lambda q, k, v: ulysses_attention(q, k, v, causal=True,
-                                              dropout_rate=rate,
+                                              dropout_rate=0.3,
                                               dropout_seed=seed),
             mesh=mesh,
-            in_specs=P(None, None, "sp", None),
+            in_specs=(P(None, None, "sp", None),) * 3,
+            out_specs=P(None, None, "sp", None),
+        )(q, k, v)
+
+    def run(seed):
+        return np.asarray(run_drop(q, k, v, jnp.int32(seed)))
+
+    def run_nodrop():
+        return np.asarray(jax.shard_map(
+            lambda q, k, v: ulysses_attention(q, k, v, causal=True),
+            mesh=mesh,
+            in_specs=(P(None, None, "sp", None),) * 3,
             out_specs=P(None, None, "sp", None),
         )(q, k, v))
 
     a, b_, c = run(5), run(5), run(6)
     np.testing.assert_array_equal(a, b_)
     assert np.abs(a - c).max() > 1e-3
-    nodrop = run(5, rate=0.0)
+    nodrop = run_nodrop()
     assert np.abs(a - nodrop).max() > 1e-3
     # every head must see live dropout (rank-folded seeds cover all slices)
     per_head = np.abs(a - nodrop).reshape(B, H, -1).max(-1)

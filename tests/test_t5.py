@@ -46,20 +46,26 @@ def _batch(rng, b=8):
     return enc_tok, dec_tok, jnp.roll(dec_tok, -1, 1)
 
 
+_LG_CACHE = {}
+
+
 def _loss_and_grads(mesh, cfg, params, batch):
-    enc_tok, dec_tok, tgt = batch
+    """value_and_grad of the sharded T5 loss; the jitted program is
+    cached per (cfg, mesh shape) so training loops compile once."""
+    ck = (cfg, tuple(mesh.shape.items()))
+    if ck not in _LG_CACHE:
+        def loss_fn(p, e, d, t):
+            def body(p, e, d, t):
+                return replicate_loss(t5_loss(p, e, d, t, cfg), mesh,
+                                      masked_axis=None)
 
-    def loss_fn(p):
-        def body(p, e, d, t):
-            return replicate_loss(t5_loss(p, e, d, t, cfg), mesh,
-                                  masked_axis=None)
+            return shard_map(
+                body, mesh=mesh,
+                in_specs=(t5_param_specs(cfg), P("dp"), P("dp"), P("dp")),
+                out_specs=P())(p, e, d, t)
 
-        return shard_map(
-            body, mesh=mesh,
-            in_specs=(t5_param_specs(cfg), P("dp"), P("dp"), P("dp")),
-            out_specs=P())(p, enc_tok, dec_tok, tgt)
-
-    return jax.jit(jax.value_and_grad(loss_fn))(params)
+        _LG_CACHE[ck] = jax.jit(jax.value_and_grad(loss_fn))
+    return _LG_CACHE[ck](params, *batch)
 
 
 def test_t5_tp2_matches_tp1():
