@@ -1,0 +1,144 @@
+"""Long-context GPT pretraining over the ring-SP axis (north-star
+extension).
+
+No reference counterpart (NVIDIA Apex has no context parallelism); this
+is the usage pattern for the TPU-native long-context stack: the sequence
+is sharded over the ``sp`` mesh axis, attention runs as the K/V ring
+(``transformer.sequence_parallel.ring_attention`` — exact global
+attention, O(s_local²) peak score memory per device), and the full GPT-2
+training config runs with BOTH dropouts on: hidden masks fold the
+sp/tp ranks so every shard drops independent positions, attention masks
+are keyed by GLOBAL positions so the ring drops exactly what a dense
+kernel would with the same seed (sharding is invisible to the stream).
+
+Run (8 virtual devices, synthetic data, global seq = 512 over sp=8;
+raise --seq on real chips):
+
+    JAX_PLATFORMS=cpu python examples/long_context/main.py --steps 10
+
+On a real slice drop the platform pin; at sp=8 a 32k-token context fits
+where dense attention cannot (see PERF.md's ring memory study and
+benchmarks/long_seq_tpu.py for the measured rows). ``--tp`` composes
+Megatron-TP with the ring (megatron_sp shards the LN/dropout regions by
+sequence on top).
+
+Reference parity note: ``apex.transformer`` stops at tensor/pipeline
+parallelism (SURVEY.md §2.3); sequence parallelism of this form is the
+capability the reference lacks.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    from apex_tpu.utils.platform import pin_cpu_platform
+
+    pin_cpu_platform(virtual_devices=8)
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.optimizers import FusedAdam
+from apex_tpu.parallel.mesh import build_mesh
+from apex_tpu.transformer.pipeline_parallel.schedules.common import (
+    replicate_loss,
+)
+from apex_tpu.transformer.testing import (
+    GPTConfig,
+    gpt_loss,
+    gpt_param_specs,
+    init_gpt_params,
+)
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=10)
+    p.add_argument("--sp", type=int, default=8,
+                   help="ring size: each device holds seq/sp tokens and "
+                        "K/V chunks rotate sp times per attention")
+    p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--batch", type=int, default=2,
+                   help="PER-dp-RANK batch (global batch = batch * dp; "
+                        "the moe_gpt example's --batch is global)")
+    p.add_argument("--seq", type=int, default=512,
+                   help="GLOBAL sequence length (sharded over sp); the "
+                        "CPU-smoke default is small — raise it on real "
+                        "chips (32k fits at sp=8, see PERF.md)")
+    p.add_argument("--hidden", type=int, default=64)
+    p.add_argument("--layers", type=int, default=2)
+    p.add_argument("--attention-dropout", type=float, default=0.1)
+    p.add_argument("--hidden-dropout", type=float, default=0.1)
+    p.add_argument("--lr", type=float, default=1e-3)
+    return p.parse_args(argv)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    if args.seq % args.sp:
+        raise SystemExit(
+            f"--seq ({args.seq}) must be divisible by --sp ({args.sp})")
+    mesh = build_mesh(tp=args.tp, pp=1, sp=args.sp)
+    dp = mesh.shape["dp"]
+    cfg = GPTConfig(vocab_size=1024, max_seq=args.seq, hidden=args.hidden,
+                    num_layers=args.layers,
+                    num_heads=max(args.hidden // 16, 1),
+                    dtype=jnp.float32, megatron_sp=args.tp > 1,
+                    attention_dropout=args.attention_dropout,
+                    hidden_dropout=args.hidden_dropout)
+    cfg.validate(tp=args.tp)
+
+    params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+    specs = gpt_param_specs(cfg)
+    opt = FusedAdam(lr=args.lr)
+    opt_state = opt.init(params)
+
+    def loss_fn(p, tok, tgt, dkey):
+        def body(p, tok, tgt):
+            return replicate_loss(gpt_loss(p, tok, tgt, cfg,
+                                           dropout_key=dkey),
+                                  mesh, masked_axis=None)
+
+        # data sharded (batch over dp) x (sequence over sp): each device
+        # holds its shard's tokens; the ring rotates K/V, never the
+        # full sequence
+        return shard_map(body, mesh=mesh,
+                         in_specs=(specs, P("dp", "sp"), P("dp", "sp")),
+                         out_specs=P())(p, tok, tgt)
+
+    @jax.jit
+    def train_step(params, opt_state, tok, tgt, dkey):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tok, tgt, dkey)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = jax.tree.map(lambda p, u: p + u, params, updates)
+        return params, opt_state, loss
+
+    key = jax.random.PRNGKey(1)
+    print(f"mesh dp={dp} sp={args.sp} tp={args.tp}; global seq {args.seq} "
+          f"({args.seq // args.sp}/device), attn/hidden dropout "
+          f"{args.attention_dropout}/{args.hidden_dropout}")
+    t0 = time.perf_counter()
+    for step in range(args.steps):
+        key, kd, kb = jax.random.split(key, 3)
+        tok = jax.random.randint(kb, (args.batch * dp, args.seq), 0,
+                                 cfg.vocab_size)
+        tgt = jnp.roll(tok, -1, axis=1)
+        params, opt_state, loss = train_step(params, opt_state, tok, tgt,
+                                             kd)
+        if step % 5 == 0 or step == args.steps - 1:
+            print(f"step {step:4d}  loss {float(loss):.4f}  "
+                  f"({time.perf_counter() - t0:.1f}s)", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
