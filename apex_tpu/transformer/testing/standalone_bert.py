@@ -66,28 +66,50 @@ def init_bert_params(rng, cfg: BertConfig) -> Pytree:
 
 def _bert_logits(params, tokens, cfg: BertConfig, token_types=None,
                  padding_mask=None):
-    """-> (vocab-sharded MLM logits, MoE aux loss). BERT's embedding has
-    no Megatron-SP reduce-scatter exit, so ``cfg.megatron_sp`` is rejected
-    rather than silently gathering an unsharded sequence."""
-    if cfg.megatron_sp:
-        raise NotImplementedError(
-            "megatron_sp is wired for the GPT path only; the BERT "
-            "embedding/head lack the sequence scatter/gather boundaries")
+    """-> (vocab-sharded MLM logits, MoE aux loss). Under
+    ``cfg.megatron_sp`` the embedding's tp-psum becomes a reduce-scatter
+    along the sequence (the GPT entry), the LN/dropout-class regions run
+    on the (b, s/tp, h) shard, and the MLM head gathers the sequence back
+    (its vocab dim is sharded over the same tp axis)."""
+    from jax import lax
+
+    from apex_tpu.parallel.mesh import TP_AXIS
+    from apex_tpu.transformer.testing.standalone_gpt import embed_tokens
+
     e = params["embed"]
-    x = vocab_parallel_embedding(tokens, e["tok"])
-    x = x + e["pos"][None, : tokens.shape[1]].astype(x.dtype)
+    # tok + pos (incl. the megatron_sp reduce-scatter entry and the
+    # rank-offset pos slice) are the GPT embedding — one source of truth
+    x = embed_tokens(e, tokens, megatron_sp=cfg.megatron_sp)
     if token_types is not None:
-        x = x + jnp.take(e["type"], token_types, axis=0).astype(x.dtype)
+        if cfg.megatron_sp:
+            # same shard coordinates embed_tokens used for pos
+            s_shard = tokens.shape[1] // lax.axis_size(TP_AXIS)
+            tt = lax.dynamic_slice_in_dim(
+                token_types, lax.axis_index(TP_AXIS) * s_shard, s_shard, 1)
+        else:
+            tt = token_types
+        x = x + jnp.take(e["type"], tt, axis=0).astype(x.dtype)
     x = layer_norm(x, e["ln_w"], e["ln_b"])
     attn_mask = None
     if padding_mask is not None:
+        # the attention core always sees the gathered sequence (the
+        # megatron_sp QKV entry all-gathers), so the mask stays full-seq
         attn_mask = padding_mask[:, None, None, :]
     x, aux = _layer_stack(params["layers"], x, cfg, causal=False,
                           mask=attn_mask)
     h = params["head"]
+    # the dense->gelu->LN transform is per-token with replicated weights,
+    # so it runs on the (b, s/tp, h) SHARD; only the tied vocab einsum
+    # needs the gathered sequence (gpt_head's ordering)
     x = x @ h["dense_kernel"] + h["dense_bias"]
     x = jax.nn.gelu(x, approximate=True)
     x = layer_norm(x, h["ln_w"], h["ln_b"])
+    if cfg.megatron_sp:
+        from apex_tpu.transformer.tensor_parallel.mappings import (
+            gather_from_sequence_parallel_region,
+        )
+
+        x = gather_from_sequence_parallel_region(x)
     from apex_tpu.transformer.tensor_parallel.mappings import (
         copy_to_tensor_model_parallel_region,
     )

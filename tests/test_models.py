@@ -224,3 +224,55 @@ def test_gpt_pipeline_interleaved_matches_sequential():
     np.testing.assert_allclose(float(loss), float(want), rtol=1e-4)
     for leaf in jax.tree.leaves(grads):
         assert np.all(np.isfinite(np.asarray(leaf)))
+
+
+def test_bert_megatron_sp_matches_plain():
+    """BERT under Megatron-SP (round 5: the embedding now reduce-scatters
+    the sequence, LN/head boundaries gather — the GPT entry/exit wired to
+    BERT's pos/type embeddings): loss and grads EQUAL the plain tp=2 run."""
+    import dataclasses
+
+    cfg = BertConfig(vocab_size=64, max_seq=16, hidden=32, num_layers=2,
+                     num_heads=4, dtype=jnp.float32, remat=False)
+    params = init_bert_params(jax.random.PRNGKey(6), cfg)
+    mesh = build_mesh(tp=2, dp=4)
+    tokens = jax.random.randint(jax.random.PRNGKey(7), (B, S), 0,
+                                cfg.vocab_size)
+    targets = jax.random.randint(jax.random.PRNGKey(8), (B, S), 0,
+                                 cfg.vocab_size)
+    loss_mask = (jax.random.uniform(jax.random.PRNGKey(9), (B, S)) < 0.3
+                 ).astype(jnp.float32)
+    types = jax.random.randint(jax.random.PRNGKey(10), (B, S), 0, 2)
+    pad = jnp.broadcast_to(jnp.arange(S)[None, :] >= 14, (B, S))
+
+    def run(c):
+        def body(p, tok, tgt, lm, tt, pm):
+            from apex_tpu.transformer.pipeline_parallel.schedules.common import (
+                replicate_loss,
+            )
+
+            return replicate_loss(
+                bert_mlm_loss(p, tok, tgt, lm, c, token_types=tt,
+                              padding_mask=pm), mesh, masked_axis=None)
+
+        specs = gpt_param_specs(c)
+        specs["embed"]["type"] = P()
+        specs["embed"]["ln_w"] = P()
+        specs["embed"]["ln_b"] = P()
+        specs["head"] = jax.tree.map(lambda _: P(), {
+            "dense_kernel": 0, "dense_bias": 0, "ln_w": 0, "ln_b": 0})
+
+        def loss_fn(p):
+            return jax.shard_map(
+                body, mesh=mesh,
+                in_specs=(specs, P("dp"), P("dp"), P("dp"), P("dp"),
+                          P("dp")),
+                out_specs=P())(p, tokens, targets, loss_mask, types, pad)
+
+        return jax.jit(jax.value_and_grad(loss_fn))(params)
+
+    l0, g0 = run(cfg)
+    l1, g1 = run(dataclasses.replace(cfg, megatron_sp=True))
+    np.testing.assert_allclose(float(l1), float(l0), rtol=1e-5)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-5), g1, g0)

@@ -406,18 +406,20 @@ def test_bert_moe_trains(mesh_dp8):
     # router grads exist (aux loss is wired through bert_mlm_loss)
     assert np.any(np.asarray(grads["layers"]["router"]) != 0.0)
 
-    with _pytest.raises(NotImplementedError, match="BERT"):
-        bad = dataclasses.replace(cfg, num_experts=0, megatron_sp=True)
-        loss_cfg = bad
+    # round 5: BERT rides Megatron-SP (the old NotImplementedError guard
+    # is gone) — the MoE + megatron_sp composition must also run
+    sp_cfg = dataclasses.replace(cfg, megatron_sp=True)
 
-        def body2(p, tok, tgt, lm):
-            return replicate_loss(
-                bert_mlm_loss(p, tok, tgt, lm, loss_cfg),
-                mesh_dp8, masked_axis=None)
+    def body2(p, tok, tgt, lm):
+        return replicate_loss(
+            bert_mlm_loss(p, tok, tgt, lm, sp_cfg),
+            mesh_dp8, masked_axis=None)
 
-        shard_map(body2, mesh=mesh_dp8,
-                  in_specs=(specs, P("dp"), P("dp"), P("dp")),
-                  out_specs=P())(params, tok, tgt, lm)
+    loss_sp = shard_map(body2, mesh=mesh_dp8,
+                        in_specs=(specs, P("dp"), P("dp"), P("dp")),
+                        out_specs=P())(params, tok, tgt, lm)
+    # tp=1: megatron_sp is the identity sharding — same loss
+    np.testing.assert_allclose(float(loss_sp), float(loss), rtol=1e-5)
 
 
 @pytest.mark.slow
