@@ -99,23 +99,16 @@ def _bert_logits(params, tokens, cfg: BertConfig, token_types=None,
                           mask=attn_mask)
     h = params["head"]
     # the dense->gelu->LN transform is per-token with replicated weights,
-    # so it runs on the (b, s/tp, h) SHARD; only the tied vocab einsum
-    # needs the gathered sequence (gpt_head's ordering)
+    # so it runs on the (b, s/tp, h) SHARD; the shared tied-head exit
+    # gathers the sequence only for the vocab einsum
     x = x @ h["dense_kernel"] + h["dense_bias"]
     x = jax.nn.gelu(x, approximate=True)
     x = layer_norm(x, h["ln_w"], h["ln_b"])
-    if cfg.megatron_sp:
-        from apex_tpu.transformer.tensor_parallel.mappings import (
-            gather_from_sequence_parallel_region,
-        )
-
-        x = gather_from_sequence_parallel_region(x)
-    from apex_tpu.transformer.tensor_parallel.mappings import (
-        copy_to_tensor_model_parallel_region,
+    from apex_tpu.transformer.testing.standalone_gpt import (
+        tied_vocab_logits,
     )
 
-    x = copy_to_tensor_model_parallel_region(x)
-    return jnp.einsum("bsh,vh->bsv", x, e["tok"]), aux
+    return tied_vocab_logits(x, e["tok"], cfg.megatron_sp), aux
 
 
 def bert_forward(params, tokens, cfg: BertConfig, token_types=None,

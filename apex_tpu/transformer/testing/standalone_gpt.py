@@ -154,9 +154,9 @@ class GPTConfig:
                           ("ffn_hidden", self.ffn_hidden)):
             if dim % tp:
                 raise ValueError(f"{name} ({dim}) not divisible by tp ({tp})")
-        if self.remat_policy not in ("full", "dots"):
+        if self.remat_policy not in ("full", "dots", "dots_attn"):
             raise ValueError(
-                f"remat_policy must be 'full' or 'dots', "
+                f"remat_policy must be 'full', 'dots' or 'dots_attn', "
                 f"got {self.remat_policy!r}")
         if self.megatron_sp and self.max_seq % tp:
             raise ValueError(
@@ -354,6 +354,10 @@ def _attention(p, x, cfg, heads_local: int, causal: bool = True, mask=None,
                               block_q=cfg.attn_block_q,
                               block_k=cfg.attn_block_k)
     ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, heads_local * cfg.head_dim)
+    # (the dots_attn remat names live INSIDE the flash custom_vjp forward
+    # — ops/attention.py tags o and lse, the exact backward residuals;
+    # tagging here would save the output without lse and the kernel would
+    # replay anyway)
     return row_parallel_linear(ctx, p["out_kernel"], p["out_bias"],
                                input_is_parallel=True,
                                sequence_parallel=cfg.megatron_sp)
@@ -471,8 +475,21 @@ def _layer_stack(layers, x, cfg, causal: bool = True, mask=None,
                       dropout_key=key)
 
     if cfg.remat:
-        policy = (jax.checkpoint_policies.dots_with_no_batch_dims_saveable
-                  if cfg.remat_policy == "dots" else None)
+        if cfg.remat_policy == "dots":
+            policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        elif cfg.remat_policy == "dots_attn":
+            # dots PLUS the flash kernels' named residuals (o AND lse —
+            # ops/attention.py tags them inside the custom_vjp fwd): the
+            # O(s^2) attention forward is the most expensive thing
+            # full/dots remat re-executes in backward, and with both
+            # residuals saved the replay is unnecessary; the cost is one
+            # (b, s, h_local) + (b*h, s, 1) activation per layer
+            policy = jax.checkpoint_policies.save_from_both_policies(
+                jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+                jax.checkpoint_policies.save_only_these_names(
+                    "attn_out", "attn_lse"))
+        else:
+            policy = None
         one = jax.checkpoint(one, policy=policy)
 
     n_layers = jax.tree_util.tree_leaves(layers)[0].shape[0]
@@ -589,28 +606,38 @@ def gpt_forward(params, tokens, cfg: GPTConfig, dropout_key=None):
     return gpt_head(params, x, cfg)
 
 
+def tied_vocab_logits(x, tok_embed, megatron_sp: bool):
+    """The tied-embedding LM-head exit shared by GPT and BERT: gather the
+    sequence under megatron_sp (the vocab dim is sharded over the same tp
+    axis, so the einsum needs the full sequence), mark the TP region, and
+    contract against each rank's vocab shard (the reference's
+    parallel_output=True path)."""
+    from apex_tpu.transformer.tensor_parallel.mappings import (
+        copy_to_tensor_model_parallel_region,
+        gather_from_sequence_parallel_region,
+    )
+
+    if megatron_sp:
+        x = gather_from_sequence_parallel_region(x)
+    x = copy_to_tensor_model_parallel_region(x)
+    return jnp.einsum("bsh,vh->bsv", x, tok_embed)
+
+
 def gpt_head(params, x, cfg: GPTConfig):
     """Final LN + LM head -> vocab-sharded logits. Tied: logits_i = h @ tok_iᵀ
-    (each rank's vocab shard — the reference's parallel_output=True path).
-    Under ``cfg.megatron_sp`` the final LN runs on the sequence shard and
-    the head entry gathers seq (the vocab dim is sharded over the same tp
-    axis, so the head needs the full sequence on every rank)."""
+    (each rank's vocab shard). Under ``cfg.megatron_sp`` the final LN runs
+    on the sequence shard; :func:`tied_vocab_logits` gathers at the exit."""
     head = params["head"]
     x = layer_norm(x, head["ln_w"], head["ln_b"],
                    use_pallas=cfg.ln_pallas)
+    if cfg.tie_embeddings:
+        return tied_vocab_logits(x, params["embed"]["tok"], cfg.megatron_sp)
     if cfg.megatron_sp:
         from apex_tpu.transformer.tensor_parallel.mappings import (
             gather_from_sequence_parallel_region,
         )
 
         x = gather_from_sequence_parallel_region(x)
-    if cfg.tie_embeddings:
-        from apex_tpu.transformer.tensor_parallel.mappings import (
-            copy_to_tensor_model_parallel_region,
-        )
-
-        x = copy_to_tensor_model_parallel_region(x)
-        return jnp.einsum("bsh,vh->bsv", x, params["embed"]["tok"])
     return column_parallel_linear(x, head["lm"], gather_output=False)
 
 

@@ -190,8 +190,10 @@ def main() -> None:
         candidates = [(batch, False, "full", 1, True),
                       (batch, False, "full", 1, False),
                       (batch * 2, False, "full", 1, True),
+                      (batch, True, "dots_attn", 1, True),
                       (batch, True, "dots", 1, True),
                       (batch, False, "full", 12, True),
+                      (batch * 2, True, "dots_attn", 1, True),
                       (batch, True, "dots", 12, True),
                       (batch, True, "full", 1, False),
                       (batch * 2, True, "dots", 1, True),

@@ -66,7 +66,8 @@ def main() -> int:
     seq = 1024
     for remat, policy, unroll, fused in [
             (False, "full", 1, True), (True, "full", 1, True),
-            (True, "dots", 1, True), (False, "full", 12, True),
+            (True, "dots", 1, True), (True, "dots_attn", 1, True),
+            (False, "full", 12, True),
             (True, "dots", 12, True), (False, "full", 1, False),
             (True, "full", 1, False)]:
         cfg = bench.flagship_config(
