@@ -27,6 +27,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 try:
     from jax.experimental import pallas as pl
@@ -662,8 +663,6 @@ def _flash3_fwd(q3, k3, v3, seed, scale, causal, block_q, block_k, interpret,
     # naming just the public output would still replay the forward kernel
     # to rebuild lse (reviewer-verified). See GPTConfig.remat_policy
     # 'dots_attn'.
-    from jax.ad_checkpoint import checkpoint_name
-
     o = checkpoint_name(o, "attn_out")
     lse = checkpoint_name(lse, "attn_lse")
     return o, (q3, k3, v3, seed, o, lse)
@@ -696,8 +695,6 @@ def _flash3_bias_fwd(q3, k3, v3, bias, seed, scale, causal, block_q, block_k,
                      interpret, dropout_rate):
     o, lse = _fa_fwd(q3, k3, v3, scale, causal, block_q, block_k, interpret,
                      dropout_rate, seed, bias=bias)
-    from jax.ad_checkpoint import checkpoint_name
-
     o = checkpoint_name(o, "attn_out")
     lse = checkpoint_name(lse, "attn_lse")
     return o, (q3, k3, v3, bias, seed, o, lse)

@@ -32,6 +32,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 from jax import lax
 
 try:
@@ -502,6 +503,10 @@ def _varlen_fwd(q, k, v, seg_q, seg_k, scale, causal, block_q, block_k,
                 interpret):
     o, lse = _vl_call(q, k, v, seg_q, seg_k, scale, causal, block_q,
                       block_k, interpret)
+    # same names as the dense flash residuals: the dots_attn remat policy
+    # saves them so backward skips the forward-kernel replay
+    o = checkpoint_name(o, "attn_out")
+    lse = checkpoint_name(lse, "attn_lse")
     return o, (q, k, v, seg_q, seg_k, o, lse)
 
 

@@ -34,6 +34,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 from jax import lax
 
 from apex_tpu.ops.attention import (
@@ -504,6 +505,12 @@ def _ring_flash_fwd(q, k, v, seed, axis_name, causal, scale, use_pallas,
                     dropout_rate):
     o, lse = _ring_fwd_impl(q, k, v, None, axis_name, causal, scale,
                             use_pallas, dropout_rate, seed)
+    # named like the dense flash residuals (ops/attention.py): under the
+    # dots_attn remat policy the backward ring then starts from the saved
+    # (o, lse) instead of replaying the ENTIRE forward ring — n chunk
+    # kernels plus the ppermute rotation per layer
+    o = checkpoint_name(o, "attn_out")
+    lse = checkpoint_name(lse, "attn_lse")
     return o, (q, k, v, seed, o, lse)
 
 
@@ -531,6 +538,8 @@ def _ring_flash_biased_fwd(q, k, v, bias_strip, seed, axis_name, causal,
                            scale, use_pallas, dropout_rate):
     o, lse = _ring_fwd_impl(q, k, v, bias_strip, axis_name, causal, scale,
                             use_pallas, dropout_rate, seed)
+    o = checkpoint_name(o, "attn_out")
+    lse = checkpoint_name(lse, "attn_lse")
     return o, (q, k, v, bias_strip, seed, o, lse)
 
 

@@ -76,6 +76,10 @@ class GPTConfig:
     # "dots": selective policy — save matmul outputs, recompute only
     # elementwise (LN/gelu/adds); ~25% fewer recompute FLOPs for ~5-6 GB
     # of residuals at the 124M bench shape.
+    # "dots_attn": dots PLUS the flash-attention custom_vjp residuals
+    # (o + lse, named inside the kernels' fwd rules) — backward skips the
+    # O(s^2) attention forward replay entirely (dense, ring and varlen)
+    # for one extra (b, s, h_local) + lse activation per layer.
     remat_policy: str = "full"
     # Fuse the LM head matmul into the CE loss (ops/lm_head_loss.py) —
     # never materializes the (tokens, vocab) logits.
@@ -458,6 +462,19 @@ def _layer(p, x, cfg, heads_local: int, causal: bool = True, mask=None,
     return x + m, aux
 
 
+def dots_attn_policy():
+    """The 'dots_attn' remat policy object: dots PLUS the flash-attention
+    custom_vjp residuals (o AND lse — named inside the kernels' fwd
+    rules; naming the public output alone would still replay the forward
+    kernel to rebuild lse). With both saved, backward skips the O(s^2)
+    attention forward replay — dense, ring and varlen alike — for one
+    extra (b, s, h_local) + (b*h, s, 1) activation per layer."""
+    return jax.checkpoint_policies.save_from_both_policies(
+        jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        jax.checkpoint_policies.save_only_these_names(
+            "attn_out", "attn_lse"))
+
+
 def _layer_stack(layers, x, cfg, causal: bool = True, mask=None,
                  dropout_key=None):
     """scan the stacked layer params over the hidden state."""
@@ -478,16 +495,7 @@ def _layer_stack(layers, x, cfg, causal: bool = True, mask=None,
         if cfg.remat_policy == "dots":
             policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
         elif cfg.remat_policy == "dots_attn":
-            # dots PLUS the flash kernels' named residuals (o AND lse —
-            # ops/attention.py tags them inside the custom_vjp fwd): the
-            # O(s^2) attention forward is the most expensive thing
-            # full/dots remat re-executes in backward, and with both
-            # residuals saved the replay is unnecessary; the cost is one
-            # (b, s, h_local) + (b*h, s, 1) activation per layer
-            policy = jax.checkpoint_policies.save_from_both_policies(
-                jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
-                jax.checkpoint_policies.save_only_these_names(
-                    "attn_out", "attn_lse"))
+            policy = dots_attn_policy()
         else:
             policy = None
         one = jax.checkpoint(one, policy=policy)

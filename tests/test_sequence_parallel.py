@@ -252,3 +252,29 @@ def test_ulysses_dropout_ranks_draw_independent_masks():
         for g2 in range(g1 + 1, H):
             assert not np.array_equal(out[:, g1], out[:, g2]), \
                 f"heads {g1} and {g2} shared a dropout mask"
+
+
+def test_dots_attn_policy_skips_ring_fwd_replay():
+    """The ring custom_vjp names its (o, lse) residuals like the dense
+    flash kernels, so the dots_attn remat policy spares backward the
+    ENTIRE forward-ring replay: grad-jaxpr ppermute count drops from 8
+    (fwd k+v rotations, their replay, bwd's 4 rotations) to 6."""
+    mesh = build_mesh(tp=1, pp=1, sp=4, dp=2)
+    q = jnp.ones((1, 2, 64, 16), jnp.float32)
+
+    def block(x):
+        o = jax.shard_map(
+            lambda x: ring_attention(x, x, x, causal=True),
+            mesh=mesh, in_specs=P(None, None, "sp", None),
+            out_specs=P(None, None, "sp", None))(x)
+        return (o * x).sum()
+
+    def n_ppermute(policy):
+        f = jax.checkpoint(block, policy=policy)
+        return str(jax.make_jaxpr(jax.grad(f))(q)).count("ppermute")
+
+    from apex_tpu.transformer.testing.standalone_gpt import dots_attn_policy
+
+    dots = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    assert n_ppermute(dots) == 8
+    assert n_ppermute(dots_attn_policy()) == 6  # the REAL installed policy
