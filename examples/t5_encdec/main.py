@@ -69,6 +69,9 @@ def parse_args(argv=None):
     p.add_argument("--seq-enc", type=int, default=32)
     p.add_argument("--seq-dec", type=int, default=16)
     p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--dropout", type=float, default=0.0,
+                   help="hidden-dropout rate routed through the enc-dec "
+                        "schedule (per-microbatch keys; round-5 wiring)")
     return p.parse_args(argv)
 
 
@@ -89,10 +92,11 @@ def main(argv=None):
                    dtype=jnp.float32, fused_loss=False,
                    megatron_sp=args.megatron_sp,
                    relative_position_bias=args.relative_position_bias,
-                   encoder_final_ln=args.encoder_final_ln)
+                   encoder_final_ln=args.encoder_final_ln,
+                   hidden_dropout=args.dropout)
     cfg.validate(tp=args.tp)
     params = t5_pipeline_params(jax.random.PRNGKey(0), cfg, pp=args.pp)
-    spec = t5_enc_dec_spec(cfg)
+    spec = t5_enc_dec_spec(cfg, dropout=args.dropout > 0.0)
     specs_tree = t5_pipeline_specs_tree(cfg)
     opt = FusedAdam(lr=args.lr)
     opt_state = opt.init(params)
@@ -100,10 +104,11 @@ def main(argv=None):
     batch = args.batch or 2 * dp * M
 
     @jax.jit
-    def train_step(params, opt_state, enc_tok, dec_tok, tgt):
+    def train_step(params, opt_state, enc_tok, dec_tok, tgt, dkey):
         loss, grads = forward_backward_pipelining_enc_dec(
             spec, params, (enc_tok, dec_tok, tgt), num_microbatches=M,
-            mesh=mesh, params_specs=specs_tree)
+            mesh=mesh, params_specs=specs_tree,
+            dropout_key=dkey if args.dropout > 0.0 else None)
         updates, opt_state = opt.update(grads, opt_state, params)
         params = jax.tree.map(lambda p, u: p + u, params, updates)
         return params, opt_state, loss
@@ -115,14 +120,14 @@ def main(argv=None):
           f"{cfg.dec_layers}L, {M} microbatches, batch {batch}")
     t0 = time.perf_counter()
     for step in range(args.steps):
-        key, ke, kd = jax.random.split(key, 3)
+        key, ke, kd, kdrop = jax.random.split(key, 4)
         enc_tok = jax.random.randint(ke, (batch, args.seq_enc), 0,
                                      cfg.vocab_size)
         dec_tok = jax.random.randint(kd, (batch, args.seq_dec), 0,
                                      cfg.vocab_size)
         tgt = jnp.roll(dec_tok, -1, axis=1)
         params, opt_state, loss = train_step(params, opt_state, enc_tok,
-                                             dec_tok, tgt)
+                                             dec_tok, tgt, kdrop)
         if step % 5 == 0 or step == args.steps - 1:
             print(f"step {step:4d}  loss {float(loss):.4f}  "
                   f"({time.perf_counter() - t0:.1f}s)", flush=True)
