@@ -10,6 +10,17 @@ synthetic data. Run: ``python examples/dcgan/main_amp.py --iters 10``.
 
 from __future__ import annotations
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    from apex_tpu.utils.platform import pin_cpu_platform
+
+    pin_cpu_platform(virtual_devices=8)
+
 import argparse
 import time
 

@@ -17,8 +17,18 @@ from __future__ import annotations
 
 import argparse
 import functools
+import os
+import sys
 import time
 from typing import Any, List
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    from apex_tpu.utils.platform import pin_cpu_platform
+
+    pin_cpu_platform(virtual_devices=8)
 
 import jax
 import jax.numpy as jnp
@@ -55,6 +65,15 @@ def parse_args(argv=None):
     p.add_argument("--deterministic", action="store_true")
     p.add_argument("--print-freq", type=int, default=10)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--resume", default="", metavar="PATH",
+                   help="path to a checkpoint to resume from (the "
+                        "reference's --resume: restores model, optimizer, "
+                        "amp and batch-norm state plus the iteration)")
+    p.add_argument("--checkpoint-dir", default="",
+                   help="save the full train state here (end of run, plus "
+                        "every --save-freq iters)")
+    p.add_argument("--save-freq", type=int, default=0,
+                   help="checkpoint every N iters (0 = only at the end)")
     return p.parse_args(argv)
 
 
@@ -164,11 +183,44 @@ def train(args) -> List[float]:
     return _run_loop(args, step, amp_state, opt_state, batch_stats)
 
 
+def _save_state(args, state, it: int) -> None:
+    from apex_tpu.utils.checkpoint import save_checkpoint
+
+    blob = {"leaves": {str(i): leaf
+                       for i, leaf in enumerate(jax.tree.leaves(state))},
+            "it": jnp.asarray(it)}
+    p = save_checkpoint(os.path.join(args.checkpoint_dir, "ckpt"), blob,
+                        step=it)
+    print(f"=> saved checkpoint '{p}' (iter {it})")
+
+
 def _run_loop(args, step, amp_state, opt_state, batch_stats) -> List[float]:
+    state = (amp_state, opt_state, batch_stats)
+    start_it = 0
+    if args.resume:
+        # the reference's resume contract: restore model/optimizer/amp
+        # state and continue at the saved iteration. Leaves are stored
+        # flat and re-hung on the LIVE treedef (orbax restores plain
+        # dicts; the amp/opt containers are custom nodes).
+        from apex_tpu.utils.checkpoint import load_checkpoint
+
+        blob = load_checkpoint(args.resume)
+        n = len(jax.tree.leaves(state))
+        leaves = [jnp.asarray(blob["leaves"][str(i)]) for i in range(n)]
+        state = jax.tree.unflatten(jax.tree.structure(state), leaves)
+        start_it = int(blob["it"])
+        print(f"=> loaded checkpoint '{args.resume}' (resuming at iter "
+              f"{start_it})")
+        if start_it >= args.iters:
+            raise SystemExit(
+                f"checkpoint is already at iter {start_it} >= --iters "
+                f"{args.iters}; nothing to resume (raise --iters)")
+    amp_state, opt_state, batch_stats = state
+
     losses = []
     data_rng = jax.random.PRNGKey(args.seed + 1)
     t0 = time.perf_counter()
-    for it in range(args.iters):
+    for it in range(start_it, args.iters):
         k = jax.random.fold_in(data_rng, it)
         images = jax.random.normal(
             k, (args.batch_size, args.image_size, args.image_size, 3))
@@ -180,8 +232,12 @@ def _run_loop(args, step, amp_state, opt_state, batch_stats) -> List[float]:
         losses.append(float(loss))
         if it % args.print_freq == 0 or it == args.iters - 1:
             dt = time.perf_counter() - t0
-            ips = args.batch_size * (it + 1) / dt
+            ips = args.batch_size * (it - start_it + 1) / dt
             print(f"iter {it:4d}  loss {losses[-1]:.6f}  {ips:,.1f} img/s")
+        if args.checkpoint_dir and (
+                it == args.iters - 1
+                or (args.save_freq and (it + 1) % args.save_freq == 0)):
+            _save_state(args, (amp_state, opt_state, batch_stats), it + 1)
     return losses
 
 

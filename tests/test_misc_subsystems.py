@@ -351,3 +351,30 @@ def test_loss_scaler_hysteresis():
     assert int(sc.load_state_dict(d).hysteresis_left) == 1
     del d["hysteresis_left"]
     assert int(sc.load_state_dict(d).hysteresis_left) == 2
+
+
+def test_imagenet_trainer_exact_resume(tmp_path):
+    """The reference's --resume contract on the flagship example trainer:
+    4 iters + checkpoint, then resume to 8, must reproduce the
+    uninterrupted 8-iter run EXACTLY (deterministic synthetic data is
+    keyed by absolute iteration, state round-trips through orbax)."""
+    from tests.gen_l1_baselines import load_trainer
+
+    m = load_trainer()
+    # the L1 fast tier's exact config (resnet18_O2_False_128.0 at BASE
+    # shapes): when that test ran first in this process, the jitted step
+    # is already cached and this test costs only the 8 tiny iterations
+    base = ["--arch", "resnet18", "--opt-level", "O2", "--loss-scale",
+            "128.0", "--iters", "8", "--batch-size", "32", "--image-size",
+            "32", "--num-classes", "10", "--deterministic", "--lr",
+            "0.0001", "--print-freq", "100"]
+    full = m.train(m.parse_args(base))
+
+    ck = str(tmp_path / "ck")
+    half = [("4" if a == "8" else a) for a in base]
+    first = m.train(m.parse_args(half + ["--checkpoint-dir", ck]))
+    import glob as _glob
+
+    ckpt = sorted(_glob.glob(ck + "/ckpt_*"))[-1]
+    rest = m.train(m.parse_args(base + ["--resume", ckpt]))
+    assert first + rest == full, (first, rest, full)
