@@ -353,10 +353,11 @@ def test_loss_scaler_hysteresis():
     assert int(sc.load_state_dict(d).hysteresis_left) == 2
 
 
+@pytest.mark.slow
 def test_imagenet_trainer_exact_resume(tmp_path):
     """The reference's --resume contract on the flagship example trainer:
-    4 iters + checkpoint, then resume to 8, must reproduce the
-    uninterrupted 8-iter run EXACTLY (deterministic synthetic data is
+    2 iters + checkpoint, then resume to 4, must reproduce the
+    uninterrupted 4-iter run EXACTLY (deterministic synthetic data is
     keyed by absolute iteration, state round-trips through orbax)."""
     from tests.gen_l1_baselines import load_trainer
 
@@ -365,13 +366,13 @@ def test_imagenet_trainer_exact_resume(tmp_path):
     # shapes): when that test ran first in this process, the jitted step
     # is already cached and this test costs only the 8 tiny iterations
     base = ["--arch", "resnet18", "--opt-level", "O2", "--loss-scale",
-            "128.0", "--iters", "8", "--batch-size", "32", "--image-size",
+            "128.0", "--iters", "4", "--batch-size", "32", "--image-size",
             "32", "--num-classes", "10", "--deterministic", "--lr",
             "0.0001", "--print-freq", "100"]
     full = m.train(m.parse_args(base))
 
     ck = str(tmp_path / "ck")
-    half = [("4" if a == "8" else a) for a in base]
+    half = [("2" if a == "4" else a) for a in base]
     first = m.train(m.parse_args(half + ["--checkpoint-dir", ck]))
     import glob as _glob
 
