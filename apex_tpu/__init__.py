@@ -21,6 +21,8 @@ L3     ``apex_tpu.optimizers``,       ``apex/optimizers``, ``apex/normalization`
        ``.normalization``, ``.mlp``,  ``apex/mlp``, ``apex/fused_dense``
        ``.fused_dense``
 L4     ``apex_tpu.parallel``          ``apex/parallel`` (DDP, SyncBN, LARC)
+L4.5   ``apex_tpu.comm``              — (north-star: compressed collectives,
+                                      int8+EF quantized allreduce)
 L5     ``apex_tpu.transformer``       ``apex/transformer`` (TP/PP runtime)
 L6     ``apex_tpu.contrib``           ``apex/contrib``
 L7     ``apex_tpu.profiler``          ``apex/pyprof``
@@ -34,6 +36,7 @@ __version__ = "0.1.0"
 
 __all__ = [
     "amp",
+    "comm",
     "config",
     "contrib",
     "fp16_utils",

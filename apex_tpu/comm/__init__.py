@@ -1,0 +1,56 @@
+"""Compressed-collective communication (L-comm) — the wire layer between
+gradient producers (DDP, ZeRO optimizers) and the mesh.
+
+Not in the reference: NVIDIA Apex moves fp16/fp32 gradient buckets
+verbatim. This subsystem adds blockwise-int8 quantized allreduce with
+optional error feedback (EQuARX, arxiv 2506.17615; weight-update sharding
+composition per Xu et al., arxiv 2004.13336), cutting DP/ZeRO gradient
+bytes-on-wire ~4× at matched convergence. One ``CompressionConfig`` object
+selects the policy everywhere:
+
+* ``apex_tpu.parallel.DistributedDataParallel(compression=cfg)``
+* ``apex_tpu.contrib.optimizers.DistributedFusedAdam(compression=cfg)``
+  (and LAMB)
+
+Modules: ``quantize`` (the int8 codec, pure-JAX + Pallas), ``collectives``
+(the two-pass quantized allreduce / reduce-scatter), ``error_feedback``
+(the residual pytree + checkpoint round-trip), ``accounting`` (bytes-on-
+wire pricing of compiled HLO — how the compression claim is *asserted*,
+see ``tests/test_collective_counts.py``).
+"""
+
+from apex_tpu.comm.accounting import (  # noqa: F401
+    CollectiveReport,
+    collective_report,
+    wire_bytes,
+)
+from apex_tpu.comm.collectives import (  # noqa: F401
+    CompressionConfig,
+    compressed_allreduce,
+    compressed_psum_scatter,
+)
+from apex_tpu.comm.error_feedback import (  # noqa: F401
+    init_error_feedback,
+    load_state_dict,
+    state_dict,
+)
+from apex_tpu.comm.quantize import (  # noqa: F401
+    dequantize_blockwise,
+    quantization_error,
+    quantize_blockwise,
+)
+
+__all__ = [
+    "CollectiveReport",
+    "CompressionConfig",
+    "collective_report",
+    "compressed_allreduce",
+    "compressed_psum_scatter",
+    "dequantize_blockwise",
+    "init_error_feedback",
+    "load_state_dict",
+    "quantization_error",
+    "quantize_blockwise",
+    "state_dict",
+    "wire_bytes",
+]

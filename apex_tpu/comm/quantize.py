@@ -1,0 +1,273 @@
+"""Blockwise int8 quantize/dequantize for gradient wire compression.
+
+Reference context: NVIDIA Apex ships no gradient compression — its DDP
+moves fp16/fp32 buckets (``apex/parallel/distributed.py:425-470``) and its
+only wire narrowing is the ZeRO ``e5m2_allgather`` param transport. EQuARX
+(arxiv 2506.17615) shows blockwise-quantized AllReduce inside XLA recovers
+near-full quality at a fraction of the interconnect bytes; this module is
+the codec half of that design: flat fp buffers are split into fixed-size
+blocks, each block carries one fp32 scale (absmax/127) and int8 mantissas —
+4 bytes of scale overhead per ``block_size`` elements, so the wire cost is
+``n + 4n/B`` bytes vs ``4n`` for fp32 (≈3.9× at B=256).
+
+Two implementations with identical deterministic math:
+
+* pure JAX (reshape → absmax → round → clip): XLA fuses this into the
+  surrounding program; always available, the ground truth for tests;
+* a Pallas TPU kernel (``use_pallas``): one VMEM pass producing the int8
+  codes and fp32 scales per row-block — selected automatically on compiled
+  TPU backends for tile-aligned shapes, opt-in interpret mode elsewhere
+  (the ``ops/layer_norm.py`` gating pattern).
+
+Stochastic rounding (``stochastic=True``) draws one uniform per element and
+rounds ``floor(x/scale + u)`` — unbiased (E[q·scale] = x), the standard
+requirement for quantized *training* signals; the Pallas path uses the
+on-core PRNG (``pltpu.prng_random_bits``), the JAX path ``jax.random``.
+Both are deterministic given the seed, but their streams differ — parity
+tests pin the deterministic mode.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops._pallas_util import compiled_backend as _compiled_backend
+from apex_tpu.ops._pallas_util import sds as _sds
+
+try:  # keep import-failure graceful (CPU-only envs), like ops/layer_norm.py
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAS_PALLAS = False
+
+QMAX = 127.0  # symmetric int8 code range; -128 is never emitted
+
+
+def blocks_for(n: int, block_size: int) -> int:
+    """Number of scale blocks covering ``n`` elements."""
+    return -(-n // block_size)
+
+
+def padded_size(n: int, block_size: int) -> int:
+    return blocks_for(n, block_size) * block_size
+
+
+def _block_scales(xb: jnp.ndarray) -> jnp.ndarray:
+    """(rows, block) fp32 -> (rows,) fp32 scale = absmax/127, with all-zero
+    blocks mapped to scale 1 so the quotient is well-defined (codes are 0
+    there anyway)."""
+    amax = jnp.max(jnp.abs(xb), axis=1)
+    return jnp.where(amax > 0, amax / QMAX, 1.0)
+
+
+def _uniform_from_bits(bits: jnp.ndarray) -> jnp.ndarray:
+    """uint32 -> [0, 1) fp32 using the top 24 bits (exactly representable)."""
+    return (bits >> jnp.uint32(8)).astype(jnp.float32) * jnp.float32(2.0**-24)
+
+
+# ---------------------------------------------------------------------------
+# Pure-JAX reference path
+
+def _quantize_jax(x_flat, block_size: int, stochastic: bool, seed):
+    xb = x_flat.astype(jnp.float32).reshape(-1, block_size)
+    scales = _block_scales(xb)
+    y = xb / scales[:, None]
+    if stochastic:
+        key = jax.random.fold_in(jax.random.PRNGKey(0), seed)
+        u = _uniform_from_bits(
+            jax.random.bits(key, xb.shape, dtype=jnp.uint32))
+        q = jnp.floor(y + u)
+    else:
+        q = jnp.round(y)
+    q = jnp.clip(q, -QMAX, QMAX).astype(jnp.int8)
+    return q.reshape(-1), scales
+
+
+def _dequantize_jax(q_flat, scales, block_size: int):
+    qb = q_flat.reshape(-1, block_size).astype(jnp.float32)
+    return (qb * scales[:, None]).reshape(-1)
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernels — one pass per row-block of (rows_per_step, block) elements
+
+def _quant_kernel(x_ref, q_ref, s_ref):
+    x = x_ref[:].astype(jnp.float32)
+    amax = jnp.max(jnp.abs(x), axis=1, keepdims=True)
+    scale = jnp.where(amax > 0, amax / QMAX, 1.0)
+    q = jnp.clip(jnp.round(x / scale), -QMAX, QMAX)
+    q_ref[:] = q.astype(jnp.int8)
+    s_ref[:] = scale
+
+
+def _quant_kernel_stochastic(x_ref, seed_ref, q_ref, s_ref):
+    # one PRNG stream per grid step: the per-core PRNG is reseeded with the
+    # (seed, program_id) pair so every row-block draws independent bits
+    pltpu.prng_seed(seed_ref[0], pl.program_id(0))
+    x = x_ref[:].astype(jnp.float32)
+    amax = jnp.max(jnp.abs(x), axis=1, keepdims=True)
+    scale = jnp.where(amax > 0, amax / QMAX, 1.0)
+    y = x / scale
+    bits = pltpu.bitcast(pltpu.prng_random_bits(y.shape), jnp.uint32)
+    q = jnp.clip(jnp.floor(y + _uniform_from_bits(bits)), -QMAX, QMAX)
+    q_ref[:] = q.astype(jnp.int8)
+    s_ref[:] = scale
+
+
+def _dequant_kernel(q_ref, s_ref, y_ref):
+    y_ref[:] = (q_ref[:].astype(jnp.float32) * s_ref[:]).astype(y_ref.dtype)
+
+
+# int8 VREG tiling wants (32, 128) blocks; a grid step holds a few fp32
+# copies of the row block — keep it well under a core's VMEM
+_ROWS_PER_STEP = 32
+
+
+def _pallas_ok(n: int, block_size: int, allow_interpret: bool) -> bool:
+    if not _HAS_PALLAS:
+        return False
+    if block_size % 128 != 0:
+        return False
+    rows = n // block_size
+    if n % block_size != 0 or rows % _ROWS_PER_STEP != 0:
+        return False
+    return allow_interpret or _compiled_backend()
+
+
+def _interpret_default() -> bool:
+    return not _compiled_backend()
+
+
+def _quantize_pallas(x_flat, block_size: int, stochastic: bool, seed):
+    rows = x_flat.size // block_size
+    x2d = x_flat.reshape(rows, block_size)
+    grid = (rows // _ROWS_PER_STEP,)
+    out_shape = [
+        _sds((rows, block_size), jnp.int8, x_flat),
+        _sds((rows, 1), jnp.float32, x_flat),
+    ]
+    out_specs = [
+        pl.BlockSpec((_ROWS_PER_STEP, block_size), lambda i: (i, 0)),
+        pl.BlockSpec((_ROWS_PER_STEP, 1), lambda i: (i, 0)),
+    ]
+    x_spec = pl.BlockSpec((_ROWS_PER_STEP, block_size), lambda i: (i, 0))
+    if stochastic:
+        q, s = pl.pallas_call(
+            _quant_kernel_stochastic,
+            grid=grid,
+            in_specs=[
+                x_spec,
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+            ],
+            out_specs=out_specs,
+            out_shape=out_shape,
+            interpret=_interpret_default(),
+        )(x2d, jnp.asarray(seed, jnp.int32).reshape((1,)))
+    else:
+        q, s = pl.pallas_call(
+            _quant_kernel,
+            grid=grid,
+            in_specs=[x_spec],
+            out_specs=out_specs,
+            out_shape=out_shape,
+            interpret=_interpret_default(),
+        )(x2d)
+    return q.reshape(-1), s.reshape(-1)
+
+
+def _dequantize_pallas(q_flat, scales, block_size: int):
+    rows = q_flat.size // block_size
+    y = pl.pallas_call(
+        _dequant_kernel,
+        grid=(rows // _ROWS_PER_STEP,),
+        in_specs=[
+            pl.BlockSpec((_ROWS_PER_STEP, block_size), lambda i: (i, 0)),
+            pl.BlockSpec((_ROWS_PER_STEP, 1), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((_ROWS_PER_STEP, block_size),
+                               lambda i: (i, 0)),
+        out_shape=_sds((rows, block_size), jnp.float32, q_flat, scales),
+        interpret=_interpret_default(),
+    )(q_flat.reshape(rows, block_size), scales.reshape(rows, 1))
+    return y.reshape(-1)
+
+
+# ---------------------------------------------------------------------------
+# Public API
+
+def quantize_blockwise(
+    x_flat: jnp.ndarray,
+    block_size: int = 256,
+    stochastic: bool = False,
+    seed=None,
+    use_pallas: Optional[bool] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Flat fp buffer -> (int8 codes (n,), fp32 per-block scales (n/B,)).
+
+    ``x_flat.size`` must be a multiple of ``block_size`` (callers pad; see
+    :func:`padded_size`). ``seed``: int32 scalar, required when
+    ``stochastic`` — the codes are deterministic given it.
+    """
+    if x_flat.ndim != 1:
+        raise ValueError(f"expected flat buffer, got shape {x_flat.shape}")
+    if x_flat.size % block_size != 0:
+        raise ValueError(
+            f"size {x_flat.size} not a multiple of block_size {block_size}")
+    if stochastic and seed is None:
+        raise ValueError("stochastic quantization needs a seed")
+    if use_pallas is None:
+        use_pallas = _pallas_ok(x_flat.size, block_size,
+                                allow_interpret=False)
+    elif use_pallas and not _pallas_ok(x_flat.size, block_size,
+                                       allow_interpret=True):
+        raise ValueError(
+            f"pallas quantize needs block_size % 128 == 0 and "
+            f"rows % {_ROWS_PER_STEP} == 0; got n={x_flat.size}, "
+            f"block_size={block_size}")
+    if stochastic and use_pallas and _interpret_default():
+        # pltpu.prng_* has no CPU interpreter lowering — the stochastic
+        # kernel is compiled-Mosaic-only; off-TPU the JAX stream stands in
+        # (different bits, same distribution — parity tests pin the
+        # deterministic mode)
+        use_pallas = False
+    if use_pallas:
+        return _quantize_pallas(x_flat, block_size, stochastic, seed)
+    return _quantize_jax(x_flat, block_size, stochastic, seed)
+
+
+def dequantize_blockwise(
+    q_flat: jnp.ndarray,
+    scales: jnp.ndarray,
+    block_size: int = 256,
+    use_pallas: Optional[bool] = None,
+) -> jnp.ndarray:
+    """(int8 codes, fp32 scales) -> fp32 flat buffer."""
+    if q_flat.size % block_size != 0:
+        raise ValueError(
+            f"size {q_flat.size} not a multiple of block_size {block_size}")
+    if use_pallas is None:
+        use_pallas = _pallas_ok(q_flat.size, block_size,
+                                allow_interpret=False)
+    elif use_pallas and not _pallas_ok(q_flat.size, block_size,
+                                       allow_interpret=True):
+        raise ValueError(
+            f"pallas dequantize needs block_size % 128 == 0 and "
+            f"rows % {_ROWS_PER_STEP} == 0; got n={q_flat.size}, "
+            f"block_size={block_size}")
+    if use_pallas:
+        return _dequantize_pallas(q_flat, scales, block_size)
+    return _dequantize_jax(q_flat, scales, block_size)
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def quantization_error(x_flat, block_size: int = 256):
+    """Round-trip error ``x - dq(q(x))`` of the deterministic codec — the
+    quantity error feedback re-injects (``error_feedback.py``)."""
+    q, s = quantize_blockwise(x_flat, block_size)
+    return x_flat.astype(jnp.float32) - dequantize_blockwise(q, s, block_size)

@@ -27,29 +27,35 @@ from jax import lax
 Pytree = Any
 
 
-def shard_size(n: int, world: int) -> int:
-    return (n + world - 1) // world
+def shard_size(n: int, world: int, multiple: int = 1) -> int:
+    """ceil(n/world), rounded up to ``multiple``. The compressed-collective
+    path (``comm/collectives.py``) passes the quantization block size so no
+    scale block ever straddles a shard boundary; state built by
+    :func:`slice_leaf` and grads from either scatter path then agree on the
+    shard shape."""
+    k = (n + world - 1) // world
+    return -(-k // multiple) * multiple
 
 
-def scatter_leaf(x, axis_name: str):
-    """flatten + pad + reduce-scatter: (shape) -> (ceil(n/world),), summed
-    over the axis (the grad reduce-scatter)."""
+def scatter_leaf(x, axis_name: str, multiple: int = 1):
+    """flatten + pad + reduce-scatter: (shape) -> (shard_size(n, world),),
+    summed over the axis (the grad reduce-scatter)."""
     world = lax.axis_size(axis_name)
     flat = x.reshape(-1)
-    k = shard_size(flat.size, world)
+    k = shard_size(flat.size, world, multiple)
     pad = k * world - flat.size
     if pad:
         flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
     return lax.psum_scatter(flat, axis_name, scatter_dimension=0, tiled=True)
 
 
-def slice_leaf(x, axis_name: str):
+def slice_leaf(x, axis_name: str, multiple: int = 1):
     """This rank's shard of a replicated leaf (no reduction): used to build
     the initial sharded master/moment state."""
     world = lax.axis_size(axis_name)
     rank = lax.axis_index(axis_name)
     flat = x.reshape(-1)
-    k = shard_size(flat.size, world)
+    k = shard_size(flat.size, world, multiple)
     pad = k * world - flat.size
     if pad:
         flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])

@@ -22,6 +22,12 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from apex_tpu.comm.collectives import (
+    CompressionConfig,
+    compressed_psum_scatter,
+    fold_seed,
+)
+from apex_tpu.comm.error_feedback import init_error_feedback
 from apex_tpu.contrib.optimizers._sharding import (
     gather_leaf,
     scatter_leaf,
@@ -30,6 +36,57 @@ from apex_tpu.contrib.optimizers._sharding import (
 from apex_tpu.parallel.mesh import DP_AXIS
 
 Pytree = Any
+
+
+def _shard_multiple(compression: Optional[CompressionConfig]) -> int:
+    """Shard-size alignment: with a quantized reduce-scatter the shards are
+    block-aligned so the codec's fp32 scale blocks never straddle ranks."""
+    if compression is not None and compression.enabled:
+        return compression.block_size
+    return 1
+
+
+def _reduce_grad_leaf(g, axis_name, compression, residual, seed):
+    """One leaf's grad reduce-scatter — quantized wire when configured.
+    Returns (fp32 summed shard, new residual or None)."""
+    if compression is not None and compression.enabled:
+        return compressed_psum_scatter(
+            g.reshape(-1).astype(jnp.float32), axis_name, compression,
+            residual=residual, seed=seed,
+            shard_multiple=compression.block_size)
+    return scatter_leaf(g.astype(jnp.float32), axis_name), residual
+
+
+def _reduce_grads(grads, comm_state, axis_name, compression, seed,
+                  scale=None):
+    """All leaves' grad reduce — flattened, so tuple-shaped CONTAINER nodes
+    in the grads pytree are never mistaken for (shard, residual) pairs.
+    Returns (shard pytree, new comm_state pytree or None).
+
+    ``scale``: AMP loss scale. The residual is carried in UNSCALED units —
+    re-scaled on the way into the collective and unscaled on the way out —
+    so a dynamic-scaler scale change between steps cannot mis-scale the
+    injected correction."""
+    leaves, treedef = jax.tree_util.tree_flatten(grads)
+    res = (jax.tree_util.tree_flatten(comm_state)[0]
+           if comm_state is not None else [None] * len(leaves))
+    if len(res) != len(leaves):
+        raise ValueError(
+            f"comm_state has {len(res)} leaves, grads have {len(leaves)}")
+    shards, new_res = [], []
+    for i, (g, r) in enumerate(zip(leaves, res)):
+        leaf_seed = None if seed is None else fold_seed(seed, i)
+        r_in = r if (r is None or scale is None) else r * scale
+        s, r2 = _reduce_grad_leaf(g, axis_name, compression, r_in, leaf_seed)
+        if r2 is not None and scale is not None:
+            r2 = r2 / scale
+        shards.append(s)
+        new_res.append(r2)
+    g_shards = jax.tree_util.tree_unflatten(treedef, shards)
+    if comm_state is None:
+        return g_shards, None
+    return g_shards, jax.tree_util.tree_unflatten(
+        jax.tree_util.tree_structure(comm_state), new_res)
 
 
 class DistAdamState(NamedTuple):
@@ -62,17 +119,32 @@ class DistributedFusedAdam:
     # float8_e5m2 (half the all-gather bytes); masters stay fp32-exact,
     # only the replicated model copy carries the e5m2 rounding
     e5m2_allgather: bool = False
+    # int8-quantized gradient reduce-scatter (comm/collectives.py): the
+    # grad leg of the ZeRO dataflow rides int8 codes + fp32 block scales;
+    # policy 'int8_ef' carries an error-feedback residual — thread
+    # ``comm_state`` through :meth:`step` (see :meth:`init_comm_state`)
+    compression: Optional[CompressionConfig] = None
 
     def init(self, params: Pytree) -> DistAdamState:
         """Shard fp32 masters + zero moments (call inside the mesh program;
         ``params`` replicated across ``axis_name``)."""
+        mult = _shard_multiple(self.compression)
         master = jax.tree.map(
-            lambda p: slice_leaf(p.astype(jnp.float32), self.axis_name),
+            lambda p: slice_leaf(p.astype(jnp.float32), self.axis_name,
+                                 multiple=mult),
             params)
         zeros = jax.tree.map(lambda m: jnp.zeros_like(m), master)
         return DistAdamState(
             count=jnp.zeros((), jnp.int32), master=master, mu=zeros,
             nu=jax.tree.map(jnp.zeros_like, master))
+
+    def init_comm_state(self, params: Pytree) -> Optional[Pytree]:
+        """Error-feedback residuals (policy ``int8_ef``), else ``None``.
+        Unsharded fp32 — EF compensates the rank-local quantization error,
+        which lives on the full gradient."""
+        if self.compression is not None and self.compression.error_feedback:
+            return init_error_feedback(params)
+        return None
 
     def _global_norm(self, shards) -> jnp.ndarray:
         sq = sum(jnp.sum(jnp.square(g)) for g in jax.tree.leaves(shards))
@@ -84,18 +156,30 @@ class DistributedFusedAdam:
         state: DistAdamState,
         params: Pytree,
         scale: Optional[jnp.ndarray] = None,
-    ) -> Tuple[Pytree, DistAdamState]:
+        comm_state: Optional[Pytree] = None,
+        seed=None,
+    ) -> Tuple[Pytree, ...]:
         """reduce-scatter → (unscale, clip) → Adam on shards → all-gather.
 
         ``grads``: per-device gradients (NOT yet dp-reduced — the
         reduce-scatter does the sum, ref "overlap_reductions" dataflow).
         ``scale``: optional AMP loss scale to divide out
         (ref step_supports_amp_scaling).
+        ``comm_state``/``seed``: error-feedback residuals and the
+        stochastic-rounding seed for the compressed reduce-scatter; when
+        ``comm_state`` is passed the return is ``(params, state,
+        comm_state)``.
         """
+        if (self.compression is not None and self.compression.error_feedback
+                and comm_state is None):
+            raise ValueError(
+                "compression policy 'int8_ef' carries state: pass "
+                "comm_state=opt.init_comm_state(params) and thread the "
+                "returned state")
         b1, b2 = self.betas
-        g_shards = jax.tree.map(
-            lambda g: scatter_leaf(g.astype(jnp.float32), self.axis_name),
-            grads)
+        g_shards, new_comm = _reduce_grads(grads, comm_state, self.axis_name,
+                                           self.compression, seed,
+                                           scale=scale)
         world = lax.axis_size(self.axis_name)
         # reduce-scatter sums over dp; grads are averaged like DDP does
         g_shards = jax.tree.map(lambda g: g / world, g_shards)
@@ -121,15 +205,23 @@ class DistributedFusedAdam:
                 u = u + self.weight_decay * p32
             return p32 - self.lr * u, m_new, v_new
 
-        out = jax.tree.map(upd, g_shards, state.mu, state.nu, state.master)
-        is3 = lambda x: isinstance(x, tuple)
-        master = jax.tree.map(lambda o: o[0], out, is_leaf=is3)
-        mu = jax.tree.map(lambda o: o[1], out, is_leaf=is3)
-        nu = jax.tree.map(lambda o: o[2], out, is_leaf=is3)
+        # flattened, not is_leaf=tuple: a tuple CONTAINER node in the grads
+        # pytree must not be mistaken for upd's (p, m, v) result triple
+        g_l, treedef = jax.tree_util.tree_flatten(g_shards)
+        out = [upd(g, m, v, p) for g, m, v, p in zip(
+            g_l, jax.tree_util.tree_leaves(state.mu),
+            jax.tree_util.tree_leaves(state.nu),
+            jax.tree_util.tree_leaves(state.master))]
+        master = jax.tree_util.tree_unflatten(treedef, [o[0] for o in out])
+        mu = jax.tree_util.tree_unflatten(treedef, [o[1] for o in out])
+        nu = jax.tree_util.tree_unflatten(treedef, [o[2] for o in out])
 
         transport = jnp.float8_e5m2 if self.e5m2_allgather else None
         new_params = jax.tree.map(
             lambda m, p: gather_leaf(m, p.shape, p.dtype, self.axis_name,
                                      transport_dtype=transport),
             master, params)
-        return new_params, DistAdamState(count, master, mu, nu)
+        new_state = DistAdamState(count, master, mu, nu)
+        if comm_state is not None:
+            return new_params, new_state, new_comm
+        return new_params, new_state

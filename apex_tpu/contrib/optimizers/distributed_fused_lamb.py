@@ -22,10 +22,15 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from apex_tpu.comm.collectives import CompressionConfig
+from apex_tpu.comm.error_feedback import init_error_feedback
 from apex_tpu.contrib.optimizers._sharding import (
     gather_leaf,
-    scatter_leaf,
     slice_leaf,
+)
+from apex_tpu.contrib.optimizers.distributed_fused_adam import (
+    _reduce_grads,
+    _shard_multiple,
 )
 from apex_tpu.parallel.mesh import DP_AXIS
 
@@ -54,15 +59,25 @@ class DistributedFusedLAMB:
     axis_name: str = DP_AXIS
     # ref e5m2 compressed all-gather (see DistributedFusedAdam)
     e5m2_allgather: bool = False
+    # int8-quantized gradient reduce-scatter (see DistributedFusedAdam)
+    compression: Optional[CompressionConfig] = None
 
     def init(self, params: Pytree) -> DistLambState:
+        mult = _shard_multiple(self.compression)
         master = jax.tree.map(
-            lambda p: slice_leaf(p.astype(jnp.float32), self.axis_name),
+            lambda p: slice_leaf(p.astype(jnp.float32), self.axis_name,
+                                 multiple=mult),
             params)
         return DistLambState(
             count=jnp.zeros((), jnp.int32), master=master,
             mu=jax.tree.map(jnp.zeros_like, master),
             nu=jax.tree.map(jnp.zeros_like, master))
+
+    def init_comm_state(self, params: Pytree) -> Optional[Pytree]:
+        """Error-feedback residuals (policy ``int8_ef``), else ``None``."""
+        if self.compression is not None and self.compression.error_feedback:
+            return init_error_feedback(params)
+        return None
 
     def step(
         self,
@@ -70,11 +85,19 @@ class DistributedFusedLAMB:
         state: DistLambState,
         params: Pytree,
         scale: Optional[jnp.ndarray] = None,
-    ) -> Tuple[Pytree, DistLambState]:
+        comm_state: Optional[Pytree] = None,
+        seed=None,
+    ) -> Tuple[Pytree, ...]:
+        if (self.compression is not None and self.compression.error_feedback
+                and comm_state is None):
+            raise ValueError(
+                "compression policy 'int8_ef' carries state: pass "
+                "comm_state=opt.init_comm_state(params) and thread the "
+                "returned state")
         b1, b2 = self.betas
-        g_shards = jax.tree.map(
-            lambda g: scatter_leaf(g.astype(jnp.float32), self.axis_name),
-            grads)
+        g_shards, new_comm = _reduce_grads(grads, comm_state, self.axis_name,
+                                           self.compression, seed,
+                                           scale=scale)
         world = lax.axis_size(self.axis_name)
         if self.grad_averaging:
             g_shards = jax.tree.map(lambda g: g / world, g_shards)
@@ -109,15 +132,22 @@ class DistributedFusedLAMB:
                 trust = jnp.where(apply_trust, w_norm / u_norm, 1.0)
             return p32 - self.lr * trust * u, m_new, v_new
 
-        out = jax.tree.map(upd, g_shards, state.mu, state.nu, state.master)
-        is3 = lambda x: isinstance(x, tuple)
-        master = jax.tree.map(lambda o: o[0], out, is_leaf=is3)
-        mu = jax.tree.map(lambda o: o[1], out, is_leaf=is3)
-        nu = jax.tree.map(lambda o: o[2], out, is_leaf=is3)
+        # flattened, not is_leaf=tuple (see DistributedFusedAdam.step)
+        g_l, treedef = jax.tree_util.tree_flatten(g_shards)
+        out = [upd(g, m, v, p) for g, m, v, p in zip(
+            g_l, jax.tree_util.tree_leaves(state.mu),
+            jax.tree_util.tree_leaves(state.nu),
+            jax.tree_util.tree_leaves(state.master))]
+        master = jax.tree_util.tree_unflatten(treedef, [o[0] for o in out])
+        mu = jax.tree_util.tree_unflatten(treedef, [o[1] for o in out])
+        nu = jax.tree_util.tree_unflatten(treedef, [o[2] for o in out])
         new_params = jax.tree.map(
             lambda m, p: gather_leaf(
                 m, p.shape, p.dtype, self.axis_name,
                 transport_dtype=(jnp.float8_e5m2 if self.e5m2_allgather
                                  else None)),
             master, params)
-        return new_params, DistLambState(count, master, mu, nu)
+        new_state = DistLambState(count, master, mu, nu)
+        if comm_state is not None:
+            return new_params, new_state, new_comm
+        return new_params, new_state

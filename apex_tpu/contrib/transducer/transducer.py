@@ -63,7 +63,12 @@ def transducer_joint(f, g, f_len=None, g_len=None, *, relu: bool = False,
                 "(= cumsum(f_len * g_len)) and a static packed_batch")
         b, local, valid = _packed_cell_coords(
             batch_offset, f_len * g_len, packed_batch)
-        t, u = local // g_len[b], local % g_len[b]
+        # surplus rows (r >= batch_offset[-1]) clamp b to the LAST batch;
+        # if that batch has g_len == 0 the // and % would divide by zero
+        # (backend-defined result, and only masked after the fact) — use a
+        # safe divisor; the valid multiply zeroes those rows regardless
+        g_safe = jnp.maximum(g_len[b], 1)
+        t, u = local // g_safe, local % g_safe
         out = f[b, t] + g[b, u]  # (packed_batch, H)
         if relu:
             out = jax.nn.relu(out)

@@ -29,6 +29,12 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from apex_tpu.comm.collectives import (
+    CompressionConfig,
+    compressed_allreduce,
+    fold_seed,
+)
+from apex_tpu.comm.error_feedback import init_error_feedback
 from apex_tpu.parallel.mesh import DP_AXIS
 
 
@@ -52,6 +58,14 @@ def _flatten_buckets(leaves: List[jnp.ndarray], message_size: int):
     return buckets
 
 
+def _rebuild(comm_state, new_leaves):
+    """Re-hang updated residual leaves on the comm_state structure."""
+    if comm_state is None or new_leaves is None:
+        return comm_state
+    treedef = jax.tree_util.tree_structure(comm_state)
+    return jax.tree_util.tree_unflatten(treedef, new_leaves)
+
+
 class DistributedDataParallel:
     """Functional DDP: ``grads = ddp.average_gradients(grads)`` inside the
     mesh program (shard_map/pjit body). Mirrors the reference constructor
@@ -66,6 +80,7 @@ class DistributedDataParallel:
         gradient_predivide_factor: float = 1.0,
         allreduce_always_fp32: bool = False,
         flat_buckets: bool = True,
+        compression: Optional[CompressionConfig] = None,
     ):
         self.axis = axis
         self.message_size = message_size
@@ -73,10 +88,20 @@ class DistributedDataParallel:
         self.gradient_predivide_factor = gradient_predivide_factor
         self.allreduce_always_fp32 = allreduce_always_fp32
         self.flat_buckets = flat_buckets
+        self.compression = compression
 
     def _world(self):
         # inside a mesh program the axis size is static
         return lax.axis_size(self.axis)
+
+    def init_comm_state(self, grads_template: Any) -> Optional[Any]:
+        """Error-feedback residuals for ``compression='int8_ef'`` — one fp32
+        leaf per grad leaf, carried through the step like the loss-scaler
+        state and threaded back into :meth:`average_gradients` via
+        ``comm_state``. ``None`` for policies with no step-to-step state."""
+        if self.compression is not None and self.compression.error_feedback:
+            return init_error_feedback(grads_template)
+        return None
 
     def replicate(self, params: Any) -> Any:
         """Mark params as per-replica (device-varying) inside the mesh
@@ -93,7 +118,8 @@ class DistributedDataParallel:
             lambda p: lax.pcast(p, self.axis, to="varying"), params
         )
 
-    def average_gradients(self, grads: Any, enabled: bool = True) -> Any:
+    def average_gradients(self, grads: Any, enabled: bool = True,
+                          comm_state: Optional[Any] = None, seed=None) -> Any:
         """The allreduce_bucket pipeline (ref ``distributed.py:425-470``):
         [flatten] → [fp32 cast] → predivide → psum → postdivide → unflatten.
         Must be called inside a mesh program with ``self.axis`` bound.
@@ -105,15 +131,44 @@ class DistributedDataParallel:
         trace two specializations (``enabled=False`` for accumulation
         microbatches, ``enabled=True`` for the boundary step) or accumulate
         on device and allreduce once — see
-        ``pipeline_parallel/schedules/fwd_bwd_no_pipelining.py``."""
+        ``pipeline_parallel/schedules/fwd_bwd_no_pipelining.py``.
+
+        With a :class:`~apex_tpu.comm.CompressionConfig` the psum is the
+        quantized two-pass allreduce (``comm/collectives.py``) — int8 codes
+        + fp32 block scales on the wire. Policy ``int8_ef`` additionally
+        threads the error-feedback residual: pass ``comm_state`` (from
+        :meth:`init_comm_state`) and the return becomes ``(grads,
+        new_comm_state)``; the residual lives in the same predivided units
+        the wire carries, so ``gradient_predivide_factor`` composes. Under
+        AMP those units include the loss scale: non-finite compression
+        errors (overflow steps) are dropped rather than carried, and a
+        dynamic-scale change mis-scales one step's correction by the
+        ratio before EF re-absorbs it (the ZeRO optimizers, which see the
+        scale, carry their residual unscaled instead).
+        ``seed``: int32 scalar for ``stochastic_rounding`` (fold the step
+        count in for fresh streams). Compressed results come off a final
+        all-gather — replicated by construction, so programs that assert
+        value-movement types need ``check_vma=False`` (the pattern
+        ``tests/test_distributed_optimizers.py`` already uses for the ZeRO
+        all-gathers).
+        """
         if not isinstance(enabled, bool):
             raise TypeError(
                 f"enabled must be a static python bool, got {enabled!r}")
+        cfg = self.compression
+        compressing = cfg is not None and cfg.enabled
+        if compressing and cfg.error_feedback and comm_state is None:
+            raise ValueError(
+                "compression policy 'int8_ef' carries state: pass comm_state="
+                "ddp.init_comm_state(grads) and thread the returned state")
+        # uniform calling convention: tuple back iff state was passed in
+        wrap = (lambda g, s: (g, s)) if comm_state is not None else (
+            lambda g, s: g)
         if not enabled:
-            return grads
+            return wrap(grads, comm_state)
         leaves, treedef = jax.tree_util.tree_flatten(grads)
         if not leaves:
-            return grads
+            return wrap(grads, comm_state)
         world = self._world()
 
         # Predivide is applied unconditionally before the allreduce — it is
@@ -122,31 +177,67 @@ class DistributedDataParallel:
         pre = 1.0 / self.gradient_predivide_factor
         post = self.gradient_predivide_factor / world if self.gradient_average else 1.0
 
-        def _reduce_flat(flat):
-            comm = flat.astype(jnp.float32) if self.allreduce_always_fp32 else flat
-            if pre != 1.0:
-                comm = comm * pre
-            comm = lax.psum(comm, self.axis)
+        res_leaves = (jax.tree_util.tree_flatten(comm_state)[0]
+                      if comm_state is not None else None)
+        new_res = list(res_leaves) if res_leaves is not None else None
+
+        def _reduce_flat(flat, residual=None, bucket_seed=None):
+            """-> (reduced flat, new residual or None)"""
+            if compressing:
+                comm = flat.astype(jnp.float32)
+                if pre != 1.0:
+                    comm = comm * pre
+                comm, residual = compressed_allreduce(
+                    comm, self.axis, cfg, residual=residual,
+                    seed=bucket_seed)
+            else:
+                comm = (flat.astype(jnp.float32)
+                        if self.allreduce_always_fp32 else flat)
+                if pre != 1.0:
+                    comm = comm * pre
+                comm = lax.psum(comm, self.axis)
             if post != 1.0:
                 comm = comm * post
-            return comm
+            return comm, residual
+
+        def _bucket_seed(i):
+            # hash-combined, not seed+i: a step-counter seed must not make
+            # bucket i at step s replay bucket i+1 at step s-1
+            return None if seed is None else fold_seed(seed, i)
 
         if not self.flat_buckets:
-            out = [ _reduce_flat(g).astype(g.dtype) for g in leaves ]
-            return jax.tree_util.tree_unflatten(treedef, out)
+            out = [None] * len(leaves)
+            for i, g in enumerate(leaves):
+                r = res_leaves[i].reshape(-1) if res_leaves is not None \
+                    else None
+                red, r_new = _reduce_flat(g.reshape(-1), r, _bucket_seed(i))
+                out[i] = red.reshape(g.shape).astype(g.dtype)
+                if new_res is not None and r_new is not None:
+                    new_res[i] = r_new.reshape(res_leaves[i].shape)
+            return wrap(jax.tree_util.tree_unflatten(treedef, out),
+                        _rebuild(comm_state, new_res))
 
         out = [None] * len(leaves)
-        for dt, idxs in _flatten_buckets(leaves, self.message_size):
+        for bi, (dt, idxs) in enumerate(
+                _flatten_buckets(leaves, self.message_size)):
             flat = jnp.concatenate([leaves[i].reshape(-1) for i in idxs])
-            red = _reduce_flat(flat)
+            residual = None
+            if res_leaves is not None:
+                residual = jnp.concatenate(
+                    [res_leaves[i].reshape(-1) for i in idxs])
+            red, r_new = _reduce_flat(flat, residual, _bucket_seed(bi))
             offset = 0
             for i in idxs:
                 n = leaves[i].size
                 out[i] = red[offset : offset + n].reshape(leaves[i].shape).astype(
                     leaves[i].dtype
                 )
+                if new_res is not None and r_new is not None:
+                    new_res[i] = r_new[offset : offset + n].reshape(
+                        res_leaves[i].shape)
                 offset += n
-        return jax.tree_util.tree_unflatten(treedef, out)
+        return wrap(jax.tree_util.tree_unflatten(treedef, out),
+                    _rebuild(comm_state, new_res))
 
     def broadcast_params(self, params: Any) -> Any:
         """Make all ranks along the axis agree on rank-0's values (ref param

@@ -37,6 +37,7 @@ import jax.numpy as jnp
 from jax.ad_checkpoint import checkpoint_name
 from jax import lax
 
+from apex_tpu.comm.collectives import fold_seed
 from apex_tpu.ops.attention import (
     NEG_INF,
     _fa_bwd,
@@ -604,9 +605,12 @@ def ulysses_attention(
     seed = dropout_seed
     if dropout_rate > 0.0:
         # decorrelate the per-rank head slices (local bh indices repeat
-        # on every rank; an unfolded seed would reuse one mask per slot)
-        seed = (jnp.asarray(dropout_seed, jnp.int32).reshape(())
-                + jnp.int32(0x9E37) * lax.axis_index(axis_name))
+        # on every rank; an unfolded seed would reuse one mask per slot).
+        # The fold must be NON-linear: a linear ``seed + C*rank`` aliases —
+        # two runs whose seeds differ by a multiple of C replay another
+        # rank's mask stream. fold_seed's full-avalanche fmix32 combine
+        # makes stream collisions require an exact 32-bit hash collision.
+        seed = fold_seed(dropout_seed, lax.axis_index(axis_name))
     o = flash_attention(to_heads(q), to_heads(k), to_heads(v),
                         causal=causal, scale=scale, use_pallas=use_pallas,
                         dropout_rate=dropout_rate, dropout_seed=seed)

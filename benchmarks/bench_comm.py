@@ -1,0 +1,133 @@
+"""Compressed-collective benchmark: bytes/step and step time, none vs int8
+vs int8+ef, on the 8-chip (CPU-sim) dp mesh.
+
+Emits ONE JSON line per policy plus a headline summary line — the bench.py
+protocol. Bytes come from the compiled HLO via ``apex_tpu.comm.accounting``
+(the same pricer the tier-1 wire-byte test asserts with); times are
+measured, but on the CPU simulator collectives are memcpys, so the honest
+headline here is the byte ratio — the time column becomes meaningful on a
+real multi-chip slice where ICI is the bottleneck this subsystem attacks.
+
+Run: ``python benchmarks/bench_comm.py`` (tier-1 box, no TPU needed).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from apex_tpu.utils.platform import pin_cpu_platform
+
+pin_cpu_platform(virtual_devices=8)
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.comm import CompressionConfig, collective_report
+from apex_tpu.parallel import DistributedDataParallel
+from apex_tpu.parallel.mesh import build_mesh
+
+# a GPT-2-124M-sized gradient set, as a few flat leaves (the bucketed DDP
+# path concatenates them anyway); ~124M fp32 elements would swamp the CPU
+# sim, so scale 1:16 and report bytes exactly, time as measured
+LEAVES = {
+    "embed": (768 * 3264,),
+    "blocks": (12, 768 * 590),
+    "head": (768,),
+}
+STEPS = 10
+
+POLICIES = {
+    "none": None,
+    "int8": CompressionConfig(policy="int8"),
+    "int8_ef": CompressionConfig(policy="int8_ef"),
+}
+
+
+def build(policy_name):
+    mesh = build_mesh(tp=1, pp=1, sp=1)  # dp=8
+    cfg = POLICIES[policy_name]
+    ddp = DistributedDataParallel(compression=cfg,
+                                  allreduce_always_fp32=True)
+    grads = {
+        k: jax.random.normal(jax.random.fold_in(jax.random.PRNGKey(0),
+                                                i), shape)
+        for i, (k, shape) in enumerate(LEAVES.items())
+    }
+    ef = ddp.init_comm_state(grads)
+
+    if ef is None:
+        def body(g):
+            return ddp.average_gradients(g)
+
+        step = jax.jit(jax.shard_map(
+            body, mesh=mesh, in_specs=P(), out_specs=P(),
+            check_vma=False))
+        compiled = step.lower(grads).compile()
+        return compiled, grads, None
+
+    def body(g, r):
+        r = jax.tree_util.tree_map(lambda x: x[0], r)
+        out, r = ddp.average_gradients(g, comm_state=r)
+        return out, jax.tree_util.tree_map(lambda x: x[None], r)
+
+    step = jax.jit(jax.shard_map(
+        body, mesh=mesh, in_specs=(P(), P("dp")),
+        out_specs=(P(), P("dp")), check_vma=False))
+    residual = jax.tree_util.tree_map(
+        lambda g: jnp.zeros((8,) + g.shape, jnp.float32), grads)
+    compiled = step.lower(grads, residual).compile()
+    return compiled, grads, residual
+
+
+def run(policy_name):
+    compiled, grads, residual = build(policy_name)
+    rep = collective_report(compiled)
+    args = (grads,) if residual is None else (grads, residual)
+    out = compiled(*args)  # warmup
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        out = compiled(*args)
+    # async-dispatch fence: host-read one scalar of the last step
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    float(jnp.sum(leaf[..., :1]))
+    dt = (time.perf_counter() - t0) / STEPS
+    n_elems = sum(math.prod(s) for s in LEAVES.values())
+    return {
+        "policy": policy_name,
+        "grad_elements": n_elems,
+        "wire_bytes_per_step": round(rep.wire_bytes),
+        "collective_counts": {k: v for k, v in rep.counts.items() if v},
+        "step_time_ms": round(dt * 1e3, 3),
+    }
+
+
+def main():
+    rows = {}
+    for name in POLICIES:
+        r = run(name)
+        rows[name] = r
+        print(json.dumps(r), flush=True)
+    ratio8 = rows["none"]["wire_bytes_per_step"] / max(
+        rows["int8"]["wire_bytes_per_step"], 1)
+    ratio_ef = rows["none"]["wire_bytes_per_step"] / max(
+        rows["int8_ef"]["wire_bytes_per_step"], 1)
+    print(json.dumps({
+        "name": "comm_compression_wire_reduction",
+        "metric": "fp32_bytes / int8_bytes",
+        "int8": round(ratio8, 2),
+        "int8_ef": round(ratio_ef, 2),
+        "backend": jax.default_backend(),
+    }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

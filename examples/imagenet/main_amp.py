@@ -183,12 +183,30 @@ def train(args) -> List[float]:
     return _run_loop(args, step, amp_state, opt_state, batch_stats)
 
 
+def _state_fingerprint(state) -> str:
+    """Structure fingerprint: treedef + per-leaf shape/dtype. Leaves are
+    checkpointed by flat positional index and re-hung on the LIVE treedef,
+    so a same-leaf-count checkpoint from another code revision would
+    otherwise silently mis-bind optimizer/amp/BN state. Shape/dtype come
+    from the avals — no device-to-host copies."""
+    leaves, treedef = jax.tree.flatten(state)
+    per_leaf = ";".join(
+        f"{tuple(jnp.shape(x))}:{jnp.result_type(x)}" for x in leaves)
+    return f"{treedef}|{per_leaf}"
+
+
 def _save_state(args, state, it: int) -> None:
+    import numpy as np
+
     from apex_tpu.utils.checkpoint import save_checkpoint
 
+    # the fingerprint rides as a uint8 array: both checkpoint backends
+    # (orbax, pickle) round-trip arrays; strings only survive one of them
+    fp = np.frombuffer(_state_fingerprint(state).encode(), dtype=np.uint8)
     blob = {"leaves": {str(i): leaf
                        for i, leaf in enumerate(jax.tree.leaves(state))},
-            "it": jnp.asarray(it)}
+            "it": jnp.asarray(it),
+            "fingerprint": fp}
     p = save_checkpoint(os.path.join(args.checkpoint_dir, "ckpt"), blob,
                         step=it)
     print(f"=> saved checkpoint '{p}' (iter {it})")
@@ -205,6 +223,19 @@ def _run_loop(args, step, amp_state, opt_state, batch_stats) -> List[float]:
         from apex_tpu.utils.checkpoint import load_checkpoint
 
         blob = load_checkpoint(args.resume)
+        if "fingerprint" in blob:
+            import numpy as np
+
+            saved = bytes(np.asarray(blob["fingerprint"],
+                                     np.uint8)).decode()
+            live = _state_fingerprint(state)
+            if saved != live:
+                raise SystemExit(
+                    f"=> checkpoint '{args.resume}' was written by a "
+                    "different train-state revision — refusing to "
+                    "mis-bind state.\n"
+                    f"   saved: {saved[:200]}...\n"
+                    f"   live:  {live[:200]}...")
         n = len(jax.tree.leaves(state))
         leaves = [jnp.asarray(blob["leaves"][str(i)]) for i in range(n)]
         state = jax.tree.unflatten(jax.tree.structure(state), leaves)

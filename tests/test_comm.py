@@ -1,0 +1,194 @@
+"""apex_tpu.comm unit tests — the int8 codec, the EF state round-trip and
+the bytes-on-wire accounting, all mesh-free (the collective-level tests
+live in tests/test_comm_mesh.py; the wire-byte regression gate in
+tests/test_collective_counts.py)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.comm import (
+    CompressionConfig,
+    collective_report,
+    dequantize_blockwise,
+    init_error_feedback,
+    quantization_error,
+    quantize_blockwise,
+)
+from apex_tpu.comm import error_feedback as ef
+
+
+# ---------------------------------------------------------------------------
+# codec
+
+def test_quantize_roundtrip_half_step_bound():
+    """|x - dq(q(x))| <= scale/2 per element, scale = block absmax/127."""
+    x = jax.random.normal(jax.random.PRNGKey(0), (4096,))
+    q, s = quantize_blockwise(x, 256)
+    assert q.dtype == jnp.int8 and s.dtype == jnp.float32
+    assert q.shape == (4096,) and s.shape == (16,)
+    y = dequantize_blockwise(q, s, 256)
+    err = np.abs(np.asarray(y) - np.asarray(x)).reshape(16, 256)
+    step = np.abs(np.asarray(x)).reshape(16, 256).max(1) / 127.0
+    assert (err <= step[:, None] * 0.5 + 1e-6).all()
+
+
+def test_quantize_zero_block():
+    """All-zero blocks must quantize to zero codes with a finite scale."""
+    x = jnp.zeros((512,))
+    q, s = quantize_blockwise(x, 256)
+    assert np.all(np.asarray(q) == 0)
+    assert np.all(np.isfinite(np.asarray(s)))
+    np.testing.assert_array_equal(
+        np.asarray(dequantize_blockwise(q, s, 256)), 0.0)
+
+
+def test_quantize_per_block_scales_isolate_outliers():
+    """A huge element in one block must not destroy resolution elsewhere —
+    the point of BLOCKWISE scales vs one per-tensor scale."""
+    x = np.random.RandomState(0).normal(size=1024).astype(np.float32)
+    x[0] = 1e4
+    y = np.asarray(dequantize_blockwise(
+        *quantize_blockwise(jnp.asarray(x), 256), 256))
+    # the outlier's own block is coarse; the other blocks stay fine-grained
+    assert np.abs(y[256:] - x[256:]).max() < np.abs(x[256:]).max() / 100.0
+
+
+def test_quantize_validates():
+    with pytest.raises(ValueError):
+        quantize_blockwise(jnp.zeros((100,)), 256)  # not a block multiple
+    with pytest.raises(ValueError):
+        quantize_blockwise(jnp.zeros((4, 64)), 64)  # not flat
+    with pytest.raises(ValueError):
+        quantize_blockwise(jnp.zeros((256,)), 256, stochastic=True)  # no seed
+    with pytest.raises(ValueError):
+        # pallas path needs lane-aligned blocks
+        quantize_blockwise(jnp.zeros((256,)), 64, use_pallas=True)
+
+
+def test_stochastic_rounding_unbiased_and_seeded():
+    x = jnp.full((256,), 0.3)
+    outs = []
+    for seed in range(64):
+        q, s = quantize_blockwise(x, 256, stochastic=True, seed=seed)
+        outs.append(np.asarray(dequantize_blockwise(q, s, 256)))
+    m = float(np.mean(outs))
+    assert abs(m - 0.3) < 0.005, m  # unbiased across seeds
+    q1, _ = quantize_blockwise(x, 256, stochastic=True, seed=11)
+    q2, _ = quantize_blockwise(x, 256, stochastic=True, seed=11)
+    np.testing.assert_array_equal(np.asarray(q1), np.asarray(q2))
+
+
+def test_pallas_interpret_matches_reference():
+    """The kernel and the XLA path are the same codec (codes equal up to
+    the 1-ulp scale difference of reassociated maxes)."""
+    x = jax.random.normal(jax.random.PRNGKey(1), (32 * 128,))
+    q_ref, s_ref = quantize_blockwise(x, 128)
+    q_pl, s_pl = quantize_blockwise(x, 128, use_pallas=True)
+    np.testing.assert_allclose(np.asarray(s_ref), np.asarray(s_pl),
+                               rtol=1e-6)
+    assert np.abs(np.asarray(q_ref, np.int32)
+                  - np.asarray(q_pl, np.int32)).max() <= 1
+    y_ref = dequantize_blockwise(q_pl, s_pl, 128)
+    y_pl = dequantize_blockwise(q_pl, s_pl, 128, use_pallas=True)
+    np.testing.assert_allclose(np.asarray(y_ref), np.asarray(y_pl),
+                               rtol=1e-6)
+
+
+def test_quantization_error_is_the_ef_residual():
+    x = jax.random.normal(jax.random.PRNGKey(2), (512,))
+    e = quantization_error(x, 256)
+    q, s = quantize_blockwise(x, 256)
+    want = np.asarray(x) - np.asarray(dequantize_blockwise(q, s, 256))
+    np.testing.assert_allclose(np.asarray(e), want, atol=1e-7)
+
+
+# ---------------------------------------------------------------------------
+# config
+
+def test_compression_config_validates():
+    with pytest.raises(ValueError):
+        CompressionConfig(policy="int4")
+    with pytest.raises(ValueError):
+        CompressionConfig(block_size=0)
+    cfg = CompressionConfig(policy="int8_ef", min_elements=100)
+    assert cfg.enabled and cfg.error_feedback
+    assert cfg.compresses(100) and not cfg.compresses(99)
+    assert not CompressionConfig(policy="none").enabled
+
+
+# ---------------------------------------------------------------------------
+# error-feedback state
+
+def test_error_feedback_state_dict_roundtrip():
+    grads = {"layer": {"w": jnp.ones((3, 4), jnp.bfloat16),
+                       "b": jnp.zeros((7,))},
+             "head": jnp.full((2,), 0.5)}
+    r = init_error_feedback(grads)
+    # residuals are fp32 regardless of grad dtype
+    assert all(x.dtype == jnp.float32 for x in jax.tree_util.tree_leaves(r))
+    r = jax.tree_util.tree_map(
+        lambda x: x + np.random.RandomState(0).normal(size=x.shape), r)
+    d = ef.state_dict(r)
+    r2 = ef.load_state_dict(init_error_feedback(grads), d)
+    for a, b in zip(jax.tree_util.tree_leaves(r),
+                    jax.tree_util.tree_leaves(r2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+
+
+def test_error_feedback_load_rejects_mismatch():
+    r = init_error_feedback({"a": jnp.zeros((4,)), "b": jnp.zeros((2,))})
+    d = ef.state_dict(r)
+    with pytest.raises(ValueError):  # different structure, same leaf count
+        ef.load_state_dict(
+            init_error_feedback({"a": jnp.zeros((4,)), "c": jnp.zeros((2,))}),
+            d)
+    with pytest.raises(ValueError):  # same structure, different shapes
+        bad = dict(d, treedef=None)
+        ef.load_state_dict(
+            init_error_feedback({"a": jnp.zeros((4,)), "b": jnp.zeros((3,))}),
+            bad)
+
+
+# ---------------------------------------------------------------------------
+# accounting — the HLO pricer itself (compiled-program integration is in
+# test_collective_counts.py, which needs the 8-device mesh)
+
+_HLO = """
+HloModule test
+  %all-reduce.1 = f32[1024]{0} all-reduce(f32[1024]{0} %p), replica_groups={{0,1,2,3,4,5,6,7}}, to_apply=%add
+  %ag = s8[4096]{0} all-gather(s8[512]{0} %q), replica_groups={{0,1,2,3,4,5,6,7}}, dimensions={0}
+  %a2a = (s8[128]{0}, s8[128]{0}, /*index=2*/s8[128]{0}, s8[128]{0}) all-to-all(s8[128]{0} %a, s8[128]{0} %b, s8[128]{0} %c, s8[128]{0} %d), replica_groups={{0,1,2,3}}
+  %rs = f32[64]{0} reduce-scatter(f32[512]{0} %x), replica_groups=[1,8]<=[8], dimensions={0}
+  %start = bf16[256]{0} all-reduce-start(bf16[256]{0} %y), replica_groups={{0,1}}
+  %done = bf16[256]{0} all-reduce-done(bf16[256]{0} %start)
+  %gte = s8[128]{0} get-tuple-element((s8[128]{0}, s8[128]{0}) %all-to-all.9), index=0
+  %cp = f32[32]{0} collective-permute(f32[32]{0} %z), source_target_pairs={{0,1}}
+"""
+
+
+def test_accounting_counts_and_prices():
+    rep = collective_report(_HLO)
+    assert rep.counts == {"all-reduce": 2, "all-gather": 1,
+                          "reduce-scatter": 1, "all-to-all": 1,
+                          "collective-permute": 1}
+    # all-reduce: 2*4096*(7/8) + 2*512*(1/2); gather: 4096*(7/8);
+    # a2a: 512*(3/4); rs: 256*7; permute: 128
+    assert rep.wire_bytes_by_kind["all-reduce"] == pytest.approx(
+        2 * 4096 * 7 / 8 + 2 * 512 * 1 / 2)
+    assert rep.wire_bytes_by_kind["all-gather"] == pytest.approx(
+        4096 * 7 / 8)
+    assert rep.wire_bytes_by_kind["all-to-all"] == pytest.approx(512 * 3 / 4)
+    assert rep.wire_bytes_by_kind["reduce-scatter"] == pytest.approx(256 * 7)
+    assert rep.wire_bytes_by_kind["collective-permute"] == pytest.approx(128)
+    assert rep.wire_bytes == pytest.approx(sum(
+        rep.wire_bytes_by_kind.values()))
+
+
+def test_accounting_single_device_groups_are_free():
+    rep = collective_report(
+        "%ar = f32[64]{0} all-reduce(f32[64]{0} %p), replica_groups={{0}}")
+    assert rep.counts["all-reduce"] == 1
+    assert rep.wire_bytes == 0.0
