@@ -26,6 +26,9 @@ L4.5   ``apex_tpu.comm``              — (north-star: compressed collectives,
 L5     ``apex_tpu.transformer``       ``apex/transformer`` (TP/PP runtime)
 L6     ``apex_tpu.contrib``           ``apex/contrib``
 L7     ``apex_tpu.profiler``          ``apex/pyprof``
+L7.5   ``apex_tpu.monitor``           — (north-star: unified in-graph
+                                      telemetry — metric pytrees, spans,
+                                      JSONL sink, MFU report)
 =====  =============================  ==========================================
 """
 
@@ -43,6 +46,7 @@ __all__ = [
     "fused_dense",
     "get_logger",
     "mlp",
+    "monitor",
     "normalization",
     "ops",
     "optimizers",

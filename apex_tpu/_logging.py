@@ -9,11 +9,15 @@ may drive many chips); mesh-coordinate identity only exists inside a mesh
 program, so the formatter shows process index / process count plus, when a
 global mesh has been initialized (see ``apex_tpu.transformer.parallel_state``),
 the mesh axis sizes.
+
+Environment: ``APEX_TPU_LOG_LEVEL`` (e.g. ``DEBUG``, ``info``, ``30``) sets
+the level of each configured top-level logger at first-configure time.
 """
 
 from __future__ import annotations
 
 import logging
+import os
 import sys
 
 
@@ -47,16 +51,52 @@ _FORMAT = "%(asctime)s - %(name)s - %(levelname)s - [%(rank_info)s] - %(message)
 _configured_roots = set()
 
 
+def _has_rank_handler(logger: logging.Logger) -> bool:
+    """True if a rank-aware handler is already installed. Matched by class
+    NAME, not identity: a pytest/notebook re-import of this module creates a
+    fresh ``RankInfoFormatter`` class (and an empty ``_configured_roots``),
+    and an ``isinstance`` check against the new class would miss the old
+    module's handler — printing every record twice."""
+    return any(
+        type(h.formatter).__name__ == "RankInfoFormatter"
+        for h in logger.handlers
+        if h.formatter is not None
+    )
+
+
+def _env_level():
+    """``APEX_TPU_LOG_LEVEL`` parsed as a level name or number, else None."""
+    raw = os.environ.get("APEX_TPU_LOG_LEVEL", "").strip()
+    if not raw:
+        return None
+    if raw.isdigit():
+        return int(raw)
+    level = logging.getLevelName(raw.upper())
+    return level if isinstance(level, int) else None
+
+
 def get_logger(name: str = "apex_tpu") -> logging.Logger:
     """Return a rank-aware logger. The handler is installed once per top-level
-    logger hierarchy, so names outside ``apex_tpu.*`` get the rank prefix too."""
+    logger hierarchy, so names outside ``apex_tpu.*`` get the rank prefix too.
+
+    The returned logger carries a ``.metrics`` attribute — the
+    ``<name>.metrics`` child logger the monitor's :class:`JsonlSink` uses
+    for human-readable step lines — so telemetry text is filterable
+    (``logging.getLogger("apex_tpu.monitor.metrics").setLevel(...)``)
+    independently of the subsystem's own messages.
+    """
     logger = logging.getLogger(name)
     root_name = name.split(".", 1)[0]
     if root_name not in _configured_roots:
         root = logging.getLogger(root_name)
-        handler = logging.StreamHandler(sys.stderr)
-        handler.setFormatter(RankInfoFormatter(_FORMAT))
-        root.addHandler(handler)
+        if not _has_rank_handler(root):
+            handler = logging.StreamHandler(sys.stderr)
+            handler.setFormatter(RankInfoFormatter(_FORMAT))
+            root.addHandler(handler)
         root.propagate = False
+        level = _env_level()
+        if level is not None:
+            root.setLevel(level)
         _configured_roots.add(root_name)
+    logger.metrics = logging.getLogger(f"{name}.metrics")
     return logger

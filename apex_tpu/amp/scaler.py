@@ -162,6 +162,28 @@ class LossScaler:
             new_scale, new_unskipped.astype(jnp.int32),
             jnp.maximum(new_hyst, 0).astype(jnp.int32)), overflow
 
+    # -- telemetry --------------------------------------------------------
+    @staticmethod
+    def metrics(state: LossScalerState, found_inf: Optional[jnp.ndarray] = None,
+                metrics: Optional[Any] = None) -> Any:
+        """Record scaler telemetry into a :class:`apex_tpu.monitor.Metrics`
+        (in-graph, like everything else in this class): ``loss_scale``, the
+        per-step ``overflow`` flag, and — when the Metrics is threaded
+        through the step as a carry — cumulative ``overflow_total`` /
+        ``skipped_total`` counters (identical under the dynamic policy:
+        every overflow step is a skipped step). Pass ``metrics=None`` to
+        start a fresh pytree; pass last step's to keep the counters."""
+        from apex_tpu.monitor import Metrics  # lazy: amp has no hard dep
+
+        m = Metrics() if metrics is None else metrics
+        entries = {"loss_scale": state.loss_scale}
+        if found_inf is not None:
+            overflow = (jnp.asarray(found_inf) > 0).astype(jnp.float32)
+            entries["overflow"] = overflow
+            m = m.accumulate(overflow_total=overflow,
+                             skipped_total=overflow)
+        return m.record(**entries)
+
     # -- distributed ------------------------------------------------------
     @staticmethod
     def all_reduce_found_inf(

@@ -26,8 +26,11 @@ from apex_tpu.comm.accounting import (  # noqa: F401
 )
 from apex_tpu.comm.collectives import (  # noqa: F401
     CompressionConfig,
+    all_gather_wire_bytes,
+    allreduce_wire_bytes,
     compressed_allreduce,
     compressed_psum_scatter,
+    psum_scatter_wire_bytes,
 )
 from apex_tpu.comm.error_feedback import (  # noqa: F401
     init_error_feedback,
@@ -43,12 +46,15 @@ from apex_tpu.comm.quantize import (  # noqa: F401
 __all__ = [
     "CollectiveReport",
     "CompressionConfig",
+    "all_gather_wire_bytes",
+    "allreduce_wire_bytes",
     "collective_report",
     "compressed_allreduce",
     "compressed_psum_scatter",
     "dequantize_blockwise",
     "init_error_feedback",
     "load_state_dict",
+    "psum_scatter_wire_bytes",
     "quantization_error",
     "quantize_blockwise",
     "state_dict",

@@ -106,6 +106,60 @@ class CompressionConfig:
         return self.enabled and n >= self.min_elements
 
 
+def allreduce_wire_bytes(n: int, itemsize: int, world: int,
+                         config: Optional[CompressionConfig] = None) -> float:
+    """Modeled bytes-on-wire per device of ONE flat-buffer allreduce, under
+    the same ring model ``accounting.collective_report`` prices compiled
+    HLO with — so a producer (DDP) can report per-bucket bytes that agree
+    exactly with what the pricer reads off the program XLA emitted
+    (asserted by ``tests/test_monitor.py``).
+
+    Mirrors :func:`compressed_allreduce` op-for-op: uncompressed → one
+    ``all-reduce`` (``2·b·(W-1)/W``); compressed → two ``all-to-all`` +
+    two ``all-gather`` of the padded int8 codes and fp32 block scales
+    (``2·(n' + 4·n'/B)·(W-1)/W`` with ``n'`` the block·world-padded size).
+    Sub-``min_elements`` buffers ride the uncompressed fp32 path, exactly
+    as the collective does.
+    """
+    if world <= 1:
+        return 0.0
+    ring = (world - 1) / world
+    if config is None or not config.compresses(n):
+        if config is not None and config.enabled:
+            itemsize = 4  # small-buffer fallback psums in fp32
+        return 2.0 * n * itemsize * ring
+    size = padded_size(n, config.block_size * world)
+    per_pass = size + 4.0 * size / config.block_size  # int8 codes + scales
+    return 2.0 * per_pass * ring
+
+
+def psum_scatter_wire_bytes(n: int, itemsize: int, world: int,
+                            config: Optional[CompressionConfig] = None,
+                            shard_multiple: int = 1) -> float:
+    """Modeled wire bytes of one :func:`compressed_psum_scatter` (the ZeRO
+    gradient leg): the exchange pass alone. Uncompressed → one
+    ``reduce-scatter`` priced at shard-result bytes × ``(W-1)``; compressed
+    → one ``all-to-all`` pass of codes + scales."""
+    if world <= 1:
+        return 0.0
+    k = -(-n // world)
+    k = -(-k // shard_multiple) * shard_multiple
+    if config is None or not config.compresses(n):
+        if config is not None and config.enabled:
+            itemsize = 4
+        return float(k) * itemsize * (world - 1)
+    size = max(k * world, padded_size(n, config.block_size * world))
+    return (size + 4.0 * size / config.block_size) * (world - 1) / world
+
+
+def all_gather_wire_bytes(n: int, itemsize: int, world: int) -> float:
+    """Modeled wire bytes of one tiled all-gather whose RESULT has ``n``
+    elements (the ZeRO param broadcast leg): ``b·(W-1)/W``."""
+    if world <= 1:
+        return 0.0
+    return float(n) * itemsize * (world - 1) / world
+
+
 def _pad_to(flat, size: int):
     if flat.size == size:
         return flat

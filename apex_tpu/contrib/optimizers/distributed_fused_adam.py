@@ -48,13 +48,17 @@ def _shard_multiple(compression: Optional[CompressionConfig]) -> int:
 
 def _reduce_grad_leaf(g, axis_name, compression, residual, seed):
     """One leaf's grad reduce-scatter — quantized wire when configured.
-    Returns (fp32 summed shard, new residual or None)."""
-    if compression is not None and compression.enabled:
-        return compressed_psum_scatter(
-            g.reshape(-1).astype(jnp.float32), axis_name, compression,
-            residual=residual, seed=seed,
-            shard_multiple=compression.block_size)
-    return scatter_leaf(g.astype(jnp.float32), axis_name), residual
+    Returns (fp32 summed shard, new residual or None). Traced under the
+    ``comm`` monitor span (phase attribution in trace/pyprof reports)."""
+    from apex_tpu.monitor.trace import span
+
+    with span("comm"):
+        if compression is not None and compression.enabled:
+            return compressed_psum_scatter(
+                g.reshape(-1).astype(jnp.float32), axis_name, compression,
+                residual=residual, seed=seed,
+                shard_multiple=compression.block_size)
+        return scatter_leaf(g.astype(jnp.float32), axis_name), residual
 
 
 def _reduce_grads(grads, comm_state, axis_name, compression, seed,
@@ -87,6 +91,59 @@ def _reduce_grads(grads, comm_state, axis_name, compression, seed,
         return g_shards, None
     return g_shards, jax.tree_util.tree_unflatten(
         jax.tree_util.tree_structure(comm_state), new_res)
+
+
+def _local_sq(tree: Pytree) -> jnp.ndarray:
+    return sum((jnp.sum(jnp.square(x))
+                for x in jax.tree_util.tree_leaves(tree)),
+               jnp.float32(0.0))
+
+
+def _global_norm_shards(tree: Pytree, axis_name: str) -> jnp.ndarray:
+    """Global L2 norm of dp-sharded leaves: local shard sq-sum + one psum
+    (the reference's two-stage ``multi_tensor_l2norm`` + allreduce). Shared
+    by both ZeRO optimizers' clipping and metrics paths."""
+    return jnp.sqrt(lax.psum(_local_sq(tree), axis_name))
+
+
+def _record_zero_metrics(metrics, gnorm, master, old_master, grads,
+                         world: int, compression, e5m2_allgather: bool,
+                         axis_name: str):
+    """Shared Adam/LAMB metrics tail: shard norms + modeled comm bytes.
+    The param and update norms ride ONE stacked psum — scalar allreduces
+    are latency-bound on multi-host meshes, so the telemetry adds a single
+    extra collective, not two."""
+    delta = jax.tree_util.tree_map(lambda a, b: a - b, master, old_master)
+    both = jnp.sqrt(lax.psum(
+        jnp.stack([_local_sq(master), _local_sq(delta)]), axis_name))
+    return metrics.record(
+        grad_norm=gnorm,
+        param_norm=both[0],
+        update_norm=both[1],
+        comm_wire_bytes=_zero_wire_bytes(
+            grads, world, compression, e5m2_allgather=e5m2_allgather))
+
+
+def _zero_wire_bytes(grads, world: int,
+                     compression: Optional[CompressionConfig],
+                     e5m2_allgather: bool = False) -> float:
+    """Modeled bytes-on-wire of one ZeRO step (grad reduce-scatter + param
+    all-gather legs, ring model — same pricing ``comm.accounting`` reads
+    off compiled HLO). Static shapes only; free to record."""
+    from apex_tpu.comm.collectives import (
+        all_gather_wire_bytes,
+        psum_scatter_wire_bytes,
+    )
+    from apex_tpu.contrib.optimizers._sharding import shard_size
+
+    mult = _shard_multiple(compression)
+    gather_item = 1 if e5m2_allgather else 4
+    total = 0.0
+    for g in jax.tree_util.tree_leaves(grads):
+        total += psum_scatter_wire_bytes(g.size, 4, world, compression, mult)
+        k = shard_size(g.size, world, mult)
+        total += all_gather_wire_bytes(k * world, gather_item, world)
+    return total
 
 
 class DistAdamState(NamedTuple):
@@ -147,8 +204,7 @@ class DistributedFusedAdam:
         return None
 
     def _global_norm(self, shards) -> jnp.ndarray:
-        sq = sum(jnp.sum(jnp.square(g)) for g in jax.tree.leaves(shards))
-        return jnp.sqrt(lax.psum(sq, self.axis_name))
+        return _global_norm_shards(shards, self.axis_name)
 
     def step(
         self,
@@ -158,6 +214,7 @@ class DistributedFusedAdam:
         scale: Optional[jnp.ndarray] = None,
         comm_state: Optional[Pytree] = None,
         seed=None,
+        metrics: Optional[Any] = None,
     ) -> Tuple[Pytree, ...]:
         """reduce-scatter → (unscale, clip) → Adam on shards → all-gather.
 
@@ -169,6 +226,12 @@ class DistributedFusedAdam:
         stochastic-rounding seed for the compressed reduce-scatter; when
         ``comm_state`` is passed the return is ``(params, state,
         comm_state)``.
+        ``metrics``: an :class:`apex_tpu.monitor.Metrics` to record
+        shard-computed telemetry into — ``grad_norm`` (global, pre-clip),
+        ``param_norm``, ``update_norm`` (each a local shard sq-sum + one
+        psum: the reference's two-stage ``multi_tensor_l2norm``), plus the
+        modeled ``comm_wire_bytes`` of the scatter+gather legs. When
+        passed, the updated Metrics is appended to the return tuple.
         """
         if (self.compression is not None and self.compression.error_feedback
                 and comm_state is None):
@@ -185,8 +248,10 @@ class DistributedFusedAdam:
         g_shards = jax.tree.map(lambda g: g / world, g_shards)
         if scale is not None:
             g_shards = jax.tree.map(lambda g: g / scale, g_shards)
+        gnorm = (self._global_norm(g_shards)
+                 if self.max_grad_norm is not None or metrics is not None
+                 else None)
         if self.max_grad_norm is not None:
-            gnorm = self._global_norm(g_shards)
             clip = jnp.minimum(1.0, self.max_grad_norm / (gnorm + 1e-6))
             g_shards = jax.tree.map(lambda g: g * clip, g_shards)
 
@@ -216,12 +281,20 @@ class DistributedFusedAdam:
         mu = jax.tree_util.tree_unflatten(treedef, [o[1] for o in out])
         nu = jax.tree_util.tree_unflatten(treedef, [o[2] for o in out])
 
+        from apex_tpu.monitor.trace import span
+
         transport = jnp.float8_e5m2 if self.e5m2_allgather else None
-        new_params = jax.tree.map(
-            lambda m, p: gather_leaf(m, p.shape, p.dtype, self.axis_name,
-                                     transport_dtype=transport),
-            master, params)
+        with span("comm"):
+            new_params = jax.tree.map(
+                lambda m, p: gather_leaf(m, p.shape, p.dtype, self.axis_name,
+                                         transport_dtype=transport),
+                master, params)
         new_state = DistAdamState(count, master, mu, nu)
+        out: Tuple[Pytree, ...] = (new_params, new_state)
         if comm_state is not None:
-            return new_params, new_state, new_comm
-        return new_params, new_state
+            out += (new_comm,)
+        if metrics is not None:
+            out += (_record_zero_metrics(
+                metrics, gnorm, master, state.master, grads, world,
+                self.compression, self.e5m2_allgather, self.axis_name),)
+        return out

@@ -29,6 +29,7 @@ from apex_tpu.contrib.optimizers._sharding import (
     slice_leaf,
 )
 from apex_tpu.contrib.optimizers.distributed_fused_adam import (
+    _global_norm_shards,
     _reduce_grads,
     _shard_multiple,
 )
@@ -87,7 +88,11 @@ class DistributedFusedLAMB:
         scale: Optional[jnp.ndarray] = None,
         comm_state: Optional[Pytree] = None,
         seed=None,
+        metrics: Optional[Any] = None,
     ) -> Tuple[Pytree, ...]:
+        """See :meth:`DistributedFusedAdam.step` — same calling convention,
+        including the optional ``metrics`` (shard norms + modeled comm
+        bytes appended to the return tuple)."""
         if (self.compression is not None and self.compression.error_feedback
                 and comm_state is None):
             raise ValueError(
@@ -103,10 +108,11 @@ class DistributedFusedLAMB:
             g_shards = jax.tree.map(lambda g: g / world, g_shards)
         if scale is not None:
             g_shards = jax.tree.map(lambda g: g / scale, g_shards)
-        if self.max_grad_norm is not None:
+        gnorm = None
+        if self.max_grad_norm is not None or metrics is not None:
             # global grad norm over ALL shards (ref fused clip path)
-            sq = sum(jnp.sum(jnp.square(g)) for g in jax.tree.leaves(g_shards))
-            gnorm = jnp.sqrt(lax.psum(sq, self.axis_name))
+            gnorm = _global_norm_shards(g_shards, self.axis_name)
+        if self.max_grad_norm is not None:
             clip = jnp.minimum(1.0, self.max_grad_norm / (gnorm + 1e-6))
             g_shards = jax.tree.map(lambda g: g * clip, g_shards)
 
@@ -141,13 +147,25 @@ class DistributedFusedLAMB:
         master = jax.tree_util.tree_unflatten(treedef, [o[0] for o in out])
         mu = jax.tree_util.tree_unflatten(treedef, [o[1] for o in out])
         nu = jax.tree_util.tree_unflatten(treedef, [o[2] for o in out])
-        new_params = jax.tree.map(
-            lambda m, p: gather_leaf(
-                m, p.shape, p.dtype, self.axis_name,
-                transport_dtype=(jnp.float8_e5m2 if self.e5m2_allgather
-                                 else None)),
-            master, params)
+        from apex_tpu.monitor.trace import span
+
+        with span("comm"):
+            new_params = jax.tree.map(
+                lambda m, p: gather_leaf(
+                    m, p.shape, p.dtype, self.axis_name,
+                    transport_dtype=(jnp.float8_e5m2 if self.e5m2_allgather
+                                     else None)),
+                master, params)
         new_state = DistLambState(count, master, mu, nu)
+        out: Tuple[Pytree, ...] = (new_params, new_state)
         if comm_state is not None:
-            return new_params, new_state, new_comm
-        return new_params, new_state
+            out += (new_comm,)
+        if metrics is not None:
+            from apex_tpu.contrib.optimizers.distributed_fused_adam import (
+                _record_zero_metrics,
+            )
+
+            out += (_record_zero_metrics(
+                metrics, gnorm, master, state.master, grads, world,
+                self.compression, self.e5m2_allgather, self.axis_name),)
+        return out

@@ -1,0 +1,80 @@
+"""Unified in-graph training telemetry (L-monitor).
+
+Not in the reference: NVIDIA Apex observes training through three
+disconnected holes — ``pyprof`` NVTX/kernel joins, per-example ``print``
+logging, and whatever the trainer scripts hand-roll. This subsystem is the
+one layer that answers "what was the loss, grad norm, loss scale, comm
+volume, and MFU at step N" from a running job, with zero perturbation of
+the step:
+
+* :mod:`~apex_tpu.monitor.metrics` — :class:`Metrics`, a named-scalar
+  pytree threaded through the jitted train step like the loss-scaler state
+  (in-graph, donation-safe, zero extra compilations), plus
+  :func:`global_norm` / :func:`train_metrics` collectors. Producers wired
+  in: ``amp.LossScaler.metrics`` (scale + overflow/skip counters),
+  ``parallel.DistributedDataParallel.average_gradients(metrics=...)``
+  (per-bucket wire bytes + compression ratio),
+  ``contrib.optimizers.DistributedFused{Adam,LAMB}.step(metrics=...)``
+  (shard norms).
+* :mod:`~apex_tpu.monitor.trace` — :func:`span` named ranges
+  (``jax.named_scope`` + host ``TraceAnnotation``: one marker, visible in
+  the trace viewer and as pyprof layer paths) and :func:`step_annotation`
+  step grouping. The pipeline schedules emit ``pp_stage`` /
+  ``pp_ring_shift`` spans for bubble attribution.
+* :mod:`~apex_tpu.monitor.sink` — :class:`JsonlSink`, the process-0-gated,
+  versioned, buffered, crash-safe JSONL writer; :func:`json_record` is the
+  shared one-JSON-line convention every bench prints.
+* :mod:`~apex_tpu.monitor.report` — :func:`step_report`, the measured-time
+  × HLO-flops × bytes-on-wire join (MFU, ICI bandwidth, per-phase ms);
+  :func:`mfu_check` / :func:`hlo_stats` compile-only variants
+  (``benchmarks/profile_step.py`` and ``check_mfu_accounting.py`` are thin
+  wrappers over these).
+"""
+
+from apex_tpu.monitor.metrics import (  # noqa: F401
+    Metrics,
+    global_norm,
+    train_metrics,
+)
+from apex_tpu.monitor.report import (  # noqa: F401
+    format_step_report,
+    gpt_analytic_flops_per_token,
+    hlo_stats,
+    mfu_check,
+    phase_breakdown,
+    pipeline_bubble_fraction,
+    step_report,
+)
+from apex_tpu.monitor.sink import (  # noqa: F401
+    SCHEMA_VERSION,
+    JsonlSink,
+    json_record,
+    read_jsonl,
+)
+from apex_tpu.monitor.trace import (  # noqa: F401
+    PHASES,
+    span,
+    span_function,
+    step_annotation,
+)
+
+__all__ = [
+    "JsonlSink",
+    "Metrics",
+    "PHASES",
+    "SCHEMA_VERSION",
+    "format_step_report",
+    "global_norm",
+    "gpt_analytic_flops_per_token",
+    "hlo_stats",
+    "json_record",
+    "mfu_check",
+    "phase_breakdown",
+    "pipeline_bubble_fraction",
+    "read_jsonl",
+    "span",
+    "span_function",
+    "step_annotation",
+    "step_report",
+    "train_metrics",
+]

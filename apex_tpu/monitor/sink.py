@@ -1,0 +1,185 @@
+"""Process-0-gated JSONL sink — the durable end of the telemetry pipe.
+
+One record per train step, one JSON object per line, appended to a file.
+The format choices are all crash-shaped:
+
+* **versioned schema** — every record carries ``"schema": SCHEMA_VERSION``
+  so a reader of mixed-age logs can dispatch; bench scripts share the same
+  convention via :func:`json_record` (the one-JSON-line contract
+  ``bench.py`` / ``benchmarks/bench_comm.py`` print).
+* **buffered flush** — records buffer host-side and flush every
+  ``buffer_steps`` (or on ``close``/``__exit__``), so the sink never adds a
+  filesystem write to the step's critical path.
+* **crash-safe append** — the file is opened in append mode and every flush
+  writes whole ``\\n``-terminated lines; a crash can truncate at most the
+  final line, which :func:`read_jsonl` skips, and a restarted job reopens
+  the same path and appends (guarded by ``tests/test_monitor.py``).
+* **process-0 gating** — under multi-process (``jax.distributed``) only
+  process 0 writes; every other process's sink is a no-op, so the call
+  sites stay SPMD-uniform.
+
+Human-readable mirror: with ``log_every=N`` the sink also logs a one-line
+summary of every Nth record through the ``apex_tpu.monitor.metrics`` child
+logger (``get_logger("apex_tpu.monitor").metrics`` — rank-prefixed like all
+apex_tpu logs, see ``apex_tpu/_logging.py``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, Iterator, List, Optional
+
+SCHEMA_VERSION = 1
+
+
+def _is_process_zero() -> bool:
+    try:
+        import jax
+
+        return jax.process_index() == 0
+    except Exception:  # jax not initialized — single-process tooling
+        return True
+
+
+def json_record(**fields: Any) -> str:
+    """Render one schema-stamped JSON line (no trailing newline) — the
+    shared convention for sink records AND bench one-liners, so every
+    emitter in the repo is parseable by the same reader."""
+    rec: Dict[str, Any] = {"schema": SCHEMA_VERSION}
+    rec.update(fields)
+    return json.dumps(rec)
+
+
+class JsonlSink:
+    """Append-only JSONL metrics sink. Typical loop::
+
+        sink = JsonlSink("metrics.jsonl", log_every=100)
+        for step in range(n):
+            state, metrics = train_step(state, batch)   # Metrics pytree out
+            sink.write(step=step, metrics=metrics, **host_side_fields)
+        sink.close()                                    # or `with` block
+
+    ``metrics`` may be an :class:`apex_tpu.monitor.Metrics` (read out with
+    one device transfer) or a plain dict of floats; ``extra`` fields must be
+    JSON-serializable. ``fsync=True`` additionally fsyncs on every flush
+    (true crash-safety at the cost of an IO stall per flush).
+    """
+
+    def __init__(
+        self,
+        path: str,
+        buffer_steps: int = 16,
+        process0_only: bool = True,
+        fsync: bool = False,
+        log_every: int = 0,
+    ):
+        self.path = path
+        self.buffer_steps = max(1, int(buffer_steps))
+        self.fsync = fsync
+        self.log_every = int(log_every)
+        self.enabled = _is_process_zero() if process0_only else True
+        self._buf: List[str] = []
+        self._file = None
+        self._logger = None
+
+    # -- write path --------------------------------------------------------
+    def write(self, step: Optional[int] = None, metrics: Any = None,
+              **extra: Any) -> None:
+        """Buffer one record ``{schema, ts, step, **metrics, **extra}``."""
+        if not self.enabled:
+            return
+        fields: Dict[str, Any] = {"ts": round(time.time(), 3)}
+        if step is not None:
+            fields["step"] = int(step)
+        if metrics is not None:
+            vals = metrics.as_dict() if hasattr(metrics, "as_dict") \
+                else dict(metrics)
+            fields.update(vals)
+        fields.update(extra)
+        self._buf.append(json_record(**fields))
+        if self.log_every and step is not None and step % self.log_every == 0:
+            self._log_line(fields)
+        if len(self._buf) >= self.buffer_steps:
+            self.flush()
+
+    def flush(self) -> None:
+        """Write buffered records as whole lines and flush the OS buffer."""
+        if not self._buf:
+            return
+        if self._file is None:
+            d = os.path.dirname(os.path.abspath(self.path))
+            os.makedirs(d, exist_ok=True)
+            # append-after-crash: a previous writer may have died mid-line;
+            # terminate the partial record so new records start on a fresh
+            # line (readers skip the malformed fragment)
+            dangling = False
+            if os.path.exists(self.path) and os.path.getsize(self.path) > 0:
+                with open(self.path, "rb") as rf:
+                    rf.seek(-1, os.SEEK_END)
+                    dangling = rf.read(1) != b"\n"
+            self._file = open(self.path, "a")
+            if dangling:
+                self._file.write("\n")
+        self._file.write("".join(line + "\n" for line in self._buf))
+        self._buf.clear()
+        self._file.flush()
+        if self.fsync:
+            os.fsync(self._file.fileno())
+
+    def close(self) -> None:
+        self.flush()
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+
+    def __enter__(self) -> "JsonlSink":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- human-readable mirror ---------------------------------------------
+    def _log_line(self, fields: Dict[str, Any]) -> None:
+        if self._logger is None:
+            import logging
+
+            from apex_tpu._logging import get_logger
+
+            self._logger = get_logger("apex_tpu.monitor").metrics
+            # log_every is an explicit opt-in: raise only THIS child to
+            # INFO if the hierarchy's default (WARNING) would swallow the
+            # lines the caller just asked for
+            if not self._logger.isEnabledFor(logging.INFO):
+                self._logger.setLevel(logging.INFO)
+        parts = [f"step {fields.get('step', '?')}"]
+        for k, v in fields.items():
+            if k in ("schema", "ts", "step"):
+                continue
+            parts.append(f"{k}={v:.6g}" if isinstance(v, float) else
+                         f"{k}={v}")
+        self._logger.info(" ".join(parts))
+
+
+def read_jsonl(path: str, strict: bool = False) -> Iterator[Dict[str, Any]]:
+    """Yield records from a JSONL file, streaming (constant memory — the
+    file is one line per train step of a possibly very long run). Malformed
+    lines — the truncated final line of a crashed writer, or an interior
+    fragment such a writer left behind before a restart terminated it — are
+    skipped; pass ``strict=True`` to raise on any malformed INTERIOR line
+    instead (a trailing partial line is always tolerated: it is the
+    expected crash artifact, not corruption)."""
+    with open(path) as f:
+        for raw in f:
+            # a line still carrying its newline is complete wherever it
+            # sits; only a newline-less final read is a crash tail
+            interior = raw.endswith("\n")
+            line = raw.strip()
+            if not line:
+                continue
+            try:
+                yield json.loads(line)
+            except json.JSONDecodeError:
+                if strict and interior:
+                    raise

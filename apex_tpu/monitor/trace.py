@@ -1,0 +1,70 @@
+"""Named-span tracing — phases visible in the trace viewer AND the HLO.
+
+Reference: ``apex.pyprof.nvtx`` ranges / ad-hoc ``torch.cuda.nvtx`` in hot
+paths — host-side markers a profiler joins with kernel launches.
+
+TPU design: one :func:`span` plants BOTH kinds of marker at once:
+
+* ``jax.named_scope`` — attaches the name to every op traced inside, so it
+  rides the compiled HLO's op metadata and shows up as the layer path in
+  ``apex_tpu.pyprof.op_table`` / ``measured_op_table`` (and the XLA trace
+  viewer's per-op details). This is the marker that survives jit.
+* ``jax.profiler.TraceAnnotation`` — a host-side range for eager/dispatch
+  work, so un-jitted phases (data loading, checkpoint writes) show in the
+  trace viewer's host rows too.
+
+Canonical phase names are :data:`PHASES` (``fwd``/``bwd``/``comm``/``opt``)
+— using them makes ``monitor.report.phase_breakdown`` attribute step time
+per phase with no configuration — but any string works.
+
+:func:`step_annotation` wraps ``jax.profiler.StepTraceAnnotation`` so the
+trace viewer groups device activity by train step (the MLPerf-style
+step-time lane); use it host-side around each step call.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+from typing import Callable, Iterator, Optional
+
+import jax
+
+# canonical train-step phases; monitor.report.phase_breakdown groups by the
+# leading scope component, so spans named from this set roll up cleanly
+PHASES = ("fwd", "bwd", "comm", "opt")
+
+
+@contextlib.contextmanager
+def span(name: str) -> Iterator[None]:
+    """Named range: in-graph (``named_scope`` → HLO op metadata → pyprof
+    layer paths) and host-side (``TraceAnnotation`` → trace-viewer host
+    row). Nesting composes into ``outer/inner`` scope paths."""
+    with jax.named_scope(name), jax.profiler.TraceAnnotation(name):
+        yield
+
+
+def span_function(fn: Callable = None, *, name: Optional[str] = None):
+    """Decorator form of :func:`span` (ref ``nvtx/nvmarker.py`` function
+    wrapping): the function body traces under ``name`` (default: its
+    qualname)."""
+    if fn is None:
+        return functools.partial(span_function, name=name)
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        with span(name or fn.__qualname__):
+            return fn(*args, **kwargs)
+
+    return wrapped
+
+
+def step_annotation(step: int, name: str = "train_step"):
+    """Host-side step marker (``jax.profiler.StepTraceAnnotation``): device
+    activity dispatched inside is grouped under step ``step`` in the trace
+    viewer. Use around the step CALL (not inside the jitted body)::
+
+        with monitor.step_annotation(i):
+            state = train_step(state, batch)
+    """
+    return jax.profiler.StepTraceAnnotation(name, step_num=step)

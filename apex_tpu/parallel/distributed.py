@@ -31,6 +31,7 @@ from jax import lax
 
 from apex_tpu.comm.collectives import (
     CompressionConfig,
+    allreduce_wire_bytes,
     compressed_allreduce,
     fold_seed,
 )
@@ -64,6 +65,18 @@ def _rebuild(comm_state, new_leaves):
         return comm_state
     treedef = jax.tree_util.tree_structure(comm_state)
     return jax.tree_util.tree_unflatten(treedef, new_leaves)
+
+
+def _record_comm_metrics(metrics, bucket_bytes, baseline_bytes):
+    """Record per-bucket + total modeled wire bytes and the compression
+    ratio into a monitor ``Metrics`` (all trace-time constants)."""
+    total = float(sum(bucket_bytes))
+    base = float(sum(baseline_bytes))
+    entries = {f"comm_bucket{i}_bytes": b
+               for i, b in enumerate(bucket_bytes)}
+    entries["comm_wire_bytes"] = total
+    entries["comm_compression_ratio"] = base / total if total else 1.0
+    return metrics.record(**entries)
 
 
 class DistributedDataParallel:
@@ -119,7 +132,8 @@ class DistributedDataParallel:
         )
 
     def average_gradients(self, grads: Any, enabled: bool = True,
-                          comm_state: Optional[Any] = None, seed=None) -> Any:
+                          comm_state: Optional[Any] = None, seed=None,
+                          metrics: Optional[Any] = None) -> Any:
         """The allreduce_bucket pipeline (ref ``distributed.py:425-470``):
         [flatten] → [fp32 cast] → predivide → psum → postdivide → unflatten.
         Must be called inside a mesh program with ``self.axis`` bound.
@@ -151,6 +165,17 @@ class DistributedDataParallel:
         value-movement types need ``check_vma=False`` (the pattern
         ``tests/test_distributed_optimizers.py`` already uses for the ZeRO
         all-gathers).
+
+        ``metrics``: an :class:`apex_tpu.monitor.Metrics` to record comm
+        telemetry into — per-bucket modeled bytes-on-wire
+        (``comm_bucket{i}_bytes``, ring model, identical to what
+        ``comm.accounting`` prices off the compiled HLO), the
+        ``comm_wire_bytes`` total, and ``comm_compression_ratio``
+        (uncompressed-wire / actual-wire; 1.0 without compression). The
+        values are trace-time constants — recording them never adds device
+        work. When passed, the updated Metrics is appended to the return:
+        ``grads`` → ``(grads, metrics)``; ``(grads, comm_state)`` →
+        ``(grads, comm_state, metrics)``.
         """
         if not isinstance(enabled, bool):
             raise TypeError(
@@ -161,15 +186,35 @@ class DistributedDataParallel:
             raise ValueError(
                 "compression policy 'int8_ef' carries state: pass comm_state="
                 "ddp.init_comm_state(grads) and thread the returned state")
-        # uniform calling convention: tuple back iff state was passed in
-        wrap = (lambda g, s: (g, s)) if comm_state is not None else (
-            lambda g, s: g)
+        # per-bucket modeled (actual, uncompressed-baseline) wire bytes —
+        # python floats from static shapes, appended as buckets reduce
+        bucket_bytes: List[float] = []
+        baseline_bytes: List[float] = []
+
+        # uniform calling convention: state appended iff passed in, then
+        # metrics iff passed in
+        def wrap(g, s):
+            out = (g,)
+            if comm_state is not None:
+                out += (s,)
+            if metrics is not None:
+                out += (_record_comm_metrics(metrics, bucket_bytes,
+                                             baseline_bytes),)
+            return out[0] if len(out) == 1 else out
+
         if not enabled:
             return wrap(grads, comm_state)
         leaves, treedef = jax.tree_util.tree_flatten(grads)
         if not leaves:
             return wrap(grads, comm_state)
         world = self._world()
+
+        def _account(n: int, dtype) -> None:
+            base_item = 4 if self.allreduce_always_fp32 else dtype.itemsize
+            bucket_bytes.append(
+                allreduce_wire_bytes(n, base_item, world, cfg))
+            baseline_bytes.append(
+                allreduce_wire_bytes(n, base_item, world, None))
 
         # Predivide is applied unconditionally before the allreduce — it is
         # the fp16/bf16 overflow guard; only the post-multiply is gated on
@@ -182,22 +227,27 @@ class DistributedDataParallel:
         new_res = list(res_leaves) if res_leaves is not None else None
 
         def _reduce_flat(flat, residual=None, bucket_seed=None):
-            """-> (reduced flat, new residual or None)"""
-            if compressing:
-                comm = flat.astype(jnp.float32)
-                if pre != 1.0:
-                    comm = comm * pre
-                comm, residual = compressed_allreduce(
-                    comm, self.axis, cfg, residual=residual,
-                    seed=bucket_seed)
-            else:
-                comm = (flat.astype(jnp.float32)
-                        if self.allreduce_always_fp32 else flat)
-                if pre != 1.0:
-                    comm = comm * pre
-                comm = lax.psum(comm, self.axis)
-            if post != 1.0:
-                comm = comm * post
+            """-> (reduced flat, new residual or None). Traced under the
+            canonical ``comm`` monitor span so the allreduce shows up as
+            its own phase in trace/pyprof reports."""
+            from apex_tpu.monitor.trace import span
+
+            with span("comm"):
+                if compressing:
+                    comm = flat.astype(jnp.float32)
+                    if pre != 1.0:
+                        comm = comm * pre
+                    comm, residual = compressed_allreduce(
+                        comm, self.axis, cfg, residual=residual,
+                        seed=bucket_seed)
+                else:
+                    comm = (flat.astype(jnp.float32)
+                            if self.allreduce_always_fp32 else flat)
+                    if pre != 1.0:
+                        comm = comm * pre
+                    comm = lax.psum(comm, self.axis)
+                if post != 1.0:
+                    comm = comm * post
             return comm, residual
 
         def _bucket_seed(i):
@@ -210,6 +260,7 @@ class DistributedDataParallel:
             for i, g in enumerate(leaves):
                 r = res_leaves[i].reshape(-1) if res_leaves is not None \
                     else None
+                _account(g.size, g.dtype)
                 red, r_new = _reduce_flat(g.reshape(-1), r, _bucket_seed(i))
                 out[i] = red.reshape(g.shape).astype(g.dtype)
                 if new_res is not None and r_new is not None:
@@ -221,6 +272,7 @@ class DistributedDataParallel:
         for bi, (dt, idxs) in enumerate(
                 _flatten_buckets(leaves, self.message_size)):
             flat = jnp.concatenate([leaves[i].reshape(-1) for i in idxs])
+            _account(flat.size, flat.dtype)
             residual = None
             if res_leaves is not None:
                 residual = jnp.concatenate(

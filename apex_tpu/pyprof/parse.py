@@ -136,8 +136,14 @@ def measured_op_table(
     owns_dir = log_dir is None
     if owns_dir:
         log_dir = tempfile.mkdtemp(prefix="apex_tpu_prof_")
+    import time as _time
+
     jax.profiler.start_trace(log_dir)
     try:
+        # wall clock spans dispatch -> fence only (NOT the profiler
+        # start/stop, which writes trace files); per-op capture overhead
+        # stays included, so the number errs slightly pessimistic
+        t0 = _time.perf_counter()
         for _ in range(steps):
             out = jitted(*args, **kwargs)
         jax.block_until_ready(out)
@@ -147,6 +153,7 @@ def measured_op_table(
         leaves = jax.tree.leaves(out)
         if leaves:
             jax.device_get(leaves[0])
+        wall_ms = (_time.perf_counter() - t0) / steps * 1e3
     finally:
         jax.profiler.stop_trace()
 
@@ -227,7 +234,16 @@ def measured_op_table(
         "unattributed": unattributed,
         "coverage_pct": 100.0 * matched_us / total_us if total_us else 0.0,
         "total_ms_per_step": total_row_ms,
+        # host wall clock around the profiled loop (includes trace + async
+        # dispatch overhead): the honest step-time denominator when the
+        # trace join is partial — attributed time understates the step by
+        # 1/coverage, and an empty join leaves the 1.0ms sentinel above
+        "wall_ms_per_step": wall_ms,
         "log_dir": log_dir,
+        # the exact executable that was measured — downstream joins
+        # (monitor.report: wire-byte pricing, cost analysis) read it instead
+        # of paying a second lower+compile of the same program
+        "compiled": compiled,
     }
 
 

@@ -50,6 +50,7 @@ from jax import lax
 from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
+from apex_tpu.monitor.trace import span
 from apex_tpu.parallel.mesh import DP_AXIS, PP_AXIS
 from apex_tpu.transformer.pipeline_parallel.schedules.common import (
     _pvary,
@@ -175,31 +176,36 @@ def _enc_dec_body(
     enc_local = jax.tree.map(lambda a: a[0], params["enc_stages"])
     dec_local = jax.tree.map(lambda a: a[0], params["dec_stages"])
 
-    # Phase 1: encoder ring over all pp stages.
-    h_enc_mb = embed_microbatches(spec.enc_embed_fn, params["embed"],
-                                  enc_inputs_mb, keys_mb)
-    enc_out_mb = pipeline_ring(
-        spec.enc_stage_fn,
-        enc_local,
-        h_enc_mb,
-        num_microbatches=num_microbatches,
-        remat=remat,
-        extra_mb=keys_mb,
-    )
-    mem_mb = broadcast_from_last_stage(enc_out_mb)
+    # Phase 1: encoder ring over all pp stages. The monitor spans nest the
+    # ring's own pp_stage/pp_ring_shift ranges under a per-phase name, so
+    # trace/pyprof reports split enc vs dec vs memory-broadcast time.
+    with span("pp_encode"):
+        h_enc_mb = embed_microbatches(spec.enc_embed_fn, params["embed"],
+                                      enc_inputs_mb, keys_mb)
+        enc_out_mb = pipeline_ring(
+            spec.enc_stage_fn,
+            enc_local,
+            h_enc_mb,
+            num_microbatches=num_microbatches,
+            remat=remat,
+            extra_mb=keys_mb,
+        )
+    with span("pp_memory_broadcast"):
+        mem_mb = broadcast_from_last_stage(enc_out_mb)
 
     # Phase 2: decoder ring, cross-attending to the broadcast memory.
-    h_dec_mb = embed_microbatches(spec.dec_embed_fn, params["embed"],
-                                  dec_inputs_mb, keys_mb)
-    ys = decoder_ring(
-        spec.dec_stage_fn,
-        dec_local,
-        h_dec_mb,
-        mem_mb,
-        num_microbatches=num_microbatches,
-        remat=remat,
-        keys_mb=keys_mb,
-    )
+    with span("pp_decode"):
+        h_dec_mb = embed_microbatches(spec.dec_embed_fn, params["embed"],
+                                      dec_inputs_mb, keys_mb)
+        ys = decoder_ring(
+            spec.dec_stage_fn,
+            dec_local,
+            h_dec_mb,
+            mem_mb,
+            num_microbatches=num_microbatches,
+            remat=remat,
+            keys_mb=keys_mb,
+        )
     losses = jax.vmap(spec.loss_fn, in_axes=(None, 0, 0))(
         params["head"], ys, targets_mb
     )

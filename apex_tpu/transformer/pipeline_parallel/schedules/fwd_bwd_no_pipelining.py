@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from apex_tpu.monitor.trace import span
 from apex_tpu.transformer.pipeline_parallel.schedules.common import (
     derive_microbatch_keys,
     split_microbatches,
@@ -79,7 +80,9 @@ def forward_backward_no_pipelining(
     def body(acc, m_key):
         m, key = m_key
         loss_sum, grad_sum = acc
-        (_, loss), g = vg(params, m, key)
+        # monitor span: one per-microbatch fwd+bwd range in trace/pyprof
+        with span("fwd_bwd"):
+            (_, loss), g = vg(params, m, key)
         return (
             loss_sum + loss,
             jax.tree.map(jnp.add, grad_sum, g),

@@ -37,6 +37,7 @@ from jax import lax
 from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
+from apex_tpu.monitor.trace import span
 from apex_tpu.parallel.mesh import DP_AXIS, PP_AXIS
 from apex_tpu.transformer.pipeline_parallel.schedules.common import (
     PipelineSpec,
@@ -114,13 +115,19 @@ def pipeline_ring_interleaved(
         if keys_mb is not None:
             key_m = lax.dynamic_index_in_dim(keys_mb, m, 0, keepdims=False)
             args += (jax.random.fold_in(key_m, r),)
+        # monitor spans: stage compute vs ring p2p as distinct layer paths
+        # (same names as the non-interleaved schedule for uniform reports)
         if returns_aux:
-            out, aux = fn(*args)
+            with span("pp_stage"):
+                out, aux = fn(*args)
             valid = (t >= rank) & (t - rank <= work - 1)
             aux_sum = aux_sum + jnp.where(valid, aux, 0.0)
         else:
-            out = fn(*args)
-        return (_pvary_all(_ring_shift(out, axis_name), axes),
+            with span("pp_stage"):
+                out = fn(*args)
+        with span("pp_ring_shift"):
+            shifted = _ring_shift(out, axis_name)
+        return (_pvary_all(shifted, axes),
                 _pvary_all(aux_sum, axes)), out
 
     init = (

@@ -34,6 +34,7 @@ from jax import lax
 from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
+from apex_tpu.monitor.trace import span
 from apex_tpu.parallel.mesh import DP_AXIS, PP_AXIS
 from apex_tpu.transformer.pipeline_parallel.schedules.common import (
     PipelineSpec,
@@ -133,13 +134,21 @@ def pipeline_ring(
         if extra_mb is not None:
             # stage `rank` holds microbatch t-rank at tick t
             args += (_tree_index(extra_mb, jnp.clip(t - rank, 0, M - 1)),)
+        # monitor spans: per-tick stage compute vs ring p2p show up as
+        # distinct layer paths in the trace/measured tables — with the
+        # analytic bubble share from monitor.pipeline_bubble_fraction this
+        # is the schedule's bubble attribution
         if returns_aux:
-            out, aux = fn(*args)
+            with span("pp_stage"):
+                out, aux = fn(*args)
             valid = (t >= rank) & (t - rank <= M - 1)
             aux_sum = aux_sum + jnp.where(valid, aux, 0.0)
         else:
-            out = fn(*args)
-        return (_pvary_all(_ring_shift(out, axis_name), axes),
+            with span("pp_stage"):
+                out = fn(*args)
+        with span("pp_ring_shift"):
+            shifted = _ring_shift(out, axis_name)
+        return (_pvary_all(shifted, axes),
                 _pvary_all(aux_sum, axes)), out
 
     init = (

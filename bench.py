@@ -228,8 +228,13 @@ def main() -> None:
 
     def emit(tokens_per_s, batch, remat, policy, unroll, fused,
              provisional):
+        from apex_tpu.monitor import gpt_analytic_flops_per_token, json_record
+
         cfg = flagship_config(seq)
-        fpt = 6 * n_params + 6 * cfg.num_layers * cfg.hidden * seq
+        # the analytic constant is shared with monitor.report so
+        # check_mfu_accounting.py always validates the number divided here
+        fpt = gpt_analytic_flops_per_token(
+            n_params, cfg.num_layers, cfg.hidden, seq)
         mfu = tokens_per_s * fpt / PEAK_FLOPS.get(backend, 1e12)
         name = "gpt2_124m_bf16_train_tokens_per_sec_chip"
         if not on_tpu:
@@ -252,7 +257,7 @@ def main() -> None:
             banked = _read_banked_watch()
             if banked and "CPU_FALLBACK" not in banked.get("metric", ""):
                 rec["last_real_tpu"] = banked
-        line = json.dumps(rec)
+        line = json_record(**rec)
         if args.out:
             with open(args.out, "w") as f:
                 f.write(line + "\n")

@@ -13,7 +13,6 @@ Run: ``python benchmarks/bench_comm.py`` (tier-1 box, no TPU needed).
 
 from __future__ import annotations
 
-import json
 import math
 import os
 import sys
@@ -31,6 +30,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from apex_tpu.comm import CompressionConfig, collective_report
+from apex_tpu.monitor import json_record
 from apex_tpu.parallel import DistributedDataParallel
 from apex_tpu.parallel.mesh import build_mesh
 
@@ -114,18 +114,18 @@ def main():
     for name in POLICIES:
         r = run(name)
         rows[name] = r
-        print(json.dumps(r), flush=True)
+        print(json_record(**r), flush=True)
     ratio8 = rows["none"]["wire_bytes_per_step"] / max(
         rows["int8"]["wire_bytes_per_step"], 1)
     ratio_ef = rows["none"]["wire_bytes_per_step"] / max(
         rows["int8_ef"]["wire_bytes_per_step"], 1)
-    print(json.dumps({
-        "name": "comm_compression_wire_reduction",
-        "metric": "fp32_bytes / int8_bytes",
-        "int8": round(ratio8, 2),
-        "int8_ef": round(ratio_ef, 2),
-        "backend": jax.default_backend(),
-    }), flush=True)
+    print(json_record(
+        name="comm_compression_wire_reduction",
+        metric="fp32_bytes / int8_bytes",
+        int8=round(ratio8, 2),
+        int8_ef=round(ratio_ef, 2),
+        backend=jax.default_backend(),
+    ), flush=True)
     return 0
 
 

@@ -4,15 +4,19 @@ cost analysis (VERDICT r1 weak #9: the denominator was self-graded).
 Compiles the exact bench train step (remat OFF, so HLO flops = algorithmic
 flops with no recompute double-counting) at a reduced batch on the current
 backend and compares ``cost_analysis()['flops']`` with the analytic
-``6·N_params + 6·L·hidden·seq`` per-token model. Flops are linear in batch,
-so a small batch checks the same constant the bench divides by.
+``6·N_params + 6·L·hidden·seq`` per-token model — both sides now come from
+``apex_tpu.monitor.report`` (:func:`mfu_check` does the compile-side join,
+:func:`gpt_analytic_flops_per_token` is the same constant ``bench.py``
+divides by). Flops are linear in batch, so a small batch checks the same
+constant the bench divides by.
+
+Prints ONE schema-stamped JSON line (``monitor.sink.json_record``).
 
 Run: JAX_PLATFORMS=cpu python benchmarks/check_mfu_accounting.py
 """
 
 from __future__ import annotations
 
-import json
 import os
 import sys
 
@@ -28,6 +32,11 @@ BATCH, SEQ = 4, 1024
 
 def main() -> None:
     from bench import build_train_step, flagship_config
+    from apex_tpu.monitor import (
+        gpt_analytic_flops_per_token,
+        json_record,
+        mfu_check,
+    )
 
     # remat=False: no recompute double-counting. scan_unroll=num_layers:
     # XLA cost analysis counts a rolled scan body ONCE (a while loop has no
@@ -40,21 +49,21 @@ def main() -> None:
     cfg = dataclasses.replace(cfg, scan_unroll=cfg.num_layers)
     train_step, params, opt_state, tok, tgt = build_train_step(
         cfg, BATCH, SEQ)
-    compiled = train_step.lower(params, opt_state, tok, tgt).compile()
-    ca = compiled.cost_analysis()
-    hlo_flops = float(ca.get("flops", float("nan")))
-
     n_params = sum(x.size for x in jax.tree.leaves(params))
     tokens = BATCH * SEQ
-    analytic_per_token = 6 * n_params + 6 * cfg.num_layers * cfg.hidden * SEQ
-    analytic = analytic_per_token * tokens
-    print(json.dumps({
-        "metric": "mfu_denominator_check",
-        "hlo_flops": hlo_flops,
-        "analytic_flops": analytic,
-        "hlo_over_analytic": round(hlo_flops / analytic, 4),
-        "batch": BATCH, "seq": SEQ, "n_params": n_params,
-    }))
+    analytic = gpt_analytic_flops_per_token(
+        n_params, cfg.num_layers, cfg.hidden, SEQ) * tokens
+
+    res = mfu_check(train_step, params, opt_state, tok, tgt,
+                    analytic_flops=analytic)
+    print(json_record(
+        metric="mfu_denominator_check",
+        hlo_flops=res["hlo_flops"],
+        analytic_flops=analytic,
+        hlo_over_analytic=res["hlo_over_analytic"],
+        wire_bytes=res["wire_bytes"],
+        batch=BATCH, seq=SEQ, n_params=n_params,
+    ))
 
 
 if __name__ == "__main__":

@@ -1,9 +1,11 @@
 """Measured per-op profile of the flagship GPT train step.
 
 The round's MFU question — *which op eats the step time?* — answered by
-the measured-time join (``apex_tpu.pyprof.measured_op_table``): run the
-bench.py train step under ``jax.profiler``, join per-instruction measured
-time with HLO flops/bytes, print the table PERF.md quotes.
+``apex_tpu.monitor.report.step_report``: run the bench.py train step under
+``jax.profiler``, join per-instruction measured time with HLO flops/bytes
+AND bytes-on-wire, print the per-op table (stderr, human) plus ONE
+machine-parseable JSON line (stdout — the ``bench_comm.py`` convention,
+schema-stamped by ``monitor.sink.json_record``).
 
 Run: ``python benchmarks/profile_step.py [--steps N] [--top N]``.
 Uses the real TPU when the tunnel answers (full bench shape); otherwise
@@ -41,7 +43,12 @@ def main() -> int:
     on_tpu = backend == "tpu"
 
     import bench
-    from apex_tpu.pyprof import format_measured_table, measured_op_table
+    from apex_tpu.monitor import (
+        gpt_analytic_flops_per_token,
+        json_record,
+        step_report,
+    )
+    from apex_tpu.pyprof import format_measured_table
 
     batch, seq = (bench.BATCH, bench.SEQ) if on_tpu else (2, 128)
     # profile the lightest remat that fits: no-remat (the MFU operating
@@ -77,14 +84,27 @@ def main() -> int:
         raise RuntimeError(f"no profiling config fit: {last}")
 
     peak = bench.PEAK_FLOPS.get(backend, 1e12)
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    flops_step = gpt_analytic_flops_per_token(
+        n_params, cfg.num_layers, cfg.hidden, seq) * batch * seq
     header = (f"flagship GPT step profile | backend={backend}"
               f"{'' if on_tpu else ' (CPU_FALLBACK)'} | batch={batch} "
               f"seq={seq} remat={args.remat}")
-    print(header)
-    res = measured_op_table(step, params, opt_state, tok, tgt,
-                            steps=args.steps, depth=args.depth,
-                            peak_flops=peak)
-    print(format_measured_table(res, top=args.top))
+    print(header, file=sys.stderr)
+    rep = step_report(step, params, opt_state, tok, tgt,
+                      steps=args.steps, depth=args.depth, peak_flops=peak,
+                      analytic_flops_per_step=flops_step)
+    # human table on stderr; the one-line contract owns stdout
+    print(format_measured_table(
+        {"rows": rep.pop("rows"), "unattributed": rep.pop("unattributed"),
+         "total_ms_per_step": rep["step_time_ms"],
+         "coverage_pct": rep["coverage_pct"]}, top=args.top),
+        file=sys.stderr, flush=True)
+    name = "gpt2_124m_step_profile"
+    if not on_tpu:
+        name += "_CPU_FALLBACK"
+    print(json_record(metric=name, batch=batch, seq=seq,
+                      remat=bool(args.remat), **rep), flush=True)
     return 0
 
 

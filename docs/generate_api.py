@@ -45,7 +45,10 @@ def public_symbols(mod):
                  and (inspect.isclass(obj) or inspect.isfunction(obj))]
     out = []
     for n in names:
-        obj = getattr(mod, n, None)
+        try:
+            obj = getattr(mod, n, None)
+        except Exception:  # lazy __getattr__ may raise ImportError, which
+            continue       # getattr's default does not suppress
         if obj is not None and (inspect.isclass(obj)
                                 or inspect.isfunction(obj)):
             out.append((n, obj))
@@ -62,8 +65,8 @@ def signature_of(obj) -> str:
 def render_module(modname: str) -> str | None:
     try:
         mod = importlib.import_module(modname)
-    except Exception as e:  # pragma: no cover — surfaced in the index
-        return f"# `{modname}`\n\nimport failed: `{type(e).__name__}: {e}`\n"
+    except Exception:  # unimportable here (e.g. newer-jax-only module on a
+        return None    # stock-jax box) — keep the existing page instead
     syms = public_symbols(mod)
     doc = inspect.getdoc(mod) or ""
     if not syms and not doc:
@@ -96,10 +99,33 @@ def render_module(modname: str) -> str | None:
     return "\n".join(lines) + "\n"
 
 
+def _first_prose_line(text: str) -> str:
+    for line in text.splitlines():
+        if line and not line.startswith("#") and not line.startswith("*"):
+            return line.strip()
+    return ""
+
+
+def _module_exists(modname: str) -> bool:
+    """Whether the module's source file exists, WITHOUT importing it (an
+    import may fail here precisely for the modules whose pages we keep).
+    Used to drop pages of renamed/deleted modules."""
+    rel = os.path.join(ROOT, *modname.split("."))
+    return (os.path.isfile(rel + ".py")
+            or os.path.isfile(os.path.join(rel, "__init__.py")))
+
+
 def main() -> None:
+    """Regenerate every page this interpreter can import; pages for modules
+    that fail to import here (e.g. mesh modules needing a newer jax than a
+    doc-building box carries) are left as previously generated, so a
+    degraded environment can still ADD pages without destroying the rest;
+    pages whose module source no longer exists (rename/delete) are removed.
+    The index is rebuilt from every page present."""
     os.makedirs(OUT, exist_ok=True)
     for f in os.listdir(OUT):
-        if f.endswith(".md"):
+        if (f.endswith(".md") and f != "index.md"
+                and not _module_exists(f[:-3])):
             os.remove(os.path.join(OUT, f))
     import apex_tpu
 
@@ -110,6 +136,15 @@ def main() -> None:
             continue
         modules.append(info.name)
 
+    rendered = 0
+    for modname in sorted(set(modules)):
+        text = render_module(modname)
+        if text is None:
+            continue
+        with open(os.path.join(OUT, f"{modname}.md"), "w") as f:
+            f.write(text)
+        rendered += 1
+
     index = ["# apex_tpu API reference", "",
              "Generated by `docs/generate_api.py` from the live docstrings "
              "(every entry cites its reference counterpart file:line where "
@@ -117,22 +152,16 @@ def main() -> None:
     for mod, page in REF_PAGE.items():
         index.append(f"- `{page}` → [`{mod}`]({mod}.md)")
     index += ["", "## Modules", ""]
-
-    for modname in sorted(modules):
-        text = render_module(modname)
-        if text is None:
-            continue
-        with open(os.path.join(OUT, f"{modname}.md"), "w") as f:
-            f.write(text)
-        first = ""
-        for line in text.splitlines():
-            if line and not line.startswith("#") and not line.startswith("*"):
-                first = line.strip()
-                break
+    pages = sorted(f for f in os.listdir(OUT)
+                   if f.endswith(".md") and f != "index.md")
+    for page in pages:
+        modname = page[:-3]
+        with open(os.path.join(OUT, page)) as f:
+            first = _first_prose_line(f.read())
         index.append(f"- [`{modname}`]({modname}.md) — {first}")
     with open(os.path.join(OUT, "index.md"), "w") as f:
         f.write("\n".join(index) + "\n")
-    print(f"wrote {len(index)} index entries to {OUT}")
+    print(f"re-rendered {rendered} pages; indexed {len(pages)} in {OUT}")
 
 
 if __name__ == "__main__":
