@@ -29,6 +29,10 @@ L7     ``apex_tpu.profiler``          ``apex/pyprof``
 L7.5   ``apex_tpu.monitor``           — (north-star: unified in-graph
                                       telemetry — metric pytrees, spans,
                                       JSONL sink, MFU report)
+L8     ``apex_tpu.resilience``        — (north-star: fault tolerance —
+                                      anomaly guard, atomic/async
+                                      checkpointing, preemption handling,
+                                      chaos harness)
 =====  =============================  ==========================================
 """
 
@@ -52,6 +56,7 @@ __all__ = [
     "optimizers",
     "parallel",
     "profiler",
+    "resilience",
     "transformer",
     "RankInfoFormatter",
 ]

@@ -204,8 +204,29 @@ class LossScaler:
         }
 
     def load_state_dict(self, d: dict) -> LossScalerState:
+        # A corrupt checkpoint must not resurrect a NaN/0/negative scale:
+        # scale_loss multiplies it into every loss, so one bad restore
+        # poisons every subsequent step with no overflow to catch it (the
+        # unscale by 1/NaN is NaN too — found_inf fires forever and the
+        # dynamic policy can never recover). Validate here, at the one
+        # place checkpoints re-enter the scaler.
+        import math
+
+        raw = float(d["loss_scale"])
+        if not math.isfinite(raw) or raw <= 0.0:
+            raise ValueError(
+                f"restored loss_scale {raw!r} is not a finite positive "
+                "number — the checkpoint's scaler state is corrupt; "
+                "re-initialize the scaler or resume from an older "
+                "checkpoint")
+        # and clamp into this scaler's configured bounds (a checkpoint
+        # written under different min/max settings stays usable). Static
+        # scalers keep the stored value — min/max only govern the dynamic
+        # adjustment policy.
+        scale = (min(max(raw, self.min_loss_scale), self.max_loss_scale)
+                 if self.dynamic else raw)
         return LossScalerState(
-            loss_scale=jnp.asarray(d["loss_scale"], jnp.float32),
+            loss_scale=jnp.asarray(scale, jnp.float32),
             unskipped=jnp.asarray(d["unskipped"], jnp.int32),
             # pre-hysteresis checkpoints: full credits (the configured value)
             hysteresis_left=jnp.asarray(

@@ -203,6 +203,26 @@ class DistributedFusedAdam:
             return init_error_feedback(params)
         return None
 
+    # -- checkpointing (the resilience manifest path) ----------------------
+    def state_dict(self, state: DistAdamState) -> dict:
+        """Sharded state (count + master/moment shards) → flat
+        fingerprinted dict. The fingerprint pins the treedef AND every
+        shard's shape/dtype, so a checkpoint written at a different dp
+        degree or shard alignment (``compression.block_size``) is refused
+        at restore instead of silently mis-binding shards — the failure
+        mode ZeRO adds over replicated optimizers."""
+        from apex_tpu.resilience.checkpoint import state_dict
+
+        return state_dict(state)
+
+    def load_state_dict(self, template: DistAdamState,
+                        d: dict) -> DistAdamState:
+        """Restore onto a live ``init(params)`` structure (same mesh, same
+        dp degree); refuses a fingerprint mismatch."""
+        from apex_tpu.resilience.checkpoint import load_state_dict
+
+        return load_state_dict(template, d)
+
     def _global_norm(self, shards) -> jnp.ndarray:
         return _global_norm_shards(shards, self.axis_name)
 

@@ -80,6 +80,20 @@ class DistributedFusedLAMB:
             return init_error_feedback(params)
         return None
 
+    # -- checkpointing (the resilience manifest path) ----------------------
+    def state_dict(self, state: DistLambState) -> dict:
+        """See :meth:`DistributedFusedAdam.state_dict` — same fingerprinted
+        flat format, same shard-mis-binding protection."""
+        from apex_tpu.resilience.checkpoint import state_dict
+
+        return state_dict(state)
+
+    def load_state_dict(self, template: DistLambState,
+                        d: dict) -> DistLambState:
+        from apex_tpu.resilience.checkpoint import load_state_dict
+
+        return load_state_dict(template, d)
+
     def step(
         self,
         grads: Pytree,

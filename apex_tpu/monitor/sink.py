@@ -17,6 +17,11 @@ The format choices are all crash-shaped:
 * **process-0 gating** — under multi-process (``jax.distributed``) only
   process 0 writes; every other process's sink is a no-op, so the call
   sites stay SPMD-uniform.
+* **exit flush** — buffered lines survive a normal interpreter exit and
+  the resilience preemption path even when the caller forgot
+  ``close()``/``with``: every enabled sink registers an ``atexit`` flush
+  fallback (unregistered again on ``close`` so a well-behaved caller pays
+  nothing at exit). Short runs and preempted runs keep their tail.
 
 Human-readable mirror: with ``log_every=N`` the sink also logs a one-line
 summary of every Nth record through the ``apex_tpu.monitor.metrics`` child
@@ -28,6 +33,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 from typing import Any, Dict, Iterator, List, Optional
 
@@ -83,6 +89,19 @@ class JsonlSink:
         self._buf: List[str] = []
         self._file = None
         self._logger = None
+        # write/flush are lock-guarded: background writers (the resilience
+        # CheckpointManager's async worker, the stall watchdog) share one
+        # sink with the train loop
+        self._iolock = threading.Lock()
+        self._atexit_registered = False
+        if self.enabled:
+            import atexit
+
+            # fallback only: close() unregisters, so the common with-block
+            # path never reaches it; a run killed by sys.exit/atexit (the
+            # preemption save-and-exit path included) still flushes its tail
+            atexit.register(self.close)
+            self._atexit_registered = True
 
     # -- write path --------------------------------------------------------
     def write(self, step: Optional[int] = None, metrics: Any = None,
@@ -98,14 +117,20 @@ class JsonlSink:
                 else dict(metrics)
             fields.update(vals)
         fields.update(extra)
-        self._buf.append(json_record(**fields))
+        line = json_record(**fields)
+        with self._iolock:
+            self._buf.append(line)
+            if len(self._buf) >= self.buffer_steps:
+                self._flush_locked()
         if self.log_every and step is not None and step % self.log_every == 0:
             self._log_line(fields)
-        if len(self._buf) >= self.buffer_steps:
-            self.flush()
 
     def flush(self) -> None:
         """Write buffered records as whole lines and flush the OS buffer."""
+        with self._iolock:
+            self._flush_locked()
+
+    def _flush_locked(self) -> None:
         if not self._buf:
             return
         if self._file is None:
@@ -129,10 +154,16 @@ class JsonlSink:
             os.fsync(self._file.fileno())
 
     def close(self) -> None:
-        self.flush()
-        if self._file is not None:
-            self._file.close()
-            self._file = None
+        with self._iolock:
+            self._flush_locked()
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+        if self._atexit_registered:
+            import atexit
+
+            atexit.unregister(self.close)
+            self._atexit_registered = False
 
     def __enter__(self) -> "JsonlSink":
         return self

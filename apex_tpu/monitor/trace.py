@@ -13,9 +13,11 @@ TPU design: one :func:`span` plants BOTH kinds of marker at once:
   work, so un-jitted phases (data loading, checkpoint writes) show in the
   trace viewer's host rows too.
 
-Canonical phase names are :data:`PHASES` (``fwd``/``bwd``/``comm``/``opt``)
-— using them makes ``monitor.report.phase_breakdown`` attribute step time
-per phase with no configuration — but any string works.
+Canonical phase names are :data:`PHASES`
+(``fwd``/``bwd``/``comm``/``opt``/``ckpt`` — the last is the host-side
+checkpoint phase the resilience layer traces under) — using them makes
+``monitor.report.phase_breakdown`` attribute step time per phase with no
+configuration — but any string works.
 
 :func:`step_annotation` wraps ``jax.profiler.StepTraceAnnotation`` so the
 trace viewer groups device activity by train step (the MLPerf-style
@@ -31,8 +33,11 @@ from typing import Callable, Iterator, Optional
 import jax
 
 # canonical train-step phases; monitor.report.phase_breakdown groups by the
-# leading scope component, so spans named from this set roll up cleanly
-PHASES = ("fwd", "bwd", "comm", "opt")
+# leading scope component, so spans named from this set roll up cleanly.
+# "ckpt" is the host-side checkpoint phase (resilience.CheckpointManager's
+# device_get + serialization) — it appears in trace-viewer host rows, not
+# in the compiled step.
+PHASES = ("fwd", "bwd", "comm", "opt", "ckpt")
 
 
 @contextlib.contextmanager

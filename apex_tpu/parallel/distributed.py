@@ -116,6 +116,26 @@ class DistributedDataParallel:
             return init_error_feedback(grads_template)
         return None
 
+    def comm_state_dict(self, comm_state: Any) -> Optional[dict]:
+        """Serialize the error-feedback comm state for a checkpoint
+        (``None`` stays ``None``) — the resilience manifest path: include
+        the returned dict in the pytree handed to
+        :class:`apex_tpu.resilience.CheckpointManager` (or any
+        ``state_dict`` blob) so a resumed run keeps its residuals instead
+        of silently restarting EF from zero."""
+        from apex_tpu.comm import error_feedback as ef
+
+        return None if comm_state is None else ef.state_dict(comm_state)
+
+    def load_comm_state_dict(self, comm_state_template: Any,
+                             d: Optional[dict]) -> Optional[Any]:
+        """Inverse of :meth:`comm_state_dict`; validates the stored
+        structure against the live one (from :meth:`init_comm_state`)."""
+        from apex_tpu.comm import error_feedback as ef
+
+        return None if d is None else ef.load_state_dict(
+            comm_state_template, d)
+
     def replicate(self, params: Any) -> Any:
         """Mark params as per-replica (device-varying) inside the mesh
         program — the analogue of each DDP rank holding its own module copy.

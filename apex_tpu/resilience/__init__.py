@@ -1,0 +1,77 @@
+"""Fault-tolerant training (L-resilience).
+
+Not in the reference: NVIDIA Apex survives exactly one failure mode — fp16
+overflow — through the loss scaler's skip; checkpointing is ``torch.save``
+and preemption is the user's problem. At pod scale (the MLPerf-on-TPU-pods
+regime) preemptions and transient numeric blowups are routine, and with
+ZeRO-sharded optimizer state a torn checkpoint silently corrupts a run.
+This subsystem is the durability layer:
+
+* :mod:`~apex_tpu.resilience.guard` — :class:`AnomalyGuard`, the in-graph
+  generalization of the scaler's overflow skip: non-finite detection over
+  loss/grads/updates/params (+ the scaler's ``found_inf``), a
+  skip → rollback → halt escalation ladder driven by
+  :class:`GuardPolicy` budgets, a last-good snapshot carried through the
+  jitted step, and ``nonfinite_*_total`` / ``rollbacks_total`` counters on
+  the monitor pipeline.
+* :mod:`~apex_tpu.resilience.checkpoint` — :class:`CheckpointManager`:
+  atomic publish (staging dir + ``os.replace``), versioned manifest with a
+  treedef fingerprint and per-leaf crc32, async save off the critical
+  path, keep-last-N / keep-every-K retention, and :meth:`latest_valid`
+  discovery that skips torn/corrupt checkpoints for auto-resume.
+* :mod:`~apex_tpu.resilience.preemption` — :class:`PreemptionHandler`
+  (SIGTERM → multihost-agreed save step → atomic save inside the grace
+  window) and :class:`StallWatchdog` (wall-clock step-stall detector that
+  dumps thread stacks + a JSONL diagnostic record).
+* :mod:`~apex_tpu.resilience.chaos` — the deterministic fault-injection
+  harness (NaN at step k, torn/corrupt checkpoints, simulated preemption)
+  the recovery tests drive.
+"""
+
+from apex_tpu.resilience.chaos import (  # noqa: F401
+    PreemptionAtStep,
+    corrupt_checkpoint,
+    corrupt_file,
+    inject_nonfinite,
+    make_manifest_lie,
+)
+from apex_tpu.resilience.checkpoint import (  # noqa: F401
+    MANIFEST_SCHEMA,
+    CheckpointError,
+    CheckpointManager,
+    fingerprint,
+    load_state_dict,
+    state_dict,
+)
+from apex_tpu.resilience.guard import (  # noqa: F401
+    AnomalyGuard,
+    AnomalyHalted,
+    GuardPolicy,
+    GuardState,
+    nonfinite_count,
+)
+from apex_tpu.resilience.preemption import (  # noqa: F401
+    PreemptionHandler,
+    StallWatchdog,
+)
+
+__all__ = [
+    "AnomalyGuard",
+    "AnomalyHalted",
+    "CheckpointError",
+    "CheckpointManager",
+    "GuardPolicy",
+    "GuardState",
+    "MANIFEST_SCHEMA",
+    "PreemptionAtStep",
+    "PreemptionHandler",
+    "StallWatchdog",
+    "corrupt_checkpoint",
+    "corrupt_file",
+    "fingerprint",
+    "inject_nonfinite",
+    "load_state_dict",
+    "make_manifest_lie",
+    "nonfinite_count",
+    "state_dict",
+]

@@ -1,0 +1,173 @@
+"""Deterministic fault injection — the test harness the recovery paths
+are proven against.
+
+Every guard/checkpoint/preemption claim in this subsystem is only as good
+as the failure it survived in CI. This module provides the failures, all
+deterministic (seedless, step-keyed, byte-exact) so a recovery test is
+reproducible:
+
+* :func:`inject_nonfinite` — in-graph NaN/Inf poisoning of a pytree at an
+  exact step (a ``jnp.where`` on the step counter: jit-stable, no
+  recompile, no host sync — the injection itself must not perturb the run
+  it corrupts).
+* :func:`corrupt_file` / :func:`corrupt_checkpoint` — host-side torn-write
+  and bit-rot simulation: truncate, flip bytes, or delete members of a
+  published checkpoint so ``latest_valid()`` has something real to reject.
+* :class:`PreemptionAtStep` — fires a
+  :class:`~apex_tpu.resilience.preemption.PreemptionHandler` at step k
+  through the exact code path the SIGTERM handler uses.
+
+Used by ``tests/test_resilience.py``; importable by users who want to
+chaos-test their own train loops.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.resilience.preemption import PreemptionHandler
+
+Pytree = Any
+
+
+def inject_nonfinite(
+    tree: Pytree,
+    step: jnp.ndarray,
+    at_step: int,
+    mode: str = "nan",
+    leaf_index: Optional[int] = 0,
+) -> Pytree:
+    """Return ``tree`` with non-finite values injected iff ``step ==
+    at_step`` (both may be traced). ``mode``: ``"nan"`` or ``"inf"``.
+    ``leaf_index`` poisons one leaf (default: the first inexact one);
+    ``None`` poisons every inexact leaf. Exact-dtype leaves (ints, bools)
+    pass through — they cannot hold a NaN."""
+    if mode not in ("nan", "inf"):
+        raise ValueError(f"mode must be 'nan' or 'inf', got {mode!r}")
+    poison = jnp.float32(jnp.nan if mode == "nan" else jnp.inf)
+    hit = jnp.asarray(step) == at_step
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    inexact = [i for i, x in enumerate(leaves)
+               if jnp.issubdtype(jnp.result_type(x), jnp.inexact)]
+    if not inexact:
+        return tree
+    targets = set(inexact) if leaf_index is None \
+        else {inexact[leaf_index % len(inexact)]}
+    out = [
+        jnp.where(hit, poison.astype(x.dtype), x) if i in targets else x
+        for i, x in enumerate(leaves)
+    ]
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def corrupt_file(path: str, mode: str = "truncate", nbytes: int = 64) -> None:
+    """Simulate a torn write / bit rot on one file. ``mode``:
+
+    * ``"truncate"`` — drop the final ``nbytes`` (torn tail);
+    * ``"flip"`` — XOR ``nbytes`` bytes in the middle (silent bit rot);
+    * ``"delete"`` — remove the file (lost member).
+    """
+    if mode == "delete":
+        os.remove(path)
+        return
+    size = os.path.getsize(path)
+    if mode == "truncate":
+        with open(path, "r+b") as f:
+            f.truncate(max(0, size - nbytes))
+    elif mode == "flip":
+        with open(path, "r+b") as f:
+            off = max(0, size // 2 - nbytes // 2)
+            f.seek(off)
+            chunk = f.read(min(nbytes, size - off))
+            f.seek(off)
+            f.write(bytes(b ^ 0xFF for b in chunk))
+    else:
+        raise ValueError(
+            f"mode must be 'truncate', 'flip' or 'delete', got {mode!r}")
+
+
+def _payload_files(ckpt_dir: str) -> list:
+    """Data files of a published checkpoint, largest first (manifest and
+    zero-byte markers excluded) — the realistic bit-rot targets."""
+    from apex_tpu.resilience.checkpoint import MANIFEST_NAME
+
+    out = []
+    for root, _, files in os.walk(ckpt_dir):
+        for name in files:
+            if name == MANIFEST_NAME:
+                continue
+            p = os.path.join(root, name)
+            if os.path.getsize(p) > 0:
+                out.append(p)
+    return sorted(out, key=os.path.getsize, reverse=True)
+
+
+def corrupt_checkpoint(ckpt_dir: str, part: str = "payload",
+                       mode: str = "truncate") -> str:
+    """Corrupt one member of a published checkpoint directory so that
+    verification must fail. ``part``: ``"payload"`` (largest data file) or
+    ``"manifest"``. Returns the path corrupted."""
+    from apex_tpu.resilience.checkpoint import MANIFEST_NAME
+
+    if part == "manifest":
+        p = os.path.join(ckpt_dir, MANIFEST_NAME)
+        if mode == "flip":
+            # JSON-breaking flip (a bitwise flip could stay parseable)
+            with open(p) as f:
+                text = f.read()
+            with open(p, "w") as f:
+                f.write(text[: max(1, len(text) // 2)])
+        else:
+            corrupt_file(p, mode)
+        return p
+    if part != "payload":
+        raise ValueError(f"part must be 'payload' or 'manifest', got "
+                         f"{part!r}")
+    files = _payload_files(ckpt_dir)
+    if not files:
+        raise FileNotFoundError(f"no payload files under {ckpt_dir}")
+    corrupt_file(files[0], mode)
+    return files[0]
+
+
+def make_manifest_lie(ckpt_dir: str, leaf: int = 0) -> None:
+    """Silent-corruption variant: leave the payload intact but falsify one
+    leaf's crc32 in the manifest — models a writer that recorded the wrong
+    bytes. ``verify()`` must catch the mismatch."""
+    from apex_tpu.resilience.checkpoint import MANIFEST_NAME
+
+    p = os.path.join(ckpt_dir, MANIFEST_NAME)
+    with open(p) as f:
+        m = json.load(f)
+    m["leaves"][leaf]["crc32"] ^= 0x5A5A5A5A
+    with open(p, "w") as f:
+        json.dump(m, f)
+
+
+class PreemptionAtStep:
+    """Deterministically preempt at step k::
+
+        pre = PreemptionHandler(install=False)
+        chaos = PreemptionAtStep(pre, at_step=7)
+        for step in range(n):
+            ...
+            chaos.maybe_fire(step)
+            if pre.sync_save_step(step) is not None:
+                save_and_exit()
+    """
+
+    def __init__(self, handler: PreemptionHandler, at_step: int):
+        self.handler = handler
+        self.at_step = int(at_step)
+        self.fired = False
+
+    def maybe_fire(self, step: int) -> bool:
+        if not self.fired and int(step) >= self.at_step:
+            self.fired = True
+            self.handler.trigger()
+        return self.fired

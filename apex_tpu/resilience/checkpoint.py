@@ -1,0 +1,479 @@
+"""Production checkpointing — atomic, manifested, async, self-verifying.
+
+Reference context: the reference delegates checkpointing to ``torch.save``
+(``examples/imagenet/main_amp.py`` writes one file in-place). At pod scale
+that contract is not survivable: a preemption mid-``torch.save`` leaves a
+torn file that unpickles halfway or not at all, and with ZeRO-sharded
+optimizer state (``contrib/optimizers``) a half-written blob silently
+mis-binds shards. This module layers the missing durability on
+:mod:`apex_tpu.utils.checkpoint` (which supplies the serialization backend
+— orbax when present, atomic pickle otherwise):
+
+* **atomic write** — everything lands in a ``.tmp-*`` staging dir, then one
+  ``os.replace`` publishes it; a crash at any point leaves either the old
+  checkpoint set or the new one, never a torn member (a same-step re-save
+  parks the old copy under ``.trash-*`` between the two renames, so even
+  that crash window loses no bytes).
+* **versioned manifest** — ``manifest.json`` carries a schema version, the
+  step, a treedef+shape/dtype fingerprint of the saved state (the
+  ``--resume`` fingerprint contract from the imagenet trainer, now shared),
+  and a per-leaf crc32 so corruption is *detected*, not just hoped against.
+* **async save** — ``device_get`` happens on the caller (the only part that
+  must see the live arrays); serialization + fsync + publish run on a
+  single worker thread off the step critical path.
+* **retention GC** — keep-last-N plus keep-every-K milestones.
+* **latest_valid() discovery** — scan, verify manifests + checksums, and
+  skip torn/corrupt checkpoints, so auto-resume always lands on a good one.
+
+Telemetry: each save records ``ckpt_save_ms`` / ``ckpt_bytes`` (readable on
+:attr:`CheckpointManager.last_save_ms`; pass ``sink=`` to append a
+``monitor`` JSONL record per save), and the blocking host section traces
+under the ``ckpt`` monitor span.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import threading
+import time
+import zlib
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Pytree = Any
+
+MANIFEST_NAME = "manifest.json"
+MANIFEST_SCHEMA = 1
+_PREFIX = "ckpt_"
+_TMP_PREFIX = ".tmp-"
+_TRASH_PREFIX = ".trash-"
+
+
+class CheckpointError(RuntimeError):
+    """A checkpoint could not be written, verified, or restored."""
+
+
+def fingerprint(state: Pytree) -> str:
+    """Structure fingerprint: treedef + per-leaf shape/dtype. Leaves are
+    checkpointed by flat positional index and re-hung on the LIVE treedef,
+    so a same-leaf-count checkpoint from another code revision would
+    otherwise silently mis-bind optimizer/amp/guard state. Shape/dtype come
+    from the avals — no device-to-host copies."""
+    leaves, treedef = jax.tree_util.tree_flatten(state)
+    per_leaf = ";".join(
+        f"{tuple(jnp.shape(x))}:{jnp.result_type(x)}" for x in leaves)
+    return f"{treedef}|{per_leaf}"
+
+
+def _crc(arr: np.ndarray) -> int:
+    return zlib.crc32(np.ascontiguousarray(arr).tobytes())
+
+
+def _require_host_fetchable(leaves) -> None:
+    """Boundary of this module's checkpoint paths: every process must be
+    able to materialize the whole array (single-process meshes, or
+    replicated multihost state — ``device_get`` can fetch those). Arrays
+    SHARDED across processes need a per-process-shard writer (orbax's
+    multihost manager) — fail loudly with one clear error, not with a
+    device_get crash inside the preemption grace window."""
+    for x in leaves:
+        if (hasattr(x, "is_fully_addressable")
+                and not x.is_fully_addressable
+                and not getattr(x, "is_fully_replicated", False)):
+            raise CheckpointError(
+                "state contains an array sharded across processes "
+                f"(shape {getattr(x, 'shape', '?')}); checkpoint writes "
+                "happen on process 0 only and cannot fetch non-addressable "
+                "shards — all-gather the state first or use an orbax "
+                "multihost checkpointer")
+
+
+def state_dict(state: Pytree) -> Dict[str, Any]:
+    """Pytree → flat fingerprinted dict (the manifest path's in-memory
+    form): leaves keyed by flat index plus the structure fingerprint, so a
+    restore against different code fails loudly instead of mis-binding.
+    The ZeRO optimizers and the DDP comm-state expose their sharded state
+    through this (gather or replicate cross-process shards first — see
+    :func:`_require_host_fetchable`)."""
+    leaves = jax.tree_util.tree_leaves(state)
+    _require_host_fetchable(leaves)
+    return {
+        "fingerprint": fingerprint(state),
+        "leaves": {str(i): np.asarray(x)
+                   for i, x in enumerate(jax.device_get(leaves))},
+    }
+
+
+def load_state_dict(template: Pytree, d: Dict[str, Any]) -> Pytree:
+    """Restore a :func:`state_dict` blob onto ``template``'s structure,
+    refusing a fingerprint mismatch."""
+    live = fingerprint(template)
+    saved = d.get("fingerprint")
+    if saved is not None and saved != live:
+        raise CheckpointError(
+            "state_dict was written by a different state revision — "
+            f"refusing to mis-bind.\n   saved: {str(saved)[:200]}\n"
+            f"   live:  {live[:200]}")
+    leaves, treedef = jax.tree_util.tree_flatten(template)
+    if len(d["leaves"]) != len(leaves):
+        raise CheckpointError(
+            f"state_dict has {len(d['leaves'])} leaves, live structure "
+            f"has {len(leaves)}")
+    return jax.tree_util.tree_unflatten(
+        treedef,
+        [jnp.asarray(d["leaves"][str(i)], jnp.result_type(leaves[i]))
+         for i in range(len(leaves))])
+
+
+def _step_of(name: str) -> Optional[int]:
+    if not name.startswith(_PREFIX):
+        return None
+    try:
+        return int(name[len(_PREFIX):])
+    except ValueError:
+        return None
+
+
+def _is_process_zero() -> bool:
+    try:
+        return jax.process_index() == 0
+    except Exception:  # jax not initialized — single-process tooling
+        return True
+
+
+class CheckpointManager:
+    """Atomic, manifested checkpoint directory. Typical loop::
+
+        mgr = CheckpointManager(ckpt_dir, keep_last_n=3, async_save=True)
+        found = mgr.latest_valid()
+        if found:
+            state, start = mgr.restore(target=state)
+        for step in range(start, n):
+            state = train_step(state, ...)
+            if step % save_freq == 0:
+                mgr.save(state, step)
+        mgr.close()                        # drains the async worker
+
+    ``state`` is any pytree (amp state, optimizer state incl. ZeRO shards,
+    batch stats, DDP error-feedback residuals, guard state, ...).
+    """
+
+    def __init__(
+        self,
+        directory: str,
+        keep_last_n: int = 3,
+        keep_every_k: int = 0,
+        async_save: bool = False,
+        fsync: bool = True,
+        sink: Optional[Any] = None,
+        process0_only: bool = True,
+    ):
+        self.directory = os.path.abspath(directory)
+        self.keep_last_n = max(1, int(keep_last_n))
+        self.keep_every_k = max(0, int(keep_every_k))
+        self.async_save = async_save
+        self.fsync = fsync
+        self.sink = sink
+        # multi-process SPMD (the preemption barrier's world): every
+        # process calls save() at the agreed step, but only process 0
+        # touches the shared directory — the JsonlSink gating pattern.
+        # Reads (latest_valid/restore) stay ungated: they are idempotent.
+        self.write_enabled = _is_process_zero() if process0_only else True
+        self.last_save_ms: Optional[float] = None
+        self.last_save_bytes: Optional[int] = None
+        self._pool: Optional[ThreadPoolExecutor] = None
+        self._pending: List[Future] = []
+        self._lock = threading.Lock()
+
+    # -- paths -------------------------------------------------------------
+    def step_path(self, step: int) -> str:
+        return os.path.join(self.directory, f"{_PREFIX}{int(step):08d}")
+
+    def all_steps(self) -> List[int]:
+        """Published checkpoint steps, ascending (no validity check)."""
+        if not os.path.isdir(self.directory):
+            return []
+        steps = [_step_of(n) for n in os.listdir(self.directory)]
+        return sorted(s for s in steps if s is not None)
+
+    # -- save --------------------------------------------------------------
+    def save(self, state: Pytree, step: int, block: Optional[bool] = None
+             ) -> str:
+        """Write ``state`` at ``step``; returns the (future) final path.
+
+        ``block=None`` follows the manager's ``async_save`` setting. Only
+        the device→host transfer (plus, for async, one private host copy —
+        donation safety) runs on the caller; checksums, serialization and
+        the atomic publish run on the worker thread. Errors from an async
+        save surface on the next :meth:`save` / :meth:`wait` /
+        :meth:`close`.
+        """
+        from apex_tpu.monitor.trace import span
+
+        final = self.step_path(step)
+        leaves, _ = jax.tree_util.tree_flatten(state)
+        _require_host_fetchable(leaves)
+        if not self.write_enabled:
+            return final  # non-zero process under SPMD: no shared-dir write
+        self._raise_pending()
+        t0 = time.perf_counter()
+        sync = not self.async_save if block is None else block
+        if not sync:
+            # backpressure: at most ONE in-flight async save — a second
+            # submit would pin a second full host snapshot of the state
+            # (unbounded RAM when serialization is slower than the save
+            # cadence); blocking here degrades to sync-save pacing instead
+            self.wait()
+        with span("ckpt"):
+            host = [np.asarray(x) for x in jax.device_get(leaves)]
+            if not sync:
+                # donation safety: on the CPU backend device_get can alias
+                # the live buffer, which a donating train step may overwrite
+                # while the worker is still serializing — snapshot it. (The
+                # checksum/serialize work itself runs on the worker.)
+                host = [np.array(h, copy=True) for h in host]
+        meta = {
+            "schema": MANIFEST_SCHEMA,
+            "step": int(step),
+            "fingerprint": fingerprint(state),
+        }
+        if sync:
+            self.wait()  # a sync save must not interleave with the worker
+            self._write(host, meta, final, t0)
+        else:
+            if self._pool is None:
+                self._pool = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="apex-tpu-ckpt")
+            with self._lock:
+                self._pending.append(self._pool.submit(
+                    self._write, host, meta, final, t0))
+        return final
+
+    def _write(self, host: List[np.ndarray], meta: Dict[str, Any],
+               final: str, t0: float) -> None:
+        from apex_tpu.utils.checkpoint import save_checkpoint
+
+        # checksum + manifest assembly on the worker: the host list is a
+        # private snapshot, so only the device transfer had to stay on the
+        # caller (the async save's critical-path cost)
+        manifest = dict(
+            meta,
+            leaves=[{"shape": list(h.shape), "dtype": str(h.dtype),
+                     "crc32": _crc(h)} for h in host],
+            bytes=int(sum(h.nbytes for h in host)))
+        os.makedirs(self.directory, exist_ok=True)
+        tmp = os.path.join(
+            self.directory,
+            f"{_TMP_PREFIX}{os.path.basename(final)}-{os.getpid()}")
+        if os.path.isdir(tmp):
+            shutil.rmtree(tmp)
+        os.makedirs(tmp)
+        try:
+            payload = save_checkpoint(
+                os.path.join(tmp, "payload"),
+                {str(i): h for i, h in enumerate(host)})
+            manifest = dict(manifest, payload=os.path.basename(payload))
+            mpath = os.path.join(tmp, MANIFEST_NAME)
+            with open(mpath, "w") as f:
+                json.dump(manifest, f)
+                if self.fsync:
+                    f.flush()
+                    os.fsync(f.fileno())
+            trash = None
+            if os.path.isdir(final):
+                # re-save of the same step: POSIX cannot atomically swap a
+                # non-empty dir, so park the old copy under a hidden name
+                # first — a crash between the two renames leaves this step
+                # missing but the old bytes intact (and recoverable),
+                # never a torn mixture
+                trash = os.path.join(
+                    self.directory,
+                    f"{_TRASH_PREFIX}{os.path.basename(final)}-"
+                    f"{os.getpid()}")
+                if os.path.isdir(trash):
+                    shutil.rmtree(trash)
+                os.replace(final, trash)
+            os.replace(tmp, final)  # the publish — atomic on POSIX
+            if trash is not None:
+                shutil.rmtree(trash, ignore_errors=True)
+            if self.fsync:
+                dirfd = os.open(self.directory, os.O_RDONLY)
+                try:
+                    os.fsync(dirfd)
+                finally:
+                    os.close(dirfd)
+        except BaseException:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
+        self._gc()
+        ms = (time.perf_counter() - t0) * 1000.0
+        self.last_save_ms = ms
+        self.last_save_bytes = manifest["bytes"]
+        if self.sink is not None:
+            self.sink.write(step=manifest["step"], ckpt_save_ms=round(ms, 3),
+                            ckpt_bytes=manifest["bytes"], ckpt_path=final)
+
+    # -- async bookkeeping -------------------------------------------------
+    def _raise_pending(self) -> None:
+        with self._lock:
+            done = [f for f in self._pending if f.done()]
+            self._pending = [f for f in self._pending if not f.done()]
+        for f in done:
+            f.result()  # re-raise the worker's exception, if any
+
+    def wait(self) -> None:
+        """Drain in-flight async saves; re-raise their errors."""
+        while True:
+            with self._lock:
+                if not self._pending:
+                    return
+                f = self._pending.pop(0)
+            f.result()
+
+    def close(self) -> None:
+        self.wait()
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
+            self._pool = None
+
+    def __enter__(self) -> "CheckpointManager":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- verify / discover -------------------------------------------------
+    def read_manifest(self, path: str) -> Dict[str, Any]:
+        with open(os.path.join(path, MANIFEST_NAME)) as f:
+            m = json.load(f)
+        if m.get("schema") != MANIFEST_SCHEMA:
+            raise CheckpointError(
+                f"{path}: manifest schema {m.get('schema')!r} != "
+                f"{MANIFEST_SCHEMA}")
+        return m
+
+    def _load_leaves(self, path: str, manifest: Dict[str, Any]
+                     ) -> List[np.ndarray]:
+        from apex_tpu.utils.checkpoint import load_checkpoint
+
+        blob = load_checkpoint(os.path.join(path, manifest["payload"]))
+        n = len(manifest["leaves"])
+        try:
+            return [np.asarray(blob[str(i)]) for i in range(n)]
+        except KeyError as e:
+            raise CheckpointError(
+                f"{path}: payload is missing leaf {e} of {n}") from e
+
+    def verify(self, path: str) -> bool:
+        """True iff ``path`` holds a complete, uncorrupted checkpoint:
+        manifest parses, payload loads, every leaf matches its manifest
+        shape/dtype/crc32."""
+        try:
+            self._verify_or_raise(path)
+            return True
+        except Exception:
+            return False
+
+    def _verify_or_raise(self, path: str) -> Tuple[Dict[str, Any],
+                                                   List[np.ndarray]]:
+        manifest = self.read_manifest(path)
+        host = self._load_leaves(path, manifest)
+        for i, (h, spec) in enumerate(zip(host, manifest["leaves"])):
+            if list(h.shape) != spec["shape"] or str(h.dtype) != spec["dtype"]:
+                raise CheckpointError(
+                    f"{path}: leaf {i} is {h.shape}:{h.dtype}, manifest "
+                    f"says {spec['shape']}:{spec['dtype']}")
+            if _crc(h) != spec["crc32"]:
+                raise CheckpointError(
+                    f"{path}: leaf {i} fails its crc32 — corrupt payload")
+        return manifest, host
+
+    def latest_valid(self) -> Optional[str]:
+        """Path of the newest checkpoint that verifies; torn or corrupt
+        ones (crashed save, truncated payload, flipped bits) are skipped
+        with a warning. ``None`` when no valid checkpoint exists."""
+        from apex_tpu._logging import get_logger
+
+        for step in reversed(self.all_steps()):
+            p = self.step_path(step)
+            if self.verify(p):
+                return p
+            get_logger("apex_tpu.resilience").warning(
+                "skipping invalid checkpoint %s (torn or corrupt)", p)
+        return None
+
+    # -- restore -----------------------------------------------------------
+    def restore(self, target: Pytree, path: Optional[str] = None
+                ) -> Tuple[Pytree, int]:
+        """Load a checkpoint onto ``target``'s structure; returns
+        ``(state, step)``. ``path=None`` discovers :meth:`latest_valid`.
+        The manifest fingerprint must match ``target``'s — a checkpoint
+        from a different train-state revision is refused, not mis-bound."""
+        if path is None:
+            path = self.latest_valid()
+            if path is None:
+                raise CheckpointError(
+                    f"no valid checkpoint under {self.directory}")
+        try:
+            manifest, host = self._verify_or_raise(path)
+        except CheckpointError:
+            raise
+        except Exception as e:
+            # missing dir, a path to a pre-manager-format file, damaged
+            # JSON, ... — one error type for callers to catch
+            raise CheckpointError(
+                f"'{path}' is not a readable checkpoint "
+                f"({type(e).__name__}: {e})") from e
+        live = fingerprint(target)
+        if manifest["fingerprint"] != live:
+            raise CheckpointError(
+                f"checkpoint '{path}' was written by a different "
+                "train-state revision — refusing to mis-bind state.\n"
+                f"   saved: {manifest['fingerprint'][:200]}...\n"
+                f"   live:  {live[:200]}...")
+        treedef = jax.tree_util.tree_structure(target)
+        state = jax.tree_util.tree_unflatten(
+            treedef, [jnp.asarray(h) for h in host])
+        return state, int(manifest["step"])
+
+    # -- retention ---------------------------------------------------------
+    def _gc(self) -> None:
+        """keep-last-N + keep-every-K milestone retention, plus a sweep of
+        staging/trash dirs orphaned by a crashed writer — a relaunch-heavy
+        spot job must not leak one checkpoint-sized dir per kill."""
+        pid_suffix = f"-{os.getpid()}"
+        for name in os.listdir(self.directory):
+            if name.endswith(pid_suffix):
+                continue  # this writer's own live staging
+            p = os.path.join(self.directory, name)
+            if name.startswith(_TMP_PREFIX):
+                # a dead writer's staging dir: never completed, delete
+                shutil.rmtree(p, ignore_errors=True)
+            elif name.startswith(_TRASH_PREFIX):
+                # a dead writer's parked old copy (same-step re-save). If
+                # the crash hit between the two renames, this trash is the
+                # ONLY copy of that step — restore it, don't delete it.
+                orig = name[len(_TRASH_PREFIX):].rsplit("-", 1)[0]
+                dest = os.path.join(self.directory, orig)
+                if _step_of(orig) is not None and not os.path.isdir(dest):
+                    try:
+                        os.replace(p, dest)
+                        continue
+                    except OSError:
+                        pass
+                shutil.rmtree(p, ignore_errors=True)
+        steps = self.all_steps()
+        if len(steps) <= self.keep_last_n:
+            return
+        keep = set(steps[-self.keep_last_n:])
+        if self.keep_every_k:
+            keep.update(s for s in steps if s % self.keep_every_k == 0)
+        for s in steps:
+            if s not in keep:
+                shutil.rmtree(self.step_path(s), ignore_errors=True)

@@ -1,0 +1,334 @@
+"""In-graph anomaly guard — skip / rollback / halt on non-finite steps.
+
+Reference context: the only fault tolerance in the reference stack is the
+loss scaler's overflow skip (``apex/amp/scaler.py:197-217`` — on ``found_inf``
+the patched ``optimizer.step`` is a no-op and the scale halves). That guards
+exactly one failure mode (fp16 overflow) at exactly one point (post-backward).
+At pod scale transient numeric blowups also arrive through data corruption,
+flaky interconnect reductions, and diverging optimizer state — and a single
+NaN that reaches the params is permanent: every later step is NaN.
+
+This module generalizes the scaler's skip into a policy-driven ladder that
+runs *inside* the jitted train step (no host sync, ``jnp.where`` guards so
+the step shape is static and donation still works):
+
+* **skip** — the scaler's move: keep the pre-step state, drop the update.
+* **rollback** — restore a last-good snapshot of the train state carried
+  through the step as part of :class:`GuardState` (one extra copy of the
+  state). Skip handles a bad *update*; rollback handles bad *state* — e.g.
+  a NaN that already reached the params through an unguarded path. The
+  snapshot deliberately lags the live state by one accepted step: a clean
+  step refreshes it to the state its own finite loss/grads were computed
+  from, so poison that slips past one step's detectors cannot enter the
+  snapshot before the next step's checks expose it.
+* **halt** — raise host-side via :meth:`AnomalyGuard.raise_if_halted` (and
+  optionally log through a ``jax.debug.callback``): the run is not making
+  progress and a human (or the preemption layer) should take over.
+
+Escalation: ``skip_budget`` consecutive bad steps are skipped, then each
+further bad step rolls back; ``rollback_budget`` consecutive rollbacks
+without an intervening clean step escalate to halt. ``on_anomaly`` picks
+the entry rung (``"skip"`` walks the whole ladder; ``"rollback"`` starts at
+rollback; ``"halt"`` halts on the first anomaly).
+
+Telemetry rides the PR-2 monitor pipeline: :meth:`AnomalyGuard.check` and
+:meth:`AnomalyGuard.apply` accumulate ``nonfinite_grads_total`` /
+``nonfinite_loss_total`` / ``guard_skips_total`` / ``rollbacks_total``
+counters into a :class:`apex_tpu.monitor.Metrics` threaded through the step.
+
+Typical wiring (composes with the AMP scaler — the guard consumes the same
+``found_inf`` the scaler derives, so an overflow spends guard budget too)::
+
+    guard = AnomalyGuard(GuardPolicy(on_anomaly="skip", skip_budget=3))
+    gstate = guard.init(train_state)
+
+    @jax.jit
+    def step(train_state, gstate, metrics, batch):
+        proposed, grads, loss = update(train_state, batch)
+        bad, metrics = guard.check(loss=loss, grads=grads, metrics=metrics)
+        train_state, gstate, metrics = guard.apply(
+            gstate, bad, proposed, train_state, metrics=metrics)
+        return train_state, gstate, metrics
+
+    for batch in data:
+        train_state, gstate, metrics = step(train_state, gstate, metrics, b)
+        guard.raise_if_halted(gstate)     # cheap: one scalar device read
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, NamedTuple, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+Pytree = Any
+
+_ACTIONS = ("skip", "rollback", "halt")
+
+
+class AnomalyHalted(RuntimeError):
+    """Raised host-side when the guard escalated to halt."""
+
+
+@dataclasses.dataclass(frozen=True)
+class GuardPolicy:
+    """Static anomaly policy (python-level config, never traced).
+
+    ``on_anomaly``: entry rung of the skip→rollback→halt ladder.
+    ``skip_budget``: consecutive bad steps absorbed by skipping before the
+    ladder escalates to rollback (ignored when ``on_anomaly != "skip"``).
+    ``rollback_budget``: consecutive rollbacks (no clean step between)
+    before the ladder escalates to halt.
+    ``halt_callback``: also fire a ``jax.debug.callback`` that logs the
+    halt from inside the graph (host-visible even if the driver loop never
+    calls :meth:`AnomalyGuard.raise_if_halted`).
+    """
+
+    on_anomaly: str = "skip"
+    skip_budget: int = 3
+    rollback_budget: int = 2
+    halt_callback: bool = False
+
+    def __post_init__(self):
+        if self.on_anomaly not in _ACTIONS:
+            raise ValueError(
+                f"on_anomaly must be one of {_ACTIONS}, got "
+                f"{self.on_anomaly!r}")
+        if self.skip_budget < 0 or self.rollback_budget < 0:
+            raise ValueError("budgets must be >= 0")
+
+
+class GuardState(NamedTuple):
+    """Guard carry — a pytree threaded through the jitted step.
+
+    ``snapshot`` is the last-good copy of the guarded train state (present
+    only when rollback is reachable under the policy, else an empty tuple —
+    no memory cost for pure-skip guards).
+    """
+
+    consecutive_bad: jnp.ndarray  # i32 — bad steps since last clean one
+    consecutive_rollbacks: jnp.ndarray  # i32 — rollbacks since last clean
+    halted: jnp.ndarray  # f32 0/1 — latched once set
+    bad_total: jnp.ndarray  # f32 — lifetime anomaly count
+    snapshot: Pytree
+
+
+def nonfinite_count(tree: Pytree) -> jnp.ndarray:
+    """Number of non-finite scalars across every leaf of ``tree`` (f32 so
+    it can ride a psum / a Metrics). The per-leaf ``isfinite`` reductions
+    fuse into whatever sweep already reads the leaves — same fusion the
+    scaler's overflow check rides."""
+    leaves = [x for x in jax.tree_util.tree_leaves(tree)
+              if jnp.issubdtype(jnp.result_type(x), jnp.inexact)]
+    if not leaves:
+        return jnp.float32(0.0)
+    # isfinite on the NATIVE dtype — downcasting an f64 leaf to f32 first
+    # would turn large finite values into inf and flag a healthy step
+    return sum(jnp.sum(~jnp.isfinite(x)).astype(jnp.float32)
+               for x in leaves)
+
+
+class AnomalyGuard:
+    """Pure methods over :class:`GuardState` for one :class:`GuardPolicy`
+    (the loss-scaler architecture: static config object, explicit state)."""
+
+    def __init__(self, policy: Optional[GuardPolicy] = None):
+        self.policy = policy or GuardPolicy()
+
+    # -- state -------------------------------------------------------------
+    def init(self, train_state: Optional[Pytree] = None) -> GuardState:
+        """Build the initial carry. Pass the train state iff the policy can
+        reach rollback — the snapshot starts as a copy of it."""
+        if self._rollback_reachable() and train_state is None:
+            raise ValueError(
+                f"policy {self.policy.on_anomaly!r} can reach rollback: "
+                "init(train_state) needs the state to snapshot")
+        snap = () if not self._rollback_reachable() else \
+            jax.tree_util.tree_map(jnp.asarray, train_state)
+        return GuardState(
+            consecutive_bad=jnp.asarray(0, jnp.int32),
+            consecutive_rollbacks=jnp.asarray(0, jnp.int32),
+            halted=jnp.asarray(0.0, jnp.float32),
+            bad_total=jnp.asarray(0.0, jnp.float32),
+            snapshot=snap)
+
+    def _rollback_reachable(self) -> bool:
+        return self.policy.on_anomaly in ("skip", "rollback")
+
+    # -- detection ---------------------------------------------------------
+    def check(
+        self,
+        *,
+        loss: Optional[jnp.ndarray] = None,
+        grads: Optional[Pytree] = None,
+        updates: Optional[Pytree] = None,
+        params: Optional[Pytree] = None,
+        found_inf: Optional[jnp.ndarray] = None,
+        metrics: Optional[Any] = None,
+        axis_names: Optional[Union[str, Sequence[str]]] = None,
+    ) -> Union[jnp.ndarray, Tuple[jnp.ndarray, Any]]:
+        """Non-finite detection over whatever is passed; returns a f32 0/1
+        ``bad`` flag (and the updated Metrics when one is given).
+
+        ``found_inf`` is the AMP scaler's overflow flag
+        (:meth:`apex_tpu.amp.LossScaler.unscale` output) — passing it makes
+        an fp16 overflow spend the same guard budget as any other anomaly,
+        so the scaler's skip and the guard's ladder agree on what a bad
+        step is. Metrics counters accumulated: ``nonfinite_loss_total``,
+        ``nonfinite_grads_total``, ``nonfinite_updates_total``,
+        ``nonfinite_params_total``, ``anomalies_total``.
+
+        ``axis_names``: mesh axis name(s) to max-reduce every flag over
+        BEFORE the counters are accumulated. Under SPMD this is required
+        when a metrics is passed: a rank-local anomaly (corrupt shard of
+        the batch) must update the replicated-declared counters on every
+        rank, not just the one that saw it — and the returned ``bad`` is
+        then already rank-uniform, so a separate :meth:`all_reduce_bad`
+        is unnecessary.
+        """
+        flags = {}
+        if loss is not None:
+            flags["nonfinite_loss_total"] = nonfinite_count(loss)
+        if grads is not None:
+            flags["nonfinite_grads_total"] = nonfinite_count(grads)
+        if updates is not None:
+            flags["nonfinite_updates_total"] = nonfinite_count(updates)
+        if params is not None:
+            flags["nonfinite_params_total"] = nonfinite_count(params)
+        if axis_names is not None:
+            flags = {k: jax.lax.pmax(v, axis_names)
+                     for k, v in flags.items()}
+        bad = jnp.float32(0.0)
+        for v in flags.values():
+            bad = jnp.maximum(bad, (v > 0).astype(jnp.float32))
+        if found_inf is not None:
+            fi = (jnp.asarray(found_inf) > 0).astype(jnp.float32)
+            if axis_names is not None:
+                fi = jax.lax.pmax(fi, axis_names)
+            bad = jnp.maximum(bad, fi)
+        if metrics is not None:
+            counters = {k: (v > 0).astype(jnp.float32)
+                        for k, v in flags.items()}
+            counters["anomalies_total"] = (bad > 0).astype(jnp.float32)
+            return bad, metrics.accumulate(**counters)
+        return bad
+
+    @staticmethod
+    def all_reduce_bad(bad: jnp.ndarray,
+                       axis_names: Union[str, Sequence[str]]) -> jnp.ndarray:
+        """Max-reduce the anomaly flag across mesh axes so every rank takes
+        the same branch (the ``LossScaler.all_reduce_found_inf`` move — a
+        rank-local skip under SPMD would desynchronize the replicas)."""
+        return jax.lax.pmax(bad, axis_names)
+
+    # -- application -------------------------------------------------------
+    def apply(
+        self,
+        gstate: GuardState,
+        bad: jnp.ndarray,
+        proposed: Pytree,
+        previous: Pytree,
+        metrics: Optional[Any] = None,
+    ) -> Tuple[Pytree, GuardState, Any]:
+        """Resolve one step: pick between ``proposed`` (the post-update
+        train state), ``previous`` (pre-update — the skip target) and the
+        carried snapshot (the rollback target), and advance the ladder.
+
+        Everything is ``jnp.where``-guarded: both branches are computed,
+        the select fuses, the step stays a single static program (the
+        ``_guard_tree`` pattern ``amp.apply_grads`` uses). Returns
+        ``(train_state, new_gstate, metrics)`` (metrics is ``None`` in/out
+        when not passed).
+        """
+        pol = self.policy
+        is_bad = jnp.asarray(bad) > 0
+        n_bad = jnp.where(is_bad, gstate.consecutive_bad + 1, 0)
+
+        if pol.on_anomaly == "halt":
+            do_skip = is_bad  # keep previous state while halting
+            do_rollback = jnp.asarray(False)
+            halt_now = is_bad
+        elif pol.on_anomaly == "rollback":
+            do_rollback = is_bad
+            do_skip = jnp.asarray(False)
+            halt_now = is_bad & (
+                gstate.consecutive_rollbacks + 1 > pol.rollback_budget)
+        else:  # skip → rollback → halt
+            over_skip = n_bad > pol.skip_budget
+            do_skip = is_bad & ~over_skip
+            do_rollback = is_bad & over_skip
+            halt_now = do_rollback & (
+                gstate.consecutive_rollbacks + 1 > pol.rollback_budget)
+
+        n_roll = jnp.where(
+            do_rollback, gstate.consecutive_rollbacks + 1,
+            jnp.where(is_bad, gstate.consecutive_rollbacks, 0))
+        halted = jnp.maximum(gstate.halted,
+                             halt_now.astype(jnp.float32))
+
+        def select(flag, a, b):
+            """tree-where: a where flag else b (non-array leaves follow the
+            eager branch only — inside jit every leaf is an array)."""
+            return jax.tree_util.tree_map(
+                lambda x, y: jnp.where(flag, x, y)
+                if hasattr(x, "dtype") or hasattr(y, "dtype")
+                else (x if flag else y),
+                a, b)
+
+        # skip keeps the pre-step state; rollback restores the snapshot
+        out = select(do_skip, previous, proposed)
+        if self._rollback_reachable():
+            out = select(do_rollback, gstate.snapshot, out)
+            # clean step → refresh the snapshot to PREVIOUS, not to the
+            # just-proposed state: this step's finite loss/grads were
+            # computed FROM previous, so previous is the newest state with
+            # evidence of health. The proposed state is unchecked until the
+            # next step — refreshing with it would let state-poisoning that
+            # slips past this step's detectors (e.g. a NaN that reached the
+            # params while the grads stayed finite) into the snapshot, and
+            # rollback would then restore the poison.
+            new_snap = select(is_bad, gstate.snapshot, previous)
+        else:
+            new_snap = gstate.snapshot
+
+        if pol.halt_callback:
+            jax.debug.callback(self._halt_log, halt_now)
+
+        new_gstate = GuardState(
+            consecutive_bad=n_bad.astype(jnp.int32),
+            consecutive_rollbacks=n_roll.astype(jnp.int32),
+            halted=halted,
+            bad_total=gstate.bad_total + is_bad.astype(jnp.float32),
+            snapshot=new_snap)
+        if metrics is not None:
+            metrics = metrics.accumulate(
+                guard_skips_total=do_skip.astype(jnp.float32),
+                rollbacks_total=do_rollback.astype(jnp.float32),
+            ).record(guard_halted=halted)
+        return out, new_gstate, metrics
+
+    # -- host side ---------------------------------------------------------
+    @staticmethod
+    def _halt_log(halt_now) -> None:
+        import numpy as np
+
+        if bool(np.any(np.asarray(halt_now))):
+            from apex_tpu._logging import get_logger
+
+            get_logger("apex_tpu.resilience").error(
+                "anomaly guard escalated to HALT — training state is not "
+                "recovering; stop the loop and inspect")
+
+    def raise_if_halted(self, gstate: GuardState) -> None:
+        """Host-side halt check (one scalar device read). Call once per
+        step — or every N steps — from the driver loop."""
+        if float(jax.device_get(gstate.halted)) > 0:
+            raise AnomalyHalted(
+                "anomaly guard halted after "
+                f"{int(jax.device_get(gstate.consecutive_bad))} consecutive "
+                "bad steps "
+                f"({int(jax.device_get(gstate.consecutive_rollbacks))} "
+                "rollbacks); last-known-good state is in "
+                "GuardState.snapshot")

@@ -1,0 +1,219 @@
+"""Preemption handling — save-and-exit on SIGTERM, plus a stall watchdog.
+
+Reference context: the reference leaves preemption to the user (a CUDA job
+that catches SIGTERM mid-``torch.save`` corrupts its own checkpoint). On
+TPU pods preemption is *routine* — maintenance events and spot reclaims
+deliver SIGTERM with a grace window — and under multi-process SPMD every
+process must agree on the step it saves at, or the sharded/replicated state
+written by different processes describes different steps.
+
+:class:`PreemptionHandler` turns the signal into a cooperative, barriered
+save: the handler only sets a flag; the train loop polls
+:meth:`PreemptionHandler.sync_save_step` once per step, which (under
+``jax.distributed``) max-reduces ``(flag, step)`` across processes so all
+of them pick the SAME save step — the process that got the signal late
+still saves at the agreed step. The save itself goes through the atomic
+:class:`~apex_tpu.resilience.checkpoint.CheckpointManager`, so even a
+too-short grace window leaves the previous valid checkpoint behind.
+
+:class:`StallWatchdog` covers the opposite failure: the job is *not*
+preempted but stopped making progress (deadlocked collective, wedged host).
+A daemon thread watches wall-clock time since the last :meth:`tick`; on
+expiry it dumps per-thread stacks and a diagnostic record through the
+monitor JSONL sink, then (optionally) invokes a callback.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import threading
+import time
+import traceback
+from typing import Any, Callable, Iterable, Optional
+
+import jax
+import numpy as np
+
+
+class PreemptionHandler:
+    """Cooperative SIGTERM/preemption handler. Typical loop::
+
+        mgr = CheckpointManager(ckpt_dir)
+        pre = PreemptionHandler()                 # installs SIGTERM handler
+        for step in range(start, n):
+            state = train_step(state, ...)
+            save_at = pre.sync_save_step(step)    # multihost agreement
+            if save_at is not None:
+                mgr.save(state, save_at + 1, block=True)
+                break                             # exit inside the grace window
+
+    :meth:`trigger` simulates a preemption (what
+    :func:`apex_tpu.resilience.chaos.PreemptionAtStep` calls) — same code
+    path as the real signal, minus the kernel.
+    """
+
+    def __init__(
+        self,
+        signals: Iterable[int] = (signal.SIGTERM,),
+        sync_every: int = 1,
+        install: bool = True,
+    ):
+        self._flag = threading.Event()
+        self._signals = tuple(signals)
+        self._previous = {}
+        self.sync_every = max(1, int(sync_every))
+        self.signaled_at: Optional[float] = None
+        if install:
+            self.install()
+
+    # -- signal plumbing ---------------------------------------------------
+    def install(self) -> None:
+        """Install handlers (main thread only — signal module contract).
+        The previous handlers are remembered and still called, so an outer
+        supervisor's SIGTERM hook keeps working."""
+        for sig in self._signals:
+            self._previous[sig] = signal.signal(sig, self._on_signal)
+
+    def uninstall(self) -> None:
+        for sig, prev in self._previous.items():
+            signal.signal(sig, prev)
+        self._previous.clear()
+
+    def _on_signal(self, signum, frame) -> None:
+        self.trigger()
+        prev = self._previous.get(signum)
+        if callable(prev) and prev not in (signal.SIG_IGN, signal.SIG_DFL):
+            prev(signum, frame)
+
+    def trigger(self) -> None:
+        """Mark this process preempted (signal handler body; also the
+        chaos-test entry point)."""
+        if not self._flag.is_set():
+            self.signaled_at = time.monotonic()
+        self._flag.set()
+
+    def preempted(self) -> bool:
+        """This process's local flag (pre-barrier)."""
+        return self._flag.is_set()
+
+    # -- the barrier -------------------------------------------------------
+    def sync_save_step(self, step: int) -> Optional[int]:
+        """Poll once per step. Returns the agreed save step when ANY
+        process has been preempted, else ``None``.
+
+        Under multi-process ``jax.distributed`` the decision is a max-
+        reduce of ``(preempted, step)`` over processes: everyone returns
+        the same step (the max proposed — processes can be a step apart
+        when the signal lands mid-step), so the checkpoint the survivors
+        write describes one consistent step. Single-process: the local
+        flag. ``sync_every > 1`` amortizes the collective by only
+        participating every Nth step (every process must use the same
+        value — it is part of the SPMD program's control flow)."""
+        if step % self.sync_every != 0:
+            return None
+        if jax.process_count() <= 1:
+            return step if self._flag.is_set() else None
+        from jax.experimental import multihost_utils
+
+        local = np.asarray(
+            [1 if self._flag.is_set() else 0, int(step)], dtype=np.int64)
+        agreed = np.max(
+            np.asarray(multihost_utils.process_allgather(local)), axis=0)
+        if int(agreed[0]) == 0:
+            return None
+        self._flag.set()  # adopt the cluster-wide decision locally
+        return int(agreed[1])
+
+
+class StallWatchdog:
+    """Wall-clock step-stall watchdog. ``tick()`` every step; if no tick
+    arrives within ``timeout_s`` the watchdog dumps diagnostics — one
+    JSONL record (via ``sink`` or the module logger) plus every thread's
+    stack — and fires ``on_stall``. One shot per stall: it re-arms on the
+    next tick. ``start()``/``stop()`` manage the daemon thread; usable as
+    a context manager."""
+
+    def __init__(
+        self,
+        timeout_s: float,
+        sink: Optional[Any] = None,
+        on_stall: Optional[Callable[[float], Any]] = None,
+        poll_s: Optional[float] = None,
+    ):
+        if timeout_s <= 0:
+            raise ValueError("timeout_s must be > 0")
+        self.timeout_s = float(timeout_s)
+        self.poll_s = float(poll_s) if poll_s else min(1.0, timeout_s / 4)
+        self.sink = sink
+        self.on_stall = on_stall
+        self.stalls = 0
+        self._last = time.monotonic()
+        self._last_step: Optional[int] = None
+        self._fired = False
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def tick(self, step: Optional[int] = None) -> None:
+        self._last = time.monotonic()
+        self._last_step = step
+        self._fired = False
+
+    def start(self) -> "StallWatchdog":
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self.tick(self._last_step)
+            self._thread = threading.Thread(
+                target=self._run, name="apex-tpu-stall-watchdog", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5 * self.poll_s + 1)
+            self._thread = None
+
+    __enter__ = start
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- internals ---------------------------------------------------------
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            idle = time.monotonic() - self._last
+            if idle >= self.timeout_s and not self._fired:
+                self._fired = True  # one report per stall
+                self.stalls += 1
+                self._report(idle)
+
+    def _report(self, idle: float) -> None:
+        from apex_tpu._logging import get_logger
+
+        names = {t.ident: t.name for t in threading.enumerate()}
+        parts = []
+        for ident, frame in sys._current_frames().items():
+            parts.append(f"Thread {names.get(ident, ident)}:")
+            parts.extend(
+                line.rstrip() for line in traceback.format_stack(frame))
+        stacks = "\n".join(parts)
+        log = get_logger("apex_tpu.resilience")
+        log.error(
+            "step stall: no progress for %.1fs (last step %s, pid %d) — "
+            "dumping thread stacks", idle, self._last_step, os.getpid())
+        for line in stacks.splitlines():
+            log.error("  %s", line)
+        if self.sink is not None:
+            try:
+                self.sink.write(step=self._last_step, stall_s=round(idle, 3),
+                                stalls_total=self.stalls, stacks=stacks)
+                self.sink.flush()
+            except Exception:
+                log.exception("stall watchdog could not write to sink")
+        if self.on_stall is not None:
+            try:
+                self.on_stall(idle)
+            except Exception:
+                log.exception("on_stall callback raised")

@@ -7,6 +7,13 @@ Reference context (SURVEY §5): model checkpointing is delegated to
 owning objects (``amp.state_dict``, ``FP16_Optimizer.state_dict``,
 ``LossScaler.state_dict``); this module supplies the ``torch.save`` role:
 orbax when available (sharded-array aware, async-capable), numpy fallback.
+
+Durability contract (both backends): the fallback writes to a ``.tmp``
+sibling and publishes with ``os.replace``, so a crash mid-save never leaves
+a torn file under the final name, and a truncated/corrupt pickle on load is
+reported as a clear error naming the path. The production layer above this
+(atomic directories, manifests, checksums, retention, discovery) is
+:class:`apex_tpu.resilience.CheckpointManager`.
 """
 
 from __future__ import annotations
@@ -20,47 +27,84 @@ import numpy as np
 
 Pytree = Any
 
+_PICKLE_SUFFIX = ".npz.pkl"
+
+
+def _orbax():
+    """The orbax.checkpoint module, or ``None`` (monkeypatchable seam —
+    tests force the numpy/pickle fallback through it)."""
+    try:
+        import orbax.checkpoint as ocp
+
+        return ocp
+    except ImportError:
+        return None
+
 
 def save_checkpoint(path: str, state: Pytree, step: Optional[int] = None,
                     overwrite: bool = True) -> str:
     """Write ``state`` (any pytree of arrays + scalars) under ``path``.
-    Returns the final checkpoint directory/file path."""
-    try:
-        import orbax.checkpoint as ocp
-
+    Returns the final checkpoint directory/file path. ``overwrite=False``
+    refuses an existing destination BEFORE any device transfer or write."""
+    ocp = _orbax()
+    if ocp is not None:
         p = os.path.abspath(path if step is None else f"{path}_{step}")
+        if not overwrite and os.path.exists(p):
+            raise FileExistsError(p)
         ckptr = ocp.PyTreeCheckpointer()
         ckptr.save(p, jax.device_get(state), force=overwrite)
         return p
-    except ImportError:
-        p = (path if step is None else f"{path}_{step}") + ".npz.pkl"
-        host = jax.tree_util.tree_map(np.asarray, jax.device_get(state))
-        if not overwrite and os.path.exists(p):
-            raise FileExistsError(p)
-        with open(p, "wb") as f:
+    p = (path if step is None else f"{path}_{step}") + _PICKLE_SUFFIX
+    if not overwrite and os.path.exists(p):
+        raise FileExistsError(p)
+    host = jax.tree_util.tree_map(np.asarray, jax.device_get(state))
+    # torn-write safety: stage then publish — a crash mid-dump leaves only
+    # the .tmp sibling, never a truncated pickle under the final name
+    import glob
+
+    for stale in glob.glob(f"{glob.escape(p)}.tmp.*"):
+        if not stale.endswith(f".{os.getpid()}"):
+            try:  # a dead writer's orphan: don't leak one per crash
+                os.remove(stale)
+            except OSError:
+                pass
+    tmp = f"{p}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
             pickle.dump(host, f)
-        return p
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, p)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.remove(tmp)
+        raise
+    return p
 
 
 def load_checkpoint(path: str, target: Optional[Pytree] = None) -> Pytree:
     """Read a checkpoint written by :func:`save_checkpoint`. ``target``:
     optional pytree of like-structured arrays used to restore dtypes/
     structure (orbax restore_args)."""
+    ocp = _orbax()
+    if ocp is not None and os.path.isdir(path):
+        ckptr = ocp.PyTreeCheckpointer()
+        restored = ckptr.restore(path)
+        if target is not None:
+            # scalar (non-array) target leaves — e.g. a scaler
+            # state_dict's plain floats/ints — restore as-is
+            restored = jax.tree_util.tree_map(
+                lambda t, r: (np.asarray(r, dtype=t.dtype)
+                              if hasattr(t, "dtype") else type(t)(r)),
+                target, restored)
+        return restored
     try:
-        import orbax.checkpoint as ocp
-
-        if os.path.isdir(path):
-            ckptr = ocp.PyTreeCheckpointer()
-            restored = ckptr.restore(path)
-            if target is not None:
-                # scalar (non-array) target leaves — e.g. a scaler
-                # state_dict's plain floats/ints — restore as-is
-                restored = jax.tree_util.tree_map(
-                    lambda t, r: (np.asarray(r, dtype=t.dtype)
-                                  if hasattr(t, "dtype") else type(t)(r)),
-                    target, restored)
-            return restored
-    except ImportError:
-        pass
-    with open(path, "rb") as f:
-        return pickle.load(f)
+        with open(path, "rb") as f:
+            return pickle.load(f)
+    except (pickle.UnpicklingError, EOFError, AttributeError) as e:
+        # a truncated tail raises EOFError, mid-file damage raises
+        # UnpicklingError (or worse) — both mean the same thing to a caller
+        raise ValueError(
+            f"checkpoint '{path}' is truncated or corrupt and cannot be "
+            f"unpickled ({type(e).__name__}: {e}); if an older checkpoint "
+            "exists, resume from that instead") from e

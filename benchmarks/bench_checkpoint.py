@@ -1,0 +1,114 @@
+"""Checkpoint-path benchmark: sync vs async save latency, bytes, restore.
+
+The resilience layer's claim is that durability stays off the step's
+critical path: an async ``CheckpointManager.save`` should cost the caller
+only the device→host transfer + checksum pass, with serialization and the
+atomic publish hidden on the worker thread. This bench measures exactly
+that split on a GPT-2-124M-shaped state (1:4 scale so the CPU box stays
+fast) and emits ONE JSON line — the ``bench.py`` / ``monitor.json_record``
+protocol — so checkpoint overhead joins the BENCH_* trajectory:
+
+* ``sync_save_ms`` — full blocking save (transfer + serialize + publish)
+* ``async_submit_ms`` — what the train loop actually pays per async save
+* ``async_drain_ms`` — worker time to finish the same save
+* ``restore_ms`` — verified restore (manifest + crc + unflatten)
+* ``verify_ms`` — ``latest_valid()`` discovery cost
+* ``bytes`` — manifest-accounted checkpoint payload
+
+Run: ``python benchmarks/bench_checkpoint.py`` (tier-1 box, no TPU).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import statistics
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.monitor import json_record
+from apex_tpu.resilience import CheckpointManager
+
+# a GPT-2-124M-shaped train state at 1:4 scale: params + 2 Adam moments
+# (fp32) + a handful of small leaves, ~93 MB on disk
+LEAVES = {
+    "embed": (768, 3264),
+    "blocks": (12, 768, 590),
+    "head": (768,),
+}
+REPS = 5
+
+
+def build_state():
+    key = jax.random.PRNGKey(0)
+    params = {
+        k: jax.random.normal(jax.random.fold_in(key, i), shape,
+                             dtype=jnp.float32)
+        for i, (k, shape) in enumerate(LEAVES.items())
+    }
+    return {
+        "params": params,
+        "mu": jax.tree.map(jnp.zeros_like, params),
+        "nu": jax.tree.map(jnp.ones_like, params),
+        "count": jnp.asarray(123, jnp.int32),
+    }
+
+
+def timed(fn) -> float:
+    t0 = time.perf_counter()
+    fn()
+    return (time.perf_counter() - t0) * 1000.0
+
+
+def main() -> None:
+    state = build_state()
+    jax.block_until_ready(state)
+    root = tempfile.mkdtemp(prefix="bench_ckpt_")
+    try:
+        sync_mgr = CheckpointManager(os.path.join(root, "sync"),
+                                     keep_last_n=2, fsync=False)
+        sync_ms = [timed(lambda s=s: sync_mgr.save(state, s))
+                   for s in range(REPS)]
+
+        amgr = CheckpointManager(os.path.join(root, "async"),
+                                 async_save=True, keep_last_n=2, fsync=False)
+        submit_ms, drain_ms = [], []
+        for s in range(REPS):
+            submit_ms.append(timed(lambda s=s: amgr.save(state, s)))
+            drain_ms.append(timed(amgr.wait))
+        amgr.close()
+
+        bytes_ = sync_mgr.last_save_bytes
+        latest = sync_mgr.latest_valid()
+        verify_ms = timed(lambda: sync_mgr.latest_valid())
+        template = jax.tree.map(jnp.zeros_like, state)
+        restore_ms = timed(
+            lambda: sync_mgr.restore(target=template, path=latest))
+
+        med = statistics.median
+        print(json_record(
+            bench="checkpoint",
+            bytes=bytes_,
+            sync_save_ms=round(med(sync_ms), 3),
+            async_submit_ms=round(med(submit_ms), 3),
+            async_drain_ms=round(med(drain_ms), 3),
+            restore_ms=round(restore_ms, 3),
+            verify_ms=round(verify_ms, 3),
+            hidden_fraction=round(
+                1.0 - med(submit_ms) / max(med(sync_ms), 1e-9), 4),
+            reps=REPS,
+        ))
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

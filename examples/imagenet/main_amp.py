@@ -68,12 +68,23 @@ def parse_args(argv=None):
     p.add_argument("--resume", default="", metavar="PATH",
                    help="path to a checkpoint to resume from (the "
                         "reference's --resume: restores model, optimizer, "
-                        "amp and batch-norm state plus the iteration)")
+                        "amp and batch-norm state plus the iteration); "
+                        "'auto' discovers the latest VALID checkpoint in "
+                        "--checkpoint-dir (torn/corrupt ones are skipped)")
     p.add_argument("--checkpoint-dir", default="",
                    help="save the full train state here (end of run, plus "
-                        "every --save-freq iters)")
+                        "every --save-freq iters) — atomic, manifested "
+                        "resilience.CheckpointManager checkpoints")
     p.add_argument("--save-freq", type=int, default=0,
                    help="checkpoint every N iters (0 = only at the end)")
+    p.add_argument("--keep-last-n", type=int, default=3,
+                   help="checkpoint retention (plus every --keep-every-k)")
+    p.add_argument("--keep-every-k", type=int, default=0)
+    p.add_argument("--async-save", action="store_true",
+                   help="serialize checkpoints off the critical path")
+    p.add_argument("--preempt-save", action="store_true",
+                   help="on SIGTERM: save a checkpoint at the agreed step "
+                        "and exit cleanly (requires --checkpoint-dir)")
     return p.parse_args(argv)
 
 
@@ -183,70 +194,61 @@ def train(args) -> List[float]:
     return _run_loop(args, step, amp_state, opt_state, batch_stats)
 
 
-def _state_fingerprint(state) -> str:
-    """Structure fingerprint: treedef + per-leaf shape/dtype. Leaves are
-    checkpointed by flat positional index and re-hung on the LIVE treedef,
-    so a same-leaf-count checkpoint from another code revision would
-    otherwise silently mis-bind optimizer/amp/BN state. Shape/dtype come
-    from the avals — no device-to-host copies."""
-    leaves, treedef = jax.tree.flatten(state)
-    per_leaf = ";".join(
-        f"{tuple(jnp.shape(x))}:{jnp.result_type(x)}" for x in leaves)
-    return f"{treedef}|{per_leaf}"
+def _make_manager(args):
+    from apex_tpu.resilience import CheckpointManager
 
-
-def _save_state(args, state, it: int) -> None:
-    import numpy as np
-
-    from apex_tpu.utils.checkpoint import save_checkpoint
-
-    # the fingerprint rides as a uint8 array: both checkpoint backends
-    # (orbax, pickle) round-trip arrays; strings only survive one of them
-    fp = np.frombuffer(_state_fingerprint(state).encode(), dtype=np.uint8)
-    blob = {"leaves": {str(i): leaf
-                       for i, leaf in enumerate(jax.tree.leaves(state))},
-            "it": jnp.asarray(it),
-            "fingerprint": fp}
-    p = save_checkpoint(os.path.join(args.checkpoint_dir, "ckpt"), blob,
-                        step=it)
-    print(f"=> saved checkpoint '{p}' (iter {it})")
+    return CheckpointManager(
+        args.checkpoint_dir, keep_last_n=args.keep_last_n,
+        keep_every_k=args.keep_every_k, async_save=args.async_save)
 
 
 def _run_loop(args, step, amp_state, opt_state, batch_stats) -> List[float]:
+    from apex_tpu.resilience import CheckpointError, PreemptionHandler
+
     state = (amp_state, opt_state, batch_stats)
+    mgr = _make_manager(args) if args.checkpoint_dir else None
     start_it = 0
     if args.resume:
         # the reference's resume contract: restore model/optimizer/amp
-        # state and continue at the saved iteration. Leaves are stored
-        # flat and re-hung on the LIVE treedef (orbax restores plain
-        # dicts; the amp/opt containers are custom nodes).
-        from apex_tpu.utils.checkpoint import load_checkpoint
-
-        blob = load_checkpoint(args.resume)
-        if "fingerprint" in blob:
-            import numpy as np
-
-            saved = bytes(np.asarray(blob["fingerprint"],
-                                     np.uint8)).decode()
-            live = _state_fingerprint(state)
-            if saved != live:
+        # state and continue at the saved iteration. The manager re-hangs
+        # the flat leaves on the LIVE treedef after verifying the manifest
+        # fingerprint + per-leaf checksums — a torn or revision-skewed
+        # checkpoint is refused, not mis-bound.
+        restore_mgr = mgr or _make_manager(args)
+        if args.resume == "auto":
+            if not args.checkpoint_dir:
+                raise SystemExit("--resume auto needs --checkpoint-dir")
+            # a standing relaunch flag: no checkpoint yet (first launch,
+            # or all torn) means start fresh, not die
+            path = restore_mgr.latest_valid()
+        else:
+            path = args.resume
+        if path is not None:
+            try:
+                state, start_it = restore_mgr.restore(target=state,
+                                                      path=path)
+            except CheckpointError as e:
+                raise SystemExit(f"=> {e}")
+            print(f"=> loaded checkpoint '{path}' (resuming at iter "
+                  f"{start_it})")
+            if start_it >= args.iters:
                 raise SystemExit(
-                    f"=> checkpoint '{args.resume}' was written by a "
-                    "different train-state revision — refusing to "
-                    "mis-bind state.\n"
-                    f"   saved: {saved[:200]}...\n"
-                    f"   live:  {live[:200]}...")
-        n = len(jax.tree.leaves(state))
-        leaves = [jnp.asarray(blob["leaves"][str(i)]) for i in range(n)]
-        state = jax.tree.unflatten(jax.tree.structure(state), leaves)
-        start_it = int(blob["it"])
-        print(f"=> loaded checkpoint '{args.resume}' (resuming at iter "
-              f"{start_it})")
-        if start_it >= args.iters:
-            raise SystemExit(
-                f"checkpoint is already at iter {start_it} >= --iters "
-                f"{args.iters}; nothing to resume (raise --iters)")
+                    f"checkpoint is already at iter {start_it} >= --iters "
+                    f"{args.iters}; nothing to resume (raise --iters)")
+        else:
+            print(f"=> no valid checkpoint in '{args.checkpoint_dir}' yet; "
+                  "starting fresh")
     amp_state, opt_state, batch_stats = state
+
+    pre = None
+    if args.preempt_save:
+        if mgr is None:
+            raise SystemExit("--preempt-save needs --checkpoint-dir")
+        pre = PreemptionHandler()
+
+    def save(state, it):
+        p = mgr.save(state, it)
+        print(f"=> saved checkpoint '{p}' (iter {it})")
 
     losses = []
     data_rng = jax.random.PRNGKey(args.seed + 1)
@@ -265,10 +267,23 @@ def _run_loop(args, step, amp_state, opt_state, batch_stats) -> List[float]:
             dt = time.perf_counter() - t0
             ips = args.batch_size * (it - start_it + 1) / dt
             print(f"iter {it:4d}  loss {losses[-1]:.6f}  {ips:,.1f} img/s")
-        if args.checkpoint_dir and (
+        if pre is not None:
+            save_at = pre.sync_save_step(it)
+            if save_at is not None:
+                # preemption: all processes agreed on this step — save
+                # synchronously inside the grace window and stop
+                p = mgr.save((amp_state, opt_state, batch_stats),
+                             save_at + 1, block=True)
+                print(f"=> saved checkpoint '{p}' (iter {save_at + 1})")
+                mgr.close()
+                print(f"=> preempted at iter {save_at}; exiting after save")
+                return losses
+        if mgr is not None and (
                 it == args.iters - 1
                 or (args.save_freq and (it + 1) % args.save_freq == 0)):
-            _save_state(args, (amp_state, opt_state, batch_stats), it + 1)
+            save((amp_state, opt_state, batch_stats), it + 1)
+    if mgr is not None:
+        mgr.close()  # drain async saves before the process exits
     return losses
 
 

@@ -1,0 +1,717 @@
+"""Chaos-driven recovery tests for ``apex_tpu.resilience``.
+
+Every claim the subsystem makes is proven against an injected failure:
+a NaN at step k must be survived (the loss curve rejoins the clean run),
+a deliberately corrupted checkpoint must be skipped by ``latest_valid()``,
+and resume-after-simulated-preemption must be bit-identical to an
+uninterrupted run on CPU. All tests are stock-jax-safe (no shard_map) —
+the guard/checkpoint/preemption machinery is mesh-agnostic pytree code.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp.scaler import LossScaler
+from apex_tpu.monitor import Metrics
+from apex_tpu.resilience import (
+    AnomalyGuard,
+    AnomalyHalted,
+    CheckpointError,
+    CheckpointManager,
+    GuardPolicy,
+    PreemptionAtStep,
+    PreemptionHandler,
+    StallWatchdog,
+    chaos,
+    fingerprint,
+)
+
+# ---------------------------------------------------------------------------
+# shared fixture: a tiny deterministic quadratic trainer (data built
+# eagerly at import — creating it lazily inside a traced step would cache
+# tracers)
+
+_X = jnp.asarray(np.random.RandomState(0).randn(32, 4).astype(np.float32))
+_Y = _X @ jnp.arange(1.0, 5.0)  # realizable: the clean loss goes to ~0
+
+
+def _data():
+    return _X, _Y
+
+
+def _loss_fn(w):
+    X, Y = _data()
+    return jnp.mean((X @ w - Y) ** 2)
+
+
+def _make_guarded_step(guard, chaos_step=-1, mode="nan", lr=0.1):
+    """One jitted SGD step with optional in-graph NaN/Inf injection."""
+
+    @jax.jit
+    def step(params, gstate, metrics, it):
+        loss, grads = jax.value_and_grad(_loss_fn)(params)
+        grads = chaos.inject_nonfinite(grads, it, chaos_step, mode=mode)
+        proposed = params - lr * grads
+        bad, metrics = guard.check(loss=loss, grads=grads, metrics=metrics)
+        params, gstate, metrics = guard.apply(
+            gstate, bad, proposed, params, metrics=metrics)
+        return params, gstate, metrics, loss
+
+    return step
+
+
+def _seed_metrics():
+    return Metrics({"anomalies_total": 0.0, "nonfinite_loss_total": 0.0,
+                    "nonfinite_grads_total": 0.0, "guard_skips_total": 0.0,
+                    "rollbacks_total": 0.0, "guard_halted": 0.0})
+
+
+def _run(guard, n, chaos_step=-1, mode="nan"):
+    params = jnp.zeros(4)
+    gstate = guard.init(params)
+    metrics = _seed_metrics()
+    step = _make_guarded_step(guard, chaos_step, mode)
+    losses = []
+    for it in range(n):
+        params, gstate, metrics, loss = step(
+            params, gstate, metrics, jnp.asarray(it))
+        losses.append(float(loss))
+    return params, gstate, metrics.as_dict(), losses
+
+
+# ---------------------------------------------------------------------------
+# anomaly guard
+
+def test_nan_at_step_k_is_survived_and_curve_rejoins():
+    """The acceptance gate: a NaN gradient injected at step k is absorbed
+    by a skip and the loss curve rejoins the clean baseline."""
+    guard = AnomalyGuard(GuardPolicy(on_anomaly="skip", skip_budget=3))
+    _, _, clean_m, clean = _run(guard, 60)
+    params, _, m, chaotic = _run(guard, 60, chaos_step=5)
+
+    assert np.isfinite(np.asarray(params)).all()
+    assert m["nonfinite_grads_total"] == 1.0
+    assert m["guard_skips_total"] == 1.0
+    assert m["rollbacks_total"] == 0.0
+    assert m["guard_halted"] == 0.0
+    assert clean_m["anomalies_total"] == 0.0
+    # rejoins the clean run: both converged, final losses agree
+    assert clean[-1] < 1e-2 and chaotic[-1] < 1e-2
+    assert abs(clean[-1] - chaotic[-1]) < 1e-2
+    # the chaotic loss at the injected step was the already-poisoned one's
+    # objective value — still finite (loss is computed pre-injection here),
+    # and every recorded loss is finite because the poison never landed
+    assert np.isfinite(chaotic).all()
+
+
+def test_inf_injection_also_caught():
+    guard = AnomalyGuard(GuardPolicy(on_anomaly="skip"))
+    params, _, m, _ = _run(guard, 10, chaos_step=2, mode="inf")
+    assert np.isfinite(np.asarray(params)).all()
+    assert m["nonfinite_grads_total"] == 1.0
+
+
+def test_rollback_restores_lagged_snapshot_exactly():
+    """on_anomaly='rollback': the bad step restores the carried snapshot
+    bit-exactly. The snapshot lags the live state by one ACCEPTED step —
+    it is the newest state whose health a step's own finite loss/grads
+    vouched for."""
+    guard = AnomalyGuard(GuardPolicy(on_anomaly="rollback",
+                                     rollback_budget=5))
+    step = _make_guarded_step(guard, chaos_step=4)
+    params = jnp.zeros(4)
+    gstate = guard.init(params)
+    metrics = _seed_metrics()
+    history = []
+    for it in range(4):  # clean steps
+        history.append(np.asarray(params))
+        params, gstate, metrics, _ = step(params, gstate, metrics,
+                                          jnp.asarray(it))
+    # entering the bad step the live state is history[3]'s successor; the
+    # snapshot is the state step 3's checks validated: history[3]
+    params, gstate, metrics, _ = step(params, gstate, metrics,
+                                      jnp.asarray(4))
+    np.testing.assert_array_equal(np.asarray(params), history[3])
+    m = metrics.as_dict()
+    assert m["rollbacks_total"] == 1.0 and m["guard_skips_total"] == 0.0
+
+
+def test_rollback_recovers_from_state_poisoning_missed_by_one_step():
+    """Poison that reaches the STATE while the step's own detectors stay
+    clean (finite grads) must not enter the snapshot: the next step's
+    checks expose it and rollback restores a pre-poison state."""
+    guard = AnomalyGuard(GuardPolicy(on_anomaly="rollback",
+                                     rollback_budget=5))
+
+    @jax.jit
+    def step(params, gstate, metrics, poison):
+        loss, grads = jax.value_and_grad(_loss_fn)(params)
+        proposed = params - 0.1 * grads
+        # state-poisoning path the detectors don't see at this step
+        proposed = jnp.where(poison, proposed * jnp.nan, proposed)
+        bad, metrics = guard.check(loss=loss, grads=grads, metrics=metrics)
+        params, gstate, metrics = guard.apply(
+            gstate, bad, proposed, params, metrics=metrics)
+        return params, gstate, metrics
+
+    params = jnp.zeros(4)
+    gstate = guard.init(params)
+    metrics = _seed_metrics()
+    for _ in range(3):
+        params, gstate, metrics = step(params, gstate, metrics,
+                                       jnp.asarray(False))
+    pre_poison = np.asarray(params)
+    # poisoned step: grads/loss are finite (computed from healthy params),
+    # so the guard accepts the NaN'd proposed state...
+    params, gstate, metrics = step(params, gstate, metrics,
+                                   jnp.asarray(True))
+    assert not np.isfinite(np.asarray(params)).all()
+    # ...but the NEXT step's checks fire and rollback restores a finite
+    # pre-poison state (the lagged snapshot), not the poisoned one
+    params, gstate, metrics = step(params, gstate, metrics,
+                                   jnp.asarray(False))
+    assert np.isfinite(np.asarray(params)).all()
+    np.testing.assert_array_equal(np.asarray(params), pre_poison)
+
+
+def test_persistent_nan_escalates_skip_rollback_halt():
+    """The ladder: skip_budget skips, then rollbacks, then halt — and the
+    params stay finite (the last-good snapshot) throughout."""
+    guard = AnomalyGuard(GuardPolicy(on_anomaly="skip", skip_budget=2,
+                                     rollback_budget=1))
+    params = jnp.ones(4)
+    gstate = guard.init(params)
+    metrics = _seed_metrics()
+
+    @jax.jit
+    def bad_step(params, gstate, metrics):
+        grads = params * jnp.nan
+        proposed = params - 0.1 * grads
+        bad, metrics = guard.check(grads=grads, metrics=metrics)
+        return *guard.apply(gstate, bad, proposed, params, metrics=metrics),
+
+    halted_at = None
+    for it in range(10):
+        params, gstate, metrics = bad_step(params, gstate, metrics)
+        try:
+            guard.raise_if_halted(gstate)
+        except AnomalyHalted:
+            halted_at = it
+            break
+    m = metrics.as_dict()
+    # 2 skips (budget), then rollbacks; the 2nd rollback breaches
+    # rollback_budget=1 and halts → 4 bad steps total
+    assert halted_at == 3
+    assert m["guard_skips_total"] == 2.0
+    assert m["rollbacks_total"] == 2.0
+    assert m["guard_halted"] == 1.0
+    assert np.isfinite(np.asarray(params)).all()
+
+
+def test_clean_step_resets_escalation():
+    """A clean step between anomalies resets the consecutive counters —
+    isolated blips never walk the ladder."""
+    guard = AnomalyGuard(GuardPolicy(on_anomaly="skip", skip_budget=1,
+                                     rollback_budget=0))
+    step = _make_guarded_step(guard, chaos_step=-1)
+    poisoned = _make_guarded_step(guard, chaos_step=0)  # fires when it==0
+    params = jnp.zeros(4)
+    gstate = guard.init(params)
+    metrics = _seed_metrics()
+    for _ in range(4):  # bad, good, bad, good ... never two bad in a row
+        params, gstate, metrics, _ = poisoned(params, gstate, metrics,
+                                              jnp.asarray(0))
+        params, gstate, metrics, _ = step(params, gstate, metrics,
+                                          jnp.asarray(1))
+    m = metrics.as_dict()
+    assert m["guard_skips_total"] == 4.0
+    assert m["rollbacks_total"] == 0.0 and m["guard_halted"] == 0.0
+
+
+def test_guard_consumes_scaler_found_inf():
+    """AMP wiring: the guard spends budget on the scaler's found_inf — an
+    fp16 overflow is an anomaly like any other."""
+    scaler = LossScaler("dynamic")
+    sstate = scaler.init_state()
+    guard = AnomalyGuard(GuardPolicy(on_anomaly="skip"))
+    grads = {"w": jnp.asarray([1.0, jnp.inf])}
+    _, found_inf = scaler.unscale(grads, sstate)
+    bad, m = guard.check(found_inf=found_inf, metrics=_seed_metrics())
+    assert float(bad) == 1.0
+    assert m.as_dict()["anomalies_total"] == 1.0
+    # clean grads → no anomaly
+    _, ok = scaler.unscale({"w": jnp.ones(2)}, sstate)
+    assert float(guard.check(found_inf=ok)) == 0.0
+
+
+def test_guard_init_requires_state_for_rollback():
+    with pytest.raises(ValueError):
+        AnomalyGuard(GuardPolicy(on_anomaly="rollback")).init()
+    # halt-only guards carry no snapshot and need no state
+    g = AnomalyGuard(GuardPolicy(on_anomaly="halt")).init()
+    assert g.snapshot == ()
+
+
+# ---------------------------------------------------------------------------
+# checkpoint manager
+
+def _rich_state():
+    """A train-state pytree with the real members: params, AMP scaler
+    state, synthetic ZeRO shards (count + master/mu/nu), EF residuals."""
+    from apex_tpu.contrib.optimizers.distributed_fused_adam import (
+        DistAdamState,
+    )
+
+    scaler = LossScaler("dynamic")
+    zero = DistAdamState(
+        count=jnp.asarray(7, jnp.int32),
+        master={"w": jnp.arange(8.0), "b": jnp.arange(2.0)},
+        mu={"w": jnp.ones(8) * 0.5, "b": jnp.zeros(2)},
+        nu={"w": jnp.ones(8) * 0.25, "b": jnp.zeros(2)})
+    return {
+        "params": {"w": jnp.arange(8.0) * 1.5, "b": jnp.asarray(0.5)},
+        "scaler": scaler.init_state(),
+        "zero": zero,
+        "ef_residual": {"w": jnp.linspace(0, 1, 8), "b": jnp.zeros(2)},
+    }
+
+
+def test_checkpoint_roundtrip_bit_identical(tmp_path):
+    state = _rich_state()
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(state, 3)
+    restored, step = mgr.restore(target=jax.tree.map(jnp.zeros_like, state))
+    assert step == 3
+    for got, want in zip(jax.tree.leaves(restored), jax.tree.leaves(state)):
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        assert got.dtype == want.dtype
+
+
+def test_checkpoint_refuses_fingerprint_mismatch(tmp_path):
+    state = _rich_state()
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(state, 1)
+    wrong = dict(state, params={"w": jnp.zeros(9), "b": jnp.asarray(0.0)})
+    with pytest.raises(CheckpointError, match="different"):
+        mgr.restore(target=wrong)
+
+
+@pytest.mark.parametrize("mode", ["truncate", "flip", "delete"])
+def test_latest_valid_skips_corrupt_payload(tmp_path, mode):
+    """The acceptance gate: a deliberately corrupted checkpoint is skipped
+    by latest_valid() and resume lands on the older good one."""
+    state = _rich_state()
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(state, 1)
+    mgr.save(state, 2)
+    chaos.corrupt_checkpoint(mgr.step_path(2), part="payload", mode=mode)
+    assert not mgr.verify(mgr.step_path(2))
+    assert mgr.latest_valid() == mgr.step_path(1)
+    restored, step = mgr.restore(target=jax.tree.map(jnp.zeros_like, state))
+    assert step == 1
+    np.testing.assert_array_equal(
+        np.asarray(restored["params"]["w"]),
+        np.asarray(state["params"]["w"]))
+
+
+def test_latest_valid_skips_corrupt_manifest(tmp_path):
+    state = _rich_state()
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(state, 1)
+    mgr.save(state, 2)
+    chaos.corrupt_checkpoint(mgr.step_path(2), part="manifest", mode="flip")
+    assert mgr.latest_valid() == mgr.step_path(1)
+
+
+def test_verify_catches_silent_crc_mismatch(tmp_path):
+    """Payload loads fine but one leaf's bytes don't match the manifest
+    crc — the silent-corruption case checksums exist for."""
+    state = _rich_state()
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(state, 1)
+    assert mgr.verify(mgr.step_path(1))
+    chaos.make_manifest_lie(mgr.step_path(1))
+    assert not mgr.verify(mgr.step_path(1))
+    assert mgr.latest_valid() is None
+    with pytest.raises(CheckpointError):
+        mgr.restore(target=state)
+
+
+def test_restore_wraps_unreadable_paths_in_checkpoint_error(tmp_path):
+    """A typo'd --resume path or a pre-manager-format file raises
+    CheckpointError (catchable by drivers), not a raw FileNotFoundError."""
+    state = {"w": jnp.ones(3)}
+    mgr = CheckpointManager(str(tmp_path))
+    with pytest.raises(CheckpointError, match="no valid checkpoint"):
+        mgr.restore(target=state)
+    with pytest.raises(CheckpointError, match="not a readable checkpoint"):
+        mgr.restore(target=state, path=str(tmp_path / "nope"))
+    legacy = tmp_path / "old_ckpt.npz.pkl"
+    legacy.write_bytes(b"not a manager checkpoint")
+    with pytest.raises(CheckpointError, match="not a readable checkpoint"):
+        mgr.restore(target=state, path=str(legacy))
+
+
+def test_gc_sweeps_stale_staging_and_recovers_orphan_trash(tmp_path):
+    """Crash-orphaned staging from a dead pid: .tmp-* (never complete) is
+    deleted; .trash-* (a previously-published copy parked by a same-step
+    re-save that crashed between its two renames) is RESTORED when it is
+    the only copy of that step, deleted when the step was re-published."""
+    state = {"w": jnp.ones(3)}
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(state, 2)
+    # a dead writer's leftovers: junk staging + a parked copy of step 1
+    # (the only copy) + a parked superseded copy of step 2
+    stale = tmp_path / ".tmp-ckpt_00000009-99999999"
+    stale.mkdir()
+    (stale / "junk").write_bytes(b"x" * 128)
+    os.rename(mgr.step_path(2),
+              tmp_path / ".trash-ckpt_00000001-99999999")
+    mgr.save(state, 2)  # publish + post-publish sweep
+    assert [n for n in os.listdir(tmp_path)
+            if n.startswith((".tmp-", ".trash-"))] == []
+    # step 1 came back from the trash (it was the only copy) — recovery
+    # is by directory move, content untouched
+    assert os.path.isdir(mgr.step_path(1))
+
+
+def test_torn_tmp_dir_is_invisible(tmp_path):
+    """A staging dir left by a crashed save is not a checkpoint."""
+    state = {"w": jnp.ones(3)}
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(state, 1)
+    torn = tmp_path / ".tmp-ckpt_00000002-999"
+    torn.mkdir()
+    (torn / "manifest.json").write_text("{")
+    assert mgr.all_steps() == [1]
+    assert mgr.latest_valid() == mgr.step_path(1)
+
+
+def test_same_step_resave_replaces_cleanly(tmp_path):
+    """Re-saving an existing step parks the old copy and publishes the new
+    one — no torn mixture, no staging/trash litter left behind."""
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save({"w": jnp.zeros(4)}, 1)
+    mgr.save({"w": jnp.ones(4)}, 1)
+    restored, _ = mgr.restore(target={"w": jnp.zeros(4)})
+    np.testing.assert_array_equal(np.asarray(restored["w"]), np.ones(4))
+    assert [n for n in os.listdir(tmp_path)
+            if n.startswith((".tmp-", ".trash-"))] == []
+
+
+def test_retention_keep_last_n_and_every_k(tmp_path):
+    state = {"w": jnp.ones(3)}
+    mgr = CheckpointManager(str(tmp_path), keep_last_n=2, keep_every_k=4)
+    for s in range(1, 10):
+        mgr.save(state, s)
+    # last 2 = {8, 9}; milestones {4, 8} survive the GC
+    assert mgr.all_steps() == [4, 8, 9]
+
+
+def test_async_save_off_critical_path(tmp_path):
+    state = _rich_state()
+    mgr = CheckpointManager(str(tmp_path), async_save=True, keep_last_n=10)
+    for s in range(5):
+        mgr.save(state, s)
+    mgr.close()  # drains the worker; re-raises its errors
+    assert mgr.all_steps() == [0, 1, 2, 3, 4]
+    for s in range(5):
+        assert mgr.verify(mgr.step_path(s))
+    assert mgr.last_save_ms is not None and mgr.last_save_bytes > 0
+
+
+def test_save_records_ckpt_telemetry(tmp_path):
+    """ckpt_save_ms / ckpt_bytes ride the monitor JSONL sink."""
+    from apex_tpu.monitor import JsonlSink, read_jsonl
+
+    path = str(tmp_path / "metrics.jsonl")
+    with JsonlSink(path, buffer_steps=1) as sink:
+        mgr = CheckpointManager(str(tmp_path / "ck"), sink=sink)
+        mgr.save({"w": jnp.ones(16)}, 5)
+    recs = list(read_jsonl(path))
+    assert len(recs) == 1
+    assert recs[0]["step"] == 5
+    assert recs[0]["ckpt_save_ms"] > 0
+    assert recs[0]["ckpt_bytes"] == 64
+
+
+# ---------------------------------------------------------------------------
+# preemption + bit-identical resume
+
+def _amp_loop(ckpt_dir, n_steps, preempt_at=None):
+    """Deterministic AMP train loop with auto-resume; data keyed by the
+    absolute step so an interrupted+resumed run sees the same batches."""
+    scaler = LossScaler("dynamic")
+
+    @jax.jit
+    def step(params, sstate, it):
+        X, Y = _data()
+        xb = X + 0.01 * it  # step-keyed data, deterministic
+        def loss_fn(w):
+            loss = jnp.mean((xb @ w - Y) ** 2)
+            return scaler.scale_loss(loss, sstate), loss
+        grads, loss = jax.grad(loss_fn, has_aux=True)(params)
+        grads, found_inf = scaler.unscale(grads, sstate)
+        new_sstate, skip = scaler.update_scale(sstate, found_inf)
+        new_params = jnp.where(skip, params, params - 0.05 * grads)
+        return new_params, new_sstate, loss
+
+    params = jnp.zeros(4)
+    sstate = scaler.init_state()
+    state = (params, sstate)
+    mgr = CheckpointManager(ckpt_dir)
+    start = 0
+    if mgr.latest_valid() is not None:
+        state, start = mgr.restore(target=state)
+    params, sstate = state
+    pre = PreemptionHandler(install=False)
+    trigger = PreemptionAtStep(pre, preempt_at) if preempt_at is not None \
+        else None
+    losses = []
+    for it in range(start, n_steps):
+        params, sstate, loss = step(params, sstate, jnp.asarray(it))
+        losses.append(float(loss))
+        if trigger is not None:
+            trigger.maybe_fire(it)
+            save_at = pre.sync_save_step(it)
+            if save_at is not None:
+                mgr.save((params, sstate), save_at + 1, block=True)
+                return losses, (params, sstate), True
+    return losses, (params, sstate), False
+
+
+def test_preemption_resume_bit_identical(tmp_path):
+    """The acceptance gate: simulated preemption at step k leaves a valid
+    checkpoint, and the resumed run continues bit-identically to an
+    uninterrupted run on CPU (scaler state included)."""
+    clean_losses, (clean_p, clean_s), _ = _amp_loop(
+        str(tmp_path / "clean"), 12)
+
+    d = str(tmp_path / "pre")
+    first, _, preempted = _amp_loop(d, 12, preempt_at=4)
+    assert preempted and len(first) == 5  # steps 0..4 ran, saved at 5
+    mgr = CheckpointManager(d)
+    assert mgr.latest_valid() is not None and mgr.verify(mgr.latest_valid())
+
+    rest, (res_p, res_s), _ = _amp_loop(d, 12)  # auto-resume
+    assert first + rest == clean_losses
+    np.testing.assert_array_equal(np.asarray(res_p), np.asarray(clean_p))
+    for got, want in zip(jax.tree.leaves(res_s), jax.tree.leaves(clean_s)):
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_preemption_sync_every_and_local_flag():
+    pre = PreemptionHandler(install=False, sync_every=4)
+    assert pre.sync_save_step(0) is None  # not preempted
+    pre.trigger()
+    assert pre.preempted()
+    assert pre.sync_save_step(5) is None  # off-cadence step: no barrier
+    assert pre.sync_save_step(8) == 8
+
+
+def test_sigterm_sets_flag_and_chains_previous():
+    import signal
+
+    seen = []
+    old = signal.signal(signal.SIGTERM, lambda s, f: seen.append(s))
+    try:
+        pre = PreemptionHandler()
+        os.kill(os.getpid(), signal.SIGTERM)
+        deadline = time.monotonic() + 2
+        while not pre.preempted() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert pre.preempted()
+        assert seen == [signal.SIGTERM]  # previous handler still ran
+        pre.uninstall()
+    finally:
+        signal.signal(signal.SIGTERM, old)
+
+
+# ---------------------------------------------------------------------------
+# stall watchdog
+
+def test_watchdog_dumps_diagnostics_and_rearms(tmp_path):
+    from apex_tpu.monitor import JsonlSink, read_jsonl
+
+    path = str(tmp_path / "stall.jsonl")
+    hits = []
+    with JsonlSink(path, buffer_steps=1) as sink:
+        wd = StallWatchdog(0.25, sink=sink, on_stall=hits.append,
+                           poll_s=0.05)
+        with wd:
+            wd.tick(step=3)
+            time.sleep(0.5)  # stall fires once (one-shot until re-armed)
+            first = wd.stalls
+            time.sleep(0.3)
+            assert wd.stalls == first  # no re-fire without a tick
+            wd.tick(step=4)
+            time.sleep(0.5)
+    assert wd.stalls == 2 and len(hits) == 2
+    recs = list(read_jsonl(path))
+    assert len(recs) == 2
+    assert recs[0]["step"] == 3 and recs[0]["stall_s"] >= 0.25
+    assert "test_resilience" in recs[0]["stacks"]  # this frame is in there
+
+
+# ---------------------------------------------------------------------------
+# ZeRO / DDP state through the manifest path
+
+def test_zero_optimizer_state_dict_roundtrip():
+    from apex_tpu.contrib.optimizers import DistributedFusedAdam
+    from apex_tpu.contrib.optimizers.distributed_fused_adam import (
+        DistAdamState,
+    )
+
+    opt = DistributedFusedAdam()
+    state = DistAdamState(
+        count=jnp.asarray(11, jnp.int32),
+        master={"w": jnp.arange(16.0)},
+        mu={"w": jnp.linspace(0, 1, 16)},
+        nu={"w": jnp.linspace(1, 2, 16)})
+    d = opt.state_dict(state)
+    template = jax.tree.map(jnp.zeros_like, state)
+    restored = opt.load_state_dict(template, d)
+    for got, want in zip(jax.tree.leaves(restored), jax.tree.leaves(state)):
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    # a different dp degree halves the shard: refused, not mis-bound
+    wrong = DistAdamState(
+        count=jnp.asarray(0, jnp.int32),
+        master={"w": jnp.zeros(8)}, mu={"w": jnp.zeros(8)},
+        nu={"w": jnp.zeros(8)})
+    with pytest.raises(CheckpointError):
+        opt.load_state_dict(wrong, d)
+
+
+def test_ddp_comm_state_dict_roundtrip():
+    from apex_tpu.comm import CompressionConfig
+    from apex_tpu.parallel import DistributedDataParallel
+
+    ddp = DistributedDataParallel(
+        compression=CompressionConfig(policy="int8_ef"))
+    grads = {"w": jnp.ones((4, 4)), "b": jnp.ones(4)}
+    cs = ddp.init_comm_state(grads)
+    cs = jax.tree.map(lambda r: r + 0.5, cs)  # non-trivial residuals
+    d = ddp.comm_state_dict(cs)
+    cs2 = ddp.load_comm_state_dict(ddp.init_comm_state(grads), d)
+    for got, want in zip(jax.tree.leaves(cs2), jax.tree.leaves(cs)):
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    # no-compression DDP: None stays None through both directions
+    plain = DistributedDataParallel()
+    assert plain.comm_state_dict(plain.init_comm_state(grads)) is None
+    assert plain.load_comm_state_dict(None, None) is None
+
+
+# ---------------------------------------------------------------------------
+# satellites
+
+def test_scaler_load_state_dict_rejects_corrupt_scale():
+    sc = LossScaler("dynamic")
+    good = sc.state_dict(sc.init_state())
+    for bad in (float("nan"), float("inf"), 0.0, -128.0):
+        with pytest.raises(ValueError, match="loss_scale"):
+            sc.load_state_dict(dict(good, loss_scale=bad))
+
+
+def test_scaler_load_state_dict_clamps_into_bounds():
+    sc = LossScaler("dynamic", min_loss_scale=1.0, max_loss_scale=2.0 ** 24)
+    good = sc.state_dict(sc.init_state())
+    assert float(sc.load_state_dict(
+        dict(good, loss_scale=2.0 ** 40)).loss_scale) == 2.0 ** 24
+    assert float(sc.load_state_dict(
+        dict(good, loss_scale=2.0 ** -40)).loss_scale) == 1.0
+    # static scalers keep their stored value (min/max govern the dynamic
+    # policy only)
+    st = LossScaler(0.5)
+    assert float(st.load_state_dict(
+        dict(good, loss_scale=0.5)).loss_scale) == 0.5
+
+
+def test_pickle_fallback_is_atomic_and_loud(tmp_path, monkeypatch):
+    from apex_tpu.utils import checkpoint as uc
+
+    monkeypatch.setattr(uc, "_orbax", lambda: None)
+    state = {"w": jnp.arange(6.0)}
+    p = uc.save_checkpoint(str(tmp_path / "ck"), state, step=1)
+    assert p.endswith(".npz.pkl")
+    # no staging litter after a successful publish
+    assert [f for f in os.listdir(tmp_path) if ".tmp." in f] == []
+    np.testing.assert_array_equal(
+        np.asarray(uc.load_checkpoint(p)["w"]), np.arange(6.0))
+
+    # overwrite=False refuses BEFORE writing anything
+    with pytest.raises(FileExistsError):
+        uc.save_checkpoint(str(tmp_path / "ck"), state, step=1,
+                           overwrite=False)
+
+    # a truncated pickle is a clear error naming the path, not a raw
+    # UnpicklingError/EOFError
+    chaos.corrupt_file(p, mode="truncate", nbytes=16)
+    with pytest.raises(ValueError, match="truncated or corrupt"):
+        uc.load_checkpoint(p)
+    with pytest.raises(ValueError, match=os.path.basename(p)):
+        uc.load_checkpoint(p)
+
+
+def test_orbax_save_honors_overwrite_false(tmp_path):
+    from apex_tpu.utils import checkpoint as uc
+
+    if uc._orbax() is None:
+        pytest.skip("orbax unavailable")
+    state = {"w": jnp.arange(4.0)}
+    uc.save_checkpoint(str(tmp_path / "ck"), state, step=1)
+    with pytest.raises(FileExistsError):
+        uc.save_checkpoint(str(tmp_path / "ck"), state, step=1,
+                           overwrite=False)
+
+
+def test_sink_flushes_on_interpreter_exit(tmp_path):
+    """The atexit fallback: a run that never calls close() still lands its
+    buffered tail on disk at normal interpreter exit."""
+    path = str(tmp_path / "tail.jsonl")
+    code = (
+        "import os; os.environ['JAX_PLATFORMS']='cpu'\n"
+        "from apex_tpu.monitor import JsonlSink\n"
+        f"s = JsonlSink({path!r}, buffer_steps=1000)\n"
+        "s.write(step=1, loss=2.5)\n"
+        "s.write(step=2, loss=1.5)\n"
+        "# no close(), no with-block: atexit must flush\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    subprocess.run([sys.executable, "-c", code], check=True, env=env,
+                   cwd=os.path.dirname(os.path.dirname(
+                       os.path.abspath(__file__))), timeout=240)
+    with open(path) as f:
+        recs = [json.loads(line) for line in f]
+    assert [r["step"] for r in recs] == [1, 2]
+
+
+def test_sink_close_unregisters_atexit(tmp_path):
+    from apex_tpu.monitor import JsonlSink
+
+    s = JsonlSink(str(tmp_path / "x.jsonl"), buffer_steps=10)
+    assert s._atexit_registered
+    s.write(step=0, a=1.0)
+    s.close()
+    assert not s._atexit_registered
+    s.close()  # idempotent
+    with open(tmp_path / "x.jsonl") as f:
+        assert len(f.readlines()) == 1
+
+
+def test_fingerprint_detects_shape_dtype_and_structure_changes():
+    base = {"a": jnp.zeros((2, 3)), "b": jnp.zeros(4, jnp.int32)}
+    assert fingerprint(base) == fingerprint(
+        {"a": jnp.ones((2, 3)), "b": jnp.ones(4, jnp.int32)})
+    assert fingerprint(base) != fingerprint(
+        {"a": jnp.zeros((3, 2)), "b": jnp.zeros(4, jnp.int32)})
+    assert fingerprint(base) != fingerprint(
+        {"a": jnp.zeros((2, 3)), "b": jnp.zeros(4, jnp.float32)})
+    assert fingerprint(base) != fingerprint({"a": jnp.zeros((2, 3))})
