@@ -16,12 +16,18 @@ Modules: ``quantize`` (the int8 codec, pure-JAX + Pallas), ``collectives``
 (the two-pass quantized allreduce / reduce-scatter), ``error_feedback``
 (the residual pytree + checkpoint round-trip), ``accounting`` (bytes-on-
 wire pricing of compiled HLO — how the compression claim is *asserted*,
-see ``tests/test_collective_counts.py``).
+see ``tests/test_collective_counts.py``), ``overlap`` (ppermute-decomposed
+collective matmuls — ``all_gather_matmul`` / ``matmul_reduce_scatter`` /
+``matmul_all_reduce`` — that hide the remaining collective latency behind
+partial GEMMs; the TP layers take them via ``overlap_comm=`` and the
+overlap is proved from compiled HLO by ``accounting.overlap_report``).
 """
 
 from apex_tpu.comm.accounting import (  # noqa: F401
     CollectiveReport,
+    OverlapReport,
     collective_report,
+    overlap_report,
     wire_bytes,
 )
 from apex_tpu.comm.collectives import (  # noqa: F401
@@ -37,6 +43,14 @@ from apex_tpu.comm.error_feedback import (  # noqa: F401
     load_state_dict,
     state_dict,
 )
+from apex_tpu.comm.overlap import (  # noqa: F401
+    all_gather_matmul,
+    all_gather_matmul_wire_bytes,
+    matmul_all_reduce,
+    matmul_all_reduce_wire_bytes,
+    matmul_reduce_scatter,
+    matmul_reduce_scatter_wire_bytes,
+)
 from apex_tpu.comm.quantize import (  # noqa: F401
     dequantize_blockwise,
     quantization_error,
@@ -46,6 +60,9 @@ from apex_tpu.comm.quantize import (  # noqa: F401
 __all__ = [
     "CollectiveReport",
     "CompressionConfig",
+    "OverlapReport",
+    "all_gather_matmul",
+    "all_gather_matmul_wire_bytes",
     "all_gather_wire_bytes",
     "allreduce_wire_bytes",
     "collective_report",
@@ -54,6 +71,11 @@ __all__ = [
     "dequantize_blockwise",
     "init_error_feedback",
     "load_state_dict",
+    "matmul_all_reduce",
+    "matmul_all_reduce_wire_bytes",
+    "matmul_reduce_scatter",
+    "matmul_reduce_scatter_wire_bytes",
+    "overlap_report",
     "psum_scatter_wire_bytes",
     "quantization_error",
     "quantize_blockwise",

@@ -22,15 +22,32 @@ collective whose *result* occupies ``b`` bytes in a group of ``W``:
 The absolute numbers are a model (real ICI topologies do better or worse
 by constant factors); *ratios between two programs on the same mesh* — the
 quantity the tests assert — are exact, because the model is linear in
-bytes. Group sizes come from each op's ``replica_groups``; async pairs
-(``all-reduce-start``/``-done``) are counted once at the ``-start``.
+bytes. Group sizes come from each op's ``replica_groups``.
+
+Async pairs (``collective-permute-start``/``-done`` etc. — what the TPU
+latency-hiding scheduler emits, and what the :mod:`overlap` decomposition
+makes common) are counted once at the ``-start`` and priced from the
+``-start``'s OPERANDS: an async start's *result* type is a tuple aliasing
+the input buffer next to the output (plus ``u32[]`` context scalars), so
+pricing it like a sync result would double-charge every async collective.
+
+:func:`overlap_report` is the comm/compute-overlap prover built on the
+same parsed HLO: it pairs each ``collective-permute-start`` with its
+``-done`` and counts ``dot``\\ s *scheduled inside the window* (compiled
+TPU modules print in schedule order), and for pre-schedule/CPU modules —
+which emit synchronous ``collective-permute`` — it falls back to a
+def-use reachability check: a hop counts as hideable when some ``dot`` in
+the same computation neither feeds it nor consumes it, i.e. a
+latency-hiding scheduler is free to run the two concurrently. This is the
+repo's established prove-it-from-the-HLO methodology applied to overlap
+(``tests/test_collective_counts.py::assert_overlapped``).
 """
 
 from __future__ import annotations
 
 import dataclasses
 import re
-from typing import Dict, Optional
+from typing import Dict, List, Optional, Tuple
 
 COLLECTIVE_KINDS = (
     "all-reduce",
@@ -56,7 +73,7 @@ _SHAPE_RE = re.compile(r"\b([a-z]+[0-9]+[a-z0-9]*|pred)\[([0-9,]*)\]")
 # get-tuple-element lines reference "%all-to-all.4)" without a following '('
 _OP_RE = re.compile(
     r"\b(all-reduce|all-gather|reduce-scatter|all-to-all|collective-permute)"
-    r"(?:-start)?\(")
+    r"(-start)?\(")
 _GROUPS_LIST_RE = re.compile(r"replica_groups=\{\{([0-9,]+)\}")
 _GROUPS_IOTA_RE = re.compile(r"replica_groups=\[(\d+),(\d+)\]")
 
@@ -91,6 +108,19 @@ def _result_bytes(type_str: str) -> int:
                 n *= int(d)
         total += n * _DTYPE_BYTES[dtype]
     return total
+
+
+def _paren_span(line: str, open_idx: int) -> str:
+    """The text inside the balanced parens opening at ``open_idx``."""
+    depth = 0
+    for i in range(open_idx, len(line)):
+        if line[i] == "(":
+            depth += 1
+        elif line[i] == ")":
+            depth -= 1
+            if depth == 0:
+                return line[open_idx + 1 : i]
+    return line[open_idx + 1 :]  # unterminated (truncated dump): best effort
 
 
 def _group_size(line: str, default: int) -> int:
@@ -140,11 +170,24 @@ def collective_report(hlo, default_group_size: Optional[int] = None
         if " = " not in pre:
             continue  # not a definition line
         kind = m.group(1)
-        # result type = everything between the assignment and the op name
-        # (tuple-form all-to-all prints "/*index=N*/" comments in there —
-        # the shape tokenizer skips them)
-        b = _result_bytes(pre.rsplit(" = ", 1)[1])
         w = _group_size(line, default_group_size or 1)
+        if m.group(2):
+            # async "-start": its RESULT is a tuple aliasing the operand
+            # buffer next to the output (+ u32[] context scalars) — pricing
+            # it would double-charge. Price from the operand types instead
+            # and reconstruct the sync op's result bytes.
+            b_op = _result_bytes(_paren_span(line, m.end() - 1))
+            if kind == "all-gather":
+                b = b_op * w  # sync result = the gathered buffer
+            elif kind == "reduce-scatter":
+                b = -(-b_op // w) if w else b_op  # sync result = one shard
+            else:  # all-reduce / all-to-all / collective-permute
+                b = b_op
+        else:
+            # result type = everything between the assignment and the op
+            # name (tuple-form all-to-all prints "/*index=N*/" comments in
+            # there — the shape tokenizer skips them)
+            b = _result_bytes(pre.rsplit(" = ", 1)[1])
         counts[kind] += 1
         rbytes[kind] += b
         wire[kind] += _wire_cost(kind, b, w)
@@ -155,3 +198,179 @@ def collective_report(hlo, default_group_size: Optional[int] = None
 def wire_bytes(hlo, default_group_size: Optional[int] = None) -> float:
     """Total modeled bytes-on-wire per device for one execution."""
     return collective_report(hlo, default_group_size).wire_bytes
+
+
+# ---------------------------------------------------------------------------
+# overlap proving — is the collective latency hidden behind matmuls?
+
+_INSTR_RE = re.compile(r"^\s*(?:ROOT\s+)?%?([\w.-]+)\s*=\s*")
+_OPCODE_RE = re.compile(r"\b([a-z][\w-]*)\(")
+_OPERAND_RE = re.compile(r"%([\w.-]+)")
+_CALLED_RE = re.compile(r"(?:calls|to_apply|body|condition|branch_"
+                        r"computations)=\{?%?([\w.-]+)")
+_COMP_HEAD_RE = re.compile(r"^\s*(?:ENTRY\s+)?%?([\w.-]+)")
+
+
+@dataclasses.dataclass
+class OverlapReport:
+    """Comm/compute overlap evidence read off one HLO module.
+
+    ``async_pairs`` / ``async_hidden``: ``collective-permute-start``/
+    ``-done`` pairs, and how many have ≥1 ``dot`` *scheduled inside the
+    start→done window* (post-schedule TPU modules print in schedule order
+    — a dot in the window executes while the permute is in flight: proof).
+
+    ``sync_permutes`` / ``sync_hidden``: synchronous ``collective-permute``
+    ops (pre-schedule or CPU modules), and how many have ≥1 ``dot`` in the
+    same computation that neither feeds them nor consumes them — the
+    data-independence a latency-hiding scheduler needs to overlap the two
+    (eligibility, not proof; the async numbers are the proof).
+
+    ``hidden_wire_bytes`` / ``exposed_wire_bytes``: the permute traffic
+    split by that evidence — the decomposition's goal is driving the
+    exposed share to ~0 while ``collective_report`` shows total bytes
+    unchanged.
+    """
+
+    async_pairs: int = 0
+    async_hidden: int = 0
+    sync_permutes: int = 0
+    sync_hidden: int = 0
+    dots: int = 0
+    hidden_wire_bytes: float = 0.0
+    exposed_wire_bytes: float = 0.0
+
+    @property
+    def permutes(self) -> int:
+        return self.async_pairs + self.sync_permutes
+
+    @property
+    def hidden(self) -> int:
+        return self.async_hidden + self.sync_hidden
+
+    @property
+    def hidden_fraction(self) -> float:
+        total = self.hidden_wire_bytes + self.exposed_wire_bytes
+        return self.hidden_wire_bytes / total if total else 0.0
+
+    def __repr__(self):
+        return (f"OverlapReport(async {self.async_hidden}/{self.async_pairs}"
+                f" hidden, sync {self.sync_hidden}/{self.sync_permutes}"
+                f" overlappable, dots={self.dots}, hidden_bytes="
+                f"{self.hidden_wire_bytes:.0f}, exposed_bytes="
+                f"{self.exposed_wire_bytes:.0f})")
+
+
+def _parse_computations(text: str):
+    """-> {comp_name: [(name, opcode, line), ...]} in print (schedule)
+    order. Instructions outside any recognized computation header land in
+    an ``""`` bucket so bare snippets (synthetic tests) still parse."""
+    comps: Dict[str, List[Tuple[str, str, str]]] = {}
+    current = ""
+    for line in text.splitlines():
+        if line.rstrip().endswith("{") and " = " not in line:
+            m = _COMP_HEAD_RE.match(line)
+            if m and m.group(1) != "HloModule":
+                current = m.group(1)
+            continue
+        if line.strip() == "}":
+            current = ""
+            continue
+        m = _INSTR_RE.match(line)
+        if not m or " = " not in line:
+            continue
+        after = line.split(" = ", 1)[1]
+        op = _OPCODE_RE.search(after)
+        comps.setdefault(current, []).append(
+            (m.group(1), op.group(1) if op else "", line))
+    return comps
+
+
+def _dot_bearing(comps) -> set:
+    """Names of computations that (transitively) execute a ``dot``."""
+    direct = {c for c, instrs in comps.items()
+              if any(op == "dot" for _, op, _ in instrs)}
+    changed = True
+    while changed:
+        changed = False
+        for c, instrs in comps.items():
+            if c in direct:
+                continue
+            for _, _, line in instrs:
+                if any(callee in direct
+                       for callee in _CALLED_RE.findall(line)):
+                    direct.add(c)
+                    changed = True
+                    break
+    return direct
+
+
+def _is_dot_like(op: str, line: str, dot_comps: set) -> bool:
+    if op == "dot":
+        return True
+    return any(callee in dot_comps for callee in _CALLED_RE.findall(line))
+
+
+def overlap_report(hlo) -> OverlapReport:
+    """Measure how much ``collective-permute`` traffic travels behind a
+    ``dot`` (see :class:`OverlapReport`). ``hlo``: text or anything with
+    ``.as_text()``. Async pairs are judged by schedule position, sync
+    permutes by def-use independence within their computation."""
+    text = hlo if isinstance(hlo, str) else hlo.as_text()
+    comps = _parse_computations(text)
+    dot_comps = _dot_bearing(comps)
+    rep = OverlapReport()
+    for comp, instrs in comps.items():
+        index = {name: i for i, (name, _, _) in enumerate(instrs)}
+        # def-use adjacency (operand -> user), same computation only
+        users: Dict[str, List[str]] = {}
+        deps: Dict[str, List[str]] = {}
+        dot_idx = []
+        for i, (name, op, line) in enumerate(instrs):
+            rhs = line.split(" = ", 1)[1]
+            ops_of = [o for o in _OPERAND_RE.findall(rhs)
+                      if o in index and o != name]
+            deps[name] = ops_of
+            for o in ops_of:
+                users.setdefault(o, []).append(name)
+            if _is_dot_like(op, line, dot_comps):
+                dot_idx.append(i)
+        rep.dots += len(dot_idx)
+
+        def _reach(start: str, edges) -> set:
+            seen, stack = set(), [start]
+            while stack:
+                n = stack.pop()
+                for nxt in edges.get(n, ()):  # noqa: B023
+                    if nxt not in seen:
+                        seen.add(nxt)
+                        stack.append(nxt)
+            return seen
+
+        for i, (name, op, line) in enumerate(instrs):
+            if op == "collective-permute-start":
+                open_idx = line.index("collective-permute-start(") \
+                    + len("collective-permute-start")
+                b = float(_result_bytes(_paren_span(line, open_idx)))
+                done = next((j for j, (n2, op2, l2) in enumerate(instrs)
+                             if op2 == "collective-permute-done"
+                             and name in _OPERAND_RE.findall(
+                                 l2.split(" = ", 1)[1])), None)
+                rep.async_pairs += 1
+                if done is not None and any(i < d < done for d in dot_idx):
+                    rep.async_hidden += 1
+                    rep.hidden_wire_bytes += b
+                else:
+                    rep.exposed_wire_bytes += b
+            elif op == "collective-permute":
+                pre = line.split(" = ", 1)[1]
+                open_idx = pre.index("collective-permute(")
+                b = float(_result_bytes(pre[:open_idx]))
+                rep.sync_permutes += 1
+                blocked = _reach(name, users) | _reach(name, deps) | {name}
+                if any(instrs[d][0] not in blocked for d in dot_idx):
+                    rep.sync_hidden += 1
+                    rep.hidden_wire_bytes += b
+                else:
+                    rep.exposed_wire_bytes += b
+    return rep

@@ -102,3 +102,8 @@ class TransformerParallelConfig:
     micro_batch_size: int = 1
     global_batch_size: int = 1
     params_dtype: jnp.dtype = jnp.float32
+    # decompose TP-boundary collectives into ppermute rings overlapped
+    # with partial GEMMs (apex_tpu.comm.overlap) — the analogue of the
+    # reference DDP's overlap_reductions / the async-allreduce linears;
+    # forwarded to GPTConfig.overlap_comm / the *ParallelLinear layers
+    overlap_comm: bool = False

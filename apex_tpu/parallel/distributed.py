@@ -15,10 +15,22 @@ itself (``lax.psum`` over the ``dp`` mesh axis), (b) dtype policy, (c)
 pre/post scaling, and (d) **bucketing** — concatenating many small grads into
 a few flat buffers so the ICI sees large transfers (the reference's
 ``message_size`` batching; XLA also combines small all-reduces itself, this
-makes the batching explicit and deterministic). Comm/compute overlap is XLA's
-latency-hiding scheduler's job — the psums are emitted inside the jitted step
-so the scheduler interleaves them with the optimizer math, replacing the
-reference's manual side streams + events (``distributed.py:411-470``).
+makes the batching explicit and deterministic).
+
+Comm/compute overlap: the per-bucket collectives are emitted inside the
+jitted step so XLA's latency-hiding scheduler interleaves them with
+independent work, replacing the reference's manual side streams + events
+(``distributed.py:411-470``) — but a ``lax.scan`` is a scheduling barrier:
+accumulate microbatch grads in a scan and every bucket's reduce waits for
+the whole loop. :meth:`DistributedDataParallel.accumulate_and_average`
+restores the reference's hook-driven overlap shape (``overlap_reductions``,
+``delay_allreduce=False``): it scans all-but-the-last microbatch, runs the
+LAST microbatch's backward unrolled outside the scan, and emits the bucket
+reduces in **reverse production order** — each bucket's collective depends
+only on its own leaves' final contributions, so the late-layer buckets
+(whose grads finalize first in backward) launch while the front of the
+backward is still computing. :meth:`average_gradients` emits the same
+reverse order on the barriered path, where it is a free scheduler hint.
 """
 
 from __future__ import annotations
@@ -69,11 +81,14 @@ def _rebuild(comm_state, new_leaves):
 
 def _record_comm_metrics(metrics, bucket_bytes, baseline_bytes):
     """Record per-bucket + total modeled wire bytes and the compression
-    ratio into a monitor ``Metrics`` (all trace-time constants)."""
-    total = float(sum(bucket_bytes))
-    base = float(sum(baseline_bytes))
-    entries = {f"comm_bucket{i}_bytes": b
-               for i, b in enumerate(bucket_bytes)}
+    ratio into a monitor ``Metrics`` (all trace-time constants).
+    ``bucket_bytes``/``baseline_bytes`` are keyed by BUCKET INDEX (tree
+    order), so the ``comm_bucket{i}_bytes`` labels are stable however the
+    reduction emission order is scheduled."""
+    total = float(sum(bucket_bytes.values()))
+    base = float(sum(baseline_bytes.values()))
+    entries = {f"comm_bucket{i}_bytes": bucket_bytes[i]
+               for i in sorted(bucket_bytes)}
     entries["comm_wire_bytes"] = total
     entries["comm_compression_ratio"] = base / total if total else 1.0
     return metrics.record(**entries)
@@ -207,9 +222,10 @@ class DistributedDataParallel:
                 "compression policy 'int8_ef' carries state: pass comm_state="
                 "ddp.init_comm_state(grads) and thread the returned state")
         # per-bucket modeled (actual, uncompressed-baseline) wire bytes —
-        # python floats from static shapes, appended as buckets reduce
-        bucket_bytes: List[float] = []
-        baseline_bytes: List[float] = []
+        # python floats from static shapes, keyed by bucket index (tree
+        # order) so the labels are emission-order-independent
+        bucket_bytes: dict = {}
+        baseline_bytes: dict = {}
 
         # uniform calling convention: state appended iff passed in, then
         # metrics iff passed in
@@ -229,12 +245,11 @@ class DistributedDataParallel:
             return wrap(grads, comm_state)
         world = self._world()
 
-        def _account(n: int, dtype) -> None:
+        def _account(bi: int, n: int, dtype) -> None:
             base_item = 4 if self.allreduce_always_fp32 else dtype.itemsize
-            bucket_bytes.append(
-                allreduce_wire_bytes(n, base_item, world, cfg))
-            baseline_bytes.append(
-                allreduce_wire_bytes(n, base_item, world, None))
+            bucket_bytes[bi] = allreduce_wire_bytes(n, base_item, world, cfg)
+            baseline_bytes[bi] = allreduce_wire_bytes(n, base_item, world,
+                                                     None)
 
         # Predivide is applied unconditionally before the allreduce — it is
         # the fp16/bf16 overflow guard; only the post-multiply is gated on
@@ -275,12 +290,21 @@ class DistributedDataParallel:
             # bucket i at step s replay bucket i+1 at step s-1
             return None if seed is None else fold_seed(seed, i)
 
+        # Reverse production order (satellite of the overlap work): the
+        # backward emits the LAST layers' grads first, so the highest-index
+        # buckets/leaves (tree order tracks forward order) finalize
+        # earliest — emitting their reduces first is the reference's
+        # arrival-order trick (``distributed.py:283-318``): the scheduler
+        # sees launchable collectives while the front of the backward is
+        # still computing. Pure emission-order change: bucket contents,
+        # seeds and metric labels stay keyed by bucket index.
         if not self.flat_buckets:
             out = [None] * len(leaves)
-            for i, g in enumerate(leaves):
+            for i in reversed(range(len(leaves))):
+                g = leaves[i]
                 r = res_leaves[i].reshape(-1) if res_leaves is not None \
                     else None
-                _account(g.size, g.dtype)
+                _account(i, g.size, g.dtype)
                 red, r_new = _reduce_flat(g.reshape(-1), r, _bucket_seed(i))
                 out[i] = red.reshape(g.shape).astype(g.dtype)
                 if new_res is not None and r_new is not None:
@@ -289,10 +313,11 @@ class DistributedDataParallel:
                         _rebuild(comm_state, new_res))
 
         out = [None] * len(leaves)
-        for bi, (dt, idxs) in enumerate(
-                _flatten_buckets(leaves, self.message_size)):
+        buckets = _flatten_buckets(leaves, self.message_size)
+        for bi in reversed(range(len(buckets))):
+            _dt, idxs = buckets[bi]
             flat = jnp.concatenate([leaves[i].reshape(-1) for i in idxs])
-            _account(flat.size, flat.dtype)
+            _account(bi, flat.size, flat.dtype)
             residual = None
             if res_leaves is not None:
                 residual = jnp.concatenate(
@@ -310,6 +335,95 @@ class DistributedDataParallel:
                 offset += n
         return wrap(jax.tree_util.tree_unflatten(treedef, out),
                     _rebuild(comm_state, new_res))
+
+    def accumulate_and_average(
+        self,
+        value_and_grad_fn,
+        params: Any,
+        microbatches: Any,
+        *,
+        microbatch_keys: Optional[Any] = None,
+        unroll: int = 1,
+        enabled: bool = True,
+        comm_state: Optional[Any] = None,
+        seed=None,
+        metrics: Optional[Any] = None,
+    ):
+        """Grad accumulation with overlap-scheduled reduction — the
+        reference's ``overlap_reductions`` (``delay_allreduce=False``)
+        rebuilt for XLA scheduling.
+
+        The barriered recipe (``forward_backward_no_pipelining`` + one
+        :meth:`average_gradients` after it) hides nothing: a ``lax.scan``
+        releases ALL its outputs at once, so every bucket's collective
+        waits for the full backward. This method restructures the same
+        math — scan the first ``M-1`` microbatches, run the LAST
+        microbatch's backward **unrolled outside the scan**, and emit the
+        bucket reduces (via :meth:`average_gradients`, reverse production
+        order) against it: each bucket's collective depends only on its
+        own leaves' final-microbatch contributions, which materialize
+        progressively through the unrolled backward, so the late-layer
+        buckets launch while the early layers' dX/dW GEMMs are still
+        running — grad-hook arrival-order overlap, from dataflow alone.
+
+        ``value_and_grad_fn(params, microbatch[, key]) -> (loss, grads)``
+        (close over ``ddp.replicate`` / loss scaling as needed);
+        ``microbatches``: pytree with leading dim ``M``;
+        ``microbatch_keys``: optional ``[M, ...]`` per-microbatch PRNG
+        keys. Remaining kwargs go to :meth:`average_gradients`.
+
+        Returns ``(mean_loss, grads[, comm_state][, metrics])`` —
+        **loss-curve-identical** to the barriered path: the scan
+        accumulates ``(((g₁+g₂)+…)+g_{M-1})`` and the peeled step adds
+        ``g_M`` last, the exact association the full scan performs, and
+        the reduction math is shared — only the schedule changes
+        (``tests/test_overlap.py`` pins the equality, int8+EF included).
+        """
+        leaves = jax.tree_util.tree_leaves(microbatches)
+        if not leaves:
+            raise ValueError("microbatches is an empty pytree")
+        m = leaves[0].shape[0]
+
+        def call(mb, key):
+            from apex_tpu.monitor.trace import span
+
+            with span("fwd_bwd"):
+                return (value_and_grad_fn(params, mb) if key is None
+                        else value_and_grad_fn(params, mb, key))
+
+        def take(i):
+            return jax.tree_util.tree_map(lambda x: x[i], microbatches)
+        last_key = (None if microbatch_keys is None
+                    else microbatch_keys[m - 1])
+        if m > 1:
+            head = jax.tree_util.tree_map(lambda x: x[: m - 1], microbatches)
+            zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+
+            def body(acc, mk):
+                mb, key = mk
+                loss_sum, gacc = acc
+                l, g = call(mb, key)
+                return (loss_sum + l,
+                        jax.tree_util.tree_map(jnp.add, gacc, g)), None
+
+            if microbatch_keys is not None:
+                (loss_sum, gacc), _ = lax.scan(
+                    body, (jnp.zeros(()), zeros),
+                    (head, microbatch_keys[: m - 1]), unroll=unroll)
+            else:
+                (loss_sum, gacc), _ = lax.scan(
+                    lambda acc, mb: body(acc, (mb, None)),
+                    (jnp.zeros(()), zeros), head, unroll=unroll)
+            l_last, g_last = call(take(m - 1), last_key)
+            loss_sum = loss_sum + l_last
+            grads = jax.tree_util.tree_map(jnp.add, gacc, g_last)
+        else:
+            loss_sum, grads = call(take(0), last_key)
+        red = self.average_gradients(grads, enabled=enabled,
+                                     comm_state=comm_state, seed=seed,
+                                     metrics=metrics)
+        red = red if isinstance(red, tuple) else (red,)
+        return (loss_sum / m,) + red
 
     def broadcast_params(self, params: Any) -> Any:
         """Make all ranks along the axis agree on rank-0's values (ref param

@@ -24,6 +24,15 @@ Two TPU-native strategies over the ``sp`` mesh axis:
 Both are pure functions usable inside ``shard_map`` over the global mesh and
 differentiable (the VJP of ``ppermute``/``all_to_all`` is the inverse
 collective, so the backward pass rotates the opposite way automatically).
+
+The ring here is also the repo's comm/compute-overlap archetype: each
+K/V hop is data-independent of the attention block computed while it is
+in flight, so the scheduler hides the rotation behind the math.
+:mod:`apex_tpu.comm.overlap` applies the same decomposition to the
+TP-boundary collective→matmul chains (Megatron-SP entry/exit and the
+row-parallel psum — ``GPTConfig.overlap_comm``), and
+``comm.accounting.overlap_report`` proves the hiding from compiled HLO
+for both rings.
 """
 
 from __future__ import annotations

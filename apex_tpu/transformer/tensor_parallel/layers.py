@@ -15,9 +15,15 @@ TPU re-design:
   natural ``shard_map`` formulation: one program per device, weights of shape
   ``(in, out/tp)`` (column) / ``(in/tp, out)`` (row). (JAX kernels are
   ``(in, out)``; the reference stores the torch-transposed ``(out, in)``.)
-* The backward collectives come from the :mod:`mappings` custom-VJP functions;
-  comm/compute overlap (the "async allreduce") is XLA's latency-hiding
-  scheduler reordering the psum against the dW dot — no streams to manage.
+* The backward collectives come from the :mod:`mappings` custom-VJP functions.
+  Comm/compute overlap: for *independent* ops XLA's latency-hiding scheduler
+  reorders the psum against the dW dot on its own — but it cannot overlap a
+  **dependent** collective→matmul chain (the SP entry all-gather feeding the
+  GEMM, the GEMM feeding the exit reduce-scatter/psum). ``overlap_comm=True``
+  switches those sites to :mod:`apex_tpu.comm.overlap`'s decomposed
+  collective matmuls — ppermute rings interleaved with partial GEMMs, the
+  reference's "async allreduce" capability (:217-269) generalized — with
+  custom VJPs so backward overlaps too.
 * Gradient-accumulation fusion into fp32 main_grad is
   :mod:`apex_tpu.optimizers.grad_accumulation` — ``accumulate_gradients``
   scans microbatches adding model-dtype dW into an fp32 accumulator; XLA
@@ -42,6 +48,7 @@ from apex_tpu.transformer.tensor_parallel.mappings import (
     copy_to_tensor_model_parallel_region,
     gather_from_sequence_parallel_region,
     gather_from_tensor_model_parallel_region,
+    pvary_like,
     reduce_from_tensor_model_parallel_region,
     reduce_scatter_to_sequence_parallel_region,
     scatter_to_tensor_model_parallel_region,
@@ -126,21 +133,32 @@ def column_parallel_linear(
     gather_output: bool = True,
     axis_name: str = TP_AXIS,
     sequence_parallel: bool = False,
+    overlap_comm: bool = False,
 ):
     """Y_i = X @ A_i (+ b_i); A sharded on the output dim (ref forward
     :443-463). ``kernel``: (in, out/tp). With ``sequence_parallel`` the
     input is the sequence-local shard (b, s/tp, h) and is all-gathered
-    along seq on entry (Megatron-SP ``g``; reduce-scatter in backward)."""
-    if sequence_parallel:
-        x = gather_from_sequence_parallel_region(x, axis_name)
+    along seq on entry (Megatron-SP ``g``; reduce-scatter in backward).
+    ``overlap_comm`` decomposes that entry gather into the
+    :func:`~apex_tpu.comm.overlap.all_gather_matmul` ppermute ring so the
+    hops hide behind partial GEMMs, forward and backward (no-op without
+    ``sequence_parallel`` — the plain entry is a collective-free copy)."""
+    if sequence_parallel and overlap_comm:
+        from apex_tpu.comm.overlap import all_gather_matmul
+
+        k = pvary_like(kernel.astype(x.dtype), x)
+        y = all_gather_matmul(x, k, axis_name=axis_name, gather_axis=1)
     else:
-        x = copy_to_tensor_model_parallel_region(x, axis_name)
-    # dot in the input dtype: the MXU accumulates bf16 x bf16 in fp32
-    # regardless, so the result equals the explicit preferred-fp32 +
-    # round-to-bf16 form — but a bf16 OUTPUT keeps the backward's
-    # cotangents bf16, so dX/dW also ride the fast MXU path instead
-    # of fp32 dots (~4x slower); with fp32 params nothing changes
-    y = jnp.dot(x, kernel.astype(x.dtype))
+        if sequence_parallel:
+            x = gather_from_sequence_parallel_region(x, axis_name)
+        else:
+            x = copy_to_tensor_model_parallel_region(x, axis_name)
+        # dot in the input dtype: the MXU accumulates bf16 x bf16 in fp32
+        # regardless, so the result equals the explicit preferred-fp32 +
+        # round-to-bf16 form — but a bf16 OUTPUT keeps the backward's
+        # cotangents bf16, so dX/dW also ride the fast MXU path instead
+        # of fp32 dots (~4x slower); with fp32 params nothing changes
+        y = jnp.dot(x, kernel.astype(x.dtype))
     if bias is not None:
         y = y + bias
     if gather_output:
@@ -156,23 +174,44 @@ def row_parallel_linear(
     input_is_parallel: bool = False,
     axis_name: str = TP_AXIS,
     sequence_parallel: bool = False,
+    overlap_comm: bool = False,
 ):
     """Y = sum_i X_i @ A_i (+ b); A sharded on the input dim (ref forward
     :560-576). ``kernel``: (in/tp, out); bias added once, after the reduce.
     With ``sequence_parallel`` the partial sums are reduce-scattered along
-    seq (Megatron-SP ``ḡ``) and the result is the (b, s/tp, out) shard."""
+    seq (Megatron-SP ``ḡ``) and the result is the (b, s/tp, out) shard.
+    ``overlap_comm`` decomposes the exit collective
+    (:func:`~apex_tpu.comm.overlap.matmul_reduce_scatter` under SP,
+    :func:`~apex_tpu.comm.overlap.matmul_all_reduce` otherwise) into a
+    ppermute ring of partial GEMMs; needs the seq dim divisible by the
+    axis size, and the non-SP result comes back TYPE-varying (equal
+    values) rather than axis-invariant — the monolithic value either way,
+    up to fp addition reorder in the ring sum."""
     if not input_is_parallel:
         x = scatter_to_tensor_model_parallel_region(x, axis_name)
-    # dot in the input dtype: the MXU accumulates bf16 x bf16 in fp32
-    # regardless, so the result equals the explicit preferred-fp32 +
-    # round-to-bf16 form — but a bf16 OUTPUT keeps the backward's
-    # cotangents bf16, so dX/dW also ride the fast MXU path instead
-    # of fp32 dots (~4x slower); with fp32 params nothing changes
-    y = jnp.dot(x, kernel.astype(x.dtype))
-    if sequence_parallel:
-        y = reduce_scatter_to_sequence_parallel_region(y, axis_name)
+    if overlap_comm:
+        from apex_tpu.comm.overlap import (
+            matmul_all_reduce,
+            matmul_reduce_scatter,
+        )
+
+        k = pvary_like(kernel.astype(x.dtype), x)
+        if sequence_parallel:
+            y = matmul_reduce_scatter(x, k, axis_name=axis_name,
+                                      scatter_axis=1)
+        else:
+            y = matmul_all_reduce(x, k, axis_name=axis_name, scatter_axis=1)
     else:
-        y = reduce_from_tensor_model_parallel_region(y, axis_name)
+        # dot in the input dtype: the MXU accumulates bf16 x bf16 in fp32
+        # regardless, so the result equals the explicit preferred-fp32 +
+        # round-to-bf16 form — but a bf16 OUTPUT keeps the backward's
+        # cotangents bf16, so dX/dW also ride the fast MXU path instead
+        # of fp32 dots (~4x slower); with fp32 params nothing changes
+        y = jnp.dot(x, kernel.astype(x.dtype))
+        if sequence_parallel:
+            y = reduce_scatter_to_sequence_parallel_region(y, axis_name)
+        else:
+            y = reduce_from_tensor_model_parallel_region(y, axis_name)
     if bias is not None:
         y = y + bias
     return y
@@ -227,6 +266,10 @@ class ColumnParallelLinear(nn.Module):
     skip_bias_add: bool = False
     params_dtype: jnp.dtype = jnp.float32
     axis_name: str = TP_AXIS
+    sequence_parallel: bool = False
+    # decompose the SP entry all-gather into the comm.overlap ppermute
+    # ring (the reference's sequence_parallel_enabled + async-comm knobs)
+    overlap_comm: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -254,6 +297,8 @@ class ColumnParallelLinear(nn.Module):
             None if self.skip_bias_add else bias,
             gather_output=self.gather_output,
             axis_name=self.axis_name,
+            sequence_parallel=self.sequence_parallel,
+            overlap_comm=self.overlap_comm,
         )
         return y, (bias if self.skip_bias_add else None)
 
@@ -269,6 +314,9 @@ class RowParallelLinear(nn.Module):
     skip_bias_add: bool = False
     params_dtype: jnp.dtype = jnp.float32
     axis_name: str = TP_AXIS
+    sequence_parallel: bool = False
+    # decompose the exit reduce-scatter/psum into the comm.overlap rings
+    overlap_comm: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -298,5 +346,7 @@ class RowParallelLinear(nn.Module):
             None if self.skip_bias_add else bias,
             input_is_parallel=self.input_is_parallel,
             axis_name=self.axis_name,
+            sequence_parallel=self.sequence_parallel,
+            overlap_comm=self.overlap_comm,
         )
         return y, (bias if self.skip_bias_add else None)

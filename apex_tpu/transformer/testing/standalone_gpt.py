@@ -105,6 +105,19 @@ class GPTConfig:
     # within each sp shard). Cuts the non-TP activation memory by tp× and
     # shrinks pipeline p2p tensors the same way.
     megatron_sp: bool = False
+    # Decompose the layers' TP-boundary collectives into ppermute rings
+    # interleaved with partial GEMMs (apex_tpu.comm.overlap): under
+    # megatron_sp the QKV/FC1 entry all-gathers become all_gather_matmul
+    # and the out-proj/FC2 exit reduce-scatters matmul_reduce_scatter;
+    # without it the row-parallel exit psums become matmul_all_reduce.
+    # Custom VJPs keep backward overlapped too. XLA cannot hide a
+    # DEPENDENT collective→matmul chain on its own — this flag is the
+    # reference's async-allreduce capability (tensor_parallel/layers.py:
+    # 217-269) rebuilt for the TPU ring. Numerics: all-gather side exact;
+    # reduce side equal up to fp addition reorder (ring association).
+    # Needs the (sp-local) sequence divisible by tp. The MoE FFN and the
+    # LM head keep their monolithic collectives.
+    overlap_comm: bool = False
     # num_experts > 0 replaces every layer's MLP with a mixture-of-experts
     # FFN (transformer.moe): top-k capacity routing, experts sharded over
     # the dp(=ep) mesh axis with all_to_all dispatch, expert FFN weights
@@ -150,7 +163,7 @@ class GPTConfig:
     def head_dim(self) -> int:
         return self.hidden // self.num_heads
 
-    def validate(self, tp: int = 1) -> None:
+    def validate(self, tp: int = 1, sp: int = 1) -> None:
         if self.hidden % self.num_heads:
             raise ValueError("hidden must be divisible by num_heads")
         for name, dim in (("vocab_size", self.vocab_size),
@@ -166,6 +179,14 @@ class GPTConfig:
             raise ValueError(
                 f"megatron_sp needs max_seq ({self.max_seq}) divisible by "
                 f"tp ({tp})")
+        if self.overlap_comm and self.max_seq % (tp * sp):
+            # the rings shard the SP-LOCAL sequence by tp, so the full
+            # sequence must split across both axes (validate(tp) alone
+            # cannot see ring-sp; callers composing with sp pass it)
+            raise ValueError(
+                f"overlap_comm rings shard the sp-local sequence by tp: "
+                f"max_seq ({self.max_seq}) must be divisible by "
+                f"tp*sp ({tp}*{sp})")
         if self.num_experts:
             self.moe_config  # MoEConfig.__post_init__ owns the MoE checks
 
@@ -311,7 +332,8 @@ def _attention(p, x, cfg, heads_local: int, causal: bool = True, mask=None,
         s = s * lax.axis_size(TP_AXIS)
     qkv = column_parallel_linear(x, p["qkv_kernel"], p["qkv_bias"],
                                  gather_output=False,
-                                 sequence_parallel=cfg.megatron_sp)
+                                 sequence_parallel=cfg.megatron_sp,
+                                 overlap_comm=cfg.overlap_comm)
     # per-head interleaved packing — column c of the global qkv kernel is
     # (head, {q,k,v}, head_dim): a contiguous TP column split then assigns
     # whole heads with their q, k, v together, so the computed function is
@@ -364,7 +386,8 @@ def _attention(p, x, cfg, heads_local: int, causal: bool = True, mask=None,
     # replay anyway)
     return row_parallel_linear(ctx, p["out_kernel"], p["out_bias"],
                                input_is_parallel=True,
-                               sequence_parallel=cfg.megatron_sp)
+                               sequence_parallel=cfg.megatron_sp,
+                               overlap_comm=cfg.overlap_comm)
 
 
 def _mlp(p, x, cfg):
@@ -405,11 +428,13 @@ def _mlp(p, x, cfg):
         return out, aux["loss"]
     y = column_parallel_linear(x, p["fc1_kernel"], p["fc1_bias"],
                                gather_output=False,
-                               sequence_parallel=cfg.megatron_sp)
+                               sequence_parallel=cfg.megatron_sp,
+                               overlap_comm=cfg.overlap_comm)
     y = jax.nn.gelu(y, approximate=True)
     out = row_parallel_linear(y, p["fc2_kernel"], p["fc2_bias"],
                               input_is_parallel=True,
-                              sequence_parallel=cfg.megatron_sp)
+                              sequence_parallel=cfg.megatron_sp,
+                              overlap_comm=cfg.overlap_comm)
     return out, jnp.zeros((), jnp.float32)
 
 
@@ -485,6 +510,16 @@ def _layer_stack(layers, x, cfg, causal: bool = True, mask=None,
         raise ValueError(
             f"num_heads ({cfg.num_heads}) not divisible by tp ({tp}); "
             f"see GPTConfig.validate(tp=...)")
+    if cfg.overlap_comm and not cfg.megatron_sp and x.shape[1] % tp:
+        # validate() only fires when the caller passes tp/sp; the flagship
+        # path calls it bare (init_gpt_params) — same trace-time guard as
+        # num_heads above, where the mesh is finally visible. (Under
+        # megatron_sp the embed exit already enforces divisibility and
+        # the exit rings scatter the gathered — always-divisible — seq.)
+        raise ValueError(
+            f"overlap_comm rings shard the sequence: local sequence "
+            f"({x.shape[1]}) not divisible by tp ({tp}); see "
+            f"GPTConfig.validate(tp=..., sp=...)")
     heads_local = cfg.num_heads // tp
 
     def one(lp, h, key):
@@ -540,6 +575,15 @@ def _layer_stack(layers, x, cfg, causal: bool = True, mask=None,
 
         if DP_AXIS not in jax.typeof(x).vma:
             x = lax.pcast(x, DP_AXIS, to="varying")
+
+    if cfg.overlap_comm and TP_AXIS not in jax.typeof(x).vma:
+        # the decomposed row-parallel exit (matmul_all_reduce) returns
+        # equal VALUES with tp-varying TYPE, so the scan carry must enter
+        # varying; the pcast's transpose is the psum that folds each
+        # rank's partial cotangents back together on the residual path —
+        # exactly where the monolithic program's invariant-input
+        # reduction fires
+        x = lax.pcast(x, TP_AXIS, to="varying")
 
     def body(h, lp_key):
         lp, key = lp_key

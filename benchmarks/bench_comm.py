@@ -8,6 +8,16 @@ measured, but on the CPU simulator collectives are memcpys, so the honest
 headline here is the byte ratio — the time column becomes meaningful on a
 real multi-chip slice where ICI is the bottleneck this subsystem attacks.
 
+CPU-fallback canary pin (the bench.py round-5 lesson, PERF.md): this bench
+always runs the 8-virtual-device CPU sim, so its time column is only
+useful round-over-round if the protocol CANNOT drift — r04's canary
+silently dropped 17% when a new flag default changed the timed program.
+Every codec knob is therefore pinned explicitly below (``use_pallas=False``
+above all: a future auto-Pallas-on-CPU flip would run interpret-mode
+kernels and shift the time column without touching the bytes), and the
+pinned protocol rides the summary line as ``canary_config`` so any future
+change is visible in the artifact diff.
+
 Run: ``python benchmarks/bench_comm.py`` (tier-1 box, no TPU needed).
 """
 
@@ -44,10 +54,14 @@ LEAVES = {
 }
 STEPS = 10
 
+# the pinned canary protocol: every knob explicit (see module docstring)
+CANARY = dict(block_size=256, min_elements=2048, stochastic_rounding=False,
+              use_pallas=False)
+
 POLICIES = {
     "none": None,
-    "int8": CompressionConfig(policy="int8"),
-    "int8_ef": CompressionConfig(policy="int8_ef"),
+    "int8": CompressionConfig(policy="int8", **CANARY),
+    "int8_ef": CompressionConfig(policy="int8_ef", **CANARY),
 }
 
 
@@ -125,6 +139,8 @@ def main():
         int8=round(ratio8, 2),
         int8_ef=round(ratio_ef, 2),
         backend=jax.default_backend(),
+        canary_config=dict(CANARY, steps=STEPS,
+                           grad_elements=rows["none"]["grad_elements"]),
     ), flush=True)
     return 0
 

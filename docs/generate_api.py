@@ -18,6 +18,7 @@ import importlib
 import inspect
 import os
 import pkgutil
+import re
 import sys
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -57,9 +58,13 @@ def public_symbols(mod):
 
 def signature_of(obj) -> str:
     try:
-        return str(inspect.signature(obj))
+        sig = str(inspect.signature(obj))
     except (ValueError, TypeError):
         return "(...)"
+    # object-repr defaults (flax _Sentinel, bound functions) stringify
+    # with the process's heap address — mask it or every regeneration
+    # dirties unrelated pages and buries real API changes in churn
+    return re.sub(r" at 0x[0-9a-fA-F]+", " at 0x...", sig)
 
 
 def render_module(modname: str) -> str | None:

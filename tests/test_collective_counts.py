@@ -35,12 +35,16 @@ from apex_tpu.transformer.testing import (
 BASE = GPTConfig(vocab_size=256, max_seq=64, hidden=128, num_layers=2,
                  num_heads=2, dtype=jnp.bfloat16)
 
+MESH_OK = hasattr(jax, "shard_map") and hasattr(jax.lax, "axis_size")
 
-def _counts(megatron_sp: bool):
+
+def _compiled_text(megatron_sp: bool, overlap_comm: bool = False) -> str:
+    """Compiled flagship tp=2 grad-program HLO on the virtual mesh."""
     if len(jax.devices()) < 8:
         pytest.skip("needs the 8-device virtual mesh")
     mesh = build_mesh(tp=2, pp=1, sp=1, dp=4)
-    cfg = dataclasses.replace(BASE, megatron_sp=megatron_sp)
+    cfg = dataclasses.replace(BASE, megatron_sp=megatron_sp,
+                              overlap_comm=overlap_comm)
     params = init_gpt_params(jax.random.PRNGKey(0), cfg)
     tok = jnp.zeros((4, 64), jnp.int32)
 
@@ -54,7 +58,11 @@ def _counts(megatron_sp: bool):
             in_specs=(gpt_param_specs(cfg), P("dp"), P("dp")),
             out_specs=P())(p, t, y)
 
-    txt = jax.jit(jax.grad(loss)).lower(params, tok, tok).compile().as_text()
+    return jax.jit(jax.grad(loss)).lower(params, tok, tok).compile().as_text()
+
+
+def _counts(megatron_sp: bool):
+    txt = _compiled_text(megatron_sp)
     return {k: len(re.findall(k, txt)) for k in
             ("all-reduce", "all-gather", "reduce-scatter")}
 
@@ -135,6 +143,59 @@ def _ddp_grad_program(compression, allreduce_always_fp32):
         out_specs=specs, check_vma=False,
     )).lower(params, tok, tok).compile()
     return collective_report(compiled)
+
+
+def assert_overlapped(hlo, min_hidden: int = 1):
+    """The comm/compute-overlap acceptance gate, from the compiled HLO (the
+    repo's prove-it-from-the-program methodology — the chip tunnel is too
+    unreliable to prove overlap with a profile).
+
+    On a SCHEDULED module (TPU: async ``collective-permute-start``/``-done``
+    pairs) this demands ≥1 pair with a ``dot`` scheduled inside the
+    start→done window — execution-order proof that the hop travels behind a
+    GEMM. On pre-schedule/CPU modules (synchronous ``collective-permute``)
+    it demands hops with data-INDEPENDENT dots — the eligibility a
+    latency-hiding scheduler needs; a monolithic collective→matmul chain
+    has no permutes at all and fails immediately. Returns the
+    :class:`~apex_tpu.comm.OverlapReport` for further assertions."""
+    from apex_tpu.comm import overlap_report
+
+    rep = overlap_report(hlo)
+    assert rep.permutes > 0, f"no collective-permute rings in program: {rep}"
+    assert rep.hidden >= min_hidden, rep
+    if rep.async_pairs:  # scheduled module: the window proof must hold
+        assert rep.async_hidden >= 1, rep
+    return rep
+
+
+@pytest.mark.skipif(not MESH_OK, reason="needs jax.shard_map (graft jax)")
+@pytest.mark.parametrize("megatron_sp", [False, True])
+def test_flagship_overlap_comm_decomposed_and_proven(megatron_sp):
+    """overlap_comm=True on the flagship tp=2 program (plain TP and
+    Megatron-SP): the TP-boundary collectives must actually decompose into
+    ppermute rings (the monolithic op counts DROP, permutes appear) and
+    the rings must be overlap-eligible/proven per assert_overlapped."""
+    from apex_tpu.comm import collective_report
+
+    txt_off = _compiled_text(megatron_sp)
+    txt_on = _compiled_text(megatron_sp, overlap_comm=True)
+    off = collective_report(txt_off)
+    on = collective_report(txt_on)
+    # the decomposition happened: permute rings replace monolithic ops
+    assert off.counts["collective-permute"] == 0, off
+    assert on.counts["collective-permute"] >= 4, on
+    if megatron_sp:
+        # the SP entry/exit all-gather+reduce-scatter pairs became rings
+        # (the embedding exit / LM-head entry keep their monolithic ops)
+        assert on.counts["all-gather"] < off.counts["all-gather"], (on, off)
+        assert on.counts["reduce-scatter"] < off.counts["reduce-scatter"], \
+            (on, off)
+    else:
+        # the row-parallel exit psums became rings
+        assert on.counts["all-reduce"] < off.counts["all-reduce"], (on, off)
+    rep = assert_overlapped(txt_on, min_hidden=2)
+    # the overwhelming share of ring traffic must be hideable
+    assert rep.hidden_fraction >= 0.5, rep
 
 
 def test_int8_allreduce_wire_byte_reduction():

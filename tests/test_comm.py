@@ -192,3 +192,141 @@ def test_accounting_single_device_groups_are_free():
         "%ar = f32[64]{0} all-reduce(f32[64]{0} %p), replica_groups={{0}}")
     assert rep.counts["all-reduce"] == 1
     assert rep.wire_bytes == 0.0
+
+
+# ---------------------------------------------------------------------------
+# async-emitted HLO (what the TPU latency-hiding scheduler produces, and
+# what comm.overlap's decomposed rings make common): the '-start' result is
+# a TUPLE aliasing the operand next to the output plus u32[] context
+# scalars, so pricing it like a sync result double-charges — the pricer
+# must price '-start' ops from their operands, once.
+
+_ASYNC_HLO = """
+HloModule async_test, is_scheduled=true
+
+ENTRY %main (p0: f32[16,32], p1: f32[32,8]) -> f32[16,8] {
+  %p0 = f32[16,32]{1,0} parameter(0)
+  %p1 = f32[32,8]{1,0} parameter(1)
+  %collective-permute-start.1 = (f32[16,32]{1,0}, f32[16,32]{1,0}, u32[], u32[]) collective-permute-start(f32[16,32]{1,0} %p0), channel_id=1, source_target_pairs={{0,1},{1,0}}
+  %dot.1 = f32[16,8]{1,0} dot(f32[16,32]{1,0} %p0, f32[32,8]{1,0} %p1), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  %collective-permute-done.1 = f32[16,32]{1,0} collective-permute-done((f32[16,32]{1,0}, f32[16,32]{1,0}, u32[], u32[]) %collective-permute-start.1)
+  %dot.2 = f32[16,8]{1,0} dot(f32[16,32]{1,0} %collective-permute-done.1, f32[32,8]{1,0} %p1), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  %all-gather-start.1 = (f32[16,8]{1,0}, f32[64,8]{1,0}) all-gather-start(f32[16,8]{1,0} %dot.2), channel_id=2, replica_groups={{0,1,2,3}}, dimensions={0}
+  %all-gather-done.1 = f32[64,8]{1,0} all-gather-done((f32[16,8]{1,0}, f32[64,8]{1,0}) %all-gather-start.1)
+  ROOT %add.1 = f32[16,8]{1,0} add(f32[16,8]{1,0} %dot.1, f32[16,8]{1,0} %dot.2)
+}
+"""
+
+
+def test_accounting_async_start_priced_once_from_operands():
+    rep = collective_report(_ASYNC_HLO)
+    # one pair each, counted once at the '-start'
+    assert rep.counts["collective-permute"] == 1, rep
+    assert rep.counts["all-gather"] == 1, rep
+    # cp: ONE hop of the f32[16,32] operand = 2048 bytes — NOT the start
+    # tuple's 2*2048 + 8 (operand alias + u32 contexts double-charge)
+    assert rep.wire_bytes_by_kind["collective-permute"] == pytest.approx(
+        2048)
+    # ag: sync result reconstructed as operand*W -> 64*8*4 * (3/4)
+    assert rep.wire_bytes_by_kind["all-gather"] == pytest.approx(
+        64 * 8 * 4 * 3 / 4)
+
+
+def test_overlap_report_async_windows():
+    from apex_tpu.comm import overlap_report
+
+    rep = overlap_report(_ASYNC_HLO)
+    # dot.1 is scheduled inside the start.1/done.1 window -> hidden
+    assert rep.async_pairs == 1 and rep.async_hidden == 1, rep
+    assert rep.hidden_wire_bytes == pytest.approx(2048)
+    assert rep.exposed_wire_bytes == 0.0, rep
+    # removing the in-window dot exposes the permute
+    exposed = overlap_report(_ASYNC_HLO.replace(
+        "  %dot.1 = f32[16,8]{1,0} dot(f32[16,32]{1,0} %p0, "
+        "f32[32,8]{1,0} %p1), lhs_contracting_dims={1}, "
+        "rhs_contracting_dims={0}\n", ""))
+    assert exposed.async_hidden == 0, exposed
+    assert exposed.exposed_wire_bytes == pytest.approx(2048)
+
+
+_SYNC_RING_HLO = """
+ENTRY %main (p0: f32[16,32], p1: f32[32,8]) -> f32[16,8] {
+  %p0 = f32[16,32]{1,0} parameter(0)
+  %p1 = f32[32,8]{1,0} parameter(1)
+  %collective-permute.1 = f32[16,32]{1,0} collective-permute(f32[16,32]{1,0} %p0), channel_id=1, source_target_pairs={{0,1},{1,0}}
+  %dot.1 = f32[16,8]{1,0} dot(f32[16,32]{1,0} %p0, f32[32,8]{1,0} %p1), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  %dot.2 = f32[16,8]{1,0} dot(f32[16,32]{1,0} %collective-permute.1, f32[32,8]{1,0} %p1), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  ROOT %add.1 = f32[16,8]{1,0} add(f32[16,8]{1,0} %dot.1, f32[16,8]{1,0} %dot.2)
+}
+"""
+
+
+def test_overlap_report_sync_independence():
+    """Pre-schedule/CPU modules emit synchronous collective-permute; a hop
+    counts as hideable iff some dot neither feeds it nor consumes it."""
+    from apex_tpu.comm import overlap_report
+
+    rep = overlap_report(_SYNC_RING_HLO)
+    # dot.1 is independent of the permute (dot.2 consumes it)
+    assert rep.sync_permutes == 1 and rep.sync_hidden == 1, rep
+    # drop the independent dot: the only remaining dot DEPENDS on the
+    # permute -> nothing a scheduler could overlap
+    dep_only = overlap_report(_SYNC_RING_HLO.replace(
+        "  %dot.1 = f32[16,8]{1,0} dot(f32[16,32]{1,0} %p0, "
+        "f32[32,8]{1,0} %p1), lhs_contracting_dims={1}, "
+        "rhs_contracting_dims={0}\n", "").replace(
+        "f32[16,8]{1,0} %dot.1", "f32[16,8]{1,0} %dot.2"))
+    assert dep_only.sync_permutes == 1 and dep_only.sync_hidden == 0, \
+        dep_only
+
+
+def test_overlap_report_fusion_wrapped_dot_counts():
+    """On TPU the partial GEMMs ride inside fusions — a fusion calling a
+    dot-bearing computation must count as a dot for the window check."""
+    from apex_tpu.comm import overlap_report
+
+    hlo = """
+%fused_dot (pa: f32[16,32], pb: f32[32,8]) -> f32[16,8] {
+  %pa = f32[16,32]{1,0} parameter(0)
+  %pb = f32[32,8]{1,0} parameter(1)
+  ROOT %dot.9 = f32[16,8]{1,0} dot(f32[16,32]{1,0} %pa, f32[32,8]{1,0} %pb), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+}
+
+ENTRY %main (p0: f32[16,32], p1: f32[32,8]) -> f32[16,8] {
+  %p0 = f32[16,32]{1,0} parameter(0)
+  %p1 = f32[32,8]{1,0} parameter(1)
+  %collective-permute-start.1 = (f32[16,32]{1,0}, f32[16,32]{1,0}, u32[], u32[]) collective-permute-start(f32[16,32]{1,0} %p0), channel_id=1, source_target_pairs={{0,1},{1,0}}
+  %fusion.1 = f32[16,8]{1,0} fusion(f32[16,32]{1,0} %p0, f32[32,8]{1,0} %p1), kind=kOutput, calls=%fused_dot
+  %collective-permute-done.1 = f32[16,32]{1,0} collective-permute-done((f32[16,32]{1,0}, f32[16,32]{1,0}, u32[], u32[]) %collective-permute-start.1)
+  ROOT %tail = f32[16,8]{1,0} add(f32[16,8]{1,0} %fusion.1, f32[16,8]{1,0} %fusion.1)
+}
+"""
+    rep = overlap_report(hlo)
+    assert rep.async_pairs == 1 and rep.async_hidden == 1, rep
+
+
+def test_overlap_wire_models_match_ring_shape():
+    """The comm.overlap byte models must equal the monolithic collective
+    models — the decomposition is wire-neutral by design: (W-1) hops of
+    one shard vs the ring cost of the fused collective."""
+    from apex_tpu.comm import (
+        all_gather_matmul_wire_bytes,
+        all_gather_wire_bytes,
+        allreduce_wire_bytes,
+        matmul_all_reduce_wire_bytes,
+        matmul_reduce_scatter_wire_bytes,
+    )
+
+    w, shard, item = 8, 16 * 128, 4
+    full = shard * w
+    assert all_gather_matmul_wire_bytes(shard, item, w) == pytest.approx(
+        all_gather_wire_bytes(full, item, w))
+    # monolithic reduce-scatter: result shard bytes * (W-1)
+    assert matmul_reduce_scatter_wire_bytes(shard, item, w) == \
+        pytest.approx(float(shard) * item * (w - 1))
+    assert matmul_all_reduce_wire_bytes(shard, item, w) == pytest.approx(
+        allreduce_wire_bytes(full, item, w, None))
+    for fn in (all_gather_matmul_wire_bytes,
+               matmul_reduce_scatter_wire_bytes,
+               matmul_all_reduce_wire_bytes):
+        assert fn(shard, item, 1) == 0.0
