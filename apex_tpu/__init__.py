@@ -33,6 +33,10 @@ L8     ``apex_tpu.resilience``        — (north-star: fault tolerance —
                                       anomaly guard, atomic/async
                                       checkpointing, preemption handling,
                                       chaos harness)
+L9     ``apex_tpu.serve``             — (north-star: continuous-batching
+                                      inference engine — paged KV cache,
+                                      q_len=1 Pallas decode attention,
+                                      in-graph sampling, bucketed prefill)
 =====  =============================  ==========================================
 """
 
@@ -57,6 +61,7 @@ __all__ = [
     "parallel",
     "profiler",
     "resilience",
+    "serve",
     "transformer",
     "RankInfoFormatter",
 ]

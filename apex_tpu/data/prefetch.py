@@ -33,6 +33,12 @@ def prefetch_to_device(
     without a resharding copy. With ``size >= 2`` the (i+1)-th transfer
     overlaps the i-th step's compute (the reference prefetcher's
     double-buffering).
+
+    The generator is closeable: a consumer that breaks early (or whose
+    ``for`` loop is garbage-collected) triggers ``close()``, and the
+    ``finally`` block drops the ``size`` still-in-flight device batches —
+    without it every early exit strands ``size`` batches of device memory
+    until the generator object dies.
     """
     if size < 1:
         raise ValueError(f"size must be >= 1, got {size}")
@@ -47,8 +53,14 @@ def prefetch_to_device(
                 queue.append(jax.tree.map(
                     lambda x: jax.device_put(x, sharding), batch))
 
-    submit(size)
-    while queue:
-        out = queue.popleft()
-        submit(1)
-        yield out
+    try:
+        submit(size)
+        while queue:
+            out = queue.popleft()
+            submit(1)
+            yield out
+    finally:
+        # early break / close(): release the in-flight transfers. The
+        # arrays may still be mid-DMA — dropping the references is enough;
+        # the backend frees each buffer once its transfer lands.
+        queue.clear()

@@ -36,8 +36,9 @@ import jax
 # leading scope component, so spans named from this set roll up cleanly.
 # "ckpt" is the host-side checkpoint phase (resilience.CheckpointManager's
 # device_get + serialization) — it appears in trace-viewer host rows, not
-# in the compiled step.
-PHASES = ("fwd", "bwd", "comm", "opt", "ckpt")
+# in the compiled step. "prefill"/"decode" are the serving phases the
+# apex_tpu.serve engine traces its two jitted programs under.
+PHASES = ("fwd", "bwd", "comm", "opt", "ckpt", "prefill", "decode")
 
 
 @contextlib.contextmanager

@@ -1,0 +1,74 @@
+"""apex_tpu.serve — continuous-batching TPU inference engine.
+
+The serving side of the north star (reference Apex has none — its only
+inference story is ``amp.initialize`` eval-mode half precision):
+
+* :mod:`~apex_tpu.serve.kv_cache` — block-paged KV cache pools as one
+  donated pytree, host-side free-list allocator, optional int8 KV
+  quantization (the ``comm.quantize`` codec), modeled byte accounting;
+* :mod:`~apex_tpu.serve.decode` — q_len=1 paged attention (pure-JAX
+  reference + Pallas gather-attend kernel) and the ``gpt_prefill`` /
+  ``gpt_decode_step`` programs built from the ``standalone_gpt`` layers;
+* :mod:`~apex_tpu.serve.sampling` — in-graph greedy/temperature/top-k/
+  top-p with request-intrinsic fold_in keys;
+* :mod:`~apex_tpu.serve.engine` — the iteration-level continuous-batching
+  :class:`InferenceEngine`: bucketed prefill + one decode program,
+  admission into freed slots, EOS/max-len retirement, checkpoint loading
+  via ``resilience``, telemetry via ``monitor``.
+"""
+
+from apex_tpu.serve.decode import (  # noqa: F401
+    gpt_decode_step,
+    gpt_prefill,
+    paged_attention,
+    paged_attention_reference,
+    serve_logits,
+)
+from apex_tpu.serve.engine import (  # noqa: F401
+    InferenceEngine,
+    Request,
+    ServeConfig,
+    decode_flops_per_token,
+    default_bucket_ladder,
+)
+from apex_tpu.serve.kv_cache import (  # noqa: F401
+    BlockAllocator,
+    KVCacheConfig,
+    gather_kv,
+    init_kv_cache,
+    kv_cache_bytes,
+    kv_read_bytes,
+    kv_write_bytes_per_token,
+    paged_write,
+)
+from apex_tpu.serve.sampling import (  # noqa: F401
+    SamplingConfig,
+    request_key,
+    sample,
+    step_keys,
+)
+
+__all__ = [
+    "BlockAllocator",
+    "InferenceEngine",
+    "KVCacheConfig",
+    "Request",
+    "SamplingConfig",
+    "ServeConfig",
+    "decode_flops_per_token",
+    "default_bucket_ladder",
+    "gather_kv",
+    "gpt_decode_step",
+    "gpt_prefill",
+    "init_kv_cache",
+    "kv_cache_bytes",
+    "kv_read_bytes",
+    "kv_write_bytes_per_token",
+    "paged_attention",
+    "paged_attention_reference",
+    "paged_write",
+    "request_key",
+    "sample",
+    "serve_logits",
+    "step_keys",
+]

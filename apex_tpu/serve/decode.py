@@ -1,0 +1,437 @@
+"""Decode-path attention against the paged KV cache + the GPT serve programs.
+
+Two halves:
+
+* **paged attention** — q_len=1 attention where K/V live in the
+  block-paged pools (``apex_tpu.serve.kv_cache``): a pure-JAX reference
+  (gather through the block tables, then exactly the
+  ``ops.attention.attention_reference`` math — fp32 accumulation, NEG_INF
+  masking) and a Pallas gather-attend kernel that walks each slot's block
+  table with scalar-prefetched indices (the ``ops/attention_varlen.py``
+  ``PrefetchScalarGridSpec`` idiom) and an online-softmax accumulator (the
+  ``ops/attention.py`` forward scheme, no lse output — decode never
+  differentiates). The MPK case (arXiv 2512.22219) is why this is one
+  kernel and the whole decode step one compiled program: at q_len=1 the
+  work per op is tiny and dispatch dominates.
+
+* **serve programs** — :func:`gpt_prefill` and :func:`gpt_decode_step`
+  built from the SAME ``standalone_gpt`` parameter pytree (tied LM head,
+  per-head interleaved QKV packing, ``ops.layer_norm``/``flash_attention``
+  cores). TP is axis-optional: with ``tp_axis`` bound (inside a mesh
+  program) the projections ride ``tensor_parallel``'s column/row-parallel
+  layers — heads sharded, the prefill row-parallel exits honoring
+  ``cfg.overlap_comm`` (the decomposed ``comm.overlap`` rings) — and the
+  vocab-sharded logits are all-gathered for sampling; with ``tp_axis=None``
+  (single device, stock-jax serving) the same math runs as plain dots.
+  The decode step's TP exits stay monolithic by design: a q_len-1 GEMM has
+  no flops to hide a ring behind.
+
+Layers scan over the stacked layer params with the per-layer cache pools
+riding the scan's xs/ys — one compiled layer body regardless of depth,
+and the updated pools restack for donation.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.ops._pallas_util import compiled_backend as _compiled_backend
+from apex_tpu.ops._pallas_util import sds as _sds
+from apex_tpu.ops.attention import NEG_INF, attention_reference, flash_attention
+from apex_tpu.ops.layer_norm import layer_norm
+from apex_tpu.serve.kv_cache import KVCacheConfig, gather_kv, paged_write
+
+try:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAS_PALLAS = False
+
+Pytree = Any
+
+
+# ---------------------------------------------------------------------------
+# Paged attention — reference
+
+
+def paged_attention_reference(q, cache_layer, cfg: KVCacheConfig,
+                              block_tables, ctx_lens,
+                              scale: Optional[float] = None):
+    """q (n, H, D) against one layer's paged pools; (n,) ``ctx_lens`` tokens
+    of context per slot. Returns (n, H, D) in q.dtype.
+
+    Math is EXACTLY ``attention_reference`` over the gathered K/V with a
+    ``kpos >= ctx_len`` mask — the fp32-exact ground truth the Pallas
+    kernel and the engine's decode step are tested against. Slots with
+    ``ctx_len == 0`` produce a finite junk row (uniform weights over
+    NEG_INF-masked scores), never NaN — callers mask by activity.
+    """
+    k, v = gather_kv(cache_layer, cfg, block_tables)  # (n, H, S, D)
+    s_tot = k.shape[2]
+    kpos = jnp.arange(s_tot)
+    mask = kpos[None, None, None, :] >= ctx_lens[:, None, None, None]
+    o = attention_reference(q[:, :, None], k, v, mask=mask, scale=scale)
+    return o[:, :, 0]
+
+
+# ---------------------------------------------------------------------------
+# Paged attention — Pallas gather-attend kernel. Grid (slots, blocks); the
+# block table rides scalar prefetch so each (slot, j) step DMAs pool block
+# ``table[slot, j]`` directly; dead blocks (past the context) clamp their
+# fetch to the last live block (Mosaic elides the repeated copy — the
+# ops/attention.py causal-clamp trick) and skip compute.
+
+
+def _paged_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, *refs,
+                  scale, block_size, nb, quantized):
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = refs
+    else:
+        o_ref, m_scr, l_scr, acc_scr = refs
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    ctx = len_ref[i]
+
+    @pl.when(j * block_size < ctx)
+    def _compute():
+        q = q_ref[0]                       # (H, D)
+        k = k_ref[:, 0]                    # (H, bs, D)
+        v = v_ref[:, 0]
+        if quantized:
+            k = k.astype(jnp.float32) * ks_ref[:, 0][..., None]
+            v = v.astype(jnp.float32) * vs_ref[:, 0][..., None]
+        s = lax.dot_general(
+            q, k, (((1,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32) * scale  # (H, bs)
+        kpos = j * block_size + lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        s = jnp.where(kpos >= ctx, NEG_INF, s)
+        m_prev = m_scr[:, :1]
+        l_prev = l_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        corr = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = corr * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * corr + lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(j == nb - 1)
+    def _finish():
+        l = l_scr[:, :1]
+        safe_l = jnp.where(l == 0.0, 1.0, l)  # ctx==0 slot: emit zeros
+        o_ref[0] = (acc_scr[:] / safe_l).astype(o_ref.dtype)
+
+
+def _paged_pallas(q, cache_layer, cfg: KVCacheConfig, block_tables,
+                  ctx_lens, scale, interpret):
+    n, h, d = q.shape
+    nb = block_tables.shape[1]
+    bs = cfg.block_size
+    bt_flat = block_tables.reshape(-1).astype(jnp.int32)
+    lens = ctx_lens.astype(jnp.int32)
+
+    def blk_index(i, j, bt, ln):
+        # clamp dead steps at the last live block: repeated index elides
+        # the DMA; max(ctx-1, 0) keeps a ctx==0 slot in range
+        jl = jnp.maximum(ln[i] - 1, 0) // bs
+        return (0, bt[i * nb + jnp.minimum(j, jl)], 0, 0)
+
+    def blk_index_s(i, j, bt, ln):
+        jl = jnp.maximum(ln[i] - 1, 0) // bs
+        return (0, bt[i * nb + jnp.minimum(j, jl)], 0)
+
+    in_specs = [
+        pl.BlockSpec((1, h, d), lambda i, j, bt, ln: (i, 0, 0)),
+        pl.BlockSpec((h, 1, bs, d), blk_index),
+        pl.BlockSpec((h, 1, bs, d), blk_index),
+    ]
+    inputs = [q, cache_layer["k"], cache_layer["v"]]
+    if cfg.quantized:
+        in_specs += [pl.BlockSpec((h, 1, bs), blk_index_s),
+                     pl.BlockSpec((h, 1, bs), blk_index_s)]
+        inputs += [cache_layer["k_scale"], cache_layer["v_scale"]]
+    kernel = functools.partial(
+        _paged_kernel, scale=scale, block_size=bs, nb=nb,
+        quantized=cfg.quantized)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(n, nb),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, h, d), lambda i, j, bt, ln: (i, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((h, 128), jnp.float32),
+            pltpu.VMEM((h, 128), jnp.float32),
+            pltpu.VMEM((h, d), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=_sds((n, h, d), q.dtype, q),
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(bt_flat, lens, *inputs)
+
+
+def _pallas_ok(head_dim: int, allow_interpret: bool) -> bool:
+    if not _HAS_PALLAS or head_dim % 8 != 0:
+        return False
+    return allow_interpret or _compiled_backend()
+
+
+def paged_attention(q, cache_layer, cfg: KVCacheConfig, block_tables,
+                    ctx_lens, scale: Optional[float] = None,
+                    use_pallas: Optional[bool] = None,
+                    interpret: Optional[bool] = None):
+    """Dispatching front door: Pallas gather-attend on compiled TPU
+    backends (head_dim % 8), the gather+reference path elsewhere — the
+    ``flash_attention`` gating pattern. Same signature/result as
+    :func:`paged_attention_reference`."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if use_pallas is None:
+        use_pallas = _pallas_ok(q.shape[-1], allow_interpret=False)
+    elif use_pallas and not _pallas_ok(q.shape[-1], allow_interpret=True):
+        raise ValueError(
+            f"pallas paged_attention needs head_dim % 8 == 0 "
+            f"(got {q.shape[-1]}) and pallas available")
+    if not use_pallas:
+        if interpret is not None:
+            raise ValueError(
+                "interpret= only applies to the Pallas path (pass "
+                "use_pallas=True to force the kernel)")
+        return paged_attention_reference(q, cache_layer, cfg, block_tables,
+                                         ctx_lens, scale=scale)
+    if interpret is None:
+        interpret = not _compiled_backend()
+    return _paged_pallas(q, cache_layer, cfg, block_tables, ctx_lens,
+                         scale, interpret)
+
+
+# ---------------------------------------------------------------------------
+# Axis-optional TP plumbing: one code path that runs as plain dots on a
+# single device (tp_axis=None — the stock-jax serving case) and as the
+# tensor_parallel layers inside a mesh program.
+
+
+def _tp_size(tp_axis: Optional[str]) -> int:
+    if tp_axis is None:
+        return 1
+    return lax.axis_size(tp_axis)
+
+
+def _col(x, kernel, bias, tp_axis: Optional[str]):
+    """Column-parallel projection (output-sharded, no gather)."""
+    if tp_axis is None:
+        y = jnp.dot(x, kernel.astype(x.dtype))
+        return y + bias if bias is not None else y
+    from apex_tpu.transformer.tensor_parallel.layers import (
+        column_parallel_linear,
+    )
+
+    return column_parallel_linear(x, kernel, bias, gather_output=False,
+                                  axis_name=tp_axis)
+
+
+def _row(x, kernel, bias, tp_axis: Optional[str], overlap: bool = False):
+    """Row-parallel projection (input-sharded, psum exit; ``overlap`` only
+    meaningful for 3D (b, s, h) prefill activations)."""
+    if tp_axis is None:
+        y = jnp.dot(x, kernel.astype(x.dtype))
+        return y + bias if bias is not None else y
+    from apex_tpu.transformer.tensor_parallel.layers import (
+        row_parallel_linear,
+    )
+
+    return row_parallel_linear(x, kernel, bias, input_is_parallel=True,
+                               axis_name=tp_axis,
+                               overlap_comm=overlap and x.ndim == 3)
+
+
+def _embed(embed, tokens, positions, tp_axis: Optional[str]):
+    """Token + position embedding at explicit positions (decode feeds one
+    token per slot at its own offset — no implicit arange)."""
+    if tp_axis is None:
+        x = jnp.take(embed["tok"], tokens, axis=0)
+    else:
+        from apex_tpu.transformer.tensor_parallel.layers import (
+            vocab_parallel_embedding,
+        )
+
+        x = vocab_parallel_embedding(tokens, embed["tok"],
+                                     axis_name=tp_axis)
+    pos = jnp.take(embed["pos"], positions, axis=0)  # OOB clamps (jnp.take)
+    return x + pos.astype(x.dtype)
+
+
+def serve_logits(params, x, cfg, tp_axis: Optional[str] = None):
+    """Final LN + LM head -> FULL-vocab fp32 logits (sampling needs the
+    global argmax/top-k, so TP-sharded logits are all-gathered here —
+    unlike training, where the fused loss never materializes them)."""
+    head = params["head"]
+    x = layer_norm(x, head["ln_w"], head["ln_b"], use_pallas=cfg.ln_pallas)
+    if cfg.tie_embeddings:
+        logits = jnp.einsum("...h,vh->...v", x,
+                            params["embed"]["tok"].astype(x.dtype))
+    else:
+        logits = jnp.dot(x, head["lm"].astype(x.dtype))
+    if tp_axis is not None:
+        logits = lax.all_gather(logits, tp_axis, axis=logits.ndim - 1,
+                                tiled=True)
+    return logits.astype(jnp.float32)
+
+
+def _split_qkv(qkv, heads_local: int, head_dim: int):
+    """Per-head interleaved unpack — the standalone_gpt packing, so serve
+    reads the SAME checkpoints at any TP degree."""
+    lead = qkv.shape[:-1]
+    qkv = qkv.reshape(*lead, heads_local, 3, head_dim)
+    return qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+
+
+def _serve_heads(cfg, tp_axis: Optional[str]) -> int:
+    tp = _tp_size(tp_axis)
+    if cfg.num_heads % tp:
+        raise ValueError(
+            f"num_heads ({cfg.num_heads}) not divisible by tp ({tp})")
+    return cfg.num_heads // tp
+
+
+def _check_serve_cfg(cfg, kv_cfg: KVCacheConfig, tp_axis) -> None:
+    if cfg.num_experts:
+        raise NotImplementedError(
+            "serve does not support MoE layers yet (num_experts > 0)")
+    heads_local = _serve_heads(cfg, tp_axis)
+    if kv_cfg.num_heads != heads_local or kv_cfg.head_dim != cfg.head_dim:
+        raise ValueError(
+            f"KVCacheConfig ({kv_cfg.num_heads} heads x {kv_cfg.head_dim}) "
+            f"does not match the model's local layout ({heads_local} x "
+            f"{cfg.head_dim})")
+    if kv_cfg.num_layers != cfg.num_layers:
+        raise ValueError(
+            f"KVCacheConfig.num_layers ({kv_cfg.num_layers}) != "
+            f"cfg.num_layers ({cfg.num_layers})")
+
+
+# ---------------------------------------------------------------------------
+# Prefill: full-prompt forward (flash attention over the in-flight K/V —
+# the cache is write-only here), cache populated for the decode loop.
+
+
+def gpt_prefill(params, tokens, prompt_len, cache, block_row,
+                cfg, kv_cfg: KVCacheConfig,
+                tp_axis: Optional[str] = None) -> Tuple[Pytree, jnp.ndarray]:
+    """Process one prompt into the cache; return the next-token logits.
+
+    ``tokens``: (bucket,) int32, the prompt padded to its compile bucket
+    (padding ignored: causal attention means positions < prompt_len never
+    see it, and padded K/V writes are dropped). ``prompt_len``: traced
+    scalar. ``block_row``: (max_blocks,) int32 blocks owning this slot.
+    Returns ``(cache', logits (vocab,))`` — logits at ``prompt_len - 1``,
+    fp32, full vocab.
+    """
+    _check_serve_cfg(cfg, kv_cfg, tp_axis)
+    heads_local = _serve_heads(cfg, tp_axis)
+    t = tokens.shape[0]
+    positions = jnp.arange(t)
+    valid = positions < prompt_len
+    x = _embed(params["embed"], tokens[None], positions, tp_axis)  # (1,t,h)
+
+    def body(x, xs):
+        lp, cl = xs
+        h1 = layer_norm(x, lp["ln1_w"], lp["ln1_b"],
+                        use_pallas=cfg.ln_pallas)
+        qkv = _col(h1, lp["qkv_kernel"], lp["qkv_bias"], tp_axis)
+        q, k, v = _split_qkv(qkv, heads_local, cfg.head_dim)  # (1,t,H,D)
+        q, k, v = (a.transpose(0, 2, 1, 3) for a in (q, k, v))  # (1,H,t,D)
+        ctx = flash_attention(q, k, v, causal=True,
+                              block_q=cfg.attn_block_q,
+                              block_k=cfg.attn_block_k)
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(1, t,
+                                                heads_local * cfg.head_dim)
+        a = _row(ctx, lp["out_kernel"], lp["out_bias"], tp_axis,
+                 overlap=cfg.overlap_comm)
+        x = x + a
+        h2 = layer_norm(x, lp["ln2_w"], lp["ln2_b"],
+                        use_pallas=cfg.ln_pallas)
+        y = jax.nn.gelu(_col(h2, lp["fc1_kernel"], lp["fc1_bias"], tp_axis),
+                        approximate=True)
+        m = _row(y, lp["fc2_kernel"], lp["fc2_bias"], tp_axis,
+                 overlap=cfg.overlap_comm)
+        x = x + m
+        cl = paged_write(cl, kv_cfg, k[0], v[0],
+                         jnp.broadcast_to(block_row, (t, block_row.shape[0])),
+                         positions, valid)
+        return x, cl
+
+    x, cache = lax.scan(body, x, (params["layers"], cache))
+    last = jnp.take(x[0], jnp.maximum(prompt_len - 1, 0), axis=0)  # (h,)
+    return cache, serve_logits(params, last, cfg, tp_axis)
+
+
+# ---------------------------------------------------------------------------
+# Decode: one token per active slot through the whole stack — ONE compiled
+# program per engine lifetime.
+
+
+def gpt_decode_step(params, last_tokens, seq_lens, active, cache,
+                    block_tables, cfg, kv_cfg: KVCacheConfig,
+                    tp_axis: Optional[str] = None,
+                    use_pallas: Optional[bool] = None
+                    ) -> Tuple[Pytree, jnp.ndarray]:
+    """Advance every active slot by one token.
+
+    ``last_tokens``: (n,) the token each slot feeds this step (the one
+    sampled last step). ``seq_lens``: (n,) tokens already cached — the fed
+    token's position. ``active``: (n,) bool. Returns ``(cache', logits
+    (n, vocab) fp32)``; inactive slots produce finite junk logits the
+    engine ignores.
+    """
+    _check_serve_cfg(cfg, kv_cfg, tp_axis)
+    heads_local = _serve_heads(cfg, tp_axis)
+    positions = jnp.minimum(seq_lens, cfg.max_seq - 1)
+    ctx_lens = jnp.where(active, positions + 1, 0)
+    x = _embed(params["embed"], last_tokens, positions, tp_axis)  # (n, h)
+
+    def body(x, xs):
+        lp, cl = xs
+        h1 = layer_norm(x, lp["ln1_w"], lp["ln1_b"],
+                        use_pallas=cfg.ln_pallas)
+        qkv = _col(h1, lp["qkv_kernel"], lp["qkv_bias"], tp_axis)
+        q, k, v = _split_qkv(qkv, heads_local, cfg.head_dim)  # (n, H, D)
+        cl = paged_write(cl, kv_cfg, k.transpose(1, 0, 2),
+                         v.transpose(1, 0, 2), block_tables, positions,
+                         active)
+        ctx = paged_attention(q, cl, kv_cfg, block_tables, ctx_lens,
+                              use_pallas=use_pallas)
+        a = _row(ctx.reshape(-1, heads_local * cfg.head_dim),
+                 lp["out_kernel"], lp["out_bias"], tp_axis)
+        x = x + a
+        h2 = layer_norm(x, lp["ln2_w"], lp["ln2_b"],
+                        use_pallas=cfg.ln_pallas)
+        y = jax.nn.gelu(_col(h2, lp["fc1_kernel"], lp["fc1_bias"], tp_axis),
+                        approximate=True)
+        x = x + _row(y, lp["fc2_kernel"], lp["fc2_bias"], tp_axis)
+        return x, cl
+
+    x, cache = lax.scan(body, x, (params["layers"], cache))
+    return cache, serve_logits(params, x, cfg, tp_axis)

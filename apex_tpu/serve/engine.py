@@ -1,0 +1,496 @@
+"""Iteration-level continuous-batching inference engine.
+
+The TPU-v3-pod MLPerf lesson (arXiv 1909.09756) applied to serving:
+throughput at scale is slot occupancy — a static batch drains to its
+longest member while every other chip's slot idles. This engine batches at
+**iteration granularity** (Orca/vLLM's scheduling, rebuilt for jitted JAX
+programs): a fixed grid of decode slots advances one token per step, and
+between steps finished requests retire and new ones are admitted into the
+freed slots. Nothing retraces:
+
+* **bounded compilation** — prompts are padded to a fixed **bucket
+  ladder**, so the engine compiles at most ``len(buckets)`` prefill
+  programs plus EXACTLY ONE decode program for its whole lifetime (the
+  compile-count gate in ``tests/test_serve.py`` pins it). The MPK argument
+  (arXiv 2512.22219) in scheduler form: decode is latency-bound, so the
+  whole step — embed, every layer, paged attention, sampling — is one
+  compiled program, one dispatch.
+* **donation-safe state** — the paged KV pools (``serve.kv_cache``) are
+  donated through every prefill/decode call; slot bookkeeping
+  (block tables, lengths, last tokens, keys) stays host-side numpy, cheap
+  to re-upload and trivially correct across admissions.
+* **request-order invariance** — greedy streams are bitwise equal to
+  single-request decode of each prompt, and sampled streams equal under
+  the same key, because per-slot computation is row-independent and
+  sampling keys are request-intrinsic (``serve.sampling``).
+
+Weights arrive through ``resilience.CheckpointManager.latest_valid()``
+(:meth:`InferenceEngine.from_checkpoint`) — a serving replica points at
+the training job's checkpoint directory and refuses torn/corrupt saves.
+Telemetry rides the PR-2 ``monitor`` pipeline: an in-graph ``Metrics``
+pytree out of the decode program plus host-side step records (tokens/s,
+TTFT, occupancy, modeled decode flops/MFU, KV bytes from
+``serve.kv_cache``'s accounting) into a ``JsonlSink``.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import time
+import zlib
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.monitor.metrics import Metrics
+from apex_tpu.monitor.trace import span
+from apex_tpu.serve.decode import gpt_decode_step, gpt_prefill
+from apex_tpu.serve.kv_cache import (
+    BlockAllocator,
+    KVCacheConfig,
+    init_kv_cache,
+    kv_cache_bytes,
+    kv_read_bytes,
+    kv_write_bytes_per_token,
+)
+from apex_tpu.serve.sampling import SamplingConfig, request_key, sample
+
+Pytree = Any
+
+
+def default_bucket_ladder(max_context: int, start: int = 16
+                          ) -> Tuple[int, ...]:
+    """Powers-of-two prompt buckets up to ``max_context`` — each prompt
+    compiles against the smallest bucket that holds it, so total prefill
+    compilations are bounded by ``log2`` of the context length."""
+    out = []
+    b = start
+    while b < max_context:
+        out.append(b)
+        b *= 2
+    out.append(max_context)
+    return tuple(out)
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request. ``seed`` feeds the request's sampling key
+    (default: crc32 of the uid — stable across runs and admission orders);
+    irrelevant under greedy decoding."""
+
+    uid: str
+    tokens: Sequence[int]
+    max_new_tokens: int = 64
+    seed: Optional[int] = None
+
+    def sampling_seed(self) -> int:
+        if self.seed is not None:
+            return int(self.seed)
+        return zlib.crc32(self.uid.encode())
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Engine shape knobs (all static — they pick the compiled programs)."""
+
+    num_slots: int = 4
+    block_size: int = 16
+    # total pool blocks; default = num_slots * blocks-per-max-context (no
+    # oversubscription). Smaller pools admit fewer concurrent requests —
+    # admission simply waits for frees, it never preempts.
+    num_blocks: Optional[int] = None
+    # prompt-length compile buckets; default: powers of two to max_context
+    prefill_buckets: Optional[Tuple[int, ...]] = None
+    max_context: Optional[int] = None  # default: model cfg.max_seq
+    eos_id: Optional[int] = None
+    kv_quant: str = "none"  # "none" | "int8" (comm.quantize codec)
+    sampling: SamplingConfig = dataclasses.field(
+        default_factory=SamplingConfig)
+
+    def validate(self) -> None:
+        if self.num_slots <= 0:
+            raise ValueError("num_slots must be positive")
+        if self.block_size <= 0:
+            raise ValueError("block_size must be positive")
+        if self.num_blocks is not None and self.num_blocks <= 0:
+            raise ValueError("num_blocks must be positive when given")
+        if self.max_context is not None and self.max_context <= 0:
+            raise ValueError("max_context must be positive when given")
+        if self.kv_quant not in ("none", "int8"):
+            raise ValueError(f"kv_quant must be 'none' or 'int8', "
+                             f"got {self.kv_quant!r}")
+        self.sampling.validate()
+
+
+@dataclasses.dataclass
+class _SlotState:
+    request: Request
+    blocks: List[int]
+    generated: List[int]
+    admitted_at: float
+
+
+class InferenceEngine:
+    """Continuous-batching engine over one parameter pytree.
+
+    Tensor parallelism: pass ``tp_axis``/``tp_size`` AND a ``transform``
+    that shard_maps the prefill/decode python callables over that axis
+    (params TP-sharded by ``gpt_param_specs``-style specs, everything else
+    replicated) — the programs then route through the
+    ``tensor_parallel`` layers with vocab-gathered logits, and the KV
+    pools hold the ``num_heads / tp_size`` LOCAL heads. The default
+    (``tp_axis=None``, identity transform) drives the single-device
+    programs — the stock-jax path the acceptance tests pin.
+
+    ``sink``: an ``apex_tpu.monitor.JsonlSink`` (or None) receiving one
+    record per engine step. ``peak_flops_per_s``: chip peak for the
+    modeled decode-MFU column (omitted -> mfu not reported).
+    """
+
+    def __init__(
+        self,
+        params: Pytree,
+        cfg,  # transformer.testing.GPTConfig
+        serve_cfg: Optional[ServeConfig] = None,
+        *,
+        base_key=None,
+        sink=None,
+        peak_flops_per_s: Optional[float] = None,
+        transform: Optional[Callable[[Callable], Callable]] = None,
+        tp_axis: Optional[str] = None,
+        tp_size: int = 1,
+        use_pallas: Optional[bool] = None,
+    ):
+        scfg = serve_cfg or ServeConfig()
+        scfg.validate()
+        if cfg.num_experts:
+            raise NotImplementedError("serve does not support MoE yet")
+        if (tp_axis is None) != (tp_size == 1):
+            raise ValueError("pass tp_axis together with tp_size > 1 "
+                             "(and a shard_map transform)")
+        if cfg.num_heads % tp_size:
+            raise ValueError(f"num_heads ({cfg.num_heads}) not divisible "
+                             f"by tp_size ({tp_size})")
+        self.params = params
+        self.cfg = cfg
+        self.serve_cfg = scfg
+        if scfg.max_context is not None and scfg.max_context > cfg.max_seq:
+            raise ValueError(
+                f"max_context ({scfg.max_context}) exceeds the model's "
+                f"max_seq ({cfg.max_seq})")
+        self.max_context = scfg.max_context or cfg.max_seq
+        bs = scfg.block_size
+        self._blocks_per_slot = -(-self.max_context // bs)
+        num_blocks = (scfg.num_blocks if scfg.num_blocks is not None
+                      else scfg.num_slots * self._blocks_per_slot)
+        self._tp_axis = tp_axis
+        self.kv_cfg = KVCacheConfig(
+            num_layers=cfg.num_layers, num_heads=cfg.num_heads // tp_size,
+            head_dim=cfg.head_dim, num_blocks=num_blocks, block_size=bs,
+            dtype=cfg.dtype, quantized=scfg.kv_quant == "int8")
+        self.buckets = tuple(sorted(
+            scfg.prefill_buckets or default_bucket_ladder(self.max_context)))
+        if self.buckets[-1] < self.max_context:
+            raise ValueError(
+                f"largest bucket ({self.buckets[-1]}) below max_context "
+                f"({self.max_context}) — long prompts would be unservable")
+        self.allocator = BlockAllocator(num_blocks)
+        self.cache = init_kv_cache(self.kv_cfg)
+        n = scfg.num_slots
+        self._block_tables = np.zeros((n, self._blocks_per_slot), np.int32)
+        self._seq_lens = np.zeros((n,), np.int32)
+        self._last_tokens = np.zeros((n,), np.int32)
+        self._active = np.zeros((n,), bool)
+        self._keys = np.zeros((n, 2), np.uint32)
+        self._slots: List[Optional[_SlotState]] = [None] * n
+        self._pending: collections.deque = collections.deque()
+        self._finished: Dict[str, List[int]] = {}
+        self.ttft_ms: Dict[str, float] = {}
+        self._base_key = (base_key if base_key is not None
+                          else jax.random.PRNGKey(0))
+        self._sink = sink
+        self._peak = peak_flops_per_s
+        self._step_idx = 0
+        self._tokens_generated = 0
+        self._t_start: Optional[float] = None
+        self._n_params = sum(
+            x.size for x in jax.tree_util.tree_leaves(params))
+        wrap = transform if transform is not None else (lambda f: f)
+        self._use_pallas = use_pallas
+        self._build_programs(wrap)
+
+    # -- program construction (the ONLY jit sites) -------------------------
+    def _build_programs(self, wrap) -> None:
+        cfg, kv_cfg, scfg = self.cfg, self.kv_cfg, self.serve_cfg
+
+        tp_axis = self._tp_axis
+
+        def prefill(params, cache, tokens, prompt_len, block_row, key):
+            cache, logits = gpt_prefill(params, tokens, prompt_len, cache,
+                                        block_row, cfg, kv_cfg,
+                                        tp_axis=tp_axis)
+            tok = sample(logits[None], key[None],
+                         jnp.stack([prompt_len]), scfg.sampling)
+            return cache, tok[0]
+
+        def decode(params, cache, last_tokens, seq_lens, active,
+                   block_tables, keys):
+            cache, logits = gpt_decode_step(
+                params, last_tokens, seq_lens, active, cache, block_tables,
+                cfg, kv_cfg, tp_axis=tp_axis, use_pallas=self._use_pallas)
+            toks = sample(logits, keys, seq_lens + 1, scfg.sampling)
+            # in-graph step metrics: donation-safe, fixed treedef — the
+            # monitor.Metrics contract (zero extra compilations)
+            m = Metrics().record(
+                active_slots=jnp.sum(active),
+                context_tokens=jnp.sum(
+                    jnp.where(active, seq_lens + 1, 0)))
+            return cache, toks, m
+
+        self._prefill = jax.jit(wrap(prefill), donate_argnums=(1,))
+        self._decode = jax.jit(wrap(decode), donate_argnums=(1,))
+
+    def compile_counts(self) -> Dict[str, Optional[int]]:
+        """Jit-cache sizes of the two programs — the compile-count gate
+        reads this (expected: <= len(buckets) prefills + 1 decode)."""
+        def n(f):
+            fn = getattr(f, "_cache_size", None)
+            return fn() if callable(fn) else None
+
+        return {"prefill": n(self._prefill), "decode": n(self._decode)}
+
+    # -- submission --------------------------------------------------------
+    def bucket_for(self, prompt_len: int) -> int:
+        for b in self.buckets:
+            if b >= prompt_len:
+                return b
+        raise ValueError(
+            f"prompt length {prompt_len} exceeds the largest bucket "
+            f"({self.buckets[-1]})")
+
+    def submit(self, request: Request) -> None:
+        p = len(request.tokens)
+        if p < 1:
+            raise ValueError(f"{request.uid}: empty prompt")
+        if request.max_new_tokens < 1:
+            raise ValueError(f"{request.uid}: max_new_tokens must be >= 1")
+        if p >= self.max_context:
+            raise ValueError(
+                f"{request.uid}: prompt ({p}) must leave room to generate "
+                f"(max_context {self.max_context})")
+        self.bucket_for(p)  # unservable prompts fail at submit, not admit
+        self._pending.append((request, time.perf_counter()))
+
+    # -- admission ---------------------------------------------------------
+    def _free_slot(self) -> Optional[int]:
+        for i, s in enumerate(self._slots):
+            if s is None:
+                return i
+        return None
+
+    def _total_tokens(self, request: Request) -> int:
+        # cached tokens at retirement: prompt + all generated but the last
+        # (never fed back); budget the full generation window, clamped
+        return min(len(request.tokens) + request.max_new_tokens,
+                   self.max_context)
+
+    def _try_admit(self) -> int:
+        admitted = 0
+        while self._pending:
+            slot = self._free_slot()
+            if slot is None:
+                break
+            request, t_submit = self._pending[0]
+            n_blocks = self.kv_cfg.blocks_for_tokens(
+                self._total_tokens(request))
+            blocks = self.allocator.alloc(n_blocks)
+            if blocks is None:
+                break  # pool full: wait for a retirement to free blocks
+            self._pending.popleft()
+            self._admit(slot, request, blocks, t_submit)
+            admitted += 1
+        return admitted
+
+    def _admit(self, slot: int, request: Request, blocks: List[int],
+               t_submit: float) -> None:
+        p = len(request.tokens)
+        bucket = self.bucket_for(p)
+        row = np.zeros((self._blocks_per_slot,), np.int32)
+        row[:len(blocks)] = blocks
+        tokens = np.zeros((bucket,), np.int32)
+        tokens[:p] = np.asarray(request.tokens, np.int32)
+        key = np.asarray(
+            request_key(self._base_key, request.sampling_seed()), np.uint32)
+        with span("prefill"):
+            self.cache, first = self._prefill(
+                self.params, self.cache, jnp.asarray(tokens),
+                jnp.int32(p), jnp.asarray(row), jnp.asarray(key))
+            first = int(first)  # fence: TTFT includes the device round-trip
+        now = time.perf_counter()
+        self.ttft_ms[request.uid] = (now - t_submit) * 1e3
+        if self._t_start is None:
+            self._t_start = now
+        self._tokens_generated += 1
+        state = _SlotState(request=request, blocks=blocks,
+                           generated=[first], admitted_at=now)
+        self._slots[slot] = state
+        self._block_tables[slot] = row
+        self._seq_lens[slot] = p
+        self._last_tokens[slot] = first
+        self._keys[slot] = key
+        self._active[slot] = True
+        if self._should_retire(state, first):
+            self._retire(slot)
+
+    # -- retirement --------------------------------------------------------
+    def _should_retire(self, state: _SlotState, tok: int) -> bool:
+        if (self.serve_cfg.eos_id is not None
+                and tok == self.serve_cfg.eos_id):
+            return True
+        if len(state.generated) >= state.request.max_new_tokens:
+            return True
+        # feeding the next token would write at position p + generated - 1,
+        # which must stay inside the context window: continue while
+        # p + generated <= max_context, retire beyond
+        return (len(state.request.tokens) + len(state.generated)
+                > self.max_context)
+
+    def _retire(self, slot: int) -> None:
+        state = self._slots[slot]
+        assert state is not None
+        self._finished[state.request.uid] = state.generated
+        self.allocator.free(state.blocks)
+        self._slots[slot] = None
+        self._active[slot] = False
+        self._seq_lens[slot] = 0
+        self._last_tokens[slot] = 0
+        self._block_tables[slot] = 0
+
+    # -- stepping ----------------------------------------------------------
+    def step(self) -> bool:
+        """Admit what fits, then advance every active slot one token.
+        Returns False when nothing happened (no active slots and nothing
+        admissible)."""
+        admitted = self._try_admit()
+        if not self._active.any():
+            return admitted > 0
+        t0 = time.perf_counter()
+        with span("decode"):
+            self.cache, toks, metrics = self._decode(
+                self.params, self.cache,
+                jnp.asarray(self._last_tokens), jnp.asarray(self._seq_lens),
+                jnp.asarray(self._active), jnp.asarray(self._block_tables),
+                jnp.asarray(self._keys))
+            toks = np.asarray(toks)  # fence — the iteration-level sync
+        dt = time.perf_counter() - t0
+        active_lens = [int(s) + 1 for s, a
+                       in zip(self._seq_lens, self._active) if a]
+        n_active = len(active_lens)
+        for i in range(len(self._slots)):
+            if not self._active[i]:
+                continue
+            state = self._slots[i]
+            tok = int(toks[i])
+            state.generated.append(tok)
+            self._seq_lens[i] += 1
+            self._last_tokens[i] = tok
+            self._tokens_generated += 1
+            if self._should_retire(state, tok):
+                self._retire(i)
+        self._step_idx += 1
+        self._emit_metrics(metrics, dt, n_active, active_lens)
+        return True
+
+    def _emit_metrics(self, metrics: Metrics, dt: float, n_active: int,
+                      active_lens: List[int]) -> None:
+        if self._sink is None:
+            return
+        flops = sum(decode_flops_per_token(
+            self._n_params, self.cfg.num_layers, self.cfg.hidden, s)
+            for s in active_lens)
+        rec = {
+            "phase": "decode",
+            "step_ms": round(dt * 1e3, 3),
+            "occupancy": n_active / self.serve_cfg.num_slots,
+            "tokens_per_s": round(n_active / dt, 3) if dt else 0.0,
+            "kv_read_bytes": kv_read_bytes(self.kv_cfg, active_lens),
+            "kv_write_bytes": n_active * kv_write_bytes_per_token(
+                self.kv_cfg),
+            "decode_flops_modeled": flops,
+        }
+        if self._peak:
+            rec["decode_mfu"] = (flops / dt) / self._peak if dt else 0.0
+        self._sink.write(step=self._step_idx, metrics=metrics, **rec)
+
+    # -- driving -----------------------------------------------------------
+    def run(self, requests: Sequence[Request],
+            max_steps: Optional[int] = None) -> Dict[str, List[int]]:
+        """Serve ``requests`` to completion; returns uid -> generated
+        tokens (the per-request streams, admission-order-invariant)."""
+        for r in requests:
+            self.submit(r)
+        steps = 0
+        while self._pending or self._active.any():
+            if max_steps is not None and steps >= max_steps:
+                break
+            if not self.step():
+                state_blocks = self.kv_cfg.blocks_for_tokens(
+                    self._total_tokens(self._pending[0][0]))
+                raise RuntimeError(
+                    f"engine stalled: next request needs {state_blocks} "
+                    f"blocks, pool has {self.allocator.free_count} free "
+                    f"and no active slot will release more — the pool is "
+                    f"too small for this request")
+            steps += 1
+        return dict(self._finished)
+
+    # -- introspection / stats --------------------------------------------
+    @property
+    def finished(self) -> Dict[str, List[int]]:
+        return dict(self._finished)
+
+    def occupancy(self) -> float:
+        return float(self._active.sum()) / self.serve_cfg.num_slots
+
+    def throughput(self) -> Optional[float]:
+        """Generated tokens per second since the first prefill."""
+        if self._t_start is None:
+            return None
+        dt = time.perf_counter() - self._t_start
+        return self._tokens_generated / dt if dt > 0 else None
+
+    def kv_budget_bytes(self) -> int:
+        return kv_cache_bytes(self.kv_cfg)
+
+    # -- checkpoint integration -------------------------------------------
+    @classmethod
+    def from_checkpoint(cls, directory: str, template_params: Pytree, cfg,
+                        serve_cfg: Optional[ServeConfig] = None,
+                        **kwargs) -> "InferenceEngine":
+        """Build an engine from the newest VALID checkpoint under
+        ``directory`` (``resilience.CheckpointManager.latest_valid`` —
+        torn/corrupt saves are skipped, a wrong-revision manifest refuses
+        to bind). ``template_params`` supplies the pytree structure (e.g.
+        ``init_gpt_params`` output)."""
+        from apex_tpu.resilience.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(directory)
+        params, step = mgr.restore(template_params)
+        eng = cls(params, cfg, serve_cfg, **kwargs)
+        eng.checkpoint_step = step
+        return eng
+
+
+def decode_flops_per_token(n_params: int, num_layers: int, hidden: int,
+                           context: int) -> float:
+    """Modeled forward flops to decode ONE token at the given context:
+    ``2N`` matmul flops plus paged attention ``4·L·hidden·context`` (QKᵀ
+    and PV against the cached context). The serving analogue of
+    ``monitor.report.gpt_analytic_flops_per_token`` (which counts fwd+bwd
+    at 6N) — bench_serve divides by this so its MFU column is honest about
+    being a model."""
+    return float(2 * n_params + 4 * num_layers * hidden * context)

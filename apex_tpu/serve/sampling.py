@@ -1,0 +1,98 @@
+"""In-graph token sampling for the decode loop.
+
+One jitted :func:`sample` over the whole slot grid — greedy, temperature,
+top-k and nucleus (top-p) filtering composed in that order, then a
+categorical draw (Gumbel argmax). Determinism is the design center:
+
+* **per-slot keys** — every request owns a PRNG key derived once at
+  admission (:func:`request_key`); each step folds the token's absolute
+  position into it, so the draw for "request r, position p" is a pure
+  function of ``(r, p)`` — independent of which slot the request occupies,
+  what else is batched alongside it, or when it was admitted. This is what
+  makes continuous batching **request-order-invariant**: the engine's
+  streams are bitwise reproducible against single-request decode
+  (``tests/test_serve.py`` pins it).
+* **greedy is argmax** — ``temperature == 0`` bypasses the draw entirely;
+  no key is consumed, so greedy streams are key-independent too.
+
+The filters run on fp32 logits; masked entries go to ``-inf`` (exact zero
+probability under the Gumbel draw). Top-p always keeps the highest-probability
+token, so the mask can never empty a row.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingConfig:
+    """``temperature == 0`` -> greedy (argmax; top_k/top_p ignored).
+    ``top_k == 0`` / ``top_p == 1.0`` disable the respective filter."""
+
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+
+    def validate(self) -> None:
+        if self.temperature < 0:
+            raise ValueError("temperature must be >= 0")
+        if self.top_k < 0:
+            raise ValueError("top_k must be >= 0")
+        if not 0.0 < self.top_p <= 1.0:
+            raise ValueError("top_p must be in (0, 1]")
+
+
+def request_key(base_key, request_seed: int):
+    """The request's own PRNG key: ``fold_in(base, seed)``. The seed is a
+    request-intrinsic integer (the engine derives it from the request id),
+    NOT an admission index — keys must not depend on arrival order."""
+    return jax.random.fold_in(base_key, request_seed)
+
+
+def step_keys(keys, positions):
+    """Fold each slot's token position into its request key: (n, 2) uint32
+    keys + (n,) positions -> (n, 2) per-step keys."""
+    return jax.vmap(jax.random.fold_in)(keys, positions)
+
+
+def _top_k_mask(x, k: int):
+    kth = jax.lax.top_k(x, k)[0][..., -1:]
+    return jnp.where(x < kth, -jnp.inf, x)
+
+
+def _top_p_mask(x, p: float):
+    """Nucleus filter: keep the smallest prefix of the probability-sorted
+    vocab whose (exclusive) cumulative mass is < p — the top token always
+    survives."""
+    sorted_x = jnp.flip(jnp.sort(x, axis=-1), axis=-1)
+    probs = jax.nn.softmax(sorted_x, axis=-1)
+    cum_excl = jnp.cumsum(probs, axis=-1) - probs
+    kept = cum_excl < p
+    thresh = jnp.min(jnp.where(kept, sorted_x, jnp.inf), axis=-1,
+                     keepdims=True)
+    return jnp.where(x < thresh, -jnp.inf, x)
+
+
+def sample(logits, keys, positions, cfg: SamplingConfig):
+    """(n, vocab) fp32 logits -> (n,) int32 tokens.
+
+    ``keys``: (n, 2) uint32 per-slot request keys; ``positions``: (n,)
+    int32 absolute position of the token being sampled. Greedy
+    (``temperature == 0``) ignores both.
+    """
+    logits = logits.astype(jnp.float32)
+    if cfg.temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    x = logits / jnp.float32(cfg.temperature)
+    if cfg.top_k > 0 and cfg.top_k < logits.shape[-1]:
+        x = _top_k_mask(x, cfg.top_k)
+    if cfg.top_p < 1.0:
+        x = _top_p_mask(x, cfg.top_p)
+    ks = step_keys(keys, positions)
+    return jax.vmap(
+        lambda k, row: jax.random.categorical(k, row)
+    )(ks, x).astype(jnp.int32)

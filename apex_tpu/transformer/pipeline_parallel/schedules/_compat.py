@@ -1,0 +1,18 @@
+"""One home for the ``jax.shard_map`` import so the schedule modules stay
+importable on stock jax.
+
+The mesh schedules NEED the graft toolchain to run, but merely importing
+them must not take down the whole ``apex_tpu.transformer`` tree (the
+serve/testing modules are stock-jax-usable). Pre-graft jax has no
+``jax.shard_map``; this stub keeps the import graceful and fails loudly
+at CALL time instead."""
+
+from __future__ import annotations
+
+try:
+    from jax import shard_map  # noqa: F401
+except ImportError:  # stock jax: importable, but the schedules need graft
+    def shard_map(*_a, **_k):
+        raise NotImplementedError(
+            "jax.shard_map unavailable (stock jax); this pipeline "
+            "schedule needs the graft toolchain")

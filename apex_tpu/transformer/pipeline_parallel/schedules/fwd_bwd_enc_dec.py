@@ -47,7 +47,9 @@ from typing import Any, Callable, Optional, Tuple
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
+from apex_tpu.transformer.pipeline_parallel.schedules._compat import (
+    shard_map,
+)
 from jax.sharding import PartitionSpec as P
 
 from apex_tpu.monitor.trace import span

@@ -51,6 +51,9 @@ def forward_backward_no_pipelining(
     """
     mb = split_microbatches(batch, num_microbatches)
     scale = 1.0 if loss_scale is None else loss_scale
+    _ARITY_HINT = (
+        "dropout_key given but forward_step_func does not accept a third "
+        "per-microbatch key argument (params, microbatch, key)")
     if dropout_key is not None:
         # fail loudly before tracing: a 2-arg step func with dropout_key
         # would otherwise die with an opaque arity TypeError inside scan
@@ -64,15 +67,32 @@ def forward_backward_no_pipelining(
             try:
                 sig.bind(object(), object(), object())
             except TypeError:
-                raise ValueError(
-                    "dropout_key given but forward_step_func does not "
-                    "accept a third per-microbatch key argument "
-                    "(params, microbatch, key)") from None
+                raise ValueError(_ARITY_HINT) from None
+
     keys_mb = derive_microbatch_keys(dropout_key, num_microbatches)
 
     def scaled(p, m, key):
-        loss = (forward_step_func(p, m) if key is None
-                else forward_step_func(p, m, key))
+        # second line of defense: a (*args, **kwargs) wrapper over a 2-arg
+        # step func binds the 3-arg signature above just fine, then the
+        # wrapped callable dies HERE at trace time. On TypeError, PROBE the
+        # 2-arg call: if it succeeds the function genuinely takes no key —
+        # raise the arity hint; if the probe also raises (a correct 3-arg
+        # func whose BODY threw, incl. nested arity bugs) the original
+        # error propagates untouched — a wrong "fix your signature"
+        # diagnosis would be worse than the opaque error. The probe costs
+        # one extra trace on the error path only.
+        try:
+            loss = (forward_step_func(p, m) if key is None
+                    else forward_step_func(p, m, key))
+        except TypeError as e:
+            if key is not None:
+                try:
+                    forward_step_func(p, m)
+                except Exception:
+                    raise e from None
+                raise ValueError(f"{_ARITY_HINT} (original error: {e})") \
+                    from e
+            raise
         return loss * scale / num_microbatches, loss
 
     vg = jax.value_and_grad(scaled, has_aux=True)

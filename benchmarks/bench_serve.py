@@ -1,0 +1,137 @@
+"""Serving benchmark: continuous-batching engine throughput + latency.
+
+One ``json_record`` line (the bench.py protocol): tokens/s, TTFT p50/p99,
+mean slot occupancy, decode-step p50 ms and the KV byte model for a fixed
+mixed-length request workload through ``apex_tpu.serve.InferenceEngine``.
+The KV/collective byte columns join the ``comm.accounting`` convention
+(modeled bytes, stated as such).
+
+Honesty notes baked into the record: the metric name gains a
+``_CPU_FALLBACK`` suffix off-chip (CPU rehearsal numbers must never be
+read as TPU serving throughput), and on a single chip the
+``tp_sharded_serving`` column says "needs a slice" — the TP-sharded
+decode path (vocab-gathered logits, sharded heads) has no ring to measure
+until a multi-chip window, exactly like ``bench_overlap.py``.
+
+Run: ``python benchmarks/bench_serve.py [--out FILE]``. Staged as
+``tpu_watch.sh`` stage 9 (hourly retry until banked).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from apex_tpu.utils.platform import (
+    pin_cpu_if_requested,
+    pin_cpu_if_tunnel_dead,
+    pin_cpu_platform,
+)
+
+pin_cpu_if_requested()
+pin_cpu_if_tunnel_dead()  # don't hang the watcher on a dead tunnel
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    pin_cpu_platform()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+ON_TPU = jax.default_backend() == "tpu"
+
+# the pinned protocol (canary discipline, see bench_comm.py): one fixed
+# model + workload so the line is comparable round-over-round
+HIDDEN, LAYERS, HEADS, VOCAB, MAX_SEQ = 128, 2, 8, 512, 256
+SLOTS, BLOCK_SIZE, MAX_NEW = 4, 16, 32
+PROMPT_LENS = (5, 17, 40, 9, 33, 12, 60, 25)
+
+
+def main() -> int:
+    import argparse
+    import statistics
+    import tempfile
+
+    from apex_tpu.monitor import JsonlSink, json_record, read_jsonl
+    from apex_tpu.serve import InferenceEngine, Request, ServeConfig
+    from apex_tpu.transformer.testing import GPTConfig, init_gpt_params
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--kv-quant", default="none", choices=["none", "int8"])
+    args = ap.parse_args()
+
+    name = "gpt_serve_engine"
+    if not ON_TPU:
+        name += "_CPU_FALLBACK"
+
+    cfg = GPTConfig(vocab_size=VOCAB, max_seq=MAX_SEQ, hidden=HIDDEN,
+                    num_layers=LAYERS, num_heads=HEADS,
+                    dtype=jnp.bfloat16 if ON_TPU else jnp.float32)
+    params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(0)
+    requests = [
+        Request(f"r{i}", rng.integers(0, VOCAB, size=p).tolist(),
+                max_new_tokens=MAX_NEW)
+        for i, p in enumerate(PROMPT_LENS)
+    ]
+
+    step_log = os.path.join(tempfile.mkdtemp(), "serve_steps.jsonl")
+    with JsonlSink(step_log, buffer_steps=1) as sink:
+        eng = InferenceEngine(
+            params, cfg,
+            ServeConfig(num_slots=SLOTS, block_size=BLOCK_SIZE,
+                        kv_quant=args.kv_quant),
+            sink=sink)
+        out = eng.run(requests)
+        tokens_per_s = eng.throughput()
+        ttfts = sorted(eng.ttft_ms.values())
+        kv_budget = eng.kv_budget_bytes()
+        compiles = eng.compile_counts()
+    steps = list(read_jsonl(step_log))
+    gen_tokens = sum(len(v) for v in out.values())
+
+    def pct(vals, q):
+        if not vals:
+            return None
+        return round(float(np.percentile(vals, q)), 3)
+
+    step_ms = [r["step_ms"] for r in steps]
+    rec = {
+        "metric": name,
+        "ok": len(out) == len(requests),
+        "tokens_per_s": round(tokens_per_s, 3) if tokens_per_s else None,
+        "generated_tokens": gen_tokens,
+        "ttft_ms_p50": pct(ttfts, 50),
+        "ttft_ms_p99": pct(ttfts, 99),
+        "decode_step_ms_p50": pct(step_ms, 50),
+        "mean_occupancy": round(
+            statistics.fmean(r["occupancy"] for r in steps), 4)
+        if steps else None,
+        "kv_cache_budget_bytes": kv_budget,
+        "kv_read_bytes_peak": max((r["kv_read_bytes"] for r in steps),
+                                  default=None),
+        "kv_quant": args.kv_quant,
+        "compilations": compiles,
+        "n_buckets": len(eng.buckets),
+        # the TP-sharded serving path (sharded heads, gathered logits)
+        # needs a multi-chip slice; a single chip has nothing to shard
+        "tp_sharded_serving": ("needs a slice"
+                               if len(jax.devices()) < 2 else "untested"),
+        "config": {"hidden": HIDDEN, "layers": LAYERS, "heads": HEADS,
+                   "vocab": VOCAB, "slots": SLOTS,
+                   "block_size": BLOCK_SIZE, "max_new": MAX_NEW,
+                   "prompts": list(PROMPT_LENS)},
+        "backend": jax.default_backend(),
+    }
+    line = json_record(**rec)
+    print(line, flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -19,9 +19,10 @@
 #   6 baseline matrix bench_matrix.py       -> BENCH_MATRIX_TPU.txt
 #   7 long-seq rows   long_seq_tpu.py       -> LONGSEQ_TPU.json
 #   8 overlap A/B     bench_overlap.py      -> OVERLAP_TPU.json
+#   9 serve engine    bench_serve.py        -> SERVE_TPU.json
 # After the first seven, later healthy probes only refresh stage 1+3
-# (hourly) so the banked number tracks the latest code; stage 8 rides
-# the same hourly cadence until banked (it is additive evidence and must
+# (hourly) so the banked number tracks the latest code; stages 8 and 9
+# ride the same hourly cadence until banked (additive evidence that must
 # never hold the suite out of refresh mode).
 cd /root/repo || exit 1
 export APEX_TPU_PROBE_NO_CACHE=1
@@ -31,6 +32,7 @@ STATE=/tmp/tpu_watch_stage   # highest completed stage, survives restarts
 last_refresh=0
 last_longseq=-3600  # first stage-7 attempt immediate, retries hourly
 last_overlap=-3600  # stage-8 (overlap A/B) same hourly retry contract
+last_serve=-3600    # stage-9 (serve engine) same hourly retry contract
 
 note() { echo "$(date '+%F %T') $*" >> "$LOG"; }
 
@@ -138,6 +140,34 @@ overlap_stage() {
   return 0
 }
 
+serve_stage() {
+  # stage 9: continuous-batching serve engine (tokens/s, TTFT, occupancy,
+  # KV bytes). A single chip IS a real serving measurement — promote any
+  # on-TPU record (the line itself says the TP-sharded path needs a
+  # slice) — but a CPU_FALLBACK rehearsal must neither become the
+  # permanent artifact nor advance the stage.
+  note "STAGE9 START: bench_serve.py"
+  rm -f /tmp/serve_try.json
+  timeout 1200 python benchmarks/bench_serve.py \
+    --out /tmp/serve_try.json \
+    > /tmp/tpu_stage9.out 2> /tmp/tpu_stage9.err
+  local rc=$?
+  note "STAGE9 EXIT=$rc"
+  [ -s /tmp/serve_try.json ] || return 1
+  if grep -q CPU_FALLBACK /tmp/serve_try.json; then
+    note "STAGE9 got CPU_FALLBACK, not promoting"
+    return 1
+  fi
+  cp /tmp/serve_try.json SERVE_TPU.json
+  note "STAGE9 PROMOTED $(cat SERVE_TPU.json)"
+  [ $rc -eq 0 ] || return 1
+  # advance only from exactly 8: jumping 7->9 would kill stage 8's
+  # hourly retry gates before OVERLAP_TPU.json ever banks (the artifact
+  # itself is already promoted above regardless of stage order)
+  [ "$(cat "$STATE")" -eq 8 ] && echo 9 > "$STATE"
+  return 0
+}
+
 smoke_stage() {
   # Smoke to a temp file; promote ANY real-TPU artifact (a failing kernel
   # on the chip is exactly the evidence we must bank) but never a CPU
@@ -187,6 +217,13 @@ while true; do
           overlap_stage
           last_overlap=$now
         fi
+        # stage 9 (serve engine, additive): same hourly-until-banked
+        # contract as stage 8, same reason for sitting outside the gate
+        if [ "$(cat "$STATE")" -lt 9 ] \
+            && [ $((now - last_serve)) -ge 3600 ]; then
+          serve_stage
+          last_serve=$now
+        fi
         last_refresh=$now
       fi
     else
@@ -218,6 +255,14 @@ while true; do
           && [ $((now - last_overlap)) -ge 3600 ]; then
         overlap_stage
         last_overlap=$now
+      fi
+      # stage 9: serve-engine bench (tokens/s + TTFT + occupancy + KV
+      # bytes). Hourly retry like stages 7/8; CPU rehearsals never
+      # promote (serve_stage).
+      if [ "$(cat "$STATE")" -eq 8 ] \
+          && [ $((now - last_serve)) -ge 3600 ]; then
+        serve_stage
+        last_serve=$now
       fi
       last_refresh=$now
     fi

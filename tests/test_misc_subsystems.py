@@ -379,3 +379,52 @@ def test_imagenet_trainer_exact_resume(tmp_path):
     ckpt = sorted(_glob.glob(ck + "/ckpt_*"))[-1]
     rest = m.train(m.parse_args(base + ["--resume", ckpt]))
     assert first + rest == full, (first, rest, full)
+
+
+# ---------------------------------------------------------------------------
+# no-pipelining schedule arity guard (stock-jax-safe home for it: the
+# pipeline test files need a mesh toolchain to even collect)
+
+
+def test_no_pipelining_arity_guard_catches_wrapped_step_func():
+    """ADVICE round-5: the inspect guard binds (*args, **kwargs) wrappers
+    fine, so a wrapped 2-arg step func used to die with the opaque in-scan
+    TypeError — the trace-time catch must re-raise the same hint."""
+    from apex_tpu.transformer.pipeline_parallel.schedules import (
+        forward_backward_no_pipelining,
+    )
+
+    def two_arg(params, mb):
+        return jnp.sum(params * mb["x"])
+
+    def wrapper(*args, **kwargs):  # defeats the signature.bind check
+        return two_arg(*args, **kwargs)
+
+    batch = {"x": jnp.ones((4, 2))}
+    # plain 2-arg works (no dropout key)
+    loss, grads = forward_backward_no_pipelining(
+        wrapper, batch, jnp.ones((2,)), num_microbatches=2)
+    assert np.isfinite(float(loss))
+    for fn in (two_arg, wrapper):
+        with pytest.raises(ValueError,
+                           match="third per-microbatch key"):
+            forward_backward_no_pipelining(
+                fn, batch, jnp.ones((2,)), num_microbatches=2,
+                dropout_key=jax.random.PRNGKey(0))
+    # a TypeError raised by the step computation itself (not arity) must
+    # propagate untranslated — with AND without a key: a correct 3-arg
+    # step func whose body raises TypeError must not be misdiagnosed as
+    # a signature problem
+    def broken(params, mb):
+        raise TypeError("not an arity problem")
+
+    def broken3(params, mb, key):
+        raise TypeError("not an arity problem")
+
+    with pytest.raises(TypeError, match="not an arity problem"):
+        forward_backward_no_pipelining(
+            broken, batch, jnp.ones((2,)), num_microbatches=2)
+    with pytest.raises(TypeError, match="not an arity problem"):
+        forward_backward_no_pipelining(
+            broken3, batch, jnp.ones((2,)), num_microbatches=2,
+            dropout_key=jax.random.PRNGKey(0))

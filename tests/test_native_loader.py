@@ -92,3 +92,36 @@ def test_prefetch_to_device_order_and_sharding():
 
     with pytest.raises(ValueError):
         next(prefetch_to_device(iter(batches), size=0))
+
+
+def test_prefetch_to_device_early_break_drains():
+    """A consumer that breaks early must not strand the ``size`` in-flight
+    device batches: close() drains the deque, stops pulling from the
+    source, and the generator is finished."""
+    import jax
+
+    from apex_tpu.data import prefetch_to_device
+
+    pulls = 0
+
+    def src():
+        nonlocal pulls
+        for i in range(100):
+            pulls += 1
+            yield np.full((4,), i, np.float32)
+
+    gen = prefetch_to_device(src(), size=3)
+    first = next(gen)
+    np.testing.assert_array_equal(np.asarray(first), 0.0)
+    gen.close()
+    # size (initial) + 1 (refill after the first yield) — and no more
+    assert pulls == 4
+    with pytest.raises(StopIteration):
+        next(gen)
+    # the for-loop break path rides the same close() via GC/refcount
+    gen2 = prefetch_to_device(src(), size=2)
+    for batch in gen2:
+        break
+    gen2.close()
+    with pytest.raises(StopIteration):
+        next(gen2)

@@ -1,0 +1,451 @@
+"""apex_tpu.serve — paged KV cache, decode attention, sampling, engine.
+
+All stock-jax-safe (single device, no shard_map): the serve programs run
+with ``tp_axis=None``. The two acceptance gates live here:
+
+* **request-order invariance** — continuous-batched multi-request streams
+  are BITWISE identical (greedy; same-key sampled) to single-request
+  decode of each prompt, in any admission order;
+* **compile-count gate** — a mixed-length workload compiles at most
+  ``len(buckets)`` prefill programs + exactly 1 decode program.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops.attention import attention_reference
+from apex_tpu.serve import (
+    BlockAllocator,
+    InferenceEngine,
+    KVCacheConfig,
+    Request,
+    SamplingConfig,
+    ServeConfig,
+    default_bucket_ladder,
+    gather_kv,
+    init_kv_cache,
+    kv_cache_bytes,
+    kv_read_bytes,
+    kv_write_bytes_per_token,
+    paged_attention,
+    paged_attention_reference,
+    paged_write,
+    sample,
+)
+from apex_tpu.serve.decode import gpt_decode_step, gpt_prefill
+from apex_tpu.transformer.testing import GPTConfig, init_gpt_params
+
+CFG = GPTConfig(vocab_size=97, max_seq=64, hidden=32, num_layers=2,
+                num_heads=4, dtype=jnp.float32, fused_loss=False)
+PARAMS = init_gpt_params(jax.random.PRNGKey(0), CFG)
+BUCKETS = (8, 16, 32, 64)
+
+
+def _engine(sampling=None, **kw):
+    scfg = ServeConfig(num_slots=3, block_size=8, prefill_buckets=BUCKETS,
+                       sampling=sampling or SamplingConfig(), **kw)
+    return InferenceEngine(PARAMS, CFG, scfg)
+
+
+REQS = [
+    Request("a", [1, 2, 3, 4, 5], max_new_tokens=6),
+    Request("b", [7, 8, 9], max_new_tokens=4),
+    Request("c", list(range(10, 22)), max_new_tokens=5),
+]
+
+
+# ---------------------------------------------------------------------------
+# kv_cache: allocator, write/gather bookkeeping, byte models
+
+
+def test_block_allocator_alloc_free_cycle():
+    al = BlockAllocator(4)
+    a = al.alloc(3)
+    assert len(a) == 3 and al.free_count == 1
+    assert al.alloc(2) is None          # insufficient: no partial grant
+    assert al.free_count == 1
+    al.free(a)
+    assert al.free_count == 4
+    b = al.alloc(4)
+    assert sorted(b) == [0, 1, 2, 3]
+    with pytest.raises(ValueError):
+        al.free([99])
+    al.free([0])
+    with pytest.raises(ValueError):
+        al.free([0])                     # double free
+
+
+def test_paged_write_gather_roundtrip():
+    """Tokens written through scattered block tables gather back exactly,
+    partial last block and invalid (masked) writes included."""
+    kv = KVCacheConfig(num_layers=1, num_heads=2, head_dim=4, num_blocks=6,
+                       block_size=4, dtype=jnp.float32)
+    cl = {k: v[0] for k, v in init_kv_cache(kv).items()}
+    rng = jax.random.PRNGKey(1)
+    t = 7  # 2 blocks minus one position
+    k_new = jax.random.normal(rng, (2, t, 4))
+    v_new = jax.random.normal(jax.random.fold_in(rng, 1), (2, t, 4))
+    row = jnp.asarray([5, 2], jnp.int32)         # non-contiguous blocks
+    positions = jnp.arange(t)
+    cl = paged_write(cl, kv, k_new, v_new,
+                     jnp.broadcast_to(row, (t, 2)), positions,
+                     jnp.ones((t,), bool))
+    k, v = gather_kv(cl, kv, row[None])          # (1, 2, 8, 4)
+    np.testing.assert_array_equal(np.asarray(k[0, :, :t]),
+                                  np.asarray(k_new))
+    np.testing.assert_array_equal(np.asarray(v[0, :, :t]),
+                                  np.asarray(v_new))
+    # invalid writes are dropped: same positions, valid=False, new values
+    cl2 = paged_write(cl, kv, k_new + 1.0, v_new + 1.0,
+                      jnp.broadcast_to(row, (t, 2)), positions,
+                      jnp.zeros((t,), bool))
+    k2, _ = gather_kv(cl2, kv, row[None])
+    np.testing.assert_array_equal(np.asarray(k2), np.asarray(k))
+
+
+def test_kv_byte_models():
+    kv = KVCacheConfig(num_layers=2, num_heads=4, head_dim=8, num_blocks=10,
+                       block_size=4, dtype=jnp.bfloat16)
+    # pool: 2 (k+v) * L2 * H4 * B10 * bs4 * D8 * 2 bytes
+    assert kv_cache_bytes(kv) == 2 * 2 * 4 * 10 * 4 * 8 * 2
+    assert kv_write_bytes_per_token(kv) == 2 * 2 * 4 * 8 * 2
+    # one slot at 5 tokens reads ceil(5/4)*4 = 8 block-granule tokens
+    assert kv_read_bytes(kv, [5]) == 2 * 2 * 4 * 8 * 2 * 8
+    kv8 = KVCacheConfig(num_layers=2, num_heads=4, head_dim=8,
+                        num_blocks=10, block_size=4, quantized=True)
+    # int8: 1 byte/elem + 4-byte scale per 8-elem vector = 1.5 bytes
+    assert kv_cache_bytes(kv8) == int(2 * 2 * 4 * 10 * 4 * 8 * 1.5)
+
+
+# ---------------------------------------------------------------------------
+# decode attention: the satellite coverage gates
+
+
+def _filled_cache(kv, n, bt, lens, rng):
+    """Write lens[s] random tokens per slot through its block-table row;
+    returns (layer cache, contiguous K, contiguous V)."""
+    cl = {k: v[0] for k, v in init_kv_cache(kv).items()}
+    s_max = bt.shape[1] * kv.block_size
+    K = jax.random.normal(rng, (n, kv.num_heads, s_max, kv.head_dim))
+    V = jax.random.normal(jax.random.fold_in(rng, 1),
+                          (n, kv.num_heads, s_max, kv.head_dim))
+    for s in range(n):
+        ln = int(lens[s])
+        if ln == 0:
+            continue
+        positions = jnp.arange(ln)
+        cl = paged_write(cl, kv, K[s, :, :ln], V[s, :, :ln],
+                         jnp.broadcast_to(bt[s], (ln, bt.shape[1])),
+                         positions, jnp.ones((ln,), bool))
+    return cl, K, V
+
+
+def test_paged_attention_q1_fp32_exact():
+    """q_len=1 against the paged cache == attention_reference on the
+    same-shape masked contiguous K/V — BITWISE (same ops, same shapes;
+    unwritten pool positions are zeros, matching the zero padding)."""
+    kv = KVCacheConfig(num_layers=1, num_heads=4, head_dim=8, num_blocks=12,
+                       block_size=4, dtype=jnp.float32)
+    bt = jnp.asarray([[0, 1, 2], [5, 6, 7], [9, 10, 11]], jnp.int32)
+    lens = jnp.asarray([9, 5, 1], jnp.int32)
+    cl, K, V = _filled_cache(kv, 3, bt, lens, jax.random.PRNGKey(2))
+    q = jax.random.normal(jax.random.PRNGKey(3), (3, 4, 8))
+    got = paged_attention_reference(q, cl, kv, bt, lens)
+    s_tot = 12
+    live = jnp.arange(s_tot) < lens[:, None]
+    Kp = jnp.where(live[:, None, :, None], K[:, :, :s_tot], 0.0)
+    Vp = jnp.where(live[:, None, :, None], V[:, :, :s_tot], 0.0)
+    mask = jnp.arange(s_tot)[None, None, None, :] >= lens[:, None, None,
+                                                          None]
+    want = attention_reference(q[:, :, None], Kp, Vp, mask=mask)[:, :, 0]
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    # and against the TRIMMED per-slot reference (different reduction
+    # shapes -> fp32 tolerance, not bitwise)
+    for s in range(3):
+        ln = int(lens[s])
+        o = attention_reference(q[s][:, None], K[s, :, :ln], V[s, :, :ln])
+        np.testing.assert_allclose(np.asarray(o[:, 0]),
+                                   np.asarray(got[s]), atol=1e-6)
+
+
+def test_paged_attention_int8_kv_within_codec_tolerance():
+    kv = KVCacheConfig(num_layers=1, num_heads=4, head_dim=8, num_blocks=12,
+                       block_size=4, dtype=jnp.float32)
+    kv8 = KVCacheConfig(num_layers=1, num_heads=4, head_dim=8,
+                        num_blocks=12, block_size=4, dtype=jnp.float32,
+                        quantized=True)
+    bt = jnp.asarray([[0, 1, 2], [5, 6, 7], [9, 10, 11]], jnp.int32)
+    lens = jnp.asarray([12, 6, 3], jnp.int32)
+    rng = jax.random.PRNGKey(4)
+    cl, _, _ = _filled_cache(kv, 3, bt, lens, rng)
+    cl8, _, _ = _filled_cache(kv8, 3, bt, lens, rng)
+    q = jax.random.normal(jax.random.PRNGKey(5), (3, 4, 8))
+    exact = paged_attention_reference(q, cl, kv, bt, lens)
+    quant = paged_attention_reference(q, cl8, kv8, bt, lens)
+    # int8 absmax/127 per 8-elem vector: attention outputs are convex
+    # combinations of quantized V rows perturbed by quantized-K logits
+    err = np.abs(np.asarray(quant) - np.asarray(exact)).max()
+    assert 0 < err < 0.05, err
+
+
+@pytest.mark.parametrize("quantized", [False, True])
+def test_paged_attention_pallas_interpret_parity(quantized):
+    """The Pallas gather-attend kernel (scalar-prefetched block tables,
+    online softmax) matches the gather+reference path in interpret mode."""
+    kv = KVCacheConfig(num_layers=1, num_heads=4, head_dim=8, num_blocks=12,
+                       block_size=4, dtype=jnp.float32,
+                       quantized=quantized)
+    bt = jnp.asarray([[0, 1, 2], [5, 6, 7], [9, 10, 11]], jnp.int32)
+    lens = jnp.asarray([9, 5, 0], jnp.int32)  # incl. an empty slot
+    cl, _, _ = _filled_cache(kv, 3, bt, lens, jax.random.PRNGKey(6))
+    q = jax.random.normal(jax.random.PRNGKey(7), (3, 4, 8))
+    ref = paged_attention_reference(q, cl, kv, bt, lens)
+    pal = paged_attention(q, cl, kv, bt, lens, use_pallas=True,
+                          interpret=True)
+    # live slots match; the empty slot is junk on both paths (uniform-
+    # weights junk vs zeros) and the engine never reads it
+    np.testing.assert_allclose(np.asarray(pal[:2]), np.asarray(ref[:2]),
+                               atol=1e-5)
+    assert np.isfinite(np.asarray(pal)).all()
+
+
+def test_decode_step_matches_full_recompute():
+    """Incremental prefill+decode logits == full prefill recompute of the
+    growing sequence at every step (the KV bookkeeping proof), with fed
+    tokens chosen to walk distinct inputs."""
+    kv = KVCacheConfig(num_layers=CFG.num_layers, num_heads=CFG.num_heads,
+                       head_dim=CFG.head_dim, num_blocks=8, block_size=4,
+                       dtype=jnp.float32)
+    prompt = [3, 14, 15, 92, 6]
+    p = len(prompt)
+    row = jnp.arange(8, dtype=jnp.int32)
+    toks = jnp.zeros((16,), jnp.int32).at[:p].set(jnp.asarray(prompt))
+    cache = init_kv_cache(kv)
+    cache, logits = gpt_prefill(PARAMS, toks, jnp.int32(p), cache, row,
+                                CFG, kv)
+    feed = [10, 20, 30, 40]
+    inc = [np.asarray(logits)]
+    for i, t in enumerate(feed):
+        cache, lg = gpt_decode_step(
+            PARAMS, jnp.asarray([t]), jnp.asarray([p + i]),
+            jnp.asarray([True]), cache, row[None], CFG, kv)
+        inc.append(np.asarray(lg[0]))
+    seq = list(prompt)
+    for i in range(len(feed) + 1):
+        tk = jnp.zeros((16,), jnp.int32).at[:len(seq)].set(
+            jnp.asarray(seq))
+        _, lg = gpt_prefill(PARAMS, tk, jnp.int32(len(seq)),
+                            init_kv_cache(kv), row, CFG, kv)
+        np.testing.assert_allclose(np.asarray(lg), inc[i], atol=2e-5)
+        if i < len(feed):
+            seq.append(feed[i])
+
+
+# ---------------------------------------------------------------------------
+# sampling
+
+
+def test_sampling_greedy_is_argmax_and_key_free():
+    logits = jax.random.normal(jax.random.PRNGKey(8), (3, 50))
+    keys = np.zeros((3, 2), np.uint32)
+    toks = sample(logits, jnp.asarray(keys), jnp.zeros((3,), jnp.int32),
+                  SamplingConfig())
+    np.testing.assert_array_equal(np.asarray(toks),
+                                  np.asarray(jnp.argmax(logits, -1)))
+
+
+def test_sampling_filters_and_determinism():
+    rng = jax.random.PRNGKey(9)
+    logits = jax.random.normal(rng, (4, 100)) * 3.0
+    keys = jnp.asarray(
+        np.stack([np.asarray(jax.random.fold_in(jax.random.PRNGKey(1), i),
+                             np.uint32) for i in range(4)]))
+    pos = jnp.asarray([5, 5, 7, 9], jnp.int32)
+    cfg = SamplingConfig(temperature=0.7, top_k=10, top_p=0.9)
+    a = np.asarray(sample(logits, keys, pos, cfg))
+    b = np.asarray(sample(logits, keys, pos, cfg))
+    np.testing.assert_array_equal(a, b)  # same (key, position) -> same draw
+    c = np.asarray(sample(logits, keys, pos + 1, cfg))
+    assert (a != c).any()                # position folds into the stream
+    # top-k restricts support to the k largest logits per row
+    topk = np.asarray(jax.lax.top_k(logits, 10)[1])
+    for i in range(4):
+        assert a[i] in topk[i]
+    # top-p alone always keeps the argmax reachable
+    tight = SamplingConfig(temperature=1.0, top_p=1e-9)
+    t = np.asarray(sample(logits, keys, pos, tight))
+    np.testing.assert_array_equal(t, np.asarray(jnp.argmax(logits, -1)))
+    with pytest.raises(ValueError):
+        SamplingConfig(top_p=0.0).validate()
+
+
+# ---------------------------------------------------------------------------
+# engine: the acceptance gates
+
+
+def test_engine_request_order_invariance_greedy():
+    """THE acceptance pin: continuous-batched streams are bitwise equal to
+    single-request decode, in any admission order."""
+    batched = _engine().run(REQS)
+    shuffled = _engine().run([REQS[2], REQS[0], REQS[1]])
+    singles = {}
+    for r in REQS:
+        singles.update(_engine().run([r]))
+    assert batched == singles
+    assert batched == shuffled
+    assert set(batched) == {"a", "b", "c"}
+    assert len(batched["a"]) == 6 and len(batched["b"]) == 4
+
+
+def test_engine_request_order_invariance_sampled():
+    samp = SamplingConfig(temperature=0.8, top_k=20, top_p=0.9)
+    batched = _engine(sampling=samp).run(REQS)
+    singles = {}
+    for r in REQS:
+        singles.update(_engine(sampling=samp).run([r]))
+    assert batched == singles
+
+
+def test_engine_compile_count_gate():
+    """Mixed-length workload: <= n_buckets jitted prefills + exactly 1
+    jitted decode across the whole run."""
+    eng = _engine()
+    reqs = [
+        Request("r1", [1, 2], max_new_tokens=3),                 # bucket 8
+        Request("r2", list(range(10)), max_new_tokens=3),        # bucket 16
+        Request("r3", list(range(20)), max_new_tokens=3),        # bucket 32
+        Request("r4", [5, 6, 7], max_new_tokens=4),              # bucket 8
+        Request("r5", list(range(12)), max_new_tokens=2),        # bucket 16
+    ]
+    out = eng.run(reqs)
+    assert len(out) == 5
+    counts = eng.compile_counts()
+    if counts["decode"] is None:
+        pytest.skip("this jax cannot report jit cache sizes")
+    assert counts["decode"] == 1
+    assert counts["prefill"] == 3          # buckets actually used
+    assert counts["prefill"] <= len(BUCKETS)
+
+
+def test_engine_eos_and_max_len_retirement():
+    greedy = _engine().run([REQS[0]])["a"]
+    eos = int(greedy[1])
+    out = _engine(eos_id=eos).run([REQS[0]])["a"]
+    assert out[-1] == eos and len(out) < len(greedy)
+    # max_new_tokens caps the stream exactly
+    out2 = _engine().run([Request("x", [4, 5], max_new_tokens=2)])["x"]
+    assert len(out2) == 2
+    # context-window retirement: tiny max_context stops generation
+    scfg = ServeConfig(num_slots=1, block_size=8, prefill_buckets=(8,),
+                       max_context=8)
+    eng = InferenceEngine(PARAMS, CFG, scfg)
+    out3 = eng.run([Request("y", [1, 2, 3], max_new_tokens=50)])["y"]
+    assert len(out3) == 8 - 3 + 1          # positions 3..8 exhausted
+
+
+def test_engine_int8_kv_runs_and_matches_shapes():
+    out = _engine(kv_quant="int8").run(REQS)
+    base = _engine().run(REQS)
+    assert {k: len(v) for k, v in out.items()} == \
+        {k: len(v) for k, v in base.items()}
+
+
+def test_engine_admission_waits_for_blocks():
+    """Pool sized for ~1.5 requests: the second admission defers until the
+    first retires — the run still completes every request."""
+    scfg = ServeConfig(num_slots=2, block_size=8, prefill_buckets=(8, 64),
+                       num_blocks=3)  # 24 tokens of pool
+    eng = InferenceEngine(PARAMS, CFG, scfg)
+    reqs = [Request("p", [1, 2, 3], max_new_tokens=10),
+            Request("q", [4, 5, 6], max_new_tokens=10)]
+    out = eng.run(reqs)
+    assert len(out["p"]) == 10 and len(out["q"]) == 10
+
+
+def test_engine_unservable_requests_fail_loudly():
+    eng = _engine()
+    with pytest.raises(ValueError):
+        eng.submit(Request("e", [], max_new_tokens=2))
+    with pytest.raises(ValueError):
+        eng.submit(Request("e", [1], max_new_tokens=0))
+    with pytest.raises(ValueError):
+        eng.submit(Request("e", list(range(64)), max_new_tokens=2))
+    # a request the pool can NEVER hold stalls -> RuntimeError, not a hang
+    scfg = ServeConfig(num_slots=1, block_size=8, prefill_buckets=(8, 64),
+                       num_blocks=1)
+    small = InferenceEngine(PARAMS, CFG, scfg)
+    with pytest.raises(RuntimeError, match="pool is too small"):
+        small.run([Request("big", list(range(20)), max_new_tokens=10)])
+
+
+def test_engine_metrics_jsonl(tmp_path):
+    from apex_tpu.monitor import JsonlSink, read_jsonl
+
+    path = str(tmp_path / "serve.jsonl")
+    with JsonlSink(path, buffer_steps=1) as sink:
+        scfg = ServeConfig(num_slots=3, block_size=8,
+                           prefill_buckets=BUCKETS)
+        eng = InferenceEngine(PARAMS, CFG, scfg, sink=sink,
+                              peak_flops_per_s=1e12)
+        eng.run(REQS)
+        assert set(eng.ttft_ms) == {"a", "b", "c"}
+        assert all(t > 0 for t in eng.ttft_ms.values())
+        assert eng.throughput() > 0
+    recs = list(read_jsonl(path))
+    assert recs, "no step records written"
+    for r in recs:
+        assert r["schema"] == 1
+        assert 0 < r["occupancy"] <= 1.0
+        assert r["kv_read_bytes"] > 0 and r["kv_write_bytes"] > 0
+        assert r["tokens_per_s"] > 0
+        assert 0 <= r["decode_mfu"]
+        assert r["active_slots"] >= 1     # in-graph Metrics made it out
+    # peak occupancy: all three requests were in flight at once
+    assert max(r["occupancy"] for r in recs) == 1.0
+
+
+def test_engine_from_checkpoint_latest_valid(tmp_path):
+    """Weights load through resilience.CheckpointManager.latest_valid():
+    a newer TORN checkpoint is skipped, the valid one serves."""
+    from apex_tpu.resilience.chaos import corrupt_checkpoint
+    from apex_tpu.resilience.checkpoint import CheckpointManager
+
+    d = str(tmp_path / "ckpt")
+    with CheckpointManager(d, keep_last_n=5) as mgr:
+        mgr.save(PARAMS, step=3)
+        mgr.save(jax.tree.map(lambda x: x * 0.5, PARAMS), step=7)
+        corrupt_checkpoint(mgr.step_path(7), mode="flip")
+    template = jax.tree.map(jnp.zeros_like, PARAMS)
+    eng = InferenceEngine.from_checkpoint(
+        d, template, CFG,
+        ServeConfig(num_slots=3, block_size=8, prefill_buckets=BUCKETS))
+    assert eng.checkpoint_step == 3
+    assert eng.run([REQS[0]]) == _engine().run([REQS[0]])
+
+
+def test_default_bucket_ladder():
+    assert default_bucket_ladder(64) == (16, 32, 64)
+    assert default_bucket_ladder(100) == (16, 32, 64, 100)
+    with pytest.raises(ValueError):
+        # ladder top below max_context is unservable
+        InferenceEngine(PARAMS, CFG, ServeConfig(
+            num_slots=1, block_size=8, prefill_buckets=(8, 16),
+            max_context=64))
+
+
+def test_engine_config_validation():
+    with pytest.raises(ValueError, match="block_size"):
+        InferenceEngine(PARAMS, CFG, ServeConfig(block_size=0))
+    with pytest.raises(ValueError, match="exceeds the model"):
+        InferenceEngine(PARAMS, CFG, ServeConfig(
+            num_slots=1, block_size=8, max_context=CFG.max_seq * 2))
+    with pytest.raises(ValueError, match="tp_axis"):
+        InferenceEngine(PARAMS, CFG, ServeConfig(num_slots=1,
+                                                 block_size=8), tp_size=2)
+    with pytest.raises(ValueError, match="divisible"):
+        InferenceEngine(PARAMS, CFG, ServeConfig(num_slots=1,
+                                                 block_size=8),
+                        tp_axis="tp", tp_size=3)

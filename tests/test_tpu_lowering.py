@@ -287,3 +287,38 @@ def test_lm_head_loss():
 
     with force_compiled():
         _lower_tpu(jax.grad(loss, argnums=(0, 1)), x, w)
+
+
+_PALLAS_PARAMS_OK = False
+try:  # the kernel entry points need the graft-era Pallas compiler params
+    from jax.experimental.pallas import tpu as _pltpu
+
+    _PALLAS_PARAMS_OK = hasattr(_pltpu, "CompilerParams")
+except Exception:
+    pass
+
+
+@pytest.mark.skipif(not _PALLAS_PARAMS_OK,
+                    reason="pltpu.CompilerParams needs graft-era pallas")
+@pytest.mark.parametrize("quantized", [False, True])
+def test_paged_attention_kernel_lowers_for_tpu(quantized):
+    """AOT TPU lowering of the serve gather-attend kernel: scalar-prefetch
+    block-table plumbing, the (H, 1, bs, D) pool block shape, and the int8
+    code + fp32 scale dequant path all pass Mosaic's layout rules."""
+    from apex_tpu.serve import KVCacheConfig, init_kv_cache
+    from apex_tpu.serve.decode import paged_attention
+
+    kv = KVCacheConfig(num_layers=1, num_heads=8, head_dim=64,
+                       num_blocks=16, block_size=128, dtype=jnp.bfloat16,
+                       quantized=quantized)
+    cl = {k: v[0] for k, v in init_kv_cache(kv).items()}
+    q = jnp.zeros((4, 8, 64), jnp.bfloat16)
+    bt = jnp.zeros((4, 4), jnp.int32)
+    lens = jnp.zeros((4,), jnp.int32)
+
+    def f(q, cl, bt, lens):
+        return paged_attention(q, cl, kv, bt, lens, use_pallas=True,
+                               interpret=False)
+
+    with force_compiled():
+        _lower_tpu(f, q, cl, bt, lens)
