@@ -29,7 +29,40 @@ the step:
   :func:`mfu_check` / :func:`hlo_stats` compile-only variants
   (``benchmarks/profile_step.py`` and ``check_mfu_accounting.py`` are thin
   wrappers over these).
+
+Tier 2 (the serving side — request-level attribution, not step averages):
+
+* :mod:`~apex_tpu.monitor.hist` — :class:`Histogram` /:class:`HistSpec`:
+  fixed log-spaced-bucket streaming histograms (mergeable, constant
+  memory, quantiles within ``rel_error``), host-side or as per-bucket
+  counters on the :class:`Metrics` pytree (:func:`accumulate_hist`);
+* :mod:`~apex_tpu.monitor.events` — :class:`EventLog` request-lifecycle
+  recording on one monotonic clock (``submitted → … → retired`` + queue/
+  occupancy gauges), JSONL via the sink and Chrome trace-event JSON via
+  :func:`chrome_trace` (one Perfetto track per slot and per request);
+* :mod:`~apex_tpu.monitor.slo` — :class:`SloSpec` declarative latency
+  budgets → :class:`SloTracker` goodput/violation accounting over rolling
+  windows;
+* :mod:`~apex_tpu.monitor.regress` — :func:`compare_records` baseline
+  diffing of bench records (the ``tpu_watch.sh`` stage-10 gate);
+* :mod:`~apex_tpu.monitor.view` — ``python -m apex_tpu.monitor.view``
+  latency/SLO summary CLI over any monitor JSONL file.
 """
+
+from apex_tpu.monitor.events import (  # noqa: F401
+    EventLog,
+    chrome_trace,
+    write_chrome_trace,
+)
+from apex_tpu.monitor.hist import (  # noqa: F401
+    DEFAULT_LATENCY_SPEC,
+    HistSpec,
+    Histogram,
+    accumulate_hist,
+    hist_counts,
+    hist_from_metrics,
+    hist_metric_names,
+)
 
 from apex_tpu.monitor.metrics import (  # noqa: F401
     Metrics,
@@ -50,6 +83,11 @@ from apex_tpu.monitor.sink import (  # noqa: F401
     JsonlSink,
     json_record,
     read_jsonl,
+    rotated_segments,
+)
+from apex_tpu.monitor.slo import (  # noqa: F401
+    SloSpec,
+    SloTracker,
 )
 from apex_tpu.monitor.trace import (  # noqa: F401
     PHASES,
@@ -58,23 +96,49 @@ from apex_tpu.monitor.trace import (  # noqa: F401
     step_annotation,
 )
 
+
+def __getattr__(name):
+    # regress doubles as `python -m apex_tpu.monitor.regress`; importing
+    # it eagerly here would make runpy warn about the pre-imported module
+    # every CLI run, so its two package-level names resolve lazily
+    if name in ("compare_records", "load_record"):
+        from apex_tpu.monitor import regress
+
+        return getattr(regress, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
 __all__ = [
+    "DEFAULT_LATENCY_SPEC",
+    "EventLog",
+    "HistSpec",
+    "Histogram",
     "JsonlSink",
     "Metrics",
     "PHASES",
     "SCHEMA_VERSION",
+    "SloSpec",
+    "SloTracker",
+    "accumulate_hist",
+    "chrome_trace",
+    "compare_records",
     "format_step_report",
     "global_norm",
     "gpt_analytic_flops_per_token",
+    "hist_counts",
+    "hist_from_metrics",
+    "hist_metric_names",
     "hlo_stats",
     "json_record",
+    "load_record",
     "mfu_check",
     "phase_breakdown",
     "pipeline_bubble_fraction",
     "read_jsonl",
+    "rotated_segments",
     "span",
     "span_function",
     "step_annotation",
     "step_report",
     "train_metrics",
+    "write_chrome_trace",
 ]

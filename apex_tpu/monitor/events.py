@@ -1,0 +1,214 @@
+"""Per-request lifecycle events on one monotonic clock — JSONL + Perfetto.
+
+The tier-2 attribution question ("which phase of which request blew the
+TTFT budget?") needs *events*, not step aggregates. This module is the
+event half of the serve telemetry:
+
+* :class:`EventLog` — stamps every event from ONE anchored monotonic clock
+  (``time.perf_counter`` relative to the log's creation, in ms — wall
+  clocks step; a latency pipeline must never subtract two of them) and
+  streams each record through the existing
+  :class:`~apex_tpu.monitor.sink.JsonlSink` (``kind: "event"`` /
+  ``"gauge"`` records alongside the engine's step records). Memory is
+  O(1) unless ``keep=True`` opts into in-process retention (tests, short
+  runs); long runs read events back with ``read_jsonl``.
+* the canonical request lifecycle is :data:`LIFECYCLE`:
+  ``submitted → admitted → prefill_start → prefill_end → first_token →
+  decode_chunk* → retired``, plus ``queue_depth`` / ``occupancy`` gauges.
+* :func:`chrome_trace` — the same event records rendered as Chrome
+  trace-event JSON (viewable in Perfetto / ``chrome://tracing``): one
+  track per decode **slot** (what the hardware grid was doing) and one per
+  **request** (where an individual request's time went: ``queued`` /
+  ``prefill`` / ``decode`` spans + per-chunk slices), with gauges as
+  counter tracks. :func:`write_chrome_trace` dumps it to a file.
+
+The span set in the exported trace is a pure function of the event log —
+``tests/test_serve.py`` pins that the trace matches the JSONL
+request-for-request, so either artifact can be trusted alone.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Callable, Dict, Iterable, List, Optional
+
+__all__ = [
+    "EventLog",
+    "GAUGES",
+    "LIFECYCLE",
+    "chrome_trace",
+    "write_chrome_trace",
+]
+
+# canonical request lifecycle, in order; decode_chunk repeats
+LIFECYCLE = ("submitted", "admitted", "prefill_start", "prefill_end",
+             "first_token", "decode_chunk", "retired")
+GAUGES = ("queue_depth", "occupancy")
+
+
+class EventLog:
+    """Monotonic-clock event recorder. ``sink`` is a
+    :class:`~apex_tpu.monitor.sink.JsonlSink` (or anything with a
+    ``write(**fields)`` method); ``keep=True`` additionally retains records
+    in ``self.records`` (unbounded — opt-in only)."""
+
+    def __init__(self, sink=None, keep: bool = False,
+                 clock: Callable[[], float] = time.perf_counter):
+        self._clock = clock
+        self._t0 = clock()
+        self._sink = sink
+        self.records: Optional[List[Dict[str, Any]]] = [] if keep else None
+
+    def now_ms(self) -> float:
+        """Milliseconds since log creation, from the one monotonic clock
+        every event in this log is stamped with."""
+        return (self._clock() - self._t0) * 1e3
+
+    def _write(self, rec: Dict[str, Any]) -> None:
+        if self._sink is not None:
+            self._sink.write(**rec)
+        if self.records is not None:
+            self.records.append(rec)
+
+    def emit(self, event: str, uid: Optional[str] = None,
+             t_ms: Optional[float] = None, **fields: Any) -> float:
+        """Record one lifecycle event; returns its timestamp (ms). Extra
+        ``fields`` ride the record (``slot=``, ``n_tokens=``,
+        ``start_ms=`` for span-shaped events)."""
+        t = self.now_ms() if t_ms is None else float(t_ms)
+        rec: Dict[str, Any] = {"kind": "event", "event": event,
+                               "t_ms": round(t, 3)}
+        if uid is not None:
+            rec["uid"] = uid
+        rec.update(fields)
+        self._write(rec)
+        return t
+
+    def gauge(self, name: str, value: float,
+              t_ms: Optional[float] = None) -> float:
+        """Record one gauge sample (queue depth, occupancy, ...)."""
+        t = self.now_ms() if t_ms is None else float(t_ms)
+        self._write({"kind": "gauge", "gauge": name, "t_ms": round(t, 3),
+                     "value": float(value)})
+        return t
+
+
+# ---------------------------------------------------------------------------
+# Chrome trace-event rendering (Perfetto / chrome://tracing)
+
+_PID_REQUESTS = 1
+_PID_SLOTS = 2
+
+# request-track spans derived from lifecycle event pairs: name -> (start
+# event, end event). decode_chunk spans carry their own start_ms instead.
+_SPAN_PAIRS = {
+    "queued": ("submitted", "admitted"),
+    "prefill": ("prefill_start", "prefill_end"),
+    "decode": ("first_token", "retired"),
+}
+
+
+def _meta(pid: int, tid: int, name: str, kind: str) -> Dict[str, Any]:
+    return {"ph": "M", "pid": pid, "tid": tid, "name": kind,
+            "args": {"name": name}}
+
+
+def _span(name: str, pid: int, tid: int, t0_ms: float, t1_ms: float,
+          args: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    return {"ph": "X", "name": name, "pid": pid, "tid": tid,
+            "ts": round(t0_ms * 1e3, 1),          # trace ts is µs
+            "dur": round(max(0.0, t1_ms - t0_ms) * 1e3, 1),
+            "cat": "serve", "args": args or {}}
+
+
+def request_spans(records: Iterable[Dict[str, Any]]
+                  ) -> Dict[str, List[Dict[str, Any]]]:
+    """Per-uid span list derived from an event log: the lifecycle pairs of
+    :data:`_SPAN_PAIRS` plus one span per ``decode_chunk`` event. This is
+    the SAME derivation :func:`chrome_trace` renders, exposed so tests can
+    pin trace == JSONL request-for-request."""
+    by_uid: Dict[str, Dict[str, float]] = {}
+    spans: Dict[str, List[Dict[str, Any]]] = {}
+    for r in records:
+        if r.get("kind") != "event" or "uid" not in r:
+            continue
+        uid, ev, t = r["uid"], r["event"], float(r["t_ms"])
+        seen = by_uid.setdefault(uid, {})
+        seen.setdefault(ev, t)  # first occurrence anchors the span
+        out = spans.setdefault(uid, [])
+        if ev == "decode_chunk" and "start_ms" in r:
+            out.append({"name": "decode_chunk",
+                        "t0_ms": float(r["start_ms"]), "t1_ms": t,
+                        "n_tokens": r.get("n_tokens")})
+    for uid, seen in by_uid.items():
+        out = spans.setdefault(uid, [])
+        for name, (a, b) in _SPAN_PAIRS.items():
+            if a in seen and b in seen:
+                out.append({"name": name, "t0_ms": seen[a],
+                            "t1_ms": seen[b]})
+    return spans
+
+
+def chrome_trace(records: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
+    """Render an event log (dicts from :class:`EventLog` / ``read_jsonl``)
+    as a Chrome trace-event object: request tracks (one tid per uid, spans
+    from :func:`request_spans`), slot tracks (one tid per slot, one span
+    per residency ``admitted → retired`` named by the uid), gauge counter
+    tracks."""
+    records = list(records)
+    events = [r for r in records if r.get("kind") == "event"]
+    gauges = [r for r in records if r.get("kind") == "gauge"]
+
+    trace: List[Dict[str, Any]] = [
+        _meta(_PID_REQUESTS, 0, "requests", "process_name"),
+        _meta(_PID_SLOTS, 0, "slots", "process_name"),
+    ]
+
+    # request tracks: stable tid per uid in first-seen order
+    uid_tid: Dict[str, int] = {}
+    for r in events:
+        uid = r.get("uid")
+        if uid is not None and uid not in uid_tid:
+            uid_tid[uid] = len(uid_tid)
+            trace.append(_meta(_PID_REQUESTS, uid_tid[uid], uid,
+                               "thread_name"))
+    for uid, spans in request_spans(events).items():
+        for s in spans:
+            args = {k: v for k, v in s.items()
+                    if k not in ("name", "t0_ms", "t1_ms") and v is not None}
+            trace.append(_span(s["name"], _PID_REQUESTS, uid_tid[uid],
+                               s["t0_ms"], s["t1_ms"], args))
+
+    # slot tracks: residency spans named by uid (admitted -> retired)
+    admitted: Dict[str, Dict[str, Any]] = {}
+    slot_tids = set()
+    for r in events:
+        uid = r.get("uid")
+        if r["event"] == "admitted" and "slot" in r:
+            admitted[uid] = r
+        elif r["event"] == "retired" and uid in admitted:
+            a = admitted.pop(uid)
+            slot = int(a["slot"])
+            slot_tids.add(slot)
+            trace.append(_span(uid, _PID_SLOTS, slot, float(a["t_ms"]),
+                               float(r["t_ms"])))
+    for slot in sorted(slot_tids):
+        trace.append(_meta(_PID_SLOTS, slot, f"slot {slot}", "thread_name"))
+
+    # gauges as counter tracks
+    for g in gauges:
+        trace.append({"ph": "C", "name": g["gauge"], "pid": _PID_REQUESTS,
+                      "tid": 0, "ts": round(float(g["t_ms"]) * 1e3, 1),
+                      "args": {g["gauge"]: g["value"]}})
+    return {"traceEvents": trace, "displayTimeUnit": "ms"}
+
+
+def write_chrome_trace(path: str,
+                       records: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
+    """Dump :func:`chrome_trace` to ``path`` (open the file in Perfetto /
+    ``chrome://tracing``); returns the trace object."""
+    trace = chrome_trace(records)
+    with open(path, "w") as f:
+        json.dump(trace, f)
+    return trace

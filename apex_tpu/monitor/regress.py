@@ -1,0 +1,183 @@
+"""Baseline comparison for bench records — flag metric regressions.
+
+The banked-artifact discipline (``BENCH_r0*.json``, ``SERVE_TPU.json``,
+``tpu_watch.sh`` promotion rules) gives every bench a durable last-good
+record; this module closes the loop by DIFFING a fresh record against the
+banked one so a perf regression fails loudly at bench time instead of
+surfacing rounds later in a human's spreadsheet:
+
+* :func:`load_record` — reads a record file in any of the repo's shapes:
+  one JSON object, a JSONL file (last parseable line wins — the sink
+  convention), or the ``BENCH_r0*.json`` wrapper whose payload sits under
+  ``"parsed"``.
+* :func:`compare_records` — walks the two records' shared numeric fields
+  (nested dicts flattened to dotted keys), classifies each as
+  higher-better (throughput/goodput/MFU/occupancy) or lower-better
+  (latency ``*_ms*``, violation counts) by name — unclassifiable keys are
+  skipped, never guessed — and flags changes beyond ``tol`` in the bad
+  direction. Returns a JSON-serializable report.
+* CLI: ``python -m apex_tpu.monitor.regress BASELINE NEW [--tol 0.1]`` —
+  table to stderr, one ``json_record`` line to stdout, exit 1 on
+  regression (the ``tpu_watch.sh`` stage-10 gate; CPU-rehearsal records
+  are refused by the caller before this ever runs).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import sys
+from typing import Any, Dict, List, Mapping, Optional, Tuple
+
+from apex_tpu.monitor.sink import json_record
+
+__all__ = ["classify_metric", "compare_records", "flatten_record",
+           "load_record", "main"]
+
+# name fragments that decide polarity; first match wins, explicit rules
+# override. Conservative on purpose: a key matching neither is SKIPPED.
+_HIGHER = ("tokens_per_s", "goodput", "_rps", "mfu", "occupancy",
+           "throughput", "hidden_fraction", "good_fraction")
+_LOWER = ("_ms", "violation", "latency", "bubble", "exposed_bytes")
+
+
+def classify_metric(key: str,
+                    rules: Optional[Mapping[str, str]] = None
+                    ) -> Optional[str]:
+    """'higher' | 'lower' | None (skip) for a flattened record key."""
+    if rules:
+        for pat, direction in rules.items():
+            if pat in key:
+                return direction
+    low = key.lower()
+    if any(t in low for t in _HIGHER):
+        return "higher"
+    if any(t in low for t in _LOWER):
+        return "lower"
+    return None
+
+
+def flatten_record(rec: Mapping[str, Any], prefix: str = ""
+                   ) -> Dict[str, float]:
+    """Dotted-key flattening of a record's numeric fields (bools and
+    non-numeric leaves dropped; histogram dumps skipped entirely — their
+    count/sum/min would otherwise classify as '_ms' latencies through the
+    dotted key and flag a fuller run as a regression; the quantile
+    summaries are the comparable surface)."""
+    out: Dict[str, float] = {}
+    for k, v in rec.items():
+        key = f"{prefix}{k}"
+        if k in ("schema", "ts", "buckets", "spec", "config", "hists"):
+            continue
+        if isinstance(v, Mapping):
+            if "buckets" in v and "spec" in v:
+                continue  # an embedded Histogram.to_dict, wherever it sits
+            out.update(flatten_record(v, prefix=f"{key}."))
+        elif isinstance(v, bool):
+            continue
+        elif isinstance(v, (int, float)) and math.isfinite(v):
+            out[key] = float(v)
+    return out
+
+
+def compare_records(baseline: Mapping[str, Any], new: Mapping[str, Any],
+                    tol: float = 0.1,
+                    rules: Optional[Mapping[str, str]] = None
+                    ) -> Dict[str, Any]:
+    """Diff two bench records. A key regresses when it moves beyond
+    ``tol`` (relative) in its bad direction; a zero baseline regresses on
+    ANY bad-direction move (violation counts: 0 → n must flag). Returns
+    ``{ok, compared, regressions: [...], improvements: [...]}``."""
+    fb, fn = flatten_record(baseline), flatten_record(new)
+    regressions: List[Dict[str, Any]] = []
+    improvements: List[Dict[str, Any]] = []
+    compared = 0
+    for key in sorted(set(fb) & set(fn)):
+        direction = classify_metric(key, rules)
+        if direction is None:
+            continue
+        b, n = fb[key], fn[key]
+        compared += 1
+        if b == n:
+            continue
+        worse = n < b if direction == "higher" else n > b
+        if b == 0.0:
+            delta = math.inf if n > 0 else -math.inf
+        else:
+            delta = (n - b) / abs(b)
+        entry = {"key": key, "baseline": b, "new": n,
+                 "delta_pct": (round(delta * 100, 2)
+                               if math.isfinite(delta) else None),
+                 "direction": direction}
+        if worse and (not math.isfinite(delta) or abs(delta) > tol):
+            regressions.append(entry)
+        elif not worse and (not math.isfinite(delta) or abs(delta) > tol):
+            improvements.append(entry)
+    return {"ok": not regressions, "compared": compared, "tol": tol,
+            "regressions": regressions, "improvements": improvements}
+
+
+def load_record(path: str) -> Dict[str, Any]:
+    """Load a bench record: whole-file JSON, else JSONL (last parseable
+    line). A ``BENCH_r0*.json``-style wrapper unwraps to its ``parsed``
+    payload."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        rec = json.loads(text)
+    except json.JSONDecodeError:
+        rec = None
+        for line in text.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+        if rec is None:
+            raise ValueError(f"{path}: no parseable JSON record")
+    if isinstance(rec, dict) and isinstance(rec.get("parsed"), dict):
+        rec = rec["parsed"]
+    if not isinstance(rec, dict):
+        raise ValueError(f"{path}: record is not a JSON object")
+    return rec
+
+
+def _format_rows(entries: List[Dict[str, Any]], label: str) -> List[str]:
+    lines = []
+    for e in entries:
+        d = (f"{e['delta_pct']:+.1f}%" if e["delta_pct"] is not None
+             else "from 0")
+        lines.append(f"  {label} {e['key']}: {e['baseline']:g} -> "
+                     f"{e['new']:g} ({d}, {e['direction']}-better)")
+    return lines
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="flag metric regressions between two bench records")
+    ap.add_argument("baseline")
+    ap.add_argument("new")
+    ap.add_argument("--tol", type=float, default=0.1,
+                    help="relative tolerance before flagging (default 0.1)")
+    args = ap.parse_args(argv)
+    report = compare_records(load_record(args.baseline),
+                             load_record(args.new), tol=args.tol)
+    print(f"compared {report['compared']} metrics "
+          f"(tol {args.tol:.0%}): "
+          f"{len(report['regressions'])} regressions, "
+          f"{len(report['improvements'])} improvements", file=sys.stderr)
+    for line in _format_rows(report["regressions"], "REGRESSED"):
+        print(line, file=sys.stderr)
+    for line in _format_rows(report["improvements"], "improved"):
+        print(line, file=sys.stderr)
+    print(json_record(metric="regress_report", baseline=args.baseline,
+                      new=args.new, **report), flush=True)
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

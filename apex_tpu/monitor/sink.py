@@ -22,6 +22,12 @@ The format choices are all crash-shaped:
   ``close()``/``with``: every enabled sink registers an ``atexit`` flush
   fallback (unregistered again on ``close`` so a well-behaved caller pays
   nothing at exit). Short runs and preempted runs keep their tail.
+* **size-based rotation** — with ``rotate_bytes=N`` a flush that carries
+  the file past N rolls it to ``<path>.1``, ``.2``, … (creation order:
+  ``.1`` oldest — segments are immutable once rolled, no cascade renames)
+  under the same lock; :func:`read_jsonl` iterates rotated segments in
+  order transparently. Week-long serve runs stop producing one unbounded
+  file; rotation only ever happens between whole records.
 
 Human-readable mirror: with ``log_every=N`` the sink also logs a one-line
 summary of every Nth record through the ``apex_tpu.monitor.metrics`` child
@@ -80,11 +86,16 @@ class JsonlSink:
         process0_only: bool = True,
         fsync: bool = False,
         log_every: int = 0,
+        rotate_bytes: Optional[int] = None,
     ):
         self.path = path
         self.buffer_steps = max(1, int(buffer_steps))
         self.fsync = fsync
         self.log_every = int(log_every)
+        if rotate_bytes is not None and rotate_bytes <= 0:
+            raise ValueError(
+                f"rotate_bytes must be positive, got {rotate_bytes}")
+        self.rotate_bytes = rotate_bytes
         self.enabled = _is_process_zero() if process0_only else True
         self._buf: List[str] = []
         self._file = None
@@ -152,6 +163,19 @@ class JsonlSink:
         self._file.flush()
         if self.fsync:
             os.fsync(self._file.fileno())
+        # size-based rotation: roll AFTER a whole-line flush so segments
+        # always end on record boundaries; the next flush reopens path.
+        # Roll to max(existing index)+1, NOT the first free slot — if an
+        # operator deleted old segments to reclaim disk, reusing a freed
+        # low index would file the NEWEST records under the oldest-read
+        # name and scramble chronological iteration
+        if (self.rotate_bytes is not None
+                and self._file.tell() >= self.rotate_bytes):
+            self._file.close()
+            self._file = None
+            indices = _segment_indices(self.path)
+            k = (indices[-1] + 1) if indices else 1
+            os.replace(self.path, f"{self.path}.{k}")
 
     def close(self) -> None:
         with self._iolock:
@@ -193,24 +217,55 @@ class JsonlSink:
         self._logger.info(" ".join(parts))
 
 
-def read_jsonl(path: str, strict: bool = False) -> Iterator[Dict[str, Any]]:
+def _segment_indices(path: str) -> List[int]:
+    """Sorted numeric suffixes of a sink's rotated segments on disk
+    (gap-tolerant: operators may delete old segments to reclaim space)."""
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    base = os.path.basename(path) + "."
+    try:
+        names = os.listdir(d)
+    except FileNotFoundError:
+        return []
+    return sorted(int(f[len(base):]) for f in names
+                  if f.startswith(base) and f[len(base):].isdigit())
+
+
+def rotated_segments(path: str) -> List[str]:
+    """The on-disk segments of a possibly-rotated sink, oldest first:
+    ``path.1``, ``path.2``, …, then ``path`` itself (segments are numbered
+    in creation order, so sort-by-index is chronological even when old
+    segments have been deleted)."""
+    segs = [f"{path}.{k}" for k in _segment_indices(path)]
+    if os.path.exists(path):
+        segs.append(path)
+    return segs
+
+
+def read_jsonl(path: str, strict: bool = False,
+               rotated: bool = True) -> Iterator[Dict[str, Any]]:
     """Yield records from a JSONL file, streaming (constant memory — the
     file is one line per train step of a possibly very long run). Malformed
     lines — the truncated final line of a crashed writer, or an interior
     fragment such a writer left behind before a restart terminated it — are
     skipped; pass ``strict=True`` to raise on any malformed INTERIOR line
     instead (a trailing partial line is always tolerated: it is the
-    expected crash artifact, not corruption)."""
-    with open(path) as f:
-        for raw in f:
-            # a line still carrying its newline is complete wherever it
-            # sits; only a newline-less final read is a crash tail
-            interior = raw.endswith("\n")
-            line = raw.strip()
-            if not line:
-                continue
-            try:
-                yield json.loads(line)
-            except json.JSONDecodeError:
-                if strict and interior:
-                    raise
+    expected crash artifact, not corruption). A rotated sink's segments
+    (``path.1``, ``.2``, …) are iterated in order before ``path`` unless
+    ``rotated=False``."""
+    paths = rotated_segments(path) if rotated else [path]
+    if not paths:
+        paths = [path]  # surface the FileNotFoundError the caller expects
+    for p in paths:
+        with open(p) as f:
+            for raw in f:
+                # a line still carrying its newline is complete wherever it
+                # sits; only a newline-less final read is a crash tail
+                interior = raw.endswith("\n")
+                line = raw.strip()
+                if not line:
+                    continue
+                try:
+                    yield json.loads(line)
+                except json.JSONDecodeError:
+                    if strict and interior:
+                        raise

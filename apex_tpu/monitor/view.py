@@ -1,0 +1,160 @@
+"""``python -m apex_tpu.monitor.view FILE.jsonl`` — latency/SLO summary.
+
+The one-command read of a serve telemetry log (step records, lifecycle
+events and gauges share one JSONL file — ``view`` partitions by the
+``kind`` field). Human table to **stderr**, one machine-readable
+``json_record`` line to **stdout** — the bench.py pipe convention, so
+``tpu_watch.sh`` and humans read the same invocation.
+
+Per-request latencies are reconstructed from the lifecycle events
+(``submitted → admitted → first_token → retired``); pass SLO budgets
+(``--ttft-budget`` / ``--tpot-budget`` / ``--queue-budget`` /
+``--e2e-budget``, ms) to get goodput/violation accounting through
+:class:`~apex_tpu.monitor.slo.SloTracker` on the same records. Rotated
+sinks (``FILE.jsonl.1`` …) are read transparently via ``read_jsonl``.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Any, Dict, List, Optional
+
+__all__ = ["main", "summarize"]
+
+
+def _pct(vals: List[float], q: float) -> Optional[float]:
+    if not vals:
+        return None
+    s = sorted(vals)
+    rank = max(1, int(-(-q * len(s) // 1)))  # ceil, nearest-rank
+    return round(s[min(rank, len(s)) - 1], 3)
+
+
+def _request_latencies(events: List[Dict[str, Any]]
+                       ) -> Dict[str, Dict[str, Optional[float]]]:
+    """uid -> {ttft_ms, queue_ms, e2e_ms, tpot_ms, n_tokens} from the
+    lifecycle events (dimensions missing when the log lacks the events)."""
+    by_uid: Dict[str, Dict[str, Any]] = {}
+    for r in events:
+        uid = r.get("uid")
+        if uid is None:
+            continue
+        by_uid.setdefault(uid, {})[r["event"]] = r
+    out: Dict[str, Dict[str, Optional[float]]] = {}
+    for uid, evs in by_uid.items():
+        t = {k: float(v["t_ms"]) for k, v in evs.items()}
+        lat: Dict[str, Optional[float]] = {
+            "queue_ms": (t["admitted"] - t["submitted"]
+                         if {"admitted", "submitted"} <= t.keys() else None),
+            "ttft_ms": (t["first_token"] - t["submitted"]
+                        if {"first_token", "submitted"} <= t.keys()
+                        else None),
+            "e2e_ms": (t["retired"] - t["submitted"]
+                       if {"retired", "submitted"} <= t.keys() else None),
+        }
+        ret = evs.get("retired", {})
+        n = ret.get("n_tokens")
+        lat["n_tokens"] = n
+        lat["tpot_ms"] = (
+            (t["retired"] - t["first_token"]) / (n - 1)
+            if n and n > 1 and {"retired", "first_token"} <= t.keys()
+            else None)
+        out[uid] = lat
+    return out
+
+
+def summarize(records: List[Dict[str, Any]],
+              slo=None) -> Dict[str, Any]:
+    """The view record: event/step/gauge counts, per-request latency
+    quantiles, optional SLO accounting (``slo``: an
+    :class:`~apex_tpu.monitor.slo.SloSpec`)."""
+    events = [r for r in records if r.get("kind") == "event"]
+    gauges = [r for r in records if r.get("kind") == "gauge"]
+    steps = [r for r in records if "kind" not in r]
+    lats = _request_latencies(events)
+    rec: Dict[str, Any] = {
+        "n_records": len(records), "n_events": len(events),
+        "n_gauges": len(gauges), "n_steps": len(steps),
+        "n_requests": len(lats),
+        "n_retired": sum(1 for r in events if r["event"] == "retired"),
+    }
+    for dim in ("ttft_ms", "queue_ms", "tpot_ms", "e2e_ms"):
+        vals = [v[dim] for v in lats.values() if v.get(dim) is not None]
+        if vals:
+            rec[f"{dim}_p50"] = _pct(vals, 0.5)
+            rec[f"{dim}_p99"] = _pct(vals, 0.99)
+    step_ms = [r["step_ms"] for r in steps if "step_ms" in r]
+    if step_ms:
+        rec["decode_step_ms_p50"] = _pct(step_ms, 0.5)
+        rec["decode_step_ms_p99"] = _pct(step_ms, 0.99)
+    occ = [r["occupancy"] for r in steps if "occupancy" in r]
+    if occ:
+        rec["mean_occupancy"] = round(sum(occ) / len(occ), 4)
+    if slo is not None and slo.budgets():
+        from apex_tpu.monitor.slo import SloTracker
+
+        tracker = SloTracker(slo)
+        for v in lats.values():
+            if v.get("ttft_ms") is None and v.get("e2e_ms") is None:
+                continue  # never admitted/retired: nothing to account
+            tracker.observe(ttft_ms=v.get("ttft_ms"),
+                            tpot_ms=v.get("tpot_ms"),
+                            queue_ms=v.get("queue_ms"),
+                            e2e_ms=v.get("e2e_ms"))
+        rep = tracker.report()
+        rec["slo"] = slo.to_dict()
+        rec["good"] = rep["good"]
+        rec["good_fraction"] = rep["good_fraction"]
+        rec["violations"] = rep["violations"]
+    return rec
+
+
+def _table(rec: Dict[str, Any]) -> List[str]:
+    lines = [f"records: {rec['n_records']} "
+             f"(events {rec['n_events']}, steps {rec['n_steps']}, "
+             f"gauges {rec['n_gauges']}) | requests: {rec['n_requests']} "
+             f"retired: {rec['n_retired']}"]
+    rows = [(d, rec.get(f"{d}_p50"), rec.get(f"{d}_p99"))
+            for d in ("ttft_ms", "queue_ms", "tpot_ms", "e2e_ms",
+                      "decode_step_ms")]
+    rows = [r for r in rows if r[1] is not None]
+    if rows:
+        lines.append(f"  {'metric':<16} {'p50':>10} {'p99':>10}")
+        for name, p50, p99 in rows:
+            lines.append(f"  {name:<16} {p50:>10.3f} {p99:>10.3f}")
+    if rec.get("mean_occupancy") is not None:
+        lines.append(f"  mean occupancy: {rec['mean_occupancy']}")
+    if "violations" in rec:
+        v = " ".join(f"{k}={n}" for k, n in rec["violations"].items())
+        lines.append(f"  SLO: good {rec['good']}/{rec['n_retired']} "
+                     f"({rec['good_fraction']}) violations: {v or 'none'}")
+    return lines
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    from apex_tpu.monitor.sink import json_record, read_jsonl
+    from apex_tpu.monitor.slo import SloSpec
+
+    ap = argparse.ArgumentParser(
+        description="summarize a monitor JSONL log (events + steps)")
+    ap.add_argument("path")
+    ap.add_argument("--ttft-budget", type=float, default=None)
+    ap.add_argument("--tpot-budget", type=float, default=None)
+    ap.add_argument("--queue-budget", type=float, default=None)
+    ap.add_argument("--e2e-budget", type=float, default=None)
+    args = ap.parse_args(argv)
+    slo = SloSpec(ttft_ms=args.ttft_budget, tpot_ms=args.tpot_budget,
+                  queue_ms=args.queue_budget, e2e_ms=args.e2e_budget)
+    records = list(read_jsonl(args.path))
+    rec = summarize(records, slo=slo if slo.budgets() else None)
+    for line in _table(rec):
+        print(line, file=sys.stderr)
+    print(json_record(metric="monitor_view", file=args.path, **rec),
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

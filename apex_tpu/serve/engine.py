@@ -31,6 +31,20 @@ Telemetry rides the PR-2 ``monitor`` pipeline: an in-graph ``Metrics``
 pytree out of the decode program plus host-side step records (tokens/s,
 TTFT, occupancy, modeled decode flops/MFU, KV bytes from
 ``serve.kv_cache``'s accounting) into a ``JsonlSink``.
+
+Monitor **tier 2** (request-level attribution, constant memory): every
+request runs a lifecycle timeline — ``submitted → admitted →
+prefill_start/end → first_token → decode_chunk* → retired`` on one
+monotonic clock through an optional ``monitor.EventLog`` (JSONL + Chrome
+trace via ``monitor.chrome_trace``, one Perfetto track per slot and per
+request) — and retirement FOLDS the request's latencies (TTFT, mean
+per-output-token, queue wait, end-to-end) into streaming
+``monitor.Histogram``\\ s plus an optional ``monitor.SloTracker``, then
+drops every per-uid entry. Engine state stays O(slots + backlog) across
+millions of requests when ``retain_streams=False`` (per-request token
+streams go to the ``on_retire`` callback instead of an ever-growing
+dict); :meth:`InferenceEngine.stats` returns the histograms, latency
+quantiles and goodput-under-SLO report as one JSON-serializable dict.
 """
 
 from __future__ import annotations
@@ -46,7 +60,10 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.monitor.events import EventLog
+from apex_tpu.monitor.hist import DEFAULT_LATENCY_SPEC, HistSpec, Histogram
 from apex_tpu.monitor.metrics import Metrics
+from apex_tpu.monitor.slo import SloSpec, SloTracker
 from apex_tpu.monitor.trace import span
 from apex_tpu.serve.decode import gpt_decode_step, gpt_prefill
 from apex_tpu.serve.kv_cache import (
@@ -126,12 +143,23 @@ class ServeConfig:
         self.sampling.validate()
 
 
+# the engine's latency dimensions; each gets a streaming Histogram
+_HIST_NAMES = ("ttft_ms", "tpot_ms", "queue_ms", "e2e_ms",
+               "decode_step_ms")
+
+
 @dataclasses.dataclass
 class _SlotState:
     request: Request
     blocks: List[int]
     generated: List[int]
-    admitted_at: float
+    # request timeline, ms on the engine's one monotonic clock
+    t_submit_ms: float
+    t_first_ms: float
+    queue_ms: float
+    ttft_ms: float
+    chunk_start_ms: float   # start of the decode chunk being accumulated
+    chunk_done: int         # tokens already covered by emitted chunks
 
 
 class InferenceEngine:
@@ -149,6 +177,14 @@ class InferenceEngine:
     ``sink``: an ``apex_tpu.monitor.JsonlSink`` (or None) receiving one
     record per engine step. ``peak_flops_per_s``: chip peak for the
     modeled decode-MFU column (omitted -> mfu not reported).
+
+    Tier-2 telemetry: ``events`` (a ``monitor.EventLog``) records every
+    request's lifecycle; ``slo`` (a ``monitor.SloSpec``) turns on
+    goodput/violation accounting; ``hist_spec`` overrides the latency
+    bucket ladder; ``chunk_tokens`` sets the decode-chunk span
+    granularity. ``retain_streams=False`` keeps per-request state
+    O(slots): retirement hands the stream to ``on_retire(uid, tokens)``
+    (or drops it) instead of growing the ``finished`` dict forever.
     """
 
     def __init__(
@@ -164,6 +200,12 @@ class InferenceEngine:
         tp_axis: Optional[str] = None,
         tp_size: int = 1,
         use_pallas: Optional[bool] = None,
+        events: Optional[EventLog] = None,
+        slo: Optional[SloSpec] = None,
+        hist_spec: Optional[HistSpec] = None,
+        retain_streams: bool = True,
+        on_retire: Optional[Callable[[str, List[int]], None]] = None,
+        chunk_tokens: int = 16,
     ):
         scfg = serve_cfg or ServeConfig()
         scfg.validate()
@@ -209,7 +251,6 @@ class InferenceEngine:
         self._slots: List[Optional[_SlotState]] = [None] * n
         self._pending: collections.deque = collections.deque()
         self._finished: Dict[str, List[int]] = {}
-        self.ttft_ms: Dict[str, float] = {}
         self._base_key = (base_key if base_key is not None
                           else jax.random.PRNGKey(0))
         self._sink = sink
@@ -217,6 +258,28 @@ class InferenceEngine:
         self._step_idx = 0
         self._tokens_generated = 0
         self._t_start: Optional[float] = None
+        # tier-2 telemetry: one monotonic clock (the EventLog's when
+        # given, so event timestamps and latency folds agree), streaming
+        # histograms, optional SLO accounting — all O(1) per request
+        self._events = events
+        self._t_anchor = time.perf_counter()
+        if chunk_tokens < 1:
+            raise ValueError(
+                f"chunk_tokens must be >= 1, got {chunk_tokens}")
+        self._chunk_tokens = int(chunk_tokens)
+        hspec = hist_spec or DEFAULT_LATENCY_SPEC
+        self.hists: Dict[str, Histogram] = {
+            name: Histogram(hspec) for name in _HIST_NAMES}
+        # the tracker SHARES the engine's histograms (decode_step_ms is
+        # engine-only): one fold per retirement, one source of truth for
+        # both the stats() quantiles and the slo_report
+        self._slo = (SloTracker(slo, hists={
+            d: self.hists[d]
+            for d in ("ttft_ms", "tpot_ms", "queue_ms", "e2e_ms")})
+            if slo is not None else None)
+        self._retain_streams = retain_streams
+        self._on_retire = on_retire
+        self._completed = 0
         self._n_params = sum(
             x.size for x in jax.tree_util.tree_leaves(params))
         wrap = transform if transform is not None else (lambda f: f)
@@ -283,7 +346,20 @@ class InferenceEngine:
                 f"{request.uid}: prompt ({p}) must leave room to generate "
                 f"(max_context {self.max_context})")
         self.bucket_for(p)  # unservable prompts fail at submit, not admit
-        self._pending.append((request, time.perf_counter()))
+        t = self._now_ms()
+        self._pending.append((request, t))
+        if self._events is not None:
+            self._events.emit("submitted", request.uid, t_ms=t,
+                              prompt_tokens=p,
+                              max_new_tokens=request.max_new_tokens)
+            self._events.gauge("queue_depth", len(self._pending), t_ms=t)
+
+    def _now_ms(self) -> float:
+        """Ms on the engine's one monotonic clock (the EventLog's anchor
+        when events are wired, so both artifacts share timestamps)."""
+        if self._events is not None:
+            return self._events.now_ms()
+        return (time.perf_counter() - self._t_anchor) * 1e3
 
     # -- admission ---------------------------------------------------------
     def _free_slot(self) -> Optional[int]:
@@ -316,9 +392,16 @@ class InferenceEngine:
         return admitted
 
     def _admit(self, slot: int, request: Request, blocks: List[int],
-               t_submit: float) -> None:
+               t_submit_ms: float) -> None:
         p = len(request.tokens)
         bucket = self.bucket_for(p)
+        t_adm = self._now_ms()
+        queue_ms = t_adm - t_submit_ms
+        if self._events is not None:
+            self._events.emit("admitted", request.uid, t_ms=t_adm,
+                              slot=slot, queue_ms=round(queue_ms, 3))
+            self._events.emit("prefill_start", request.uid, t_ms=t_adm,
+                              slot=slot, bucket=bucket, prompt_tokens=p)
         row = np.zeros((self._blocks_per_slot,), np.int32)
         row[:len(blocks)] = blocks
         tokens = np.zeros((bucket,), np.int32)
@@ -330,19 +413,29 @@ class InferenceEngine:
                 self.params, self.cache, jnp.asarray(tokens),
                 jnp.int32(p), jnp.asarray(row), jnp.asarray(key))
             first = int(first)  # fence: TTFT includes the device round-trip
-        now = time.perf_counter()
-        self.ttft_ms[request.uid] = (now - t_submit) * 1e3
+        t_first = self._now_ms()
+        ttft_ms = t_first - t_submit_ms
+        if self._events is not None:
+            self._events.emit("prefill_end", request.uid, t_ms=t_first,
+                              slot=slot)
+            self._events.emit("first_token", request.uid, t_ms=t_first,
+                              slot=slot, ttft_ms=round(ttft_ms, 3))
         if self._t_start is None:
-            self._t_start = now
+            self._t_start = time.perf_counter()
         self._tokens_generated += 1
         state = _SlotState(request=request, blocks=blocks,
-                           generated=[first], admitted_at=now)
+                           generated=[first], t_submit_ms=t_submit_ms,
+                           t_first_ms=t_first, queue_ms=queue_ms,
+                           ttft_ms=ttft_ms, chunk_start_ms=t_first,
+                           chunk_done=1)
         self._slots[slot] = state
         self._block_tables[slot] = row
         self._seq_lens[slot] = p
         self._last_tokens[slot] = first
         self._keys[slot] = key
         self._active[slot] = True
+        if self._events is not None:
+            self._events.gauge("occupancy", self.occupancy(), t_ms=t_first)
         if self._should_retire(state, first):
             self._retire(slot)
 
@@ -360,15 +453,53 @@ class InferenceEngine:
                 > self.max_context)
 
     def _retire(self, slot: int) -> None:
+        """Retirement FOLDS the request's timeline into the streaming
+        histograms (and SLO tracker) and drops every per-uid entry — the
+        O(slots) state contract. Streams are retained only when the
+        engine was built with ``retain_streams=True`` (the default, for
+        ``run()``'s return value) or handed to ``on_retire``."""
         state = self._slots[slot]
         assert state is not None
-        self._finished[state.request.uid] = state.generated
+        uid = state.request.uid
+        now = self._now_ms()
+        n_gen = len(state.generated)
+        e2e_ms = now - state.t_submit_ms
+        tpot_ms = ((now - state.t_first_ms) / (n_gen - 1)
+                   if n_gen > 1 else None)
+        if self._slo is not None:
+            # the tracker folds into the SAME shared histograms
+            self._slo.observe(ttft_ms=state.ttft_ms, tpot_ms=tpot_ms,
+                              queue_ms=state.queue_ms, e2e_ms=e2e_ms)
+        else:
+            self.hists["ttft_ms"].add([state.ttft_ms])
+            self.hists["queue_ms"].add([state.queue_ms])
+            self.hists["e2e_ms"].add([e2e_ms])
+            if tpot_ms is not None:
+                self.hists["tpot_ms"].add([tpot_ms])
+        if self._events is not None:
+            if n_gen > state.chunk_done:  # final partial decode chunk
+                self._events.emit(
+                    "decode_chunk", uid, t_ms=now, slot=slot,
+                    start_ms=round(state.chunk_start_ms, 3),
+                    n_tokens=n_gen - state.chunk_done)
+            self._events.emit(
+                "retired", uid, t_ms=now, slot=slot, n_tokens=n_gen,
+                ttft_ms=round(state.ttft_ms, 3), e2e_ms=round(e2e_ms, 3),
+                tpot_ms=(round(tpot_ms, 3) if tpot_ms is not None
+                         else None))
+        self._completed += 1
+        if self._retain_streams:
+            self._finished[uid] = state.generated
+        if self._on_retire is not None:
+            self._on_retire(uid, state.generated)
         self.allocator.free(state.blocks)
         self._slots[slot] = None
         self._active[slot] = False
         self._seq_lens[slot] = 0
         self._last_tokens[slot] = 0
         self._block_tables[slot] = 0
+        if self._events is not None:
+            self._events.gauge("occupancy", self.occupancy(), t_ms=now)
 
     # -- stepping ----------------------------------------------------------
     def step(self) -> bool:
@@ -387,6 +518,8 @@ class InferenceEngine:
                 jnp.asarray(self._keys))
             toks = np.asarray(toks)  # fence — the iteration-level sync
         dt = time.perf_counter() - t0
+        self.hists["decode_step_ms"].add([dt * 1e3])
+        now_ms = self._now_ms()
         active_lens = [int(s) + 1 for s, a
                        in zip(self._seq_lens, self._active) if a]
         n_active = len(active_lens)
@@ -399,6 +532,15 @@ class InferenceEngine:
             self._seq_lens[i] += 1
             self._last_tokens[i] = tok
             self._tokens_generated += 1
+            if (self._events is not None
+                    and len(state.generated) - state.chunk_done
+                    >= self._chunk_tokens):
+                self._events.emit(
+                    "decode_chunk", state.request.uid, t_ms=now_ms,
+                    slot=i, start_ms=round(state.chunk_start_ms, 3),
+                    n_tokens=len(state.generated) - state.chunk_done)
+                state.chunk_start_ms = now_ms
+                state.chunk_done = len(state.generated)
             if self._should_retire(state, tok):
                 self._retire(i)
         self._step_idx += 1
@@ -452,6 +594,51 @@ class InferenceEngine:
     @property
     def finished(self) -> Dict[str, List[int]]:
         return dict(self._finished)
+
+    @property
+    def completed(self) -> int:
+        """Requests retired so far (counts even when streams are not
+        retained)."""
+        return self._completed
+
+    def per_request_state_count(self) -> int:
+        """Per-request entries the engine is holding: retained streams +
+        queued submissions + occupied slots. With ``retain_streams=False``
+        this is O(slots + backlog) forever — the leak gate
+        ``tests/test_serve.py`` pins after 10× slot-count requests."""
+        return (len(self._finished) + len(self._pending)
+                + sum(s is not None for s in self._slots))
+
+    def stats(self) -> Dict[str, Any]:
+        """One JSON-serializable telemetry snapshot: counts, latency
+        quantiles (p50/p99 from the streaming histograms — bounded
+        relative error, O(1) memory), full histogram dumps, and the
+        goodput-under-SLO report when an ``SloSpec`` was given."""
+        out: Dict[str, Any] = {
+            "completed": self._completed,
+            "steps": self._step_idx,
+            "generated_tokens": self._tokens_generated,
+            "queue_depth": len(self._pending),
+            "occupancy": self.occupancy(),
+        }
+        tput = self.throughput()
+        out["tokens_per_s"] = round(tput, 3) if tput else None
+        for name in _HIST_NAMES:
+            h = self.hists[name]
+            if h.total == 0:
+                continue
+            out[f"{name}_p50"] = round(h.quantile(0.5), 3)
+            out[f"{name}_p99"] = round(h.quantile(0.99), 3)
+        out["hists"] = {k: v.to_dict() for k, v in self.hists.items()}
+        if self._slo is not None:
+            out["slo_report"] = self._slo.report()
+        return out
+
+    @property
+    def active(self) -> bool:
+        """Whether the engine still has work: a slot mid-generation or a
+        queued submission (the drive-loop condition loadgen polls)."""
+        return bool(self._active.any()) or bool(self._pending)
 
     def occupancy(self) -> float:
         return float(self._active.sum()) / self.serve_cfg.num_slots

@@ -15,6 +15,14 @@ until a multi-chip window, exactly like ``bench_overlap.py``.
 
 Run: ``python benchmarks/bench_serve.py [--out FILE]``. Staged as
 ``tpu_watch.sh`` stage 9 (hourly retry until banked).
+
+``--loadgen`` switches to the monitor-tier-2 goodput-under-SLO bench:
+``benchmarks/loadgen.py`` drives the engine with a seeded Poisson+burst
+workload and the line becomes goodput req/s + TTFT/TPOT p50/p99 from the
+streaming histograms + SLO violation counts (watcher stage 10, regression
+-gated against the banked record via ``apex_tpu.monitor.regress``).
+Extra args after ``--loadgen`` pass through (``--n-requests``,
+``--rate-rps``, ``--trace-dir``, budgets — see ``loadgen.py``).
 """
 
 from __future__ import annotations
@@ -60,7 +68,22 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default=None)
     ap.add_argument("--kv-quant", default="none", choices=["none", "int8"])
-    args = ap.parse_args()
+    ap.add_argument("--loadgen", action="store_true",
+                    help="run the goodput-under-SLO loadgen bench instead")
+    args, extra = ap.parse_known_args()
+
+    if args.loadgen:
+        # the tier-2 record: loadgen drives the engine, SLO accounting
+        # emits the line (same --out contract, extra args pass through)
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from loadgen import main as loadgen_main
+
+        fwd = list(extra) + ["--kv-quant", args.kv_quant]
+        if args.out:
+            fwd += ["--out", args.out]
+        return loadgen_main(fwd)
+    if extra:
+        ap.error(f"unrecognized arguments: {' '.join(extra)}")
 
     name = "gpt_serve_engine"
     if not ON_TPU:
@@ -86,26 +109,21 @@ def main() -> int:
             sink=sink)
         out = eng.run(requests)
         tokens_per_s = eng.throughput()
-        ttfts = sorted(eng.ttft_ms.values())
+        stats = eng.stats()  # TTFT/step quantiles from the streaming hists
         kv_budget = eng.kv_budget_bytes()
         compiles = eng.compile_counts()
     steps = list(read_jsonl(step_log))
     gen_tokens = sum(len(v) for v in out.values())
 
-    def pct(vals, q):
-        if not vals:
-            return None
-        return round(float(np.percentile(vals, q)), 3)
-
-    step_ms = [r["step_ms"] for r in steps]
     rec = {
         "metric": name,
         "ok": len(out) == len(requests),
         "tokens_per_s": round(tokens_per_s, 3) if tokens_per_s else None,
         "generated_tokens": gen_tokens,
-        "ttft_ms_p50": pct(ttfts, 50),
-        "ttft_ms_p99": pct(ttfts, 99),
-        "decode_step_ms_p50": pct(step_ms, 50),
+        "ttft_ms_p50": stats.get("ttft_ms_p50"),
+        "ttft_ms_p99": stats.get("ttft_ms_p99"),
+        "tpot_ms_p50": stats.get("tpot_ms_p50"),
+        "decode_step_ms_p50": stats.get("decode_step_ms_p50"),
         "mean_occupancy": round(
             statistics.fmean(r["occupancy"] for r in steps), 4)
         if steps else None,

@@ -1,0 +1,293 @@
+"""Deterministic closed-/open-loop load generator for the serve engine.
+
+The ROADMAP item-2 harness half: serving numbers mean nothing without a
+workload model, and averages mean nothing without arrival bursts — tail
+latency IS the product of queueing (arXiv 1909.09756's scale lesson;
+arXiv 2512.22219's dispatch-latency analysis). This module generates a
+**seeded, reproducible** workload and drives an ``InferenceEngine``
+through it:
+
+* **open loop** — Poisson arrivals at ``rate_rps`` (exponential gaps from
+  a fixed seed) with optional superimposed **bursts** (every
+  ``burst_every_s``, ``burst_size`` requests arrive at the same instant —
+  the queue-building event that separates p99 from p50), long-tail
+  (lognormal, clipped) prompt lengths and generation lengths. Arrivals
+  are wall-clock scheduled: a request is submitted when its arrival time
+  passes, whether or not the engine kept up — offered load is independent
+  of completion, exactly what an SLO needs to be measured against.
+* **closed loop** — a fixed number of in-flight requests; each
+  retirement immediately submits the next. Measures capacity without
+  queueing effects (the classic loadgen dual).
+
+``run_workload`` drives the engine with ``retain_streams=False`` — state
+stays O(slots + backlog) no matter how many requests flow — and returns
+``engine.stats()`` (histquantiles + goodput-under-SLO). ``main`` builds
+the pinned bench model, runs a Poisson+burst workload against a default
+SLO and prints ONE ``json_record`` line (goodput req/s, TTFT/TPOT
+p50/p99, violation counts) — ``benchmarks/bench_serve.py --loadgen``
+calls straight into this, and ``tpu_watch.sh`` stage 10 banks and
+regression-gates the line via ``apex_tpu.monitor.regress``.
+
+Run: ``python benchmarks/loadgen.py [--out FILE] [--trace-dir DIR]``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+__all__ = ["WorkloadConfig", "build_workload", "run_workload", "main"]
+
+
+@dataclasses.dataclass(frozen=True)
+class WorkloadConfig:
+    """Seeded workload shape. ``mode="open"`` uses Poisson arrivals +
+    bursts; ``mode="closed"`` keeps ``concurrency`` requests in flight
+    (arrival times all 0)."""
+
+    n_requests: int = 64
+    mode: str = "open"                 # "open" | "closed"
+    rate_rps: float = 8.0              # open: mean Poisson arrival rate
+    burst_every_s: Optional[float] = 2.0  # open: burst period (None: off)
+    burst_size: int = 4                # open: requests per burst instant
+    concurrency: int = 8               # closed: in-flight target
+    prompt_len_median: int = 24        # lognormal median prompt length
+    prompt_len_sigma: float = 0.8      # long-tail spread (log-space std)
+    prompt_len_min: int = 2
+    prompt_len_max: int = 128
+    max_new_median: int = 16           # lognormal median generation length
+    max_new_sigma: float = 0.5
+    max_new_min: int = 2
+    max_new_max: int = 64
+    seed: int = 0
+
+    def validate(self) -> None:
+        if self.mode not in ("open", "closed"):
+            raise ValueError(f"mode must be open|closed, got {self.mode!r}")
+        if self.n_requests < 1:
+            raise ValueError("n_requests must be >= 1")
+        if self.mode == "open" and self.rate_rps <= 0:
+            raise ValueError("rate_rps must be positive for open loop")
+        if self.mode == "closed" and self.concurrency < 1:
+            raise ValueError("concurrency must be >= 1 for closed loop")
+        if not (1 <= self.prompt_len_min <= self.prompt_len_max):
+            raise ValueError("bad prompt length bounds")
+        if not (1 <= self.max_new_min <= self.max_new_max):
+            raise ValueError("bad max_new bounds")
+
+
+def _lognormal_int(rng, median: float, sigma: float, lo: int, hi: int,
+                   size: int) -> np.ndarray:
+    v = rng.lognormal(mean=np.log(median), sigma=sigma, size=size)
+    return np.clip(np.round(v).astype(np.int64), lo, hi)
+
+
+def build_workload(cfg: WorkloadConfig, vocab_size: int,
+                   max_context: int) -> List[Tuple[float, Any]]:
+    """The deterministic workload: ``[(arrival_s, Request), ...]`` sorted
+    by arrival. Same config + seed -> identical request stream (uids,
+    prompts, lengths, arrival instants), so records are comparable
+    round-over-round — the canary discipline applied to load."""
+    from apex_tpu.serve import Request
+
+    cfg.validate()
+    rng = np.random.default_rng(cfg.seed)
+    n = cfg.n_requests
+    # prompt must leave >= 1 position to generate inside max_context
+    p_hi = min(cfg.prompt_len_max, max_context - 1)
+    plens = _lognormal_int(rng, cfg.prompt_len_median, cfg.prompt_len_sigma,
+                           cfg.prompt_len_min, p_hi, n)
+    glens = _lognormal_int(rng, cfg.max_new_median, cfg.max_new_sigma,
+                           cfg.max_new_min, cfg.max_new_max, n)
+    if cfg.mode == "closed":
+        arrivals = np.zeros((n,))
+    else:
+        gaps = rng.exponential(1.0 / cfg.rate_rps, size=n)
+        arrivals = np.cumsum(gaps)
+        if cfg.burst_every_s:
+            # bursts: every burst_every_s, the next burst_size arrivals
+            # collapse onto the burst instant (offered load unchanged in
+            # total, concentrated in time — the p99-making event)
+            t, i = cfg.burst_every_s, 0
+            while i < n:
+                j = int(np.searchsorted(arrivals, t))
+                k = min(j + cfg.burst_size, n)
+                arrivals[j:k] = t
+                if j >= n:
+                    break
+                i = k
+                t += cfg.burst_every_s
+            arrivals = np.sort(arrivals)
+    out = []
+    for i in range(n):
+        toks = rng.integers(0, vocab_size, size=int(plens[i])).tolist()
+        out.append((float(arrivals[i]),
+                    Request(f"lg{i:05d}", toks,
+                            max_new_tokens=int(glens[i]))))
+    return out
+
+
+def run_workload(engine, workload: List[Tuple[float, Any]],
+                 time_scale: float = 1.0,
+                 max_wall_s: float = 600.0) -> Dict[str, Any]:
+    """Drive ``engine`` through the workload; returns ``engine.stats()``
+    plus offered-load accounting.
+
+    Open loop: requests are submitted when their (scaled) arrival time
+    passes on the wall clock; the engine steps continuously while active
+    and sleeps to the next arrival when idle. Closed loop (all arrivals
+    0 with a ``concurrency``-bounded workload) degenerates to submit-all
+    + drain, which is exactly the closed-loop semantics under a slot
+    grid: the engine itself caps in-flight at ``num_slots``.
+    ``time_scale`` compresses arrival times (tests); ``max_wall_s`` is a
+    hard stop so a saturated engine still reports."""
+    pending = sorted(workload, key=lambda aw: aw[0])
+    t0 = time.perf_counter()
+    submitted = 0
+    deadline = t0 + max_wall_s
+    while (pending or engine.active) and time.perf_counter() < deadline:
+        now = time.perf_counter() - t0
+        while pending and pending[0][0] * time_scale <= now:
+            _, req = pending.pop(0)
+            engine.submit(req)
+            submitted += 1
+        progressed = engine.step()
+        if not progressed and pending:
+            # idle: sleep to the next arrival instead of spinning
+            wait = pending[0][0] * time_scale - (time.perf_counter() - t0)
+            if wait > 0:
+                time.sleep(min(wait, 0.05))
+        elif not progressed and not pending:
+            break  # drained
+    wall = time.perf_counter() - t0
+    stats = engine.stats()
+    stats["offered"] = len(workload)
+    stats["submitted"] = submitted
+    last = workload[-1][0] * time_scale if workload else 0.0
+    stats["offered_rps"] = (round(len(workload) / last, 3)
+                            if last > 0 else None)
+    stats["wall_s"] = round(wall, 3)
+    return stats
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    from apex_tpu.utils.platform import (
+        pin_cpu_if_requested,
+        pin_cpu_if_tunnel_dead,
+        pin_cpu_platform,
+    )
+
+    pin_cpu_if_requested()
+    pin_cpu_if_tunnel_dead()
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        pin_cpu_platform()
+
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.monitor import (
+        EventLog,
+        JsonlSink,
+        SloSpec,
+        json_record,
+        read_jsonl,
+        write_chrome_trace,
+    )
+    from apex_tpu.serve import InferenceEngine, ServeConfig
+    from apex_tpu.transformer.testing import GPTConfig, init_gpt_params
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--trace-dir", default=None,
+                    help="also write events.jsonl + trace.json here")
+    ap.add_argument("--n-requests", type=int, default=64)
+    ap.add_argument("--rate-rps", type=float, default=8.0)
+    ap.add_argument("--mode", default="open", choices=["open", "closed"])
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--kv-quant", default="none", choices=["none", "int8"])
+    ap.add_argument("--ttft-budget", type=float, default=2000.0)
+    ap.add_argument("--tpot-budget", type=float, default=200.0)
+    ap.add_argument("--queue-budget", type=float, default=1000.0)
+    args = ap.parse_args(argv)
+
+    on_tpu = jax.default_backend() == "tpu"
+    name = "gpt_serve_goodput_slo"
+    if not on_tpu:
+        name += "_CPU_FALLBACK"
+
+    # the pinned bench model (bench_serve.py's canary constants)
+    HIDDEN, LAYERS, HEADS, VOCAB, MAX_SEQ = 128, 2, 8, 512, 256
+    SLOTS, BLOCK_SIZE = 4, 16
+    cfg = GPTConfig(vocab_size=VOCAB, max_seq=MAX_SEQ, hidden=HIDDEN,
+                    num_layers=LAYERS, num_heads=HEADS,
+                    dtype=jnp.bfloat16 if on_tpu else jnp.float32)
+    params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+    wcfg = WorkloadConfig(n_requests=args.n_requests, mode=args.mode,
+                          rate_rps=args.rate_rps, seed=args.seed,
+                          prompt_len_max=MAX_SEQ // 2)
+    slo = SloSpec(ttft_ms=args.ttft_budget, tpot_ms=args.tpot_budget,
+                  queue_ms=args.queue_budget)
+    workload = build_workload(wcfg, VOCAB, MAX_SEQ)
+
+    events = None
+    sink = None
+    events_path = None
+    if args.trace_dir:
+        os.makedirs(args.trace_dir, exist_ok=True)
+        events_path = os.path.join(args.trace_dir, "events.jsonl")
+        sink = JsonlSink(events_path, buffer_steps=64)
+        events = EventLog(sink=sink)
+    eng = InferenceEngine(
+        params, cfg,
+        ServeConfig(num_slots=SLOTS, block_size=BLOCK_SIZE,
+                    kv_quant=args.kv_quant),
+        events=events, slo=slo, retain_streams=False)
+    stats = run_workload(eng, workload)
+    if sink is not None:
+        sink.close()
+        write_chrome_trace(os.path.join(args.trace_dir, "trace.json"),
+                           read_jsonl(events_path))
+
+    slo_rep = stats.pop("slo_report")
+    hists = stats.pop("hists")
+    rec = {
+        "metric": name,
+        "ok": stats["completed"] == len(workload),
+        "goodput_rps": slo_rep["goodput_rps"],
+        "throughput_rps": slo_rep["throughput_rps"],
+        "good_fraction": slo_rep["good_fraction"],
+        "violations": slo_rep["violations"],
+        **{k: stats.get(k) for k in (
+            "offered", "submitted", "completed", "offered_rps",
+            "generated_tokens", "tokens_per_s", "wall_s",
+            "ttft_ms_p50", "ttft_ms_p99", "tpot_ms_p50", "tpot_ms_p99",
+            "queue_ms_p50", "queue_ms_p99", "decode_step_ms_p50",
+            "decode_step_ms_p99")},
+        "slo": slo.to_dict(),
+        "hist_rel_error": round(eng.hists["ttft_ms"].spec.rel_error, 4),
+        "workload": {"mode": wcfg.mode, "n": wcfg.n_requests,
+                     "rate_rps": wcfg.rate_rps,
+                     "burst_every_s": wcfg.burst_every_s,
+                     "burst_size": wcfg.burst_size, "seed": wcfg.seed},
+        "hists": {k: hists[k] for k in ("ttft_ms", "tpot_ms")},
+        "backend": jax.default_backend(),
+    }
+    line = json_record(**rec)
+    print(line, flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -20,8 +20,9 @@
 #   7 long-seq rows   long_seq_tpu.py       -> LONGSEQ_TPU.json
 #   8 overlap A/B     bench_overlap.py      -> OVERLAP_TPU.json
 #   9 serve engine    bench_serve.py        -> SERVE_TPU.json
+#  10 serve SLO       bench_serve.py --loadgen -> SERVE_SLO_TPU.json
 # After the first seven, later healthy probes only refresh stage 1+3
-# (hourly) so the banked number tracks the latest code; stages 8 and 9
+# (hourly) so the banked number tracks the latest code; stages 8-10
 # ride the same hourly cadence until banked (additive evidence that must
 # never hold the suite out of refresh mode).
 cd /root/repo || exit 1
@@ -33,6 +34,7 @@ last_refresh=0
 last_longseq=-3600  # first stage-7 attempt immediate, retries hourly
 last_overlap=-3600  # stage-8 (overlap A/B) same hourly retry contract
 last_serve=-3600    # stage-9 (serve engine) same hourly retry contract
+last_slo=-3600      # stage-10 (serve goodput-SLO) same hourly contract
 
 note() { echo "$(date '+%F %T') $*" >> "$LOG"; }
 
@@ -168,6 +170,42 @@ serve_stage() {
   return 0
 }
 
+slo_stage() {
+  # stage 10: goodput-under-SLO serve bench (loadgen Poisson+burst ->
+  # goodput req/s, TTFT/TPOT p50/p99 from histograms, violation counts).
+  # Promotion adds a REGRESSION GATE: a fresh on-TPU record only replaces
+  # the banked one if monitor.regress finds no >15% move in the bad
+  # direction — a regressed record is logged as evidence, not banked.
+  # CPU rehearsals never promote, matching stage 9.
+  note "STAGE10 START: bench_serve.py --loadgen"
+  rm -f /tmp/serve_slo_try.json
+  timeout 1200 python benchmarks/bench_serve.py --loadgen \
+    --out /tmp/serve_slo_try.json \
+    > /tmp/tpu_stage10.out 2> /tmp/tpu_stage10.err
+  local rc=$?
+  note "STAGE10 EXIT=$rc"
+  [ -s /tmp/serve_slo_try.json ] || return 1
+  if grep -q CPU_FALLBACK /tmp/serve_slo_try.json; then
+    note "STAGE10 got CPU_FALLBACK, not promoting"
+    return 1
+  fi
+  if [ -s SERVE_SLO_TPU.json ]; then
+    if ! python -m apex_tpu.monitor.regress SERVE_SLO_TPU.json \
+        /tmp/serve_slo_try.json --tol 0.15 \
+        > /tmp/tpu_stage10_regress.out 2>> /tmp/tpu_stage10.err; then
+      note "STAGE10 REGRESSION vs banked, keeping banked record: \
+$(cat /tmp/tpu_stage10_regress.out)"
+      return 1
+    fi
+  fi
+  cp /tmp/serve_slo_try.json SERVE_SLO_TPU.json
+  note "STAGE10 PROMOTED $(cat SERVE_SLO_TPU.json)"
+  [ $rc -eq 0 ] || return 1
+  # advance only from exactly 9 (same reasoning as stage 9's 8-gate)
+  [ "$(cat "$STATE")" -eq 9 ] && echo 10 > "$STATE"
+  return 0
+}
+
 smoke_stage() {
   # Smoke to a temp file; promote ANY real-TPU artifact (a failing kernel
   # on the chip is exactly the evidence we must bank) but never a CPU
@@ -224,6 +262,14 @@ while true; do
           serve_stage
           last_serve=$now
         fi
+        # stage 10 (serve goodput-SLO, additive): hourly even AFTER
+        # banking — the regression gate is the point: every healthy
+        # window re-measures goodput-under-SLO against the banked record
+        # so a serving-latency regression surfaces within an hour
+        if [ $((now - last_slo)) -ge 3600 ]; then
+          slo_stage
+          last_slo=$now
+        fi
         last_refresh=$now
       fi
     else
@@ -263,6 +309,14 @@ while true; do
           && [ $((now - last_serve)) -ge 3600 ]; then
         serve_stage
         last_serve=$now
+      fi
+      # stage 10: goodput-under-SLO loadgen bench, regression-gated
+      # against the banked record. Hourly retry; CPU rehearsals never
+      # promote (slo_stage).
+      if [ "$(cat "$STATE")" -eq 9 ] \
+          && [ $((now - last_slo)) -ge 3600 ]; then
+        slo_stage
+        last_slo=$now
       fi
       last_refresh=$now
     fi

@@ -56,15 +56,19 @@ def public_symbols(mod):
     return out
 
 
+def _mask_addresses(text: str) -> str:
+    # object-repr defaults (flax _Sentinel, bound functions) stringify
+    # with the process's heap address — mask it or every regeneration
+    # dirties unrelated pages and buries real API changes in churn
+    return re.sub(r" at 0x[0-9a-fA-F]+", " at 0x...", text)
+
+
 def signature_of(obj) -> str:
     try:
         sig = str(inspect.signature(obj))
     except (ValueError, TypeError):
         return "(...)"
-    # object-repr defaults (flax _Sentinel, bound functions) stringify
-    # with the process's heap address — mask it or every regeneration
-    # dirties unrelated pages and buries real API changes in churn
-    return re.sub(r" at 0x[0-9a-fA-F]+", " at 0x...", sig)
+    return _mask_addresses(sig)
 
 
 def render_module(modname: str) -> str | None:
@@ -87,7 +91,7 @@ def render_module(modname: str) -> str | None:
         lines += [f"## `{kind} {name}{signature_of(obj)}`", ""]
         odoc = inspect.getdoc(obj)
         if odoc:
-            lines += [odoc, ""]
+            lines += [_mask_addresses(odoc), ""]
         if inspect.isclass(obj):
             for mname, meth in sorted(vars(obj).items()):
                 if mname.startswith("_") and mname != "__call__":
@@ -100,7 +104,7 @@ def render_module(modname: str) -> str | None:
                 lines += [f"### `{name}.{mname}{signature_of(fn)}`", ""]
                 mdoc = inspect.getdoc(fn)
                 if mdoc:
-                    lines += [mdoc, ""]
+                    lines += [_mask_addresses(mdoc), ""]
     return "\n".join(lines) + "\n"
 
 

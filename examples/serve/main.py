@@ -10,10 +10,15 @@ engine loop on one chip (or the CPU sim):
     python examples/serve/main.py --kv-quant int8 --temperature 0.8
 
 Writes per-step engine telemetry (tokens/s, TTFT, slot occupancy, KV
-bytes) to ``--metrics`` as JSONL (the monitor sink convention) and prints
-the per-request token streams. With ``--ckpt`` the parameters load through
-``resilience.CheckpointManager.latest_valid()`` — torn or corrupt saves
-are skipped, a checkpoint from a different model revision is refused.
+bytes) AND per-request lifecycle events to ``--metrics`` as JSONL (the
+monitor sink convention; ``python -m apex_tpu.monitor.view`` summarizes
+it), optionally a Chrome trace to ``--trace`` (open in Perfetto: one
+track per slot, one per request), and prints the per-request token
+streams plus the goodput-under-SLO report when budgets are given
+(``--ttft-budget`` / ``--tpot-budget`` ms). With ``--ckpt`` the
+parameters load through ``resilience.CheckpointManager.latest_valid()``
+— torn or corrupt saves are skipped, a checkpoint from a different model
+revision is refused.
 """
 
 from __future__ import annotations
@@ -30,7 +35,13 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from apex_tpu.monitor import JsonlSink
+from apex_tpu.monitor import (
+    EventLog,
+    JsonlSink,
+    SloSpec,
+    read_jsonl,
+    write_chrome_trace,
+)
 from apex_tpu.serve import (
     InferenceEngine,
     Request,
@@ -55,6 +66,12 @@ def parse_args(argv=None):
     ap.add_argument("--max-new-tokens", type=int, default=24)
     ap.add_argument("--num-requests", type=int, default=8)
     ap.add_argument("--metrics", default="serve_metrics.jsonl")
+    ap.add_argument("--trace", default=None,
+                    help="also write a Chrome trace (Perfetto) here")
+    ap.add_argument("--ttft-budget", type=float, default=None,
+                    help="TTFT SLO budget in ms (enables goodput report)")
+    ap.add_argument("--tpot-budget", type=float, default=None,
+                    help="per-output-token SLO budget in ms")
     ap.add_argument("--hidden", type=int, default=256)
     ap.add_argument("--layers", type=int, default=4)
     ap.add_argument("--heads", type=int, default=8)
@@ -76,14 +93,18 @@ def main(argv=None) -> int:
         sampling=SamplingConfig(temperature=args.temperature,
                                 top_k=args.top_k, top_p=args.top_p))
     template = init_gpt_params(jax.random.PRNGKey(0), cfg)
+    slo = (SloSpec(ttft_ms=args.ttft_budget, tpot_ms=args.tpot_budget)
+           if (args.ttft_budget or args.tpot_budget) else None)
     with JsonlSink(args.metrics, buffer_steps=8) as sink:
+        events = EventLog(sink=sink)
+        kw = dict(sink=sink, events=events, slo=slo)
         if args.ckpt:
             engine = InferenceEngine.from_checkpoint(
-                args.ckpt, template, cfg, scfg, sink=sink)
+                args.ckpt, template, cfg, scfg, **kw)
             print(f"serving checkpoint step {engine.checkpoint_step} "
                   f"from {args.ckpt}")
         else:
-            engine = InferenceEngine(template, cfg, scfg, sink=sink)
+            engine = InferenceEngine(template, cfg, scfg, **kw)
             print("serving random-init weights (pass --ckpt for a real "
                   "model)")
         rng = np.random.default_rng(0)
@@ -96,13 +117,22 @@ def main(argv=None) -> int:
         ]
         streams = engine.run(requests)
         for uid in sorted(streams):
-            ttft = engine.ttft_ms[uid]
-            print(f"{uid}: ttft={ttft:.1f}ms tokens={streams[uid]}")
-        tput = engine.throughput()
-        print(f"throughput: {tput:.1f} tokens/s | "
+            print(f"{uid}: tokens={streams[uid]}")
+        stats = engine.stats()
+        print(f"throughput: {engine.throughput():.1f} tokens/s | "
+              f"ttft p50/p99: {stats['ttft_ms_p50']:.1f}/"
+              f"{stats['ttft_ms_p99']:.1f} ms | "
               f"kv budget: {engine.kv_budget_bytes() / 1e6:.1f} MB | "
               f"compilations: {engine.compile_counts()} "
               f"(buckets: {engine.buckets})")
+        if slo is not None:
+            rep = stats["slo_report"]
+            print(f"SLO {slo.to_dict()}: good {rep['good']}/"
+                  f"{rep['completed']} goodput {rep['goodput_rps']} req/s "
+                  f"violations {rep['violations']}")
+    if args.trace:
+        write_chrome_trace(args.trace, read_jsonl(args.metrics))
+        print(f"chrome trace -> {args.trace} (open in Perfetto)")
     return 0
 
 

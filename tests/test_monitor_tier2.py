@@ -1,0 +1,556 @@
+"""monitor tier 2 — hist/events/slo/regress/view + sink rotation.
+
+All stock-jax/CPU-safe. The load-bearing gates:
+
+* histogram quantile estimates stay within the bucket relative-error
+  bound against EXACT nearest-rank quantiles on adversarial
+  distributions (bimodal, heavy-tail), merges are associative and equal
+  to one-shot ingestion, and the Metrics-pytree round-trip survives jit
+  with donation at cache-size == 1 (the PR-2 convention);
+* the loadgen + SLO path emits a goodput-under-SLO ``json_record`` with
+  TTFT/TPOT quantiles from histograms and violation counts under a
+  seeded Poisson+burst workload (the acceptance line);
+* ``JsonlSink(rotate_bytes=)`` rolls to ``.1``/``.2``/… and
+  ``read_jsonl`` iterates segments in order transparently.
+"""
+
+import functools
+import json
+import math
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.monitor import (
+    EventLog,
+    HistSpec,
+    Histogram,
+    JsonlSink,
+    Metrics,
+    SloSpec,
+    SloTracker,
+    accumulate_hist,
+    chrome_trace,
+    compare_records,
+    hist_from_metrics,
+    hist_metric_names,
+    json_record,
+    load_record,
+    read_jsonl,
+    rotated_segments,
+    write_chrome_trace,
+)
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "benchmarks"))
+
+
+def _cache_size(jitted):
+    fn = getattr(jitted, "_cache_size", None)
+    return fn() if callable(fn) else None
+
+
+def _exact_nearest_rank(values, q):
+    s = sorted(values)
+    return s[max(1, math.ceil(q * len(s))) - 1]
+
+
+# ---------------------------------------------------------------------------
+# histograms: spec, bounded-error quantiles, merge, serialization
+
+
+def test_hist_spec_buckets_and_edges():
+    spec = HistSpec(lo=1.0, hi=1000.0, growth=2.0)
+    assert spec.num_log_buckets == 10  # 2^10 = 1024 covers 1000
+    assert spec.num_buckets == 12
+    e = spec.edges()
+    np.testing.assert_allclose(e, [2.0 ** i for i in range(11)])
+    # bucket placement: underflow, ladder, overflow
+    idx = spec.bucket_of(np.array([0.0, -3.0, 0.5, 1.0, 1.9, 2.0, 999.0,
+                                   1024.0, 1e9]))
+    assert idx.tolist() == [0, 0, 0, 1, 1, 2, 10, 11, 11]
+    assert spec.rel_error == pytest.approx(math.sqrt(2.0) - 1.0)
+    with pytest.raises(ValueError):
+        HistSpec(lo=0.0, hi=1.0)
+    with pytest.raises(ValueError):
+        HistSpec(lo=1.0, hi=10.0, growth=1.0)
+
+
+@pytest.mark.parametrize("dist", ["bimodal", "heavy_tail"])
+def test_hist_quantiles_within_relative_error_bound(dist):
+    """The correctness satellite: estimates within the bucket bound
+    against exact nearest-rank quantiles on adversarial distributions."""
+    rng = np.random.default_rng(7)
+    if dist == "bimodal":
+        v = np.concatenate([rng.lognormal(0.5, 0.25, 20000),
+                            rng.lognormal(6.0, 0.4, 20000)])
+    else:  # heavy tail (Pareto alpha=1.2: p99 >> p50)
+        v = (rng.pareto(1.2, 40000) + 1.0) * 2.0
+    spec = HistSpec(lo=0.1, hi=1e6, growth=1.1)
+    h = Histogram(spec).add(v)
+    assert h.total == v.size
+    for q in (0.01, 0.1, 0.5, 0.9, 0.99, 0.999):
+        exact = _exact_nearest_rank(v, q)
+        est = h.quantile(q)
+        err = abs(est - exact) / exact
+        # tiny slack only for float-noise bucket placement at edges
+        assert err <= spec.rel_error * 1.0001, (q, est, exact, err)
+    # extremes are exact (clamped by tracked min/max)
+    assert h.quantile(0.0) == pytest.approx(v.min())
+    assert h.quantile(1.0) == pytest.approx(v.max())
+    assert h.mean() == pytest.approx(v.mean())
+
+
+def test_hist_merge_associative_and_matches_oneshot():
+    rng = np.random.default_rng(3)
+    v = rng.lognormal(2.0, 1.5, 9000)
+    spec = HistSpec(lo=0.01, hi=1e5, growth=1.2)
+    a = Histogram(spec).add(v[:3000])
+    b = Histogram(spec).add(v[3000:6000])
+    c = Histogram(spec).add(v[6000:])
+    lhs, rhs = (a + b) + c, a + (b + c)
+    one = Histogram(spec).add(v)
+    for m in (lhs, rhs):
+        np.testing.assert_array_equal(m.counts, one.counts)
+        assert m.total == one.total
+        assert m.min == one.min and m.max == one.max
+        assert m.quantile(0.99) == one.quantile(0.99)
+    # commutative too, and spec mismatch is loud
+    np.testing.assert_array_equal((b + a).counts, (a + b).counts)
+    with pytest.raises(ValueError):
+        a.merge(Histogram(HistSpec(lo=0.01, hi=1e5, growth=1.3)))
+
+
+def test_hist_json_roundtrip_and_empty():
+    spec = HistSpec(lo=0.1, hi=100.0, growth=1.5)
+    h = Histogram(spec).add([0.5, 3.0, 3.1, 250.0])
+    h2 = Histogram.from_dict(json.loads(json.dumps(h.to_dict())))
+    np.testing.assert_array_equal(h2.counts, h.counts)
+    assert h2.total == h.total and h2.quantile(0.5) == h.quantile(0.5)
+    assert h2.min == h.min and h2.max == h.max
+    empty = Histogram(spec)
+    assert empty.quantile(0.5) is None and empty.mean() is None
+    e2 = Histogram.from_dict(json.loads(json.dumps(empty.to_dict())))
+    assert e2.total == 0 and e2.quantile(0.9) is None
+    with pytest.raises(ValueError):
+        h.quantile(1.5)
+
+
+def test_hist_metrics_pytree_roundtrip_under_jit_with_donation():
+    """The PR-2 convention applied to histograms: per-bucket counters on
+    a donated Metrics carry across steps with ONE compilation, and the
+    reassembled host histogram equals the host-side reference."""
+    spec = HistSpec(lo=0.1, hi=100.0, growth=1.5)
+    rng = np.random.default_rng(0)
+    batches = rng.lognormal(1.0, 1.0, (5, 16)).astype(np.float32)
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def step(m, x):
+        return accumulate_hist(m, "lat_ms", x, spec)
+
+    m = Metrics({n: 0.0 for n in hist_metric_names("lat_ms", spec)})
+    for i in range(5):
+        m = step(m, jnp.asarray(batches[i]))
+    n = _cache_size(step)
+    if n is not None:
+        assert n == 1, f"hist accumulation retraced: {n} compilations"
+    got = hist_from_metrics(m.as_dict(), "lat_ms", spec)
+    want = Histogram(spec).add(batches.ravel())
+    np.testing.assert_array_equal(got.counts, want.counts)
+    assert got.total == want.total == 80
+    # bucket-estimate quantiles agree (counts are identical)
+    assert got.quantile(0.9) == pytest.approx(
+        spec.estimate_of(int(spec.bucket_of(
+            np.array([_exact_nearest_rank(batches.ravel(), 0.9)]))[0])),
+        rel=1e-6)
+
+
+def test_hist_counts_masks_invalid_entries():
+    from apex_tpu.monitor import hist_counts
+
+    spec = HistSpec(lo=1.0, hi=100.0, growth=2.0)
+    v = jnp.asarray([2.0, 5.0, 50.0, 7.0])
+    valid = jnp.asarray([True, False, True, False])
+    counts = np.asarray(hist_counts(v, spec, valid=valid))
+    assert counts.sum() == 2
+    h = Histogram(spec).add_counts(counts)
+    assert h.total == 2
+
+
+# ---------------------------------------------------------------------------
+# events + chrome trace (module level; the engine integration test lives
+# in test_serve.py)
+
+
+def test_event_log_monotonic_clock_and_sink(tmp_path):
+    path = str(tmp_path / "ev.jsonl")
+    with JsonlSink(path, buffer_steps=1) as sink:
+        log = EventLog(sink=sink, keep=True)
+        t1 = log.emit("submitted", "r1", prompt_tokens=5)
+        t2 = log.emit("admitted", "r1", slot=0)
+        log.gauge("queue_depth", 3)
+        assert t2 >= t1 >= 0.0
+    recs = list(read_jsonl(path))
+    assert [r.get("event", r.get("gauge")) for r in recs] == \
+        ["submitted", "admitted", "queue_depth"]
+    assert recs[0]["kind"] == "event" and recs[2]["kind"] == "gauge"
+    assert recs[0]["prompt_tokens"] == 5 and recs[2]["value"] == 3.0
+    assert log.records is not None and len(log.records) == 3
+    # explicit timestamps pass through (replayed logs)
+    log2 = EventLog()
+    assert log2.emit("retired", "r1", t_ms=42.5) == 42.5
+    assert log2.records is None  # keep=False holds nothing
+
+
+def test_chrome_trace_structure_and_counter_tracks():
+    log = EventLog(keep=True)
+    for uid, slot in (("a", 0), ("b", 1)):
+        log.emit("submitted", uid, t_ms=0.0)
+        log.emit("admitted", uid, t_ms=1.0, slot=slot)
+        log.emit("prefill_start", uid, t_ms=1.0, slot=slot)
+        log.emit("prefill_end", uid, t_ms=2.0, slot=slot)
+        log.emit("first_token", uid, t_ms=2.0, slot=slot)
+        log.emit("decode_chunk", uid, t_ms=4.0, slot=slot, start_ms=2.0,
+                 n_tokens=8)
+        log.emit("retired", uid, t_ms=4.0, slot=slot, n_tokens=9)
+    log.gauge("occupancy", 0.5, t_ms=1.0)
+    trace = chrome_trace(log.records)
+    json.dumps(trace)  # valid trace-event JSON
+    evs = trace["traceEvents"]
+    spans = [e for e in evs if e["ph"] == "X"]
+    per_req = sorted(e["name"] for e in spans if e["pid"] == 1)
+    assert per_req == ["decode", "decode", "decode_chunk", "decode_chunk",
+                      "prefill", "prefill", "queued", "queued"]
+    # ts is µs, dur from the event pair: queued = 0..1 ms
+    queued = next(e for e in spans if e["name"] == "queued")
+    assert queued["ts"] == 0.0 and queued["dur"] == 1000.0
+    # slot residency spans named by uid, one per slot tid
+    slots = {e["tid"]: e["name"] for e in spans if e["pid"] == 2}
+    assert slots == {0: "a", 1: "b"}
+    counters = [e for e in evs if e["ph"] == "C"]
+    assert counters and counters[0]["args"] == {"occupancy": 0.5}
+
+
+# ---------------------------------------------------------------------------
+# SLO accounting
+
+
+def test_slo_spec_check_and_validate():
+    spec = SloSpec(ttft_ms=100.0, tpot_ms=10.0)
+    assert spec.budgets() == {"ttft_ms": 100.0, "tpot_ms": 10.0}
+    assert spec.check(ttft_ms=50.0, tpot_ms=20.0) == \
+        {"ttft_ms": False, "tpot_ms": True}
+    # unmeasured dimension never violates (single-token request: no tpot)
+    assert spec.check(ttft_ms=50.0, tpot_ms=None) == \
+        {"ttft_ms": False, "tpot_ms": False}
+    with pytest.raises(ValueError):
+        SloSpec(ttft_ms=-1.0).validate()
+
+
+def test_slo_tracker_counts_and_rolling_window():
+    # manual clock: deterministic window arithmetic
+    now = [0.0]
+    t = SloTracker(SloSpec(ttft_ms=100.0, queue_ms=50.0), window_s=10.0,
+                   clock=lambda: now[0])
+    now[0] = 1.0
+    assert t.observe(ttft_ms=20.0, queue_ms=5.0) is True
+    now[0] = 2.0
+    assert t.observe(ttft_ms=500.0, queue_ms=5.0) is False
+    now[0] = 3.0
+    assert t.observe(ttft_ms=20.0, queue_ms=80.0) is False
+    rep = t.report()
+    assert rep["completed"] == 3 and rep["good"] == 1
+    assert rep["violations"] == {"ttft_ms": 1, "queue_ms": 2 - 1}
+    assert rep["good_fraction"] == pytest.approx(1 / 3, abs=1e-4)
+    # rates over min(window, elapsed) = 3 s
+    assert rep["throughput_rps"] == pytest.approx(3 / 3.0)
+    assert rep["goodput_rps"] == pytest.approx(1 / 3.0, abs=1e-4)
+    # window prune: at t=12.5 the cutoff is 2.5 — the first two
+    # observations age out, the t=3 one stays
+    now[0] = 12.5
+    rep2 = t.report()
+    assert rep2["throughput_rps"] == pytest.approx(1 / 10.0)
+    assert rep2["completed"] == 3  # lifetime counters survive the window
+    # histograms feed quantiles
+    assert rep2["ttft_ms_p99"] > rep2["ttft_ms_p50"] > 0
+    with pytest.raises(ValueError):
+        t.observe(bogus_ms=1.0)
+
+
+# ---------------------------------------------------------------------------
+# regression comparison
+
+
+def test_regress_flags_both_polarities_with_tolerance():
+    base = {"tokens_per_s": 100.0, "ttft_ms_p99": 20.0, "goodput_rps": 5.0,
+            "violations": {"ttft_ms": 0}, "uncls": 7.0, "ok": True}
+    # within tolerance: no flags
+    near = {"tokens_per_s": 95.0, "ttft_ms_p99": 21.0, "goodput_rps": 5.2,
+            "violations": {"ttft_ms": 0}, "uncls": 900.0, "ok": True}
+    rep = compare_records(base, near, tol=0.1)
+    assert rep["ok"] and not rep["regressions"]
+    assert rep["compared"] == 4  # 'uncls'/'ok' skipped, never guessed
+    # beyond tolerance, both polarities + zero-baseline violation jump
+    bad = {"tokens_per_s": 80.0, "ttft_ms_p99": 30.0, "goodput_rps": 8.0,
+           "violations": {"ttft_ms": 3}, "uncls": 7.0, "ok": True}
+    rep2 = compare_records(base, bad, tol=0.1)
+    assert not rep2["ok"]
+    keys = {e["key"] for e in rep2["regressions"]}
+    assert keys == {"tokens_per_s", "ttft_ms_p99", "violations.ttft_ms"}
+    assert {e["key"] for e in rep2["improvements"]} == {"goodput_rps"}
+    # explicit rules override name classification
+    rep3 = compare_records({"weird": 1.0}, {"weird": 10.0},
+                           rules={"weird": "lower"})
+    assert [e["key"] for e in rep3["regressions"]] == ["weird"]
+
+
+def test_regress_skips_embedded_histogram_dumps():
+    """A fuller run's hist count/sum/min must never read as a latency
+    regression: histogram dumps are excluded from the comparison."""
+    def rec(n, p99):
+        h = Histogram(HistSpec(lo=1.0, hi=100.0, growth=2.0))
+        h.add(np.linspace(2.0, 50.0, n))
+        return {"ttft_ms_p99": p99, "completed": n,
+                "hists": {"ttft_ms": h.to_dict()},
+                "embedded": h.to_dict()}  # a dump outside 'hists' too
+    base, new = rec(50, 20.0), rec(64, 20.0)
+    rep = compare_records(base, new, tol=0.1)
+    assert rep["ok"], rep["regressions"]
+    assert rep["compared"] == 1  # only the quantile summary compared
+
+
+def test_regress_load_record_shapes(tmp_path):
+    # whole-file JSON
+    p1 = str(tmp_path / "a.json")
+    with open(p1, "w") as f:
+        json.dump({"tokens_per_s": 10.0}, f)
+    assert load_record(p1)["tokens_per_s"] == 10.0
+    # BENCH_r0* wrapper: payload under "parsed"
+    p2 = str(tmp_path / "b.json")
+    with open(p2, "w") as f:
+        json.dump({"n": 5, "tail": "...", "parsed": {"value": 3.0}}, f)
+    assert load_record(p2) == {"value": 3.0}
+    # JSONL: last parseable line wins
+    p3 = str(tmp_path / "c.jsonl")
+    with open(p3, "w") as f:
+        f.write(json_record(value=1.0) + "\n")
+        f.write(json_record(value=2.0) + "\n")
+        f.write('{"truncated": ')
+    assert load_record(p3)["value"] == 2.0
+    with pytest.raises(ValueError):
+        p4 = str(tmp_path / "d.json")
+        with open(p4, "w") as f:
+            f.write("not json at all")
+        load_record(p4)
+
+
+def test_regress_cli_exit_codes(tmp_path, capsys):
+    from apex_tpu.monitor.regress import main
+
+    base = str(tmp_path / "base.json")
+    new = str(tmp_path / "new.json")
+    with open(base, "w") as f:
+        json.dump({"tokens_per_s": 100.0}, f)
+    with open(new, "w") as f:
+        json.dump({"tokens_per_s": 50.0}, f)
+    assert main([base, new, "--tol", "0.1"]) == 1
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    rep = json.loads(out)
+    assert rep["metric"] == "regress_report" and not rep["ok"]
+    with open(new, "w") as f:
+        json.dump({"tokens_per_s": 99.0}, f)
+    assert main([base, new, "--tol", "0.1"]) == 0
+
+
+# ---------------------------------------------------------------------------
+# sink rotation
+
+
+def test_jsonl_sink_rotation_and_transparent_read(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    with JsonlSink(path, buffer_steps=3, rotate_bytes=120) as sink:
+        for i in range(20):
+            sink.write(step=i, metrics={"x": float(i)})
+    segs = rotated_segments(path)
+    assert len(segs) > 2, "rotation never triggered"
+    assert segs[0].endswith(".1") and segs[-1] == path
+    # every rotated segment respects the cap's flush granularity and ends
+    # on a whole line
+    for s in segs[:-1]:
+        with open(s, "rb") as f:
+            data = f.read()
+        assert data.endswith(b"\n")
+    # transparent ordered read across segments
+    recs = list(read_jsonl(path))
+    assert [r["step"] for r in recs] == list(range(20))
+    # rotated=False reads only the live file
+    live = list(read_jsonl(path, rotated=False))
+    assert len(live) < 20
+    with pytest.raises(ValueError):
+        JsonlSink(str(tmp_path / "n.jsonl"), rotate_bytes=0)
+
+
+def test_jsonl_sink_rotation_appends_after_reopen(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    with JsonlSink(path, buffer_steps=1, rotate_bytes=100) as sink:
+        for i in range(5):
+            sink.write(step=i, metrics={"x": 1.0})
+    n_segs = len(rotated_segments(path))
+    # a restarted writer keeps numbering where the last one stopped
+    with JsonlSink(path, buffer_steps=1, rotate_bytes=100) as sink:
+        for i in range(5, 10):
+            sink.write(step=i, metrics={"x": 1.0})
+    assert len(rotated_segments(path)) >= n_segs
+    assert [r["step"] for r in read_jsonl(path)] == list(range(10))
+
+
+def test_jsonl_sink_rotation_survives_deleted_old_segments(tmp_path):
+    """Disk-reclaim scenario: deleting old segments must NOT make the
+    next roll reuse a freed low index — newest records would then read
+    under the oldest name and scramble chronological iteration."""
+    path = str(tmp_path / "m.jsonl")
+    sink = JsonlSink(path, buffer_steps=1, rotate_bytes=100)
+    for i in range(6):
+        sink.write(step=i, metrics={"x": 1.0})
+    segs = rotated_segments(path)
+    assert len(segs) >= 3
+    os.remove(segs[0])  # operator reclaims the oldest segment
+    top = max(int(s.rsplit(".", 1)[1]) for s in segs
+              if s.rsplit(".", 1)[1].isdigit())
+    for i in range(6, 10):
+        sink.write(step=i, metrics={"x": 1.0})
+    sink.close()
+    # new segments numbered past the old maximum, never into the gap
+    gap = int(segs[0].rsplit(".", 1)[1])
+    new_idx = [int(s.rsplit(".", 1)[1]) for s in rotated_segments(path)
+               if s.rsplit(".", 1)[1].isdigit()]
+    assert gap not in new_idx
+    assert max(new_idx) > top
+    # and the surviving records still read in step order
+    steps = [r["step"] for r in read_jsonl(path)]
+    assert steps == sorted(steps) and steps[-1] == 9
+
+
+# ---------------------------------------------------------------------------
+# view CLI
+
+
+def test_view_cli_summary_and_json_line(tmp_path, capsys):
+    from apex_tpu.monitor.view import main
+
+    path = str(tmp_path / "log.jsonl")
+    with JsonlSink(path, buffer_steps=1) as sink:
+        log = EventLog(sink=sink)
+        for i, uid in enumerate(("a", "b")):
+            log.emit("submitted", uid, t_ms=0.0)
+            log.emit("admitted", uid, t_ms=5.0, slot=i)
+            log.emit("first_token", uid, t_ms=10.0 + i, slot=i)
+            log.emit("retired", uid, t_ms=30.0, slot=i, n_tokens=5)
+        sink.write(step=0, metrics={"step_ms": 2.0, "occupancy": 0.5})
+    rc = main([path, "--ttft-budget", "10.5"])
+    assert rc == 0
+    cap = capsys.readouterr()
+    rec = json.loads(cap.out.strip())
+    assert rec["metric"] == "monitor_view"
+    assert rec["n_requests"] == 2 and rec["n_retired"] == 2
+    assert rec["ttft_ms_p50"] == 10.0 and rec["ttft_ms_p99"] == 11.0
+    assert rec["queue_ms_p50"] == 5.0
+    # tpots: a=(30-10)/4=5.0, b=(30-11)/4=4.75; nearest-rank p50 of two
+    assert rec["tpot_ms_p50"] == pytest.approx(4.75)
+    assert rec["decode_step_ms_p50"] == 2.0
+    assert rec["good"] == 1 and rec["violations"]["ttft_ms"] == 1
+    assert "ttft_ms" in cap.err and "p99" in cap.err
+
+
+def test_view_module_is_runnable(tmp_path):
+    """``python -m apex_tpu.monitor.view`` — the CI/tooling entry point."""
+    import subprocess
+
+    path = str(tmp_path / "log.jsonl")
+    with JsonlSink(path, buffer_steps=1) as sink:
+        sink.write(step=0, metrics={"step_ms": 1.5, "occupancy": 1.0})
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "apex_tpu.monitor.view", path],
+        capture_output=True, text=True, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert proc.returncode == 0, proc.stderr
+    rec = json.loads(proc.stdout.strip())
+    assert rec["n_steps"] == 1 and rec["decode_step_ms_p50"] == 1.5
+
+
+# ---------------------------------------------------------------------------
+# loadgen: deterministic workloads + the goodput-under-SLO record
+# (drives the real engine on a tiny GPT — the acceptance line's test)
+
+
+def test_loadgen_workload_deterministic_with_bursts():
+    from loadgen import WorkloadConfig, build_workload
+
+    cfg = WorkloadConfig(n_requests=32, rate_rps=20.0, burst_every_s=0.5,
+                         burst_size=4, seed=5, prompt_len_max=48)
+    w1 = build_workload(cfg, vocab_size=97, max_context=64)
+    w2 = build_workload(cfg, vocab_size=97, max_context=64)
+    assert [(t, r.uid, tuple(r.tokens), r.max_new_tokens)
+            for t, r in w1] == \
+        [(t, r.uid, tuple(r.tokens), r.max_new_tokens) for t, r in w2]
+    arr = [t for t, _ in w1]
+    assert arr == sorted(arr)
+    # bursts: some arrival instants repeat burst_size times
+    from collections import Counter
+
+    assert max(Counter(arr).values()) >= cfg.burst_size
+    # long-tail prompt lengths stay in bounds and leave room to generate
+    plens = [len(r.tokens) for _, r in w1]
+    assert max(plens) < 64 and min(plens) >= cfg.prompt_len_min
+    # a different seed changes the stream
+    w3 = build_workload(WorkloadConfig(n_requests=32, seed=6,
+                                       prompt_len_max=48), 97, 64)
+    assert [tuple(r.tokens) for _, r in w1] != \
+        [tuple(r.tokens) for _, r in w3]
+    with pytest.raises(ValueError):
+        WorkloadConfig(mode="sideways").validate()
+
+
+def test_loadgen_goodput_under_slo_record():
+    """Acceptance: loadgen drives the engine under a seeded Poisson+burst
+    workload and the resulting record carries goodput req/s, TTFT/TPOT
+    p50/p99 from histograms, and violation counts."""
+    from loadgen import WorkloadConfig, build_workload, run_workload
+
+    from apex_tpu.serve import InferenceEngine, ServeConfig
+    from apex_tpu.transformer.testing import GPTConfig, init_gpt_params
+
+    cfg = GPTConfig(vocab_size=97, max_seq=64, hidden=32, num_layers=2,
+                    num_heads=4, dtype=jnp.float32, fused_loss=False)
+    params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+    wcfg = WorkloadConfig(n_requests=12, rate_rps=200.0, burst_every_s=0.02,
+                          burst_size=3, seed=0, prompt_len_median=6,
+                          prompt_len_max=30, max_new_median=4,
+                          max_new_max=8)
+    workload = build_workload(wcfg, cfg.vocab_size, cfg.max_seq)
+    eng = InferenceEngine(
+        params, cfg,
+        ServeConfig(num_slots=3, block_size=8,
+                    prefill_buckets=(8, 16, 32, 64)),
+        slo=SloSpec(ttft_ms=60000.0, tpot_ms=60000.0, queue_ms=60000.0),
+        retain_streams=False)
+    stats = run_workload(eng, workload, max_wall_s=120.0)
+    assert stats["completed"] == len(workload)
+    assert eng.per_request_state_count() == 0
+    rep = stats["slo_report"]
+    line = json_record(metric="goodput_slo_test", **{
+        k: stats[k] for k in ("completed", "offered", "ttft_ms_p50",
+                              "ttft_ms_p99", "tpot_ms_p50", "tpot_ms_p99")
+    }, goodput_rps=rep["goodput_rps"], violations=rep["violations"])
+    rec = json.loads(line)  # the one-JSON-line contract holds
+    assert rec["ttft_ms_p99"] >= rec["ttft_ms_p50"] > 0
+    assert rec["tpot_ms_p99"] >= rec["tpot_ms_p50"] > 0
+    assert rec["goodput_rps"] > 0  # generous budgets: everything good
+    assert set(rec["violations"]) == {"ttft_ms", "tpot_ms", "queue_ms"}
+    assert sum(rec["violations"].values()) == 0

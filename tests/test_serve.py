@@ -391,8 +391,10 @@ def test_engine_metrics_jsonl(tmp_path):
         eng = InferenceEngine(PARAMS, CFG, scfg, sink=sink,
                               peak_flops_per_s=1e12)
         eng.run(REQS)
-        assert set(eng.ttft_ms) == {"a", "b", "c"}
-        assert all(t > 0 for t in eng.ttft_ms.values())
+        st = eng.stats()
+        assert st["completed"] == 3
+        assert st["ttft_ms_p50"] > 0 and st["ttft_ms_p99"] > 0
+        assert eng.hists["ttft_ms"].total == 3
         assert eng.throughput() > 0
     recs = list(read_jsonl(path))
     assert recs, "no step records written"
@@ -405,6 +407,139 @@ def test_engine_metrics_jsonl(tmp_path):
         assert r["active_slots"] >= 1     # in-graph Metrics made it out
     # peak occupancy: all three requests were in flight at once
     assert max(r["occupancy"] for r in recs) == 1.0
+
+
+# ---------------------------------------------------------------------------
+# monitor tier 2: lifecycle events, O(slots) state, stats/SLO, trace export
+
+
+def test_engine_state_stays_o_slots():
+    """THE leak gate: with retain_streams=False, per-request state after
+    10x slot-count requests is zero — retirement folded every timeline
+    into the histograms and dropped the per-uid entries."""
+    n_slots = 3
+    scfg = ServeConfig(num_slots=n_slots, block_size=8,
+                       prefill_buckets=BUCKETS)
+    got = {}
+    eng = InferenceEngine(PARAMS, CFG, scfg, retain_streams=False,
+                          on_retire=lambda uid, toks: got.__setitem__(
+                              uid, toks))
+    n = 10 * n_slots
+    reqs = [Request(f"r{i:03d}", [1 + i % 7, 2, 3], max_new_tokens=3)
+            for i in range(n)]
+    out = eng.run(reqs)
+    assert out == {}                       # streams not retained...
+    assert len(got) == n                   # ...but delivered via callback
+    assert eng.completed == n
+    assert eng.per_request_state_count() == 0
+    # the latencies all landed in the constant-size histograms
+    assert eng.hists["ttft_ms"].total == n
+    assert eng.hists["e2e_ms"].total == n
+    assert eng.hists["tpot_ms"].total == n
+    st = eng.stats()
+    assert st["completed"] == n and st["ttft_ms_p99"] > 0
+    # retained-mode comparison: identical streams
+    base = InferenceEngine(PARAMS, CFG, scfg).run(reqs)
+    assert got == base
+
+
+def test_engine_event_timeline_and_chrome_trace(tmp_path):
+    """Acceptance pin: the exported Chrome trace-event file is valid JSON
+    whose span set matches the JSONL event log request-for-request."""
+    import json
+
+    from apex_tpu.monitor import (
+        EventLog,
+        JsonlSink,
+        read_jsonl,
+        write_chrome_trace,
+    )
+    from apex_tpu.monitor.events import request_spans
+
+    path = str(tmp_path / "events.jsonl")
+    with JsonlSink(path, buffer_steps=1) as sink:
+        eng = InferenceEngine(PARAMS, CFG,
+                              ServeConfig(num_slots=3, block_size=8,
+                                          prefill_buckets=BUCKETS),
+                              events=EventLog(sink=sink), chunk_tokens=2)
+        out = eng.run(REQS)
+    assert set(out) == {"a", "b", "c"}
+    recs = list(read_jsonl(path))
+    events = [r for r in recs if r.get("kind") == "event"]
+    # every request ran the full lifecycle, in order, on one clock
+    for uid in ("a", "b", "c"):
+        evs = [r for r in events if r.get("uid") == uid]
+        names = [r["event"] for r in evs]
+        for must in ("submitted", "admitted", "prefill_start",
+                     "prefill_end", "first_token", "retired"):
+            assert must in names, (uid, names)
+        ts = [r["t_ms"] for r in evs]
+        assert ts == sorted(ts), f"{uid}: clock went backwards"
+        ret = next(r for r in evs if r["event"] == "retired")
+        assert ret["n_tokens"] == len(out[uid])
+        assert ret["ttft_ms"] > 0 and ret["e2e_ms"] >= ret["ttft_ms"]
+    # chrome trace: valid JSON round-trip...
+    trace_path = str(tmp_path / "trace.json")
+    write_chrome_trace(trace_path, recs)
+    with open(trace_path) as f:
+        trace = json.load(f)
+    spans = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    # ...whose request-track span set matches the JSONL-derived spans
+    # request-for-request (names AND timestamps)
+    want = request_spans(events)
+    req_spans = [e for e in spans if e["pid"] == 1]
+    tid_uid = {e["tid"]: e["args"]["name"]
+               for e in trace["traceEvents"]
+               if e["ph"] == "M" and e["pid"] == 1
+               and e["name"] == "thread_name"}
+    got = {}
+    for e in req_spans:
+        got.setdefault(tid_uid[e["tid"]], []).append(
+            (e["name"], e["ts"]))
+    for uid in ("a", "b", "c"):
+        want_set = sorted((s["name"], round(s["t0_ms"] * 1e3, 1))
+                          for s in want[uid])
+        assert sorted(got[uid]) == want_set, uid
+    # slot tracks: one residency span per request, named by uid
+    slot_spans = [e for e in spans if e["pid"] == 2]
+    assert sorted(e["name"] for e in slot_spans) == ["a", "b", "c"]
+
+
+def test_engine_slo_goodput_accounting():
+    from apex_tpu.monitor import SloSpec
+
+    # generous budgets: everything good
+    scfg = ServeConfig(num_slots=3, block_size=8, prefill_buckets=BUCKETS)
+    eng = InferenceEngine(PARAMS, CFG, scfg,
+                          slo=SloSpec(ttft_ms=1e9, tpot_ms=1e9))
+    eng.run(REQS)
+    rep = eng.stats()["slo_report"]
+    assert rep["completed"] == 3 and rep["good"] == 3
+    assert rep["violations"] == {"ttft_ms": 0, "tpot_ms": 0}
+    assert rep["goodput_rps"] > 0
+    # tracker and engine SHARE histograms: one fold per retirement
+    assert eng.hists["ttft_ms"].total == 3
+    assert rep["ttft_ms_p50"] == eng.stats()["ttft_ms_p50"]
+    # impossible budgets: everything violates, goodput 0
+    eng2 = InferenceEngine(PARAMS, CFG, scfg,
+                           slo=SloSpec(ttft_ms=1e-6))
+    eng2.run(REQS)
+    rep2 = eng2.stats()["slo_report"]
+    assert rep2["good"] == 0 and rep2["violations"]["ttft_ms"] == 3
+    assert rep2["goodput_rps"] == 0.0
+
+
+def test_engine_stats_json_serializable():
+    import json
+
+    eng = _engine()
+    eng.run(REQS)
+    st = eng.stats()
+    json.dumps(st)  # the whole snapshot must drop into a json_record
+    assert st["generated_tokens"] == sum(
+        len(v) for v in eng.finished.values())
+    assert st["queue_depth"] == 0 and st["occupancy"] == 0.0
+    assert st["decode_step_ms_p50"] > 0
 
 
 def test_engine_from_checkpoint_latest_valid(tmp_path):
