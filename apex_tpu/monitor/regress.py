@@ -37,7 +37,10 @@ __all__ = ["classify_metric", "compare_records", "flatten_record",
 # name fragments that decide polarity; first match wins, explicit rules
 # override. Conservative on purpose: a key matching neither is SKIPPED.
 _HIGHER = ("tokens_per_s", "goodput", "_rps", "mfu", "occupancy",
-           "throughput", "hidden_fraction", "good_fraction")
+           "throughput", "hidden_fraction", "good_fraction",
+           # serve throughput tier 2: a collapsing prefix-cache hit rate
+           # or draft acceptance rate is a regression (stage-11 gate)
+           "hit_rate", "acceptance_rate")
 _LOWER = ("_ms", "violation", "latency", "bubble", "exposed_bytes")
 
 

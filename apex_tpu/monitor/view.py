@@ -90,6 +90,31 @@ def summarize(records: List[Dict[str, Any]],
     occ = [r["occupancy"] for r in steps if "occupancy" in r]
     if occ:
         rec["mean_occupancy"] = round(sum(occ) / len(occ), 4)
+    # serve throughput-optimization telemetry (chunked prefill backlog,
+    # speculative proposed/accepted per step, cumulative prefix-cache
+    # counters — see serve.engine._emit_metrics)
+    backlog = [r["prefill_backlog_tokens"] for r in steps
+               if "prefill_backlog_tokens" in r]
+    if backlog:
+        rec["prefill_backlog_mean"] = round(
+            sum(backlog) / len(backlog), 2)
+        rec["prefill_backlog_max"] = max(backlog)
+    proposed = sum(r.get("spec_proposed", 0) for r in steps)
+    if proposed:
+        accepted = sum(r.get("spec_accepted", 0) for r in steps)
+        rec["spec_proposed"] = proposed
+        rec["spec_accepted"] = accepted
+        rec["spec_acceptance_rate"] = round(accepted / proposed, 4)
+    cum = [r for r in steps if "prefix_blocks_needed_total" in r]
+    if cum and cum[-1]["prefix_blocks_needed_total"]:
+        last = cum[-1]
+        rec["prefix_blocks_hit"] = last["prefix_blocks_hit_total"]
+        rec["prefix_blocks_needed"] = last["prefix_blocks_needed_total"]
+        rec["prefix_hit_rate"] = round(
+            last["prefix_blocks_hit_total"]
+            / last["prefix_blocks_needed_total"], 4)
+        rec["prefill_flops_saved"] = last.get(
+            "prefill_flops_saved_total")
     if slo is not None and slo.budgets():
         from apex_tpu.monitor.slo import SloTracker
 
@@ -124,6 +149,21 @@ def _table(rec: Dict[str, Any]) -> List[str]:
             lines.append(f"  {name:<16} {p50:>10.3f} {p99:>10.3f}")
     if rec.get("mean_occupancy") is not None:
         lines.append(f"  mean occupancy: {rec['mean_occupancy']}")
+    if rec.get("prefix_hit_rate") is not None:
+        lines.append(
+            f"  prefix cache: {rec['prefix_blocks_hit']}"
+            f"/{rec['prefix_blocks_needed']} blocks "
+            f"({rec['prefix_hit_rate']}) "
+            f"flops saved: {rec.get('prefill_flops_saved')}")
+    if rec.get("spec_acceptance_rate") is not None:
+        lines.append(
+            f"  speculative: {rec['spec_accepted']}"
+            f"/{rec['spec_proposed']} drafts accepted "
+            f"({rec['spec_acceptance_rate']})")
+    if rec.get("prefill_backlog_mean") is not None:
+        lines.append(
+            f"  prefill backlog: mean {rec['prefill_backlog_mean']} "
+            f"max {rec['prefill_backlog_max']} tokens")
     if "violations" in rec:
         v = " ".join(f"{k}={n}" for k, n in rec["violations"].items())
         lines.append(f"  SLO: good {rec['good']}/{rec['n_retired']} "

@@ -4,25 +4,41 @@ The serving side of the north star (reference Apex has none — its only
 inference story is ``amp.initialize`` eval-mode half precision):
 
 * :mod:`~apex_tpu.serve.kv_cache` — block-paged KV cache pools as one
-  donated pytree, host-side free-list allocator, optional int8 KV
-  quantization (the ``comm.quantize`` codec), modeled byte accounting;
-* :mod:`~apex_tpu.serve.decode` — q_len=1 paged attention (pure-JAX
-  reference + Pallas gather-attend kernel) and the ``gpt_prefill`` /
-  ``gpt_decode_step`` programs built from the ``standalone_gpt`` layers;
+  donated pytree, host-side refcounted allocator with content-addressed
+  **prefix caching** (hash-of-token-prefix block reuse, LRU eviction,
+  copy-on-write), optional int8 KV quantization (the ``comm.quantize``
+  codec), modeled byte accounting;
+* :mod:`~apex_tpu.serve.decode` — paged attention (pure-JAX reference +
+  Pallas gather-attend kernel) and the unified ``gpt_paged_forward``
+  serve programs: ``gpt_decode_step`` (q=1), ``gpt_verify_step``
+  (speculative verify, q=k+1), ``gpt_prefill_chunk`` (chunked prefill),
+  plus ``gpt_prefill`` — the full-prompt flash prefill kept as the
+  cold-path oracle;
 * :mod:`~apex_tpu.serve.sampling` — in-graph greedy/temperature/top-k/
-  top-p with request-intrinsic fold_in keys;
+  top-p with request-intrinsic fold_in keys (position-keyed draws make
+  speculative verification bitwise-exact);
+* :mod:`~apex_tpu.serve.drafter` — host-side draft proposers for
+  self-speculative decoding (prompt-lookup n-gram; pluggable);
 * :mod:`~apex_tpu.serve.engine` — the iteration-level continuous-batching
-  :class:`InferenceEngine`: bucketed prefill + one decode program,
-  admission into freed slots, EOS/max-len retirement, checkpoint loading
-  via ``resilience``, telemetry via ``monitor``.
+  :class:`InferenceEngine`: ONE chunked-prefill + ONE decode program
+  (+ one optional verify program), prefix-cached admission, speculative
+  decode, EOS/max-len retirement, checkpoint loading via ``resilience``,
+  telemetry via ``monitor``.
 """
 
 from apex_tpu.serve.decode import (  # noqa: F401
     gpt_decode_step,
+    gpt_paged_forward,
     gpt_prefill,
+    gpt_prefill_chunk,
+    gpt_verify_step,
     paged_attention,
     paged_attention_reference,
     serve_logits,
+)
+from apex_tpu.serve.drafter import (  # noqa: F401
+    Drafter,
+    NGramDrafter,
 )
 from apex_tpu.serve.engine import (  # noqa: F401
     InferenceEngine,
@@ -34,12 +50,15 @@ from apex_tpu.serve.engine import (  # noqa: F401
 from apex_tpu.serve.kv_cache import (  # noqa: F401
     BlockAllocator,
     KVCacheConfig,
+    copy_block,
     gather_kv,
+    hash_block_tokens,
     init_kv_cache,
     kv_cache_bytes,
     kv_read_bytes,
     kv_write_bytes_per_token,
     paged_write,
+    prefix_block_hashes,
 )
 from apex_tpu.serve.sampling import (  # noqa: F401
     SamplingConfig,
@@ -50,16 +69,23 @@ from apex_tpu.serve.sampling import (  # noqa: F401
 
 __all__ = [
     "BlockAllocator",
+    "Drafter",
     "InferenceEngine",
     "KVCacheConfig",
+    "NGramDrafter",
     "Request",
     "SamplingConfig",
     "ServeConfig",
+    "copy_block",
     "decode_flops_per_token",
     "default_bucket_ladder",
     "gather_kv",
     "gpt_decode_step",
+    "gpt_paged_forward",
     "gpt_prefill",
+    "gpt_prefill_chunk",
+    "gpt_verify_step",
+    "hash_block_tokens",
     "init_kv_cache",
     "kv_cache_bytes",
     "kv_read_bytes",
@@ -67,6 +93,7 @@ __all__ = [
     "paged_attention",
     "paged_attention_reference",
     "paged_write",
+    "prefix_block_hashes",
     "request_key",
     "sample",
     "serve_logits",

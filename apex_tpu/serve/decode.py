@@ -2,9 +2,9 @@
 
 Two halves:
 
-* **paged attention** — q_len=1 attention where K/V live in the
-  block-paged pools (``apex_tpu.serve.kv_cache``): a pure-JAX reference
-  (gather through the block tables, then exactly the
+* **paged attention** — attention where K/V live in the block-paged
+  pools (``apex_tpu.serve.kv_cache``): a pure-JAX reference (gather
+  through the block tables, then exactly the
   ``ops.attention.attention_reference`` math — fp32 accumulation, NEG_INF
   masking) and a Pallas gather-attend kernel that walks each slot's block
   table with scalar-prefetched indices (the ``ops/attention_varlen.py``
@@ -14,17 +14,27 @@ Two halves:
   kernel and the whole decode step one compiled program: at q_len=1 the
   work per op is tiny and dispatch dominates.
 
-* **serve programs** — :func:`gpt_prefill` and :func:`gpt_decode_step`
+* **serve programs** — one unified :func:`gpt_paged_forward` (q tokens
+  per slot against the paged cache, per-row math independent of q) with
+  three thin wrappers that are the engine's ONLY compiled programs:
+  :func:`gpt_decode_step` (q=1), :func:`gpt_verify_step` (q=k+1 — verify
+  k drafted tokens in one call, amortizing the dispatch-bound decode
+  step k-fold exactly the way the fused computation-collective ops of
+  arXiv 2305.06942 amortize launch overhead), and
+  :func:`gpt_prefill_chunk` (one slot, q=chunk — the fixed-size prefill
+  chunk that replaced the PR-5 bucket ladder). :func:`gpt_prefill` (the
+  full-prompt flash-attention prefill) remains as the COLD-PATH ORACLE
+  the chunked/cached/speculative streams are tested against. All are
   built from the SAME ``standalone_gpt`` parameter pytree (tied LM head,
   per-head interleaved QKV packing, ``ops.layer_norm``/``flash_attention``
   cores). TP is axis-optional: with ``tp_axis`` bound (inside a mesh
   program) the projections ride ``tensor_parallel``'s column/row-parallel
-  layers — heads sharded, the prefill row-parallel exits honoring
+  layers — heads sharded, the flash-prefill row-parallel exits honoring
   ``cfg.overlap_comm`` (the decomposed ``comm.overlap`` rings) — and the
   vocab-sharded logits are all-gathered for sampling; with ``tp_axis=None``
   (single device, stock-jax serving) the same math runs as plain dots.
-  The decode step's TP exits stay monolithic by design: a q_len-1 GEMM has
-  no flops to hide a ring behind.
+  The decode step's TP exits stay monolithic by design: a small-q GEMM
+  has no flops to hide a ring behind.
 
 Layers scan over the stacked layer params with the per-layer cache pools
 riding the scan's xs/ys — one compiled layer body regardless of depth,
@@ -333,8 +343,11 @@ def _check_serve_cfg(cfg, kv_cfg: KVCacheConfig, tp_axis) -> None:
 
 
 # ---------------------------------------------------------------------------
-# Prefill: full-prompt forward (flash attention over the in-flight K/V —
-# the cache is write-only here), cache populated for the decode loop.
+# Full-prompt prefill: flash attention over the in-flight K/V (the cache
+# is write-only here). Since the chunked-prefill engine rewrite this is
+# the COLD-PATH ORACLE — the reference the chunked / prefix-cached /
+# speculative engine streams are pinned against — and the TP-overlap
+# showcase (3D activations give the rings flops to hide behind).
 
 
 def gpt_prefill(params, tokens, prompt_len, cache, block_row,
@@ -389,41 +402,61 @@ def gpt_prefill(params, tokens, prompt_len, cache, block_row,
 
 
 # ---------------------------------------------------------------------------
-# Decode: one token per active slot through the whole stack — ONE compiled
-# program per engine lifetime.
+# The unified paged forward: q tokens per slot through the whole stack —
+# ONE compiled program per (n, q) shape. q=1 is the decode step, q=k+1 the
+# speculative verify, (n=1, q=chunk) the chunked prefill. Per-ROW math is
+# identical across q (each token row embeds at its own position, writes
+# its K/V, then attends through the paged gather masked to its own
+# context), which is exactly why speculative verification and chunked
+# prefill produce BITWISE the streams sequential decode would — the
+# oracle tests in tests/test_serve_prefix.py pin it.
 
 
-def gpt_decode_step(params, last_tokens, seq_lens, active, cache,
-                    block_tables, cfg, kv_cfg: KVCacheConfig,
-                    tp_axis: Optional[str] = None,
-                    use_pallas: Optional[bool] = None
-                    ) -> Tuple[Pytree, jnp.ndarray]:
-    """Advance every active slot by one token.
+def gpt_paged_forward(params, tokens, start_lens, n_valid, active, cache,
+                      block_tables, cfg, kv_cfg: KVCacheConfig,
+                      tp_axis: Optional[str] = None,
+                      use_pallas: Optional[bool] = None
+                      ) -> Tuple[Pytree, jnp.ndarray]:
+    """Process ``tokens`` (n, q) — per slot, q consecutive tokens starting
+    at position ``start_lens[slot]`` — against the paged cache.
 
-    ``last_tokens``: (n,) the token each slot feeds this step (the one
-    sampled last step). ``seq_lens``: (n,) tokens already cached — the fed
-    token's position. ``active``: (n,) bool. Returns ``(cache', logits
-    (n, vocab) fp32)``; inactive slots produce finite junk logits the
-    engine ignores.
+    ``n_valid``: (n,) how many of each slot's q tokens are real (the rest
+    are padding: K/V writes dropped, logits junk). ``active``: (n,) bool.
+    Returns ``(cache', logits (n, q, vocab) fp32)`` — logits[i, j] is the
+    next-token distribution after feeding tokens[i, j] at position
+    ``start_lens[i] + j``. Inactive slots and invalid positions produce
+    finite junk logits the engine ignores.
     """
     _check_serve_cfg(cfg, kv_cfg, tp_axis)
     heads_local = _serve_heads(cfg, tp_axis)
-    positions = jnp.minimum(seq_lens, cfg.max_seq - 1)
-    ctx_lens = jnp.where(active, positions + 1, 0)
-    x = _embed(params["embed"], last_tokens, positions, tp_axis)  # (n, h)
+    n, q = tokens.shape
+    offs = jnp.arange(q)
+    positions = start_lens[:, None] + offs[None, :]            # (n, q)
+    valid = active[:, None] & (offs[None, :] < n_valid[:, None])
+    positions_c = jnp.minimum(positions, cfg.max_seq - 1)
+    ctx_lens = jnp.where(valid, positions + 1, 0)
+    # flat row views for the paged write/gather (each token is its own
+    # "slot" sharing its owner's block-table row)
+    bt_rows = jnp.repeat(block_tables, q, axis=0)   # (n*q, max_blocks)
+    pos_flat = positions.reshape(-1)
+    valid_flat = valid.reshape(-1)
+    x = _embed(params["embed"], tokens, positions_c, tp_axis)  # (n, q, h)
 
     def body(x, xs):
         lp, cl = xs
         h1 = layer_norm(x, lp["ln1_w"], lp["ln1_b"],
                         use_pallas=cfg.ln_pallas)
         qkv = _col(h1, lp["qkv_kernel"], lp["qkv_bias"], tp_axis)
-        q, k, v = _split_qkv(qkv, heads_local, cfg.head_dim)  # (n, H, D)
-        cl = paged_write(cl, kv_cfg, k.transpose(1, 0, 2),
-                         v.transpose(1, 0, 2), block_tables, positions,
-                         active)
-        ctx = paged_attention(q, cl, kv_cfg, block_tables, ctx_lens,
-                              use_pallas=use_pallas)
-        a = _row(ctx.reshape(-1, heads_local * cfg.head_dim),
+        qh, k, v = _split_qkv(qkv, heads_local, cfg.head_dim)  # (n,q,H,D)
+        k_flat = k.reshape(n * q, heads_local, cfg.head_dim)
+        v_flat = v.reshape(n * q, heads_local, cfg.head_dim)
+        cl = paged_write(cl, kv_cfg, k_flat.transpose(1, 0, 2),
+                         v_flat.transpose(1, 0, 2), bt_rows, pos_flat,
+                         valid_flat)
+        ctx = paged_attention(qh.reshape(n * q, heads_local, cfg.head_dim),
+                              cl, kv_cfg, bt_rows,
+                              ctx_lens.reshape(-1), use_pallas=use_pallas)
+        a = _row(ctx.reshape(n, q, heads_local * cfg.head_dim),
                  lp["out_kernel"], lp["out_bias"], tp_axis)
         x = x + a
         h2 = layer_norm(x, lp["ln2_w"], lp["ln2_b"],
@@ -435,3 +468,72 @@ def gpt_decode_step(params, last_tokens, seq_lens, active, cache,
 
     x, cache = lax.scan(body, x, (params["layers"], cache))
     return cache, serve_logits(params, x, cfg, tp_axis)
+
+
+def gpt_decode_step(params, last_tokens, seq_lens, active, cache,
+                    block_tables, cfg, kv_cfg: KVCacheConfig,
+                    tp_axis: Optional[str] = None,
+                    use_pallas: Optional[bool] = None
+                    ) -> Tuple[Pytree, jnp.ndarray]:
+    """Advance every active slot by one token (q=1 paged forward).
+
+    ``last_tokens``: (n,) the token each slot feeds this step (the one
+    sampled last step). ``seq_lens``: (n,) tokens already cached — the fed
+    token's position. ``active``: (n,) bool. Returns ``(cache', logits
+    (n, vocab) fp32)``; inactive slots produce finite junk logits the
+    engine ignores.
+    """
+    n = last_tokens.shape[0]
+    cache, logits = gpt_paged_forward(
+        params, last_tokens[:, None], seq_lens,
+        jnp.ones((n,), jnp.int32), active, cache, block_tables, cfg,
+        kv_cfg, tp_axis=tp_axis, use_pallas=use_pallas)
+    return cache, logits[:, 0]
+
+
+def gpt_verify_step(params, fed_tokens, seq_lens, n_fed, active, cache,
+                    block_tables, cfg, kv_cfg: KVCacheConfig,
+                    tp_axis: Optional[str] = None,
+                    use_pallas: Optional[bool] = None
+                    ) -> Tuple[Pytree, jnp.ndarray]:
+    """Speculative verify: feed ``fed_tokens`` (n, k+1) — each slot's last
+    sampled token followed by up to k drafted tokens — in ONE paged call
+    (the MPK amortization: q_len=k+1 turns k+1 dispatch-bound steps into
+    one). Returns ``(cache', logits (n, k+1, vocab))``; logits[i, j]
+    scores the token AFTER fed_tokens[i, j], so the engine accepts the
+    longest run where the sampled token matches the next draft. Rejected
+    drafts' K/V writes need no rollback: the accepted length caps
+    ``seq_lens``, the stale positions are masked by every later context
+    window and overwritten when real tokens reach them (the same
+    ``mode="drop"``/masking contract that drops padded writes)."""
+    return gpt_paged_forward(params, fed_tokens, seq_lens, n_fed, active,
+                             cache, block_tables, cfg, kv_cfg,
+                             tp_axis=tp_axis, use_pallas=use_pallas)
+
+
+def gpt_prefill_chunk(params, tokens, start, n_valid, cache, block_row,
+                      cfg, kv_cfg: KVCacheConfig,
+                      tp_axis: Optional[str] = None,
+                      use_pallas: Optional[bool] = None
+                      ) -> Tuple[Pytree, jnp.ndarray]:
+    """Process one fixed-size chunk of ONE prompt into the cache.
+
+    ``tokens``: (chunk,) int32, prompt positions ``start .. start+n_valid-1``
+    padded to the chunk size (padding writes dropped). ``block_row``:
+    (max_blocks,) int32 blocks owning the slot. Returns ``(cache', logits
+    (vocab,))`` — the next-token logits after the chunk's LAST valid
+    token, meaningful only on the final chunk of a prompt (the engine
+    samples the first generated token from it).
+
+    One chunk shape -> ONE compiled prefill program for the engine's
+    lifetime, replacing the PR-5 bucket ladder: the chunk interleaves
+    into decode steps, so long prompts neither stall running decodes nor
+    mint per-bucket compilations.
+    """
+    cache, logits = gpt_paged_forward(
+        params, tokens[None, :], jnp.asarray(start)[None],
+        jnp.asarray(n_valid)[None], jnp.ones((1,), bool), cache,
+        block_row[None, :], cfg, kv_cfg, tp_axis=tp_axis,
+        use_pallas=use_pallas)
+    last = jnp.take(logits[0], jnp.maximum(n_valid - 1, 0), axis=0)
+    return cache, last

@@ -1,0 +1,71 @@
+"""Draft-token proposers for self-speculative decoding.
+
+At q_len=1 the decode program is dispatch/latency-bound, not flops-bound
+(the MPK observation, arXiv 2512.22219): verifying k drafted tokens in
+ONE paged-attention call (``serve.decode.gpt_verify_step``) costs barely
+more wall-clock than one decode step, so any drafter that guesses right
+even occasionally buys throughput. The interface is deliberately tiny —
+``propose(tokens, k) -> up to k draft ids`` on the host, between steps —
+so a small draft MODEL can slot in later without touching the engine;
+what ships now is the zero-cost **prompt-lookup / n-gram** drafter
+(PLD / arXiv 2304.04487 lineage): find the most recent earlier occurrence
+of the sequence's last ``ngram`` tokens and propose whatever followed it.
+That is exactly the right drafter for the shared-system-prompt serving
+workloads the prefix cache targets — summarization, RAG, code editing,
+few-shot prompts — where the continuation frequently copies spans of the
+prompt.
+
+Correctness never depends on the drafter: the engine accepts only the
+longest run of drafts that match what its own verify pass sampled at each
+position, so streams stay BITWISE identical to non-speculative decode
+(greedy and same-key sampled alike; pinned by test). A bad drafter costs
+wasted verify columns, never wrong tokens.
+"""
+
+from __future__ import annotations
+
+from typing import List, Protocol, Sequence, runtime_checkable
+
+__all__ = ["Drafter", "NGramDrafter"]
+
+
+@runtime_checkable
+class Drafter(Protocol):
+    """Host-side draft proposer. ``tokens`` is the request's full history
+    (prompt + generated so far); return at most ``k`` draft ids — an empty
+    list opts the slot out of this step's speculation (it decodes
+    normally). Called between engine steps: keep it cheap."""
+
+    def propose(self, tokens: Sequence[int], k: int) -> List[int]:
+        ...
+
+
+class NGramDrafter:
+    """Prompt-lookup drafter: match the last ``ngram`` tokens against the
+    most recent earlier occurrence in the history and propose the tokens
+    that followed it. O(len(history) * ngram) per call with no state —
+    cheap enough to run for every active slot every step.
+
+    ``min_context``: histories shorter than this never propose (too little
+    signal to be worth the verify columns)."""
+
+    def __init__(self, ngram: int = 3, min_context: int = 8):
+        if ngram < 1:
+            raise ValueError("ngram must be >= 1")
+        self.ngram = ngram
+        self.min_context = max(min_context, ngram + 1)
+
+    def propose(self, tokens: Sequence[int], k: int) -> List[int]:
+        # the engine hands its incrementally-maintained history list:
+        # don't copy the whole thing per step
+        t = tokens if isinstance(tokens, list) else list(tokens)
+        n = len(t)
+        if k < 1 or n < self.min_context:
+            return []
+        tail = t[n - self.ngram:]
+        # most recent earlier occurrence wins (locality: recent repeats
+        # predict the continuation better than distant ones)
+        for i in range(n - self.ngram - 1, -1, -1):
+            if t[i:i + self.ngram] == tail:
+                return t[i + self.ngram:i + self.ngram + k]
+        return []

@@ -6,19 +6,44 @@ longest member while every other chip's slot idles. This engine batches at
 **iteration granularity** (Orca/vLLM's scheduling, rebuilt for jitted JAX
 programs): a fixed grid of decode slots advances one token per step, and
 between steps finished requests retire and new ones are admitted into the
-freed slots. Nothing retraces:
+freed slots. Nothing retraces, and three stacked throughput optimizations
+ride the same paged cache:
 
-* **bounded compilation** — prompts are padded to a fixed **bucket
-  ladder**, so the engine compiles at most ``len(buckets)`` prefill
-  programs plus EXACTLY ONE decode program for its whole lifetime (the
-  compile-count gate in ``tests/test_serve.py`` pins it). The MPK argument
-  (arXiv 2512.22219) in scheduler form: decode is latency-bound, so the
-  whole step — embed, every layer, paged attention, sampling — is one
-  compiled program, one dispatch.
+* **chunked prefill, bounded compilation** — prompts are processed as
+  fixed-size chunks (``ServeConfig.prefill_chunk``) interleaved into the
+  decode loop: ONE compiled chunk program + ONE decode program (+ at most
+  one verify program per speculative k) for the engine's whole lifetime —
+  the PR-5 prompt bucket ladder and its ``n_buckets`` compile set are
+  gone, and with them the TTFT-vs-throughput tradeoff of picking a ladder
+  (``compile_counts()`` is the gate ``tests/test_serve.py`` pins). The
+  MPK argument (arXiv 2512.22219) in scheduler form: decode is
+  latency-bound, so the whole step — embed, every layer, paged attention,
+  sampling — is one compiled program, one dispatch.
+* **prefix caching** — the block allocator is content-addressed
+  (``kv_cache.BlockAllocator(prefix_cache=True)``): admission looks up
+  the longest cached prefix of the prompt at block granularity and only
+  prefills the tail, so a shared system prompt costs ZERO prefill flops
+  after its first admission; retired requests' cached blocks park in an
+  evictable LRU at refcount 0 and are reclaimed only under memory
+  pressure. Copy-on-write covers the one divergent-write case (a
+  fully-cached prompt recomputing its final position) — a shared block is
+  never mutated.
+* **self-speculative decoding** — an optional host-side drafter
+  (``serve.drafter``, prompt-lookup n-gram by default, pluggable for a
+  small model) proposes up to k tokens per slot; ONE q_len=k+1
+  paged-attention call (``gpt_verify_step``) verifies all of them,
+  amortizing the dispatch-bound decode step k-fold. The engine accepts
+  the longest run matching its own position-keyed draws, so streams are
+  BITWISE identical to non-speculative decode (greedy and sampled);
+  rejected drafts need no rollback — their K/V writes are masked by every
+  later context window and overwritten when real tokens arrive.
+
 * **donation-safe state** — the paged KV pools (``serve.kv_cache``) are
-  donated through every prefill/decode call; slot bookkeeping
-  (block tables, lengths, last tokens, keys) stays host-side numpy, cheap
-  to re-upload and trivially correct across admissions.
+  donated through every chunk/decode/verify call; slot bookkeeping
+  (block tables, lengths, last tokens, keys) stays host-side numpy with
+  CACHED device mirrors — an array is re-uploaded only after an
+  admission/retirement/decode actually changed it
+  (``engine.transfer_counts`` pins it).
 * **request-order invariance** — greedy streams are bitwise equal to
   single-request decode of each prompt, and sampled streams equal under
   the same key, because per-slot computation is row-independent and
@@ -27,10 +52,12 @@ freed slots. Nothing retraces:
 Weights arrive through ``resilience.CheckpointManager.latest_valid()``
 (:meth:`InferenceEngine.from_checkpoint`) — a serving replica points at
 the training job's checkpoint directory and refuses torn/corrupt saves.
-Telemetry rides the PR-2 ``monitor`` pipeline: an in-graph ``Metrics``
-pytree out of the decode program plus host-side step records (tokens/s,
-TTFT, occupancy, modeled decode flops/MFU, KV bytes from
-``serve.kv_cache``'s accounting) into a ``JsonlSink``.
+Telemetry rides the ``monitor`` pipeline: an in-graph ``Metrics`` pytree
+out of the decode/verify programs plus host-side step records (tokens/s,
+TTFT, occupancy, modeled decode flops/MFU, KV bytes, chunked-prefill
+backlog, speculative proposed/accepted, cumulative prefix-cache hit
+counters) into a ``JsonlSink``; ``python -m apex_tpu.monitor.view``
+summarizes all of them.
 
 Monitor **tier 2** (request-level attribution, constant memory): every
 request runs a lifecycle timeline — ``submitted → admitted →
@@ -44,7 +71,8 @@ drops every per-uid entry. Engine state stays O(slots + backlog) across
 millions of requests when ``retain_streams=False`` (per-request token
 streams go to the ``on_retire`` callback instead of an ever-growing
 dict); :meth:`InferenceEngine.stats` returns the histograms, latency
-quantiles and goodput-under-SLO report as one JSON-serializable dict.
+quantiles, prefix-cache/speculation counters and goodput-under-SLO
+report as one JSON-serializable dict.
 """
 
 from __future__ import annotations
@@ -65,14 +93,21 @@ from apex_tpu.monitor.hist import DEFAULT_LATENCY_SPEC, HistSpec, Histogram
 from apex_tpu.monitor.metrics import Metrics
 from apex_tpu.monitor.slo import SloSpec, SloTracker
 from apex_tpu.monitor.trace import span
-from apex_tpu.serve.decode import gpt_decode_step, gpt_prefill
+from apex_tpu.serve.decode import (
+    gpt_decode_step,
+    gpt_prefill_chunk,
+    gpt_verify_step,
+)
+from apex_tpu.serve.drafter import Drafter, NGramDrafter
 from apex_tpu.serve.kv_cache import (
     BlockAllocator,
     KVCacheConfig,
+    copy_block,
     init_kv_cache,
     kv_cache_bytes,
     kv_read_bytes,
     kv_write_bytes_per_token,
+    prefix_block_hashes,
 )
 from apex_tpu.serve.sampling import SamplingConfig, request_key, sample
 
@@ -81,9 +116,10 @@ Pytree = Any
 
 def default_bucket_ladder(max_context: int, start: int = 16
                           ) -> Tuple[int, ...]:
-    """Powers-of-two prompt buckets up to ``max_context`` — each prompt
-    compiles against the smallest bucket that holds it, so total prefill
-    compilations are bounded by ``log2`` of the context length."""
+    """COMPAT SHIM (pre-chunked-prefill API): powers-of-two prompt buckets
+    up to ``max_context``. The engine no longer compiles per-bucket
+    prefill programs — prompts stream through one fixed-size chunk program
+    — but the ladder remains for callers that sized workloads by it."""
     out = []
     b = start
     while b < max_context:
@@ -120,8 +156,20 @@ class ServeConfig:
     # oversubscription). Smaller pools admit fewer concurrent requests —
     # admission simply waits for frees, it never preempts.
     num_blocks: Optional[int] = None
-    # prompt-length compile buckets; default: powers of two to max_context
+    # COMPAT SHIM: the pre-chunked-prefill bucket ladder. Accepted and
+    # surfaced via engine.buckets/bucket_for for old callers, but NO
+    # prefill program is compiled per bucket anymore.
     prefill_buckets: Optional[Tuple[int, ...]] = None
+    # tokens per prefill chunk: ONE compiled prefill program, interleaved
+    # into the decode loop one chunk per step
+    prefill_chunk: int = 32
+    # content-addressed block reuse across requests (zero prefill flops
+    # for cached shared prefixes)
+    prefix_cache: bool = True
+    # self-speculative decoding: draft up to spec_k tokens per slot per
+    # step and verify them in one q_len=spec_k+1 call; 0 disables
+    spec_k: int = 0
+    spec_ngram: int = 3  # n-gram order of the default prompt-lookup drafter
     max_context: Optional[int] = None  # default: model cfg.max_seq
     eos_id: Optional[int] = None
     kv_quant: str = "none"  # "none" | "int8" (comm.quantize codec)
@@ -135,6 +183,12 @@ class ServeConfig:
             raise ValueError("block_size must be positive")
         if self.num_blocks is not None and self.num_blocks <= 0:
             raise ValueError("num_blocks must be positive when given")
+        if self.prefill_chunk <= 0:
+            raise ValueError("prefill_chunk must be positive")
+        if self.spec_k < 0:
+            raise ValueError("spec_k must be >= 0")
+        if self.spec_ngram < 1:
+            raise ValueError("spec_ngram must be >= 1")
         if self.max_context is not None and self.max_context <= 0:
             raise ValueError("max_context must be positive when given")
         if self.kv_quant not in ("none", "int8"):
@@ -147,28 +201,41 @@ class ServeConfig:
 _HIST_NAMES = ("ttft_ms", "tpot_ms", "queue_ms", "e2e_ms",
                "decode_step_ms")
 
+# host arrays with cached device mirrors (uploaded only when dirty)
+_MIRROR_NAMES = ("block_tables", "seq_lens", "last_tokens", "active",
+                 "keys")
+
 
 @dataclasses.dataclass
 class _SlotState:
     request: Request
-    blocks: List[int]
+    blocks: List[int]          # every block the slot holds a ref on
     generated: List[int]
+    # prompt + generated, maintained incrementally so the drafter reads
+    # it without an O(prompt_len) re-concatenation every step
+    history: List[int]
+    prompt_len: int
+    prefill_pos: int           # prompt tokens cached so far (chunk cursor)
+    cached_tokens: int         # prompt tokens served by the prefix cache
+    # (block_id, hash, end_pos): commit to the content map once the chunk
+    # cursor passes end_pos (the block is then fully written)
+    pending_commits: List[Tuple[int, int, int]]
     # request timeline, ms on the engine's one monotonic clock
     t_submit_ms: float
-    t_first_ms: float
-    queue_ms: float
-    ttft_ms: float
-    chunk_start_ms: float   # start of the decode chunk being accumulated
-    chunk_done: int         # tokens already covered by emitted chunks
+    t_first_ms: float = 0.0
+    queue_ms: float = 0.0
+    ttft_ms: float = 0.0
+    chunk_start_ms: float = 0.0  # start of the decode chunk being accumulated
+    chunk_done: int = 0          # tokens already covered by emitted chunks
 
 
 class InferenceEngine:
     """Continuous-batching engine over one parameter pytree.
 
     Tensor parallelism: pass ``tp_axis``/``tp_size`` AND a ``transform``
-    that shard_maps the prefill/decode python callables over that axis
-    (params TP-sharded by ``gpt_param_specs``-style specs, everything else
-    replicated) — the programs then route through the
+    that shard_maps the chunk/decode/verify python callables over that
+    axis (params TP-sharded by ``gpt_param_specs``-style specs, everything
+    else replicated) — the programs then route through the
     ``tensor_parallel`` layers with vocab-gathered logits, and the KV
     pools hold the ``num_heads / tp_size`` LOCAL heads. The default
     (``tp_axis=None``, identity transform) drives the single-device
@@ -178,13 +245,19 @@ class InferenceEngine:
     record per engine step. ``peak_flops_per_s``: chip peak for the
     modeled decode-MFU column (omitted -> mfu not reported).
 
+    ``drafter``: a ``serve.drafter.Drafter`` for the speculative path
+    (default when ``spec_k > 0``: ``NGramDrafter(spec_ngram)``). The
+    drafter only proposes — acceptance is decided by the engine's own
+    verify pass, so a bad drafter can never change a stream.
+
     Tier-2 telemetry: ``events`` (a ``monitor.EventLog``) records every
     request's lifecycle; ``slo`` (a ``monitor.SloSpec``) turns on
     goodput/violation accounting; ``hist_spec`` overrides the latency
-    bucket ladder; ``chunk_tokens`` sets the decode-chunk span
-    granularity. ``retain_streams=False`` keeps per-request state
-    O(slots): retirement hands the stream to ``on_retire(uid, tokens)``
-    (or drops it) instead of growing the ``finished`` dict forever.
+    bucket ladder; ``chunk_tokens`` sets the decode-chunk EVENT span
+    granularity (unrelated to ``prefill_chunk``, the compiled chunk
+    size). ``retain_streams=False`` keeps per-request state O(slots):
+    retirement hands the stream to ``on_retire(uid, tokens)`` (or drops
+    it) instead of growing the ``finished`` dict forever.
     """
 
     def __init__(
@@ -206,6 +279,7 @@ class InferenceEngine:
         retain_streams: bool = True,
         on_retire: Optional[Callable[[str, List[int]], None]] = None,
         chunk_tokens: int = 16,
+        drafter: Optional[Drafter] = None,
     ):
         scfg = serve_cfg or ServeConfig()
         scfg.validate()
@@ -234,21 +308,33 @@ class InferenceEngine:
             num_layers=cfg.num_layers, num_heads=cfg.num_heads // tp_size,
             head_dim=cfg.head_dim, num_blocks=num_blocks, block_size=bs,
             dtype=cfg.dtype, quantized=scfg.kv_quant == "int8")
-        self.buckets = tuple(sorted(
-            scfg.prefill_buckets or default_bucket_ladder(self.max_context)))
-        if self.buckets[-1] < self.max_context:
-            raise ValueError(
-                f"largest bucket ({self.buckets[-1]}) below max_context "
-                f"({self.max_context}) — long prompts would be unservable")
-        self.allocator = BlockAllocator(num_blocks)
+        self.allocator = BlockAllocator(num_blocks,
+                                        prefix_cache=scfg.prefix_cache)
         self.cache = init_kv_cache(self.kv_cfg)
+        self.drafter: Optional[Drafter] = None
+        if scfg.spec_k > 0:
+            self.drafter = (drafter if drafter is not None
+                            else NGramDrafter(ngram=scfg.spec_ngram))
+        elif drafter is not None:
+            raise ValueError("drafter given but spec_k == 0 — set "
+                             "ServeConfig.spec_k to enable speculation")
         n = scfg.num_slots
         self._block_tables = np.zeros((n, self._blocks_per_slot), np.int32)
         self._seq_lens = np.zeros((n,), np.int32)
         self._last_tokens = np.zeros((n,), np.int32)
         self._active = np.zeros((n,), bool)
         self._keys = np.zeros((n, 2), np.uint32)
+        # device mirrors of the host arrays above: uploaded lazily, reused
+        # until a host mutation marks them dirty (the satellite gate —
+        # steady-state decode re-uploads ONLY what changed)
+        self._dev_cache: Dict[str, Any] = {}
+        self.transfer_counts: Dict[str, int] = {
+            nm: 0 for nm in _MIRROR_NAMES}
         self._slots: List[Optional[_SlotState]] = [None] * n
+        # admission-ordered slots with prompt tokens still to prefill; the
+        # front slot gets one chunk per step (FCFS-to-completion: best
+        # TTFT under interleaving)
+        self._prefill_queue: collections.deque = collections.deque()
         self._pending: collections.deque = collections.deque()
         self._finished: Dict[str, List[int]] = {}
         self._base_key = (base_key if base_key is not None
@@ -280,11 +366,38 @@ class InferenceEngine:
         self._retain_streams = retain_streams
         self._on_retire = on_retire
         self._completed = 0
+        # throughput-optimization counters (stats() + step records)
+        self._prefix_blocks_hit = 0
+        self._prefix_blocks_needed = 0
+        self._prefill_tokens_saved = 0
+        self._prefill_flops_saved = 0.0
+        self._cow_copies = 0
+        self._chunks_run = 0
+        self._spec_proposed = 0
+        self._spec_accepted = 0
+        self._verify_steps = 0
+        self._decode_steps = 0
         self._n_params = sum(
             x.size for x in jax.tree_util.tree_leaves(params))
         wrap = transform if transform is not None else (lambda f: f)
         self._use_pallas = use_pallas
         self._build_programs(wrap)
+
+    # -- device mirrors ---------------------------------------------------
+    def _dirty(self, *names: str) -> None:
+        for nm in names:
+            self._dev_cache.pop(nm, None)
+
+    def _dev(self, name: str):
+        """Cached device copy of host array ``self._<name>`` — uploads
+        only when a mutation marked it dirty (``transfer_counts`` tallies
+        actual uploads; the identity test pins reuse)."""
+        arr = self._dev_cache.get(name)
+        if arr is None:
+            arr = jnp.asarray(getattr(self, "_" + name))
+            self._dev_cache[name] = arr
+            self.transfer_counts[name] += 1
+        return arr
 
     # -- program construction (the ONLY jit sites) -------------------------
     def _build_programs(self, wrap) -> None:
@@ -292,12 +405,15 @@ class InferenceEngine:
 
         tp_axis = self._tp_axis
 
-        def prefill(params, cache, tokens, prompt_len, block_row, key):
-            cache, logits = gpt_prefill(params, tokens, prompt_len, cache,
-                                        block_row, cfg, kv_cfg,
-                                        tp_axis=tp_axis)
+        def chunk_prefill(params, cache, tokens, start, n_valid, block_row,
+                          key):
+            cache, logits = gpt_prefill_chunk(
+                params, tokens, start, n_valid, cache, block_row, cfg,
+                kv_cfg, tp_axis=tp_axis, use_pallas=self._use_pallas)
+            # the draw for the token that will sit at position start+n_valid
+            # — meaningful only on a prompt's FINAL chunk; junk otherwise
             tok = sample(logits[None], key[None],
-                         jnp.stack([prompt_len]), scfg.sampling)
+                         jnp.reshape(start + n_valid, (1,)), scfg.sampling)
             return cache, tok[0]
 
         def decode(params, cache, last_tokens, seq_lens, active,
@@ -314,20 +430,61 @@ class InferenceEngine:
                     jnp.where(active, seq_lens + 1, 0)))
             return cache, toks, m
 
-        self._prefill = jax.jit(wrap(prefill), donate_argnums=(1,))
+        def verify(params, cache, fed_tokens, seq_lens, n_fed, active,
+                   block_tables, keys):
+            cache, logits = gpt_verify_step(
+                params, fed_tokens, seq_lens, n_fed, active, cache,
+                block_tables, cfg, kv_cfg, tp_axis=tp_axis,
+                use_pallas=self._use_pallas)
+            k1 = fed_tokens.shape[1]
+            draw_pos = seq_lens[:, None] + 1 + jnp.arange(k1)[None, :]
+            toks = sample(logits, keys, draw_pos, scfg.sampling)
+            m = Metrics().record(
+                active_slots=jnp.sum(active),
+                context_tokens=jnp.sum(
+                    jnp.where(active, seq_lens + 1, 0)))
+            return cache, toks, m
+
+        def cow(cache, src, dst):
+            # local closure (not the module-level copy_block directly):
+            # jax keys jit caches on function identity, and compile_counts
+            # must report THIS engine's compiles only
+            return copy_block(cache, src, dst)
+
+        self._chunk_prefill = jax.jit(wrap(chunk_prefill),
+                                      donate_argnums=(1,))
         self._decode = jax.jit(wrap(decode), donate_argnums=(1,))
+        self._verify = (jax.jit(wrap(verify), donate_argnums=(1,))
+                        if scfg.spec_k > 0 else None)
+        # copy-on-write block copy (src/dst traced -> one compile, ever)
+        self._cow = jax.jit(wrap(cow), donate_argnums=(0,))
 
     def compile_counts(self) -> Dict[str, Optional[int]]:
-        """Jit-cache sizes of the two programs — the compile-count gate
-        reads this (expected: <= len(buckets) prefills + 1 decode)."""
+        """Jit-cache sizes of the engine programs — the compile-count gate
+        reads this (expected: exactly 1 chunked prefill + 1 decode, plus
+        <= 1 verify per distinct spec-k shape and <= 1 CoW copy)."""
         def n(f):
+            if f is None:
+                return 0
             fn = getattr(f, "_cache_size", None)
             return fn() if callable(fn) else None
 
-        return {"prefill": n(self._prefill), "decode": n(self._decode)}
+        return {"chunk_prefill": n(self._chunk_prefill),
+                "decode": n(self._decode),
+                "verify": n(self._verify),
+                "cow_copy": n(self._cow)}
 
     # -- submission --------------------------------------------------------
+    @property
+    def buckets(self) -> Tuple[int, ...]:
+        """COMPAT SHIM: the ladder old callers sized workloads by. The
+        engine compiles no per-bucket programs anymore."""
+        return tuple(sorted(self.serve_cfg.prefill_buckets
+                            or default_bucket_ladder(self.max_context)))
+
     def bucket_for(self, prompt_len: int) -> int:
+        """COMPAT SHIM: smallest compat-ladder bucket holding the prompt
+        (no compilation consequence since chunked prefill)."""
         for b in self.buckets:
             if b >= prompt_len:
                 return b
@@ -345,7 +502,6 @@ class InferenceEngine:
             raise ValueError(
                 f"{request.uid}: prompt ({p}) must leave room to generate "
                 f"(max_context {self.max_context})")
-        self.bucket_for(p)  # unservable prompts fail at submit, not admit
         t = self._now_ms()
         self._pending.append((request, t))
         if self._events is not None:
@@ -383,61 +539,172 @@ class InferenceEngine:
             request, t_submit = self._pending[0]
             n_blocks = self.kv_cfg.blocks_for_tokens(
                 self._total_tokens(request))
-            blocks = self.allocator.alloc(n_blocks)
-            if blocks is None:
+            bs = self.kv_cfg.block_size
+            hashes = (prefix_block_hashes(request.tokens, bs)
+                      if self.serve_cfg.prefix_cache else [])
+            # acquire the longest cached prefix FIRST (a ref pins those
+            # blocks against the eviction alloc() may run next)
+            hit = self.allocator.lookup(hashes)
+            # FULL-prompt hit (p % bs == 0): the final prompt position
+            # must be recomputed for its logits, and that write lands
+            # inside the last matched block — the one genuinely divergent
+            # write. Copy-on-write: one extra private block to copy the
+            # shared content into; the sharers' block is never mutated
+            # (bitwise-pinned by test).
+            cow = bool(hit) and len(hit) * bs >= len(request.tokens)
+            fresh = self.allocator.alloc(
+                n_blocks - len(hit) + (1 if cow else 0))
+            if fresh is None and cow:
+                # pool too tight for the CoW copy: degrade to dropping the
+                # last matched block and prefilling it into a fresh one
+                self.allocator.free([hit[-1]])
+                hit = hit[:-1]
+                cow = False
+                fresh = self.allocator.alloc(n_blocks - len(hit))
+            if fresh is None:
+                if hit:
+                    self.allocator.free(hit)  # release the acquired refs
                 break  # pool full: wait for a retirement to free blocks
             self._pending.popleft()
-            self._admit(slot, request, blocks, t_submit)
+            self._admit(slot, request, hit, fresh, cow, hashes, t_submit)
             admitted += 1
         return admitted
 
-    def _admit(self, slot: int, request: Request, blocks: List[int],
+    def _admit(self, slot: int, request: Request, hit: List[int],
+               fresh: List[int], cow: bool, hashes: List[int],
                t_submit_ms: float) -> None:
         p = len(request.tokens)
-        bucket = self.bucket_for(p)
+        bs = self.kv_cfg.block_size
+        n_hit = len(hit)
+        if cow:
+            # fresh[0] is the private replacement for the last matched
+            # block: copy the shared content on device, swap it into the
+            # table, drop OUR ref on the shared source (sharers keep it)
+            src, dst = hit[-1], fresh[0]
+            self.cache = self._cow(self.cache, jnp.int32(src),
+                                   jnp.int32(dst))
+            self.allocator.free([src])
+            blocks = hit[:-1] + [dst] + fresh[1:]
+            self._cow_copies += 1
+        else:
+            blocks = hit + fresh
+        hit_len = n_hit * bs
+        cached = min(hit_len, p - 1)  # position p-1 always recomputed
+        n_full = p // bs
+        if self.serve_cfg.prefix_cache:
+            self._prefix_blocks_needed += n_full
+            self._prefix_blocks_hit += min(n_hit, n_full)
+        self._prefill_tokens_saved += cached
+        # modeled flops the cache saved: 2N matmul per skipped token plus
+        # the causal attention term (the decode_flops_per_token model
+        # summed over the skipped positions)
+        self._prefill_flops_saved += (
+            2.0 * self._n_params * cached
+            + 4.0 * self.cfg.num_layers * self.cfg.hidden
+            * (cached * (cached + 1)) / 2.0)
         t_adm = self._now_ms()
         queue_ms = t_adm - t_submit_ms
         if self._events is not None:
             self._events.emit("admitted", request.uid, t_ms=t_adm,
-                              slot=slot, queue_ms=round(queue_ms, 3))
+                              slot=slot, queue_ms=round(queue_ms, 3),
+                              cached_tokens=cached)
             self._events.emit("prefill_start", request.uid, t_ms=t_adm,
-                              slot=slot, bucket=bucket, prompt_tokens=p)
+                              slot=slot, prompt_tokens=p,
+                              chunk=self.serve_cfg.prefill_chunk)
         row = np.zeros((self._blocks_per_slot,), np.int32)
         row[:len(blocks)] = blocks
-        tokens = np.zeros((bucket,), np.int32)
-        tokens[:p] = np.asarray(request.tokens, np.int32)
         key = np.asarray(
             request_key(self._base_key, request.sampling_seed()), np.uint32)
+        # blocks the tail prefill will fill: committed to the content map
+        # as the chunk cursor passes their end (never before — a block is
+        # addressable only once fully written); empty when the prefix
+        # cache is off (no hashes computed)
+        commits = [(int(row[j]), hashes[j], (j + 1) * bs)
+                   for j in range(n_hit, n_full)] if hashes else []
+        if cow:
+            # the CoW copy is content-complete once position p-1 rewrites;
+            # commit is a no-op while the shared source stays mapped but
+            # re-registers the content if the source gets evicted first
+            commits.append((int(blocks[n_hit - 1]), hashes[n_full - 1], p))
+        state = _SlotState(request=request, blocks=blocks, generated=[],
+                           history=[int(t) for t in request.tokens],
+                           prompt_len=p, prefill_pos=cached,
+                           cached_tokens=cached, pending_commits=commits,
+                           t_submit_ms=t_submit_ms, queue_ms=queue_ms)
+        self._slots[slot] = state
+        self._block_tables[slot] = row
+        self._keys[slot] = key
+        self._dirty("block_tables", "keys")
+        self._prefill_queue.append(slot)
+
+    # -- chunked prefill ---------------------------------------------------
+    def _prefill_backlog_tokens(self) -> int:
+        """Prompt tokens admitted but not yet chunk-prefilled (the
+        chunked-prefill backlog depth gauge)."""
+        return sum(s.prompt_len - s.prefill_pos
+                   for s in self._slots
+                   if s is not None and s.prefill_pos < s.prompt_len)
+
+    def _run_prefill_chunk(self) -> bool:
+        """One fixed-size chunk for the front of the prefill queue; on the
+        prompt's final chunk, sample the first token and promote the slot
+        to the decode grid."""
+        if not self._prefill_queue:
+            return False
+        slot = self._prefill_queue[0]
+        state = self._slots[slot]
+        assert state is not None
+        C = self.serve_cfg.prefill_chunk
+        c = state.prefill_pos
+        p = state.prompt_len
+        n_valid = min(C, p - c)
+        tokens = np.zeros((C,), np.int32)
+        tokens[:n_valid] = np.asarray(
+            state.request.tokens[c:c + n_valid], np.int32)
         with span("prefill"):
-            self.cache, first = self._prefill(
+            self.cache, tok = self._chunk_prefill(
                 self.params, self.cache, jnp.asarray(tokens),
-                jnp.int32(p), jnp.asarray(row), jnp.asarray(key))
-            first = int(first)  # fence: TTFT includes the device round-trip
+                jnp.int32(c), jnp.int32(n_valid),
+                self._dev("block_tables")[slot], self._dev("keys")[slot])
+            state.prefill_pos = c + n_valid
+            self._chunks_run += 1
+            done = state.prefill_pos >= p
+            if done:
+                first = int(tok)  # fence: TTFT includes the round-trip
+        # full blocks the cursor passed are now content-addressable
+        while (state.pending_commits
+               and state.pending_commits[0][2] <= state.prefill_pos):
+            b, h, _ = state.pending_commits.pop(0)
+            self.allocator.commit(b, h)
+        if not done:
+            return True
+        self._prefill_queue.popleft()
         t_first = self._now_ms()
-        ttft_ms = t_first - t_submit_ms
+        ttft_ms = t_first - state.t_submit_ms
         if self._events is not None:
-            self._events.emit("prefill_end", request.uid, t_ms=t_first,
-                              slot=slot)
-            self._events.emit("first_token", request.uid, t_ms=t_first,
-                              slot=slot, ttft_ms=round(ttft_ms, 3))
+            self._events.emit("prefill_end", state.request.uid,
+                              t_ms=t_first, slot=slot)
+            self._events.emit("first_token", state.request.uid,
+                              t_ms=t_first, slot=slot,
+                              ttft_ms=round(ttft_ms, 3))
         if self._t_start is None:
             self._t_start = time.perf_counter()
         self._tokens_generated += 1
-        state = _SlotState(request=request, blocks=blocks,
-                           generated=[first], t_submit_ms=t_submit_ms,
-                           t_first_ms=t_first, queue_ms=queue_ms,
-                           ttft_ms=ttft_ms, chunk_start_ms=t_first,
-                           chunk_done=1)
-        self._slots[slot] = state
-        self._block_tables[slot] = row
+        state.generated.append(first)
+        state.history.append(first)
+        state.t_first_ms = t_first
+        state.ttft_ms = ttft_ms
+        state.chunk_start_ms = t_first
+        state.chunk_done = 1
         self._seq_lens[slot] = p
         self._last_tokens[slot] = first
-        self._keys[slot] = key
         self._active[slot] = True
+        self._dirty("seq_lens", "last_tokens", "active")
         if self._events is not None:
             self._events.gauge("occupancy", self.occupancy(), t_ms=t_first)
         if self._should_retire(state, first):
             self._retire(slot)
+        return True
 
     # -- retirement --------------------------------------------------------
     def _should_retire(self, state: _SlotState, tok: int) -> bool:
@@ -449,7 +716,7 @@ class InferenceEngine:
         # feeding the next token would write at position p + generated - 1,
         # which must stay inside the context window: continue while
         # p + generated <= max_context, retire beyond
-        return (len(state.request.tokens) + len(state.generated)
+        return (state.prompt_len + len(state.generated)
                 > self.max_context)
 
     def _retire(self, slot: int) -> None:
@@ -457,7 +724,9 @@ class InferenceEngine:
         histograms (and SLO tracker) and drops every per-uid entry — the
         O(slots) state contract. Streams are retained only when the
         engine was built with ``retain_streams=True`` (the default, for
-        ``run()``'s return value) or handed to ``on_retire``."""
+        ``run()``'s return value) or handed to ``on_retire``. Freed
+        blocks that carry a content address PARK in the allocator's
+        evictable LRU — the prefix cache outlives its requests."""
         state = self._slots[slot]
         assert state is not None
         uid = state.request.uid
@@ -498,41 +767,123 @@ class InferenceEngine:
         self._seq_lens[slot] = 0
         self._last_tokens[slot] = 0
         self._block_tables[slot] = 0
+        self._dirty("block_tables", "seq_lens", "last_tokens", "active")
         if self._events is not None:
             self._events.gauge("occupancy", self.occupancy(), t_ms=now)
 
+    # -- speculative drafting ---------------------------------------------
+    def _collect_drafts(self) -> Optional[Dict[int, List[int]]]:
+        """Ask the drafter for up to spec_k tokens per active slot, capped
+        so fed positions stay inside the slot's allocated blocks, the
+        context window, and the remaining generation budget. None when no
+        slot proposes (the step falls back to the plain decode program)."""
+        if self.drafter is None:
+            return None
+        out: Dict[int, List[int]] = {}
+        any_drafts = False
+        for i, state in enumerate(self._slots):
+            if state is None or not self._active[i]:
+                continue
+            s = int(self._seq_lens[i])
+            remaining = state.request.max_new_tokens - len(state.generated)
+            cap = min(
+                self.serve_cfg.spec_k,
+                remaining - 1,  # the last token is never fed back
+                len(state.blocks) * self.kv_cfg.block_size - 1 - s,
+                self.max_context - 1 - s,
+            )
+            if cap < 1:
+                continue
+            drafts = list(self.drafter.propose(state.history, cap))[:cap]
+            if drafts:
+                out[i] = [int(t) for t in drafts]
+                any_drafts = True
+        return out if any_drafts else None
+
     # -- stepping ----------------------------------------------------------
     def step(self) -> bool:
-        """Admit what fits, then advance every active slot one token.
-        Returns False when nothing happened (no active slots and nothing
-        admissible)."""
+        """Admit what fits, run one prefill chunk if any prompt is mid-
+        prefill, then advance every decode-ready slot — one token via the
+        decode program, or up to spec_k+1 via the speculative verify
+        program when the drafter proposed. Returns False when nothing
+        happened (no admission, no prefill, no active slots)."""
         admitted = self._try_admit()
+        chunked = self._run_prefill_chunk()
         if not self._active.any():
-            return admitted > 0
+            if self._sink is not None and chunked:
+                self._sink.write(step=self._step_idx,
+                                 phase="prefill_chunk",
+                                 prefill_backlog_tokens=(
+                                     self._prefill_backlog_tokens()))
+            if chunked:
+                self._step_idx += 1
+            return admitted > 0 or chunked
         t0 = time.perf_counter()
+        drafts = self._collect_drafts()
         with span("decode"):
-            self.cache, toks, metrics = self._decode(
-                self.params, self.cache,
-                jnp.asarray(self._last_tokens), jnp.asarray(self._seq_lens),
-                jnp.asarray(self._active), jnp.asarray(self._block_tables),
-                jnp.asarray(self._keys))
+            if drafts is None:
+                self._decode_steps += 1
+                self.cache, toks, metrics = self._decode(
+                    self.params, self.cache,
+                    self._dev("last_tokens"), self._dev("seq_lens"),
+                    self._dev("active"), self._dev("block_tables"),
+                    self._dev("keys"))
+            else:
+                self._verify_steps += 1
+                k1 = self.serve_cfg.spec_k + 1
+                n = self.serve_cfg.num_slots
+                fed = np.zeros((n, k1), np.int32)
+                fed[:, 0] = self._last_tokens
+                n_fed = np.where(self._active, 1, 0).astype(np.int32)
+                for i, d in drafts.items():
+                    fed[i, 1:1 + len(d)] = d
+                    n_fed[i] = 1 + len(d)
+                self.cache, toks, metrics = self._verify(
+                    self.params, self.cache, jnp.asarray(fed),
+                    self._dev("seq_lens"), jnp.asarray(n_fed),
+                    self._dev("active"), self._dev("block_tables"),
+                    self._dev("keys"))
             toks = np.asarray(toks)  # fence — the iteration-level sync
         dt = time.perf_counter() - t0
         self.hists["decode_step_ms"].add([dt * 1e3])
         now_ms = self._now_ms()
         active_lens = [int(s) + 1 for s, a
                        in zip(self._seq_lens, self._active) if a]
+        # tokens FED through the program per active slot (the write/flops
+        # unit: a verify step feeds 1 + len(drafts) per slot)
+        fed_counts = [1 + len(drafts.get(i, [])) if drafts is not None
+                      else 1
+                      for i in range(len(self._slots)) if self._active[i]]
         n_active = len(active_lens)
+        step_proposed = step_accepted = step_emitted = 0
         for i in range(len(self._slots)):
             if not self._active[i]:
                 continue
             state = self._slots[i]
-            tok = int(toks[i])
-            state.generated.append(tok)
-            self._seq_lens[i] += 1
-            self._last_tokens[i] = tok
-            self._tokens_generated += 1
-            if (self._events is not None
+            if drafts is None:
+                emitted = [int(toks[i])]
+            else:
+                d = drafts.get(i, [])
+                step_proposed += len(d)
+                a = 1
+                while a <= len(d) and int(toks[i, a - 1]) == d[a - 1]:
+                    a += 1
+                emitted = [int(toks[i, j]) for j in range(a)]
+                step_accepted += a - 1
+            retired = False
+            n_emit = 0
+            for tok in emitted:
+                state.generated.append(tok)
+                state.history.append(tok)
+                self._tokens_generated += 1
+                n_emit += 1
+                if self._should_retire(state, tok):
+                    retired = True
+                    break
+            step_emitted += n_emit
+            self._seq_lens[i] += n_emit
+            self._last_tokens[i] = state.generated[-1]
+            if (self._events is not None and not retired
                     and len(state.generated) - state.chunk_done
                     >= self._chunk_tokens):
                 self._events.emit(
@@ -541,28 +892,49 @@ class InferenceEngine:
                     n_tokens=len(state.generated) - state.chunk_done)
                 state.chunk_start_ms = now_ms
                 state.chunk_done = len(state.generated)
-            if self._should_retire(state, tok):
+            if retired:
                 self._retire(i)
+        self._dirty("seq_lens", "last_tokens")
+        self._spec_proposed += step_proposed
+        self._spec_accepted += step_accepted
         self._step_idx += 1
-        self._emit_metrics(metrics, dt, n_active, active_lens)
+        self._emit_metrics(metrics, dt, n_active, active_lens, fed_counts,
+                           step_proposed, step_accepted, step_emitted)
         return True
 
     def _emit_metrics(self, metrics: Metrics, dt: float, n_active: int,
-                      active_lens: List[int]) -> None:
+                      active_lens: List[int], fed_counts: List[int],
+                      step_proposed: int, step_accepted: int,
+                      step_emitted: int) -> None:
         if self._sink is None:
             return
-        flops = sum(decode_flops_per_token(
+        # a verify step feeds (writes K/V for, and gathers context per)
+        # 1+len(drafts) tokens per slot and emits 1+accepted — the record
+        # must not read 1/slot on exactly the steps speculation
+        # accelerates
+        flops = sum(f * decode_flops_per_token(
             self._n_params, self.cfg.num_layers, self.cfg.hidden, s)
-            for s in active_lens)
+            for s, f in zip(active_lens, fed_counts))
+        fed_total = sum(fed_counts)
+        read_lens = [s for s, f in zip(active_lens, fed_counts)
+                     for _ in range(f)]  # one gather per FED row
         rec = {
             "phase": "decode",
             "step_ms": round(dt * 1e3, 3),
             "occupancy": n_active / self.serve_cfg.num_slots,
-            "tokens_per_s": round(n_active / dt, 3) if dt else 0.0,
-            "kv_read_bytes": kv_read_bytes(self.kv_cfg, active_lens),
-            "kv_write_bytes": n_active * kv_write_bytes_per_token(
+            "tokens_per_s": round(step_emitted / dt, 3) if dt else 0.0,
+            "kv_read_bytes": kv_read_bytes(self.kv_cfg, read_lens),
+            "kv_write_bytes": fed_total * kv_write_bytes_per_token(
                 self.kv_cfg),
             "decode_flops_modeled": flops,
+            # throughput-optimization telemetry (per-step + cumulative;
+            # monitor.view aggregates these)
+            "prefill_backlog_tokens": self._prefill_backlog_tokens(),
+            "spec_proposed": step_proposed,
+            "spec_accepted": step_accepted,
+            "prefix_blocks_hit_total": self._prefix_blocks_hit,
+            "prefix_blocks_needed_total": self._prefix_blocks_needed,
+            "prefill_flops_saved_total": self._prefill_flops_saved,
         }
         if self._peak:
             rec["decode_mfu"] = (flops / dt) / self._peak if dt else 0.0
@@ -576,7 +948,7 @@ class InferenceEngine:
         for r in requests:
             self.submit(r)
         steps = 0
-        while self._pending or self._active.any():
+        while self._pending or self._active.any() or self._prefill_queue:
             if max_steps is not None and steps >= max_steps:
                 break
             if not self.step():
@@ -612,8 +984,9 @@ class InferenceEngine:
     def stats(self) -> Dict[str, Any]:
         """One JSON-serializable telemetry snapshot: counts, latency
         quantiles (p50/p99 from the streaming histograms — bounded
-        relative error, O(1) memory), full histogram dumps, and the
-        goodput-under-SLO report when an ``SloSpec`` was given."""
+        relative error, O(1) memory), full histogram dumps, the
+        prefix-cache / chunked-prefill / speculative-decoding counters,
+        and the goodput-under-SLO report when an ``SloSpec`` was given."""
         out: Dict[str, Any] = {
             "completed": self._completed,
             "steps": self._step_idx,
@@ -629,6 +1002,38 @@ class InferenceEngine:
                 continue
             out[f"{name}_p50"] = round(h.quantile(0.5), 3)
             out[f"{name}_p99"] = round(h.quantile(0.99), 3)
+        out["prefix_cache"] = {
+            "enabled": self.serve_cfg.prefix_cache,
+            "blocks_hit": self._prefix_blocks_hit,
+            "blocks_needed": self._prefix_blocks_needed,
+            "hit_rate": round(
+                self._prefix_blocks_hit / self._prefix_blocks_needed, 4)
+            if self._prefix_blocks_needed else None,
+            "tokens_saved": self._prefill_tokens_saved,
+            "prefill_flops_saved": self._prefill_flops_saved,
+            "cow_copies": self._cow_copies,
+            "cached_blocks": self.allocator.cached_count,
+            "evictions": self.allocator.blocks_evicted_total,
+        }
+        out["prefill"] = {
+            "chunk": self.serve_cfg.prefill_chunk,
+            "chunks_run": self._chunks_run,
+            "backlog_tokens": self._prefill_backlog_tokens(),
+        }
+        out["speculative"] = {
+            "k": self.serve_cfg.spec_k,
+            "proposed": self._spec_proposed,
+            "accepted": self._spec_accepted,
+            "acceptance_rate": round(
+                self._spec_accepted / self._spec_proposed, 4)
+            if self._spec_proposed else None,
+            "verify_steps": self._verify_steps,
+            "decode_steps": self._decode_steps,
+        }
+        # flat aliases for regression gating (monitor.regress flattens
+        # dotted keys; these are the two headline rates)
+        out["prefix_hit_rate"] = out["prefix_cache"]["hit_rate"]
+        out["spec_acceptance_rate"] = out["speculative"]["acceptance_rate"]
         out["hists"] = {k: v.to_dict() for k, v in self.hists.items()}
         if self._slo is not None:
             out["slo_report"] = self._slo.report()
@@ -636,15 +1041,19 @@ class InferenceEngine:
 
     @property
     def active(self) -> bool:
-        """Whether the engine still has work: a slot mid-generation or a
-        queued submission (the drive-loop condition loadgen polls)."""
-        return bool(self._active.any()) or bool(self._pending)
+        """Whether the engine still has work: a slot mid-generation or
+        mid-prefill, or a queued submission (the drive-loop condition
+        loadgen polls)."""
+        return (bool(self._active.any()) or bool(self._pending)
+                or bool(self._prefill_queue))
 
     def occupancy(self) -> float:
-        return float(self._active.sum()) / self.serve_cfg.num_slots
+        """Occupied slots (decoding or mid-prefill) / total slots."""
+        return (sum(s is not None for s in self._slots)
+                / self.serve_cfg.num_slots)
 
     def throughput(self) -> Optional[float]:
-        """Generated tokens per second since the first prefill."""
+        """Generated tokens per second since the first token."""
         if self._t_start is None:
             return None
         dt = time.perf_counter() - self._t_start

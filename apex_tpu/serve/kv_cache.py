@@ -18,7 +18,16 @@ for donated JAX pytrees) splits every sequence's K/V into fixed-size
   zero device work;
 * per-slot **block tables** (``(slots, max_blocks)`` int32) map logical
   token positions to pool blocks; the decode attention gathers through
-  them (``apex_tpu.serve.decode``).
+  them (``apex_tpu.serve.decode``);
+* **prefix caching** (``BlockAllocator(prefix_cache=True)``) adds
+  content-addressed reuse: full prompt blocks get a chained
+  hash-of-token-prefix address (:func:`prefix_block_hashes`), freed
+  cached blocks park in an evictable LRU at refcount 0 instead of being
+  recycled, and a later request sharing the prefix re-acquires them via
+  :meth:`BlockAllocator.lookup` — a shared system prompt costs zero
+  prefill flops after its first admission. :func:`copy_block` is the
+  copy-on-write escape hatch for the one case where a request must write
+  inside a shared block.
 
 Optional int8 KV quantization (``quantized=True``) stores the pools as
 int8 codes plus one fp32 scale per (token, head) vector — the
@@ -35,6 +44,7 @@ engine reports both through the ``monitor`` pipeline and
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -214,50 +224,202 @@ def gather_kv(
     return k, v
 
 
+def copy_block(cache: Dict[str, jnp.ndarray], src, dst
+               ) -> Dict[str, jnp.ndarray]:
+    """Copy pool block ``src`` -> ``dst`` across every layer and pool leaf
+    (K, V, and the int8 scales when present) — the device half of
+    copy-on-write: when a request must write into a SHARED cached block
+    (recomputing the last prompt position of a fully-cached prompt), the
+    engine allocates a private block, copies the shared content here, and
+    rewrites its block table; the sharing requests' block is never
+    mutated. ``src``/``dst`` are traced scalars, so the jitted copy is ONE
+    compiled program for the engine's lifetime."""
+    return {k: v.at[:, :, dst].set(v[:, :, src]) for k, v in cache.items()}
+
+
 # ---------------------------------------------------------------------------
-# Host-side block allocator: a plain LIFO free-list. Admission happens
-# between steps on the host, so this needs no device work and no locking
-# (the engine is single-threaded by construction).
+# Prefix hashing — the content address of a FULL block of prompt tokens.
+# Chained (each block's hash folds its predecessor's), so a hash names the
+# whole token prefix ending at that block, not just the block's own span:
+# matching block j implies the entire prefix [0, (j+1)*block_size) matches.
+# Ints only (python salts str hashing per process; int hashing is stable
+# within one process, which is all a per-engine cache needs).
+
+
+def hash_block_tokens(prev_hash: int, tokens: Sequence[int]) -> int:
+    """Chained content hash of one full block: ``h_j = H(h_{j-1}, tokens)``."""
+    return hash((prev_hash,) + tuple(int(t) for t in tokens))
+
+
+def prefix_block_hashes(tokens: Sequence[int],
+                        block_size: int) -> List[int]:
+    """Chain hashes of every FULL block of ``tokens`` (the partial tail
+    block has no content address — it is never shared)."""
+    out: List[int] = []
+    h = hash(("apex_tpu.serve.prefix", block_size))
+    for j in range(len(tokens) // block_size):
+        h = hash_block_tokens(h, tokens[j * block_size:(j + 1) * block_size])
+        out.append(h)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Host-side block allocator. Admission happens between steps on the host,
+# so this needs no device work and no locking (the engine is
+# single-threaded by construction). Two modes:
+#
+# * plain (``prefix_cache=False``) — a LIFO free-list, every block owned by
+#   exactly one request (the PR-5 behavior);
+# * prefix-caching (``prefix_cache=True``) — content-addressed reuse: a
+#   hash-of-token-prefix -> block-id map at block granularity with
+#   per-block refcounts. Freed blocks that carry a content address are
+#   PARKED in an LRU of evictable cached blocks instead of returning to
+#   the free list — a later request whose prompt shares the prefix
+#   re-acquires them via :meth:`lookup` and pays ZERO prefill flops for
+#   those tokens; ``alloc`` evicts least-recently-used refcount-0 cached
+#   blocks only when the free list runs dry.
 
 
 class BlockAllocator:
-    """Free-list over the pool's ``num_blocks`` block ids."""
+    """Refcounted free-list (+ optional content-addressed prefix cache)
+    over the pool's ``num_blocks`` block ids.
 
-    def __init__(self, num_blocks: int):
+    Invariants (``assert_consistent`` checks them; the chaos test in
+    ``tests/test_serve_prefix.py`` hammers them under random admit/retire/
+    evict interleavings):
+
+    * every block is in exactly ONE of: free list, evictable LRU
+      (cached, refcount 0), or allocated (refcount >= 1);
+    * a block is evictable iff its refcount is 0 and it holds a content
+      hash; eviction drops the hash and returns it to the free list;
+    * ``free`` of a block whose refcount is already 0 raises (double
+      free), as does an out-of-range id.
+    """
+
+    def __init__(self, num_blocks: int, prefix_cache: bool = False):
         if num_blocks <= 0:
             raise ValueError("num_blocks must be positive")
         self.num_blocks = num_blocks
+        self.prefix_cache = prefix_cache
         # LIFO: recently freed blocks are re-used first (still warm in any
-        # cache hierarchy; also makes tests deterministic). The shadow set
-        # keeps the double-free check O(1) — retirement frees thousands of
-        # blocks on production pools and must stay off the step's critical
-        # path.
+        # cache hierarchy; also makes tests deterministic).
         self._free: List[int] = list(range(num_blocks - 1, -1, -1))
-        self._free_set = set(self._free)
+        self._refcount: Dict[int, int] = {}
+        self._hash_to_block: Dict[int, int] = {}
+        self._block_hash: Dict[int, int] = {}
+        # refcount-0 cached blocks, least-recently-used first
+        self._lru: "collections.OrderedDict[int, None]" = \
+            collections.OrderedDict()
+        # lifetime counters (the engine's prefix-cache stats read these)
+        self.blocks_reused_total = 0
+        self.blocks_evicted_total = 0
 
     @property
     def free_count(self) -> int:
-        return len(self._free)
+        """Allocatable blocks: truly free + evictable cached."""
+        return len(self._free) + len(self._lru)
+
+    @property
+    def cached_count(self) -> int:
+        """Blocks holding a content address (shared or parked)."""
+        return len(self._block_hash)
+
+    def refcount(self, block: int) -> int:
+        return self._refcount.get(block, 0)
+
+    def _evict_one(self) -> None:
+        b, _ = self._lru.popitem(last=False)  # least recently used
+        h = self._block_hash.pop(b)
+        del self._hash_to_block[h]
+        self._free.append(b)
+        self.blocks_evicted_total += 1
 
     def alloc(self, n: int) -> Optional[List[int]]:
-        """``n`` block ids, or None when the pool cannot satisfy the request
-        (caller defers admission — never a partial grant)."""
+        """``n`` fresh block ids at refcount 1, or None when the pool
+        cannot satisfy the request even after evicting every refcount-0
+        cached block (caller defers admission — never a partial grant)."""
         if n < 0:
             raise ValueError("n must be >= 0")
-        if n > len(self._free):
+        if n > self.free_count:
             return None
+        while len(self._free) < n:
+            self._evict_one()
         out = [self._free.pop() for _ in range(n)]
-        self._free_set.difference_update(out)
+        for b in out:
+            self._refcount[b] = 1
         return out
 
     def free(self, ids: Sequence[int]) -> None:
+        """Drop one reference per id. A cached block reaching refcount 0
+        parks in the evictable LRU (its content stays addressable); an
+        uncached block returns to the free list."""
         for b in ids:
             if not 0 <= b < self.num_blocks:
                 raise ValueError(f"block id {b} out of range")
-            if b in self._free_set:
+            rc = self._refcount.get(b, 0)
+            if rc <= 0:
                 raise ValueError(f"double free of block {b}")
-            self._free.append(b)
-            self._free_set.add(b)
+            if rc > 1:
+                self._refcount[b] = rc - 1
+                continue
+            del self._refcount[b]
+            if b in self._block_hash:
+                self._lru[b] = None          # most-recently-used end
+            else:
+                self._free.append(b)
+
+    # -- content-addressed reuse ------------------------------------------
+    def lookup(self, hashes: Sequence[int]) -> List[int]:
+        """Longest cached prefix of the chained ``hashes``: acquires (one
+        reference each) and returns the matched block ids in prefix order.
+        A parked block leaves the LRU; a shared one just gains a holder.
+        Always misses when the allocator was built plain
+        (``prefix_cache=False``)."""
+        if not self.prefix_cache:
+            return []
+        out: List[int] = []
+        for h in hashes:
+            b = self._hash_to_block.get(h)
+            if b is None:
+                break
+            out.append(b)
+        for b in out:
+            rc = self._refcount.get(b, 0)
+            if rc == 0:
+                self._lru.pop(b, None)
+            self._refcount[b] = rc + 1
+            self.blocks_reused_total += 1
+        return out
+
+    def commit(self, block: int, h: int) -> bool:
+        """Register an allocated, fully-written block under its content
+        hash. No-op (False) when the allocator is plain
+        (``prefix_cache=False``), when the hash is already mapped (a
+        concurrent identical prompt won the race — this copy stays
+        private), or when the block already carries an address."""
+        if self._refcount.get(block, 0) <= 0:
+            raise ValueError(f"commit of unallocated block {block}")
+        if not self.prefix_cache:
+            return False
+        if h in self._hash_to_block or block in self._block_hash:
+            return False
+        self._hash_to_block[h] = block
+        self._block_hash[block] = h
+        return True
+
+    def assert_consistent(self) -> None:
+        """Every-block-in-exactly-one-place conservation check (cheap; the
+        chaos test calls it after every random operation)."""
+        free = set(self._free)
+        lru = set(self._lru)
+        alloc = set(self._refcount)
+        assert not (free & lru) and not (free & alloc) and not (lru & alloc)
+        assert len(free) + len(lru) + len(alloc) == self.num_blocks
+        assert all(rc >= 1 for rc in self._refcount.values())
+        for b in lru:
+            assert b in self._block_hash, f"evictable block {b} uncached"
+        for h, b in self._hash_to_block.items():
+            assert self._block_hash.get(b) == h
 
 
 # ---------------------------------------------------------------------------

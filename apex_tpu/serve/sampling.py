@@ -83,7 +83,20 @@ def sample(logits, keys, positions, cfg: SamplingConfig):
     ``keys``: (n, 2) uint32 per-slot request keys; ``positions``: (n,)
     int32 absolute position of the token being sampled. Greedy
     (``temperature == 0``) ignores both.
+
+    Also accepts (n, q, vocab) logits with (n, q) positions (the
+    speculative-verify shape: q draws per slot under ONE request key) —
+    rows flatten to (n*q,) draws and the result is (n, q). Because every
+    draw is keyed by (request, position) alone, the q-at-a-time draws are
+    bitwise the ones sequential decode would make at those positions —
+    the speculative path's acceptance oracle rests on exactly this.
     """
+    if logits.ndim == 3:
+        n, q, v = logits.shape
+        flat = sample(logits.reshape(n * q, v),
+                      jnp.repeat(keys, q, axis=0),
+                      positions.reshape(n * q), cfg)
+        return flat.reshape(n, q)
     logits = logits.astype(jnp.float32)
     if cfg.temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)

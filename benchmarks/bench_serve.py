@@ -22,7 +22,11 @@ workload and the line becomes goodput req/s + TTFT/TPOT p50/p99 from the
 streaming histograms + SLO violation counts (watcher stage 10, regression
 -gated against the banked record via ``apex_tpu.monitor.regress``).
 Extra args after ``--loadgen`` pass through (``--n-requests``,
-``--rate-rps``, ``--trace-dir``, budgets — see ``loadgen.py``).
+``--rate-rps``, ``--prefix-pool``, ``--trace-dir``, budgets — see
+``loadgen.py``). Watcher stage 11 runs ``--loadgen --prefix-pool 2
+--spec-k 4`` — the shared-prefix + speculative workload whose record
+(``SERVE_PREFIX_TPU.json``, prefix-hit and acceptance rates included)
+must materially beat the plain stage-10 goodput on the same hardware.
 """
 
 from __future__ import annotations
@@ -53,6 +57,7 @@ ON_TPU = jax.default_backend() == "tpu"
 # model + workload so the line is comparable round-over-round
 HIDDEN, LAYERS, HEADS, VOCAB, MAX_SEQ = 128, 2, 8, 512, 256
 SLOTS, BLOCK_SIZE, MAX_NEW = 4, 16, 32
+PREFILL_CHUNK = 32
 PROMPT_LENS = (5, 17, 40, 9, 33, 12, 60, 25)
 
 
@@ -68,6 +73,8 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default=None)
     ap.add_argument("--kv-quant", default="none", choices=["none", "int8"])
+    ap.add_argument("--spec-k", type=int, default=0,
+                    help="speculative draft length (0: off)")
     ap.add_argument("--loadgen", action="store_true",
                     help="run the goodput-under-SLO loadgen bench instead")
     args, extra = ap.parse_known_args()
@@ -78,7 +85,8 @@ def main() -> int:
         sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
         from loadgen import main as loadgen_main
 
-        fwd = list(extra) + ["--kv-quant", args.kv_quant]
+        fwd = list(extra) + ["--kv-quant", args.kv_quant,
+                             "--spec-k", str(args.spec_k)]
         if args.out:
             fwd += ["--out", args.out]
         return loadgen_main(fwd)
@@ -105,14 +113,16 @@ def main() -> int:
         eng = InferenceEngine(
             params, cfg,
             ServeConfig(num_slots=SLOTS, block_size=BLOCK_SIZE,
-                        kv_quant=args.kv_quant),
+                        kv_quant=args.kv_quant,
+                        prefill_chunk=PREFILL_CHUNK, spec_k=args.spec_k),
             sink=sink)
         out = eng.run(requests)
         tokens_per_s = eng.throughput()
         stats = eng.stats()  # TTFT/step quantiles from the streaming hists
         kv_budget = eng.kv_budget_bytes()
         compiles = eng.compile_counts()
-    steps = list(read_jsonl(step_log))
+    steps = [r for r in read_jsonl(step_log)
+             if r.get("phase") == "decode"]
     gen_tokens = sum(len(v) for v in out.values())
 
     rec = {
@@ -131,8 +141,13 @@ def main() -> int:
         "kv_read_bytes_peak": max((r["kv_read_bytes"] for r in steps),
                                   default=None),
         "kv_quant": args.kv_quant,
+        # the tightened compile gate: 1 chunked prefill + 1 decode
+        # (+ <= 1 verify when speculation is on) — no bucket ladder
         "compilations": compiles,
-        "n_buckets": len(eng.buckets),
+        "prefill_chunk": PREFILL_CHUNK,
+        "prefix_hit_rate": stats.get("prefix_hit_rate"),
+        "spec_acceptance_rate": stats.get("spec_acceptance_rate"),
+        "spec_k": args.spec_k,
         # the TP-sharded serving path (sharded heads, gathered logits)
         # needs a multi-chip slice; a single chip has nothing to shard
         "tp_sharded_serving": ("needs a slice"

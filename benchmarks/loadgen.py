@@ -18,6 +18,13 @@ through it:
 * **closed loop** — a fixed number of in-flight requests; each
   retirement immediately submits the next. Measures capacity without
   queueing effects (the classic loadgen dual).
+* **shared prefixes** — ``prefix_pool`` distinct "system prompts" of
+  ``prefix_len`` tokens, mixed into a ``prefix_ratio`` fraction of
+  requests (same seed -> same pool, same mixing). This is the workload
+  the engine's prefix cache exists for: the acceptance record for
+  ``tpu_watch.sh`` stage 11 (``SERVE_PREFIX_TPU.json``) runs it with
+  ``--prefix-pool`` + ``--spec-k`` and must beat the stage-10 plain
+  record on the same hardware.
 
 ``run_workload`` drives the engine with ``retain_streams=False`` — state
 stays O(slots + backlog) no matter how many requests flow — and returns
@@ -66,6 +73,13 @@ class WorkloadConfig:
     max_new_sigma: float = 0.5
     max_new_min: int = 2
     max_new_max: int = 64
+    # shared-prefix mixing: a pool of prefix_pool distinct "system
+    # prompts" of prefix_len tokens each; a prefix_ratio fraction of
+    # requests open with one of them (the rest are fully random) — the
+    # workload shape the engine's prefix cache exists for. 0 disables.
+    prefix_pool: int = 0
+    prefix_len: int = 32
+    prefix_ratio: float = 1.0
     seed: int = 0
 
     def validate(self) -> None:
@@ -81,6 +95,13 @@ class WorkloadConfig:
             raise ValueError("bad prompt length bounds")
         if not (1 <= self.max_new_min <= self.max_new_max):
             raise ValueError("bad max_new bounds")
+        if self.prefix_pool < 0:
+            raise ValueError("prefix_pool must be >= 0")
+        if self.prefix_pool:
+            if self.prefix_len < 1:
+                raise ValueError("prefix_len must be >= 1")
+            if not 0.0 < self.prefix_ratio <= 1.0:
+                raise ValueError("prefix_ratio must be in (0, 1]")
 
 
 def _lognormal_int(rng, median: float, sigma: float, lo: int, hi: int,
@@ -106,6 +127,16 @@ def build_workload(cfg: WorkloadConfig, vocab_size: int,
                            cfg.prompt_len_min, p_hi, n)
     glens = _lognormal_int(rng, cfg.max_new_median, cfg.max_new_sigma,
                            cfg.max_new_min, cfg.max_new_max, n)
+    # shared-prefix pool: the N "system prompts" are drawn FIRST from the
+    # same seeded rng, so the pool is part of the deterministic workload
+    prefixes: List[List[int]] = []
+    pick = share = None
+    if cfg.prefix_pool:
+        plen = min(cfg.prefix_len, max_context - 2)
+        prefixes = [rng.integers(0, vocab_size, size=plen).tolist()
+                    for _ in range(cfg.prefix_pool)]
+        pick = rng.integers(0, cfg.prefix_pool, size=n)
+        share = rng.random(size=n) < cfg.prefix_ratio
     if cfg.mode == "closed":
         arrivals = np.zeros((n,))
     else:
@@ -128,6 +159,10 @@ def build_workload(cfg: WorkloadConfig, vocab_size: int,
     out = []
     for i in range(n):
         toks = rng.integers(0, vocab_size, size=int(plens[i])).tolist()
+        if prefixes and share[i]:
+            # shared system prompt + the request's own tail, clipped to
+            # leave >= 1 position to generate
+            toks = (prefixes[int(pick[i])] + toks)[:max_context - 1]
         out.append((float(arrivals[i]),
                     Request(f"lg{i:05d}", toks,
                             max_new_tokens=int(glens[i]))))
@@ -217,10 +252,24 @@ def main(argv=None) -> int:
     ap.add_argument("--ttft-budget", type=float, default=2000.0)
     ap.add_argument("--tpot-budget", type=float, default=200.0)
     ap.add_argument("--queue-budget", type=float, default=1000.0)
+    # shared-prefix workload (the prefix-cache acceptance knob) + the
+    # serve-throughput tier-2 engine knobs
+    ap.add_argument("--prefix-pool", type=int, default=0,
+                    help="N distinct shared system prompts (0: off)")
+    ap.add_argument("--prefix-len", type=int, default=64)
+    ap.add_argument("--prefix-ratio", type=float, default=0.75,
+                    help="fraction of requests opening with a shared "
+                         "prefix")
+    ap.add_argument("--spec-k", type=int, default=0,
+                    help="speculative draft length (0: off)")
+    ap.add_argument("--prefill-chunk", type=int, default=32)
+    ap.add_argument("--no-prefix-cache", action="store_true",
+                    help="disable content-addressed block reuse")
     args = ap.parse_args(argv)
 
     on_tpu = jax.default_backend() == "tpu"
-    name = "gpt_serve_goodput_slo"
+    name = ("gpt_serve_prefix_goodput_slo" if args.prefix_pool
+            else "gpt_serve_goodput_slo")
     if not on_tpu:
         name += "_CPU_FALLBACK"
 
@@ -233,7 +282,10 @@ def main(argv=None) -> int:
     params = init_gpt_params(jax.random.PRNGKey(0), cfg)
     wcfg = WorkloadConfig(n_requests=args.n_requests, mode=args.mode,
                           rate_rps=args.rate_rps, seed=args.seed,
-                          prompt_len_max=MAX_SEQ // 2)
+                          prompt_len_max=MAX_SEQ // 2,
+                          prefix_pool=args.prefix_pool,
+                          prefix_len=args.prefix_len,
+                          prefix_ratio=args.prefix_ratio)
     slo = SloSpec(ttft_ms=args.ttft_budget, tpot_ms=args.tpot_budget,
                   queue_ms=args.queue_budget)
     workload = build_workload(wcfg, VOCAB, MAX_SEQ)
@@ -249,7 +301,10 @@ def main(argv=None) -> int:
     eng = InferenceEngine(
         params, cfg,
         ServeConfig(num_slots=SLOTS, block_size=BLOCK_SIZE,
-                    kv_quant=args.kv_quant),
+                    kv_quant=args.kv_quant,
+                    prefill_chunk=args.prefill_chunk,
+                    prefix_cache=not args.no_prefix_cache,
+                    spec_k=args.spec_k),
         events=events, slo=slo, retain_streams=False)
     stats = run_workload(eng, workload)
     if sink is not None:
@@ -272,12 +327,25 @@ def main(argv=None) -> int:
             "ttft_ms_p50", "ttft_ms_p99", "tpot_ms_p50", "tpot_ms_p99",
             "queue_ms_p50", "queue_ms_p99", "decode_step_ms_p50",
             "decode_step_ms_p99")},
+        # the throughput-optimization headline fields (acceptance: the
+        # shared-prefix record carries hit/acceptance rates)
+        "prefix_hit_rate": stats.get("prefix_hit_rate"),
+        "prefix_cache": stats.get("prefix_cache"),
+        "spec_acceptance_rate": stats.get("spec_acceptance_rate"),
+        "speculative": stats.get("speculative"),
+        "prefill": stats.get("prefill"),
+        "compilations": eng.compile_counts(),
         "slo": slo.to_dict(),
         "hist_rel_error": round(eng.hists["ttft_ms"].spec.rel_error, 4),
         "workload": {"mode": wcfg.mode, "n": wcfg.n_requests,
                      "rate_rps": wcfg.rate_rps,
                      "burst_every_s": wcfg.burst_every_s,
-                     "burst_size": wcfg.burst_size, "seed": wcfg.seed},
+                     "burst_size": wcfg.burst_size, "seed": wcfg.seed,
+                     "prefix_pool": wcfg.prefix_pool,
+                     "prefix_len": wcfg.prefix_len,
+                     "prefix_ratio": wcfg.prefix_ratio,
+                     "spec_k": args.spec_k,
+                     "prefill_chunk": args.prefill_chunk},
         "hists": {k: hists[k] for k in ("ttft_ms", "tpot_ms")},
         "backend": jax.default_backend(),
     }

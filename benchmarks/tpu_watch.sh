@@ -21,8 +21,10 @@
 #   8 overlap A/B     bench_overlap.py      -> OVERLAP_TPU.json
 #   9 serve engine    bench_serve.py        -> SERVE_TPU.json
 #  10 serve SLO       bench_serve.py --loadgen -> SERVE_SLO_TPU.json
+#  11 serve prefix    bench_serve.py --loadgen --prefix-pool --spec-k
+#                                           -> SERVE_PREFIX_TPU.json
 # After the first seven, later healthy probes only refresh stage 1+3
-# (hourly) so the banked number tracks the latest code; stages 8-10
+# (hourly) so the banked number tracks the latest code; stages 8-11
 # ride the same hourly cadence until banked (additive evidence that must
 # never hold the suite out of refresh mode).
 cd /root/repo || exit 1
@@ -35,6 +37,7 @@ last_longseq=-3600  # first stage-7 attempt immediate, retries hourly
 last_overlap=-3600  # stage-8 (overlap A/B) same hourly retry contract
 last_serve=-3600    # stage-9 (serve engine) same hourly retry contract
 last_slo=-3600      # stage-10 (serve goodput-SLO) same hourly contract
+last_prefix=-3600   # stage-11 (shared-prefix + speculative) same contract
 
 note() { echo "$(date '+%F %T') $*" >> "$LOG"; }
 
@@ -206,6 +209,43 @@ $(cat /tmp/tpu_stage10_regress.out)"
   return 0
 }
 
+prefix_stage() {
+  # stage 11: shared-prefix + speculative serve bench — the loadgen
+  # workload the prefix cache and drafter exist for (pool of shared
+  # system prompts, spec-k 4). Record carries prefix-hit and acceptance
+  # rates; promotion is REGRESSION-GATED via monitor.regress exactly
+  # like stage 10 (tol 15%, bad-direction moves keep the banked record).
+  # CPU rehearsals never promote.
+  note "STAGE11 START: bench_serve.py --loadgen --prefix-pool 2 --spec-k 4"
+  rm -f /tmp/serve_prefix_try.json
+  timeout 1200 python benchmarks/bench_serve.py --loadgen \
+    --prefix-pool 2 --prefix-len 64 --prefix-ratio 0.75 --spec-k 4 \
+    --out /tmp/serve_prefix_try.json \
+    > /tmp/tpu_stage11.out 2> /tmp/tpu_stage11.err
+  local rc=$?
+  note "STAGE11 EXIT=$rc"
+  [ -s /tmp/serve_prefix_try.json ] || return 1
+  if grep -q CPU_FALLBACK /tmp/serve_prefix_try.json; then
+    note "STAGE11 got CPU_FALLBACK, not promoting"
+    return 1
+  fi
+  if [ -s SERVE_PREFIX_TPU.json ]; then
+    if ! python -m apex_tpu.monitor.regress SERVE_PREFIX_TPU.json \
+        /tmp/serve_prefix_try.json --tol 0.15 \
+        > /tmp/tpu_stage11_regress.out 2>> /tmp/tpu_stage11.err; then
+      note "STAGE11 REGRESSION vs banked, keeping banked record: \
+$(cat /tmp/tpu_stage11_regress.out)"
+      return 1
+    fi
+  fi
+  cp /tmp/serve_prefix_try.json SERVE_PREFIX_TPU.json
+  note "STAGE11 PROMOTED $(cat SERVE_PREFIX_TPU.json)"
+  [ $rc -eq 0 ] || return 1
+  # advance only from exactly 10 (same reasoning as stage 9's 8-gate)
+  [ "$(cat "$STATE")" -eq 10 ] && echo 11 > "$STATE"
+  return 0
+}
+
 smoke_stage() {
   # Smoke to a temp file; promote ANY real-TPU artifact (a failing kernel
   # on the chip is exactly the evidence we must bank) but never a CPU
@@ -270,6 +310,13 @@ while true; do
           slo_stage
           last_slo=$now
         fi
+        # stage 11 (shared-prefix + speculative loadgen): same hourly
+        # re-measure-after-banked contract as stage 10 — a prefix-cache
+        # or acceptance-rate regression must surface within an hour
+        if [ $((now - last_prefix)) -ge 3600 ]; then
+          prefix_stage
+          last_prefix=$now
+        fi
         last_refresh=$now
       fi
     else
@@ -317,6 +364,14 @@ while true; do
           && [ $((now - last_slo)) -ge 3600 ]; then
         slo_stage
         last_slo=$now
+      fi
+      # stage 11: shared-prefix + speculative loadgen bench, regression-
+      # gated like stage 10. Hourly retry; CPU rehearsals never promote
+      # (prefix_stage).
+      if [ "$(cat "$STATE")" -eq 10 ] \
+          && [ $((now - last_prefix)) -ge 3600 ]; then
+        prefix_stage
+        last_prefix=$now
       fi
       last_refresh=$now
     fi

@@ -59,6 +59,13 @@ def parse_args(argv=None):
     ap.add_argument("--num-slots", type=int, default=4)
     ap.add_argument("--block-size", type=int, default=16)
     ap.add_argument("--kv-quant", default="none", choices=["none", "int8"])
+    ap.add_argument("--prefill-chunk", type=int, default=32,
+                    help="tokens per chunked-prefill step")
+    ap.add_argument("--no-prefix-cache", action="store_true",
+                    help="disable content-addressed KV block reuse")
+    ap.add_argument("--spec-k", type=int, default=0,
+                    help="speculative draft length (0 = off; n-gram "
+                         "prompt-lookup drafter)")
     ap.add_argument("--temperature", type=float, default=0.0,
                     help="0 = greedy")
     ap.add_argument("--top-k", type=int, default=0)
@@ -89,7 +96,8 @@ def main(argv=None) -> int:
         else jnp.float32)
     scfg = ServeConfig(
         num_slots=args.num_slots, block_size=args.block_size,
-        kv_quant=args.kv_quant,
+        kv_quant=args.kv_quant, prefill_chunk=args.prefill_chunk,
+        prefix_cache=not args.no_prefix_cache, spec_k=args.spec_k,
         sampling=SamplingConfig(temperature=args.temperature,
                                 top_k=args.top_k, top_p=args.top_p))
     template = init_gpt_params(jax.random.PRNGKey(0), cfg)
@@ -124,7 +132,18 @@ def main(argv=None) -> int:
               f"{stats['ttft_ms_p99']:.1f} ms | "
               f"kv budget: {engine.kv_budget_bytes() / 1e6:.1f} MB | "
               f"compilations: {engine.compile_counts()} "
-              f"(buckets: {engine.buckets})")
+              f"(prefill chunk: {args.prefill_chunk})")
+        pc = stats["prefix_cache"]
+        if pc["blocks_needed"]:
+            print(f"prefix cache: {pc['blocks_hit']}/"
+                  f"{pc['blocks_needed']} blocks reused "
+                  f"(hit rate {pc['hit_rate']}), "
+                  f"{pc['tokens_saved']} prefill tokens saved")
+        sp = stats["speculative"]
+        if sp["proposed"]:
+            print(f"speculative: {sp['accepted']}/{sp['proposed']} drafts "
+                  f"accepted (rate {sp['acceptance_rate']}) over "
+                  f"{sp['verify_steps']} verify steps")
         if slo is not None:
             rep = stats["slo_report"]
             print(f"SLO {slo.to_dict()}: good {rep['good']}/"

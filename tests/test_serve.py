@@ -1,13 +1,17 @@
 """apex_tpu.serve — paged KV cache, decode attention, sampling, engine.
 
 All stock-jax-safe (single device, no shard_map): the serve programs run
-with ``tp_axis=None``. The two acceptance gates live here:
+with ``tp_axis=None``. Two acceptance gates live here:
 
 * **request-order invariance** — continuous-batched multi-request streams
   are BITWISE identical (greedy; same-key sampled) to single-request
   decode of each prompt, in any admission order;
-* **compile-count gate** — a mixed-length workload compiles at most
-  ``len(buckets)`` prefill programs + exactly 1 decode program.
+* **compile-count gate** — a mixed-length workload compiles EXACTLY one
+  chunked-prefill program + one decode program (the bucket ladder and its
+  per-bucket compiles are gone).
+
+The prefix-cache / chunked-prefill / speculative-decoding oracles and the
+allocator chaos gates live in ``tests/test_serve_prefix.py``.
 """
 
 import numpy as np
@@ -45,7 +49,8 @@ BUCKETS = (8, 16, 32, 64)
 
 
 def _engine(sampling=None, **kw):
-    scfg = ServeConfig(num_slots=3, block_size=8, prefill_buckets=BUCKETS,
+    # prefill_chunk=8 makes multi-chunk prompts common in these workloads
+    scfg = ServeConfig(num_slots=3, block_size=8, prefill_chunk=8,
                        sampling=sampling or SamplingConfig(), **kw)
     return InferenceEngine(PARAMS, CFG, scfg)
 
@@ -310,15 +315,17 @@ def test_engine_request_order_invariance_sampled():
 
 
 def test_engine_compile_count_gate():
-    """Mixed-length workload: <= n_buckets jitted prefills + exactly 1
-    jitted decode across the whole run."""
+    """THE tightened gate: a mixed-length workload compiles EXACTLY one
+    chunked-prefill program + one decode program — the PR-5 bucket ladder
+    (one compile per bucket used) is gone. Speculation off -> no verify
+    program; no full-prompt cache hit -> no CoW copy."""
     eng = _engine()
     reqs = [
-        Request("r1", [1, 2], max_new_tokens=3),                 # bucket 8
-        Request("r2", list(range(10)), max_new_tokens=3),        # bucket 16
-        Request("r3", list(range(20)), max_new_tokens=3),        # bucket 32
-        Request("r4", [5, 6, 7], max_new_tokens=4),              # bucket 8
-        Request("r5", list(range(12)), max_new_tokens=2),        # bucket 16
+        Request("r1", [1, 2], max_new_tokens=3),
+        Request("r2", list(range(10)), max_new_tokens=3),
+        Request("r3", list(range(20)), max_new_tokens=3),
+        Request("r4", [5, 6, 7], max_new_tokens=4),
+        Request("r5", list(range(12)), max_new_tokens=2),
     ]
     out = eng.run(reqs)
     assert len(out) == 5
@@ -326,8 +333,9 @@ def test_engine_compile_count_gate():
     if counts["decode"] is None:
         pytest.skip("this jax cannot report jit cache sizes")
     assert counts["decode"] == 1
-    assert counts["prefill"] == 3          # buckets actually used
-    assert counts["prefill"] <= len(BUCKETS)
+    assert counts["chunk_prefill"] == 1    # one program, all lengths
+    assert counts["verify"] == 0
+    assert counts["cow_copy"] == 0
 
 
 def test_engine_eos_and_max_len_retirement():
@@ -398,15 +406,21 @@ def test_engine_metrics_jsonl(tmp_path):
         assert eng.throughput() > 0
     recs = list(read_jsonl(path))
     assert recs, "no step records written"
-    for r in recs:
+    decode_recs = [r for r in recs if r.get("phase") == "decode"]
+    assert decode_recs, "no decode step records written"
+    for r in decode_recs:
         assert r["schema"] == 1
         assert 0 < r["occupancy"] <= 1.0
         assert r["kv_read_bytes"] > 0 and r["kv_write_bytes"] > 0
         assert r["tokens_per_s"] > 0
         assert 0 <= r["decode_mfu"]
         assert r["active_slots"] >= 1     # in-graph Metrics made it out
+        # the throughput-optimization telemetry rides every decode record
+        assert r["prefill_backlog_tokens"] >= 0
+        assert r["spec_proposed"] == 0    # speculation off in this engine
+        assert r["prefix_blocks_needed_total"] >= 0
     # peak occupancy: all three requests were in flight at once
-    assert max(r["occupancy"] for r in recs) == 1.0
+    assert max(r["occupancy"] for r in decode_recs) == 1.0
 
 
 # ---------------------------------------------------------------------------
@@ -561,14 +575,23 @@ def test_engine_from_checkpoint_latest_valid(tmp_path):
     assert eng.run([REQS[0]]) == _engine().run([REQS[0]])
 
 
-def test_default_bucket_ladder():
+def test_default_bucket_ladder_compat_shim():
+    """The ladder survives as a COMPAT SHIM only: no prefill program is
+    compiled per bucket anymore, and a short ladder no longer makes
+    prompts unservable (chunked prefill handles any length)."""
     assert default_bucket_ladder(64) == (16, 32, 64)
     assert default_bucket_ladder(100) == (16, 32, 64, 100)
-    with pytest.raises(ValueError):
-        # ladder top below max_context is unservable
-        InferenceEngine(PARAMS, CFG, ServeConfig(
-            num_slots=1, block_size=8, prefill_buckets=(8, 16),
-            max_context=64))
+    eng = InferenceEngine(PARAMS, CFG, ServeConfig(
+        num_slots=1, block_size=8, prefill_buckets=(8, 16),
+        prefill_chunk=8, max_context=64))
+    assert eng.buckets == (8, 16)          # surfaced for old callers
+    assert eng.bucket_for(5) == 8
+    # a prompt past the compat ladder still serves (the shim's whole point)
+    out = eng.run([Request("long", list(range(30)), max_new_tokens=3)])
+    assert len(out["long"]) == 3
+    counts = eng.compile_counts()
+    if counts["decode"] is not None:
+        assert counts["chunk_prefill"] == 1
 
 
 def test_engine_config_validation():
