@@ -181,6 +181,19 @@ class DistributedFusedAdam:
     # policy 'int8_ef' carries an error-feedback residual — thread
     # ``comm_state`` through :meth:`step` (see :meth:`init_comm_state`)
     compression: Optional[CompressionConfig] = None
+    # the update tail (moments + bias correction + decay + direction) as
+    # ONE Pallas kernel per shard leaf (ops/fused_update.py) instead of
+    # ~10 elementwise XLA ops: "auto" on compiled Mosaic backends, "on"
+    # forces (interpret off-TPU — the parity tests' mode), "off" keeps
+    # the per-op chain
+    fused_update: str = "auto"
+
+    def __post_init__(self):
+        # validate eagerly (like FusedAdam's fused_tail): a bad mode must
+        # fail at construction, not mid-trace inside the first step()
+        from apex_tpu.ops.fused_update import resolve_fused
+
+        resolve_fused(self.fused_update)
 
     def init(self, params: Pytree) -> DistAdamState:
         """Shard fp32 masters + zero moments (call inside the mesh program;
@@ -279,8 +292,19 @@ class DistributedFusedAdam:
         t = count.astype(jnp.float32)
         c1 = 1.0 - jnp.power(b1, t)
         c2 = 1.0 - jnp.power(b2, t)
+        from apex_tpu.ops.fused_update import fused_adam_tail, resolve_fused
+
+        use_fused = resolve_fused(self.fused_update)
 
         def upd(g, m, v, p32):
+            if use_fused:
+                # the whole tail as ONE kernel (ops/fused_update.py);
+                # only the lr axpy stays outside
+                u, m_new, v_new = fused_adam_tail(
+                    g, m, v, p32, c1, c2, betas=self.betas, eps=self.eps,
+                    weight_decay=self.weight_decay,
+                    adam_w_mode=self.adam_w_mode, use_pallas=True)
+                return p32 - self.lr * u, m_new, v_new
             if not self.adam_w_mode and self.weight_decay:
                 g = g + self.weight_decay * p32
             m_new = b1 * m + (1.0 - b1) * g

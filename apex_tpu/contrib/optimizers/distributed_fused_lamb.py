@@ -62,6 +62,16 @@ class DistributedFusedLAMB:
     e5m2_allgather: bool = False
     # int8-quantized gradient reduce-scatter (see DistributedFusedAdam)
     compression: Optional[CompressionConfig] = None
+    # fused update tail (see DistributedFusedAdam.fused_update): the LAMB
+    # kernel additionally accumulates the trust ratio's local Σp²/Σu²
+    # in-kernel — only the psum + trust scale + lr axpy stay outside
+    fused_update: str = "auto"
+
+    def __post_init__(self):
+        # validate eagerly (see DistributedFusedAdam)
+        from apex_tpu.ops.fused_update import resolve_fused
+
+        resolve_fused(self.fused_update)
 
     def init(self, params: Pytree) -> DistLambState:
         mult = _shard_multiple(self.compression)
@@ -135,16 +145,30 @@ class DistributedFusedLAMB:
         c1 = 1.0 - jnp.power(b1, t) if self.bias_correction else 1.0
         c2 = 1.0 - jnp.power(b2, t) if self.bias_correction else 1.0
 
+        from apex_tpu.ops.fused_update import fused_lamb_tail, resolve_fused
+
+        use_fused = resolve_fused(self.fused_update)
+
         def upd(g, m, v, p32):
-            m_new = b1 * m + (1.0 - b1) * g
-            v_new = b2 * v + (1.0 - b2) * g * g
-            u = (m_new / c1) / (jnp.sqrt(v_new / c2) + self.eps)
-            if self.weight_decay:
-                u = u + self.weight_decay * p32
-            # per-PARAMETER norms: local shard sq-sum + psum (ref two-stage
-            # multi_tensor_l2norm + allreduce)
-            w_norm = jnp.sqrt(lax.psum(jnp.sum(p32 * p32), self.axis_name))
-            u_norm = jnp.sqrt(lax.psum(jnp.sum(u * u), self.axis_name))
+            if use_fused:
+                # moments + direction + the trust ratio's LOCAL sq-sums in
+                # ONE kernel; the cross-shard psum stays a collective
+                u, m_new, v_new, wsq, usq = fused_lamb_tail(
+                    g, m, v, p32, c1, c2, betas=self.betas, eps=self.eps,
+                    weight_decay=self.weight_decay, use_pallas=True)
+                w_norm = jnp.sqrt(lax.psum(wsq, self.axis_name))
+                u_norm = jnp.sqrt(lax.psum(usq, self.axis_name))
+            else:
+                m_new = b1 * m + (1.0 - b1) * g
+                v_new = b2 * v + (1.0 - b2) * g * g
+                u = (m_new / c1) / (jnp.sqrt(v_new / c2) + self.eps)
+                if self.weight_decay:
+                    u = u + self.weight_decay * p32
+                # per-PARAMETER norms: local shard sq-sum + psum (ref
+                # two-stage multi_tensor_l2norm + allreduce)
+                w_norm = jnp.sqrt(
+                    lax.psum(jnp.sum(p32 * p32), self.axis_name))
+                u_norm = jnp.sqrt(lax.psum(jnp.sum(u * u), self.axis_name))
             apply_trust = (w_norm > 0) & (u_norm > 0)
             if not self.use_nvlamb and not self.weight_decay:
                 trust = 1.0

@@ -40,7 +40,10 @@ _HIGHER = ("tokens_per_s", "goodput", "_rps", "mfu", "occupancy",
            "throughput", "hidden_fraction", "good_fraction",
            # serve throughput tier 2: a collapsing prefix-cache hit rate
            # or draft acceptance rate is a regression (stage-11 gate)
-           "hit_rate", "acceptance_rate")
+           "hit_rate", "acceptance_rate",
+           # megakernel A/B: the fused-vs-per-op decode-step ratio is the
+           # stage-12 headline — a shrinking speedup is a regression
+           "speedup")
 _LOWER = ("_ms", "violation", "latency", "bubble", "exposed_bytes")
 
 

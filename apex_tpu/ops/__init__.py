@@ -11,6 +11,7 @@ Reference CUDA ext                             apex_tpu equivalent
 ``mlp_cuda``, ``fused_dense_cuda``             ``apex_tpu.mlp`` / ``fused_dense``
 ``fmhalib``, ``fast_multihead_attn``           ``ops.flash_attention``
 ``amp_C`` multi-tensor kernels                 jit over pytrees (+``ops.multi_tensor``)
+``multi_tensor_adam/lamb`` update kernels      ``ops.fused_update`` (Pallas)
 ``syncbn`` Welford kernels                     ``parallel.sync_batchnorm``
 =============================================  =================================
 """
@@ -19,6 +20,12 @@ from apex_tpu.ops.attention import (  # noqa: F401
     attention_reference,
     flash_attention,
     flash_attention_with_lse,
+)
+from apex_tpu.ops.fused_update import (  # noqa: F401
+    adam_tail_reference,
+    fused_adam_tail,
+    fused_lamb_tail,
+    lamb_tail_reference,
 )
 from apex_tpu.ops.layer_norm import (  # noqa: F401
     layer_norm,
@@ -34,9 +41,13 @@ from apex_tpu.ops.softmax import (  # noqa: F401
 from apex_tpu.ops.xentropy import softmax_cross_entropy_loss  # noqa: F401
 
 __all__ = [
+    "adam_tail_reference",
     "attention_reference",
     "flash_attention",
     "flash_attention_with_lse",
+    "fused_adam_tail",
+    "fused_lamb_tail",
+    "lamb_tail_reference",
     "layer_norm",
     "layer_norm_reference",
     "rms_norm",

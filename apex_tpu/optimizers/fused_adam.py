@@ -48,12 +48,22 @@ def FusedAdam(
     weight_decay: float = 0.0,
     amsgrad: bool = False,
     capturable: bool = True,  # always "capturable": everything lives on device
+    fused_tail: str = "auto",
 ) -> optax.GradientTransformation:
     """Build the transform (ref ``fused_adam.py:4`` constructor signature;
     ``step`` at ``:92``). ``amsgrad`` is unsupported, as in the reference
-    (``fused_adam.py:77-78`` raises)."""
+    (``fused_adam.py:77-78`` raises).
+
+    ``fused_tail``: run the per-leaf update tail as ONE Pallas kernel
+    (``ops/fused_update.py`` — the actual "fused" of the reference's
+    multi_tensor launch, rebuilt for Mosaic) — "auto" on compiled TPU
+    backends, "on" forces (interpret off-TPU), "off" keeps the XLA op
+    chain."""
     if amsgrad:
         raise RuntimeError("FusedAdam does not support the AMSGrad variant.")
+    from apex_tpu.ops.fused_update import resolve_fused
+
+    resolve_fused(fused_tail, what="fused_tail")  # validate eagerly
     b1, b2 = betas
 
     def init(params):
@@ -74,9 +84,21 @@ def FusedAdam(
         c1 = 1.0 - jnp.power(b1, t) if bias_correction else jnp.asarray(1.0)
         c2 = 1.0 - jnp.power(b2, t) if bias_correction else jnp.asarray(1.0)
 
+        from apex_tpu.ops.fused_update import fused_adam_tail, resolve_fused
+
+        use_fused = resolve_fused(fused_tail, what="fused_tail")
+
         def leaf(g, p, m, v):
             g = g.astype(jnp.float32)
             p32 = p.astype(jnp.float32)
+            if use_fused:
+                # the whole tail as ONE kernel per leaf — the Mosaic
+                # analogue of the reference's chunked multi_tensor_adam
+                upd, m_new, v_new = fused_adam_tail(
+                    g, m, v, p32, c1, c2, betas=betas, eps=eps,
+                    weight_decay=weight_decay, adam_w_mode=adam_w_mode,
+                    use_pallas=True)
+                return (-step_lr * upd).astype(p.dtype), m_new, v_new
             if not adam_w_mode and weight_decay != 0.0:
                 g = g + weight_decay * p32  # ADAM_MODE_1 (multi_tensor_adam.cu:60)
             m_new = b1 * m + (1.0 - b1) * g

@@ -14,6 +14,11 @@ inference story is ``amp.initialize`` eval-mode half precision):
   (speculative verify, q=k+1), ``gpt_prefill_chunk`` (chunked prefill),
   plus ``gpt_prefill`` — the full-prompt flash prefill kept as the
   cold-path oracle;
+* :mod:`~apex_tpu.serve.megakernel` — the fused per-layer decode block
+  (``ServeConfig(megakernel=...)``): LN + QKV + paged gather-attend +
+  MLP with in-kernel int8 dequant as ONE Pallas kernel per layer,
+  current-token K/V folded in-register, ``gpt_decode_step_fused`` as the
+  drop-in decode program;
 * :mod:`~apex_tpu.serve.sampling` — in-graph greedy/temperature/top-k/
   top-p with request-intrinsic fold_in keys (position-keyed draws make
   speculative verification bitwise-exact);
@@ -60,6 +65,11 @@ from apex_tpu.serve.kv_cache import (  # noqa: F401
     paged_write,
     prefix_block_hashes,
 )
+from apex_tpu.serve.megakernel import (  # noqa: F401
+    fused_layer_decode,
+    gpt_decode_step_fused,
+    megakernel_ok,
+)
 from apex_tpu.serve.sampling import (  # noqa: F401
     SamplingConfig,
     request_key,
@@ -79,8 +89,10 @@ __all__ = [
     "copy_block",
     "decode_flops_per_token",
     "default_bucket_ladder",
+    "fused_layer_decode",
     "gather_kv",
     "gpt_decode_step",
+    "gpt_decode_step_fused",
     "gpt_paged_forward",
     "gpt_prefill",
     "gpt_prefill_chunk",
@@ -90,6 +102,7 @@ __all__ = [
     "kv_cache_bytes",
     "kv_read_bytes",
     "kv_write_bytes_per_token",
+    "megakernel_ok",
     "paged_attention",
     "paged_attention_reference",
     "paged_write",

@@ -208,6 +208,25 @@ def _pallas_ok(head_dim: int, allow_interpret: bool) -> bool:
     return allow_interpret or _compiled_backend()
 
 
+# head_dims whose silent kernel->reference fallback was already logged
+# (warn ONCE per shape: a 10x slower serve run must be diagnosable from
+# the log, not only from the bench line)
+_FALLBACK_WARNED: set = set()
+
+
+def _warn_reference_fallback(head_dim: int) -> None:
+    if head_dim in _FALLBACK_WARNED:
+        return
+    _FALLBACK_WARNED.add(head_dim)
+    from apex_tpu._logging import get_logger
+
+    get_logger("apex_tpu.serve").warning(
+        "paged_attention: head_dim %d %% 8 != 0 — falling back to the "
+        "pure-JAX gather+reference path on a compiled TPU backend "
+        "(expect a much slower decode step; pad head_dim to a multiple "
+        "of 8 to get the Pallas kernel)", head_dim)
+
+
 def paged_attention(q, cache_layer, cfg: KVCacheConfig, block_tables,
                     ctx_lens, scale: Optional[float] = None,
                     use_pallas: Optional[bool] = None,
@@ -220,6 +239,9 @@ def paged_attention(q, cache_layer, cfg: KVCacheConfig, block_tables,
         scale = 1.0 / math.sqrt(q.shape[-1])
     if use_pallas is None:
         use_pallas = _pallas_ok(q.shape[-1], allow_interpret=False)
+        if (not use_pallas and _HAS_PALLAS and _compiled_backend()
+                and q.shape[-1] % 8 != 0):
+            _warn_reference_fallback(q.shape[-1])
     elif use_pallas and not _pallas_ok(q.shape[-1], allow_interpret=True):
         raise ValueError(
             f"pallas paged_attention needs head_dim % 8 == 0 "

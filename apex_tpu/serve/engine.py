@@ -18,7 +18,11 @@ ride the same paged cache:
   (``compile_counts()`` is the gate ``tests/test_serve.py`` pins). The
   MPK argument (arXiv 2512.22219) in scheduler form: decode is
   latency-bound, so the whole step — embed, every layer, paged attention,
-  sampling — is one compiled program, one dispatch.
+  sampling — is one compiled program, one dispatch. With
+  ``ServeConfig.megakernel`` the argument goes one level deeper: each
+  layer's interior (LN + QKV + paged attend + MLP, int8 dequant in
+  kernel) becomes ONE fused Pallas block (``serve.megakernel``), cutting
+  the per-layer op count ~14 -> 2 inside that single program.
 * **prefix caching** — the block allocator is content-addressed
   (``kv_cache.BlockAllocator(prefix_cache=True)``): admission looks up
   the longest cached prefix of the prompt at block granularity and only
@@ -170,6 +174,12 @@ class ServeConfig:
     # step and verify them in one q_len=spec_k+1 call; 0 disables
     spec_k: int = 0
     spec_ngram: int = 3  # n-gram order of the default prompt-lookup drafter
+    # fused per-layer decode megakernel (serve.megakernel): "auto" uses it
+    # when supported AND a compiled Mosaic backend is available, "on"
+    # forces it (interpret mode off-TPU — the parity tests' mode; raises
+    # when the model shape is unsupported), "off" keeps the per-op
+    # gpt_decode_step program
+    megakernel: str = "auto"
     max_context: Optional[int] = None  # default: model cfg.max_seq
     eos_id: Optional[int] = None
     kv_quant: str = "none"  # "none" | "int8" (comm.quantize codec)
@@ -189,6 +199,9 @@ class ServeConfig:
             raise ValueError("spec_k must be >= 0")
         if self.spec_ngram < 1:
             raise ValueError("spec_ngram must be >= 1")
+        if self.megakernel not in ("auto", "on", "off"):
+            raise ValueError(f"megakernel must be 'auto', 'on' or 'off', "
+                             f"got {self.megakernel!r}")
         if self.max_context is not None and self.max_context <= 0:
             raise ValueError("max_context must be positive when given")
         if self.kv_quant not in ("none", "int8"):
@@ -381,7 +394,54 @@ class InferenceEngine:
             x.size for x in jax.tree_util.tree_leaves(params))
         wrap = transform if transform is not None else (lambda f: f)
         self._use_pallas = use_pallas
+        self._megakernel = self._resolve_megakernel()
         self._build_programs(wrap)
+
+    def _resolve_megakernel(self) -> bool:
+        """ServeConfig.megakernel -> whether the decode program is the
+        fused per-layer block. ``auto`` requires a compiled Mosaic backend
+        (the interpreter saves no dispatch); ``on`` forces it and raises
+        on unsupported shapes (TP, MoE, VMEM-oversized layers)."""
+        from apex_tpu.serve.megakernel import megakernel_ok
+
+        mode = self.serve_cfg.megakernel
+        if mode == "off":
+            return False
+        supported = (self._tp_axis is None
+                     and megakernel_ok(self.cfg, self.kv_cfg,
+                                       allow_interpret=(mode == "on")))
+        if mode == "on":
+            if not supported:
+                raise ValueError(
+                    "megakernel='on' but the fused decode block does not "
+                    "support this configuration (TP-sharded programs, MoE, "
+                    "head_dim % 8 != 0, or per-layer weights over the VMEM "
+                    "budget) — use megakernel='off'/'auto'")
+            return True
+        return supported
+
+    @property
+    def megakernel_enabled(self) -> bool:
+        """Whether decode steps run the fused per-layer Pallas block."""
+        return self._megakernel
+
+    @property
+    def decode_kernel(self) -> str:
+        """The decode path this engine actually runs: ``fused`` (the
+        per-layer megakernel), ``pallas`` (gather-attend kernel inside
+        the per-op layer body) or ``reference`` (pure-JAX gather +
+        softmax). Emitted in :meth:`stats` and the bench record so the
+        stage-12 regression gate can tell a kernel FALLBACK from a real
+        regression."""
+        if self._megakernel:
+            return "fused"
+        from apex_tpu.serve.decode import _pallas_ok
+
+        use_pallas = self._use_pallas
+        if use_pallas is None:
+            use_pallas = _pallas_ok(self.cfg.head_dim,
+                                    allow_interpret=False)
+        return "pallas" if use_pallas else "reference"
 
     # -- device mirrors ---------------------------------------------------
     def _dirty(self, *names: str) -> None:
@@ -416,11 +476,21 @@ class InferenceEngine:
                          jnp.reshape(start + n_valid, (1,)), scfg.sampling)
             return cache, tok[0]
 
+        use_mega = self._megakernel
+
         def decode(params, cache, last_tokens, seq_lens, active,
                    block_tables, keys):
-            cache, logits = gpt_decode_step(
-                params, last_tokens, seq_lens, active, cache, block_tables,
-                cfg, kv_cfg, tp_axis=tp_axis, use_pallas=self._use_pallas)
+            if use_mega:
+                from apex_tpu.serve.megakernel import gpt_decode_step_fused
+
+                cache, logits = gpt_decode_step_fused(
+                    params, last_tokens, seq_lens, active, cache,
+                    block_tables, cfg, kv_cfg)
+            else:
+                cache, logits = gpt_decode_step(
+                    params, last_tokens, seq_lens, active, cache,
+                    block_tables, cfg, kv_cfg, tp_axis=tp_axis,
+                    use_pallas=self._use_pallas)
             toks = sample(logits, keys, seq_lens + 1, scfg.sampling)
             # in-graph step metrics: donation-safe, fixed treedef — the
             # monitor.Metrics contract (zero extra compilations)
@@ -1015,6 +1085,8 @@ class InferenceEngine:
             "cached_blocks": self.allocator.cached_count,
             "evictions": self.allocator.blocks_evicted_total,
         }
+        out["megakernel"] = self._megakernel
+        out["decode_kernel"] = self.decode_kernel
         out["prefill"] = {
             "chunk": self.serve_cfg.prefill_chunk,
             "chunks_run": self._chunks_run,

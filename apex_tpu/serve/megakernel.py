@@ -1,0 +1,408 @@
+"""Megakernel decode step — one fused Pallas block per transformer layer.
+
+The MPK observation (arXiv 2512.22219) taken past the scheduler: at
+q_len=1 the decode step's per-op work is tiny — a (slots, hidden) GEMM
+here, a layer norm there — and the compiled program spends its time
+dispatching ~14 XLA ops per layer rather than computing. PR 7 already
+made the whole step ONE program; this module makes each layer's interior
+ONE kernel:
+
+* :func:`fused_layer_decode` — a single ``pallas_call`` per layer fusing
+  **LN1 → QKV projection → paged gather-attend → output projection →
+  residual → LN2 → FC1+gelu → FC2 → residual** over a ``(slots, blocks)``
+  grid. The block tables ride scalar prefetch (the
+  ``decode._paged_pallas`` idiom) so each grid step DMAs exactly the pool
+  block it attends to, dead blocks clamp to the last live block (the
+  repeated fetch is elided), and the int8 KV pools dequantize **in
+  kernel** — codes and scales never round-trip through HBM as fp.
+* the **current token's K/V stay in registers**: the kernel computes them
+  from the QKV GEMM, folds their attention contribution directly into the
+  online-softmax accumulator (at the END of the walk, mirroring the
+  reference's position order), and emits them as outputs — the pool write
+  stays the engine's proven ``paged_write`` ``mode="drop"`` scatter, so
+  there is no in-kernel read-after-write hazard and invalid slots keep
+  the exact masking contract of the unfused path. In the int8 cache the
+  in-register contribution uses the codec's round-trip value
+  (``clip(round(x/scale)) * scale``, scale = absmax/127 per head vector)
+  — bit-for-bit what the unfused path reads back from the pool.
+* :func:`gpt_decode_step_fused` — drop-in replacement for
+  ``decode.gpt_decode_step``: embed, ``lax.scan`` of the fused layer
+  block over the stacked layer params (cache pools riding xs/ys — one
+  compiled fused block regardless of depth), final LN + logits. The
+  per-layer op count drops from ~14 to 2 (fused block + K/V scatter)
+  while ``decode.gpt_paged_forward`` remains the parity oracle
+  (``tests/test_megakernel.py`` pins fp32 agreement and the engine-level
+  greedy/sampled stream equality).
+
+Honest gating: the fused block keeps the layer's full weight set resident
+in VMEM, so :func:`megakernel_ok` refuses configurations whose per-layer
+weights exceed the VMEM budget (GPT-2-124M bf16 at ~14 MB does NOT fit —
+tiling the FFN GEMMs over the grid is the follow-up), MoE layers, and
+tensor-parallel programs (a sharded head set needs the collective exits
+the unfused path provides). ``ServeConfig(megakernel="auto")`` silently
+falls back to the unfused program in those cases; ``"on"`` raises.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.ops._pallas_util import compiled_backend as _compiled_backend
+from apex_tpu.ops._pallas_util import sds as _sds
+from apex_tpu.ops.attention import NEG_INF
+from apex_tpu.serve.kv_cache import KVCacheConfig, paged_write
+
+try:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAS_PALLAS = False
+
+Pytree = Any
+
+from apex_tpu.comm.quantize import QMAX as _QMAX  # the codec's code range:
+# _codec_roundtrip must track comm.quantize bit-for-bit (parity-pinned)
+
+# The fused block holds every weight matrix of the layer in VMEM for the
+# whole grid (constant index maps): qkv (h, 3h) + out (hd, h) + fc1 (h, f)
+# + fc2 (f, h), plus one pool block per pool and the activation scratch.
+# Budget well under the ~16 MB/core so the pool blocks and double-buffered
+# windows still fit.
+_VMEM_BUDGET_BYTES = 10 * 1024 * 1024
+
+
+def layer_weight_bytes(cfg) -> int:
+    """Resident VMEM bytes of one layer's weight set inside the fused
+    block (matrices + bias/norm vectors, in the model dtype)."""
+    h, f = cfg.hidden, cfg.ffn_hidden
+    hd = cfg.num_heads * cfg.head_dim
+    elems = h * 3 * h + hd * h + h * f + f * h  # the four GEMMs
+    # qkv_b (3h) + ln1 w/b (2h) + fc1_b (f) + ln2 w/b (2h) + out_b + fc2_b
+    elems += 3 * h + 2 * h + f + 2 * h + h + h
+    return elems * jnp.dtype(cfg.dtype).itemsize
+
+
+def megakernel_ok(cfg, kv_cfg: KVCacheConfig,
+                  allow_interpret: bool = True) -> bool:
+    """Whether the fused decode block supports this model/cache shape.
+
+    Static gate, no params needed: pallas importable, no MoE, attention
+    heads covering the hidden size (the residual add needs hd == h),
+    head_dim lane-friendly, and the layer's weights within the VMEM
+    budget. ``allow_interpret=False`` additionally requires a compiled
+    Mosaic backend (the ``"auto"`` resolution off-TPU).
+    """
+    if not _HAS_PALLAS:
+        return False
+    if cfg.num_experts:
+        return False
+    if cfg.num_heads * cfg.head_dim != cfg.hidden:
+        return False
+    if kv_cfg.head_dim != cfg.head_dim or kv_cfg.head_dim % 8 != 0:
+        return False
+    if layer_weight_bytes(cfg) > _VMEM_BUDGET_BYTES:
+        return False
+    return allow_interpret or _compiled_backend()
+
+
+# ---------------------------------------------------------------------------
+# The fused layer kernel. Grid (slots, blocks): j walks slot i's block
+# table exactly like decode._paged_kernel; the layer compute hangs off the
+# walk's endpoints — QKV at j == 0 (filling the q/k/v scratch and the K/V
+# outputs), the current-token softmax fold + out-proj + MLP at j == nb-1.
+
+
+def _ln_rows(x, w, b, eps):
+    """fp32 layer norm over the last axis — the ``layer_norm_reference``
+    math (E[x²]−E[x]² with the cancellation clamp) inlined so the fused
+    block and the unfused path normalize identically."""
+    n = x.shape[-1]
+    mean = jnp.sum(x, axis=-1, keepdims=True) / n
+    msq = jnp.sum(x * x, axis=-1, keepdims=True) / n
+    var = jnp.maximum(msq - mean * mean, 0.0)
+    y = (x - mean) * lax.rsqrt(var + eps)
+    return y * w + b
+
+
+def _codec_roundtrip(x):
+    """comm.quantize blockwise codec round-trip at codec-block = head_dim:
+    what the unfused path reads back from an int8 pool. (H, D) fp32 in
+    and out. The pool write outside re-quantizes the RAW values through
+    the same deterministic codec, so the codes it stores match this
+    round-trip bit-for-bit."""
+    amax = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
+    scale = jnp.where(amax > 0, amax / _QMAX, 1.0)
+    q = jnp.clip(jnp.round(x / scale), -_QMAX, _QMAX)
+    return q * scale
+
+
+def _fused_layer_kernel(bt_ref, len_ref, x_ref, ln1w_ref, ln1b_ref,
+                        qkvk_ref, qkvb_ref, outk_ref, outb_ref,
+                        ln2w_ref, ln2b_ref, fc1k_ref, fc1b_ref,
+                        fc2k_ref, fc2b_ref, k_ref, v_ref, *refs,
+                        scale, block_size, nb, heads, head_dim,
+                        quantized, pool_dtype, eps):
+    if quantized:
+        (ks_ref, vs_ref, xo_ref, ko_ref, vo_ref,
+         q_scr, kc_scr, vc_scr, m_scr, l_scr, acc_scr) = refs
+    else:
+        (xo_ref, ko_ref, vo_ref,
+         q_scr, kc_scr, vc_scr, m_scr, l_scr, acc_scr) = refs
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+    ctx = len_ref[i]  # OLD tokens in the pool (current token is in-register)
+
+    @pl.when(j == 0)
+    def _qkv():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+        x = x_ref[:].astype(jnp.float32)                      # (1, h)
+        h1 = _ln_rows(x, ln1w_ref[:].astype(jnp.float32),
+                      ln1b_ref[:].astype(jnp.float32), eps)
+        h1 = h1.astype(x_ref.dtype)
+        qkv = jnp.dot(h1, qkvk_ref[:],
+                      preferred_element_type=jnp.float32)
+        qkv = qkv + qkvb_ref[:].astype(jnp.float32)           # (1, 3h)
+        # per-head interleaved unpack (the standalone_gpt packing):
+        # row-major (1, 3h) -> (H, 3, D)
+        hqkv = qkv.reshape(heads, 3, head_dim)
+        qh, kh, vh = hqkv[:, 0], hqkv[:, 1], hqkv[:, 2]       # (H, D) f32
+        q_scr[:] = qh
+        # the EMITTED values (model dtype) are what paged_write consumes —
+        # the in-register fold must round-trip through that cast first,
+        # or a bf16 model's codec scales/codes diverge from the pool's
+        kq = kh.astype(ko_ref.dtype)
+        vq = vh.astype(vo_ref.dtype)
+        ko_ref[0] = kq
+        vo_ref[0] = vq
+        # what the pool hands back for this token: the codec round-trip
+        # (int8 cache) or the pool-dtype cast (fp cache)
+        if quantized:
+            kc_scr[:] = _codec_roundtrip(kq.astype(jnp.float32))
+            vc_scr[:] = _codec_roundtrip(vq.astype(jnp.float32))
+        else:
+            kc_scr[:] = kq.astype(pool_dtype).astype(jnp.float32)
+            vc_scr[:] = vq.astype(pool_dtype).astype(jnp.float32)
+
+    @pl.when(j * block_size < ctx)
+    def _attend_block():
+        q = q_scr[:]                      # (H, D)
+        k = k_ref[:, 0]                   # (H, bs, D)
+        v = v_ref[:, 0]
+        if quantized:
+            k = k.astype(jnp.float32) * ks_ref[:, 0][..., None]
+            v = v.astype(jnp.float32) * vs_ref[:, 0][..., None]
+        s = lax.dot_general(
+            q, k, (((1,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32) * scale       # (H, bs)
+        kpos = j * block_size + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(kpos >= ctx, NEG_INF, s)
+        m_prev = m_scr[:, :1]
+        l_prev = l_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        corr = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = corr * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * corr + lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(j == nb - 1)
+    def _finish_layer():
+        # fold the current token in LAST — its position is the end of the
+        # context, so the online softmax visits scores in reference order
+        q = q_scr[:]
+        kc = kc_scr[:]
+        vc = vc_scr[:]
+        s_cur = jnp.sum(q * kc, axis=1, keepdims=True) * scale  # (H, 1)
+        m_prev = m_scr[:, :1]
+        l_prev = l_scr[:, :1]
+        m_new = jnp.maximum(m_prev, s_cur)
+        corr = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s_cur - m_new)                               # (H, 1)
+        l_new = corr * l_prev + p
+        acc = acc_scr[:] * corr + p * vc                         # (H, D)
+        ctx_vec = acc / l_new                                    # l_new >= p > 0
+        ctx_row = ctx_vec.reshape(1, heads * head_dim)
+        ctx_row = ctx_row.astype(x_ref.dtype)
+        a = jnp.dot(ctx_row, outk_ref[:],
+                    preferred_element_type=jnp.float32)
+        a = a + outb_ref[:].astype(jnp.float32)
+        x1 = x_ref[:].astype(jnp.float32) + a                    # (1, h)
+        h2 = _ln_rows(x1, ln2w_ref[:].astype(jnp.float32),
+                      ln2b_ref[:].astype(jnp.float32), eps)
+        h2 = h2.astype(x_ref.dtype)
+        y = jnp.dot(h2, fc1k_ref[:],
+                    preferred_element_type=jnp.float32)
+        y = jax.nn.gelu(y + fc1b_ref[:].astype(jnp.float32),
+                        approximate=True)
+        y = y.astype(x_ref.dtype)
+        m_out = jnp.dot(y, fc2k_ref[:],
+                        preferred_element_type=jnp.float32)
+        m_out = m_out + fc2b_ref[:].astype(jnp.float32)
+        xo_ref[:] = (x1 + m_out).astype(xo_ref.dtype)
+
+
+def fused_layer_decode(x, layer_params, cache_layer, cfg,
+                       kv_cfg: KVCacheConfig, block_tables, ctx_lens,
+                       interpret: Optional[bool] = None
+                       ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One transformer layer of the decode step as ONE fused Pallas block.
+
+    ``x``: (n, hidden) residual-stream rows, one per slot. ``ctx_lens``:
+    (n,) OLD tokens cached per slot (0 for inactive slots — the kernel
+    then skips every pool block and produces finite junk from the
+    in-register current token alone). Returns ``(x', k_new, v_new)`` with
+    ``k_new``/``v_new`` (n, H, D) in the model dtype — the caller scatters
+    them via ``paged_write`` (masking invalid slots exactly like the
+    unfused path).
+    """
+    n, h = x.shape
+    heads, d = kv_cfg.num_heads, kv_cfg.head_dim
+    nb = block_tables.shape[1]
+    bs = kv_cfg.block_size
+    f = cfg.ffn_hidden
+    if interpret is None:
+        interpret = not _compiled_backend()
+    lp = layer_params
+    bt_flat = block_tables.reshape(-1).astype(jnp.int32)
+    lens = ctx_lens.astype(jnp.int32)
+    att_scale = 1.0 / math.sqrt(d)
+
+    def row(i, j, bt, ln):       # per-slot activation rows
+        return (i, 0)
+
+    def const2(i, j, bt, ln):    # weights resident across the whole grid
+        return (0, 0)
+
+    def blk_index(i, j, bt, ln):
+        # dead steps clamp at the last live block — the repeated index
+        # elides the DMA (decode._paged_pallas idiom); ctx==0 stays in
+        # range via the max()
+        jl = jnp.maximum(ln[i] - 1, 0) // bs
+        return (0, bt[i * nb + jnp.minimum(j, jl)], 0, 0)
+
+    def blk_index_s(i, j, bt, ln):
+        jl = jnp.maximum(ln[i] - 1, 0) // bs
+        return (0, bt[i * nb + jnp.minimum(j, jl)], 0)
+
+    in_specs = [
+        pl.BlockSpec((1, h), row),                 # x
+        pl.BlockSpec((1, h), const2),              # ln1_w
+        pl.BlockSpec((1, h), const2),              # ln1_b
+        pl.BlockSpec((h, 3 * h), const2),          # qkv_kernel
+        pl.BlockSpec((1, 3 * h), const2),          # qkv_bias
+        pl.BlockSpec((heads * d, h), const2),      # out_kernel
+        pl.BlockSpec((1, h), const2),              # out_bias
+        pl.BlockSpec((1, h), const2),              # ln2_w
+        pl.BlockSpec((1, h), const2),              # ln2_b
+        pl.BlockSpec((h, f), const2),              # fc1_kernel
+        pl.BlockSpec((1, f), const2),              # fc1_bias
+        pl.BlockSpec((f, h), const2),              # fc2_kernel
+        pl.BlockSpec((1, h), const2),              # fc2_bias
+        pl.BlockSpec((heads, 1, bs, d), blk_index),   # k pool
+        pl.BlockSpec((heads, 1, bs, d), blk_index),   # v pool
+    ]
+    vec = lambda a: a.reshape(1, -1)
+    inputs = [
+        x,
+        vec(lp["ln1_w"]), vec(lp["ln1_b"]),
+        lp["qkv_kernel"], vec(lp["qkv_bias"]),
+        lp["out_kernel"], vec(lp["out_bias"]),
+        vec(lp["ln2_w"]), vec(lp["ln2_b"]),
+        lp["fc1_kernel"], vec(lp["fc1_bias"]),
+        lp["fc2_kernel"], vec(lp["fc2_bias"]),
+        cache_layer["k"], cache_layer["v"],
+    ]
+    if kv_cfg.quantized:
+        in_specs += [pl.BlockSpec((heads, 1, bs), blk_index_s),
+                     pl.BlockSpec((heads, 1, bs), blk_index_s)]
+        inputs += [cache_layer["k_scale"], cache_layer["v_scale"]]
+    kernel = functools.partial(
+        _fused_layer_kernel, scale=att_scale, block_size=bs, nb=nb,
+        heads=heads, head_dim=d, quantized=kv_cfg.quantized,
+        pool_dtype=kv_cfg.dtype, eps=1e-5)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(n, nb),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, h), row),
+            pl.BlockSpec((1, heads, d), lambda i, j, bt, ln: (i, 0, 0)),
+            pl.BlockSpec((1, heads, d), lambda i, j, bt, ln: (i, 0, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((heads, d), jnp.float32),    # q
+            pltpu.VMEM((heads, d), jnp.float32),    # current-token K
+            pltpu.VMEM((heads, d), jnp.float32),    # current-token V
+            pltpu.VMEM((heads, 128), jnp.float32),  # online-softmax m
+            pltpu.VMEM((heads, 128), jnp.float32),  # online-softmax l
+            pltpu.VMEM((heads, d), jnp.float32),    # acc
+        ],
+    )
+    x_new, k_new, v_new = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            _sds((n, h), x.dtype, x),
+            _sds((n, heads, d), x.dtype, x),
+            _sds((n, heads, d), x.dtype, x),
+        ],
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(bt_flat, lens, *inputs)
+    return x_new, k_new, v_new
+
+
+# ---------------------------------------------------------------------------
+# The fused decode step: embed + scan(fused layer block + K/V scatter) +
+# final LN/logits. Signature mirrors decode.gpt_decode_step (minus TP,
+# which the megakernel refuses) so the engine swaps programs freely.
+
+
+def gpt_decode_step_fused(params, last_tokens, seq_lens, active, cache,
+                          block_tables, cfg, kv_cfg: KVCacheConfig,
+                          interpret: Optional[bool] = None
+                          ) -> Tuple[Pytree, jnp.ndarray]:
+    """Advance every active slot by one token with the fused per-layer
+    block. Bit-compatible contract with ``decode.gpt_decode_step``
+    (q=1, ``tp_axis=None``): same cache-write masking, same junk-logits
+    behavior for inactive slots; logits agree within fp32 tolerance
+    (``tests/test_megakernel.py`` pins it, plus engine-level greedy and
+    same-key sampled stream equality)."""
+    from apex_tpu.serve.decode import _check_serve_cfg, _embed, serve_logits
+
+    _check_serve_cfg(cfg, kv_cfg, None)
+    if not megakernel_ok(cfg, kv_cfg, allow_interpret=True):
+        raise ValueError(
+            "megakernel unsupported for this config (MoE, hd != hidden, "
+            "head_dim % 8, or per-layer weights over the VMEM budget) — "
+            "use decode.gpt_decode_step")
+    positions = jnp.minimum(seq_lens, cfg.max_seq - 1)
+    x = _embed(params["embed"], last_tokens, positions, None)   # (n, h)
+    ctx_old = jnp.where(active, seq_lens, 0).astype(jnp.int32)
+
+    def body(x, xs):
+        lp, cl = xs
+        x, k_new, v_new = fused_layer_decode(
+            x, lp, cl, cfg, kv_cfg, block_tables, ctx_old,
+            interpret=interpret)
+        cl = paged_write(cl, kv_cfg, k_new.transpose(1, 0, 2),
+                         v_new.transpose(1, 0, 2), block_tables,
+                         seq_lens, active)
+        return x, cl
+
+    x, cache = lax.scan(body, x, (params["layers"], cache))
+    return cache, serve_logits(params, x, cfg, None)

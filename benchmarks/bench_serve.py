@@ -16,6 +16,17 @@ until a multi-chip window, exactly like ``bench_overlap.py``.
 Run: ``python benchmarks/bench_serve.py [--out FILE]``. Staged as
 ``tpu_watch.sh`` stage 9 (hourly retry until banked).
 
+``--megakernel {auto,on,off}`` selects the fused per-layer decode block
+(``serve.megakernel``; the record's ``decode_kernel`` field says which
+path actually served). ``--megakernel-ab`` runs the SAME workload twice —
+megakernel on, then off — and emits one A/B record whose headline fields
+come from the fused side (watcher stage 12, ``DECODE_FUSED_TPU.json``,
+regression-gated like stages 10/11). The A/B is a TPU measurement: on
+CPU the fused block only exists in interpret mode (a simulator, not a
+perf number), so the record honestly says ``megakernel_ab: needs a
+chip`` and carries the per-op-path numbers under the ``_CPU_FALLBACK``
+metric suffix.
+
 ``--loadgen`` switches to the monitor-tier-2 goodput-under-SLO bench:
 ``benchmarks/loadgen.py`` drives the engine with a seeded Poisson+burst
 workload and the line becomes goodput req/s + TTFT/TPOT p50/p99 from the
@@ -75,9 +86,21 @@ def main() -> int:
     ap.add_argument("--kv-quant", default="none", choices=["none", "int8"])
     ap.add_argument("--spec-k", type=int, default=0,
                     help="speculative draft length (0: off)")
+    ap.add_argument("--megakernel", default="auto",
+                    choices=["auto", "on", "off"],
+                    help="fused per-layer decode block (serve.megakernel)")
+    ap.add_argument("--megakernel-ab", action="store_true",
+                    help="run the workload megakernel-on AND -off, emit "
+                         "one A/B record (watcher stage 12)")
     ap.add_argument("--loadgen", action="store_true",
                     help="run the goodput-under-SLO loadgen bench instead")
     args, extra = ap.parse_known_args()
+    if args.megakernel_ab and args.loadgen:
+        ap.error("--megakernel-ab runs the fixed A/B workload; it cannot "
+                 "be combined with --loadgen")
+    if args.megakernel_ab and args.megakernel == "off":
+        ap.error("--megakernel-ab measures the fused side; "
+                 "--megakernel off contradicts it")
 
     if args.loadgen:
         # the tier-2 record: loadgen drives the engine, SLO accounting
@@ -86,14 +109,16 @@ def main() -> int:
         from loadgen import main as loadgen_main
 
         fwd = list(extra) + ["--kv-quant", args.kv_quant,
-                             "--spec-k", str(args.spec_k)]
+                             "--spec-k", str(args.spec_k),
+                             "--megakernel", args.megakernel]
         if args.out:
             fwd += ["--out", args.out]
         return loadgen_main(fwd)
     if extra:
         ap.error(f"unrecognized arguments: {' '.join(extra)}")
 
-    name = "gpt_serve_engine"
+    name = ("gpt_serve_decode_fused_ab" if args.megakernel_ab
+            else "gpt_serve_engine")
     if not ON_TPU:
         name += "_CPU_FALLBACK"
 
@@ -102,51 +127,88 @@ def main() -> int:
                     dtype=jnp.bfloat16 if ON_TPU else jnp.float32)
     params = init_gpt_params(jax.random.PRNGKey(0), cfg)
     rng = np.random.default_rng(0)
-    requests = [
-        Request(f"r{i}", rng.integers(0, VOCAB, size=p).tolist(),
-                max_new_tokens=MAX_NEW)
-        for i, p in enumerate(PROMPT_LENS)
-    ]
+    prompts = [rng.integers(0, VOCAB, size=p).tolist() for p in PROMPT_LENS]
 
-    step_log = os.path.join(tempfile.mkdtemp(), "serve_steps.jsonl")
-    with JsonlSink(step_log, buffer_steps=1) as sink:
-        eng = InferenceEngine(
-            params, cfg,
-            ServeConfig(num_slots=SLOTS, block_size=BLOCK_SIZE,
-                        kv_quant=args.kv_quant,
-                        prefill_chunk=PREFILL_CHUNK, spec_k=args.spec_k),
-            sink=sink)
-        out = eng.run(requests)
-        tokens_per_s = eng.throughput()
-        stats = eng.stats()  # TTFT/step quantiles from the streaming hists
-        kv_budget = eng.kv_budget_bytes()
-        compiles = eng.compile_counts()
-    steps = [r for r in read_jsonl(step_log)
-             if r.get("phase") == "decode"]
-    gen_tokens = sum(len(v) for v in out.values())
+    def run_engine(megakernel):
+        """One full workload pass -> (measurement sub-record, streams);
+        fresh Request objects each pass (the engine consumes them)."""
+        requests = [Request(f"r{i}", list(p), max_new_tokens=MAX_NEW)
+                    for i, p in enumerate(prompts)]
+        step_log = os.path.join(tempfile.mkdtemp(), "serve_steps.jsonl")
+        with JsonlSink(step_log, buffer_steps=1) as sink:
+            eng = InferenceEngine(
+                params, cfg,
+                ServeConfig(num_slots=SLOTS, block_size=BLOCK_SIZE,
+                            kv_quant=args.kv_quant,
+                            prefill_chunk=PREFILL_CHUNK,
+                            spec_k=args.spec_k, megakernel=megakernel),
+                sink=sink)
+            out = eng.run(requests)
+            tokens_per_s = eng.throughput()
+            stats = eng.stats()  # quantiles from the streaming hists
+            kv_budget = eng.kv_budget_bytes()
+            compiles = eng.compile_counts()
+        steps = [r for r in read_jsonl(step_log)
+                 if r.get("phase") == "decode"]
+        return {
+            "ok": len(out) == len(requests),
+            # which decode path actually served (fused|pallas|reference):
+            # lets the stage-12 gate tell a kernel fallback from a real
+            # regression
+            "decode_kernel": stats.get("decode_kernel"),
+            "tokens_per_s": round(tokens_per_s, 3) if tokens_per_s
+            else None,
+            "generated_tokens": sum(len(v) for v in out.values()),
+            "ttft_ms_p50": stats.get("ttft_ms_p50"),
+            "ttft_ms_p99": stats.get("ttft_ms_p99"),
+            "tpot_ms_p50": stats.get("tpot_ms_p50"),
+            "decode_step_ms_p50": stats.get("decode_step_ms_p50"),
+            "decode_step_ms_p99": stats.get("decode_step_ms_p99"),
+            "mean_occupancy": round(
+                statistics.fmean(r["occupancy"] for r in steps), 4)
+            if steps else None,
+            "kv_cache_budget_bytes": kv_budget,
+            "kv_read_bytes_peak": max((r["kv_read_bytes"] for r in steps),
+                                      default=None),
+            # the tightened compile gate: 1 chunked prefill + 1 decode
+            # (+ <= 1 verify when speculation is on) — no bucket ladder
+            "compilations": compiles,
+            "prefix_hit_rate": stats.get("prefix_hit_rate"),
+            "spec_acceptance_rate": stats.get("spec_acceptance_rate"),
+        }, out
 
-    rec = {
-        "metric": name,
-        "ok": len(out) == len(requests),
-        "tokens_per_s": round(tokens_per_s, 3) if tokens_per_s else None,
-        "generated_tokens": gen_tokens,
-        "ttft_ms_p50": stats.get("ttft_ms_p50"),
-        "ttft_ms_p99": stats.get("ttft_ms_p99"),
-        "tpot_ms_p50": stats.get("tpot_ms_p50"),
-        "decode_step_ms_p50": stats.get("decode_step_ms_p50"),
-        "mean_occupancy": round(
-            statistics.fmean(r["occupancy"] for r in steps), 4)
-        if steps else None,
-        "kv_cache_budget_bytes": kv_budget,
-        "kv_read_bytes_peak": max((r["kv_read_bytes"] for r in steps),
-                                  default=None),
+    # the headline run; in A/B mode the fused side is the headline (what
+    # stage 12 regression-tracks), forced on only where it is a real
+    # measurement (compiled Mosaic, not the interpreter)
+    mega = args.megakernel
+    if args.megakernel_ab:
+        mega = "on" if ON_TPU else "auto"
+    head, out = run_engine(mega)
+
+    rec = {"metric": name, **head}
+    if args.megakernel_ab:
+        if ON_TPU:
+            # same workload, per-op layer body: the denominator. Streams
+            # must be EQUAL (the parity oracle) — a divergence means the
+            # A/B measured different work, so it FAILS the bench (ok:
+            # false + exit 1; the stage-12 gate additionally refuses to
+            # promote a record whose streams diverged).
+            base, out_off = run_engine("off")
+            rec["megakernel_ab"] = {"fused_on": head, "fused_off": base}
+            rec["streams_equal"] = out == out_off
+            rec["ok"] = bool(rec["ok"] and base["ok"]
+                             and rec["streams_equal"])
+            p_on, p_off = (head.get("decode_step_ms_p50"),
+                           base.get("decode_step_ms_p50"))
+            rec["decode_step_speedup_p50"] = (
+                round(p_off / p_on, 4) if p_on and p_off else None)
+        else:
+            # off-chip the fused block is interpret mode — a simulator,
+            # not a measurement (the stage-12 gate never promotes this)
+            rec["megakernel_ab"] = "needs a chip"
+    rec.update({
         "kv_quant": args.kv_quant,
-        # the tightened compile gate: 1 chunked prefill + 1 decode
-        # (+ <= 1 verify when speculation is on) — no bucket ladder
-        "compilations": compiles,
         "prefill_chunk": PREFILL_CHUNK,
-        "prefix_hit_rate": stats.get("prefix_hit_rate"),
-        "spec_acceptance_rate": stats.get("spec_acceptance_rate"),
         "spec_k": args.spec_k,
         # the TP-sharded serving path (sharded heads, gathered logits)
         # needs a multi-chip slice; a single chip has nothing to shard
@@ -155,15 +217,18 @@ def main() -> int:
         "config": {"hidden": HIDDEN, "layers": LAYERS, "heads": HEADS,
                    "vocab": VOCAB, "slots": SLOTS,
                    "block_size": BLOCK_SIZE, "max_new": MAX_NEW,
-                   "prompts": list(PROMPT_LENS)},
+                   "prompts": list(PROMPT_LENS),
+                   "megakernel": mega},  # the mode actually run
         "backend": jax.default_backend(),
-    }
+    })
     line = json_record(**rec)
     print(line, flush=True)
     if args.out:
         with open(args.out, "w") as f:
             f.write(line + "\n")
-    return 0
+    # ok:false (e.g. A/B stream divergence) is a bench FAILURE, not a
+    # slow record — the exit code is the first gate stage 12 sees
+    return 0 if rec.get("ok", True) else 1
 
 
 if __name__ == "__main__":

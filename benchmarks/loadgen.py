@@ -263,6 +263,9 @@ def main(argv=None) -> int:
     ap.add_argument("--spec-k", type=int, default=0,
                     help="speculative draft length (0: off)")
     ap.add_argument("--prefill-chunk", type=int, default=32)
+    ap.add_argument("--megakernel", default="auto",
+                    choices=["auto", "on", "off"],
+                    help="fused per-layer decode block (serve.megakernel)")
     ap.add_argument("--no-prefix-cache", action="store_true",
                     help="disable content-addressed block reuse")
     args = ap.parse_args(argv)
@@ -304,7 +307,7 @@ def main(argv=None) -> int:
                     kv_quant=args.kv_quant,
                     prefill_chunk=args.prefill_chunk,
                     prefix_cache=not args.no_prefix_cache,
-                    spec_k=args.spec_k),
+                    spec_k=args.spec_k, megakernel=args.megakernel),
         events=events, slo=slo, retain_streams=False)
     stats = run_workload(eng, workload)
     if sink is not None:
@@ -334,6 +337,7 @@ def main(argv=None) -> int:
         "spec_acceptance_rate": stats.get("spec_acceptance_rate"),
         "speculative": stats.get("speculative"),
         "prefill": stats.get("prefill"),
+        "megakernel": stats.get("megakernel"),
         "compilations": eng.compile_counts(),
         "slo": slo.to_dict(),
         "hist_rel_error": round(eng.hists["ttft_ms"].spec.rel_error, 4),

@@ -23,8 +23,11 @@
 #  10 serve SLO       bench_serve.py --loadgen -> SERVE_SLO_TPU.json
 #  11 serve prefix    bench_serve.py --loadgen --prefix-pool --spec-k
 #                                           -> SERVE_PREFIX_TPU.json
+#  12 decode fused A/B bench_serve.py --megakernel-ab --spec-k 4
+#                                           -> DECODE_FUSED_TPU.json
+#  13 fused update    bench_fused_update.py -> FUSED_UPDATE_TPU.json
 # After the first seven, later healthy probes only refresh stage 1+3
-# (hourly) so the banked number tracks the latest code; stages 8-11
+# (hourly) so the banked number tracks the latest code; stages 8-13
 # ride the same hourly cadence until banked (additive evidence that must
 # never hold the suite out of refresh mode).
 cd /root/repo || exit 1
@@ -38,6 +41,8 @@ last_overlap=-3600  # stage-8 (overlap A/B) same hourly retry contract
 last_serve=-3600    # stage-9 (serve engine) same hourly retry contract
 last_slo=-3600      # stage-10 (serve goodput-SLO) same hourly contract
 last_prefix=-3600   # stage-11 (shared-prefix + speculative) same contract
+last_mega=-3600     # stage-12 (megakernel decode A/B) same contract
+last_fusedupd=-3600 # stage-13 (fused update tail) same contract
 
 note() { echo "$(date '+%F %T') $*" >> "$LOG"; }
 
@@ -246,6 +251,82 @@ $(cat /tmp/tpu_stage11_regress.out)"
   return 0
 }
 
+mega_stage() {
+  # stage 12: megakernel decode A/B — the stage-9 serve workload run
+  # fused-on AND fused-off in one record (decode_step_ms p50/p99 both
+  # sides, speedup, stream-equality assertion, spec-k 4 so the verify
+  # interplay is in the measurement). The fused-on decode-step p50 vs
+  # fused-off is THE megakernel headline (ROADMAP item 4). Promotion is
+  # REGRESSION-GATED via monitor.regress exactly like stages 10/11; CPU
+  # rehearsals (interpret-mode Pallas) never promote.
+  note "STAGE12 START: bench_serve.py --megakernel-ab --spec-k 4"
+  rm -f /tmp/decode_fused_try.json
+  timeout 1800 python benchmarks/bench_serve.py --megakernel-ab \
+    --spec-k 4 --out /tmp/decode_fused_try.json \
+    > /tmp/tpu_stage12.out 2> /tmp/tpu_stage12.err
+  local rc=$?
+  note "STAGE12 EXIT=$rc"
+  [ -s /tmp/decode_fused_try.json ] || return 1
+  if grep -q CPU_FALLBACK /tmp/decode_fused_try.json; then
+    note "STAGE12 got CPU_FALLBACK, not promoting"
+    return 1
+  fi
+  # a diverged or failed A/B is a correctness failure, never a baseline
+  # (monitor.regress only compares numeric fields, so gate it here)
+  if grep -Eq '"(streams_equal|ok)": false' /tmp/decode_fused_try.json; then
+    note "STAGE12 record has ok/streams_equal false, not promoting"
+    return 1
+  fi
+  if [ -s DECODE_FUSED_TPU.json ]; then
+    if ! python -m apex_tpu.monitor.regress DECODE_FUSED_TPU.json \
+        /tmp/decode_fused_try.json --tol 0.15 \
+        > /tmp/tpu_stage12_regress.out 2>> /tmp/tpu_stage12.err; then
+      note "STAGE12 REGRESSION vs banked, keeping banked record: \
+$(cat /tmp/tpu_stage12_regress.out)"
+      return 1
+    fi
+  fi
+  cp /tmp/decode_fused_try.json DECODE_FUSED_TPU.json
+  note "STAGE12 PROMOTED $(cat DECODE_FUSED_TPU.json)"
+  [ $rc -eq 0 ] || return 1
+  # advance only from exactly 11 (same reasoning as stage 9's 8-gate)
+  [ "$(cat "$STATE")" -eq 11 ] && echo 12 > "$STATE"
+  return 0
+}
+
+fusedupd_stage() {
+  # stage 13: fused optimizer update tail A/B (ops/fused_update.py) —
+  # ref_ms vs fused_ms over GPT-2-124M ZeRO dp=8 shards. Same promote
+  # rules: CPU rehearsals (interpret mode, honest _CPU_FALLBACK suffix)
+  # never promote; regression-gated once banked.
+  note "STAGE13 START: bench_fused_update.py"
+  rm -f /tmp/fused_update_try.json
+  timeout 1200 python benchmarks/bench_fused_update.py \
+    --out /tmp/fused_update_try.json \
+    > /tmp/tpu_stage13.out 2> /tmp/tpu_stage13.err
+  local rc=$?
+  note "STAGE13 EXIT=$rc"
+  [ -s /tmp/fused_update_try.json ] || return 1
+  if grep -q CPU_FALLBACK /tmp/fused_update_try.json; then
+    note "STAGE13 got CPU_FALLBACK, not promoting"
+    return 1
+  fi
+  if [ -s FUSED_UPDATE_TPU.json ]; then
+    if ! python -m apex_tpu.monitor.regress FUSED_UPDATE_TPU.json \
+        /tmp/fused_update_try.json --tol 0.15 \
+        > /tmp/tpu_stage13_regress.out 2>> /tmp/tpu_stage13.err; then
+      note "STAGE13 REGRESSION vs banked, keeping banked record: \
+$(cat /tmp/tpu_stage13_regress.out)"
+      return 1
+    fi
+  fi
+  cp /tmp/fused_update_try.json FUSED_UPDATE_TPU.json
+  note "STAGE13 PROMOTED $(cat FUSED_UPDATE_TPU.json)"
+  [ $rc -eq 0 ] || return 1
+  [ "$(cat "$STATE")" -eq 12 ] && echo 13 > "$STATE"
+  return 0
+}
+
 smoke_stage() {
   # Smoke to a temp file; promote ANY real-TPU artifact (a failing kernel
   # on the chip is exactly the evidence we must bank) but never a CPU
@@ -317,6 +398,18 @@ while true; do
           prefix_stage
           last_prefix=$now
         fi
+        # stage 12 (megakernel decode A/B): same hourly re-measure-after-
+        # banked contract — a fused decode-step regression must surface
+        # within an hour
+        if [ $((now - last_mega)) -ge 3600 ]; then
+          mega_stage
+          last_mega=$now
+        fi
+        # stage 13 (fused optimizer update tail): same contract
+        if [ $((now - last_fusedupd)) -ge 3600 ]; then
+          fusedupd_stage
+          last_fusedupd=$now
+        fi
         last_refresh=$now
       fi
     else
@@ -372,6 +465,19 @@ while true; do
           && [ $((now - last_prefix)) -ge 3600 ]; then
         prefix_stage
         last_prefix=$now
+      fi
+      # stage 12: megakernel decode A/B (serve bench with the fused
+      # per-layer block forced on), regression-gated like stages 10/11.
+      if [ "$(cat "$STATE")" -eq 11 ] \
+          && [ $((now - last_mega)) -ge 3600 ]; then
+        mega_stage
+        last_mega=$now
+      fi
+      # stage 13: fused optimizer update tail A/B, same contract.
+      if [ "$(cat "$STATE")" -eq 12 ] \
+          && [ $((now - last_fusedupd)) -ge 3600 ]; then
+        fusedupd_stage
+        last_fusedupd=$now
       fi
       last_refresh=$now
     fi

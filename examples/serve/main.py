@@ -66,6 +66,10 @@ def parse_args(argv=None):
     ap.add_argument("--spec-k", type=int, default=0,
                     help="speculative draft length (0 = off; n-gram "
                          "prompt-lookup drafter)")
+    ap.add_argument("--megakernel", default="auto",
+                    choices=["auto", "on", "off"],
+                    help="fused per-layer decode block (auto = only on "
+                         "compiled TPU backends)")
     ap.add_argument("--temperature", type=float, default=0.0,
                     help="0 = greedy")
     ap.add_argument("--top-k", type=int, default=0)
@@ -98,6 +102,7 @@ def main(argv=None) -> int:
         num_slots=args.num_slots, block_size=args.block_size,
         kv_quant=args.kv_quant, prefill_chunk=args.prefill_chunk,
         prefix_cache=not args.no_prefix_cache, spec_k=args.spec_k,
+        megakernel=args.megakernel,
         sampling=SamplingConfig(temperature=args.temperature,
                                 top_k=args.top_k, top_p=args.top_p))
     template = init_gpt_params(jax.random.PRNGKey(0), cfg)
@@ -132,7 +137,8 @@ def main(argv=None) -> int:
               f"{stats['ttft_ms_p99']:.1f} ms | "
               f"kv budget: {engine.kv_budget_bytes() / 1e6:.1f} MB | "
               f"compilations: {engine.compile_counts()} "
-              f"(prefill chunk: {args.prefill_chunk})")
+              f"(prefill chunk: {args.prefill_chunk}, megakernel: "
+              f"{'on' if engine.megakernel_enabled else 'off'})")
         pc = stats["prefix_cache"]
         if pc["blocks_needed"]:
             print(f"prefix cache: {pc['blocks_hit']}/"

@@ -175,3 +175,40 @@ def test_dist_adam_e5m2_allgather():
         np.testing.assert_allclose(a, b, rtol=0.25, atol=1e-6,
                                    err_msg=f"params {k}")
         assert np.any(a != b), "compression should actually round something"
+
+
+MESH_OK = hasattr(jax, "shard_map") and hasattr(jax.lax, "axis_size")
+
+
+@pytest.mark.skipif(not MESH_OK,
+                    reason="needs graft jax (jax.shard_map + lax.axis_size)")
+@pytest.mark.parametrize("cls_name", ["adam", "lamb"])
+def test_zero_fused_update_matches_unfused(cls_name):
+    """fused_update='on' (the ops/fused_update.py Pallas tail) produces
+    the same parameters as the per-op chain — the megakernel-PR gate for
+    the ZeRO update tail. Tolerance is fp reassociation noise only."""
+    params, grads = _params_grads(jax.random.PRNGKey(3))
+    mesh = build_mesh(tp=1, pp=1, sp=1)  # dp=8
+
+    def run(mode):
+        cls = (DistributedFusedAdam if cls_name == "adam"
+               else DistributedFusedLAMB)
+        opt = cls(lr=1e-2, weight_decay=0.01, fused_update=mode)
+
+        def body(p, g):
+            state = opt.init(p)
+            for _ in range(3):
+                p, state = opt.step(g, state, p)
+            return p
+
+        return jax.jit(jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(jax.tree.map(lambda _: P(), params),) * 2,
+            out_specs=jax.tree.map(lambda _: P(), params),
+            check_vma=False,
+        ))(params, grads)
+
+    got, want = run("on"), run("off")
+    for k in ("w", "b"):
+        np.testing.assert_allclose(np.asarray(got[k]), np.asarray(want[k]),
+                                   rtol=5e-6, atol=5e-7, err_msg=k)

@@ -322,3 +322,71 @@ def test_paged_attention_kernel_lowers_for_tpu(quantized):
 
     with force_compiled():
         _lower_tpu(f, q, cl, bt, lens)
+
+
+@pytest.mark.skipif(not _PALLAS_PARAMS_OK,
+                    reason="pltpu.CompilerParams needs graft-era pallas")
+@pytest.mark.parametrize("quantized", [False, True])
+def test_fused_layer_decode_kernel_lowers_for_tpu(quantized):
+    """AOT TPU lowering of the megakernel fused layer block: resident
+    weight BlockSpecs (constant index maps), the clamped pool-walk DMA,
+    the in-register current-token fold and the in-kernel int8 dequant all
+    pass Mosaic's tiling/layout rules at a serving-sized shape."""
+    from apex_tpu.serve import KVCacheConfig, init_kv_cache
+    from apex_tpu.serve.megakernel import fused_layer_decode, megakernel_ok
+    from apex_tpu.transformer.testing import GPTConfig
+
+    cfg = GPTConfig(vocab_size=512, max_seq=1024, hidden=512, num_layers=1,
+                    num_heads=8, dtype=jnp.bfloat16, fused_loss=False)
+    kv = KVCacheConfig(num_layers=1, num_heads=8, head_dim=64,
+                       num_blocks=16, block_size=128, dtype=jnp.bfloat16,
+                       quantized=quantized)
+    assert megakernel_ok(cfg, kv)
+    h, f3, hd = cfg.hidden, 3 * cfg.hidden, cfg.num_heads * cfg.head_dim
+    f = cfg.ffn_hidden
+    dt = jnp.bfloat16
+    lp = {
+        "ln1_w": jnp.ones((h,), dt), "ln1_b": jnp.zeros((h,), dt),
+        "qkv_kernel": jnp.zeros((h, f3), dt),
+        "qkv_bias": jnp.zeros((f3,), dt),
+        "out_kernel": jnp.zeros((hd, h), dt),
+        "out_bias": jnp.zeros((h,), dt),
+        "ln2_w": jnp.ones((h,), dt), "ln2_b": jnp.zeros((h,), dt),
+        "fc1_kernel": jnp.zeros((h, f), dt),
+        "fc1_bias": jnp.zeros((f,), dt),
+        "fc2_kernel": jnp.zeros((f, h), dt),
+        "fc2_bias": jnp.zeros((h,), dt),
+    }
+    cl = {k: v[0] for k, v in init_kv_cache(kv).items()}
+    x = jnp.zeros((4, h), dt)
+    bt = jnp.zeros((4, 4), jnp.int32)
+    lens = jnp.zeros((4,), jnp.int32)
+
+    def fn(x, lp, cl, bt, lens):
+        return fused_layer_decode(x, lp, cl, cfg, kv, bt, lens,
+                                  interpret=False)
+
+    with force_compiled():
+        _lower_tpu(fn, x, lp, cl, bt, lens)
+
+
+@pytest.mark.skipif(not _PALLAS_PARAMS_OK,
+                    reason="pltpu.CompilerParams needs graft-era pallas")
+@pytest.mark.parametrize("with_norms", [False, True])
+def test_fused_update_tail_lowers_for_tpu(with_norms):
+    """AOT TPU lowering of the fused Adam/LAMB update-tail kernel: the
+    SMEM scalar block, the padded (rows, 128) row blocking and the LAMB
+    variant's sequential (1, 1) norm accumulators."""
+    from apex_tpu.ops.fused_update import fused_adam_tail, fused_lamb_tail
+
+    n = 70_001  # deliberately unaligned: exercises the padding path
+    g = jnp.zeros((n,), jnp.float32)
+    c = jnp.float32(0.5)
+
+    def fn(g, c):
+        tail = fused_lamb_tail if with_norms else fused_adam_tail
+        return tail(g, g, g, g, c, c, betas=(0.9, 0.999), eps=1e-8,
+                    weight_decay=0.01, use_pallas=True, interpret=False)
+
+    with force_compiled():
+        _lower_tpu(fn, g, c)
