@@ -23,6 +23,11 @@ L3     ``apex_tpu.optimizers``,       ``apex/optimizers``, ``apex/normalization`
 L4     ``apex_tpu.parallel``          ``apex/parallel`` (DDP, SyncBN, LARC)
 L4.5   ``apex_tpu.comm``              — (north-star: compressed collectives,
                                       int8+EF quantized allreduce)
+L4.7   ``apex_tpu.fsdp``              — (north-star: ZeRO-3 parameter
+                                      sharding — gather-on-demand custom
+                                      VJPs, overlapped gather rings,
+                                      shard-only optimizer; configured via
+                                      ``parallel.ParallelismPlan``)
 L5     ``apex_tpu.transformer``       ``apex/transformer`` (TP/PP runtime)
 L6     ``apex_tpu.contrib``           ``apex/contrib``
 L7     ``apex_tpu.profiler``          ``apex/pyprof``
@@ -51,6 +56,7 @@ __all__ = [
     "config",
     "contrib",
     "fp16_utils",
+    "fsdp",
     "fused_dense",
     "get_logger",
     "mlp",

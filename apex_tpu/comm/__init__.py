@@ -48,6 +48,8 @@ from apex_tpu.comm.overlap import (  # noqa: F401
     all_gather_matmul_wire_bytes,
     matmul_all_reduce,
     matmul_all_reduce_wire_bytes,
+    matmul_param_gather,
+    matmul_param_gather_wire_bytes,
     matmul_reduce_scatter,
     matmul_reduce_scatter_wire_bytes,
 )
@@ -73,6 +75,8 @@ __all__ = [
     "load_state_dict",
     "matmul_all_reduce",
     "matmul_all_reduce_wire_bytes",
+    "matmul_param_gather",
+    "matmul_param_gather_wire_bytes",
     "matmul_reduce_scatter",
     "matmul_reduce_scatter_wire_bytes",
     "overlap_report",

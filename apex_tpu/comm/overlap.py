@@ -65,9 +65,24 @@ column layer (~10% on the flagship; ``benchmarks/bench_overlap.py``
 reports both totals) — bytes traded for activation memory, and hops that
 all travel behind GEMMs regardless.
 
+``matmul_param_gather(x, w_shard)``
+    ``x @ all_gather(w_shard, axis=-1)`` — the same decomposition in **FSDP
+    position** (arXiv:2004.13336's weight-update sharding taken to ZeRO-3):
+    the *weight* is what is sharded (each dp rank owns a column shard), the
+    activation is resident, and the gather ring hops weight shards while
+    each hop's partial GEMM lands in an output column slice. Backward is
+    the classic FSDP pair: dX **re-gathers** the weight through a second
+    ring (re-materialize — the shard is the residual, the full weight is
+    never saved: reshard-after-forward by construction) while dW rides a
+    travelling-accumulator ring that reduce-scatters the dp-summed weight
+    gradient straight into shard layout. The two backward rings rotate in
+    opposite directions, so both ICI directions carry payload.
+
 Wired in via ``ColumnParallelLinear``/``RowParallelLinear``/
 ``column_parallel_linear``/``row_parallel_linear`` ``overlap_comm=`` and
-``GPTConfig.overlap_comm`` (``transformer/testing/standalone_gpt.py``).
+``GPTConfig.overlap_comm`` (``transformer/testing/standalone_gpt.py``);
+``matmul_param_gather`` via ``apex_tpu.fsdp.FSDP.linear`` and the
+``ParallelismPlan`` fsdp presets.
 """
 
 from __future__ import annotations
@@ -78,13 +93,15 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from apex_tpu.parallel.mesh import TP_AXIS
+from apex_tpu.parallel.mesh import DP_AXIS, TP_AXIS
 
 __all__ = [
     "all_gather_matmul",
+    "matmul_param_gather",
     "matmul_reduce_scatter",
     "matmul_all_reduce",
     "all_gather_matmul_wire_bytes",
+    "matmul_param_gather_wire_bytes",
     "matmul_reduce_scatter_wire_bytes",
     "matmul_all_reduce_wire_bytes",
 ]
@@ -104,6 +121,24 @@ def all_gather_matmul_wire_bytes(shard_elems: int, itemsize: int,
     if world <= 1:
         return 0.0
     return float(shard_elems) * itemsize * (world - 1)
+
+
+def matmul_param_gather_wire_bytes(shard_elems: int, itemsize: int,
+                                   world: int, backward: bool = False
+                                   ) -> float:
+    """Modeled wire bytes of one FSDP-position gather-matmul ring whose
+    WEIGHT shard has ``shard_elems`` elements: ``(W-1)`` hops of the shard
+    forward — identical to the monolithic tiled all-gather of the full
+    weight. ``backward=True`` prices the backward pair instead: the dX
+    re-gather ring (shard bytes again) plus the dW travelling accumulator
+    (fp32, shard-shaped) — identical to the monolithic all-gather +
+    fp32 reduce-scatter the unfused FSDP backward pays."""
+    if world <= 1:
+        return 0.0
+    fwd = float(shard_elems) * itemsize * (world - 1)
+    if not backward:
+        return fwd
+    return fwd + float(shard_elems) * 4 * (world - 1)
 
 
 def matmul_reduce_scatter_wire_bytes(shard_elems: int, itemsize: int,
@@ -421,3 +456,103 @@ def matmul_all_reduce(x, kernel, *, axis_name: str = TP_AXIS,
     Backward is purely local (the psum transpose). Same ``pvary_like``
     contract as :func:`all_gather_matmul`."""
     return _matmul_all_reduce(x, kernel, axis_name, scatter_axis)
+
+
+# ---------------------------------------------------------------------------
+# FSDP position: the WEIGHT is the sharded operand
+
+
+def _mm_pg_impl(x, w_shard, axis_name, bidirectional):
+    """x @ all_gather(w_shard, axis=-1): ring-gather the weight shards,
+    one partial GEMM per hop landing in the output COLUMN slice. Exact —
+    the gathered dim is non-contracting, no reduction is reordered."""
+    world = lax.axis_size(axis_name)
+    if world == 1:
+        return jnp.dot(x, w_shard)
+    n_loc = w_shard.shape[-1]
+    out_shape = list(x.shape[:-1]) + [n_loc * world]
+    out = _pvary_like(
+        jnp.zeros(tuple(out_shape), jnp.result_type(x.dtype, w_shard.dtype)),
+        x)
+    axis = len(out_shape) - 1
+    for chunk, src in _gather_ring(w_shard, axis_name, bidirectional):
+        out = _place(out, jnp.dot(x, chunk), src, n_loc, axis)
+    return out
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _matmul_param_gather(x, w_shard, axis_name, bidirectional):
+    return _mm_pg_impl(x, w_shard, axis_name, bidirectional)
+
+
+def _mm_pg_fwd(x, w_shard, axis_name, bidirectional):
+    # residuals are (x, SHARD): the gathered full weight is never saved —
+    # reshard-after-forward is structural, not a hook
+    return _mm_pg_impl(x, w_shard, axis_name, bidirectional), (x, w_shard)
+
+
+def _mm_pg_bwd(axis_name, bidirectional, res, dy):
+    x, w_shard = res
+    world = lax.axis_size(axis_name)
+    if world == 1:
+        dx = jnp.dot(dy, w_shard.T).astype(x.dtype)
+        dw = _contract_leading(x, dy).astype(w_shard.dtype)
+        return dx, dw
+    idx = lax.axis_index(axis_name)
+    n_loc = w_shard.shape[-1]
+    col = dy.ndim - 1
+    # ONE loop, two counter-rotating rings: the weight re-gather ring
+    # (recv-from-right — the classic FSDP backward re-materialize; the
+    # full weight was never a residual) feeds the dX partial sums, while
+    # the dW travelling accumulator (moving right) reduce-scatters the
+    # dp-summed weight grad straight into shard layout. Each hop of both
+    # rings travels behind the two partial GEMMs of the next iteration.
+    perm_w = [(j, (j - 1) % world) for j in range(world)]
+    perm_acc = [(j, (j + 1) % world) for j in range(world)]
+    chunk = w_shard
+    dx = None
+    acc = None
+    for t in range(world):
+        src = lax.rem(idx + t, jnp.int32(world))  # which w shard we hold
+        # dX partial: dy's src column block against the resident shard.
+        # fp32 accumulator — the monolithic dX is ONE dot with an fp32 MXU
+        # accumulator; summing W model-dtype partials would add roundings
+        p_dx = lax.dot_general(
+            _chunk_slice(dy, src, n_loc, col), chunk,
+            (((col,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        dx = p_dx if dx is None else dx + p_dx
+        # dW partial for the accumulator currently resident (starts at the
+        # left neighbour's shard and arrives home after W-1 hops — the
+        # _matmul_rs_impl shifting-accumulator recipe)
+        d = lax.rem(idx - 1 - t + 2 * world, world)
+        p_dw = _contract_leading(x, _chunk_slice(dy, d, n_loc, col))
+        acc = p_dw if acc is None else acc + p_dw
+        if t < world - 1:
+            with _span_comm():
+                chunk = lax.ppermute(chunk, axis_name, perm_w)
+                acc = lax.ppermute(acc, axis_name, perm_acc)
+    return dx.astype(x.dtype), acc.astype(w_shard.dtype)
+
+
+_matmul_param_gather.defvjp(_mm_pg_fwd, _mm_pg_bwd)
+
+
+def matmul_param_gather(x, w_shard, *, axis_name: str = DP_AXIS,
+                        bidirectional: bool = False):
+    """``x @ all_gather(w_shard, axis=-1)`` with the WEIGHT gather
+    decomposed into a ppermute ring interleaved with partial GEMMs — the
+    collective-matmul decomposition in FSDP (ZeRO-3) position.
+
+    ``x``: the rank-resident activation ``(..., in)`` (each dp rank holds
+    its own batch shard). ``w_shard``: this rank's column shard ``(in,
+    out/W)`` of the full ``(in, out)`` weight. Forward is EXACT vs the
+    monolithic ``x @ all_gather(w)`` (the gathered dim is
+    non-contracting). Backward: dX re-gathers the weight through a second
+    ring (fp-reorder tolerance — W partials vs one fused dot) and dW
+    arrives as this rank's ``(in, out/W)`` shard of the dp-SUMMED weight
+    gradient (the FSDP grad reduce-scatter, fused into the same loop);
+    divide by the axis size for the data-parallel mean. Wire-byte-neutral
+    vs the monolithic gather + reduce-scatter pair
+    (:func:`matmul_param_gather_wire_bytes`). Same ``pvary_like``/mesh
+    contract as :func:`all_gather_matmul`."""
+    return _matmul_param_gather(x, w_shard, axis_name, bool(bidirectional))

@@ -27,6 +27,72 @@ from jax import lax
 Pytree = Any
 
 
+def shard_multiple(compression) -> int:
+    """Shard-size alignment for a (possibly ``None``) ``CompressionConfig``:
+    with a quantized wire the shards are block-aligned so the codec's fp32
+    scale blocks never straddle ranks. Shared by the ZeRO-1 optimizers and
+    ``apex_tpu.fsdp`` (which aligns to the lcm of its grad and weight-gather
+    codecs via :func:`shard_multiple_lcm`)."""
+    if compression is not None and compression.enabled:
+        return compression.block_size
+    return 1
+
+
+def shard_multiple_lcm(*compressions) -> int:
+    """lcm of the block alignments of several codecs (FSDP's grad
+    reduce-scatter and weight-gather wires may use different block sizes;
+    one shard layout must satisfy both)."""
+    import math
+
+    m = 1
+    for c in compressions:
+        m = math.lcm(m, shard_multiple(c))
+    return m
+
+
+def local_sq(tree: Pytree) -> jnp.ndarray:
+    """Σ x² over every leaf (fp32 scalar) — the local half of a sharded
+    global norm."""
+    return sum((jnp.sum(jnp.square(x))
+                for x in jax.tree_util.tree_leaves(tree)),
+               jnp.float32(0.0))
+
+
+def global_norm_shards(tree: Pytree, axis_name: str) -> jnp.ndarray:
+    """Global L2 norm of dp-sharded leaves: local shard sq-sum + one psum
+    (the reference's two-stage ``multi_tensor_l2norm`` + allreduce). Shared
+    by the ZeRO-1 optimizers' and FSDP's clipping and metrics paths."""
+    return jnp.sqrt(lax.psum(local_sq(tree), axis_name))
+
+
+def adam_shard_update(g, m, v, p32, c1, c2, *, lr, betas, eps,
+                      weight_decay=0.0, adam_w_mode=True,
+                      use_fused=False):
+    """The per-(shard-)leaf Adam tail shared by ``DistributedFusedAdam``
+    (ZeRO-1) and ``apex_tpu.fsdp.FSDPAdam`` (ZeRO-3) — identical math, so
+    the two stages produce bit-matched updates given the same shard grads.
+    ``use_fused`` routes through the ONE-kernel Pallas tail
+    (``ops/fused_update.py``); only the lr axpy stays outside it.
+    Returns ``(p32', m', v')``."""
+    b1, b2 = betas
+    if use_fused:
+        from apex_tpu.ops.fused_update import fused_adam_tail
+
+        u, m_new, v_new = fused_adam_tail(
+            g, m, v, p32, c1, c2, betas=betas, eps=eps,
+            weight_decay=weight_decay, adam_w_mode=adam_w_mode,
+            use_pallas=True)
+        return p32 - lr * u, m_new, v_new
+    if not adam_w_mode and weight_decay:
+        g = g + weight_decay * p32
+    m_new = b1 * m + (1.0 - b1) * g
+    v_new = b2 * v + (1.0 - b2) * g * g
+    u = (m_new / c1) / (jnp.sqrt(v_new / c2) + eps)
+    if adam_w_mode and weight_decay:
+        u = u + weight_decay * p32
+    return p32 - lr * u, m_new, v_new
+
+
 def shard_size(n: int, world: int, multiple: int = 1) -> int:
     """ceil(n/world), rounded up to ``multiple``. The compressed-collective
     path (``comm/collectives.py``) passes the quantization block size so no

@@ -29,21 +29,23 @@ from apex_tpu.comm.collectives import (
 )
 from apex_tpu.comm.error_feedback import init_error_feedback
 from apex_tpu.contrib.optimizers._sharding import (
+    adam_shard_update,
     gather_leaf,
+    global_norm_shards,
+    local_sq,
     scatter_leaf,
+    shard_multiple,
     slice_leaf,
 )
 from apex_tpu.parallel.mesh import DP_AXIS
 
 Pytree = Any
 
-
-def _shard_multiple(compression: Optional[CompressionConfig]) -> int:
-    """Shard-size alignment: with a quantized reduce-scatter the shards are
-    block-aligned so the codec's fp32 scale blocks never straddle ranks."""
-    if compression is not None and compression.enabled:
-        return compression.block_size
-    return 1
+# the shard alignment / norm helpers moved to ``_sharding.py`` (shared with
+# apex_tpu.fsdp); the private names stay importable for existing callers
+_shard_multiple = shard_multiple
+_local_sq = local_sq
+_global_norm_shards = global_norm_shards
 
 
 def _reduce_grad_leaf(g, axis_name, compression, residual, seed):
@@ -91,19 +93,6 @@ def _reduce_grads(grads, comm_state, axis_name, compression, seed,
         return g_shards, None
     return g_shards, jax.tree_util.tree_unflatten(
         jax.tree_util.tree_structure(comm_state), new_res)
-
-
-def _local_sq(tree: Pytree) -> jnp.ndarray:
-    return sum((jnp.sum(jnp.square(x))
-                for x in jax.tree_util.tree_leaves(tree)),
-               jnp.float32(0.0))
-
-
-def _global_norm_shards(tree: Pytree, axis_name: str) -> jnp.ndarray:
-    """Global L2 norm of dp-sharded leaves: local shard sq-sum + one psum
-    (the reference's two-stage ``multi_tensor_l2norm`` + allreduce). Shared
-    by both ZeRO optimizers' clipping and metrics paths."""
-    return jnp.sqrt(lax.psum(_local_sq(tree), axis_name))
 
 
 def _record_zero_metrics(metrics, gnorm, master, old_master, grads,
@@ -292,27 +281,16 @@ class DistributedFusedAdam:
         t = count.astype(jnp.float32)
         c1 = 1.0 - jnp.power(b1, t)
         c2 = 1.0 - jnp.power(b2, t)
-        from apex_tpu.ops.fused_update import fused_adam_tail, resolve_fused
+        from apex_tpu.ops.fused_update import resolve_fused
 
         use_fused = resolve_fused(self.fused_update)
 
         def upd(g, m, v, p32):
-            if use_fused:
-                # the whole tail as ONE kernel (ops/fused_update.py);
-                # only the lr axpy stays outside
-                u, m_new, v_new = fused_adam_tail(
-                    g, m, v, p32, c1, c2, betas=self.betas, eps=self.eps,
-                    weight_decay=self.weight_decay,
-                    adam_w_mode=self.adam_w_mode, use_pallas=True)
-                return p32 - self.lr * u, m_new, v_new
-            if not self.adam_w_mode and self.weight_decay:
-                g = g + self.weight_decay * p32
-            m_new = b1 * m + (1.0 - b1) * g
-            v_new = b2 * v + (1.0 - b2) * g * g
-            u = (m_new / c1) / (jnp.sqrt(v_new / c2) + self.eps)
-            if self.adam_w_mode and self.weight_decay:
-                u = u + self.weight_decay * p32
-            return p32 - self.lr * u, m_new, v_new
+            # the shared ZeRO-1/FSDP Adam tail (_sharding.adam_shard_update)
+            return adam_shard_update(
+                g, m, v, p32, c1, c2, lr=self.lr, betas=self.betas,
+                eps=self.eps, weight_decay=self.weight_decay,
+                adam_w_mode=self.adam_w_mode, use_fused=use_fused)
 
         # flattened, not is_leaf=tuple: a tuple CONTAINER node in the grads
         # pytree must not be mistaken for upd's (p, m, v) result triple

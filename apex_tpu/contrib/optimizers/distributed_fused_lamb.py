@@ -26,12 +26,12 @@ from apex_tpu.comm.collectives import CompressionConfig
 from apex_tpu.comm.error_feedback import init_error_feedback
 from apex_tpu.contrib.optimizers._sharding import (
     gather_leaf,
+    global_norm_shards as _global_norm_shards,
+    shard_multiple as _shard_multiple,
     slice_leaf,
 )
 from apex_tpu.contrib.optimizers.distributed_fused_adam import (
-    _global_norm_shards,
     _reduce_grads,
-    _shard_multiple,
 )
 from apex_tpu.parallel.mesh import DP_AXIS
 

@@ -1,0 +1,34 @@
+"""apex_tpu.fsdp — ZeRO-3 parameter sharding on overlapped gather rings.
+
+The third rung of the ZeRO ladder grown from the contrib optimizers:
+``parallel.DistributedDataParallel`` replicates everything (stage 0), the
+contrib ``DistributedFusedAdam/LAMB`` shard optimizer state (stage 1+2),
+and :class:`FSDP` + :class:`FSDPAdam` shard the parameters too — forward
+gathers on demand (optionally int8 on the wire), gradients reduce-scatter
+straight into shard layout inside autodiff, matmul-adjacent weights ride
+``comm.overlap.matmul_param_gather``'s decomposed ppermute ring, and the
+optimizer steps only the local shard through the shared Pallas tail.
+
+Configure through :class:`apex_tpu.parallel.ParallelismPlan` (preset
+``"fsdp"``/``"fsdp+tp"``) rather than wiring by hand.
+"""
+
+from apex_tpu.fsdp.accounting import (  # noqa: F401
+    fsdp_step_wire_bytes,
+    hbm_params_bytes,
+    hbm_reduction,
+    param_gather_wire_bytes,
+)
+from apex_tpu.fsdp.core import FSDP, LeafMeta  # noqa: F401
+from apex_tpu.fsdp.optim import FSDPAdam, FSDPAdamState  # noqa: F401
+
+__all__ = [
+    "FSDP",
+    "FSDPAdam",
+    "FSDPAdamState",
+    "LeafMeta",
+    "fsdp_step_wire_bytes",
+    "hbm_params_bytes",
+    "hbm_reduction",
+    "param_gather_wire_bytes",
+]

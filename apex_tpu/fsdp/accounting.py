@@ -1,0 +1,156 @@
+"""FSDP byte accounting — HBM and wire models for the ZeRO ladder.
+
+Same discipline as ``comm.accounting``: every number here is computed from
+static shapes under the same ring model the HLO pricer reads off compiled
+programs, so benchmarks and tests can assert the memory story instead of
+narrating it. ``hbm_params_bytes`` is the headline (the acceptance metric
+of the FSDP PR): per-chip bytes attributable to parameters + gradients +
+optimizer state — the terms parameter sharding moves — for each strategy
+on the ladder:
+
+``ddp``
+    Replicated everything: model-dtype params + grads, fp32 Adam moments,
+    plus an fp32 master copy when the model dtype is narrower than fp32
+    (the amp-O2 contract).
+``zero1``
+    ``DistributedFusedAdam``: params + grads still replicated full-model
+    (model dtype; the reduce-scatter consumes fp32 casts transiently),
+    fp32 master + moments sharded 1/dp.
+``fsdp``
+    Everything sharded: fp32 master+moments shards ARE the parameter
+    store (no replicated copy), grads arrive as fp32 shards, and the only
+    full-model-dtype bytes are the transient gather working set (reported
+    separately as ``gather_workspace_bytes`` — bounded by the largest
+    leaf, not the model).
+
+Activations are deliberately out of scope (unchanged by the ZeRO stage).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+Pytree = Any
+
+STRATEGIES = ("ddp", "zero1", "fsdp")
+
+
+def _leaf_meta(tree: Pytree):
+    """(elements, model itemsize) per leaf — accepts a params pytree or an
+    ``FSDP.meta`` pytree (LeafMeta leaves)."""
+    from apex_tpu.fsdp.core import LeafMeta
+
+    out = []
+    for x in jax.tree_util.tree_leaves(
+            tree, is_leaf=lambda v: isinstance(v, LeafMeta)):
+        if isinstance(x, LeafMeta):
+            out.append((x.size, np.dtype(x.dtype).itemsize))
+        else:
+            n = 1
+            for d in jax.numpy.shape(x):
+                n *= d
+            out.append((n, np.dtype(jax.numpy.result_type(x)).itemsize))
+    return out
+
+
+def _shard_elems(n: int, world: int, multiple: int) -> int:
+    from apex_tpu.contrib.optimizers._sharding import shard_size
+
+    return shard_size(n, world, multiple)
+
+
+def hbm_params_bytes(params_or_meta: Pytree, *, strategy: str, world: int,
+                     shard_multiple: int = 1) -> Dict[str, float]:
+    """Modeled per-chip param+grad+optimizer-state HBM for one strategy.
+
+    Returns ``{"params_bytes", "grads_bytes", "opt_state_bytes",
+    "gather_workspace_bytes", "total"}`` (floats; ``total`` excludes the
+    transient gather workspace, which is reported so callers can see it
+    stays leaf-sized, not model-sized).
+    """
+    if strategy not in STRATEGIES:
+        raise ValueError(
+            f"strategy must be one of {STRATEGIES}, got {strategy!r}")
+    leaves = _leaf_meta(params_or_meta)
+    params = grads = opt = workspace = 0.0
+    for n, isz in leaves:
+        k = _shard_elems(n, world, shard_multiple)
+        if strategy == "ddp":
+            params += n * isz
+            grads += n * isz
+            opt += n * 8  # fp32 mu+nu (FusedAdam)
+            if isz < 4:
+                opt += n * 4  # amp fp32 master
+        elif strategy == "zero1":
+            params += n * isz
+            grads += n * isz
+            opt += k * 12  # fp32 master+mu+nu shards
+        else:  # fsdp
+            grads += k * 4  # fp32 shard grads off the reduce-scatter
+            opt += k * 12  # fp32 master+mu+nu shards (the param store)
+            workspace = max(workspace, 2.0 * n * isz)
+    return {
+        "params_bytes": params,
+        "grads_bytes": grads,
+        "opt_state_bytes": opt,
+        "gather_workspace_bytes": workspace,
+        "total": params + grads + opt,
+    }
+
+
+def hbm_reduction(params_or_meta: Pytree, *, world: int,
+                  baseline: str = "ddp",
+                  shard_multiple: int = 1) -> float:
+    """``baseline_total / fsdp_total`` — the headline drop factor."""
+    base = hbm_params_bytes(params_or_meta, strategy=baseline, world=world,
+                            shard_multiple=shard_multiple)["total"]
+    ours = hbm_params_bytes(params_or_meta, strategy="fsdp", world=world,
+                            shard_multiple=shard_multiple)["total"]
+    return base / ours if ours else float("inf")
+
+
+def param_gather_wire_bytes(meta: Pytree, world: int,
+                            weight_gather=None,
+                            shard_multiple: int = 1) -> float:
+    """Modeled per-device wire bytes of ONE full parameter gather (the
+    FSDP forward leg): per leaf, a tiled all-gather of the model-dtype
+    shard — ``k·isz·(W-1)`` — or, with the int8 codec, codes + fp32 block
+    scales. Matches what ``comm.accounting.collective_report`` prices on
+    the compiled program (``all_gather_wire_bytes`` convention: result
+    bytes × (W-1)/W)."""
+    from apex_tpu.comm.collectives import all_gather_wire_bytes
+
+    total = 0.0
+    for n, isz in _leaf_meta(meta):
+        if world <= 1:
+            continue
+        k = _shard_elems(n, world, shard_multiple)
+        if weight_gather is not None and weight_gather.compresses(n):
+            # int8 codes + fp32 scales, both gathered tiled
+            total += all_gather_wire_bytes(k * world, 1, world)
+            total += all_gather_wire_bytes(
+                (k // weight_gather.block_size) * world, 4, world)
+        else:
+            total += all_gather_wire_bytes(k * world, isz, world)
+    return total
+
+
+def fsdp_step_wire_bytes(meta: Pytree, world: int,
+                         compression: Optional[Any] = None,
+                         weight_gather: Optional[Any] = None,
+                         shard_multiple: int = 1,
+                         remat_gathers: int = 1) -> float:
+    """Whole-step wire model: ``remat_gathers`` forward gathers (2 under
+    full remat — the backward replays the gather: the FSDP re-materialize)
+    plus the fp32 grad reduce-scatter leg."""
+    from apex_tpu.comm.collectives import psum_scatter_wire_bytes
+
+    total = param_gather_wire_bytes(
+        meta, world, weight_gather, shard_multiple) * max(1, remat_gathers)
+    for n, _ in _leaf_meta(meta):
+        total += psum_scatter_wire_bytes(n, 4, world, compression,
+                                         shard_multiple)
+    return total

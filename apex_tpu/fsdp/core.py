@@ -1,0 +1,291 @@
+"""FSDP (ZeRO-3) parameter sharding — gather-on-demand over the dp axis.
+
+Reference context: the contrib ZeRO optimizers
+(``apex/contrib/optimizers/distributed_fused_adam.py``) stop at stage 1+2 —
+optimizer state is dp-sharded but the *parameters* (and the gradients the
+backward materializes) still cost full-model HBM on every chip. Xu et al.,
+"Automatic Cross-Replica Sharding of Weight Update" (arXiv:2004.13336) and
+the MLPerf TPU-pod scaling playbook (arXiv:1909.09756) take the last step:
+shard the parameters too and hide the forward/backward all-gathers behind
+compute. This module is that step for the TPU mesh:
+
+* each dp rank owns a **flat block-aligned shard** of every leaf (the
+  ``_sharding`` shard-multiple layout from contrib ZeRO, so an int8 comm
+  codec's fp32 scale blocks never straddle ranks);
+* the forward **gathers parameters on demand** through a ``custom_vjp``
+  whose backward **reduce-scatters the gradient straight into shard
+  layout** — the dp grad sum and the ZeRO-3 shard delivery are ONE
+  collective. The gather wire optionally rides the blockwise-int8
+  ``comm.quantize`` codec (``weight_gather=``), the grad reduce-scatter
+  optionally rides ``comm.collectives.compressed_psum_scatter``
+  (``compression=``);
+* matmul-adjacent leaves can skip the materialized gather entirely:
+  :meth:`FSDP.linear` stores the weight as a **column shard** and rides
+  ``comm.overlap.matmul_param_gather``'s decomposed ppermute ring — each
+  gather hop travels behind a partial GEMM (the dependent
+  collective→matmul chain XLA cannot overlap on its own), the backward
+  re-gather ring is the classic FSDP re-materialize, and the dW ring
+  reduce-scatters into shard layout. Reshard-after-forward is structural:
+  the ring's residual is the shard, the full weight is never saved;
+* the optimizer (``fsdp.optim.FSDPAdam``) steps only the local shard
+  through the shared ZeRO tail (``_sharding.adam_shard_update``, Pallas
+  ``fused_update`` included) — there is NO replicated parameter copy: the
+  fp32 master shard is the canonical store, full parameters exist only
+  transiently inside the gathered step.
+
+Declarative entry point: ``apex_tpu.parallel.ParallelismPlan`` composes
+this with dp/tp/pp meshes, overlap, compression and the monitor/resilience
+wiring — see ``parallel/plan.py``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.comm.collectives import (
+    CompressionConfig,
+    compressed_psum_scatter,
+)
+from apex_tpu.contrib.optimizers._sharding import (
+    gather_leaf,
+    scatter_leaf,
+    shard_multiple_lcm,
+    slice_leaf,
+)
+from apex_tpu.parallel.mesh import DP_AXIS
+
+Pytree = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class LeafMeta:
+    """Static per-leaf record (NOT a pytree container — travels as a leaf
+    through ``tree_map`` next to the shard pytree): the full shape/dtype a
+    gathered leaf must be restored to."""
+
+    shape: tuple
+    dtype: str
+
+    @property
+    def size(self) -> int:
+        n = 1
+        for d in self.shape:
+            n *= d
+        return n
+
+
+def _prod(shape) -> int:
+    n = 1
+    for d in shape:
+        n *= d
+    return n
+
+
+# ---------------------------------------------------------------------------
+# the gather-on-demand primitive (plain leaves)
+#
+# custom_vjp so the backward is OUR reduce-scatter (optionally quantized)
+# landing in shard layout — jax's built-in all_gather transpose would psum
+# the full gradient first.
+
+
+def _gather_impl(shard, axis_name, shape, dtype, wg):
+    n = _prod(shape)
+    if wg is not None and wg.compresses(n):
+        from apex_tpu.comm.quantize import (
+            dequantize_blockwise,
+            quantize_blockwise,
+        )
+
+        # round to the model dtype FIRST (the wire carries what the model
+        # would see anyway — same contract as ZeRO's e5m2_allgather), then
+        # int8 codes + fp32 block scales on the wire. The shard is
+        # block-aligned by construction (shard_multiple), so no scale
+        # block straddles ranks.
+        vals = shard.astype(dtype).astype(jnp.float32)
+        q, s = quantize_blockwise(vals, wg.block_size,
+                                  use_pallas=wg.use_pallas)
+        qf = lax.all_gather(q, axis_name, axis=0, tiled=True)
+        sf = lax.all_gather(s, axis_name, axis=0, tiled=True)
+        full = dequantize_blockwise(qf, sf, wg.block_size,
+                                    use_pallas=wg.use_pallas)
+        return full[:n].reshape(shape).astype(dtype)
+    # uncompressed: the ZeRO-1 gather path — model dtype on the wire
+    # (transport_dtype=dtype is the saturating master→model-dtype cast),
+    # so the two strategies can never diverge in layout or unpad math
+    return gather_leaf(shard, shape, dtype, axis_name,
+                       transport_dtype=dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4, 5, 6))
+def _gather_leaf_op(shard, axis_name, shape, dtype, wg, rs, multiple):
+    return _gather_impl(shard, axis_name, shape, jnp.dtype(dtype), wg)
+
+
+def _gather_leaf_fwd(shard, axis_name, shape, dtype, wg, rs, multiple):
+    # NO residuals: the gather is linear and the backward re-derives its
+    # shapes from the static args — the full parameter is never saved
+    # (reshard-after-forward), and neither is the shard
+    return _gather_impl(shard, axis_name, shape, jnp.dtype(dtype), wg), None
+
+
+def _gather_leaf_bwd(axis_name, shape, dtype, wg, rs, multiple, res, dy):
+    del res, shape
+    flat = dy.reshape(-1).astype(jnp.float32)
+    if rs is not None and rs.enabled:
+        # quantized grad reduce-scatter (no EF/stochastic state — the VJP
+        # is stateless; FSDP validates those policies away at construction)
+        g, _ = compressed_psum_scatter(flat, axis_name, rs,
+                                       shard_multiple=multiple)
+        return (g,)
+    return (scatter_leaf(flat, axis_name, multiple=multiple),)
+
+
+_gather_leaf_op.defvjp(_gather_leaf_fwd, _gather_leaf_bwd)
+
+
+@dataclasses.dataclass(frozen=True)
+class FSDP:
+    """The ZeRO-3 engine: shard layout + gather-on-demand + grad
+    reduce-scatter, one dp axis. Use inside the mesh program::
+
+        fsdp = FSDP(compression=CompressionConfig("int8"))
+        opt = FSDPAdam(fsdp=fsdp, lr=1e-3)
+        meta = fsdp.meta(params)            # static, once
+        state = opt.init(params)            # fp32 master/moment SHARDS
+
+        def loss_fn(master):
+            p = fsdp.gather(master, meta)   # full params, model dtype
+            return model_loss(p, batch)
+
+        loss, g_shards = jax.value_and_grad(loss_fn)(state.master)
+        state = opt.step(g_shards, state)   # local-shard update, no gather
+
+    ``compression``: wire policy of the gradient reduce-scatter (policy
+    ``int8``; ``int8_ef``/stochastic rounding are refused — the VJP is
+    stateless). ``weight_gather``: optional int8 codec for the parameter
+    all-gather wire (lossy within codec tolerance; the fp32 master shard
+    stays exact). Shards are flat ``(k,)`` with ``k`` aligned to the lcm
+    of both codecs' block sizes."""
+
+    axis_name: str = DP_AXIS
+    compression: Optional[CompressionConfig] = None
+    weight_gather: Optional[CompressionConfig] = None
+    bidirectional: bool = False
+
+    def __post_init__(self):
+        for name, cfg in (("compression", self.compression),
+                          ("weight_gather", self.weight_gather)):
+            if cfg is None:
+                continue
+            if cfg.error_feedback:
+                raise ValueError(
+                    f"FSDP {name} cannot carry error feedback: the "
+                    "gather/reduce-scatter VJP is stateless — use policy "
+                    "'int8' (ZeRO-1 DistributedFusedAdam supports "
+                    "'int8_ef' on its grad leg)")
+            if cfg.stochastic_rounding:
+                raise ValueError(
+                    f"FSDP {name} does not support stochastic_rounding "
+                    "(no per-step seed reaches the stateless VJP)")
+
+    @property
+    def shard_multiple(self) -> int:
+        return shard_multiple_lcm(self.compression, self.weight_gather)
+
+    # -- layout ------------------------------------------------------------
+    def meta(self, params_template: Pytree) -> Pytree:
+        """Static :class:`LeafMeta` pytree mirroring ``params_template``
+        (shapes/dtypes from avals — no device reads)."""
+        return jax.tree_util.tree_map(
+            lambda p: LeafMeta(tuple(jnp.shape(p)),
+                               str(jnp.result_type(p))),
+            params_template)
+
+    def shard_params(self, params: Pytree) -> Pytree:
+        """This rank's flat fp32 shard of every (replicated) leaf — call
+        inside the mesh program. The fp32 copy is the canonical store
+        (master); there is no separate replicated parameter copy."""
+        return jax.tree_util.tree_map(
+            lambda p: slice_leaf(p.astype(jnp.float32), self.axis_name,
+                                 multiple=self.shard_multiple),
+            params)
+
+    # -- forward -----------------------------------------------------------
+    def gather_leaf(self, shard, meta: LeafMeta):
+        return _gather_leaf_op(shard, self.axis_name, meta.shape,
+                               meta.dtype, self.weight_gather,
+                               self.compression, self.shard_multiple)
+
+    def gather(self, shards: Pytree, meta: Pytree) -> Pytree:
+        """Full parameters (model dtype) from the shard pytree. Each leaf
+        is an independent all-gather emitted under the ``comm`` monitor
+        span — XLA's latency-hiding scheduler overlaps them with
+        neighbouring compute; backward is the per-leaf grad
+        reduce-scatter straight into shard layout."""
+        from apex_tpu.monitor.trace import span
+
+        with span("comm"):
+            return jax.tree_util.tree_map(
+                self.gather_leaf, shards, meta,
+                is_leaf=lambda x: isinstance(x, LeafMeta))
+
+    # -- the fused matmul path (module mode) -------------------------------
+    def shard_linear_weight(self, w):
+        """Column shard ``(in, out/W)`` of a 2-D weight for
+        :meth:`linear` — fp32 master layout, ``out`` divisible by the
+        axis size (fail loudly; the flat layout has no such constraint)."""
+        if w.ndim != 2:
+            raise ValueError(
+                f"shard_linear_weight needs a 2-D kernel, got {w.shape}")
+        world = lax.axis_size(self.axis_name)
+        if w.shape[-1] % world:
+            raise ValueError(
+                f"linear weight out dim {w.shape[-1]} not divisible by "
+                f"the {self.axis_name} axis size {world}")
+        idx = lax.axis_index(self.axis_name)
+        n_loc = w.shape[-1] // world
+        return lax.dynamic_slice_in_dim(
+            w.astype(jnp.float32), idx * n_loc, n_loc, 1)
+
+    def linear(self, x, w_shard, dtype=None):
+        """``x @ all_gather(w_shard)`` on the overlapped
+        :func:`~apex_tpu.comm.overlap.matmul_param_gather` ring — the
+        gather hops hide behind partial GEMMs, backward re-gathers
+        (re-materialize) and reduce-scatters dW into the column shard.
+        ``w_shard``: fp32 master column shard (from
+        :meth:`shard_linear_weight`); ``dtype``: compute dtype (default
+        ``x.dtype``)."""
+        from apex_tpu.comm.overlap import matmul_param_gather
+
+        dt = x.dtype if dtype is None else dtype
+        return matmul_param_gather(x, w_shard.astype(dt),
+                                   axis_name=self.axis_name,
+                                   bidirectional=self.bidirectional)
+
+    # -- accounting --------------------------------------------------------
+    def gather_wire_bytes(self, meta: Pytree, world: int) -> float:
+        """Modeled bytes-on-wire per device of one full parameter gather
+        (forward leg), same ring model ``comm.accounting`` prices off
+        compiled HLO. Static — free to record on the Metrics pipeline."""
+        from apex_tpu.fsdp.accounting import param_gather_wire_bytes
+
+        return param_gather_wire_bytes(meta, world, self.weight_gather,
+                                       self.shard_multiple)
+
+    def reduce_wire_bytes(self, meta: Pytree, world: int) -> float:
+        """Modeled wire bytes of the backward grad reduce-scatter leg."""
+        from apex_tpu.comm.collectives import psum_scatter_wire_bytes
+
+        total = 0.0
+        for m in jax.tree_util.tree_leaves(
+                meta, is_leaf=lambda x: isinstance(x, LeafMeta)):
+            total += psum_scatter_wire_bytes(
+                m.size, 4, world, self.compression, self.shard_multiple)
+        return total

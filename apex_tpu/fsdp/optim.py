@@ -1,0 +1,172 @@
+"""FSDP optimizer — Adam on the local shard only, no gather in the step.
+
+The ZeRO-1 optimizers (``contrib/optimizers/distributed_fused_adam.py``)
+own the whole reduce-scatter → update → all-gather pipeline. Under FSDP the
+first and last legs moved into the model's autodiff (the gather VJP
+delivers dp-summed shard grads; the next forward re-gathers), so the
+optimizer shrinks to the middle: the shared Adam tail
+(``_sharding.adam_shard_update`` — bit-identical math to ZeRO-1, Pallas
+``fused_update`` included) over fp32 master/moment shards. The master
+shard IS the parameter store; ``hbm_params_bytes`` accounting lives in
+``fsdp/accounting.py``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.contrib.optimizers._sharding import (
+    adam_shard_update,
+    global_norm_shards,
+    local_sq,
+)
+from apex_tpu.fsdp.core import FSDP
+from apex_tpu.parallel.mesh import DP_AXIS
+
+Pytree = Any
+
+
+class FSDPAdamState(NamedTuple):
+    count: jnp.ndarray
+    master: Pytree  # fp32 param shards — the canonical parameter store
+    mu: Pytree  # fp32 moment shards
+    nu: Pytree
+
+
+@dataclasses.dataclass(frozen=True)
+class FSDPAdam:
+    """AdamW over FSDP shards. Usage (inside the mesh program) — see
+    :class:`apex_tpu.fsdp.FSDP` for the full loop. ``step`` takes the
+    shard grads the gather VJP produced (already dp-SUMMED by the
+    reduce-scatter) and averages them here, mirroring
+    ``DistributedFusedAdam``'s sum-then-divide."""
+
+    fsdp: FSDP = dataclasses.field(default_factory=FSDP)
+    lr: float = 1e-3
+    betas: Tuple[float, float] = (0.9, 0.999)
+    eps: float = 1e-8
+    weight_decay: float = 0.0
+    adam_w_mode: bool = True
+    max_grad_norm: Optional[float] = None
+    fused_update: str = "auto"
+
+    def __post_init__(self):
+        from apex_tpu.ops.fused_update import resolve_fused
+
+        resolve_fused(self.fused_update)
+
+    @property
+    def axis_name(self) -> str:
+        return self.fsdp.axis_name
+
+    # -- state -------------------------------------------------------------
+    def init(self, params: Pytree) -> FSDPAdamState:
+        """Shard fp32 masters + zero moments from replicated ``params``
+        (call inside the mesh program)."""
+        master = self.fsdp.shard_params(params)
+        return self.init_shards(master)
+
+    def init_shards(self, master: Pytree) -> FSDPAdamState:
+        """State from an already-sharded fp32 master pytree (the module
+        mode: column shards from :meth:`FSDP.shard_linear_weight` mixed
+        with flat shards — the tail math is elementwise, any shard shape
+        works)."""
+        zeros = jax.tree_util.tree_map(jnp.zeros_like, master)
+        return FSDPAdamState(
+            count=jnp.zeros((), jnp.int32), master=master, mu=zeros,
+            nu=jax.tree_util.tree_map(jnp.zeros_like, master))
+
+    # -- checkpointing (the resilience manifest path) ----------------------
+    def state_dict(self, state: FSDPAdamState) -> dict:
+        """Flat fingerprinted dict via the shared manifest path — the
+        fingerprint pins every shard's shape/dtype, so a checkpoint from a
+        different dp degree or shard alignment is refused at restore."""
+        from apex_tpu.resilience.checkpoint import state_dict
+
+        return state_dict(state)
+
+    def load_state_dict(self, template: FSDPAdamState,
+                        d: dict) -> FSDPAdamState:
+        from apex_tpu.resilience.checkpoint import load_state_dict
+
+        return load_state_dict(template, d)
+
+    # -- step --------------------------------------------------------------
+    def step(
+        self,
+        g_shards: Pytree,
+        state: FSDPAdamState,
+        scale: Optional[jnp.ndarray] = None,
+        metrics: Optional[Any] = None,
+        meta: Optional[Pytree] = None,
+    ):
+        """One update on the local shards; returns ``state`` (or
+        ``(state, metrics)`` when ``metrics`` is passed).
+
+        ``g_shards``: dp-summed fp32 shard grads from the gather VJP
+        (``jax.grad`` of a loss over ``state.master``). ``scale``: AMP
+        loss scale to divide out. ``metrics``: a ``monitor.Metrics`` —
+        records ``grad_norm``/``param_norm``/``update_norm`` (one stacked
+        psum like ZeRO-1) plus, when ``meta`` (the static
+        :meth:`FSDP.meta` pytree) is given, the modeled
+        ``param_gather_bytes``/``comm_wire_bytes`` and per-chip
+        ``hbm_params_bytes`` of this strategy.
+        """
+        world = lax.axis_size(self.axis_name)
+        g_shards = jax.tree_util.tree_map(
+            lambda g: g.astype(jnp.float32) / world, g_shards)
+        if scale is not None:
+            g_shards = jax.tree_util.tree_map(lambda g: g / scale, g_shards)
+        gnorm = (global_norm_shards(g_shards, self.axis_name)
+                 if self.max_grad_norm is not None or metrics is not None
+                 else None)
+        if self.max_grad_norm is not None:
+            clip = jnp.minimum(1.0, self.max_grad_norm / (gnorm + 1e-6))
+            g_shards = jax.tree_util.tree_map(lambda g: g * clip, g_shards)
+
+        count = state.count + 1
+        t = count.astype(jnp.float32)
+        b1, b2 = self.betas
+        c1 = 1.0 - jnp.power(b1, t)
+        c2 = 1.0 - jnp.power(b2, t)
+        from apex_tpu.ops.fused_update import resolve_fused
+
+        use_fused = resolve_fused(self.fused_update)
+
+        g_l, treedef = jax.tree_util.tree_flatten(g_shards)
+        out = [adam_shard_update(
+            g, m, v, p, c1, c2, lr=self.lr, betas=self.betas, eps=self.eps,
+            weight_decay=self.weight_decay, adam_w_mode=self.adam_w_mode,
+            use_fused=use_fused)
+            for g, m, v, p in zip(
+                g_l, jax.tree_util.tree_leaves(state.mu),
+                jax.tree_util.tree_leaves(state.nu),
+                jax.tree_util.tree_leaves(state.master))]
+        master = jax.tree_util.tree_unflatten(treedef, [o[0] for o in out])
+        mu = jax.tree_util.tree_unflatten(treedef, [o[1] for o in out])
+        nu = jax.tree_util.tree_unflatten(treedef, [o[2] for o in out])
+        new_state = FSDPAdamState(count, master, mu, nu)
+        if metrics is None:
+            return new_state
+        delta = jax.tree_util.tree_map(lambda a, b: a - b, master,
+                                       state.master)
+        both = jnp.sqrt(lax.psum(
+            jnp.stack([local_sq(master), local_sq(delta)]), self.axis_name))
+        entries = dict(grad_norm=gnorm, param_norm=both[0],
+                       update_norm=both[1])
+        if meta is not None:
+            from apex_tpu.fsdp.accounting import hbm_params_bytes
+
+            gather = self.fsdp.gather_wire_bytes(meta, world)
+            entries["param_gather_bytes"] = gather
+            entries["comm_wire_bytes"] = (
+                gather + self.fsdp.reduce_wire_bytes(meta, world))
+            entries["hbm_params_bytes"] = hbm_params_bytes(
+                meta, strategy="fsdp", world=world,
+                shard_multiple=self.fsdp.shard_multiple)["total"]
+        return new_state, metrics.record(**entries)

@@ -43,8 +43,19 @@ _HIGHER = ("tokens_per_s", "goodput", "_rps", "mfu", "occupancy",
            "hit_rate", "acceptance_rate",
            # megakernel A/B: the fused-vs-per-op decode-step ratio is the
            # stage-12 headline — a shrinking speedup is a regression
-           "speedup")
-_LOWER = ("_ms", "violation", "latency", "bubble", "exposed_bytes")
+           "speedup",
+           # FSDP round: hidden ring bytes + the modeled HBM drop factor
+           # (checked BEFORE _LOWER, so these never fall into the generic
+           # *_bytes lower-is-better rules below)
+           "hidden_bytes", "hbm_reduction")
+_LOWER = ("_ms", "violation", "latency", "bubble", "exposed_bytes",
+          # FSDP round: the headline memory/wire accounting — growing
+          # per-chip param HBM, peak HBM or FSDP bytes-on-wire is a
+          # regression (hidden_fraction, the overlap headline, is in
+          # _HIGHER; wire_bytes_fsdp only — the generic "wire_bytes"
+          # fragment would also gate baseline-side columns like
+          # bench_overlap's wire_bytes_off, where only the ratio matters)
+          "hbm_params_bytes", "peak_hbm_bytes", "wire_bytes_fsdp")
 
 
 def classify_metric(key: str,

@@ -22,6 +22,7 @@ __all__ = [
     "TP_AXIS",
     "build_mesh",
     "DistributedDataParallel",
+    "ParallelismPlan",
     "Reducer",
     "SyncBatchNorm",
     "convert_syncbn_model",
@@ -35,6 +36,10 @@ def __getattr__(name):
             from apex_tpu.parallel import distributed
 
             return getattr(distributed, name)
+        if name == "ParallelismPlan":
+            from apex_tpu.parallel.plan import ParallelismPlan
+
+            return ParallelismPlan
         if name in ("SyncBatchNorm", "convert_syncbn_model"):
             from apex_tpu.parallel import sync_batchnorm
 

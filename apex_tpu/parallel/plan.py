@@ -1,0 +1,241 @@
+"""ParallelismPlan — every parallelism decision as ONE declarative object.
+
+Before this module, composing the repo's parallel machinery was pairwise
+wiring: examples hand-threaded DDP construction, compression configs, ZeRO
+optimizer knobs, mesh shapes, overlap flags and checkpoint managers, and
+every new strategy (now FSDP) would have multiplied the plumbing again.
+The reference has the same disease in ``parallel_state.py`` (four process
+group families built by hand at every call site); the GSPMD helpers the
+SNIPPETS collect solve it with one mesh + named specs. ``ParallelismPlan``
+is that idea for the whole stack:
+
+* **mesh axes** — dp/tp/pp/sp sizes, validated against ``mesh.AXIS_ORDER``
+  and the device count at :meth:`mesh` time (indivisible shapes fail
+  loudly, at construction, with the arithmetic in the message);
+* **data strategy** — ``"ddp"`` (replicated params, bucketed allreduce),
+  ``"zero1"`` (``DistributedFusedAdam/LAMB``: sharded optimizer state),
+  ``"fsdp"`` (``apex_tpu.fsdp``: sharded parameters, gather-on-demand);
+* **wire policy** — one ``CompressionConfig`` for the gradient leg, an
+  optional int8 ``weight_gather`` codec for the FSDP param gather, the
+  ZeRO-1 ``e5m2_allgather`` transport;
+* **overlap** — ``overlap_comm`` for the decomposed collective-matmul
+  rings (TP boundaries via ``GPTConfig.overlap_comm``, FSDP weights via
+  ``matmul_param_gather``);
+* **kernel policy** — the ``fused_update`` Pallas tail mode;
+* **composition hooks** — :meth:`checkpoint_manager` (resilience) and
+  :meth:`hbm_params_bytes` / :meth:`describe` (monitor/accounting), so
+  examples and benchmarks configure EVERYTHING through the plan.
+
+Presets cover the recipes the examples/benchmarks ship::
+
+    plan = ParallelismPlan.preset("fsdp+tp", tp=4)
+    mesh = plan.mesh()                 # validated dp×pp×sp×tp Mesh
+    opt = plan.build_optimizer(lr=1e-3)  # FSDPAdam riding plan.fsdp()
+    print(plan.describe())
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Sequence, Tuple
+
+from apex_tpu.parallel.mesh import AXIS_ORDER, DP_AXIS, build_mesh
+
+DATA_STRATEGIES = ("ddp", "zero1", "fsdp")
+PRESETS = ("ddp", "zero1", "fsdp", "fsdp+tp")
+OPTIMIZERS = ("adam", "lamb")
+
+
+@dataclasses.dataclass(frozen=True)
+class ParallelismPlan:
+    """Declarative parallelism config; every field is validated at
+    construction so a bad plan dies with a message, never mid-trace."""
+
+    # data-parallel strategy (the ZeRO ladder rung)
+    data: str = "ddp"
+    # mesh shape: dp=-1 means "all remaining devices"
+    dp: int = -1
+    tp: int = 1
+    pp: int = 1
+    sp: int = 1
+    # axis names — must come from mesh.AXIS_ORDER (one mesh vocabulary
+    # program-wide; a typo'd axis dies here, not as an unbound-name trace
+    # error deep inside a collective)
+    dp_axis: str = DP_AXIS
+    # wire policies
+    compression: Optional[Any] = None  # CompressionConfig for the grad leg
+    weight_gather: Optional[Any] = None  # int8 codec, FSDP param gather
+    e5m2_allgather: bool = False  # ZeRO-1 param all-gather transport
+    # overlap + kernels
+    overlap_comm: bool = False
+    bidirectional: bool = False
+    fused_update: str = "auto"
+    # optimizer family for the sharded strategies
+    optimizer: str = "adam"
+
+    def __post_init__(self):
+        if self.data not in DATA_STRATEGIES:
+            raise ValueError(
+                f"data must be one of {DATA_STRATEGIES}, got {self.data!r}")
+        if self.optimizer not in OPTIMIZERS:
+            raise ValueError(
+                f"optimizer must be one of {OPTIMIZERS}, "
+                f"got {self.optimizer!r}")
+        if self.dp_axis not in AXIS_ORDER:
+            raise ValueError(
+                f"dp_axis {self.dp_axis!r} is not a mesh axis; the mesh "
+                f"vocabulary is {AXIS_ORDER}")
+        for name in ("tp", "pp", "sp"):
+            v = getattr(self, name)
+            if not isinstance(v, int) or v < 1:
+                raise ValueError(f"{name} must be a positive int, got {v!r}")
+        if not isinstance(self.dp, int) or (self.dp < 1 and self.dp != -1):
+            raise ValueError(
+                f"dp must be a positive int or -1 (all remaining devices), "
+                f"got {self.dp!r}")
+        if self.e5m2_allgather and self.data != "zero1":
+            raise ValueError(
+                "e5m2_allgather is the ZeRO-1 param-gather transport; "
+                f"data={self.data!r} does not gather from a ZeRO-1 "
+                "optimizer (FSDP's analogue is weight_gather=)")
+        if self.weight_gather is not None and self.data != "fsdp":
+            raise ValueError(
+                "weight_gather is the FSDP param-gather codec; it has no "
+                f"wire to ride under data={self.data!r}")
+        if self.data == "fsdp" and self.optimizer != "adam":
+            raise ValueError(
+                "fsdp currently ships an Adam(W) shard optimizer only "
+                "(FSDPAdam); optimizer='lamb' is a ZeRO-1 recipe")
+        from apex_tpu.ops.fused_update import resolve_fused
+
+        resolve_fused(self.fused_update)
+        if self.data == "fsdp":
+            self.fsdp()  # runs the FSDP codec validation eagerly
+
+    # -- presets -----------------------------------------------------------
+    @classmethod
+    def preset(cls, name: str, **overrides) -> "ParallelismPlan":
+        """The named recipes the examples/benchmarks expose as ``--plan``:
+        ``ddp`` | ``zero1`` | ``fsdp`` | ``fsdp+tp`` (fsdp over dp composed
+        with tensor parallelism + overlapped rings; default tp=2)."""
+        if name not in PRESETS:
+            raise ValueError(
+                f"unknown plan preset {name!r}; presets: {PRESETS}")
+        base = {
+            "ddp": dict(data="ddp"),
+            "zero1": dict(data="zero1"),
+            "fsdp": dict(data="fsdp"),
+            "fsdp+tp": dict(data="fsdp", tp=2, overlap_comm=True),
+        }[name]
+        base.update(overrides)
+        return cls(**base)
+
+    # -- mesh --------------------------------------------------------------
+    def mesh(self, devices: Optional[Sequence[Any]] = None):
+        """The validated dp×pp×sp×tp Mesh (``build_mesh`` raises with the
+        divisibility arithmetic when the device count does not fit)."""
+        return build_mesh(tp=self.tp, pp=self.pp, sp=self.sp, dp=self.dp,
+                          devices=devices)
+
+    def model_axes(self) -> Tuple[str, ...]:
+        return tuple(a for a in AXIS_ORDER if a != self.dp_axis)
+
+    # -- component builders ------------------------------------------------
+    def ddp(self, **kw):
+        """The bucketed-allreduce DDP helper (data='ddp')."""
+        if self.data != "ddp":
+            raise ValueError(
+                f"plan.data={self.data!r}: gradients ride the sharded "
+                "optimizer's reduce-scatter, not a DDP allreduce")
+        from apex_tpu.parallel.distributed import DistributedDataParallel
+
+        return DistributedDataParallel(
+            axis=self.dp_axis, compression=self.compression, **kw)
+
+    def fsdp(self, **kw):
+        """The ZeRO-3 engine (data='fsdp')."""
+        if self.data != "fsdp":
+            raise ValueError(f"plan.data={self.data!r} is not fsdp")
+        from apex_tpu.fsdp import FSDP
+
+        return FSDP(axis_name=self.dp_axis, compression=self.compression,
+                    weight_gather=self.weight_gather,
+                    bidirectional=self.bidirectional, **kw)
+
+    def build_optimizer(self, lr: float = 1e-3, **kw):
+        """The plan's optimizer: ``zero1`` → ``DistributedFusedAdam/LAMB``
+        (sharded state, its own reduce-scatter/all-gather); ``fsdp`` →
+        ``FSDPAdam`` (shard-only step); ``ddp`` → plain ``FusedAdam/LAMB``
+        (pair with :meth:`ddp`'s ``average_gradients``)."""
+        if self.data == "zero1":
+            from apex_tpu.contrib.optimizers import (
+                DistributedFusedAdam,
+                DistributedFusedLAMB,
+            )
+
+            cls = (DistributedFusedAdam if self.optimizer == "adam"
+                   else DistributedFusedLAMB)
+            kwargs = dict(lr=lr, axis_name=self.dp_axis,
+                          compression=self.compression,
+                          fused_update=self.fused_update, **kw)
+            if self.optimizer == "adam":
+                kwargs["e5m2_allgather"] = self.e5m2_allgather
+            elif self.e5m2_allgather:
+                raise ValueError(
+                    "e5m2_allgather is a DistributedFusedAdam option")
+            return cls(**kwargs)
+        if self.data == "fsdp":
+            from apex_tpu.fsdp import FSDPAdam
+
+            return FSDPAdam(fsdp=self.fsdp(), lr=lr,
+                            fused_update=self.fused_update, **kw)
+        from apex_tpu.optimizers import FusedAdam, FusedLAMB
+
+        cls = FusedAdam if self.optimizer == "adam" else FusedLAMB
+        return cls(lr=lr, **kw)
+
+    def checkpoint_manager(self, directory: str, **kw):
+        """The resilience composition hook: an atomic manifested
+        ``CheckpointManager`` — FSDP/ZeRO shard pytrees ride its
+        fingerprinted (per-shard, under multi-process) manifest path."""
+        from apex_tpu.resilience import CheckpointManager
+
+        return CheckpointManager(directory, **kw)
+
+    def gpt_overrides(self) -> dict:
+        """``GPTConfig`` fields this plan pins (benchmarks/tests splice
+        them with ``dataclasses.replace``)."""
+        out = {}
+        if self.tp > 1:
+            out["megatron_sp"] = True
+            out["overlap_comm"] = self.overlap_comm
+        return out
+
+    # -- accounting / description ------------------------------------------
+    def hbm_params_bytes(self, params_or_meta, world: int) -> dict:
+        """Modeled per-chip param+grad+optimizer-state HBM of THIS plan's
+        data strategy (``fsdp/accounting.py``)."""
+        from apex_tpu.contrib.optimizers._sharding import shard_multiple_lcm
+        from apex_tpu.fsdp.accounting import hbm_params_bytes
+
+        return hbm_params_bytes(
+            params_or_meta, strategy=self.data, world=world,
+            shard_multiple=shard_multiple_lcm(self.compression,
+                                              self.weight_gather))
+
+    def describe(self) -> str:
+        """The resolved plan, printable — the examples' ``--plan`` echo."""
+        wire = self.compression.policy if self.compression else "fp32"
+        wgather = (self.weight_gather.policy if self.weight_gather
+                   else ("e5m2" if self.e5m2_allgather else "model-dtype"))
+        lines = [
+            f"ParallelismPlan(data={self.data}, optimizer={self.optimizer})",
+            f"  mesh: dp={self.dp if self.dp != -1 else 'auto'} pp={self.pp}"
+            f" sp={self.sp} tp={self.tp} (axes {AXIS_ORDER})",
+            f"  grad wire: {wire}; param gather: "
+            + (wgather if self.data != "ddp" else "n/a (replicated)"),
+            f"  overlap_comm={self.overlap_comm}"
+            f" bidirectional={self.bidirectional}"
+            f" fused_update={self.fused_update}",
+        ]
+        return "\n".join(lines)

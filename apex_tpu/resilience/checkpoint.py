@@ -24,6 +24,14 @@ mis-binds shards. This module layers the missing durability on
 * **retention GC** — keep-last-N plus keep-every-K milestones.
 * **latest_valid() discovery** — scan, verify manifests + checksums, and
   skip torn/corrupt checkpoints, so auto-resume always lands on a good one.
+* **per-shard manifests** — FSDP/ZeRO pytrees whose leaves are sharded
+  ACROSS processes are not refused: each process saves its local shards
+  under ``shard-p{K}/`` with its own fingerprinted manifest (leaf index +
+  shard placement + crc32), the main manifest records the dp degree, and
+  restore validates dp-degree + shard-shape/placement against the live
+  sharding before rebinding — skew is refused exactly like a revision
+  mismatch. The loud ``CheckpointError`` remains only for leaves with no
+  addressable replica-0 shard (genuinely non-addressable).
 
 Telemetry: each save records ``ckpt_save_ms`` / ``ckpt_bytes`` (readable on
 :attr:`CheckpointManager.last_save_ms`; pass ``sink=`` to append a
@@ -50,6 +58,12 @@ Pytree = Any
 
 MANIFEST_NAME = "manifest.json"
 MANIFEST_SCHEMA = 1
+# sharded checkpoints (FSDP/ZeRO leaves in per-process shard-p{K} payloads,
+# absent from the main payload) are a different on-disk format: they carry
+# schema 2 so a pre-sharding reader refuses with a loud schema mismatch
+# instead of a misleading "payload is missing leaf K" corruption error.
+# Plain checkpoints keep schema 1 (bidirectionally compatible).
+MANIFEST_SCHEMA_SHARDED = 2
 _PREFIX = "ckpt_"
 _TMP_PREFIX = ".tmp-"
 _TRASH_PREFIX = ".trash-"
@@ -75,44 +89,142 @@ def _crc(arr: np.ndarray) -> int:
     return zlib.crc32(np.ascontiguousarray(arr).tobytes())
 
 
-def _require_host_fetchable(leaves) -> None:
-    """Boundary of this module's checkpoint paths: every process must be
-    able to materialize the whole array (single-process meshes, or
-    replicated multihost state — ``device_get`` can fetch those). Arrays
-    SHARDED across processes need a per-process-shard writer (orbax's
-    multihost manager) — fail loudly with one clear error, not with a
-    device_get crash inside the preemption grace window."""
-    for x in leaves:
-        if (hasattr(x, "is_fully_addressable")
-                and not x.is_fully_addressable
-                and not getattr(x, "is_fully_replicated", False)):
-            raise CheckpointError(
-                "state contains an array sharded across processes "
-                f"(shape {getattr(x, 'shape', '?')}); checkpoint writes "
-                "happen on process 0 only and cannot fetch non-addressable "
-                "shards — all-gather the state first or use an orbax "
-                "multihost checkpointer")
+def _is_cross_process(x) -> bool:
+    """A leaf this process cannot materialize whole — an FSDP/ZeRO shard
+    pytree under multi-process SPMD. Module-level so tests can exercise
+    the per-shard path on a single-process mesh."""
+    return (hasattr(x, "is_fully_addressable")
+            and not x.is_fully_addressable
+            and not getattr(x, "is_fully_replicated", False))
+
+
+def _index_key(index, shape) -> str:
+    """Serializable key for a shard's position: 'start:stop' per dim.
+    Pins the shard SHAPE and placement, so a checkpoint written at a
+    different dp degree (different slicing) is refused at restore."""
+    out = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = dim if sl.stop is None else int(sl.stop)
+        out.append(f"{start}:{stop}")
+    return ",".join(out)
+
+
+def _local_shards(x):
+    """This process's unique (replica-0) shards of a cross-process-sharded
+    leaf: ``[(index_key, np.ndarray)]``. A leaf with NO addressable
+    replica-0 shard is genuinely non-addressable here — the loud refusal
+    stays for that case only."""
+    shards = [s for s in x.addressable_shards
+              if getattr(s, "replica_id", 0) == 0]
+    if not shards:
+        raise CheckpointError(
+            "state contains an array with no addressable replica-0 shard "
+            f"on this process (shape {getattr(x, 'shape', '?')}) — "
+            "genuinely non-addressable; all-gather it first or use an "
+            "orbax multihost checkpointer")
+    return [(_index_key(s.index, x.shape), np.asarray(s.data))
+            for s in shards]
+
+
+def _process_info():
+    try:
+        return jax.process_index(), jax.process_count()
+    except Exception:  # jax not initialized — single-process tooling
+        return 0, 1
 
 
 def state_dict(state: Pytree) -> Dict[str, Any]:
     """Pytree → flat fingerprinted dict (the manifest path's in-memory
     form): leaves keyed by flat index plus the structure fingerprint, so a
     restore against different code fails loudly instead of mis-binding.
-    The ZeRO optimizers and the DDP comm-state expose their sharded state
-    through this (gather or replicate cross-process shards first — see
-    :func:`_require_host_fetchable`)."""
+
+    FSDP/ZeRO shard pytrees ride the same path: a leaf SHARDED across
+    processes is stored as this process's local shards (``{"__sharded__":
+    ..., "shards": {index_key: array}}``) stamped with the process
+    index/count — :func:`load_state_dict` validates the dp degree and
+    every shard's placement before rebinding. Only a leaf with no
+    addressable replica-0 shard is refused."""
     leaves = jax.tree_util.tree_leaves(state)
-    _require_host_fetchable(leaves)
-    return {
-        "fingerprint": fingerprint(state),
-        "leaves": {str(i): np.asarray(x)
-                   for i, x in enumerate(jax.device_get(leaves))},
-    }
+    pidx, pcount = _process_info()
+    out: Dict[str, Any] = {"fingerprint": fingerprint(state), "leaves": {}}
+    host_idx = [i for i, x in enumerate(leaves) if not _is_cross_process(x)]
+    fetched = jax.device_get([leaves[i] for i in host_idx])
+    for i, h in zip(host_idx, fetched):
+        out["leaves"][str(i)] = np.asarray(h)
+    for i, x in enumerate(leaves):
+        if _is_cross_process(x):
+            out["leaves"][str(i)] = {
+                "__sharded__": True,
+                "global_shape": list(jnp.shape(x)),
+                "dtype": str(jnp.result_type(x)),
+                "process_index": pidx,
+                "process_count": pcount,
+                "shards": dict(_local_shards(x)),
+            }
+    return out
+
+
+def _manifest_ident(path: str):
+    """Filesystem identity (inode+mtime+size) of a published dir's
+    manifest — lets a peer distinguish a stale same-step dir (left by a
+    crashed previous run, possibly with a colliding ``save_seq``) from
+    process 0's fresh publish, whose manifest is always a new file."""
+    try:
+        st = os.stat(os.path.join(path, MANIFEST_NAME))
+    except OSError:
+        return None
+    return (st.st_ino, st.st_mtime_ns, st.st_size)
+
+
+def _restore_sharded_leaf(template_leaf, entry: Dict[str, Any], i: int):
+    """Rebind one per-shard entry onto the LIVE template leaf's sharding,
+    refusing dp-degree or shard-shape/placement skew — the failure mode
+    parameter sharding adds over replicated state."""
+    pidx, pcount = _process_info()
+    if entry["process_count"] != pcount:
+        raise CheckpointError(
+            f"leaf {i}: checkpoint shards were written at dp degree "
+            f"{entry['process_count']} processes, live mesh has {pcount} "
+            "— refusing to mis-bind shards (restore on the original "
+            "topology or all-gather + reshard explicitly)")
+    if list(jnp.shape(template_leaf)) != list(entry["global_shape"]):
+        raise CheckpointError(
+            f"leaf {i}: checkpoint global shape {entry['global_shape']} "
+            f"!= live {list(jnp.shape(template_leaf))}")
+    saved = entry["shards"]
+    live_shards = [s for s in template_leaf.addressable_shards
+                   if getattr(s, "replica_id", 0) == 0]
+    live_keys = {_index_key(s.index, template_leaf.shape)
+                 for s in live_shards}
+    if set(saved) != live_keys:
+        raise CheckpointError(
+            f"leaf {i}: shard layout skew — checkpoint holds shards "
+            f"{sorted(saved)}, live sharding expects {sorted(live_keys)} "
+            "(different dp degree or shard alignment)")
+    arrays = []
+    for s in template_leaf.addressable_shards:
+        key = _index_key(s.index, template_leaf.shape)
+        if key not in saved:
+            # an addressable replica>0 copy whose replica-0 home lives on
+            # another process: its bytes are in that process's shard
+            # payload, not ours — refuse loudly rather than KeyError
+            raise CheckpointError(
+                f"leaf {i}: live sharding places a replica copy of shard "
+                f"{key} on this process but its replica-0 home is on "
+                "another process — per-process shard payloads cannot "
+                "rebuild it; restore on the original topology")
+        arr = np.asarray(saved[key]).astype(
+            jnp.result_type(template_leaf), copy=False)
+        arrays.append(jax.device_put(arr, s.device))
+    return jax.make_array_from_single_device_arrays(
+        template_leaf.shape, template_leaf.sharding, arrays)
 
 
 def load_state_dict(template: Pytree, d: Dict[str, Any]) -> Pytree:
     """Restore a :func:`state_dict` blob onto ``template``'s structure,
-    refusing a fingerprint mismatch."""
+    refusing a fingerprint mismatch (and, for per-shard entries, any
+    dp-degree or shard-shape skew against the live sharding)."""
     live = fingerprint(template)
     saved = d.get("fingerprint")
     if saved is not None and saved != live:
@@ -125,10 +237,19 @@ def load_state_dict(template: Pytree, d: Dict[str, Any]) -> Pytree:
         raise CheckpointError(
             f"state_dict has {len(d['leaves'])} leaves, live structure "
             f"has {len(leaves)}")
-    return jax.tree_util.tree_unflatten(
-        treedef,
-        [jnp.asarray(d["leaves"][str(i)], jnp.result_type(leaves[i]))
-         for i in range(len(leaves))])
+    out = []
+    for i, leaf in enumerate(leaves):
+        entry = d["leaves"][str(i)]
+        if isinstance(entry, dict) and entry.get("__sharded__"):
+            if not _is_cross_process(leaf):
+                raise CheckpointError(
+                    f"leaf {i} was checkpointed as per-process shards but "
+                    "the live template is fully addressable — dp-degree "
+                    "skew; restore on the original topology")
+            out.append(_restore_sharded_leaf(leaf, entry, i))
+        else:
+            out.append(jnp.asarray(entry, jnp.result_type(leaf)))
+    return jax.tree_util.tree_unflatten(treedef, out)
 
 
 def _step_of(name: str) -> Optional[int]:
@@ -173,6 +294,7 @@ class CheckpointManager:
         fsync: bool = True,
         sink: Optional[Any] = None,
         process0_only: bool = True,
+        shard_publish_timeout_s: float = 60.0,
     ):
         self.directory = os.path.abspath(directory)
         self.keep_last_n = max(1, int(keep_last_n))
@@ -185,6 +307,15 @@ class CheckpointManager:
         # touches the shared directory — the JsonlSink gating pattern.
         # Reads (latest_valid/restore) stay ungated: they are idempotent.
         self.write_enabled = _is_process_zero() if process0_only else True
+        self._process0_only = bool(process0_only)
+        # how long a non-zero process waits for process 0's publish before
+        # declaring the sharded save failed (slow shared filesystems need
+        # more than the default)
+        self.shard_publish_timeout_s = float(shard_publish_timeout_s)
+        # save-call counter, advanced in lockstep on EVERY process (save()
+        # is SPMD): stamps the manifest so peers publishing shards can tell
+        # THIS save's dir from an older same-step dir (re-save)
+        self._save_seq = 0
         self.last_save_ms: Optional[float] = None
         self.last_save_bytes: Optional[int] = None
         self._pool: Optional[ThreadPoolExecutor] = None
@@ -217,13 +348,48 @@ class CheckpointManager:
         from apex_tpu.monitor.trace import span
 
         final = self.step_path(step)
+        # advanced on every process, even ones that end up writing nothing
+        # — the counters must stay in lockstep for the publish handshake
+        save_seq = self._save_seq
+        self._save_seq += 1
+        # captured NOW, before process 0 can have started this save's
+        # write: whatever dir currently sits at `final` is stale (an older
+        # save of this step) and must never receive this save's shards
+        stale_ident = None if self.write_enabled else _manifest_ident(final)
         leaves, _ = jax.tree_util.tree_flatten(state)
-        _require_host_fetchable(leaves)
-        if not self.write_enabled:
-            return final  # non-zero process under SPMD: no shared-dir write
+        pidx, pcount = _process_info()
+        # FSDP/ZeRO shard pytrees: leaves sharded ACROSS processes ride the
+        # per-process shard-payload path (each process saves its local
+        # shards; _local_shards raises the loud refusal for the genuinely
+        # non-addressable case). Everything else is process-0's payload.
+        shard_entries: List[Tuple[int, str, np.ndarray]] = []
+        host_idx = []
+        for i, x in enumerate(leaves):
+            if _is_cross_process(x):
+                for key, arr in _local_shards(x):
+                    shard_entries.append((i, key, arr))
+            else:
+                host_idx.append(i)
+        if not self.write_enabled and not shard_entries:
+            return final  # non-zero process, nothing sharded: no write
         self._raise_pending()
         t0 = time.perf_counter()
         sync = not self.async_save if block is None else block
+        if shard_entries and pcount > 1:
+            if not self._process0_only:
+                # with every process a full writer there is no single
+                # manifest owner: each would publish its own step dir
+                # holding only its own shard-p{K} and the last os.replace
+                # wins — every save would verify as torn
+                raise CheckpointError(
+                    "multi-process sharded saves need process0_only=True: "
+                    "the per-shard publish protocol has process 0 own the "
+                    "manifest and peers rename their shard dirs in")
+            # multi-process sharded saves publish in two phases (shard
+            # subdirs land after process 0's manifest) — keep the whole
+            # sequence on the caller so the preemption barrier that agreed
+            # on the step also brackets the write
+            sync = True
         if not sync:
             # backpressure: at most ONE in-flight async save — a second
             # submit would pin a second full host snapshot of the state
@@ -231,42 +397,163 @@ class CheckpointManager:
             # cadence); blocking here degrades to sync-save pacing instead
             self.wait()
         with span("ckpt"):
-            host = [np.asarray(x) for x in jax.device_get(leaves)]
+            if self.write_enabled:
+                fetched = jax.device_get([leaves[i] for i in host_idx])
+                host = list(zip(host_idx,
+                                [np.asarray(h) for h in fetched]))
+            else:
+                # non-writer process: _write ignores the replicated
+                # payload — don't pay a full device→host transfer on the
+                # forced-sync critical path for bytes never written
+                host = []
             if not sync:
                 # donation safety: on the CPU backend device_get can alias
                 # the live buffer, which a donating train step may overwrite
                 # while the worker is still serializing — snapshot it. (The
                 # checksum/serialize work itself runs on the worker.)
-                host = [np.array(h, copy=True) for h in host]
+                host = [(i, np.array(h, copy=True)) for i, h in host]
+                shard_entries = [(i, k, np.array(a, copy=True))
+                                 for i, k, a in shard_entries]
         meta = {
-            "schema": MANIFEST_SCHEMA,
+            "schema": (MANIFEST_SCHEMA_SHARDED if shard_entries
+                       else MANIFEST_SCHEMA),
             "step": int(step),
+            "save_seq": save_seq,
             "fingerprint": fingerprint(state),
+            "num_leaves": len(leaves),
         }
+        if shard_entries:
+            sharded = {}
+            for i, _, _ in shard_entries:
+                sharded[str(i)] = {
+                    "global_shape": list(jnp.shape(leaves[i])),
+                    "dtype": str(jnp.result_type(leaves[i])),
+                    "dp_degree": pcount,
+                }
+            meta["sharded"] = sharded
         if sync:
             self.wait()  # a sync save must not interleave with the worker
-            self._write(host, meta, final, t0)
+            self._write(host, shard_entries, meta, final, t0, stale_ident)
         else:
             if self._pool is None:
                 self._pool = ThreadPoolExecutor(
                     max_workers=1, thread_name_prefix="apex-tpu-ckpt")
             with self._lock:
                 self._pending.append(self._pool.submit(
-                    self._write, host, meta, final, t0))
+                    self._write, host, shard_entries, meta, final, t0,
+                    stale_ident))
         return final
 
-    def _write(self, host: List[np.ndarray], meta: Dict[str, Any],
-               final: str, t0: float) -> None:
+    def _write_shard_subdir(self, parent: str,
+                            shard_entries: List[Tuple[int, str, np.ndarray]],
+                            meta: Dict[str, Any]) -> int:
+        """This process's shard payload + fingerprinted shard manifest
+        under ``parent/shard-p{K}``; returns the shard bytes."""
         from apex_tpu.utils.checkpoint import save_checkpoint
 
+        pidx, pcount = _process_info()
+        sub = os.path.join(parent, f"shard-p{pidx}")
+        os.makedirs(sub, exist_ok=True)
+        payload = save_checkpoint(
+            os.path.join(sub, "payload"),
+            {f"{i}|{key}": arr for i, key, arr in shard_entries})
+        manifest = {
+            "schema": MANIFEST_SCHEMA,
+            "step": meta["step"],
+            "process_index": pidx,
+            "process_count": pcount,
+            "payload": os.path.basename(payload),
+            "shards": [{"leaf": i, "index": key, "shape": list(a.shape),
+                        "dtype": str(a.dtype), "crc32": _crc(a)}
+                       for i, key, a in shard_entries],
+        }
+        with open(os.path.join(sub, MANIFEST_NAME), "w") as f:
+            json.dump(manifest, f)
+            if self.fsync:
+                f.flush()
+                os.fsync(f.fileno())
+        return int(sum(a.nbytes for _, _, a in shard_entries))
+
+    def _publish_shard_subdir(self, shard_entries, meta, final,
+                              stale_ident=None) -> None:
+        """Non-zero process under multi-process SPMD: stage this process's
+        shards, wait for process 0 to publish THIS save's checkpoint dir,
+        then rename the staging in. A crash before the rename leaves a
+        manifest whose expected shard dir is missing — verify() reports
+        the checkpoint torn, exactly like a torn payload.
+
+        The wait must not match an OLDER dir for the same step (re-save:
+        process 0 parks the old copy and publishes a fresh dir — renaming
+        into the old one would land the shard in the copy about to be
+        trashed). The fresh dir is recognized by its manifest carrying
+        this save's ``save_seq``, not being the dir captured as stale at
+        save() entry (``stale_ident`` closes the restart case where a
+        crashed previous run left a torn dir whose save_seq collides),
+        and not yet holding this process's shard subdir (a completed
+        older save always holds one)."""
+        pidx, _ = _process_info()
+        staging = os.path.join(
+            self.directory,
+            f"{_TMP_PREFIX}shard-{os.path.basename(final)}-p{pidx}")
+        if os.path.isdir(staging):
+            shutil.rmtree(staging)
+        os.makedirs(staging)
+
+        def _fresh_dir_published() -> bool:
+            if os.path.exists(os.path.join(final, f"shard-p{pidx}")):
+                return False  # an older, completed copy of this step
+            ident = _manifest_ident(final)
+            if ident is None or ident == stale_ident:
+                return False  # absent, or the stale copy seen at entry
+            try:
+                with open(os.path.join(final, MANIFEST_NAME)) as f:
+                    m = json.load(f)
+            except (OSError, ValueError):
+                return False
+            return m.get("save_seq") == meta["save_seq"]
+
+        try:
+            self._write_shard_subdir(staging, shard_entries, meta)
+            deadline = time.monotonic() + self.shard_publish_timeout_s
+            while not _fresh_dir_published():
+                if time.monotonic() > deadline:
+                    raise CheckpointError(
+                        f"process {pidx}: {final} (save_seq "
+                        f"{meta['save_seq']}) was never published by "
+                        "process 0 — this save is lost on this process "
+                        "(its staged shards are discarded)")
+                time.sleep(0.05)
+            # _write_shard_subdir staged under staging/shard-p{K}
+            os.replace(os.path.join(staging, f"shard-p{pidx}"),
+                       os.path.join(final, f"shard-p{pidx}"))
+        finally:
+            shutil.rmtree(staging, ignore_errors=True)
+
+    def _write(self, host: List[Tuple[int, np.ndarray]],
+               shard_entries: List[Tuple[int, str, np.ndarray]],
+               meta: Dict[str, Any], final: str, t0: float,
+               stale_ident=None) -> None:
+        from apex_tpu.utils.checkpoint import save_checkpoint
+
+        if not self.write_enabled:
+            # non-zero process: only its shard subdir (sharded saves only
+            # reach here with shard entries)
+            self._publish_shard_subdir(shard_entries, meta, final,
+                                       stale_ident)
+            ms = (time.perf_counter() - t0) * 1000.0
+            self.last_save_ms = ms
+            self.last_save_bytes = int(
+                sum(a.nbytes for _, _, a in shard_entries))
+            return
         # checksum + manifest assembly on the worker: the host list is a
         # private snapshot, so only the device transfer had to stay on the
         # caller (the async save's critical-path cost)
         manifest = dict(
             meta,
-            leaves=[{"shape": list(h.shape), "dtype": str(h.dtype),
-                     "crc32": _crc(h)} for h in host],
-            bytes=int(sum(h.nbytes for h in host)))
+            leaves=[{"leaf_index": i, "shape": list(h.shape),
+                     "dtype": str(h.dtype), "crc32": _crc(h)}
+                    for i, h in host],
+            bytes=int(sum(h.nbytes for _, h in host)))
         os.makedirs(self.directory, exist_ok=True)
         tmp = os.path.join(
             self.directory,
@@ -277,8 +564,13 @@ class CheckpointManager:
         try:
             payload = save_checkpoint(
                 os.path.join(tmp, "payload"),
-                {str(i): h for i, h in enumerate(host)})
+                {str(i): h for i, h in host})
             manifest = dict(manifest, payload=os.path.basename(payload))
+            if shard_entries:
+                # process 0's own shards land INSIDE the staging dir, so
+                # the atomic publish below covers them too
+                manifest["bytes"] += self._write_shard_subdir(
+                    tmp, shard_entries, meta)
             mpath = os.path.join(tmp, MANIFEST_NAME)
             with open(mpath, "w") as f:
                 json.dump(manifest, f)
@@ -352,10 +644,10 @@ class CheckpointManager:
     def read_manifest(self, path: str) -> Dict[str, Any]:
         with open(os.path.join(path, MANIFEST_NAME)) as f:
             m = json.load(f)
-        if m.get("schema") != MANIFEST_SCHEMA:
+        if m.get("schema") not in (MANIFEST_SCHEMA, MANIFEST_SCHEMA_SHARDED):
             raise CheckpointError(
-                f"{path}: manifest schema {m.get('schema')!r} != "
-                f"{MANIFEST_SCHEMA}")
+                f"{path}: manifest schema {m.get('schema')!r} not in "
+                f"{(MANIFEST_SCHEMA, MANIFEST_SCHEMA_SHARDED)}")
         return m
 
     def _load_leaves(self, path: str, manifest: Dict[str, Any]
@@ -363,12 +655,61 @@ class CheckpointManager:
         from apex_tpu.utils.checkpoint import load_checkpoint
 
         blob = load_checkpoint(os.path.join(path, manifest["payload"]))
-        n = len(manifest["leaves"])
+        entries = manifest["leaves"]
         try:
-            return [np.asarray(blob[str(i)]) for i in range(n)]
+            # keys are original flat leaf indices (sharded leaves are
+            # absent — they live in the per-process shard payloads); old
+            # manifests without leaf_index are positional
+            return [np.asarray(blob[str(e.get("leaf_index", j))])
+                    for j, e in enumerate(entries)]
         except KeyError as e:
             raise CheckpointError(
-                f"{path}: payload is missing leaf {e} of {n}") from e
+                f"{path}: payload is missing leaf {e} of "
+                f"{len(entries)}") from e
+
+    def _load_shard_dir(self, path: str, manifest: Dict[str, Any]):
+        """This process's shard payload of a sharded checkpoint:
+        ``{leaf_index: {index_key: np.ndarray}}`` after verifying the
+        shard manifest + per-shard crc32s; raises CheckpointError on a
+        missing/torn shard dir (a crash between process 0's publish and
+        this process's shard rename)."""
+        from apex_tpu.utils.checkpoint import load_checkpoint
+
+        pidx, _ = _process_info()
+        sub = os.path.join(path, f"shard-p{pidx}")
+        try:
+            with open(os.path.join(sub, MANIFEST_NAME)) as f:
+                sm = json.load(f)
+        except OSError as e:
+            raise CheckpointError(
+                f"{path}: missing shard dir for process {pidx} "
+                "(torn sharded save)") from e
+        if sm.get("schema") != MANIFEST_SCHEMA:
+            raise CheckpointError(
+                f"{sub}: shard manifest schema {sm.get('schema')!r} != "
+                f"{MANIFEST_SCHEMA}")
+        blob = load_checkpoint(os.path.join(sub, sm["payload"]))
+        out: Dict[int, Dict[str, np.ndarray]] = {}
+        for spec in sm["shards"]:
+            key = f"{spec['leaf']}|{spec['index']}"
+            try:
+                arr = np.asarray(blob[key])
+            except KeyError as e:
+                raise CheckpointError(
+                    f"{sub}: shard payload is missing {key}") from e
+            if (list(arr.shape) != spec["shape"]
+                    or str(arr.dtype) != spec["dtype"]
+                    or _crc(arr) != spec["crc32"]):
+                raise CheckpointError(
+                    f"{sub}: shard {key} fails its manifest "
+                    "shape/dtype/crc32 — corrupt shard payload")
+            out.setdefault(int(spec["leaf"]), {})[spec["index"]] = arr
+        expected = set(manifest.get("sharded", {}))
+        if {str(i) for i in out} != expected:
+            raise CheckpointError(
+                f"{sub}: shard payload covers leaves {sorted(out)}, "
+                f"manifest expects {sorted(expected)}")
+        return out, sm
 
     def verify(self, path: str) -> bool:
         """True iff ``path`` holds a complete, uncorrupted checkpoint:
@@ -380,8 +721,7 @@ class CheckpointManager:
         except Exception:
             return False
 
-    def _verify_or_raise(self, path: str) -> Tuple[Dict[str, Any],
-                                                   List[np.ndarray]]:
+    def _verify_or_raise(self, path: str):
         manifest = self.read_manifest(path)
         host = self._load_leaves(path, manifest)
         for i, (h, spec) in enumerate(zip(host, manifest["leaves"])):
@@ -392,7 +732,35 @@ class CheckpointManager:
             if _crc(h) != spec["crc32"]:
                 raise CheckpointError(
                     f"{path}: leaf {i} fails its crc32 — corrupt payload")
-        return manifest, host
+        shards = None
+        if manifest.get("sharded"):
+            self._check_all_shard_dirs(path, manifest)
+            shards, _ = self._load_shard_dir(path, manifest)
+        return manifest, host, shards
+
+    def _check_all_shard_dirs(self, path: str,
+                              manifest: Dict[str, Any]) -> None:
+        """EVERY process's shard dir must be present and step-consistent.
+        Checked by every process (not just for its own shard) so all ranks
+        reach the same verify()/latest_valid() verdict — a torn save makes
+        the whole job fall back to the previous checkpoint instead of rank
+        K alone restoring older state and diverging from its peers."""
+        degree = max(int(s["dp_degree"])
+                     for s in manifest["sharded"].values())
+        for p in range(degree):
+            sub = os.path.join(path, f"shard-p{p}")
+            try:
+                with open(os.path.join(sub, MANIFEST_NAME)) as f:
+                    sm = json.load(f)
+            except OSError as e:
+                raise CheckpointError(
+                    f"{path}: records dp degree {degree} but the shard dir "
+                    f"for process {p} is missing — torn sharded save or "
+                    "dp-degree skew") from e
+            if sm.get("step") != manifest["step"]:
+                raise CheckpointError(
+                    f"{sub}: shard dir step {sm.get('step')} != manifest "
+                    f"step {manifest['step']} — stale shard dir")
 
     def latest_valid(self) -> Optional[str]:
         """Path of the newest checkpoint that verifies; torn or corrupt
@@ -421,7 +789,7 @@ class CheckpointManager:
                 raise CheckpointError(
                     f"no valid checkpoint under {self.directory}")
         try:
-            manifest, host = self._verify_or_raise(path)
+            manifest, host, shards = self._verify_or_raise(path)
         except CheckpointError:
             raise
         except Exception as e:
@@ -437,10 +805,37 @@ class CheckpointManager:
                 "train-state revision — refusing to mis-bind state.\n"
                 f"   saved: {manifest['fingerprint'][:200]}...\n"
                 f"   live:  {live[:200]}...")
-        treedef = jax.tree_util.tree_structure(target)
-        state = jax.tree_util.tree_unflatten(
-            treedef, [jnp.asarray(h) for h in host])
-        return state, int(manifest["step"])
+        leaves, treedef = jax.tree_util.tree_flatten(target)
+        sharded = manifest.get("sharded", {})
+        if not sharded:
+            state = jax.tree_util.tree_unflatten(
+                treedef, [jnp.asarray(h) for h in host])
+            return state, int(manifest["step"])
+        by_idx = {e.get("leaf_index", j): h
+                  for j, (e, h) in enumerate(zip(manifest["leaves"], host))}
+        out = []
+        for i, leaf in enumerate(leaves):
+            if str(i) in sharded:
+                spec = sharded[str(i)]
+                if not _is_cross_process(leaf):
+                    raise CheckpointError(
+                        f"{path}: leaf {i} was saved as per-process shards "
+                        "(dp degree "
+                        f"{spec['dp_degree']}) but the live target is "
+                        "fully addressable — dp-degree skew; restore on "
+                        "the original topology")
+                entry = {
+                    "__sharded__": True,
+                    "global_shape": spec["global_shape"],
+                    "dtype": spec["dtype"],
+                    "process_count": spec["dp_degree"],
+                    "shards": shards[i],
+                }
+                out.append(_restore_sharded_leaf(leaf, entry, i))
+            else:
+                out.append(jnp.asarray(by_idx[i]))
+        return (jax.tree_util.tree_unflatten(treedef, out),
+                int(manifest["step"]))
 
     # -- retention ---------------------------------------------------------
     def _gc(self) -> None:
@@ -451,6 +846,23 @@ class CheckpointManager:
         for name in os.listdir(self.directory):
             if name.endswith(pid_suffix):
                 continue  # this writer's own live staging
+            if name.startswith(f"{_TMP_PREFIX}shard-"):
+                # another process's shard staging. A LIVE peer mid-publish
+                # (its step's dir exists but its shard is not yet renamed
+                # in) must not be torn — but a dead peer's staging would
+                # otherwise leak one shard-sized dir per crash. Dead means
+                # the publish can no longer complete: the step dir is gone
+                # (GC'd / never published before the job died) or already
+                # holds this process's shard (rename done, cleanup lost).
+                rest = name[len(f"{_TMP_PREFIX}shard-"):]
+                target, _, pname = rest.rpartition("-")
+                tdir = os.path.join(self.directory, target)
+                if (not os.path.isdir(tdir)
+                        or os.path.exists(os.path.join(
+                            tdir, f"shard-{pname}"))):
+                    shutil.rmtree(os.path.join(self.directory, name),
+                                  ignore_errors=True)
+                continue
             p = os.path.join(self.directory, name)
             if name.startswith(_TMP_PREFIX):
                 # a dead writer's staging dir: never completed, delete

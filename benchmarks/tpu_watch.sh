@@ -43,6 +43,7 @@ last_slo=-3600      # stage-10 (serve goodput-SLO) same hourly contract
 last_prefix=-3600   # stage-11 (shared-prefix + speculative) same contract
 last_mega=-3600     # stage-12 (megakernel decode A/B) same contract
 last_fusedupd=-3600 # stage-13 (fused update tail) same contract
+last_fsdp=-3600     # stage-14 (fsdp vs zero1 A/B) same contract
 
 note() { echo "$(date '+%F %T') $*" >> "$LOG"; }
 
@@ -327,6 +328,49 @@ $(cat /tmp/tpu_stage13_regress.out)"
   return 0
 }
 
+fsdp_stage() {
+  # stage 14: FSDP (ZeRO-3) vs DDP+ZeRO-1 A/B (benchmarks/bench_fsdp.py)
+  # — step ms both sides, peak HBM (device_memory_stats on chip, modeled
+  # hbm_params_bytes otherwise), wire bytes, and the gather ring's
+  # HLO-proven hidden_fraction. Same promote rules as stages 10-13: CPU
+  # rehearsals (_CPU_FALLBACK) never promote; REGRESSION-GATED via
+  # monitor.regress --tol 0.15 once banked; hourly even after banked so
+  # a step-time / HBM / hidden-fraction regression surfaces within an
+  # hour.
+  note "STAGE14 START: bench_fsdp.py"
+  rm -f /tmp/fsdp_try.json
+  timeout 1800 python benchmarks/bench_fsdp.py \
+    --out /tmp/fsdp_try.json \
+    > /tmp/tpu_stage14.out 2> /tmp/tpu_stage14.err
+  local rc=$?
+  note "STAGE14 EXIT=$rc"
+  [ -s /tmp/fsdp_try.json ] || return 1
+  if grep -q CPU_FALLBACK /tmp/fsdp_try.json; then
+    note "STAGE14 got CPU_FALLBACK, not promoting"
+    return 1
+  fi
+  # an under-overlapped ring (ok=false: hidden_fraction < 0.5) is a
+  # correctness-of-claim failure, never a baseline
+  if grep -Eq '"ok": false' /tmp/fsdp_try.json; then
+    note "STAGE14 record has ok false, not promoting"
+    return 1
+  fi
+  if [ -s FSDP_TPU.json ]; then
+    if ! python -m apex_tpu.monitor.regress FSDP_TPU.json \
+        /tmp/fsdp_try.json --tol 0.15 \
+        > /tmp/tpu_stage14_regress.out 2>> /tmp/tpu_stage14.err; then
+      note "STAGE14 REGRESSION vs banked, keeping banked record: \
+$(cat /tmp/tpu_stage14_regress.out)"
+      return 1
+    fi
+  fi
+  cp /tmp/fsdp_try.json FSDP_TPU.json
+  note "STAGE14 PROMOTED $(cat FSDP_TPU.json)"
+  [ $rc -eq 0 ] || return 1
+  [ "$(cat "$STATE")" -eq 13 ] && echo 14 > "$STATE"
+  return 0
+}
+
 smoke_stage() {
   # Smoke to a temp file; promote ANY real-TPU artifact (a failing kernel
   # on the chip is exactly the evidence we must bank) but never a CPU
@@ -409,6 +453,13 @@ while true; do
         if [ $((now - last_fusedupd)) -ge 3600 ]; then
           fusedupd_stage
           last_fusedupd=$now
+        fi
+        # stage 14 (FSDP vs ZeRO-1 A/B): same hourly re-measure-after-
+        # banked contract — an HBM/step-time/hidden-fraction regression
+        # must surface within an hour
+        if [ $((now - last_fsdp)) -ge 3600 ]; then
+          fsdp_stage
+          last_fsdp=$now
         fi
         last_refresh=$now
       fi

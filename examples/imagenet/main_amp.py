@@ -39,12 +39,21 @@ from apex_tpu.amp.autocast import autocast
 from apex_tpu.models import ResNet18, ResNet50
 from apex_tpu.models.resnet import make_norm
 from apex_tpu.optimizers import FusedSGD
-from apex_tpu.parallel import DistributedDataParallel
-from apex_tpu.parallel.mesh import DP_AXIS, build_mesh
+from apex_tpu.parallel import ParallelismPlan
+from apex_tpu.parallel.mesh import DP_AXIS
 
 
 def parse_args(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--plan", default="ddp",
+                   choices=["ddp", "zero1", "fsdp", "fsdp+tp"],
+                   help="ParallelismPlan preset. 'ddp' is the reference "
+                        "recipe (SGD + amp, replicated params); 'zero1' / "
+                        "'fsdp' switch to the sharded Adam optimizers "
+                        "(DistributedFusedAdam / FSDPAdam — the sharded "
+                        "families are Adam/LAMB) and run fp32 (O0). "
+                        "'fsdp+tp' resolves the dp×tp mesh; the ResNet "
+                        "defines no TP layers, so tp replicates compute")
     p.add_argument("--arch", default="resnet50",
                    choices=["resnet18", "resnet50"])
     p.add_argument("-b", "--batch-size", type=int, default=64,
@@ -97,14 +106,17 @@ _STEP_CACHE = {}
 
 
 def _step_key(args):
-    return (args.arch, args.batch_size, args.image_size, args.num_classes,
-            args.lr, args.momentum, args.weight_decay, args.opt_level,
-            args.loss_scale, args.keep_batchnorm_fp32, args.sync_bn)
+    return (args.plan, args.arch, args.batch_size, args.image_size,
+            args.num_classes, args.lr, args.momentum, args.weight_decay,
+            args.opt_level, args.loss_scale, args.keep_batchnorm_fp32,
+            args.sync_bn)
 
 
 def train(args) -> List[float]:
     """Run the loop; returns the per-iteration loss list (the L1 contract)."""
-    mesh = build_mesh(tp=1, pp=1, sp=1)
+    plan = ParallelismPlan.preset(args.plan)
+    print(plan.describe())
+    mesh = plan.mesh()
     dp = mesh.shape[DP_AXIS]
     if args.batch_size % dp != 0:
         raise ValueError(f"batch {args.batch_size} % dp {dp} != 0")
@@ -117,6 +129,17 @@ def train(args) -> List[float]:
     sample = jnp.zeros((2, args.image_size, args.image_size, 3))
     variables = model.init(rng, sample, use_running_average=False)
     params, batch_stats = variables["params"], variables["batch_stats"]
+    print("  modeled hbm_params_bytes:",
+          {k: int(v)
+           for k, v in plan.hbm_params_bytes(params, world=dp).items()})
+
+    if plan.data != "ddp":
+        if args.opt_level != "O0":
+            raise SystemExit(
+                f"--plan {args.plan} runs the sharded fp32 Adam loop; "
+                "pass --opt-level O0 (amp×FSDP composition is a "
+                "benchmarks/bench_fsdp.py + GPT story)")
+        return _train_sharded(args, plan, mesh, model, params, batch_stats)
 
     overrides = {}
     if args.loss_scale is not None:
@@ -131,7 +154,7 @@ def train(args) -> List[float]:
     tx = FusedSGD(lr=args.lr, momentum=args.momentum,
                   weight_decay=args.weight_decay)
     opt_state = tx.init(amp_state.master_params)
-    ddp = DistributedDataParallel()
+    ddp = plan.ddp()
 
     cached = _STEP_CACHE.get(_step_key(args))
     if cached is not None:
@@ -194,6 +217,112 @@ def train(args) -> List[float]:
     return _run_loop(args, step, amp_state, opt_state, batch_stats)
 
 
+def _train_sharded(args, plan, mesh, model, params, batch_stats
+                   ) -> List[float]:
+    """zero1 / fsdp: the plan-built sharded-Adam loop (fp32). Replaces the
+    old hand-threaded optimizer wiring with ``plan.build_optimizer``; the
+    batch stats stay replicated and dp-meaned exactly like the ddp path."""
+    from jax.sharding import PartitionSpec as P
+
+    opt = plan.build_optimizer(lr=args.lr, weight_decay=args.weight_decay)
+    pspecs = jax.tree_util.tree_map(lambda _: P(), params)
+    bspecs = jax.tree_util.tree_map(lambda _: P(), batch_stats)
+    shard = jax.tree_util.tree_map(lambda _: P(DP_AXIS), params)
+
+    def loss_fn(model_p, bs, images, labels):
+        logits, upd = model.apply(
+            {"params": model_p, "batch_stats": bs}, images,
+            use_running_average=False, mutable=["batch_stats"])
+        onehot = jax.nn.one_hot(labels, args.num_classes)
+        loss = -jnp.mean(jnp.sum(
+            jax.nn.log_softmax(logits.astype(jnp.float32)) * onehot, -1))
+        return loss, upd["batch_stats"]
+
+    def pmean(s):
+        if hasattr(jax, "typeof") and DP_AXIS not in jax.typeof(s).vma:
+            s = jax.lax.pcast(s, DP_AXIS, to="varying")
+        return jax.lax.pmean(s, DP_AXIS)
+
+    if plan.data == "fsdp":
+        from apex_tpu.fsdp import FSDPAdamState
+
+        fsdp = plan.fsdp()
+        meta = fsdp.meta(params)
+        sspec = (FSDPAdamState(count=P(), master=shard, mu=shard, nu=shard),
+                 bspecs)
+
+        def init_fn(p, bs):
+            return opt.init(p), bs
+
+        def body(st, images, labels):
+            ostate, bs = st
+
+            def wrapped(master):
+                return loss_fn(fsdp.gather(master, meta), bs, images,
+                               labels)
+
+            (loss, new_bs), g = jax.value_and_grad(
+                wrapped, has_aux=True)(ostate.master)
+            ostate = opt.step(g, ostate)
+            new_bs = jax.tree_util.tree_map(pmean, new_bs)
+            return (ostate, new_bs), pmean(loss)
+    else:  # zero1
+        from apex_tpu.contrib.optimizers.distributed_fused_adam import (
+            DistAdamState,
+        )
+
+        sspec = (pspecs,
+                 DistAdamState(count=P(), master=shard, mu=shard, nu=shard),
+                 bspecs)
+
+        def init_fn(p, bs):
+            return p, opt.init(p), bs
+
+        def body(st, images, labels):
+            p, ostate, bs = st
+            (loss, new_bs), g = jax.value_and_grad(
+                loss_fn, has_aux=True)(p, bs, images, labels)
+            p, ostate = opt.step(g, ostate, p)
+            new_bs = jax.tree_util.tree_map(pmean, new_bs)
+            return (p, ostate, new_bs), pmean(loss)
+
+    init = jax.jit(jax.shard_map(
+        init_fn, mesh=mesh, in_specs=(pspecs, bspecs), out_specs=sspec,
+        check_vma=False))
+    step = jax.jit(jax.shard_map(
+        body, mesh=mesh, in_specs=(sspec, P(DP_AXIS), P(DP_AXIS)),
+        out_specs=(sspec, P()), check_vma=False))
+    state = init(params, batch_stats)
+
+    mgr = _make_manager(args) if args.checkpoint_dir else None
+    state, start_it = _resolve_resume(args, mgr, state)
+
+    losses = []
+    data_rng = jax.random.PRNGKey(args.seed + 1)
+    t0 = time.perf_counter()
+    for it in range(start_it, args.iters):
+        k = jax.random.fold_in(data_rng, it)
+        images = jax.random.normal(
+            k, (args.batch_size, args.image_size, args.image_size, 3))
+        labels = jax.random.randint(
+            jax.random.fold_in(k, 1), (args.batch_size,), 0,
+            args.num_classes)
+        state, loss = step(state, images, labels)
+        losses.append(float(loss))
+        if it % args.print_freq == 0 or it == args.iters - 1:
+            dt = time.perf_counter() - t0
+            ips = args.batch_size * (it - start_it + 1) / dt
+            print(f"iter {it:4d}  loss {losses[-1]:.6f}  {ips:,.1f} img/s")
+        if mgr is not None and (
+                it == args.iters - 1
+                or (args.save_freq and (it + 1) % args.save_freq == 0)):
+            p = mgr.save(state, it + 1)
+            print(f"=> saved checkpoint '{p}' (iter {it + 1})")
+    if mgr is not None:
+        mgr.close()
+    return losses
+
+
 def _make_manager(args):
     from apex_tpu.resilience import CheckpointManager
 
@@ -202,42 +331,48 @@ def _make_manager(args):
         keep_every_k=args.keep_every_k, async_save=args.async_save)
 
 
+def _resolve_resume(args, mgr, state):
+    """The resume contract shared by the ddp and sharded loops: restore
+    the train state and continue at the saved iteration. The manager
+    re-hangs the flat leaves on the LIVE treedef after verifying the
+    manifest fingerprint + per-leaf checksums — a torn or revision-skewed
+    checkpoint is refused, not mis-bound. ``--resume auto`` is a standing
+    relaunch flag: no checkpoint yet (first launch, or all torn) means
+    start fresh, not die."""
+    from apex_tpu.resilience import CheckpointError
+
+    start_it = 0
+    if not args.resume:
+        return state, start_it
+    restore_mgr = mgr or _make_manager(args)
+    if args.resume == "auto":
+        if not args.checkpoint_dir:
+            raise SystemExit("--resume auto needs --checkpoint-dir")
+        path = restore_mgr.latest_valid()
+    else:
+        path = args.resume
+    if path is None:
+        print(f"=> no valid checkpoint in '{args.checkpoint_dir}' yet; "
+              "starting fresh")
+        return state, start_it
+    try:
+        state, start_it = restore_mgr.restore(target=state, path=path)
+    except CheckpointError as e:
+        raise SystemExit(f"=> {e}")
+    print(f"=> loaded checkpoint '{path}' (resuming at iter {start_it})")
+    if start_it >= args.iters:
+        raise SystemExit(
+            f"checkpoint is already at iter {start_it} >= --iters "
+            f"{args.iters}; nothing to resume (raise --iters)")
+    return state, start_it
+
+
 def _run_loop(args, step, amp_state, opt_state, batch_stats) -> List[float]:
-    from apex_tpu.resilience import CheckpointError, PreemptionHandler
+    from apex_tpu.resilience import PreemptionHandler
 
     state = (amp_state, opt_state, batch_stats)
     mgr = _make_manager(args) if args.checkpoint_dir else None
-    start_it = 0
-    if args.resume:
-        # the reference's resume contract: restore model/optimizer/amp
-        # state and continue at the saved iteration. The manager re-hangs
-        # the flat leaves on the LIVE treedef after verifying the manifest
-        # fingerprint + per-leaf checksums — a torn or revision-skewed
-        # checkpoint is refused, not mis-bound.
-        restore_mgr = mgr or _make_manager(args)
-        if args.resume == "auto":
-            if not args.checkpoint_dir:
-                raise SystemExit("--resume auto needs --checkpoint-dir")
-            # a standing relaunch flag: no checkpoint yet (first launch,
-            # or all torn) means start fresh, not die
-            path = restore_mgr.latest_valid()
-        else:
-            path = args.resume
-        if path is not None:
-            try:
-                state, start_it = restore_mgr.restore(target=state,
-                                                      path=path)
-            except CheckpointError as e:
-                raise SystemExit(f"=> {e}")
-            print(f"=> loaded checkpoint '{path}' (resuming at iter "
-                  f"{start_it})")
-            if start_it >= args.iters:
-                raise SystemExit(
-                    f"checkpoint is already at iter {start_it} >= --iters "
-                    f"{args.iters}; nothing to resume (raise --iters)")
-        else:
-            print(f"=> no valid checkpoint in '{args.checkpoint_dir}' yet; "
-                  "starting fresh")
+    state, start_it = _resolve_resume(args, mgr, state)
     amp_state, opt_state, batch_stats = state
 
     pre = None

@@ -1,12 +1,24 @@
-"""Minimal DDP example (ref ``examples/simple/distributed/
-distributed_data_parallel.py``): a linear model trained data-parallel over
-every device with the bucketed-allreduce DDP helper, made fault-tolerant
-with the ``resilience`` layer — an in-graph anomaly guard around the
-update, atomic auto-resumed checkpoints, and a SIGTERM save-and-exit path.
+"""Minimal distributed-training example (ref ``examples/simple/distributed/
+distributed_data_parallel.py``): a linear model trained over every device,
+with the parallelism strategy picked by ONE declarative
+``ParallelismPlan`` preset instead of hand-wired flags:
+
+* ``--plan ddp``    — replicated params, bucketed-allreduce DDP (plus the
+  full resilience wiring: in-graph anomaly guard, atomic auto-resumed
+  checkpoints, SIGTERM save-and-exit, ``--chaos-step`` NaN injection);
+* ``--plan zero1``  — ``DistributedFusedAdam``: dp-sharded optimizer
+  state, grads reduce-scattered, params all-gathered by the optimizer;
+* ``--plan fsdp``   — ``apex_tpu.fsdp``: parameters sharded too; the
+  forward gathers on demand and the backward reduce-scatters gradients
+  straight into shard layout;
+* ``--plan fsdp+tp`` — the same FSDP engine on a dp×tp mesh (this toy
+  model defines no tensor-parallel layers, so tp only replicates compute —
+  the point is that the PLAN resolves the composed mesh; see
+  ``benchmarks/bench_fsdp.py`` for fsdp+tp on the TP GPT).
+
 Run directly; on a CPU-only machine set
 ``XLA_FLAGS=--xla_force_host_platform_device_count=8`` to fake a mesh.
-``--chaos-step K`` injects a NaN gradient at step K to watch the guard
-absorb it."""
+"""
 
 from __future__ import annotations
 
@@ -24,11 +36,12 @@ if os.environ.get("JAX_PLATFORMS") == "cpu":
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from apex_tpu.monitor import Metrics
-from apex_tpu.parallel import DistributedDataParallel
-from apex_tpu.parallel.mesh import DP_AXIS, build_mesh
+from apex_tpu.parallel import ParallelismPlan
+from apex_tpu.parallel.mesh import DP_AXIS
 from apex_tpu.resilience import (
     AnomalyGuard,
     CheckpointManager,
@@ -38,44 +51,51 @@ from apex_tpu.resilience import (
 )
 
 
-def main(argv=None):
+def parse_args(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--plan", default="ddp",
+                    choices=["ddp", "zero1", "fsdp", "fsdp+tp"],
+                    help="ParallelismPlan preset (replaces the old "
+                         "hand-wired DDP/ZeRO knobs)")
     ap.add_argument("--steps", type=int, default=200)
+    ap.add_argument("--lr", type=float, default=0.1)
     ap.add_argument("--checkpoint-dir", default="",
                     help="atomic checkpoints + auto-resume + SIGTERM save")
     ap.add_argument("--save-freq", type=int, default=50)
     ap.add_argument("--chaos-step", type=int, default=-1,
-                    help="inject a NaN gradient at this step (guard demo)")
-    args = ap.parse_args(argv)
+                    help="inject a NaN gradient at this step "
+                         "(guard demo; --plan ddp only)")
+    return ap.parse_args(argv)
 
-    # TPU matmuls default to bf16 accumulation; this toy regression needs f32
-    jax.config.update("jax_default_matmul_precision", "highest")
-    mesh = build_mesh(tp=1, pp=1, sp=1)
-    dp = mesh.shape[DP_AXIS]
-    ddp = DistributedDataParallel()
-    guard = AnomalyGuard(GuardPolicy(on_anomaly="skip", skip_budget=3))
 
-    params = {"w": jnp.zeros((8,)), "b": jnp.zeros(())}
+def _data():
     n = 128  # fixed global sample count (divisible by any dp in 1..8)
     x = jax.random.normal(jax.random.PRNGKey(0), (n, 8))
     true_w = jnp.arange(8.0)
     y = x @ true_w + 0.5
+    return x, y, true_w
+
+
+def _loss(params, x, y):
+    pred = x @ params["w"] + params["b"]
+    return jnp.mean((pred - y) ** 2)
+
+
+def _train_ddp(args, plan, mesh, params, x, y):
+    """The original resilience-wired DDP loop, constructed from the plan."""
+    ddp = plan.ddp()
+    guard = AnomalyGuard(GuardPolicy(on_anomaly="skip", skip_budget=3))
 
     def body(params, gstate, metrics, x, y, it):
-        def loss_fn(p):
-            pred = x @ p["w"] + p["b"]
-            return jnp.mean((pred - y) ** 2)
-
-        grads = jax.grad(loss_fn)(ddp.replicate(params))
+        grads = jax.grad(_loss)(ddp.replicate(params), x, y)
         grads = ddp.average_gradients(grads)
         if args.chaos_step >= 0:
             grads = chaos.inject_nonfinite(grads, it, args.chaos_step)
-        proposed = jax.tree.map(lambda p, g: p - 0.1 * g, params, grads)
+        proposed = jax.tree.map(lambda p, g: p - args.lr * g, params, grads)
         # guard: a non-finite grad never reaches the params — the bad step
         # is skipped (then rolled back / halted if it persists), and the
         # counters ride the Metrics pytree. axis_names makes both the flag
-        # and the counters rank-uniform (every replica takes the same
-        # branch and logs the same totals).
+        # and the counters rank-uniform.
         bad, metrics = guard.check(grads=grads, metrics=metrics,
                                    axis_names=DP_AXIS)
         params, gstate, metrics = guard.apply(
@@ -111,16 +131,123 @@ def main(argv=None):
             if save_at is not None:
                 mgr.save((params, gstate, metrics), save_at + 1, block=True)
                 print(f"=> preempted: saved at step {save_at + 1}, exiting")
-                return
+                # None params = "no final state to validate": main skips
+                # the convergence assert on this clean save-and-exit path
+                return None, metrics
         if mgr is not None and (it + 1) % args.save_freq == 0:
             mgr.save((params, gstate, metrics), it + 1)
-    err = float(jnp.abs(params["w"] - true_w).max())
-    stats = metrics.as_dict()
-    print(f"w error after {args.steps} steps: {err:.4f}  "
-          f"(anomalies={stats['anomalies_total']:.0f} "
-          f"skips={stats['guard_skips_total']:.0f})")
     if mgr is not None:
         mgr.close()
+    return params, metrics
+
+
+def _train_sharded(args, plan, mesh, params, x, y):
+    """zero1 / fsdp / fsdp+tp: the sharded-optimizer loops, built entirely
+    from the plan (no strategy-specific wiring beyond the state specs)."""
+    opt = plan.build_optimizer(lr=args.lr)
+    pspecs = jax.tree.map(lambda _: P(), params)
+    shard = jax.tree.map(lambda _: P(DP_AXIS), params)
+
+    if plan.data == "fsdp":
+        from apex_tpu.fsdp import FSDPAdamState
+
+        fsdp = plan.fsdp()
+        meta = fsdp.meta(params)
+        sspec = FSDPAdamState(count=P(), master=shard, mu=shard, nu=shard)
+
+        def init_fn(p):
+            return opt.init(p)
+
+        def body(st, x, y):
+            def loss_fn(master):
+                return _loss(fsdp.gather(master, meta), x, y)
+
+            l, g = jax.value_and_grad(loss_fn)(st.master)
+            st = opt.step(g, st)
+            return st, lax.pmean(l, DP_AXIS)
+
+        def final_fn(st):
+            return fsdp.gather(st.master, meta)
+    else:  # zero1
+        from apex_tpu.contrib.optimizers.distributed_fused_adam import (
+            DistAdamState,
+        )
+
+        sspec = (pspecs,
+                 DistAdamState(count=P(), master=shard, mu=shard, nu=shard))
+
+        def init_fn(p):
+            return p, opt.init(p)
+
+        def body(st, x, y):
+            p, ostate = st
+            l, g = jax.value_and_grad(_loss)(p, x, y)
+            p, ostate = opt.step(g, ostate, p)
+            return (p, ostate), lax.pmean(l, DP_AXIS)
+
+        def final_fn(st):
+            return st[0]
+
+    init = jax.jit(jax.shard_map(
+        init_fn, mesh=mesh, in_specs=(pspecs,), out_specs=sspec,
+        check_vma=False))
+    step = jax.jit(jax.shard_map(
+        body, mesh=mesh, in_specs=(sspec, P(DP_AXIS), P(DP_AXIS)),
+        out_specs=(sspec, P()), check_vma=False))
+    finalize = jax.jit(jax.shard_map(
+        final_fn, mesh=mesh, in_specs=(sspec,), out_specs=pspecs,
+        check_vma=False))
+
+    state = init(params)
+    mgr = CheckpointManager(args.checkpoint_dir) if args.checkpoint_dir \
+        else None
+    start = 0
+    if mgr is not None and mgr.latest_valid() is not None:
+        state, start = mgr.restore(target=state)
+        print(f"=> auto-resumed at step {start}")
+    loss = None
+    for it in range(start, args.steps):
+        state, loss = step(state, x, y)
+        if mgr is not None and (it + 1) % args.save_freq == 0:
+            mgr.save(state, it + 1)
+    if mgr is not None:
+        mgr.close()
+    if loss is None:
+        print(f"=> nothing to run: resumed at step {start} "
+              f">= --steps {args.steps}")
+    else:
+        print(f"final loss {float(loss):.6f}")
+    return finalize(state), None
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    plan = ParallelismPlan.preset(args.plan)
+    print(plan.describe())
+
+    # TPU matmuls default to bf16 accumulation; this toy regression needs f32
+    jax.config.update("jax_default_matmul_precision", "highest")
+    mesh = plan.mesh()
+    params = {"w": jnp.zeros((8,)), "b": jnp.zeros(())}
+    x, y, true_w = _data()
+    print("  modeled hbm_params_bytes:",
+          {k: int(v) for k, v in plan.hbm_params_bytes(
+              params, world=mesh.shape[DP_AXIS]).items()})
+
+    if plan.data == "ddp":
+        params, metrics = _train_ddp(args, plan, mesh, params, x, y)
+        if metrics is not None:
+            stats = metrics.as_dict()
+            print(f"(anomalies={stats['anomalies_total']:.0f} "
+                  f"skips={stats['guard_skips_total']:.0f})")
+    else:
+        params, _ = _train_sharded(args, plan, mesh, params, x, y)
+
+    if params is None:
+        return  # preempted: state saved for --resume, nothing to validate
+
+    err = float(jnp.abs(params["w"] - true_w).max())
+    print(f"w error after {args.steps} steps: {err:.4f}")
     assert err < 0.05
 
 
