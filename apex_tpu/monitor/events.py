@@ -41,9 +41,15 @@ __all__ = [
     "write_chrome_trace",
 ]
 
-# canonical request lifecycle, in order; decode_chunk repeats
+# canonical request lifecycle, in order; decode_chunk repeats. The
+# disaggregated-cluster path (serve.cluster) inserts a transfer span
+# between prefill and decode — ``prefill_end → transfer_start →
+# transfer_end → admitted`` — and ``shed`` is the router's terminal
+# state for a request that was never admitted (load shedding: recorded,
+# never an exception).
 LIFECYCLE = ("submitted", "admitted", "prefill_start", "prefill_end",
-             "first_token", "decode_chunk", "retired")
+             "first_token", "transfer_start", "transfer_end",
+             "decode_chunk", "retired", "shed")
 GAUGES = ("queue_depth", "occupancy")
 
 
@@ -102,9 +108,13 @@ _PID_SLOTS = 2
 
 # request-track spans derived from lifecycle event pairs: name -> (start
 # event, end event). decode_chunk spans carry their own start_ms instead.
+# transfer renders the cluster's KV-block hop between hosts — in Perfetto
+# a disaggregated request visibly leaves its prefill host and lands on
+# its decode host.
 _SPAN_PAIRS = {
     "queued": ("submitted", "admitted"),
     "prefill": ("prefill_start", "prefill_end"),
+    "transfer": ("transfer_start", "transfer_end"),
     "decode": ("first_token", "retired"),
 }
 

@@ -47,8 +47,16 @@ _HIGHER = ("tokens_per_s", "goodput", "_rps", "mfu", "occupancy",
            # FSDP round: hidden ring bytes + the modeled HBM drop factor
            # (checked BEFORE _LOWER, so these never fall into the generic
            # *_bytes lower-is-better rules below)
-           "hidden_bytes", "hbm_reduction")
+           "hidden_bytes", "hbm_reduction",
+           # disaggregated cluster (stage 15): admitted requests/s is the
+           # router headline — already matched by "_rps", listed so the
+           # gate's coverage is explicit next to its shed_rate dual
+           "admitted_rps")
 _LOWER = ("_ms", "violation", "latency", "bubble", "exposed_bytes",
+          # disaggregated cluster (stage 15): a rising shed fraction is a
+          # capacity regression (transfer_ms falls under the generic
+          # "_ms" rule; listed here for the same explicitness)
+          "shed_rate", "transfer_ms",
           # FSDP round: the headline memory/wire accounting — growing
           # per-chip param HBM, peak HBM or FSDP bytes-on-wire is a
           # regression (hidden_fraction, the overlap headline, is in

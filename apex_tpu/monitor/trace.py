@@ -37,8 +37,11 @@ import jax
 # "ckpt" is the host-side checkpoint phase (resilience.CheckpointManager's
 # device_get + serialization) — it appears in trace-viewer host rows, not
 # in the compiled step. "prefill"/"decode" are the serving phases the
-# apex_tpu.serve engine traces its two jitted programs under.
-PHASES = ("fwd", "bwd", "comm", "opt", "ckpt", "prefill", "decode")
+# apex_tpu.serve engine traces its two jitted programs under; "transfer"
+# is the disaggregated cluster's KV-block handoff between hosts
+# (serve.cluster — pack/ship/unpack around the SimTransport or ICI hop).
+PHASES = ("fwd", "bwd", "comm", "opt", "ckpt", "prefill", "decode",
+          "transfer")
 
 
 @contextlib.contextmanager

@@ -28,7 +28,13 @@ inference story is ``amp.initialize`` eval-mode half precision):
   :class:`InferenceEngine`: ONE chunked-prefill + ONE decode program
   (+ one optional verify program), prefix-cached admission, speculative
   decode, EOS/max-len retirement, checkpoint loading via ``resilience``,
-  telemetry via ``monitor``.
+  telemetry via ``monitor``;
+* :mod:`~apex_tpu.serve.cluster` — disaggregated prefill/decode serving
+  past one host: :class:`~apex_tpu.serve.cluster.ServeCluster` =
+  SLO-aware router (TTFT feasibility, per-tenant WFQ, explicit ``shed``)
+  → prefill workers → KV-block transfer (raw or int8 wire, modeled +
+  measured byte accounting) → decode workers, with bitwise stream
+  parity against the single engine.
 """
 
 from apex_tpu.serve.decode import (  # noqa: F401
@@ -76,9 +82,29 @@ from apex_tpu.serve.sampling import (  # noqa: F401
     sample,
     step_keys,
 )
+from apex_tpu.serve.cluster import (  # noqa: F401  (isort: after engine)
+    ClusterConfig,
+    DecodeWorker,
+    KVHandoff,
+    PrefillWorker,
+    Router,
+    RouterConfig,
+    ServeCluster,
+    SimTransport,
+    transfer_wire_bytes,
+)
 
 __all__ = [
     "BlockAllocator",
+    "ClusterConfig",
+    "DecodeWorker",
+    "KVHandoff",
+    "PrefillWorker",
+    "Router",
+    "RouterConfig",
+    "ServeCluster",
+    "SimTransport",
+    "transfer_wire_bytes",
     "Drafter",
     "InferenceEngine",
     "KVCacheConfig",

@@ -1,0 +1,62 @@
+"""apex_tpu.serve.cluster — disaggregated prefill/decode serving.
+
+The multi-host tier over the single-engine serve stack (ROADMAP item 2):
+
+* :mod:`~apex_tpu.serve.cluster.workers` — :class:`PrefillWorker`
+  (chunked prefill into a staging pool, emits KV handoffs) and
+  :class:`DecodeWorker` (a full :class:`~apex_tpu.serve.engine.
+  InferenceEngine` admitted into via transferred blocks);
+* :mod:`~apex_tpu.serve.cluster.transfer` — KV-block pack/ship/unpack
+  with raw and blockwise-int8 wire modes (int8 pools transfer bitwise —
+  no dequant-requant), modeled wire-byte accounting that matches the
+  payload to the byte, the in-process :class:`SimTransport` and the
+  real-mesh :func:`ppermute_blocks` hop;
+* :mod:`~apex_tpu.serve.cluster.router` — SLO-aware admission:
+  TTFT-budget feasibility against the measured prefill backlog,
+  per-tenant weighted fair queueing, explicit ``shed`` terminal states;
+* :mod:`~apex_tpu.serve.cluster.cluster` — :class:`ServeCluster`, the
+  router → prefill → transfer → decode step loop with one shared
+  monotonic clock and full lifecycle events (new ``transfer`` span).
+"""
+
+from apex_tpu.serve.cluster.cluster import (  # noqa: F401
+    ClusterConfig,
+    ServeCluster,
+)
+from apex_tpu.serve.cluster.router import (  # noqa: F401
+    Router,
+    RouterConfig,
+    ShedDecision,
+)
+from apex_tpu.serve.cluster.transfer import (  # noqa: F401
+    SimTransport,
+    extract_blocks,
+    insert_blocks,
+    pack_blocks,
+    payload_nbytes,
+    ppermute_blocks,
+    transfer_wire_bytes,
+)
+from apex_tpu.serve.cluster.workers import (  # noqa: F401
+    DecodeWorker,
+    KVHandoff,
+    PrefillWorker,
+)
+
+__all__ = [
+    "ClusterConfig",
+    "DecodeWorker",
+    "KVHandoff",
+    "PrefillWorker",
+    "Router",
+    "RouterConfig",
+    "ServeCluster",
+    "ShedDecision",
+    "SimTransport",
+    "extract_blocks",
+    "insert_blocks",
+    "pack_blocks",
+    "payload_nbytes",
+    "ppermute_blocks",
+    "transfer_wire_bytes",
+]

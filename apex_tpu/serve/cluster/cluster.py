@@ -1,0 +1,461 @@
+"""ServeCluster — the disaggregated prefill/decode step loop.
+
+One object wires the whole multi-host story together: an SLO-aware
+:class:`~apex_tpu.serve.cluster.router.Router` in front, ``n_prefill``
+:class:`~apex_tpu.serve.cluster.workers.PrefillWorker` hosts feeding a
+:class:`~apex_tpu.serve.cluster.transfer.SimTransport` (or a real ICI
+link built from the same payloads), and ``n_decode``
+:class:`~apex_tpu.serve.cluster.workers.DecodeWorker` hosts draining it.
+Every :meth:`ServeCluster.step` is one cluster tick:
+
+    deliver transfers → router dispatch (WFQ + TTFT feasibility, sheds
+    are terminal) → one prefill chunk per busy prefill host → ship
+    finished prefills → admit + one decode step per decode host
+
+All timestamps come from ONE :class:`~apex_tpu.monitor.events.EventLog`
+clock shared by the router, both worker kinds and every decode engine,
+so the request lifecycle — ``submitted → prefill_start/end →
+first_token → transfer_start/end → admitted → decode_chunk* → retired``
+(or ``submitted → shed``) — lines up across hosts in the JSONL stream
+and the Chrome trace (``monitor.chrome_trace`` renders the new
+``transfer`` span like any other; a request visibly hops hosts in
+Perfetto).
+
+Parity is the design invariant, not an aspiration: the prefill hosts run
+the engine's own chunk program, the wire ships pool blocks bitwise (raw
+mode, and int8 pools under EITHER mode), and the decode hosts install
+slots exactly as local prefill completion would — so per-request token
+streams from a multi-host cluster are **bitwise equal** to the
+single-engine path, greedy and sampled
+(``tests/test_serve_cluster.py`` pins it). Overload degrades by
+shedding: offered load beyond capacity turns into ``shed`` terminal
+records while the kept traffic's goodput-under-SLO holds — the cluster
+never deadlocks and never raises the engine's pool-exhaustion error.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+
+from apex_tpu.monitor.events import EventLog
+from apex_tpu.monitor.hist import DEFAULT_LATENCY_SPEC, Histogram
+from apex_tpu.monitor.trace import span
+from apex_tpu.serve.cluster.router import Router, RouterConfig, ShedDecision
+from apex_tpu.serve.cluster.transfer import SimTransport, validate_wire_mode
+from apex_tpu.serve.cluster.workers import (
+    DecodeWorker,
+    KVHandoff,
+    PrefillWorker,
+)
+from apex_tpu.serve.engine import Request, ServeConfig
+
+Pytree = Any
+
+__all__ = ["ClusterConfig", "ServeCluster"]
+
+
+@dataclasses.dataclass(frozen=True)
+class ClusterConfig:
+    """Cluster shape. ``serve`` configures each DECODE host's engine
+    (slots, pool, kv_quant, spec_k, megakernel…); prefill hosts derive
+    their staging config from it. ``wire_mode`` picks the transfer codec
+    (``"int8"`` on a float pool cuts wire bytes ~3.6×; int8 pools ship
+    their codes+scales verbatim either way). ``link_fixed_ms`` /
+    ``link_gib_per_s`` shape the simulated transport's modeled latency
+    (both 0: instant — the deterministic test default)."""
+
+    n_prefill: int = 1
+    n_decode: int = 1
+    serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
+    router: RouterConfig = dataclasses.field(default_factory=RouterConfig)
+    wire_mode: str = "raw"
+    prefill_queue_limit: int = 1
+    link_fixed_ms: float = 0.0
+    link_gib_per_s: float = 0.0
+
+    def validate(self) -> None:
+        if self.n_prefill < 1:
+            raise ValueError("n_prefill must be >= 1")
+        if self.n_decode < 1:
+            raise ValueError("n_decode must be >= 1")
+        validate_wire_mode(self.wire_mode)
+        self.serve.validate()
+        self.router.validate()
+        if self.link_fixed_ms < 0 or self.link_gib_per_s < 0:
+            raise ValueError("link latency knobs must be >= 0")
+
+
+class ServeCluster:
+    """Disaggregated serving over simulated (or real) hosts.
+
+    Duck-type compatible with the single :class:`InferenceEngine` where
+    it matters — ``submit`` / ``step`` / ``active`` / ``stats`` — so
+    ``benchmarks/loadgen.run_workload`` drives a cluster unchanged.
+    ``params`` is one replicated pytree (every host serves the same
+    model). Streams are retained in :attr:`finished` unless
+    ``retain_streams=False`` routes them to ``on_retire``; shed requests
+    land in :attr:`shed` (uid → :class:`ShedDecision`) instead — the
+    explicit terminal state."""
+
+    def __init__(self, params: Pytree, cfg, cluster_cfg: ClusterConfig, *,
+                 base_key=None, sink=None,
+                 events: Optional[EventLog] = None,
+                 retain_streams: bool = True,
+                 on_retire: Optional[Callable[[str, List[int]], None]] = None,
+                 use_pallas: Optional[bool] = None,
+                 peak_flops_per_s: Optional[float] = None):
+        cluster_cfg.validate()
+        self.cfg = cfg
+        self.cluster_cfg = cluster_cfg
+        base_key = (base_key if base_key is not None
+                    else jax.random.PRNGKey(0))
+        # one clock for the whole cluster: every event, latency fold and
+        # transfer timestamp subtracts the same anchor
+        self._events = events if events is not None else EventLog()
+        self._sink = sink
+        self.router = Router(cluster_cfg.router)
+        self.transport = SimTransport(fixed_ms=cluster_cfg.link_fixed_ms,
+                                      gib_per_s=cluster_cfg.link_gib_per_s)
+        scfg = cluster_cfg.serve
+        # decode hosts keep the full engine feature set minus the prefix
+        # cache (blocks arrive by wire, not by content address); prefill
+        # hosts need no speculation/megakernel — they never decode
+        decode_cfg = dataclasses.replace(scfg, prefix_cache=False)
+        prefill_cfg = dataclasses.replace(
+            scfg, prefix_cache=False, spec_k=0, megakernel="off")
+        self._retain_streams = retain_streams
+        self._on_retire = on_retire
+        self._finished: Dict[str, List[int]] = {}
+        self.shed: Dict[str, ShedDecision] = {}
+        self.prefill_workers = [
+            PrefillWorker(params, cfg, prefill_cfg, base_key=base_key,
+                          wire_mode=cluster_cfg.wire_mode,
+                          events=self._events,
+                          now_ms=self._events.now_ms,
+                          queue_limit=cluster_cfg.prefill_queue_limit,
+                          use_pallas=use_pallas, name=f"prefill{i}")
+            for i in range(cluster_cfg.n_prefill)]
+        self.decode_workers = [
+            DecodeWorker(params, cfg, decode_cfg, base_key=base_key,
+                         wire_mode=cluster_cfg.wire_mode, sink=sink,
+                         events=self._events,
+                         slo=cluster_cfg.router.slo,
+                         retain_streams=False,
+                         on_retire=self._retired,
+                         use_pallas=use_pallas,
+                         peak_flops_per_s=peak_flops_per_s,
+                         name=f"decode{i}")
+            for i in range(cluster_cfg.n_decode)]
+        # hard capacity for the unservable check: the roomiest decode pool
+        self._max_servable_tokens = max(
+            w.engine.kv_cfg.num_blocks * w.engine.kv_cfg.block_size
+            for w in self.decode_workers)
+        self.max_context = self.decode_workers[0].engine.max_context
+        self.transfer_ms_hist = Histogram(DEFAULT_LATENCY_SPEC)
+        self._step_idx = 0
+        self._t_first_submit_ms: Optional[float] = None
+
+    # -- lifecycle ---------------------------------------------------------
+    def _now_ms(self) -> float:
+        return self._events.now_ms()
+
+    def _retired(self, uid: str, tokens: List[int]) -> None:
+        if self._retain_streams:
+            self._finished[uid] = tokens
+        if self._on_retire is not None:
+            self._on_retire(uid, tokens)
+
+    def submit(self, request: Request) -> None:
+        """Route one request in. Input validation mirrors the engine's
+        (garbage raises); a request that can never FIT the decode pool is
+        shed — terminal, recorded, never a deadlock."""
+        p = len(request.tokens)
+        if p < 1:
+            raise ValueError(f"{request.uid}: empty prompt")
+        if request.max_new_tokens < 1:
+            raise ValueError(f"{request.uid}: max_new_tokens must be >= 1")
+        if p >= self.max_context:
+            raise ValueError(
+                f"{request.uid}: prompt ({p}) must leave room to generate "
+                f"(max_context {self.max_context})")
+        t = self._now_ms()
+        if self._t_first_submit_ms is None:
+            self._t_first_submit_ms = t
+        self._events.emit("submitted", request.uid, t_ms=t,
+                          prompt_tokens=p,
+                          max_new_tokens=request.max_new_tokens,
+                          tenant=getattr(request, "tenant", "default"))
+        total = min(p + request.max_new_tokens, self.max_context)
+        decision = self.router.submit(
+            request, t, total_tokens=total,
+            max_servable_tokens=self._max_servable_tokens)
+        if decision is not None:
+            self._record_shed(decision)
+        self._events.gauge("queue_depth", self.router.queue_depth, t_ms=t)
+
+    def _record_shed(self, d: ShedDecision) -> None:
+        self.shed[d.request.uid] = d
+        self._events.emit(
+            "shed", d.request.uid, t_ms=d.t_ms, reason=d.reason,
+            predicted_ttft_ms=(round(d.predicted_ttft_ms, 3)
+                               if d.predicted_ttft_ms is not None else None),
+            budget_ms=d.budget_ms)
+
+    # -- the cluster tick --------------------------------------------------
+    def _deliver(self, t_ms: float) -> int:
+        n = 0
+        for d in self.transport.poll(t_ms):
+            h: KVHandoff = d.item
+            self.transfer_ms_hist.add([d.transfer_ms])
+            self._events.emit(
+                "transfer_end", h.request.uid, t_ms=d.t_deliver_ms,
+                wire_bytes=d.wire_bytes,
+                transfer_ms=round(d.transfer_ms, 3))
+            worker = min(self.decode_workers, key=lambda w: w.load)
+            worker.admit(h)
+            n += 1
+        return n
+
+    def _outstanding(self) -> int:
+        """Requests in flight anywhere downstream of the router: mid- or
+        awaiting prefill, on the wire, pending or occupying a decode
+        slot."""
+        n = self.transport.in_flight
+        for w in self.prefill_workers:
+            n += (1 if w._current is not None else 0) + len(w._queue)
+        for w in self.decode_workers:
+            n += len(w._pending)
+            n += sum(s is not None for s in w.engine._slots)
+        return n
+
+    def _pipeline_tokens(self) -> int:
+        """Token-denominated outstanding work the feasibility predictor
+        charges at the measured prefill rate: unprefilled prompt tokens
+        plus the decode side's remaining generation budgets — a
+        deliberately simple stand-in for per-stage service curves, but
+        one that GROWS with congestion, which is all admission control
+        needs."""
+        n = sum(w.backlog_tokens for w in self.prefill_workers)
+        for w in self.decode_workers:
+            for h in w._pending:
+                n += h.request.max_new_tokens
+            for s in w.engine._slots:
+                if s is not None:
+                    n += max(0, s.request.max_new_tokens
+                             - len(s.generated))
+        return n
+
+    def _dispatch(self, t_ms: float) -> int:
+        """Admit from the router while the pipeline has credit. The
+        credit bound (decode slots + one buffered handoff per decode
+        host) is BACKPRESSURE: when decode saturates, dispatch stops,
+        queue wait mounts at the ROUTER, and the TTFT feasibility check
+        — waited + pipeline-work · measured ms/token — sheds there,
+        where a rejection is still cheap. Without it, prefill would race
+        ahead and mint first tokens whose streams then stall for seconds
+        in a decode queue no budget knows about."""
+        n = 0
+        capacity = (sum(w.engine.serve_cfg.num_slots
+                        for w in self.decode_workers)
+                    + len(self.decode_workers))
+        outstanding = self._outstanding()
+        backlog = self._pipeline_tokens()
+        for worker in sorted(self.prefill_workers,
+                             key=lambda w: w.backlog_tokens):
+            while worker.can_accept and outstanding < capacity:
+                item, sheds = self.router.next_request(backlog, t_ms)
+                for d in sheds:
+                    self._record_shed(d)
+                if item is None:
+                    return n
+                request, t_submit = item
+                worker.accept(request, t_submit)
+                backlog += len(request.tokens) + request.max_new_tokens
+                outstanding += 1
+                n += 1
+        return n
+
+    def step(self) -> bool:
+        """One cluster tick; False when nothing moved anywhere."""
+        t = self._now_ms()
+        with span("transfer"):
+            delivered = self._deliver(t)
+        dispatched = self._dispatch(t)
+        chunks = 0
+        sent = 0
+        for w in self.prefill_workers:
+            before = w.chunks_run
+            h = w.step()
+            if w.chunks_run > before:  # feed only a FRESH measurement
+                self.router.observe_chunk(w.last_chunk_tokens,
+                                          w.last_chunk_ms)
+            if w.busy or h is not None:
+                chunks += 1
+            if h is not None:
+                with span("transfer"):
+                    t_send = self._now_ms()
+                    self._events.emit("transfer_start", h.request.uid,
+                                      t_ms=t_send,
+                                      wire_bytes=h.wire_bytes,
+                                      n_blocks=h.n_blocks)
+                    self.transport.send(h, h.wire_bytes, t_send)
+                sent += 1
+        decoded = 0
+        for w in self.decode_workers:
+            if w.step():
+                decoded += 1
+        # transfers still on the (modeled-latency) wire count as pending
+        # progress: a driver polling "did anything move?" must not
+        # declare the cluster drained while a handoff is in flight
+        progressed = bool(delivered or dispatched or chunks or sent
+                          or decoded or self.transport.in_flight)
+        self._step_idx += 1
+        if self._sink is not None and progressed:
+            self._sink.write(
+                step=self._step_idx, phase="cluster",
+                queue_depth=self.router.queue_depth,
+                prefill_backlog_tokens=sum(
+                    w.backlog_tokens for w in self.prefill_workers),
+                transfers_in_flight=self.transport.in_flight,
+                shed_total=self.router.shed)
+        return progressed
+
+    # -- driving -----------------------------------------------------------
+    @property
+    def active(self) -> bool:
+        return (self.router.queue_depth > 0
+                or any(w.busy for w in self.prefill_workers)
+                or self.transport.in_flight > 0
+                or any(w.active for w in self.decode_workers))
+
+    def run(self, requests: Sequence[Request],
+            max_steps: Optional[int] = None) -> Dict[str, List[int]]:
+        """Serve ``requests`` to completion (or shed — check
+        :attr:`shed`); returns uid → generated tokens for the completed
+        ones. Never deadlocks: a tick that moves nothing while work
+        remains is impossible by construction (queued work either
+        dispatches, sheds, chunks, ships or decodes), and ``max_steps``
+        is a belt-and-braces bound for drivers."""
+        for r in requests:
+            self.submit(r)
+        steps = 0
+        while self.active:
+            if max_steps is not None and steps >= max_steps:
+                break
+            self.step()
+            steps += 1
+        return dict(self._finished)
+
+    @property
+    def finished(self) -> Dict[str, List[int]]:
+        return dict(self._finished)
+
+    @property
+    def completed(self) -> int:
+        return sum(w.engine.completed for w in self.decode_workers)
+
+    def compile_counts(self) -> Dict[str, Any]:
+        return {
+            "prefill": [w.compile_counts() for w in self.prefill_workers],
+            "decode": [w.compile_counts() for w in self.decode_workers],
+        }
+
+    # -- stats -------------------------------------------------------------
+    def occupancy(self) -> float:
+        tot = sum(w.engine.serve_cfg.num_slots for w in self.decode_workers)
+        occ = sum(sum(s is not None for s in w.engine._slots)
+                  for w in self.decode_workers)
+        return occ / tot if tot else 0.0
+
+    def stats(self) -> Dict[str, Any]:
+        """One JSON-serializable snapshot of the whole cluster: router
+        admission/shed accounting, transfer wire totals, merged decode
+        latency quantiles and the summed goodput-under-SLO report —
+        ``shed_rate`` / ``admitted_rps`` / ``transfer_ms_p50`` are the
+        flat headline fields ``monitor.regress`` gates."""
+        router_stats = self.router.stats()
+        out: Dict[str, Any] = {
+            "hosts": {"prefill": len(self.prefill_workers),
+                      "decode": len(self.decode_workers)},
+            "steps": self._step_idx,
+            "completed": self.completed,
+            "generated_tokens": sum(
+                w.engine._tokens_generated for w in self.decode_workers),
+            "occupancy": self.occupancy(),
+            "router": router_stats,
+            "shed_rate": router_stats["shed_rate"],
+        }
+        # admitted requests per second of cluster wall time (elapsed on
+        # the shared clock since the first submission)
+        elapsed_ms = (self._now_ms() - self._t_first_submit_ms
+                      if self._t_first_submit_ms is not None else 0.0)
+        out["admitted_rps"] = (
+            round(self.router.admitted / (elapsed_ms / 1e3), 4)
+            if elapsed_ms > 0 else None)
+        tr = self.transport
+        out["transfer"] = {
+            "transfers": tr.transfers_total,
+            "wire_bytes_total": tr.wire_bytes_total,
+            "transfer_ms_total": round(tr.transfer_ms_total, 3),
+            "wire_mode": self.cluster_cfg.wire_mode,
+            "bytes_per_transfer": (
+                tr.wire_bytes_total // tr.transfers_total
+                if tr.transfers_total else None),
+            "bytes_per_ms": (
+                round(tr.wire_bytes_total / tr.transfer_ms_total, 1)
+                if tr.transfer_ms_total > 0 else None),
+            "in_flight": tr.in_flight,
+        }
+        h = self.transfer_ms_hist
+        if h.total:
+            out["transfer_ms_p50"] = round(h.quantile(0.5), 4)
+            out["transfer_ms_p99"] = round(h.quantile(0.99), 4)
+        # merged decode-side latency quantiles: the per-worker streaming
+        # histograms are associative — merging them equals one engine
+        # having seen every retirement
+        for dim in ("ttft_ms", "tpot_ms", "queue_ms", "e2e_ms",
+                    "decode_step_ms"):
+            merged = None
+            for w in self.decode_workers:
+                hw = w.engine.hists[dim]
+                merged = hw if merged is None else merged.merge(hw)
+            if merged is not None and merged.total:
+                out[f"{dim}_p50"] = round(merged.quantile(0.5), 3)
+                out[f"{dim}_p99"] = round(merged.quantile(0.99), 3)
+        # summed SLO/goodput accounting across decode hosts
+        reports = [w.engine._slo.report() for w in self.decode_workers
+                   if w.engine._slo is not None]
+        if reports:
+            slo_rep: Dict[str, Any] = {
+                "completed": sum(r["completed"] for r in reports),
+                "good": sum(r["good"] for r in reports),
+                "goodput_rps": round(
+                    sum(r["goodput_rps"] for r in reports), 4),
+                "throughput_rps": round(
+                    sum(r["throughput_rps"] for r in reports), 4),
+                "violations": {
+                    k: sum(r["violations"].get(k, 0) for r in reports)
+                    for k in reports[0]["violations"]},
+                "slo": reports[0]["slo"],
+            }
+            comp = slo_rep["completed"]
+            slo_rep["good_fraction"] = (round(slo_rep["good"] / comp, 4)
+                                        if comp else None)
+            out["slo_report"] = slo_rep
+            out["goodput_rps"] = slo_rep["goodput_rps"]
+            out["good_fraction"] = slo_rep["good_fraction"]
+        out["prefill_hosts"] = [
+            {"host": w.name, "chunks_run": w.chunks_run,
+             "prefills_done": w.prefills_done,
+             "backlog_tokens": w.backlog_tokens}
+            for w in self.prefill_workers]
+        out["decode_hosts"] = [
+            {"host": w.name, "completed": w.engine.completed,
+             "handoffs_admitted": w.admitted,
+             "handoffs_pending": len(w._pending),
+             "occupancy": w.engine.occupancy()}
+            for w in self.decode_workers]
+        return out

@@ -1,0 +1,232 @@
+"""SLO-aware admission router — feasibility, fairness, explicit shedding.
+
+The front door of the disaggregated cluster. Three jobs, in the order a
+request meets them:
+
+* **feasibility** (admission control): a request whose TTFT budget cannot
+  be met *given the measured prefill backlog* is shed at dispatch instead
+  of queued into a guaranteed violation. Prediction reuses the PR-6
+  telemetry primitives rather than inventing new ones: the router feeds a
+  streaming :class:`~apex_tpu.monitor.hist.Histogram` with measured
+  per-token prefill chunk times and predicts
+  ``waited + (backlog_tokens + prompt_len) · ms_per_token_p50`` against
+  the :class:`~apex_tpu.monitor.slo.SloSpec` ``ttft_ms`` budget. Cold
+  start (no measurements yet) admits — the first requests calibrate the
+  estimator.
+* **per-tenant weighted fair queueing**: each tenant owns a FIFO and a
+  virtual-time counter (service in prompt tokens / weight); dispatch
+  always serves the non-empty tenant with the least virtual time, so a
+  tenant flooding the queue cannot starve the others beyond its weight
+  share — under saturation, admitted work converges to the weight ratio
+  (``tests/test_serve_cluster.py`` pins it).
+* **explicit shedding, never deadlock**: a shed is a *terminal state* — a
+  :class:`ShedDecision` with the reason and prediction, a ``shed``
+  lifecycle event, and per-tenant counters — not an exception. Overload
+  degrades to "fewer requests, each still inside its SLO" (the
+  goodput-under-SLO currency) instead of an unbounded queue or the
+  engine's pool-exhaustion ``RuntimeError``. Requests too large to EVER
+  fit the decode pool shed immediately at submit (``unservable``).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+from typing import Any, Dict, List, Mapping, Optional, Tuple
+
+from apex_tpu.monitor.hist import DEFAULT_LATENCY_SPEC, HistSpec, Histogram
+from apex_tpu.monitor.slo import SloSpec
+from apex_tpu.serve.engine import Request
+
+__all__ = ["Router", "RouterConfig", "ShedDecision"]
+
+
+@dataclasses.dataclass(frozen=True)
+class RouterConfig:
+    """Admission policy. ``slo.ttft_ms`` drives the feasibility check
+    (None: admit everything); ``tenant_weights`` the WFQ shares (missing
+    tenants weigh 1.0); ``shed_headroom`` scales the budget the predictor
+    is held to (< 1 sheds earlier, > 1 tolerates predicted overshoot)."""
+
+    slo: SloSpec = dataclasses.field(default_factory=SloSpec)
+    tenant_weights: Optional[Mapping[str, float]] = None
+    shed_headroom: float = 1.0
+    hist_spec: Optional[HistSpec] = None
+
+    def validate(self) -> None:
+        self.slo.validate()
+        if self.shed_headroom <= 0:
+            raise ValueError("shed_headroom must be positive")
+        for t, w in (self.tenant_weights or {}).items():
+            if w <= 0:
+                raise ValueError(f"tenant {t!r} weight must be positive, "
+                                 f"got {w}")
+
+
+@dataclasses.dataclass
+class ShedDecision:
+    """One shed request — the terminal record the cluster reports and
+    events record."""
+
+    request: Request
+    reason: str                      # "infeasible" | "unservable"
+    predicted_ttft_ms: Optional[float]
+    budget_ms: Optional[float]
+    t_ms: float
+
+
+class Router:
+    """Per-tenant WFQ + TTFT feasibility in front of the prefill hosts.
+
+    Host side only — no device work. The cluster calls :meth:`submit` on
+    arrival, :meth:`observe_chunk` after every measured prefill chunk,
+    and :meth:`next_request` whenever a prefill worker can accept."""
+
+    def __init__(self, cfg: Optional[RouterConfig] = None):
+        self.cfg = cfg or RouterConfig()
+        self.cfg.validate()
+        self._queues: Dict[str, collections.deque] = {}
+        self._vtime: Dict[str, float] = {}
+        # monotone global virtual clock = vtime of the last tenant
+        # served; new or re-activating tenants start here, so an idle
+        # spell can never be replayed as a burst of catch-up service
+        self._vclock = 0.0
+        self.prefill_ms_per_token = Histogram(
+            self.cfg.hist_spec or DEFAULT_LATENCY_SPEC)
+        self.submitted = 0
+        self.admitted = 0
+        self.shed = 0
+        self.tenants: Dict[str, Dict[str, int]] = {}
+        self.sheds: List[ShedDecision] = []
+
+    # -- accounting --------------------------------------------------------
+    def _tenant(self, name: str) -> Dict[str, int]:
+        return self.tenants.setdefault(
+            name, {"submitted": 0, "admitted": 0, "shed": 0})
+
+    def _weight(self, tenant: str) -> float:
+        if self.cfg.tenant_weights is None:
+            return 1.0
+        return float(self.cfg.tenant_weights.get(tenant, 1.0))
+
+    def observe_chunk(self, tokens: int, ms: float) -> None:
+        """Feed one measured prefill chunk (the estimator's only input)."""
+        if tokens > 0 and ms >= 0:
+            self.prefill_ms_per_token.add([ms / tokens])
+
+    def ms_per_token(self) -> Optional[float]:
+        """Median measured prefill ms/token (None until calibrated)."""
+        return self.prefill_ms_per_token.quantile(0.5)
+
+    # -- submission --------------------------------------------------------
+    def submit(self, request: Request, t_ms: float,
+               total_tokens: Optional[int] = None,
+               max_servable_tokens: Optional[int] = None
+               ) -> Optional[ShedDecision]:
+        """Enqueue a request (returns a :class:`ShedDecision` instead when
+        it can NEVER be served: its full KV footprint ``total_tokens``
+        — prompt + generation budget, context-clamped — exceeds
+        ``max_servable_tokens``, the decode pool's hard capacity; the
+        engine's deadlock-loud ``RuntimeError`` becomes a terminal shed)."""
+        tenant = getattr(request, "tenant", "default")
+        self.submitted += 1
+        rec = self._tenant(tenant)
+        rec["submitted"] += 1
+        if (max_servable_tokens is not None and total_tokens is not None
+                and total_tokens > max_servable_tokens):
+            return self._shed(request, tenant, "unservable", None, t_ms)
+        q = self._queues.setdefault(tenant, collections.deque())
+        if not q:
+            # tenant is (re-)activating: start at the global virtual
+            # clock (never below its own history) so it cannot replay
+            # the service it missed while idle — WFQ's standard
+            # max(own finish time, system vtime) rule
+            self._vtime[tenant] = max(self._vtime.get(tenant, 0.0),
+                                      self._vclock)
+        q.append((request, float(t_ms)))
+        return None
+
+    def _shed(self, request: Request, tenant: str, reason: str,
+              predicted: Optional[float], t_ms: float) -> ShedDecision:
+        self.shed += 1
+        self._tenant(tenant)["shed"] += 1
+        d = ShedDecision(request=request, reason=reason,
+                         predicted_ttft_ms=predicted,
+                         budget_ms=self.cfg.slo.ttft_ms, t_ms=t_ms)
+        self.sheds.append(d)
+        return d
+
+    # -- dispatch ----------------------------------------------------------
+    @property
+    def queue_depth(self) -> int:
+        return sum(len(q) for q in self._queues.values())
+
+    def queued_tokens(self) -> int:
+        return sum(len(r.tokens) for q in self._queues.values()
+                   for r, _ in q)
+
+    def _pick_tenant(self) -> Optional[str]:
+        best = None
+        for t, q in self._queues.items():
+            if not q:
+                continue
+            key = (self._vtime[t], t)  # name breaks ties deterministically
+            if best is None or key < best[0]:
+                best = (key, t)
+        return best[1] if best else None
+
+    def feasible(self, prompt_len: int, waited_ms: float,
+                 backlog_tokens: int) -> Tuple[bool, Optional[float]]:
+        """Can this request's first token still make its TTFT budget?
+        Returns ``(feasible, predicted_ttft_ms)`` — predicted is None
+        when no budget or no calibration constrains the answer."""
+        budget = self.cfg.slo.ttft_ms
+        if budget is None:
+            return True, None
+        mpt = self.ms_per_token()
+        if mpt is None:
+            return True, None  # cold start: calibrate on real traffic
+        predicted = waited_ms + (backlog_tokens + prompt_len) * mpt
+        return predicted <= budget * self.cfg.shed_headroom, predicted
+
+    def next_request(self, backlog_tokens: int, t_ms: float
+                     ) -> Tuple[Optional[Tuple[Request, float]],
+                                List[ShedDecision]]:
+        """Dispatch the WFQ-next feasible request; infeasible heads shed
+        (terminal) and dispatch moves on. Returns ``((request,
+        t_submit_ms) | None, sheds_made_now)``."""
+        sheds: List[ShedDecision] = []
+        while True:
+            tenant = self._pick_tenant()
+            if tenant is None:
+                return None, sheds
+            request, t_submit = self._queues[tenant].popleft()
+            ok, predicted = self.feasible(
+                len(request.tokens), t_ms - t_submit, backlog_tokens)
+            if not ok:
+                sheds.append(self._shed(request, tenant, "infeasible",
+                                        predicted, t_ms))
+                continue
+            self.admitted += 1
+            self._tenant(tenant)["admitted"] += 1
+            self._vtime[tenant] += len(request.tokens) / self._weight(tenant)
+            # the served tenant had the MINIMUM vtime, so tracking it
+            # keeps the clock monotone
+            self._vclock = max(self._vclock, self._vtime[tenant])
+            return (request, t_submit), sheds
+
+    # -- stats -------------------------------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        mpt = self.ms_per_token()
+        return {
+            "submitted": self.submitted,
+            "admitted": self.admitted,
+            "shed": self.shed,
+            "shed_rate": (round(self.shed / self.submitted, 4)
+                          if self.submitted else None),
+            "queue_depth": self.queue_depth,
+            "queued_tokens": self.queued_tokens(),
+            "prefill_ms_per_token_p50": (round(mpt, 4)
+                                         if mpt is not None else None),
+            "tenants": {t: dict(v) for t, v in sorted(self.tenants.items())},
+        }

@@ -1,0 +1,413 @@
+"""Prefill and decode workers — the two halves of a disaggregated host.
+
+Splitting prefill from decode (the DistServe/Splitwise argument, applied
+to the PR-5/7/8 engine) exists because the two phases fight each other in
+one grid: a long prompt's chunk steps steal iterations from every running
+decode (inflating TPOT), while decode-only steps leave the prefill
+backlog — and TTFT — to rot. Give each phase its own mesh slice and each
+runs its own optimal loop; all that crosses the boundary is one KV-block
+payload and a first token per request (``cluster.transfer``).
+
+* :class:`PrefillWorker` — wraps the engine's chunked-prefill machinery
+  (the SAME :func:`~apex_tpu.serve.decode.gpt_prefill_chunk` program and
+  first-token sampling closure, so cluster streams stay bitwise the
+  single-engine ones) around a small **staging** KV pool sized for one
+  max-context prompt. FCFS-to-completion, one fixed-size chunk per
+  :meth:`PrefillWorker.step`; a finished prompt is packed into a
+  :class:`KVHandoff` (blocks + first token + timeline) and its staging
+  blocks are freed immediately — the staging pool never holds a request
+  longer than its prefill.
+* :class:`DecodeWorker` — owns the big paged pool through a full
+  :class:`~apex_tpu.serve.engine.InferenceEngine` (speculative decode and
+  the megakernel knob ride along untouched) whose prefill path is simply
+  never used: :meth:`DecodeWorker.admit` lands transferred blocks into
+  freshly allocated pool blocks via the ``insert_blocks`` /
+  ``copy_block``-style set, installs the slot exactly as the engine's own
+  prefill completion would (same seq_lens/last_token/key bookkeeping),
+  and decode steps take it from there. A handoff that does not fit yet
+  (no free slot / blocks) waits in the worker's pending queue and is
+  retried every step — admission defers, it never deadlocks.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.monitor.events import EventLog
+from apex_tpu.monitor.slo import SloSpec
+from apex_tpu.monitor.trace import span
+from apex_tpu.serve.cluster.transfer import (
+    insert_blocks,
+    pack_blocks,
+    payload_nbytes,
+    transfer_wire_bytes,
+    validate_wire_mode,
+)
+from apex_tpu.serve.decode import gpt_prefill_chunk
+from apex_tpu.serve.engine import (
+    InferenceEngine,
+    Request,
+    ServeConfig,
+    _SlotState,
+)
+from apex_tpu.serve.kv_cache import (
+    BlockAllocator,
+    KVCacheConfig,
+    init_kv_cache,
+)
+from apex_tpu.serve.sampling import request_key, sample
+
+Pytree = Any
+
+
+@dataclasses.dataclass
+class KVHandoff:
+    """Everything a decode host needs to continue a prefilled request:
+    the packed KV payload (host numpy, trimmed to ``n_blocks`` valid
+    blocks), the first sampled token, and the request's timeline so far
+    (ms on the cluster's one clock — retirement folds these into the
+    decode engine's histograms/SLO tracker unchanged)."""
+
+    request: Request
+    payload: Dict[str, np.ndarray]
+    n_blocks: int
+    prompt_len: int
+    first_token: int
+    wire_bytes: int
+    t_submit_ms: float
+    queue_ms: float
+    t_first_ms: float
+    ttft_ms: float
+
+
+def _cache_size_of(jitted) -> Optional[int]:
+    fn = getattr(jitted, "_cache_size", None)
+    return fn() if callable(fn) else None
+
+
+class PrefillWorker:
+    """One prefill host: staging pool + the engine's chunk program.
+
+    ``queue_limit`` bounds accepted-but-unstarted requests (the router
+    holds the rest — that is what makes weighted fair queueing and
+    TTFT-feasibility shedding observable at the router instead of inside
+    an unbounded worker queue)."""
+
+    def __init__(self, params: Pytree, cfg, serve_cfg: ServeConfig, *,
+                 base_key=None, wire_mode: str = "raw",
+                 events: Optional[EventLog] = None,
+                 now_ms: Optional[Callable[[], float]] = None,
+                 queue_limit: int = 1, use_pallas: Optional[bool] = None,
+                 name: str = "prefill0"):
+        serve_cfg.validate()
+        validate_wire_mode(wire_mode)
+        if queue_limit < 0:
+            raise ValueError("queue_limit must be >= 0")
+        self.cfg = cfg
+        self.serve_cfg = serve_cfg
+        self.wire_mode = wire_mode
+        self.name = name
+        self.max_context = serve_cfg.max_context or cfg.max_seq
+        bs = serve_cfg.block_size
+        self._blocks_per_prompt = -(-self.max_context // bs)
+        # staging pool: exactly one max-context prompt (FCFS-to-completion
+        # means at most one request is mid-prefill at a time)
+        self.kv_cfg = KVCacheConfig(
+            num_layers=cfg.num_layers, num_heads=cfg.num_heads,
+            head_dim=cfg.head_dim, num_blocks=self._blocks_per_prompt,
+            block_size=bs, dtype=cfg.dtype,
+            quantized=serve_cfg.kv_quant == "int8")
+        self.allocator = BlockAllocator(self._blocks_per_prompt)
+        self.cache = init_kv_cache(self.kv_cfg)
+        self._base_key = (base_key if base_key is not None
+                          else jax.random.PRNGKey(0))
+        self._events = events
+        self._anchor = time.perf_counter()
+        self._now_ms = now_ms or (
+            lambda: (time.perf_counter() - self._anchor) * 1e3)
+        self.queue_limit = int(queue_limit)
+        # (request, t_submit_ms) accepted but not started
+        self._queue: collections.deque = collections.deque()
+        self._current: Optional[Dict[str, Any]] = None
+        self.chunks_run = 0
+        self.prefills_done = 0
+        self.last_chunk_tokens = 0
+        self.last_chunk_ms = 0.0
+        kv_cfg, scfg = self.kv_cfg, serve_cfg
+
+        def chunk_prefill(params, cache, tokens, start, n_valid, block_row,
+                          key):
+            # the engine's chunk closure verbatim — same program, same
+            # first-token draw, which is why cluster streams are bitwise
+            # the single-engine ones
+            cache, logits = gpt_prefill_chunk(
+                params, tokens, start, n_valid, cache, block_row, cfg,
+                kv_cfg, use_pallas=use_pallas)
+            tok = sample(logits[None], key[None],
+                         jnp.reshape(start + n_valid, (1,)), scfg.sampling)
+            return cache, tok[0]
+
+        def extract(cache, ids):
+            return pack_blocks(cache, kv_cfg, ids, wire_mode=wire_mode)
+
+        self.params = params
+        self._chunk_prefill = jax.jit(chunk_prefill, donate_argnums=(1,))
+        self._extract = jax.jit(extract)
+
+    # -- capacity / submission --------------------------------------------
+    @property
+    def can_accept(self) -> bool:
+        return len(self._queue) < self.queue_limit or (
+            self._current is None and not self._queue)
+
+    def accept(self, request: Request, t_submit_ms: float) -> None:
+        if not self.can_accept:
+            raise RuntimeError(f"{self.name}: queue full")
+        self._queue.append((request, float(t_submit_ms)))
+
+    @property
+    def backlog_tokens(self) -> int:
+        """Prompt tokens accepted but not yet chunk-prefilled — the
+        router's feasibility signal."""
+        n = sum(len(r.tokens) for r, _ in self._queue)
+        if self._current is not None:
+            n += self._current["prompt_len"] - self._current["pos"]
+        return n
+
+    @property
+    def busy(self) -> bool:
+        return self._current is not None or bool(self._queue)
+
+    def compile_counts(self) -> Dict[str, Optional[int]]:
+        return {"chunk_prefill": _cache_size_of(self._chunk_prefill),
+                "extract": _cache_size_of(self._extract)}
+
+    # -- stepping ----------------------------------------------------------
+    def _start_next(self) -> None:
+        request, t_submit = self._queue.popleft()
+        p = len(request.tokens)
+        blocks = self.allocator.alloc(self.kv_cfg.blocks_for_tokens(p))
+        assert blocks is not None  # staging pool fits any valid prompt
+        row = np.zeros((self._blocks_per_prompt,), np.int32)
+        row[:len(blocks)] = blocks
+        t = self._now_ms()
+        if self._events is not None:
+            self._events.emit("prefill_start", request.uid, t_ms=t,
+                              host=self.name, prompt_tokens=p,
+                              chunk=self.serve_cfg.prefill_chunk)
+        self._current = {
+            "request": request, "prompt_len": p, "pos": 0,
+            "blocks": blocks, "row": jnp.asarray(row),
+            "key": jnp.asarray(
+                request_key(self._base_key, request.sampling_seed())),
+            "t_submit_ms": t_submit, "queue_ms": t - t_submit,
+        }
+
+    def step(self) -> Optional[KVHandoff]:
+        """Run one fixed-size chunk of the current prompt (starting the
+        next queued request if idle); returns the finished request's
+        :class:`KVHandoff` on its final chunk, else None."""
+        if self._current is None:
+            if not self._queue:
+                return None
+            self._start_next()
+        cur = self._current
+        assert cur is not None
+        C = self.serve_cfg.prefill_chunk
+        c, p = cur["pos"], cur["prompt_len"]
+        n_valid = min(C, p - c)
+        tokens = np.zeros((C,), np.int32)
+        tokens[:n_valid] = np.asarray(
+            cur["request"].tokens[c:c + n_valid], np.int32)
+        t0 = time.perf_counter()
+        with span("prefill"):
+            self.cache, tok = self._chunk_prefill(
+                self.params, self.cache, jnp.asarray(tokens),
+                jnp.int32(c), jnp.int32(n_valid), cur["row"], cur["key"])
+            cur["pos"] = c + n_valid
+            done = cur["pos"] >= p
+            if done:
+                first = int(tok)  # fence: TTFT includes the round-trip
+            else:
+                # fence EVERY chunk before reading the timer — async
+                # dispatch would otherwise stamp ~0 ms on non-final
+                # chunks and poison the router's ms/token calibration
+                jax.block_until_ready(self.cache)
+        self.last_chunk_tokens = n_valid
+        self.last_chunk_ms = (time.perf_counter() - t0) * 1e3
+        self.chunks_run += 1
+        if not done:
+            return None
+        t_first = self._now_ms()
+        if self._events is not None:
+            self._events.emit("prefill_end", cur["request"].uid,
+                              t_ms=t_first, host=self.name)
+            self._events.emit("first_token", cur["request"].uid,
+                              t_ms=t_first, host=self.name,
+                              ttft_ms=round(t_first - cur["t_submit_ms"],
+                                            3))
+        # pack the written prompt blocks (padded to the fixed extract
+        # shape by repeating the first block — insert drops the padding)
+        n_blocks = self.kv_cfg.blocks_for_tokens(p)
+        ids = np.full((self._blocks_per_prompt,), cur["blocks"][0],
+                      np.int32)
+        ids[:n_blocks] = cur["blocks"][:n_blocks]
+        payload_dev = self._extract(self.cache, jnp.asarray(ids))
+        payload = {k: np.asarray(v)[:, :, :n_blocks]
+                   for k, v in payload_dev.items()}
+        wire = transfer_wire_bytes(self.kv_cfg, n_blocks, self.wire_mode)
+        assert payload_nbytes(payload, n_blocks) == wire
+        self.allocator.free(cur["blocks"])
+        self._current = None
+        self.prefills_done += 1
+        return KVHandoff(
+            request=cur["request"], payload=payload, n_blocks=n_blocks,
+            prompt_len=p, first_token=first, wire_bytes=wire,
+            t_submit_ms=cur["t_submit_ms"], queue_ms=cur["queue_ms"],
+            t_first_ms=t_first, ttft_ms=t_first - cur["t_submit_ms"])
+
+
+class DecodeWorker:
+    """One decode host: a full :class:`InferenceEngine` admitted into via
+    KV handoffs instead of prompts. ``serve_cfg`` shapes the engine
+    (slots, pool, kv_quant, spec_k, megakernel); the engine's own
+    submit/prefill path stays unused."""
+
+    def __init__(self, params: Pytree, cfg, serve_cfg: ServeConfig, *,
+                 base_key=None, wire_mode: str = "raw", sink=None,
+                 events: Optional[EventLog] = None,
+                 slo: Optional[SloSpec] = None,
+                 retain_streams: bool = True,
+                 on_retire: Optional[Callable[[str, List[int]], None]] = None,
+                 use_pallas: Optional[bool] = None,
+                 peak_flops_per_s: Optional[float] = None,
+                 name: str = "decode0"):
+        validate_wire_mode(wire_mode)
+        self.name = name
+        self.wire_mode = wire_mode
+        self.engine = InferenceEngine(
+            params, cfg, serve_cfg, base_key=base_key, sink=sink,
+            events=events, slo=slo, retain_streams=retain_streams,
+            on_retire=on_retire, use_pallas=use_pallas,
+            peak_flops_per_s=peak_flops_per_s)
+        self._events = events
+        self._pending: collections.deque = collections.deque()
+        self.admitted = 0
+        kv_cfg = self.engine.kv_cfg
+
+        def insert(cache, payload, dst_ids):
+            return insert_blocks(cache, kv_cfg, payload, dst_ids,
+                                 wire_mode=wire_mode)
+
+        self._insert = jax.jit(insert, donate_argnums=(0,))
+
+    # -- admission of transferred blocks ----------------------------------
+    @property
+    def load(self) -> int:
+        """Occupied slots + handoffs waiting — the cluster's least-loaded
+        placement key."""
+        eng = self.engine
+        return (sum(s is not None for s in eng._slots) + len(self._pending))
+
+    def admit(self, handoff: KVHandoff) -> None:
+        self._pending.append(handoff)
+
+    def compile_counts(self) -> Dict[str, Optional[int]]:
+        out = self.engine.compile_counts()
+        out["insert"] = _cache_size_of(self._insert)
+        return out
+
+    def _install(self, h: KVHandoff) -> bool:
+        eng = self.engine
+        slot = eng._free_slot()
+        if slot is None:
+            return False
+        total = min(h.prompt_len + h.request.max_new_tokens,
+                    eng.max_context)
+        n_blocks = eng.kv_cfg.blocks_for_tokens(total)
+        blocks = eng.allocator.alloc(n_blocks)
+        if blocks is None:
+            return False
+        nbp = h.n_blocks
+        bpp = eng._blocks_per_slot
+        # destination ids padded out of range (insert drops them), payload
+        # zero-padded to the one compiled insert shape
+        dst = np.full((bpp,), eng.kv_cfg.num_blocks, np.int32)
+        dst[:nbp] = blocks[:nbp]
+        payload = {}
+        for k, arr in h.payload.items():
+            pad = np.zeros(arr.shape[:2] + (bpp - nbp,) + arr.shape[3:],
+                           arr.dtype)
+            payload[k] = jnp.asarray(np.concatenate([arr, pad], axis=2))
+        eng.cache = self._insert(eng.cache, payload, jnp.asarray(dst))
+        state = _SlotState(
+            request=h.request, blocks=blocks,
+            generated=[h.first_token],
+            history=[int(t) for t in h.request.tokens] + [h.first_token],
+            prompt_len=h.prompt_len, prefill_pos=h.prompt_len,
+            cached_tokens=0, pending_commits=[],
+            t_submit_ms=h.t_submit_ms, t_first_ms=h.t_first_ms,
+            queue_ms=h.queue_ms, ttft_ms=h.ttft_ms,
+            chunk_start_ms=h.t_first_ms, chunk_done=1)
+        eng._slots[slot] = state
+        row = np.zeros((bpp,), np.int32)
+        row[:len(blocks)] = blocks
+        eng._block_tables[slot] = row
+        eng._keys[slot] = np.asarray(
+            request_key(eng._base_key, h.request.sampling_seed()),
+            np.uint32)
+        eng._seq_lens[slot] = h.prompt_len
+        eng._last_tokens[slot] = h.first_token
+        eng._active[slot] = True
+        eng._dirty("block_tables", "keys", "seq_lens", "last_tokens",
+                   "active")
+        if eng._t_start is None:
+            eng._t_start = time.perf_counter()
+        eng._tokens_generated += 1
+        self.admitted += 1
+        if self._events is not None:
+            self._events.emit("admitted", h.request.uid,
+                              t_ms=self.engine._now_ms(), host=self.name,
+                              slot=slot, queue_ms=round(h.queue_ms, 3))
+            self._events.gauge("occupancy", eng.occupancy())
+        # a 1-token request (or an immediate EOS) retires without ever
+        # reaching the decode grid — same as the engine's prefill tail
+        if eng._should_retire(state, h.first_token):
+            eng._retire(slot)
+        return True
+
+    def try_admit(self) -> int:
+        """Install as many pending handoffs as currently fit (in arrival
+        order — a blocked head defers the rest so streams stay FCFS)."""
+        n = 0
+        while self._pending:
+            if not self._install(self._pending[0]):
+                break
+            self._pending.popleft()
+            n += 1
+        return n
+
+    def step(self) -> bool:
+        """Admit what fits, then advance the decode grid one step."""
+        admitted = self.try_admit()
+        stepped = self.engine.step()
+        return stepped or admitted > 0
+
+    @property
+    def active(self) -> bool:
+        return bool(self.engine._active.any()) or bool(self._pending)
+
+    def stats(self) -> Dict[str, Any]:
+        out = self.engine.stats()
+        out["host"] = self.name
+        out["handoffs_admitted"] = self.admitted
+        out["handoffs_pending"] = len(self._pending)
+        return out

@@ -137,12 +137,15 @@ def default_bucket_ladder(max_context: int, start: int = 16
 class Request:
     """One generation request. ``seed`` feeds the request's sampling key
     (default: crc32 of the uid — stable across runs and admission orders);
-    irrelevant under greedy decoding."""
+    irrelevant under greedy decoding. ``tenant`` names the paying party
+    for the cluster router's weighted fair queueing (the single engine
+    ignores it)."""
 
     uid: str
     tokens: Sequence[int]
     max_new_tokens: int = 64
     seed: Optional[int] = None
+    tenant: str = "default"
 
     def sampling_seed(self) -> int:
         if self.seed is not None:
@@ -293,6 +296,8 @@ class InferenceEngine:
         on_retire: Optional[Callable[[str, List[int]], None]] = None,
         chunk_tokens: int = 16,
         drafter: Optional[Drafter] = None,
+        on_reject: Optional[Callable[[Request, Dict[str, Any]],
+                                     None]] = None,
     ):
         scfg = serve_cfg or ServeConfig()
         scfg.validate()
@@ -378,6 +383,12 @@ class InferenceEngine:
             if slo is not None else None)
         self._retain_streams = retain_streams
         self._on_retire = on_retire
+        # overload behavior: with an on_reject hook, a request the pool
+        # can NEVER fit is handed back as a structured rejection (the
+        # cluster router's shed path) instead of run()'s deadlock-loud
+        # RuntimeError; default behavior (raise) unchanged
+        self._on_reject = on_reject
+        self._rejected = 0
         self._completed = 0
         # throughput-optimization counters (stats() + step records)
         self._prefix_blocks_hit = 0
@@ -1022,8 +1033,26 @@ class InferenceEngine:
             if max_steps is not None and steps >= max_steps:
                 break
             if not self.step():
+                request = self._pending[0][0]
                 state_blocks = self.kv_cfg.blocks_for_tokens(
-                    self._total_tokens(self._pending[0][0]))
+                    self._total_tokens(request))
+                if self._on_reject is not None:
+                    # structured rejection instead of the deadlock-loud
+                    # raise: drop the unservable head and keep serving —
+                    # the caller (e.g. the cluster router) decides what a
+                    # rejection means
+                    self._pending.popleft()
+                    self._rejected += 1
+                    self._on_reject(request, {
+                        "reason": "pool_exhausted",
+                        "needed_blocks": state_blocks,
+                        "free_blocks": self.allocator.free_count,
+                        "pool_blocks": self.kv_cfg.num_blocks,
+                    })
+                    if self._events is not None:
+                        self._events.emit("shed", request.uid,
+                                          reason="pool_exhausted")
+                    continue
                 raise RuntimeError(
                     f"engine stalled: next request needs {state_blocks} "
                     f"blocks, pool has {self.allocator.free_count} free "
@@ -1059,6 +1088,7 @@ class InferenceEngine:
         and the goodput-under-SLO report when an ``SloSpec`` was given."""
         out: Dict[str, Any] = {
             "completed": self._completed,
+            "rejected": self._rejected,
             "steps": self._step_idx,
             "generated_tokens": self._tokens_generated,
             "queue_depth": len(self._pending),

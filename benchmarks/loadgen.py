@@ -80,6 +80,13 @@ class WorkloadConfig:
     prefix_pool: int = 0
     prefix_len: int = 32
     prefix_ratio: float = 1.0
+    # multi-tenant mixing (the cluster router's fairness knob): each
+    # request is tagged tenant "t0".."t{n-1}", drawn from the SAME seeded
+    # rng with probabilities proportional to tenant_weights (None: equal)
+    # — deterministic like prefix_pool, so the WFQ path is drivable from
+    # the bench and tests. 0 disables (every request tenant "default").
+    n_tenants: int = 0
+    tenant_weights: Optional[Tuple[float, ...]] = None
     seed: int = 0
 
     def validate(self) -> None:
@@ -102,6 +109,15 @@ class WorkloadConfig:
                 raise ValueError("prefix_len must be >= 1")
             if not 0.0 < self.prefix_ratio <= 1.0:
                 raise ValueError("prefix_ratio must be in (0, 1]")
+        if self.n_tenants < 0:
+            raise ValueError("n_tenants must be >= 0")
+        if self.tenant_weights is not None:
+            if len(self.tenant_weights) != self.n_tenants:
+                raise ValueError(
+                    f"tenant_weights has {len(self.tenant_weights)} "
+                    f"entries for n_tenants={self.n_tenants}")
+            if any(w <= 0 for w in self.tenant_weights):
+                raise ValueError("tenant_weights must be positive")
 
 
 def _lognormal_int(rng, median: float, sigma: float, lo: int, hi: int,
@@ -137,6 +153,14 @@ def build_workload(cfg: WorkloadConfig, vocab_size: int,
                     for _ in range(cfg.prefix_pool)]
         pick = rng.integers(0, cfg.prefix_pool, size=n)
         share = rng.random(size=n) < cfg.prefix_ratio
+    # tenant tags drawn from the same seeded stream (only when enabled, so
+    # an n_tenants=0 workload is bit-identical to the pre-tenant one)
+    tenants = None
+    if cfg.n_tenants:
+        w = np.asarray(cfg.tenant_weights
+                       if cfg.tenant_weights is not None
+                       else [1.0] * cfg.n_tenants, np.float64)
+        tenants = rng.choice(cfg.n_tenants, size=n, p=w / w.sum())
     if cfg.mode == "closed":
         arrivals = np.zeros((n,))
     else:
@@ -163,9 +187,12 @@ def build_workload(cfg: WorkloadConfig, vocab_size: int,
             # shared system prompt + the request's own tail, clipped to
             # leave >= 1 position to generate
             toks = (prefixes[int(pick[i])] + toks)[:max_context - 1]
+        tenant = (f"t{int(tenants[i])}" if tenants is not None
+                  else "default")
         out.append((float(arrivals[i]),
                     Request(f"lg{i:05d}", toks,
-                            max_new_tokens=int(glens[i]))))
+                            max_new_tokens=int(glens[i]),
+                            tenant=tenant)))
     return out
 
 

@@ -26,8 +26,10 @@
 #  12 decode fused A/B bench_serve.py --megakernel-ab --spec-k 4
 #                                           -> DECODE_FUSED_TPU.json
 #  13 fused update    bench_fused_update.py -> FUSED_UPDATE_TPU.json
+#  14 fsdp A/B        bench_fsdp.py         -> FSDP_TPU.json
+#  15 serve multihost bench_serve_mh.py --hosts 2 -> SERVE_MH_TPU.json
 # After the first seven, later healthy probes only refresh stage 1+3
-# (hourly) so the banked number tracks the latest code; stages 8-13
+# (hourly) so the banked number tracks the latest code; stages 8-15
 # ride the same hourly cadence until banked (additive evidence that must
 # never hold the suite out of refresh mode).
 cd /root/repo || exit 1
@@ -44,6 +46,7 @@ last_prefix=-3600   # stage-11 (shared-prefix + speculative) same contract
 last_mega=-3600     # stage-12 (megakernel decode A/B) same contract
 last_fusedupd=-3600 # stage-13 (fused update tail) same contract
 last_fsdp=-3600     # stage-14 (fsdp vs zero1 A/B) same contract
+last_mh=-3600       # stage-15 (disaggregated serve cluster) same contract
 
 note() { echo "$(date '+%F %T') $*" >> "$LOG"; }
 
@@ -371,6 +374,49 @@ $(cat /tmp/tpu_stage14_regress.out)"
   return 0
 }
 
+mh_stage() {
+  # stage 15: disaggregated prefill/decode cluster bench
+  # (benchmarks/bench_serve_mh.py --hosts 2) — goodput-under-SLO, shed
+  # rate, transfer wire bytes/ms and the disaggregated-vs-colocated A/B
+  # at >= 2 simulated hosts. Same promote rules as stages 10-14: CPU
+  # rehearsals (_CPU_FALLBACK) never promote; REGRESSION-GATED via
+  # monitor.regress --tol 0.15 once banked (shed_rate/transfer_ms lower-
+  # is-better, admitted_rps/goodput higher); hourly even after banked so
+  # a routing/transfer regression surfaces within an hour.
+  note "STAGE15 START: bench_serve_mh.py --hosts 2"
+  rm -f /tmp/serve_mh_try.json
+  timeout 1800 python benchmarks/bench_serve_mh.py --hosts 2 \
+    --out /tmp/serve_mh_try.json \
+    > /tmp/tpu_stage15.out 2> /tmp/tpu_stage15.err
+  local rc=$?
+  note "STAGE15 EXIT=$rc"
+  [ -s /tmp/serve_mh_try.json ] || return 1
+  if grep -q CPU_FALLBACK /tmp/serve_mh_try.json; then
+    note "STAGE15 got CPU_FALLBACK, not promoting"
+    return 1
+  fi
+  # a record whose measured transfer bytes disagree with the wire model
+  # (ok=false) is a correctness-of-claim failure, never a baseline
+  if grep -Eq '"ok": false' /tmp/serve_mh_try.json; then
+    note "STAGE15 record has ok false, not promoting"
+    return 1
+  fi
+  if [ -s SERVE_MH_TPU.json ]; then
+    if ! python -m apex_tpu.monitor.regress SERVE_MH_TPU.json \
+        /tmp/serve_mh_try.json --tol 0.15 \
+        > /tmp/tpu_stage15_regress.out 2>> /tmp/tpu_stage15.err; then
+      note "STAGE15 REGRESSION vs banked, keeping banked record: \
+$(cat /tmp/tpu_stage15_regress.out)"
+      return 1
+    fi
+  fi
+  cp /tmp/serve_mh_try.json SERVE_MH_TPU.json
+  note "STAGE15 PROMOTED $(cat SERVE_MH_TPU.json)"
+  [ $rc -eq 0 ] || return 1
+  [ "$(cat "$STATE")" -eq 14 ] && echo 15 > "$STATE"
+  return 0
+}
+
 smoke_stage() {
   # Smoke to a temp file; promote ANY real-TPU artifact (a failing kernel
   # on the chip is exactly the evidence we must bank) but never a CPU
@@ -461,6 +507,13 @@ while true; do
           fsdp_stage
           last_fsdp=$now
         fi
+        # stage 15 (disaggregated serve cluster): same hourly re-measure-
+        # after-banked contract — a goodput/shed/transfer regression must
+        # surface within an hour
+        if [ $((now - last_mh)) -ge 3600 ]; then
+          mh_stage
+          last_mh=$now
+        fi
         last_refresh=$now
       fi
     else
@@ -529,6 +582,18 @@ while true; do
           && [ $((now - last_fusedupd)) -ge 3600 ]; then
         fusedupd_stage
         last_fusedupd=$now
+      fi
+      # stage 14: FSDP vs ZeRO-1 A/B, same contract.
+      if [ "$(cat "$STATE")" -eq 13 ] \
+          && [ $((now - last_fsdp)) -ge 3600 ]; then
+        fsdp_stage
+        last_fsdp=$now
+      fi
+      # stage 15: disaggregated serve cluster, same contract.
+      if [ "$(cat "$STATE")" -eq 14 ] \
+          && [ $((now - last_mh)) -ge 3600 ]; then
+        mh_stage
+        last_mh=$now
       fi
       last_refresh=$now
     fi
