@@ -42,6 +42,12 @@ L9     ``apex_tpu.serve``             — (north-star: continuous-batching
                                       inference engine — paged KV cache,
                                       q_len=1 Pallas decode attention,
                                       in-graph sampling, bucketed prefill)
+L10    ``apex_tpu.analyze``           — (north-star: compiled-program
+                                      contract checker — donation /
+                                      recompile / dtype-leak / exposed-
+                                      collective / host-sync analyzers on
+                                      jaxprs + compiled HLO, plus the
+                                      baseline-gated repo graph-lint)
 =====  =============================  ==========================================
 """
 
@@ -52,6 +58,7 @@ __version__ = "0.1.0"
 
 __all__ = [
     "amp",
+    "analyze",
     "comm",
     "config",
     "contrib",

@@ -30,6 +30,7 @@ from apex_tpu.amp.frontend import (  # noqa: F401
     initialize,
     load_state_dict,
     model_params,
+    policy_compute_dtype,
     scale_loss,
     state_dict,
 )
@@ -51,6 +52,7 @@ __all__ = [
     "initialize",
     "load_state_dict",
     "model_params",
+    "policy_compute_dtype",
     "promote_function",
     "scale_loss",
     "state_dict",

@@ -85,6 +85,18 @@ def _preset(opt_level: str, half_dtype) -> PrecisionConfig:
     )
 
 
+def policy_compute_dtype(policy: PrecisionConfig):
+    """The effective low-precision dtype a policy declares for compute —
+    the O2/O3 model-cast dtype, else the O1 per-op compute dtype, else
+    ``None`` (O0: full precision, nothing to leak). This is THE policy-
+    region declaration ``apex_tpu.analyze.dtype_leak`` verifies compiled
+    steps against: a program whose dots run f32 under a policy that
+    declares bf16 here is flagged as a leak."""
+    dt = getattr(policy, "cast_model_type", None) or \
+        getattr(policy, "compute_dtype", None)
+    return jnp.dtype(dt) if dt is not None else None
+
+
 def get_policy(
     opt_level: str = "O0", half_dtype=_BF16, **overrides
 ) -> PrecisionConfig:

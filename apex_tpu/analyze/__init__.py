@@ -1,0 +1,96 @@
+"""apex_tpu.analyze — compiled-program contract checker + repo graph-lint.
+
+The repo's correctness story for compiled programs — donation actually
+aliased, jit caches bounded, dtype policies respected, collectives hidden
+behind compute, no host syncs in the step — grew up as one-off assertions
+inside individual test files. This subsystem promotes them into one
+reusable static-analysis surface, checked on the program XLA actually
+compiled (the EQuARX lesson: claims validated on the artifact, not the
+source), in two tiers:
+
+**Tier A — program analyzers** (jaxprs + lowered/compiled HLO):
+
+========================  ==================================================
+:mod:`~.donation`          ``assert_donated`` / ``check_donation`` — are
+                           declared-donated buffers ALIASED in the
+                           compiled executable, or silently copied?
+:mod:`~.recompile`         ``recompile_guard`` / ``jit_cache_size`` — jit
+                           cache sizes pinned to a declared budget across
+                           N invocations (the serve compile gate,
+                           generalized to any step).
+:mod:`~.dtype_leak`        ``assert_no_dtype_leaks`` — fp32 dots/convs
+                           under a declared bf16/fp8 policy and
+                           f32↔bf16 convert churn, from the jaxpr.
+:mod:`~.collectives`       ``assert_no_exposed(hlo, budget_bytes)`` —
+                           hidden/exposed wire-byte split over every
+                           collective kind (the ``overlap_report``
+                           evidence rules as an assertion pass).
+:mod:`~.host_sync`         ``assert_no_host_sync`` — ``device_get`` /
+                           ``block_until_ready`` / ``float(tracer)``
+                           sync points reachable from a step function.
+:mod:`~.hlo`               the shared ``as_text``/``parse`` entry point
+                           (one HLO normalization for ``comm.accounting``,
+                           ``monitor.report`` and every analyzer here).
+========================  ==================================================
+
+**Tier B — repo graph-lint** (:mod:`~.lint`): ``python -m
+apex_tpu.analyze.lint apex_tpu/`` — an AST pass flagging the
+anti-patterns this codebase has repeatedly fixed by hand (tracer
+branches, ``jnp.array`` on tracers, unjustified bare excepts, mutable
+default args, step-shaped jits missing ``donate_argnums``), gated by a
+checked-in baseline (``tests/lint_baseline.json``) so accepted sites pass
+while NEW violations fail tier-1.
+
+Analyzer records (``*.as_record()``) join the bench ``json_record``
+convention, and ``monitor.regress`` knows their polarity
+(``exposed_bytes`` / ``convert_churn_ops`` / ``host_syncs`` /
+``lint_violations``: lower is better) so the watcher's stage-16 contract
+record is regression-gated like every other banked artifact.
+"""
+
+# LAZY exports (PEP 562), deliberately: ``comm.accounting`` imports
+# ``analyze.hlo`` (the shared normalization) while ``analyze.collectives``
+# imports ``comm.accounting`` (the wire model) — an eager __init__ would
+# make that a cycle the moment either side loads first. ``hlo`` itself is
+# dependency-free and safe to import here.
+import importlib
+
+from apex_tpu.analyze import hlo  # noqa: F401  (submodule re-export)
+
+_EXPORTS = {
+    "DonationError": "donation", "DonationReport": "donation",
+    "assert_donated": "donation", "check_donation": "donation",
+    "donation_report": "donation",
+    "RecompileError": "recompile", "RecompileGuard": "recompile",
+    "compile_counts": "recompile", "jit_cache_size": "recompile",
+    "recompile_guard": "recompile",
+    "DtypeLeakError": "dtype_leak", "DtypeLeakReport": "dtype_leak",
+    "assert_no_dtype_leaks": "dtype_leak",
+    "dtype_leak_report": "dtype_leak",
+    "resolve_policy_dtype": "dtype_leak",
+    "ExposedCollectiveError": "collectives", "ExposedReport": "collectives",
+    "assert_no_exposed": "collectives", "exposed_report": "collectives",
+    "overlap_assertion": "collectives",
+    "HostSyncError": "host_sync", "HostSyncReport": "host_sync",
+    "assert_no_host_sync": "host_sync", "host_sync_report": "host_sync",
+    "Violation": "lint", "lint_paths": "lint", "load_baseline": "lint",
+    "new_violations": "lint", "write_baseline": "lint",
+}
+
+__all__ = sorted(_EXPORTS) + ["hlo"]
+
+
+def __getattr__(name: str):
+    try:
+        modname = _EXPORTS[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}") from None
+    mod = importlib.import_module(f"{__name__}.{modname}")
+    value = getattr(mod, name)
+    globals()[name] = value  # cache for the next access
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(__all__))

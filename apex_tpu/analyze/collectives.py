@@ -1,0 +1,190 @@
+"""Exposed-collective checker — assert comm latency is hidden, from HLO.
+
+``comm.accounting.overlap_report`` PROVES overlap for the decomposed
+ppermute rings (async start/done windows with dots inside); what it does
+not do is gate: every ring/FSDP/cluster bench re-derived its own "is the
+exposed share small enough" arithmetic. This module extends the report
+into an assertion pass over ALL collective kinds (not just permutes — a
+monolithic ``all-gather`` sitting on the critical path with no
+data-independent compute is exactly the exposed traffic the decomposition
+exists to remove):
+
+* :func:`exposed_report` — per-kind hidden/exposed wire-byte split using
+  the same evidence rules as ``overlap_report`` (async pairs: a ``dot``
+  scheduled inside the start→done window; sync ops: a def-use-independent
+  ``dot`` in the same computation) priced by the ``accounting`` ring
+  model;
+* :func:`assert_no_exposed` — raise :class:`ExposedCollectiveError` when
+  exposed bytes exceed a declared budget (``assert_no_exposed(hlo,
+  budget_bytes)`` — the gate every bench imports instead of re-deriving).
+
+Built on :func:`apex_tpu.analyze.hlo.parse` and the pricing helpers of
+:mod:`apex_tpu.comm.accounting` so the bytes here and the bytes in
+``collective_report`` are the SAME model.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Sequence
+
+from apex_tpu.analyze.hlo import OPERAND_RE, dependency_graph, parse, reach
+from apex_tpu.comm.accounting import (
+    COLLECTIVE_KINDS,
+    OverlapReport,
+    _async_result_bytes,
+    _dot_bearing,
+    _group_size,
+    _is_dot_like,
+    _paren_span,
+    _result_bytes,
+    _wire_cost,
+    overlap_report,
+)
+
+__all__ = ["ExposedCollectiveError", "ExposedReport", "assert_no_exposed",
+           "exposed_report", "overlap_assertion"]
+
+
+class ExposedCollectiveError(AssertionError):
+    """Collective traffic sits exposed on the critical path beyond the
+    declared budget."""
+
+
+@dataclasses.dataclass
+class ExposedReport:
+    """Hidden/exposed wire-byte split over every collective kind."""
+
+    hidden_wire_bytes: float = 0.0
+    exposed_wire_bytes: float = 0.0
+    hidden_by_kind: Dict[str, float] = dataclasses.field(default_factory=dict)
+    exposed_by_kind: Dict[str, float] = dataclasses.field(
+        default_factory=dict)
+    collectives: int = 0
+    hidden: int = 0
+
+    @property
+    def exposed(self) -> int:
+        return self.collectives - self.hidden
+
+    @property
+    def hidden_fraction(self) -> float:
+        total = self.hidden_wire_bytes + self.exposed_wire_bytes
+        return self.hidden_wire_bytes / total if total else 1.0
+
+    def as_record(self) -> dict:
+        """Flat json_record fields (``exposed_bytes`` is the
+        ``monitor.regress`` lower-is-better gate field)."""
+        return {"exposed_bytes": round(self.exposed_wire_bytes),
+                "hidden_bytes": round(self.hidden_wire_bytes),
+                "hidden_fraction": round(self.hidden_fraction, 4),
+                "collectives": self.collectives,
+                "collectives_hidden": self.hidden}
+
+    def __repr__(self):
+        return (f"ExposedReport({self.hidden}/{self.collectives} hidden, "
+                f"hidden_bytes={self.hidden_wire_bytes:.0f}, "
+                f"exposed_bytes={self.exposed_wire_bytes:.0f})")
+
+
+def exposed_report(hlo, kinds: Optional[Sequence[str]] = None,
+                   default_group_size: Optional[int] = None
+                   ) -> ExposedReport:
+    """Split every collective's modeled wire bytes into hidden vs exposed.
+
+    ``kinds`` restricts the op set (default: all of
+    ``accounting.COLLECTIVE_KINDS``); pass ``("collective-permute",)``
+    for exactly the ``overlap_report`` surface. Evidence rules match
+    ``overlap_report``: async ``-start``/``-done`` pairs are hidden when
+    a ``dot`` is scheduled inside the window; sync ops are hidden when
+    some ``dot`` in the same computation neither feeds nor consumes them.
+    """
+    kinds = tuple(kinds) if kinds is not None else COLLECTIVE_KINDS
+    mod = parse(hlo)
+    dot_comps = _dot_bearing(mod.computations)
+    rep = ExposedReport()
+
+    def _tally(kind: str, b: float, hidden: bool) -> None:
+        rep.collectives += 1
+        bucket = rep.hidden_by_kind if hidden else rep.exposed_by_kind
+        bucket[kind] = bucket.get(kind, 0.0) + b
+        if hidden:
+            rep.hidden += 1
+            rep.hidden_wire_bytes += b
+        else:
+            rep.exposed_wire_bytes += b
+
+    for comp, instrs in mod.computations.items():
+        # the SAME def-use walk overlap_report runs (analyze.hlo owns it:
+        # the evidence rules must never diverge between the two reports)
+        _index, deps, users = dependency_graph(instrs)
+        dot_idx = [i for i, (name, op, line) in enumerate(instrs)
+                   if _is_dot_like(op, line, dot_comps)]
+
+        for i, (name, op, line) in enumerate(instrs):
+            if op.endswith("-start") and op[:-len("-start")] in kinds:
+                kind = op[: -len("-start")]
+                open_idx = line.index(op + "(") + len(op)
+                # async start: price from the OPERANDS and reconstruct
+                # the sync result bytes (accounting's shared rule — a
+                # start's result tuple aliases the input next to the
+                # output)
+                b_op = _result_bytes(_paren_span(line, open_idx))
+                w = _group_size(line, default_group_size or 1)
+                wire = _wire_cost(kind,
+                                  float(_async_result_bytes(kind, b_op, w)),
+                                  w)
+                done = next(
+                    (j for j, (n2, op2, l2) in enumerate(instrs)
+                     if op2 == kind + "-done"
+                     and name in OPERAND_RE.findall(
+                         l2.split(" = ", 1)[1])), None)
+                hidden = done is not None and \
+                    any(i < d < done for d in dot_idx)
+                _tally(kind, wire, hidden)
+            elif op in kinds:
+                pre = line.split(" = ", 1)[1]
+                open_idx = pre.index(op + "(")
+                b = float(_result_bytes(pre[:open_idx]))
+                w = _group_size(line, default_group_size or 1)
+                wire = _wire_cost(op, b, w)
+                blocked = reach(name, users) | reach(name, deps) | {name}
+                hidden = any(instrs[d][0] not in blocked for d in dot_idx)
+                _tally(op, wire, hidden)
+    return rep
+
+
+def assert_no_exposed(hlo, budget_bytes: float = 0.0,
+                      kinds: Optional[Sequence[str]] = None,
+                      default_group_size: Optional[int] = None
+                      ) -> ExposedReport:
+    """Assert a compiled program's exposed collective traffic stays within
+    ``budget_bytes`` (modeled wire bytes, the ``accounting`` ring model).
+    Returns the :class:`ExposedReport` on success; raises
+    :class:`ExposedCollectiveError` with the per-kind breakdown
+    otherwise. The assertion pass every ring/FSDP/cluster bench imports
+    (``overlap_report`` remains the permute-window prover — see
+    :func:`apex_tpu.comm.accounting.overlap_report`)."""
+    rep = exposed_report(hlo, kinds=kinds,
+                         default_group_size=default_group_size)
+    if rep.exposed_wire_bytes > budget_bytes:
+        split = ", ".join(f"{k}={v:.0f}B"
+                          for k, v in sorted(rep.exposed_by_kind.items()))
+        raise ExposedCollectiveError(
+            f"{rep.exposed_wire_bytes:.0f} modeled wire bytes exposed "
+            f"(budget {budget_bytes:.0f}): {split}; hidden_fraction="
+            f"{rep.hidden_fraction:.3f} over {rep.collectives} collectives")
+    return rep
+
+
+def overlap_assertion(hlo, min_hidden_fraction: float = 0.5
+                      ) -> OverlapReport:
+    """The permute-ring form of the gate: ``overlap_report`` +
+    a hidden-byte-fraction floor (what the flagship tp/FSDP gates in
+    ``tests/test_collective_counts.py`` assert by hand)."""
+    rep = overlap_report(hlo)
+    if rep.permutes and rep.hidden_fraction < min_hidden_fraction:
+        raise ExposedCollectiveError(
+            f"permute traffic under-hidden: hidden_fraction="
+            f"{rep.hidden_fraction:.3f} < {min_hidden_fraction} ({rep})")
+    return rep

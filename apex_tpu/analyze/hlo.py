@@ -1,0 +1,173 @@
+"""One entry point for reading compiled-HLO text — normalization + parse.
+
+Every compiled-program check in this repo starts the same way: take "an
+HLO" (a string, a ``jax.stages.Compiled``, anything with ``.as_text()``),
+normalize it to text, and walk its computations in print order (which is
+schedule order for post-schedule TPU modules) while chasing
+``calls=``/``to_apply=``/``body=`` edges so fusion wrappers and while
+bodies are not blind spots. ``comm/accounting.py`` grew one copy of that
+walker for :func:`~apex_tpu.comm.accounting.overlap_report`,
+``monitor/report.py`` re-did the normalization for ``hlo_stats``, and
+every new analyzer would have needed a third. This module is the single
+implementation both import (and :mod:`apex_tpu.analyze` builds on):
+
+* :func:`as_text` — the ``isinstance(hlo, str) ... as_text()``
+  normalization, in one place;
+* :func:`parse_computations` — ``{computation: [(name, opcode, line)]}``
+  in print order (the ``overlap_report`` walker, verbatim semantics);
+* :func:`parse` — both of the above plus the module header, as one
+  :class:`HloModule` with alias/called-computation accessors.
+
+Deliberately dependency-free (stdlib + ``re`` only): ``comm`` and
+``monitor`` import it, so it must import neither.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Dict, List, Tuple
+
+__all__ = ["HloModule", "as_text", "parse", "parse_computations",
+           "CALLED_RE", "dependency_graph", "input_output_aliases",
+           "reach"]
+
+# instruction name on the left of " = "
+INSTR_RE = re.compile(r"^\s*(?:ROOT\s+)?%?([\w.-]+)\s*=\s*")
+# first opcode-like token followed by "(" on the right of " = "
+OPCODE_RE = re.compile(r"\b([a-z][\w-]*)\(")
+# %operand references inside an instruction's right-hand side
+OPERAND_RE = re.compile(r"%([\w.-]+)")
+# computation edges: fusions, maps, reductions, while bodies/conditions,
+# conditional branches — the walker must see through all of them
+CALLED_RE = re.compile(r"(?:calls|to_apply|body|condition|branch_"
+                       r"computations)=\{?%?([\w.-]+)")
+COMP_HEAD_RE = re.compile(r"^\s*(?:ENTRY\s+)?%?([\w.-]+)")
+
+# "{output_path}: (param_number, {param_path}, kind)" entries inside the
+# module header's input_output_alias={...} attribute
+_ALIAS_ENTRY_RE = re.compile(
+    r"\{([0-9, ]*)\}:\s*\((\d+),\s*\{([0-9, ]*)\}(?:,\s*([\w-]+))?\)")
+
+Instruction = Tuple[str, str, str]  # (name, opcode, full line)
+
+
+def as_text(hlo) -> str:
+    """Normalize to HLO text: a ``str`` passes through, anything else must
+    provide ``.as_text()`` (``jax.stages.Compiled``/``Lowered``, XLA
+    ``HloModule`` wrappers)."""
+    if isinstance(hlo, str):
+        return hlo
+    fn = getattr(hlo, "as_text", None)
+    if callable(fn):
+        return fn()
+    raise TypeError(
+        f"expected HLO text or an object with .as_text(), got {type(hlo)}")
+
+
+def parse_computations(text: str) -> Dict[str, List[Instruction]]:
+    """-> ``{comp_name: [(name, opcode, line), ...]}`` in print (schedule)
+    order. Instructions outside any recognized computation header land in
+    an ``""`` bucket so bare snippets (synthetic tests) still parse."""
+    comps: Dict[str, List[Instruction]] = {}
+    current = ""
+    for line in text.splitlines():
+        if line.rstrip().endswith("{") and " = " not in line:
+            m = COMP_HEAD_RE.match(line)
+            if m and m.group(1) != "HloModule":
+                current = m.group(1)
+            continue
+        if line.strip() == "}":
+            current = ""
+            continue
+        m = INSTR_RE.match(line)
+        if not m or " = " not in line:
+            continue
+        after = line.split(" = ", 1)[1]
+        op = OPCODE_RE.search(after)
+        comps.setdefault(current, []).append(
+            (m.group(1), op.group(1) if op else "", line))
+    return comps
+
+
+def input_output_aliases(text: str) -> List[Tuple[str, int, str, str]]:
+    """Donation evidence from the module header: the
+    ``input_output_alias={ {out}: (param, {idx}, kind), ... }`` entries of
+    a compiled module, as ``(output_path, param_number, param_path,
+    kind)`` tuples. An empty list on a program whose inputs were donated
+    means XLA aliased NOTHING — every donated buffer was silently
+    copied."""
+    # the attribute value is brace-nested ({ {0}: (0, {}, kind) ... }):
+    # a balanced scan, not a regex, finds its true extent
+    idx = text.find("input_output_alias={")
+    if idx < 0:
+        return []
+    depth, start = 0, idx + len("input_output_alias=")
+    m_text = ""
+    for i in range(start, len(text)):
+        if text[i] == "{":
+            depth += 1
+        elif text[i] == "}":
+            depth -= 1
+            if depth == 0:
+                m_text = text[start + 1: i]
+                break
+    return [(out.strip(), int(param), pidx.strip(), kind or "")
+            for out, param, pidx, kind in _ALIAS_ENTRY_RE.findall(m_text)]
+
+
+def dependency_graph(instrs: List[Instruction]):
+    """Def-use maps for ONE computation's instructions (same-computation
+    operands only): ``(index, deps, users)`` where ``deps[name]`` are the
+    operands an instruction reads and ``users[name]`` the instructions
+    that read it. The shared walk under ``overlap_report`` and
+    ``analyze.collectives.exposed_report`` — the hidden/exposed evidence
+    rules must never diverge between the two."""
+    index = {name: i for i, (name, _, _) in enumerate(instrs)}
+    users: Dict[str, List[str]] = {}
+    deps: Dict[str, List[str]] = {}
+    for name, _, line in instrs:
+        rhs = line.split(" = ", 1)[1]
+        ops_of = [o for o in OPERAND_RE.findall(rhs)
+                  if o in index and o != name]
+        deps[name] = ops_of
+        for o in ops_of:
+            users.setdefault(o, []).append(name)
+    return index, deps, users
+
+
+def reach(start: str, edges: Dict[str, List[str]]) -> set:
+    """Transitive closure of ``start`` over ``edges`` (deps or users)."""
+    seen, stack = set(), [start]
+    while stack:
+        n = stack.pop()
+        for nxt in edges.get(n, ()):
+            if nxt not in seen:
+                seen.add(nxt)
+                stack.append(nxt)
+    return seen
+
+
+@dataclasses.dataclass
+class HloModule:
+    """A parsed module: raw text + computations in print order."""
+
+    text: str
+    computations: Dict[str, List[Instruction]]
+
+    @property
+    def header(self) -> str:
+        return self.text.splitlines()[0] if self.text else ""
+
+    def input_output_aliases(self) -> List[Tuple[str, int, str, str]]:
+        return input_output_aliases(self.text)
+
+    def instructions(self) -> List[Instruction]:
+        return [i for instrs in self.computations.values() for i in instrs]
+
+
+def parse(hlo) -> HloModule:
+    """THE shared entry point: normalize (:func:`as_text`) + walk
+    (:func:`parse_computations`) in one call."""
+    text = as_text(hlo)
+    return HloModule(text=text, computations=parse_computations(text))

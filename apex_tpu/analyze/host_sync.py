@@ -1,0 +1,152 @@
+"""Host-sync detector — device↔host round trips reachable from a step.
+
+A jitted step is only as fast as its slowest DISPATCH: one stray
+``float(x)`` / ``bool(x)`` on a device value, a ``jax.device_get``, or an
+eager ``block_until_ready`` inside the step loop serializes the host
+against the device and halves a dispatch-bound decode loop. These bugs
+hide well — the program still computes the right answer, just slowly, and
+on CPU tests the sync costs nothing. This detector makes them loud:
+
+:func:`host_sync_report` traces ``fn`` with abstract values
+(``jax.make_jaxpr``) under a spy that counts the EXPLICIT sync APIs
+(``jax.device_get`` / ``jax.block_until_ready`` pass tracers through
+silently — the spy counts each call) and catches the IMPLICIT ones as the
+concretization errors they raise on tracers (``float``/``int``/``bool``
+on a traced value, ``np.asarray``, data-dependent Python ``if``), with
+the offending kind and message recorded. A clean step function reports
+``host_syncs == 0``.
+
+Caveat (by design of the passthrough spy): functions that captured
+``device_get`` via ``from jax import device_get`` at import time bypass
+the patch — call through the ``jax.`` namespace in step code, which is
+this repo's idiom anyway. Tracing stops at the FIRST implicit sync (the
+trace cannot continue past a concretization error), so fix-and-rerun
+until clean.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+from unittest import mock
+
+import jax
+
+__all__ = ["HostSyncError", "HostSyncReport", "assert_no_host_sync",
+           "host_sync_report"]
+
+_IMPLICIT_ERRORS = (
+    jax.errors.ConcretizationTypeError,       # float()/int(), shape uses
+    jax.errors.TracerArrayConversionError,    # np.asarray(tracer)
+    jax.errors.TracerBoolConversionError,     # bool(tracer), if tracer:
+    jax.errors.TracerIntegerConversionError,  # int(tracer) as index
+)
+
+# method-form sync attributes tracers lack: an AttributeError naming one
+# of these during the trace is the sync, not a detector bug
+_SYNC_ATTRS = ("block_until_ready", "device_buffer", "copy_to_host_async",
+               "on_device_size_in_bytes")
+
+
+class HostSyncError(AssertionError):
+    """A host↔device synchronization point is reachable from the step."""
+
+
+@dataclasses.dataclass
+class HostSyncReport:
+    """Sync points found on one trace of the step function."""
+
+    device_gets: int = 0
+    block_until_readys: int = 0
+    implicit_syncs: int = 0
+    implicit_kind: Optional[str] = None
+    implicit_detail: str = ""
+
+    @property
+    def host_syncs(self) -> int:
+        return self.device_gets + self.block_until_readys \
+            + self.implicit_syncs
+
+    @property
+    def ok(self) -> bool:
+        return self.host_syncs == 0
+
+    def as_record(self) -> dict:
+        return {"host_syncs": self.host_syncs,
+                "device_gets": self.device_gets,
+                "block_until_readys": self.block_until_readys,
+                "implicit_syncs": self.implicit_syncs}
+
+    def __repr__(self):
+        tail = f", implicit={self.implicit_kind}" if self.implicit_kind \
+            else ""
+        return (f"HostSyncReport(device_get={self.device_gets}, "
+                f"block_until_ready={self.block_until_readys}{tail})")
+
+
+def _kind_of(exc: Exception) -> str:
+    name = type(exc).__name__
+    return {"TracerBoolConversionError": "bool(tracer)",
+            "TracerIntegerConversionError": "int(tracer)",
+            "TracerArrayConversionError": "np.asarray(tracer)",
+            }.get(name, "concretization (float()/shape use of a tracer)")
+
+
+def host_sync_report(fn, *args, **kwargs) -> HostSyncReport:
+    """Trace ``fn(*args, **kwargs)`` and count reachable host syncs (see
+    module docstring for the detection rules)."""
+    rep = HostSyncReport()
+    real_get, real_block = jax.device_get, jax.block_until_ready
+
+    def spy_get(x):
+        rep.device_gets += 1
+        try:
+            return real_get(x)
+        except _IMPLICIT_ERRORS:
+            return x  # tracer: counted, pass through so the trace goes on
+
+    def spy_block(x):
+        rep.block_until_readys += 1
+        return x
+
+    with mock.patch.object(jax, "device_get", spy_get), \
+            mock.patch.object(jax, "block_until_ready", spy_block):
+        try:
+            jax.make_jaxpr(lambda *a: fn(*a, **kwargs))(*args)
+        except _IMPLICIT_ERRORS as e:
+            rep.implicit_syncs = 1
+            rep.implicit_kind = _kind_of(e)
+            rep.implicit_detail = str(e).splitlines()[0][:200]
+        except AttributeError as e:
+            # the METHOD forms sync through attributes tracers don't
+            # have (x.block_until_ready(), x.device_buffer, ...) — an
+            # AttributeError naming one of them IS the sync evidence;
+            # anything else is a genuine bug and re-raises
+            msg = str(e)
+            if any(a in msg for a in _SYNC_ATTRS):
+                rep.implicit_syncs = 1
+                rep.implicit_kind = "sync method on tracer"
+                rep.implicit_detail = msg.splitlines()[0][:200]
+            else:
+                raise
+    return rep
+
+
+def assert_no_host_sync(fn, *args, **kwargs) -> HostSyncReport:
+    """:func:`host_sync_report`, raising :class:`HostSyncError` when any
+    sync point is reachable from the step."""
+    rep = host_sync_report(fn, *args, **kwargs)
+    if not rep.ok:
+        parts = []
+        if rep.device_gets:
+            parts.append(f"{rep.device_gets}× jax.device_get")
+        if rep.block_until_readys:
+            parts.append(f"{rep.block_until_readys}× "
+                         f"jax.block_until_ready")
+        if rep.implicit_syncs:
+            parts.append(f"implicit sync via {rep.implicit_kind}: "
+                         f"{rep.implicit_detail}")
+        raise HostSyncError(
+            "host↔device sync reachable from the step function: "
+            + "; ".join(parts))
+    return rep

@@ -1,0 +1,357 @@
+"""Repo graph-lint — AST pass over ``apex_tpu/`` for repeat-offender bugs.
+
+Tier B of :mod:`apex_tpu.analyze`: where the program analyzers inspect
+jaxprs and compiled HLO, this pass inspects the SOURCE for anti-patterns
+the codebase has repeatedly fixed by hand, so the next instance fails
+tier-1 instead of shipping:
+
+``tracer-branch``
+    Python ``if``/``while`` on a ``jnp``/``lax``-valued expression inside
+    a jit-decorated function — a data-dependent branch that either
+    crashes at trace time or silently bakes one side into the program.
+``jnp-array-on-tracer``
+    ``jnp.array(x)`` on a bare name inside a jit-decorated function —
+    forces a copy (and a fresh const) where ``jnp.asarray``/nothing was
+    meant.
+``bare-except``
+    ``except Exception:`` / bare ``except:`` with no justification
+    comment on the handler line or the line above — the pattern that has
+    eaten real errors here before; an explanatory comment (or ``# pragma``)
+    marks the deliberate ones.
+``mutable-default-arg``
+    ``def f(x, acc=[])`` — the classic shared-state default.
+``missing-donate``
+    A step-shaped jit (function name containing ``step``/``update``,
+    decorated or wrapped with ``jax.jit``) without ``donate_argnums``/
+    ``donate_argnames`` — the donation the Metrics/scaler/KV threading
+    depends on, silently absent.
+
+Violations are identified by ``(rule, file, normalized source line)`` —
+NOT line numbers — so the checked-in baseline
+(``tests/lint_baseline.json``) survives unrelated edits: existing
+accepted sites pass, while a NEW violation (or a new copy of an old one)
+fails. CLI::
+
+    python -m apex_tpu.analyze.lint apex_tpu/ [--baseline FILE]
+    python -m apex_tpu.analyze.lint apex_tpu/ --write-baseline  # re-bless
+
+Exit 0 when every current violation is covered by the baseline, 1
+otherwise (the tier-1 wiring in ``tests/test_analyze.py``).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import sys
+from collections import Counter
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["Violation", "RULES", "lint_file", "lint_paths",
+           "load_baseline", "write_baseline", "new_violations", "main"]
+
+RULES = ("tracer-branch", "jnp-array-on-tracer", "bare-except",
+         "mutable-default-arg", "missing-donate")
+
+_STEP_SHAPED = ("step", "update")
+_JNP_NAMES = ("jnp", "lax")
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    rule: str
+    file: str       # repo-relative, '/'-separated
+    line: int       # 1-indexed (diagnostic only; NOT part of identity)
+    code: str       # stripped source line (identity)
+    message: str
+
+    @property
+    def key(self) -> Tuple[str, str, str]:
+        """Baseline identity: stable across unrelated line drift."""
+        return (self.rule, self.file, self.code)
+
+    def __str__(self):
+        return (f"{self.file}:{self.line}: [{self.rule}] {self.message}"
+                f"\n    {self.code}")
+
+
+def _is_jax_jit(node: ast.AST) -> bool:
+    """``jax.jit`` / ``jit`` / ``pjit`` as a name or attribute."""
+    if isinstance(node, ast.Attribute):
+        return node.attr in ("jit", "pjit")
+    if isinstance(node, ast.Name):
+        return node.id in ("jit", "pjit")
+    return False
+
+
+def _jit_decorator(dec: ast.AST) -> Optional[ast.Call]:
+    """The jit Call of a decorator, if this decorator jits the function:
+    ``@jax.jit``, ``@jit``, ``@functools.partial(jax.jit, ...)``.
+    Returns the Call carrying the jit kwargs (or None for a bare name)."""
+    if _is_jax_jit(dec):
+        return None if not isinstance(dec, ast.Call) else dec
+    if isinstance(dec, ast.Call):
+        if _is_jax_jit(dec.func):
+            return dec
+        fname = dec.func
+        is_partial = (isinstance(fname, ast.Attribute)
+                      and fname.attr == "partial") or \
+                     (isinstance(fname, ast.Name) and fname.id == "partial")
+        if is_partial and dec.args and _is_jax_jit(dec.args[0]):
+            return dec
+    return None
+
+
+def _decorated_jit(fn: ast.AST) -> Optional[Tuple[bool, bool]]:
+    """(is_jitted, has_donate) for a function def's decorator list."""
+    if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return None
+    for dec in fn.decorator_list:
+        if _is_jax_jit(dec) and not isinstance(dec, ast.Call):
+            return True, False
+        call = _jit_decorator(dec)
+        if call is not None:
+            donate = any(kw.arg in ("donate_argnums", "donate_argnames")
+                         for kw in call.keywords)
+            return True, donate
+    return None
+
+
+def _mentions_jnp(expr: ast.AST) -> bool:
+    """Does the expression subtree call into jnp/lax (tracer-valued)?"""
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id in _JNP_NAMES:
+            return True
+    return False
+
+
+def _step_shaped(name: str) -> bool:
+    low = name.lower()
+    return any(t in low for t in _STEP_SHAPED)
+
+
+class _Linter(ast.NodeVisitor):
+    def __init__(self, path: str, lines: Sequence[str]):
+        self.path = path
+        self.lines = lines
+        self.out: List[Violation] = []
+        self._jit_depth = 0  # inside a jit-decorated function (nested incl.)
+
+    # -- helpers ----------------------------------------------------------
+    def _code(self, node: ast.AST) -> str:
+        i = getattr(node, "lineno", 1) - 1
+        return self.lines[i].strip() if i < len(self.lines) else ""
+
+    def _flag(self, rule: str, node: ast.AST, message: str) -> None:
+        self.out.append(Violation(rule=rule, file=self.path,
+                                  line=getattr(node, "lineno", 0),
+                                  code=self._code(node), message=message))
+
+    def _has_comment(self, lineno: int) -> bool:
+        """A '#' comment on the line itself or the line above counts as
+        justification (crude but deliberate: the ask is a WHY, not a
+        format)."""
+        for i in (lineno - 1, lineno - 2):
+            if 0 <= i < len(self.lines) and "#" in self.lines[i]:
+                return True
+        return False
+
+    # -- function defs: jit context, donate rule, mutable defaults --------
+    def _visit_fn(self, node) -> None:
+        jit = _decorated_jit(node)
+        if jit is not None:
+            is_jit, has_donate = jit
+            if is_jit and not has_donate and _step_shaped(node.name):
+                self._flag(
+                    "missing-donate", node,
+                    f"step-shaped jit '{node.name}' without "
+                    f"donate_argnums — carried state will be copied, "
+                    f"not aliased")
+        for default in list(node.args.defaults) \
+                + [d for d in node.args.kw_defaults if d is not None]:
+            mutable = isinstance(default, (ast.List, ast.Dict, ast.Set)) \
+                or (isinstance(default, ast.Call)
+                    and isinstance(default.func, ast.Name)
+                    and default.func.id in ("list", "dict", "set"))
+            if mutable:
+                self._flag("mutable-default-arg", node,
+                           f"mutable default argument on '{node.name}'")
+        if jit is not None:
+            self._jit_depth += 1
+            self.generic_visit(node)
+            self._jit_depth -= 1
+        else:
+            self.generic_visit(node)
+
+    visit_FunctionDef = _visit_fn
+    visit_AsyncFunctionDef = _visit_fn
+
+    # -- jit-context rules -------------------------------------------------
+    def visit_If(self, node: ast.If) -> None:
+        if self._jit_depth and _mentions_jnp(node.test):
+            self._flag("tracer-branch", node,
+                       "Python `if` on a jnp/lax-valued expression in a "
+                       "jitted path — use jnp.where/lax.cond")
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        if self._jit_depth and _mentions_jnp(node.test):
+            self._flag("tracer-branch", node,
+                       "Python `while` on a jnp/lax-valued expression in "
+                       "a jitted path — use lax.while_loop")
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        # jnp.array(<bare name>) inside a jitted function
+        if (self._jit_depth
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "array"
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "jnp"
+                and node.args
+                and isinstance(node.args[0], (ast.Name, ast.Attribute))):
+            self._flag("jnp-array-on-tracer", node,
+                       "jnp.array() on a traced value forces a copy — "
+                       "jnp.asarray (or nothing) was meant")
+        # jax.jit(step_fn, ...) call form of the donate rule
+        if _is_jax_jit(node.func) and node.args:
+            target = node.args[0]
+            tname = None
+            if isinstance(target, ast.Name):
+                tname = target.id
+            elif isinstance(target, ast.Attribute):
+                tname = target.attr
+            elif isinstance(target, ast.Call) \
+                    and isinstance(target.func, ast.Name) \
+                    and target.args \
+                    and isinstance(target.args[0], ast.Name):
+                tname = target.args[0].id  # jax.jit(wrap(step))
+            if tname and _step_shaped(tname) and not any(
+                    kw.arg in ("donate_argnums", "donate_argnames")
+                    for kw in node.keywords):
+                self._flag(
+                    "missing-donate", node,
+                    f"step-shaped jit of '{tname}' without donate_argnums")
+        self.generic_visit(node)
+
+    # -- bare except --------------------------------------------------------
+    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+        bare = node.type is None or (
+            isinstance(node.type, ast.Name)
+            and node.type.id in ("Exception", "BaseException"))
+        if bare and not self._has_comment(node.lineno):
+            self._flag("bare-except", node,
+                       "bare `except Exception` without a justification "
+                       "comment — name the exception or say why")
+        self.generic_visit(node)
+
+
+def lint_file(path: str, root: str = ".") -> List[Violation]:
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    rel = os.path.relpath(path, root).replace(os.sep, "/")
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Violation(rule="syntax-error", file=rel,
+                          line=e.lineno or 0, code=e.text or "",
+                          message=str(e))]
+    linter = _Linter(rel, source.splitlines())
+    linter.visit(tree)
+    return linter.out
+
+
+def lint_paths(paths: Sequence[str], root: str = ".") -> List[Violation]:
+    """Lint files and directory trees (``.py`` files, recursively)."""
+    out: List[Violation] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if d != "__pycache__")
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        out.extend(lint_file(os.path.join(dirpath, fn),
+                                             root))
+        else:
+            out.extend(lint_file(p, root))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# baseline allowlist
+
+
+def load_baseline(path: str) -> Counter:
+    """Baseline multiset of accepted violation keys. A missing file is an
+    empty baseline (everything flags)."""
+    if not os.path.exists(path):
+        return Counter()
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    return Counter((e["rule"], e["file"], e["code"])
+                   for e in data.get("violations", []))
+
+
+def write_baseline(violations: Sequence[Violation], path: str) -> None:
+    """Bless the current violation set. Entries keep the line number for
+    human navigation; matching ignores it."""
+    entries = [{"rule": v.rule, "file": v.file, "line": v.line,
+                "code": v.code}
+               for v in sorted(violations, key=lambda v: v.key)]
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"schema": 1, "violations": entries}, f, indent=1)
+        f.write("\n")
+
+
+def new_violations(violations: Sequence[Violation],
+                   baseline: Counter) -> List[Violation]:
+    """Multiset subtraction: each baseline entry absolves ONE occurrence
+    of its key — a second copy of an accepted anti-pattern still flags."""
+    budget = Counter(baseline)
+    fresh = []
+    for v in violations:
+        if budget[v.key] > 0:
+            budget[v.key] -= 1
+        else:
+            fresh.append(v)
+    return fresh
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="apex_tpu repo graph-lint (baseline-gated)")
+    ap.add_argument("paths", nargs="+", help="files or directories to lint")
+    ap.add_argument("--baseline", default="tests/lint_baseline.json",
+                    help="accepted-violations allowlist (default: "
+                         "tests/lint_baseline.json)")
+    ap.add_argument("--root", default=".",
+                    help="path prefix violations are keyed relative to")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="re-bless: write the current violation set as "
+                         "the baseline and exit 0")
+    args = ap.parse_args(argv)
+
+    violations = lint_paths(args.paths, root=args.root)
+    if args.write_baseline:
+        write_baseline(violations, args.baseline)
+        print(f"baseline written: {len(violations)} accepted violations "
+              f"-> {args.baseline}", file=sys.stderr)
+        return 0
+    fresh = new_violations(violations, load_baseline(args.baseline))
+    print(f"linted: {len(violations)} violations, "
+          f"{len(violations) - len(fresh)} baselined, {len(fresh)} new",
+          file=sys.stderr)
+    for v in fresh:
+        print(str(v), file=sys.stderr)
+    return 1 if fresh else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,137 @@
+"""Recompile sentinel — jit cache sizes pinned to a declared budget.
+
+The repo's compile-count gates grew up scattered: ``tests/test_serve.py``
+pins ``engine.compile_counts()``, ``tests/test_monitor.py`` carried its
+own ``_cache_size`` helper, ``tests/test_megakernel.py`` re-asserted the
+serve gate. One implementation now lives here:
+
+* :func:`jit_cache_size` — compilation count of one jitted callable
+  (``None`` when this jax cannot report it);
+* :func:`compile_counts` — the ``engine.compile_counts()`` shape for any
+  named set of programs;
+* :func:`recompile_guard` — the generalization the issue asked for: a
+  context manager that snapshots cache sizes at entry and asserts growth
+  stays within a declared budget at exit, so ANY test or bench can write
+  ``with recompile_guard(step): run N steps`` and fail loudly on a
+  retrace (shape-keyed recompiles, accidental weak-type flips, treedef
+  churn — the failure modes the serve/monitor gates exist for).
+
+Budget semantics: ``budget`` bounds cache-size GROWTH inside the block.
+The default ``budget=None`` means "warmup allowed": each guarded program
+may add at most one entry if its cache was empty at entry, and none
+otherwise — the steady-state contract every step loop wants.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Callable, Dict, Iterator, Mapping, Optional, Union
+
+__all__ = ["RecompileError", "RecompileGuard", "compile_counts",
+           "jit_cache_size", "recompile_guard"]
+
+
+class RecompileError(AssertionError):
+    """A guarded program compiled more than its declared budget."""
+
+
+def jit_cache_size(jitted) -> Optional[int]:
+    """Compilation count of a jitted callable (``None`` if this jax
+    cannot say, or the callable is not jit-wrapped)."""
+    if jitted is None:
+        return 0
+    fn = getattr(jitted, "_cache_size", None)
+    return fn() if callable(fn) else None
+
+
+def compile_counts(programs: Mapping[str, Callable]
+                   ) -> Dict[str, Optional[int]]:
+    """Named jit-cache sizes — the ``engine.compile_counts()`` record
+    shape for any program set."""
+    return {name: jit_cache_size(fn) for name, fn in programs.items()}
+
+
+class RecompileGuard:
+    """State of one :func:`recompile_guard` block (inspectable inside)."""
+
+    def __init__(self, programs: Mapping[str, Callable],
+                 budget: Optional[int]):
+        self.programs = dict(programs)
+        self.budget = budget
+        self.entry = compile_counts(self.programs)
+        self.supported = any(v is not None for v in self.entry.values())
+
+    def counts(self) -> Dict[str, Optional[int]]:
+        return compile_counts(self.programs)
+
+    def growth(self) -> Dict[str, int]:
+        """Cache-size growth since entry, per program (unknowns as 0)."""
+        now = self.counts()
+        return {k: (now[k] or 0) - (self.entry[k] or 0)
+                for k in self.programs}
+
+    def check(self) -> None:
+        """Raise :class:`RecompileError` if any program exceeded its
+        budget (called automatically at block exit)."""
+        if not self.supported:
+            return  # this jax cannot report cache sizes: nothing to pin
+        over = {}
+        for name, grew in self.growth().items():
+            allowed = self.budget
+            if allowed is None:  # warmup contract: 1 if cold, else 0
+                allowed = 1 if not self.entry[name] else 0
+            if grew > allowed:
+                over[name] = (grew, allowed)
+        if over:
+            detail = ", ".join(
+                f"{name}: +{grew} compiles (budget {allowed})"
+                for name, (grew, allowed) in sorted(over.items()))
+            raise RecompileError(
+                f"jit cache grew past the declared budget — {detail}. "
+                f"Something retraced: shape-keyed inputs, weak-type "
+                f"flips, or a changing carry treedef.")
+
+
+def _name_of(fn: Callable, i: int) -> str:
+    inner = getattr(fn, "__wrapped__", fn)
+    return getattr(inner, "__name__", None) or f"program{i}"
+
+
+@contextlib.contextmanager
+def recompile_guard(
+    programs: Union[Callable, Mapping[str, Callable]],
+    *more: Callable,
+    budget: Optional[int] = None,
+) -> Iterator[RecompileGuard]:
+    """Assert the jit caches of ``programs`` stay within ``budget`` new
+    compilations across the block::
+
+        with recompile_guard(step) as g:       # warmup contract
+            for batch in data:
+                params = step(params, batch)
+        # exits cleanly: exactly one compile; raises RecompileError on
+        # ANY retrace. g.growth() is inspectable mid-block.
+
+        with recompile_guard({"prefill": eng._chunk_prefill,
+                              "decode": eng._decode}, budget=0):
+            eng.run(requests)                  # steady state: no compiles
+
+    ``programs``: one callable, several, or a ``{name: callable}`` dict.
+    ``budget=None`` (default) is the warmup contract — one compile
+    allowed per cold program, zero per warm one; an integer bounds growth
+    for every program uniformly. On a jax that cannot report cache sizes
+    the guard degrades to a no-op (the property is unpinnable there, not
+    violated)."""
+    if callable(programs):
+        named: Dict[str, Callable] = {}
+        for i, f in enumerate((programs,) + more):
+            name = _name_of(f, i)
+            if name in named:   # every step is named "step": keep both
+                name = f"{name}#{i}"
+            named[name] = f
+        programs = named
+    elif more:
+        raise TypeError("pass either one mapping or bare callables")
+    guard = RecompileGuard(programs, budget)
+    yield guard
+    guard.check()

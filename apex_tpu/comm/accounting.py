@@ -47,7 +47,14 @@ from __future__ import annotations
 
 import dataclasses
 import re
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, Optional
+
+from apex_tpu.analyze.hlo import (
+    as_text,
+    dependency_graph,
+    parse_computations,
+    reach,
+)
 
 COLLECTIVE_KINDS = (
     "all-reduce",
@@ -133,6 +140,18 @@ def _group_size(line: str, default: int) -> int:
     return default
 
 
+def _async_result_bytes(kind: str, b_op: int, w: int) -> int:
+    """Reconstruct a sync op's result bytes from an async ``-start``'s
+    OPERAND bytes (a start's result tuple aliases the operand next to the
+    output + u32 contexts — pricing it directly would double-charge).
+    One rule, shared with ``analyze.collectives``."""
+    if kind == "all-gather":
+        return b_op * w  # sync result = the gathered buffer
+    if kind == "reduce-scatter":
+        return -(-b_op // w) if w else b_op  # sync result = one shard
+    return b_op  # all-reduce / all-to-all / collective-permute
+
+
 def _wire_cost(kind: str, b: float, w: int) -> float:
     if kind == "collective-permute":
         # one hop per element; prints source_target_pairs, not groups
@@ -158,7 +177,7 @@ def collective_report(hlo, default_group_size: Optional[int] = None
     ``jax.stages.Compiled``). ``default_group_size``: group size used when
     an op prints no ``replica_groups`` (rare; flat single-group programs).
     """
-    text = hlo if isinstance(hlo, str) else hlo.as_text()
+    text = as_text(hlo)
     counts = {k: 0 for k in COLLECTIVE_KINDS}
     rbytes = {k: 0 for k in COLLECTIVE_KINDS}
     wire = {k: 0.0 for k in COLLECTIVE_KINDS}
@@ -177,12 +196,7 @@ def collective_report(hlo, default_group_size: Optional[int] = None
             # it would double-charge. Price from the operand types instead
             # and reconstruct the sync op's result bytes.
             b_op = _result_bytes(_paren_span(line, m.end() - 1))
-            if kind == "all-gather":
-                b = b_op * w  # sync result = the gathered buffer
-            elif kind == "reduce-scatter":
-                b = -(-b_op // w) if w else b_op  # sync result = one shard
-            else:  # all-reduce / all-to-all / collective-permute
-                b = b_op
+            b = _async_result_bytes(kind, b_op, w)
         else:
             # result type = everything between the assignment and the op
             # name (tuple-form all-to-all prints "/*index=N*/" comments in
@@ -203,12 +217,15 @@ def wire_bytes(hlo, default_group_size: Optional[int] = None) -> float:
 # ---------------------------------------------------------------------------
 # overlap proving — is the collective latency hidden behind matmuls?
 
-_INSTR_RE = re.compile(r"^\s*(?:ROOT\s+)?%?([\w.-]+)\s*=\s*")
-_OPCODE_RE = re.compile(r"\b([a-z][\w-]*)\(")
-_OPERAND_RE = re.compile(r"%([\w.-]+)")
-_CALLED_RE = re.compile(r"(?:calls|to_apply|body|condition|branch_"
-                        r"computations)=\{?%?([\w.-]+)")
-_COMP_HEAD_RE = re.compile(r"^\s*(?:ENTRY\s+)?%?([\w.-]+)")
+# instruction/operand/computation-walk machinery lives in analyze.hlo
+# (the one shared HLO normalization + parser); kept as module aliases for
+# the existing consumers of these names
+from apex_tpu.analyze.hlo import (  # noqa: E402
+    CALLED_RE as _CALLED_RE,
+    OPERAND_RE as _OPERAND_RE,
+)
+
+_parse_computations = parse_computations
 
 
 @dataclasses.dataclass
@@ -261,31 +278,6 @@ class OverlapReport:
                 f"{self.exposed_wire_bytes:.0f})")
 
 
-def _parse_computations(text: str):
-    """-> {comp_name: [(name, opcode, line), ...]} in print (schedule)
-    order. Instructions outside any recognized computation header land in
-    an ``""`` bucket so bare snippets (synthetic tests) still parse."""
-    comps: Dict[str, List[Tuple[str, str, str]]] = {}
-    current = ""
-    for line in text.splitlines():
-        if line.rstrip().endswith("{") and " = " not in line:
-            m = _COMP_HEAD_RE.match(line)
-            if m and m.group(1) != "HloModule":
-                current = m.group(1)
-            continue
-        if line.strip() == "}":
-            current = ""
-            continue
-        m = _INSTR_RE.match(line)
-        if not m or " = " not in line:
-            continue
-        after = line.split(" = ", 1)[1]
-        op = _OPCODE_RE.search(after)
-        comps.setdefault(current, []).append(
-            (m.group(1), op.group(1) if op else "", line))
-    return comps
-
-
 def _dot_bearing(comps) -> set:
     """Names of computations that (transitively) execute a ``dot``."""
     direct = {c for c, instrs in comps.items()
@@ -316,36 +308,18 @@ def overlap_report(hlo) -> OverlapReport:
     ``dot`` (see :class:`OverlapReport`). ``hlo``: text or anything with
     ``.as_text()``. Async pairs are judged by schedule position, sync
     permutes by def-use independence within their computation."""
-    text = hlo if isinstance(hlo, str) else hlo.as_text()
-    comps = _parse_computations(text)
+    text = as_text(hlo)
+    comps = parse_computations(text)
     dot_comps = _dot_bearing(comps)
     rep = OverlapReport()
     for comp, instrs in comps.items():
-        index = {name: i for i, (name, _, _) in enumerate(instrs)}
-        # def-use adjacency (operand -> user), same computation only
-        users: Dict[str, List[str]] = {}
-        deps: Dict[str, List[str]] = {}
-        dot_idx = []
-        for i, (name, op, line) in enumerate(instrs):
-            rhs = line.split(" = ", 1)[1]
-            ops_of = [o for o in _OPERAND_RE.findall(rhs)
-                      if o in index and o != name]
-            deps[name] = ops_of
-            for o in ops_of:
-                users.setdefault(o, []).append(name)
-            if _is_dot_like(op, line, dot_comps):
-                dot_idx.append(i)
+        # def-use adjacency (operand -> user), same computation only —
+        # the shared analyze.hlo walk (exposed_report uses the same one)
+        _index, deps, users = dependency_graph(instrs)
+        dot_idx = [i for i, (name, op, line) in enumerate(instrs)
+                   if _is_dot_like(op, line, dot_comps)]
         rep.dots += len(dot_idx)
-
-        def _reach(start: str, edges) -> set:
-            seen, stack = set(), [start]
-            while stack:
-                n = stack.pop()
-                for nxt in edges.get(n, ()):  # noqa: B023
-                    if nxt not in seen:
-                        seen.add(nxt)
-                        stack.append(nxt)
-            return seen
+        _reach = reach
 
         for i, (name, op, line) in enumerate(instrs):
             if op == "collective-permute-start":

@@ -217,6 +217,28 @@ class FSDP:
                                  multiple=self.shard_multiple),
             params)
 
+    def policy_dtype(self, meta: Pytree):
+        """The model compute dtype this engine's gathered forwards run in
+        (the widest low-precision leaf dtype of ``meta``, else the widest
+        overall) — the declared policy region
+        ``apex_tpu.analyze.dtype_leak`` checks the compiled step against:
+        a forward whose dots come out f32 under a bf16 ``meta`` is a
+        leak, not a preference."""
+        dts = {jnp.dtype(m.dtype) for m in jax.tree_util.tree_leaves(
+            meta, is_leaf=lambda x: isinstance(x, LeafMeta))
+            if isinstance(m, LeafMeta)}
+        # FLOAT dtypes only: an int8 codebook/bool mask leaf is not a
+        # compute-dtype declaration (and would silently disarm the
+        # dtype-leak gate, whose low-precision set is float-typed)
+        dts = {d for d in dts if jnp.issubdtype(d, jnp.floating)}
+        if not dts:
+            return None
+        low = [d for d in dts if d.itemsize < 4]
+        # deterministic pick: widest by itemsize, name as the tie-break
+        # (np dtype comparison is partial across ml_dtypes — never sort
+        # dtypes directly)
+        return max(low or dts, key=lambda d: (d.itemsize, d.name))
+
     # -- forward -----------------------------------------------------------
     def gather_leaf(self, shard, meta: LeafMeta):
         return _gather_leaf_op(shard, self.axis_name, meta.shape,

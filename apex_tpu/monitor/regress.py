@@ -63,7 +63,13 @@ _LOWER = ("_ms", "violation", "latency", "bubble", "exposed_bytes",
           # _HIGHER; wire_bytes_fsdp only — the generic "wire_bytes"
           # fragment would also gate baseline-side columns like
           # bench_overlap's wire_bytes_off, where only the ratio matters)
-          "hbm_params_bytes", "peak_hbm_bytes", "wire_bytes_fsdp")
+          "hbm_params_bytes", "peak_hbm_bytes", "wire_bytes_fsdp",
+          # analyze round (stage 16): the contract-checker record fields —
+          # growing exposed collective traffic (exposed_bytes above),
+          # f32↔bf16 convert round-trips, host syncs reachable from a
+          # step, or new lint violations are all regressions
+          "convert_churn", "host_syncs", "lint_violations",
+          "fp32_dots", "donated_copied")
 
 
 def classify_metric(key: str,

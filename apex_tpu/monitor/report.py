@@ -28,6 +28,7 @@ from typing import Any, Callable, Dict, Optional
 
 import jax
 
+from apex_tpu.analyze.hlo import as_text
 from apex_tpu.comm.accounting import collective_report
 
 
@@ -58,7 +59,9 @@ def hlo_stats(compiled, default_group_size: Optional[int] = None
     if isinstance(ca, (list, tuple)):  # older jax returns [dict]
         ca = ca[0] if ca else {}
     ca = dict(ca or {})
-    rep = collective_report(compiled, default_group_size)
+    # one .as_text() through the shared analyze.hlo normalization (the
+    # same entry point accounting parses through), priced once
+    rep = collective_report(as_text(compiled), default_group_size)
     # NaN (not 0.0) when the backend's cost model omits a key: a reader
     # must see "unavailable", never "measured zero"
     return {

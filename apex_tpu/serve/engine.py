@@ -540,20 +540,27 @@ class InferenceEngine:
         # copy-on-write block copy (src/dst traced -> one compile, ever)
         self._cow = jax.jit(wrap(cow), donate_argnums=(0,))
 
+    def programs(self) -> Dict[str, Optional[Callable]]:
+        """The engine's jitted programs, keyed like :meth:`compile_counts`
+        — hand this straight to ``analyze.recompile_guard`` to pin a
+        workload's compile behavior in place::
+
+            with recompile_guard(engine.programs(), budget=0):
+                engine.run(requests)   # steady state: no new compiles
+        """
+        return {"chunk_prefill": self._chunk_prefill,
+                "decode": self._decode,
+                "verify": self._verify,
+                "cow_copy": self._cow}
+
     def compile_counts(self) -> Dict[str, Optional[int]]:
         """Jit-cache sizes of the engine programs — the compile-count gate
         reads this (expected: exactly 1 chunked prefill + 1 decode, plus
-        <= 1 verify per distinct spec-k shape and <= 1 CoW copy)."""
-        def n(f):
-            if f is None:
-                return 0
-            fn = getattr(f, "_cache_size", None)
-            return fn() if callable(fn) else None
+        <= 1 verify per distinct spec-k shape and <= 1 CoW copy). One
+        implementation: ``analyze.recompile.compile_counts``."""
+        from apex_tpu.analyze.recompile import compile_counts
 
-        return {"chunk_prefill": n(self._chunk_prefill),
-                "decode": n(self._decode),
-                "verify": n(self._verify),
-                "cow_copy": n(self._cow)}
+        return compile_counts(self.programs())
 
     # -- submission --------------------------------------------------------
     @property

@@ -28,8 +28,9 @@
 #  13 fused update    bench_fused_update.py -> FUSED_UPDATE_TPU.json
 #  14 fsdp A/B        bench_fsdp.py         -> FSDP_TPU.json
 #  15 serve multihost bench_serve_mh.py --hosts 2 -> SERVE_MH_TPU.json
+#  16 contract check  analyze_contracts.py  -> ANALYZE_TPU.json
 # After the first seven, later healthy probes only refresh stage 1+3
-# (hourly) so the banked number tracks the latest code; stages 8-15
+# (hourly) so the banked number tracks the latest code; stages 8-16
 # ride the same hourly cadence until banked (additive evidence that must
 # never hold the suite out of refresh mode).
 cd /root/repo || exit 1
@@ -47,6 +48,7 @@ last_mega=-3600     # stage-12 (megakernel decode A/B) same contract
 last_fusedupd=-3600 # stage-13 (fused update tail) same contract
 last_fsdp=-3600     # stage-14 (fsdp vs zero1 A/B) same contract
 last_mh=-3600       # stage-15 (disaggregated serve cluster) same contract
+last_analyze=-3600  # stage-16 (compiled-program contract check) same
 
 note() { echo "$(date '+%F %T') $*" >> "$LOG"; }
 
@@ -417,6 +419,51 @@ $(cat /tmp/tpu_stage15_regress.out)"
   return 0
 }
 
+analyze_stage() {
+  # stage 16: compiled-program contract check (benchmarks/
+  # analyze_contracts.py) — donation aliases + recompile budgets on the
+  # flagship GPT/serve steps, the bf16 decode dtype profile (fp32_dots /
+  # convert_churn_ops), host-sync count, the gather-ring exposed-
+  # collective split over the banked bench HLO shapes, and the repo lint
+  # gate, all in ONE json_record. Same promote rules as stages 10-15:
+  # CPU rehearsals (_CPU_FALLBACK) never promote; a failed contract
+  # (ok=false) is evidence, never a baseline; REGRESSION-GATED via
+  # monitor.regress --tol 0.15 once banked (exposed_bytes / fp32_dots /
+  # convert_churn_ops / host_syncs / lint_violations are lower-is-better
+  # in the regress polarity tables); hourly even after banked so a new
+  # silently-copied donation or exposed ring surfaces within an hour.
+  note "STAGE16 START: analyze_contracts.py"
+  rm -f /tmp/analyze_try.json
+  timeout 1200 python benchmarks/analyze_contracts.py \
+    --out /tmp/analyze_try.json \
+    > /tmp/tpu_stage16.out 2> /tmp/tpu_stage16.err
+  local rc=$?
+  note "STAGE16 EXIT=$rc"
+  [ -s /tmp/analyze_try.json ] || return 1
+  if grep -q CPU_FALLBACK /tmp/analyze_try.json; then
+    note "STAGE16 got CPU_FALLBACK, not promoting"
+    return 1
+  fi
+  if grep -Eq '"ok": false' /tmp/analyze_try.json; then
+    note "STAGE16 record has ok false, not promoting"
+    return 1
+  fi
+  if [ -s ANALYZE_TPU.json ]; then
+    if ! python -m apex_tpu.monitor.regress ANALYZE_TPU.json \
+        /tmp/analyze_try.json --tol 0.15 \
+        > /tmp/tpu_stage16_regress.out 2>> /tmp/tpu_stage16.err; then
+      note "STAGE16 REGRESSION vs banked, keeping banked record: \
+$(cat /tmp/tpu_stage16_regress.out)"
+      return 1
+    fi
+  fi
+  cp /tmp/analyze_try.json ANALYZE_TPU.json
+  note "STAGE16 PROMOTED $(cat ANALYZE_TPU.json)"
+  [ $rc -eq 0 ] || return 1
+  [ "$(cat "$STATE")" -eq 15 ] && echo 16 > "$STATE"
+  return 0
+}
+
 smoke_stage() {
   # Smoke to a temp file; promote ANY real-TPU artifact (a failing kernel
   # on the chip is exactly the evidence we must bank) but never a CPU
@@ -514,6 +561,13 @@ while true; do
           mh_stage
           last_mh=$now
         fi
+        # stage 16 (compiled-program contract check): same contract — a
+        # lost donation alias, a new exposed ring, or a fresh lint
+        # violation must surface within an hour
+        if [ $((now - last_analyze)) -ge 3600 ]; then
+          analyze_stage
+          last_analyze=$now
+        fi
         last_refresh=$now
       fi
     else
@@ -594,6 +648,12 @@ while true; do
           && [ $((now - last_mh)) -ge 3600 ]; then
         mh_stage
         last_mh=$now
+      fi
+      # stage 16: compiled-program contract check, same contract.
+      if [ "$(cat "$STATE")" -eq 15 ] \
+          && [ $((now - last_analyze)) -ge 3600 ]; then
+        analyze_stage
+        last_analyze=$now
       fi
       last_refresh=$now
     fi

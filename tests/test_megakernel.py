@@ -173,9 +173,13 @@ def test_engine_streams_equal_with_speculation_and_int8():
 
 def test_engine_compile_gate_holds_with_megakernel():
     """The tightened PR-7 compile gate survives fusion: exactly 1 chunked
-    prefill + 1 decode program."""
+    prefill + 1 decode program (pinned through the shared
+    ``analyze.recompile_guard`` sentinel)."""
+    from apex_tpu.analyze import recompile_guard
+
     eng = _engine("on")
-    eng.run([Request(r.uid, r.tokens, r.max_new_tokens) for r in REQS])
+    with recompile_guard(eng.programs()):  # warmup contract
+        eng.run([Request(r.uid, r.tokens, r.max_new_tokens) for r in REQS])
     counts = eng.compile_counts()
     assert counts["chunk_prefill"] == 1
     assert counts["decode"] == 1

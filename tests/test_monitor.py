@@ -44,10 +44,10 @@ needs_mesh = pytest.mark.skipif(
     reason="mesh programs need jax.shard_map/lax.axis_size (graft jax)")
 
 
-def _cache_size(jitted):
-    """Compilation count of a jitted callable (None if this jax can't say)."""
-    fn = getattr(jitted, "_cache_size", None)
-    return fn() if callable(fn) else None
+# compilation count of a jitted callable (None if this jax can't say) —
+# ONE implementation, shared with engine.compile_counts and the
+# recompile_guard sentinel (tests/test_analyze.py pins its semantics)
+from apex_tpu.analyze.recompile import jit_cache_size as _cache_size  # noqa: E402,E501
 
 
 # ---------------------------------------------------------------------------

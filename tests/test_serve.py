@@ -318,7 +318,11 @@ def test_engine_compile_count_gate():
     """THE tightened gate: a mixed-length workload compiles EXACTLY one
     chunked-prefill program + one decode program — the PR-5 bucket ladder
     (one compile per bucket used) is gone. Speculation off -> no verify
-    program; no full-prompt cache hit -> no CoW copy."""
+    program; no full-prompt cache hit -> no CoW copy. The whole workload
+    runs under the shared ``analyze.recompile_guard`` sentinel (warmup
+    contract: one compile per cold program, then steady)."""
+    from apex_tpu.analyze import recompile_guard
+
     eng = _engine()
     reqs = [
         Request("r1", [1, 2], max_new_tokens=3),
@@ -327,7 +331,8 @@ def test_engine_compile_count_gate():
         Request("r4", [5, 6, 7], max_new_tokens=4),
         Request("r5", list(range(12)), max_new_tokens=2),
     ]
-    out = eng.run(reqs)
+    with recompile_guard(eng.programs()):  # >1 compile per program raises
+        out = eng.run(reqs)
     assert len(out) == 5
     counts = eng.compile_counts()
     if counts["decode"] is None:
