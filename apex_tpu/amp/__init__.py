@@ -11,7 +11,13 @@ Public surface (ref ``apex/amp/__init__.py`` + ``frontend.py:195`` +
 * :func:`half_function` / :func:`float_function` / :func:`promote_function` —
   user registration decorators.
 * :func:`state_dict` / :func:`load_state_dict` — checkpoint parity.
+* :mod:`apex_tpu.amp.fp8` — the sub-8-bit tier: e4m3-forward /
+  e5m2-gradient matmuls (``fp8.fp8_dot``) with per-tensor delayed
+  scaling carried as a Metrics-pytree state; ``get_policy("FP8")`` is the
+  policy declaration ``analyze.dtype_leak`` enforces.
 """
+
+from apex_tpu.amp import fp8  # noqa: F401
 
 from apex_tpu.amp.autocast import (  # noqa: F401
     autocast,
@@ -47,6 +53,7 @@ __all__ = [
     "cast_params",
     "default_norm_predicate",
     "float_function",
+    "fp8",
     "get_policy",
     "half_function",
     "initialize",

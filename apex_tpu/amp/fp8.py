@@ -1,0 +1,333 @@
+"""fp8 policy tier — e4m3 forward / e5m2 gradient with delayed scaling.
+
+The sub-8-bit training recipe (Transformer Engine / FP8-LM lineage)
+applied to the functional amp design: matmul operands are cast to
+``float8_e4m3fn`` on the forward and the incoming cotangent to
+``float8_e5m2`` on the backward (gradients need e5m2's 4× dynamic range;
+activations/weights need e4m3's extra mantissa bit), each tensor carrying
+a **per-tensor scale** chosen by *delayed scaling*: the scale used at step
+``k`` is derived from the rolling amax history of steps ``< k``, so the
+cast is a pure function of carried state — no data-dependent host sync,
+no recompilation. The state rides the jitted step exactly like the
+loss-scaler / EF-residual pytrees, and :func:`fp8_metrics` flattens it
+onto the :class:`~apex_tpu.monitor.Metrics` pipeline (scales, amaxes, and
+the ``fp8_overflow_rate`` saturation fraction the TPU watcher gates).
+
+The one structural wrinkle is the backward: a custom-VJP backward cannot
+emit a primal output, so the *gradient-side* amax observation travels as
+the COTANGENT of the gradient tensor-state argument (the established
+TE-JAX/flax ``q_dot_dq`` idiom). Concretely:
+
+* forward-side state (``x``/``w`` halves) updates flow out of
+  :func:`fp8_dot` as ordinary outputs;
+* the gradient-side half updates arrive in ``jax.grad``'s slot for the
+  state argument — differentiate the loss w.r.t. the fp8 state too and
+  stitch the two with :func:`merge_state_grads`::
+
+      def loss_fn(params, fp8_state):
+          y, st1 = fp8.fp8_dot(x, params["w1"], fp8_state["l1"])
+          ...
+          return loss, new_fwd_states
+
+      (loss, fwd_states), grads = jax.value_and_grad(
+          loss_fn, argnums=(0, 1), has_aux=True)(params, fp8_state)
+      fp8_state = fp8.merge_state_grads(fwd_states, grads[1])
+
+:func:`fp8_policy` is the amp-side declaration —
+``get_policy("FP8")`` resolves to a ``PrecisionConfig`` whose
+``compute_dtype`` is e4m3, which is what
+``apex_tpu.analyze.dtype_leak`` verifies compiled steps against (fp8 dots
+pass; a smuggled fp32 dot under the policy fails).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+Pytree = Any
+
+E4M3 = jnp.dtype(jnp.float8_e4m3fn)
+E5M2 = jnp.dtype(jnp.float8_e5m2)
+
+
+def fp8_max(dtype) -> float:
+    """Largest finite value of an fp8 dtype (448 for e4m3fn, 57344 for
+    e5m2) — the clip bound of :func:`cast_fp8` and the numerator of the
+    delayed-scaling rule."""
+    try:
+        import ml_dtypes
+        return float(ml_dtypes.finfo(dtype).max)
+    except Exception:  # pragma: no cover - ml_dtypes ships with jax
+        return {E4M3: 448.0, E5M2: 57344.0}[jnp.dtype(dtype)]
+
+
+@dataclasses.dataclass(frozen=True)
+class Fp8Recipe:
+    """Static delayed-scaling knobs (the TE recipe surface).
+
+    ``history_len``: amax-history window (scales react within this many
+    steps to a dynamic-range shift). ``margin``: scale = fp8_max /
+    (max(history) · 2^margin) — a safety headroom in powers of two.
+    ``fwd_dtype`` / ``grad_dtype``: the e4m3/e5m2 split.
+    """
+
+    history_len: int = 16
+    margin: float = 0.0
+    fwd_dtype: Any = E4M3
+    grad_dtype: Any = E5M2
+
+    def __post_init__(self):
+        if self.history_len < 1:
+            raise ValueError("history_len must be >= 1")
+        if self.margin < 0:
+            raise ValueError("margin must be >= 0")
+
+
+class Fp8TensorState(NamedTuple):
+    """Per-tensor delayed-scaling state: the scale the NEXT cast uses and
+    the rolling amax history it was derived from, plus the last observed
+    saturation fraction (elements clipping at the fp8 max — the
+    ``fp8_overflow_rate`` telemetry)."""
+
+    scale: jnp.ndarray          # f32 scalar
+    amax_history: jnp.ndarray   # (history_len,) f32
+    overflow_rate: jnp.ndarray  # f32 scalar, last cast's clip fraction
+
+
+def init_tensor_state(recipe: Fp8Recipe = Fp8Recipe()) -> Fp8TensorState:
+    return Fp8TensorState(scale=jnp.float32(1.0),
+                          amax_history=jnp.zeros((recipe.history_len,),
+                                                 jnp.float32),
+                          overflow_rate=jnp.float32(0.0))
+
+
+class Fp8DotState(NamedTuple):
+    """The three tensor states of one fp8 matmul site: forward operand
+    casts (``x``, ``w`` — e4m3) and the backward cotangent cast (``g`` —
+    e5m2)."""
+
+    x: Fp8TensorState
+    w: Fp8TensorState
+    g: Fp8TensorState
+
+
+def init_dot_state(recipe: Fp8Recipe = Fp8Recipe()) -> Fp8DotState:
+    return Fp8DotState(*(init_tensor_state(recipe) for _ in range(3)))
+
+
+def init_fp8_state(names, recipe: Fp8Recipe = Fp8Recipe()
+                   ) -> Dict[str, Fp8DotState]:
+    """One :class:`Fp8DotState` per named matmul site."""
+    return {str(n): init_dot_state(recipe) for n in names}
+
+
+# ---------------------------------------------------------------------------
+# cast + delayed-scale update
+
+
+def cast_fp8(x, scale, dtype):
+    """Scale, saturate and narrow to fp8. The scale is state, never data:
+    ``stop_gradient`` so the backward differentiates the MATH, not the
+    bookkeeping."""
+    s = lax.stop_gradient(scale)
+    m = fp8_max(dtype)
+    return jnp.clip(x.astype(jnp.float32) * s, -m, m).astype(dtype)
+
+
+def _observe(x, scale, dtype):
+    """(amax, overflow_rate) of casting ``x`` at ``scale`` — the
+    quantities the delayed-scaling update consumes."""
+    ax = jnp.abs(x.astype(jnp.float32))
+    amax = jnp.max(ax)
+    over = jnp.mean((ax * lax.stop_gradient(scale)
+                     > fp8_max(dtype)).astype(jnp.float32))
+    return amax, over
+
+
+def update_tensor_state(state: Fp8TensorState, amax, overflow_rate,
+                        dtype, recipe: Fp8Recipe = Fp8Recipe()
+                        ) -> Fp8TensorState:
+    """Delayed scaling: roll ``amax`` into the history and derive the
+    NEXT step's scale from the history maximum (so the scale at step k is
+    a pure function of steps < k+1 — no in-step data dependence). A
+    still-empty history (all zeros) keeps scale 1."""
+    hist = jnp.concatenate([state.amax_history[1:],
+                            jnp.reshape(amax, (1,)).astype(jnp.float32)])
+    hmax = jnp.max(hist)
+    new_scale = jnp.where(
+        (hmax > 0) & jnp.isfinite(hmax),
+        fp8_max(dtype) / (hmax * 2.0 ** recipe.margin),
+        state.scale)
+    return Fp8TensorState(scale=new_scale.astype(jnp.float32),
+                          amax_history=hist,
+                          overflow_rate=jnp.float32(overflow_rate))
+
+
+# ---------------------------------------------------------------------------
+# the fp8 matmul: e4m3 forward operands, e5m2 backward cotangent.
+# custom_vjp so the backward dots also run on fp8 operands (the whole point
+# — XLA would otherwise transpose the forward in fp32).
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _fp8_dot(x, w, state: Fp8DotState, recipe: Fp8Recipe):
+    qx = cast_fp8(x, state.x.scale, recipe.fwd_dtype)
+    qw = cast_fp8(w, state.w.scale, recipe.fwd_dtype)
+    y = lax.dot_general(qx, qw, (((x.ndim - 1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32)
+    return (y / (state.x.scale * state.w.scale)).astype(x.dtype)
+
+
+def _fp8_dot_fwd(x, w, state, recipe):
+    qx = cast_fp8(x, state.x.scale, recipe.fwd_dtype)
+    qw = cast_fp8(w, state.w.scale, recipe.fwd_dtype)
+    y = lax.dot_general(qx, qw, (((x.ndim - 1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32)
+    y = (y / (state.x.scale * state.w.scale)).astype(x.dtype)
+    return y, (qx, qw, state)
+
+
+def _fp8_dot_bwd(recipe, res, dy):
+    qx, qw, state = res
+    sg = lax.stop_gradient(state.g.scale)
+    qdy = cast_fp8(dy, sg, recipe.grad_dtype)
+    nb = qx.ndim - 1  # batch dims of x
+    # dx = dy @ w.T — e5m2 × e4m3 operands, f32 accumulate
+    dx = lax.dot_general(qdy, qw, (((qdy.ndim - 1,), (1,)), ((), ())),
+                         preferred_element_type=jnp.float32)
+    dx = dx / (sg * lax.stop_gradient(state.w.scale))
+    # dw = x.T @ dy — contract over every batch dim
+    bdims = tuple(range(nb))
+    dw = lax.dot_general(qx, qdy, ((bdims, bdims), ((), ())),
+                         preferred_element_type=jnp.float32)
+    dw = dw / (lax.stop_gradient(state.x.scale) * sg)
+    # the gradient-side state update travels as the state cotangent (the
+    # q_dot_dq idiom): harvest with jax.grad w.r.t. the state argument +
+    # merge_state_grads
+    amax_g, over_g = _observe(dy, sg, recipe.grad_dtype)
+    new_g = update_tensor_state(state.g, amax_g, over_g,
+                                recipe.grad_dtype, recipe)
+    zero = jax.tree_util.tree_map(jnp.zeros_like, state.x)
+    dstate = Fp8DotState(x=zero, w=zero, g=new_g)
+    # the wrapper normalized both operands to f32, so f32 cotangents
+    # match the primal avals by construction
+    return dx.astype(jnp.float32), dw.astype(jnp.float32), dstate
+
+
+_fp8_dot.defvjp(_fp8_dot_fwd, _fp8_dot_bwd)
+
+
+def fp8_dot(x, w, state: Fp8DotState, recipe: Fp8Recipe = Fp8Recipe()):
+    """``x @ w`` with e4m3 forward operands and an e5m2 backward
+    cotangent, per-tensor delayed scaling.
+
+    Returns ``(y, new_state)`` where ``new_state`` carries the FORWARD
+    halves' updates (``x``/``w`` amax histories + scales); the ``g`` half
+    is returned unchanged — its update arrives as the cotangent of
+    ``state`` when the caller differentiates w.r.t. it (see the module
+    docstring and :func:`merge_state_grads`). ``x``: (..., k); ``w``:
+    (k, n). The result is f32 (the dots accumulate f32 and the scales
+    divide out there; narrow at the call site if the surrounding policy
+    wants it).
+    """
+    y = _fp8_dot(x.astype(jnp.float32), w.astype(jnp.float32), state,
+                 recipe)
+    amax_x, over_x = _observe(x, state.x.scale, recipe.fwd_dtype)
+    amax_w, over_w = _observe(w, state.w.scale, recipe.fwd_dtype)
+    new_state = Fp8DotState(
+        x=update_tensor_state(state.x, amax_x, over_x,
+                              recipe.fwd_dtype, recipe),
+        w=update_tensor_state(state.w, amax_w, over_w,
+                              recipe.fwd_dtype, recipe),
+        g=state.g)
+    return y, new_state
+
+
+def merge_state_grads(fwd_states: Pytree, state_grads: Pytree) -> Pytree:
+    """Stitch one step's new fp8 state: the forward halves from the
+    :func:`fp8_dot` outputs, the gradient halves from ``jax.grad``'s slot
+    for the state argument (where the backward parked them)."""
+    def merge(fwd: Fp8DotState, g: Fp8DotState) -> Fp8DotState:
+        return Fp8DotState(x=fwd.x, w=fwd.w, g=g.g)
+
+    return jax.tree_util.tree_map(
+        merge, fwd_states, state_grads,
+        is_leaf=lambda v: isinstance(v, Fp8DotState))
+
+
+# ---------------------------------------------------------------------------
+# policy declaration + telemetry + checkpointing
+
+
+def fp8_policy():
+    """The amp-side fp8 declaration: a ``PrecisionConfig`` whose
+    ``compute_dtype`` is e4m3 — what ``amp.policy_compute_dtype`` resolves
+    and ``analyze.dtype_leak`` enforces. Per-tensor scaling replaces the
+    global loss scale (1.0)."""
+    from apex_tpu.amp.frontend import get_policy
+
+    return get_policy("FP8")
+
+
+def fp8_metrics(state: Pytree, prefix: str = "fp8") -> Dict[str, Any]:
+    """Flatten an fp8 state pytree to Metrics-ready named scalars: per-site
+    scales and amaxes plus the headline ``{prefix}_overflow_rate`` (max
+    saturation fraction across every cast site — lower is better, the
+    watcher-gated field)."""
+    out: Dict[str, Any] = {}
+    rates = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(
+            state, is_leaf=lambda v: isinstance(v, Fp8DotState))[0]:
+        if not isinstance(leaf, Fp8DotState):
+            continue
+        name = "/".join(
+            str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
+        name = name or "dot"
+        for half in ("x", "w", "g"):
+            ts: Fp8TensorState = getattr(leaf, half)
+            out[f"{prefix}_{name}_{half}_scale"] = ts.scale
+            out[f"{prefix}_{name}_{half}_amax"] = jnp.max(ts.amax_history)
+            rates.append(ts.overflow_rate)
+    if rates:
+        out[f"{prefix}_overflow_rate"] = jnp.max(jnp.stack(rates))
+    return out
+
+
+def state_dict(state: Pytree) -> Dict[str, Any]:
+    """Flat, revision-stable serialization (the EF-residual/loss-scaler
+    pattern): leaves keyed by flat index + the treedef string, so a resume
+    against different code fails loudly instead of mis-binding."""
+    leaves, treedef = jax.tree_util.tree_flatten(state)
+    return {
+        "treedef": str(treedef),
+        "leaves": {str(i): np.asarray(x) for i, x in enumerate(leaves)},
+    }
+
+
+def load_state_dict(state_template: Pytree, d: Dict[str, Any]) -> Pytree:
+    """Restore onto the live structure; validates treedef + leaf shapes."""
+    leaves, treedef = jax.tree_util.tree_flatten(state_template)
+    if d.get("treedef") is not None and d["treedef"] != str(treedef):
+        raise ValueError(
+            "fp8 state does not match the live structure:\n"
+            f"  saved: {d['treedef']}\n  live:  {treedef}")
+    if len(d["leaves"]) != len(leaves):
+        raise ValueError(
+            f"fp8 state has {len(d['leaves'])} saved leaves, live "
+            f"structure has {len(leaves)}")
+    new = []
+    for i, want in enumerate(leaves):
+        got = jnp.asarray(d["leaves"][str(i)], want.dtype)
+        if got.shape != jnp.shape(want):
+            raise ValueError(
+                f"fp8 state leaf {i} shape mismatch: saved {got.shape}, "
+                f"live {jnp.shape(want)}")
+        new.append(got)
+    return jax.tree_util.tree_unflatten(treedef, new)

@@ -79,9 +79,24 @@ def _preset(opt_level: str, half_dtype) -> PrecisionConfig:
             master_weights=False,
             loss_scale=1.0,
         )
+    if opt_level == "FP8":  # sub-8-bit tier: e4m3 fwd / e5m2 grad dots
+        # (apex_tpu.amp.fp8) with per-tensor delayed scaling — the
+        # per-tensor scales replace the global loss scale (1.0), masters
+        # stay fp32, norms stay wide (only the declared matmul sites
+        # narrow). compute_dtype is THE policy declaration dtype_leak
+        # verifies compiled steps against.
+        import jax.numpy as _jnp
+        return PrecisionConfig(
+            opt_level="FP8",
+            cast_model_type=None,
+            compute_dtype=_jnp.float8_e4m3fn,
+            keep_batchnorm_fp32=True,
+            master_weights=True,
+            loss_scale=1.0,
+        )
     raise ValueError(
         f"Unexpected optimization level {opt_level!r} "
-        "(options are 'O0', 'O1', 'O2', 'O3')"
+        "(options are 'O0', 'O1', 'O2', 'O3', 'FP8')"
     )
 
 

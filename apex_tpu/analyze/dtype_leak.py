@@ -40,6 +40,12 @@ __all__ = ["DtypeLeakError", "DtypeLeakReport", "assert_no_dtype_leaks",
 _LOW_PRECISION = ("bfloat16", "float16", "float8_e4m3", "float8_e4m3fn",
                   "float8_e5m2", "float8_e4m3fnuz", "float8_e5m2fnuz",
                   "float8_e4m3b11fnuz")
+# the policy lattice: a dot is ON-policy when its operands sit at or below
+# the declared dtype's rung. fp8 forward (e4m3) and gradient (e5m2) casts
+# share the bottom rung — an fp8 policy accepts both (the e4m3/e5m2 split
+# is the recipe, not a leak).
+_HALF = ("bfloat16", "float16")
+_FP8 = tuple(d for d in _LOW_PRECISION if d.startswith("float8"))
 _WIDE = ("float32", "float64")
 _HOT_PRIMS = ("dot_general", "conv_general_dilated")
 
@@ -125,6 +131,10 @@ class DtypeLeakReport:
     fp32_dots: int = 0
     fp32_dot_sites: Tuple[str, ...] = ()
     fp32_accum_dots: int = 0  # low-precision operands, f32 accumulate: ok
+    # dots one lattice rung ABOVE an fp8 policy (bf16/f16 operands):
+    # informational, never raise — fp8 recipes legitimately keep some
+    # sites half (norm-adjacent math) but the count should not creep
+    off_policy_half_dots: int = 0
     convert_ops: int = 0
     convert_churn_ops: int = 0
     churn_sites: Tuple[str, ...] = ()
@@ -137,6 +147,7 @@ class DtypeLeakReport:
     def as_record(self) -> dict:
         return {"fp32_dots": self.fp32_dots,
                 "fp32_accum_dots": self.fp32_accum_dots,
+                "off_policy_half_dots": self.off_policy_half_dots,
                 "convert_churn_ops": self.convert_churn_ops,
                 "convert_ops": self.convert_ops,
                 "total_dots": self.total_dots,
@@ -184,11 +195,20 @@ def dtype_leak_report(fn, *args, policy, **kwargs) -> DtypeLeakReport:
                     # path — the leak
                     rep.fp32_dots += 1
                     fp32_sites.append(_site(eqn))
-                elif low_policy and out_dt is not None \
-                        and out_dt.name in _WIDE:
-                    # low-precision operands accumulating into f32
-                    # (preferred_element_type): TPU-native, not a leak
-                    rep.fp32_accum_dots += 1
+                else:
+                    if low_policy and out_dt is not None \
+                            and out_dt.name in _WIDE:
+                        # low-precision operands accumulating into f32
+                        # (preferred_element_type): TPU-native, not a leak
+                        rep.fp32_accum_dots += 1
+                    if low_policy and policy_dt.name in _FP8 and any(
+                            getattr(getattr(v, "aval", None), "dtype",
+                                    None) is not None
+                            and v.aval.dtype.name in _HALF
+                            for v in eqn.invars):
+                        # one lattice rung above an fp8 policy: counted,
+                        # never raised (see _HALF note above)
+                        rep.off_policy_half_dots += 1
             elif name == "convert_element_type":
                 src, dst = _in_dtype(eqn), _out_dtype(eqn)
                 if src is None or dst is None:

@@ -55,8 +55,13 @@ from apex_tpu.comm.overlap import (  # noqa: F401
 )
 from apex_tpu.comm.quantize import (  # noqa: F401
     dequantize_blockwise,
+    dequantize_blockwise_int4,
+    pack_int4,
     quantization_error,
+    quantization_error_int4,
     quantize_blockwise,
+    quantize_blockwise_int4,
+    unpack_int4,
 )
 
 __all__ = [
@@ -71,6 +76,7 @@ __all__ = [
     "compressed_allreduce",
     "compressed_psum_scatter",
     "dequantize_blockwise",
+    "dequantize_blockwise_int4",
     "init_error_feedback",
     "load_state_dict",
     "matmul_all_reduce",
@@ -80,9 +86,13 @@ __all__ = [
     "matmul_reduce_scatter",
     "matmul_reduce_scatter_wire_bytes",
     "overlap_report",
+    "pack_int4",
     "psum_scatter_wire_bytes",
     "quantization_error",
+    "quantization_error_int4",
     "quantize_blockwise",
+    "quantize_blockwise_int4",
     "state_dict",
+    "unpack_int4",
     "wire_bytes",
 ]

@@ -52,11 +52,13 @@ from jax import lax
 
 from apex_tpu.comm.quantize import (
     dequantize_blockwise,
+    dequantize_blockwise_int4,
     padded_size,
     quantize_blockwise,
+    quantize_blockwise_int4,
 )
 
-POLICIES = ("none", "int8", "int8_ef")
+POLICIES = ("none", "int8", "int8_ef", "int4", "int4_ef")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -69,15 +71,23 @@ class CompressionConfig:
       * ``"int8"`` — blockwise int8 wire, quantization error discarded;
       * ``"int8_ef"`` — int8 wire + error feedback: the residual pytree
         (carried like the loss-scaler state) re-injects this step's
-        quantization error into the next step's gradients.
+        quantization error into the next step's gradients;
+      * ``"int4"`` / ``"int4_ef"`` — group-quantized 4-bit wire: codes
+        nibble-packed two per byte at 0.5 B/element plus one fp32 scale
+        per ``block_size``-element group (EQuARX's sub-8-bit extension).
+        EF is strongly recommended at 4 bits — the per-step quantization
+        error is ~16× the int8 one, so the telescoping residual is what
+        keeps the loss curve on the fp32 track.
 
     ``block_size``: elements per fp32 scale (wire overhead 4/B per element;
-    256 ≈ 1.6%). ``stochastic_rounding``: unbiased rounding — needs a
-    per-step ``seed`` at the call sites. ``min_elements``: buckets smaller
-    than this ride the uncompressed path (tiny buffers are latency-, not
-    bandwidth-bound; compressing them costs accuracy for no wire win).
-    ``use_pallas``: forwarded to the codec (None = auto: Pallas on compiled
-    TPU backends).
+    256 ≈ 1.6%); the int4 policies read it as the GROUP size (must be
+    even for nibble packing — keep it a multiple of the ZeRO shard
+    multiple, which the sharded optimizers already derive from it).
+    ``stochastic_rounding``: unbiased rounding — needs a per-step ``seed``
+    at the call sites. ``min_elements``: buckets smaller than this ride
+    the uncompressed path (tiny buffers are latency-, not bandwidth-bound;
+    compressing them costs accuracy for no wire win). ``use_pallas``:
+    forwarded to the codec (None = auto: Pallas on compiled TPU backends).
     """
 
     policy: str = "int8"
@@ -92,6 +102,10 @@ class CompressionConfig:
                 f"policy must be one of {POLICIES}, got {self.policy!r}")
         if self.block_size <= 0:
             raise ValueError(f"block_size must be > 0: {self.block_size}")
+        if self.bits == 4 and self.block_size % 2:
+            raise ValueError(
+                f"int4 policies need an even block_size (nibble packing): "
+                f"{self.block_size}")
 
     @property
     def enabled(self) -> bool:
@@ -99,11 +113,46 @@ class CompressionConfig:
 
     @property
     def error_feedback(self) -> bool:
-        return self.policy == "int8_ef"
+        return self.policy in ("int8_ef", "int4_ef")
+
+    @property
+    def bits(self) -> int:
+        """Code width of the quantized wire (8 or 4)."""
+        return 4 if self.policy.startswith("int4") else 8
+
+    def payload_bytes(self, n: int) -> float:
+        """Wire bytes of ONE quantized copy of an ``n``-element (padded)
+        buffer: packed codes at ``bits/8`` B/element + the fp32 per-block
+        scale sidecar. The unit the wire models below and the compiled-HLO
+        pricer (``accounting``) must agree on."""
+        return n * (self.bits / 8.0) + 4.0 * n / self.block_size
 
     def compresses(self, n: int) -> bool:
         """Whether a flat buffer of ``n`` elements takes the quantized path."""
         return self.enabled and n >= self.min_elements
+
+    # -- the policy-dispatched codec (THE supported encode/decode surface
+    # for every consumer: the collectives below, the FSDP weight gather) --
+    def quantize(self, flat, seed=None):
+        """Encode a flat fp buffer per this policy: ``(codes, scales)``.
+        int4 codes come back nibble-packed (half the element count);
+        chunk boundaries never split a packed pair because the (even)
+        block size divides every chunk."""
+        if self.bits == 4:
+            return quantize_blockwise_int4(
+                flat, self.block_size, stochastic=self.stochastic_rounding,
+                seed=seed, use_pallas=self.use_pallas)
+        return quantize_blockwise(
+            flat, self.block_size, stochastic=self.stochastic_rounding,
+            seed=seed, use_pallas=self.use_pallas)
+
+    def dequantize(self, q, s):
+        """Decode ``(codes, scales)`` back to the fp32 flat buffer."""
+        if self.bits == 4:
+            return dequantize_blockwise_int4(q, s, self.block_size,
+                                             use_pallas=self.use_pallas)
+        return dequantize_blockwise(q, s, self.block_size,
+                                    use_pallas=self.use_pallas)
 
 
 def allreduce_wire_bytes(n: int, itemsize: int, world: int,
@@ -116,10 +165,12 @@ def allreduce_wire_bytes(n: int, itemsize: int, world: int,
 
     Mirrors :func:`compressed_allreduce` op-for-op: uncompressed → one
     ``all-reduce`` (``2·b·(W-1)/W``); compressed → two ``all-to-all`` +
-    two ``all-gather`` of the padded int8 codes and fp32 block scales
-    (``2·(n' + 4·n'/B)·(W-1)/W`` with ``n'`` the block·world-padded size).
-    Sub-``min_elements`` buffers ride the uncompressed fp32 path, exactly
-    as the collective does.
+    two ``all-gather`` of the padded codes and fp32 block scales
+    (``2·payload(n')·(W-1)/W`` with ``n'`` the block·world-padded size and
+    ``payload`` the policy's packed-code + scale-sidecar bytes — int8 codes
+    at 1 B/element, int4 nibble pairs at 0.5 B/element). Sub-
+    ``min_elements`` buffers ride the uncompressed fp32 path, exactly as
+    the collective does.
     """
     if world <= 1:
         return 0.0
@@ -129,8 +180,7 @@ def allreduce_wire_bytes(n: int, itemsize: int, world: int,
             itemsize = 4  # small-buffer fallback psums in fp32
         return 2.0 * n * itemsize * ring
     size = padded_size(n, config.block_size * world)
-    per_pass = size + 4.0 * size / config.block_size  # int8 codes + scales
-    return 2.0 * per_pass * ring
+    return 2.0 * config.payload_bytes(size) * ring
 
 
 def psum_scatter_wire_bytes(n: int, itemsize: int, world: int,
@@ -149,7 +199,7 @@ def psum_scatter_wire_bytes(n: int, itemsize: int, world: int,
             itemsize = 4
         return float(k) * itemsize * (world - 1)
     size = max(k * world, padded_size(n, config.block_size * world))
-    return (size + 4.0 * size / config.block_size) * (world - 1) / world
+    return config.payload_bytes(size) * (world - 1) / world
 
 
 def all_gather_wire_bytes(n: int, itemsize: int, world: int) -> float:
@@ -213,18 +263,14 @@ def _exchange_and_sum(flat_padded, axis: str, cfg: CompressionConfig, seed):
     local quantization error over the full padded buffer)."""
     world = lax.axis_size(axis)
     n = flat_padded.size
-    q, s = quantize_blockwise(
-        flat_padded, cfg.block_size, stochastic=cfg.stochastic_rounding,
-        seed=_pass_seed(seed, axis, 1), use_pallas=cfg.use_pallas)
-    err = flat_padded - dequantize_blockwise(q, s, cfg.block_size,
-                                            use_pallas=cfg.use_pallas)
+    q, s = cfg.quantize(flat_padded, _pass_seed(seed, axis, 1))
+    err = flat_padded - cfg.dequantize(q, s)
     # rank i keeps chunk i of everyone's buffer: the reduce-scatter leg,
-    # int8 + fp32-scales on the wire
+    # packed codes + fp32 scales on the wire
     qt = lax.all_to_all(q, axis, split_axis=0, concat_axis=0, tiled=True)
     st = lax.all_to_all(s, axis, split_axis=0, concat_axis=0, tiled=True)
     k = n // world
-    rows = dequantize_blockwise(qt, st, cfg.block_size,
-                                use_pallas=cfg.use_pallas).reshape(world, k)
+    rows = cfg.dequantize(qt, st).reshape(world, k)
     return jnp.sum(rows, axis=0), err
 
 
@@ -251,7 +297,7 @@ def compressed_allreduce(
     """
     if config.error_feedback and residual is None:
         raise ValueError(
-            "policy 'int8_ef' needs the residual carried in: "
+            f"policy {config.policy!r} needs the residual carried in: "
             "init with error_feedback.init_error_feedback / "
             "DistributedDataParallel.init_comm_state")
     n = flat.size
@@ -272,13 +318,10 @@ def compressed_allreduce(
     shard_sum, err1 = _exchange_and_sum(padded, axis, config, seed)
 
     # midpoint requantization: fresh scales for the grown dynamic range
-    q2, s2 = quantize_blockwise(
-        shard_sum, config.block_size, stochastic=config.stochastic_rounding,
-        seed=_pass_seed(seed, axis, 2), use_pallas=config.use_pallas)
+    q2, s2 = config.quantize(shard_sum, _pass_seed(seed, axis, 2))
     qf = lax.all_gather(q2, axis, axis=0, tiled=True)
     sf = lax.all_gather(s2, axis, axis=0, tiled=True)
-    out = dequantize_blockwise(qf, sf, config.block_size,
-                               use_pallas=config.use_pallas)
+    out = config.dequantize(qf, sf)
 
     new_residual = residual
     if config.error_feedback:
@@ -286,8 +329,7 @@ def compressed_allreduce(
         # there — summed over ranks, the residuals then cover the whole
         # lost mass: sum_k r_k = sum_k e1_k + e2
         k = size // world
-        err2 = shard_sum - dequantize_blockwise(
-            q2, s2, config.block_size, use_pallas=config.use_pallas)
+        err2 = shard_sum - config.dequantize(q2, s2)
         rank = lax.axis_index(axis)
         err = lax.dynamic_update_slice(
             err1, lax.dynamic_slice(err1, (rank * k,), (k,)) + err2,
@@ -316,7 +358,7 @@ def compressed_psum_scatter(
     """
     if config.error_feedback and residual is None:
         raise ValueError(
-            "policy 'int8_ef' needs the residual carried in: "
+            f"policy {config.policy!r} needs the residual carried in: "
             "init with error_feedback.init_error_feedback")
     world = lax.axis_size(axis)
     n = flat.size

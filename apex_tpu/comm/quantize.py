@@ -47,6 +47,15 @@ except Exception:  # pragma: no cover
     _HAS_PALLAS = False
 
 QMAX = 127.0  # symmetric int8 code range; -128 is never emitted
+QMAX4 = 7.0   # symmetric int4 code range; -8 is never emitted
+
+
+def qmax_for_bits(bits: int) -> float:
+    if bits == 8:
+        return QMAX
+    if bits == 4:
+        return QMAX4
+    raise ValueError(f"unsupported code width: {bits} bits")
 
 
 def blocks_for(n: int, block_size: int) -> int:
@@ -58,12 +67,12 @@ def padded_size(n: int, block_size: int) -> int:
     return blocks_for(n, block_size) * block_size
 
 
-def _block_scales(xb: jnp.ndarray) -> jnp.ndarray:
-    """(rows, block) fp32 -> (rows,) fp32 scale = absmax/127, with all-zero
+def _block_scales(xb: jnp.ndarray, qmax: float = QMAX) -> jnp.ndarray:
+    """(rows, block) fp32 -> (rows,) fp32 scale = absmax/qmax, with all-zero
     blocks mapped to scale 1 so the quotient is well-defined (codes are 0
     there anyway)."""
     amax = jnp.max(jnp.abs(xb), axis=1)
-    return jnp.where(amax > 0, amax / QMAX, 1.0)
+    return jnp.where(amax > 0, amax / qmax, 1.0)
 
 
 def _uniform_from_bits(bits: jnp.ndarray) -> jnp.ndarray:
@@ -72,11 +81,41 @@ def _uniform_from_bits(bits: jnp.ndarray) -> jnp.ndarray:
 
 
 # ---------------------------------------------------------------------------
+# int4 nibble packing. Codes live in [-7, 7]; two two's-complement nibbles
+# share one byte (even index -> low nibble), so the packed wire/HBM payload
+# is exactly 0.5 B per element. Pure elementwise bit ops — XLA fuses the
+# pack/unpack into the surrounding program (and the Pallas paged-attention /
+# megakernel paths inline the same unpack in-kernel).
+
+
+def pack_int4(q: jnp.ndarray) -> jnp.ndarray:
+    """int8 codes in [-7, 7], even-sized last axis -> uint8 packed pairs
+    (last axis halved)."""
+    if q.shape[-1] % 2:
+        raise ValueError(f"pack_int4 needs an even last axis: {q.shape}")
+    lo = q[..., 0::2].astype(jnp.uint8) & jnp.uint8(0xF)
+    hi = q[..., 1::2].astype(jnp.uint8) & jnp.uint8(0xF)
+    return lo | (hi << jnp.uint8(4))
+
+
+def unpack_int4(packed: jnp.ndarray) -> jnp.ndarray:
+    """uint8 packed pairs -> int8 codes (last axis doubled); exact inverse
+    of :func:`pack_int4` for codes in [-8, 7]."""
+    lo = (packed & jnp.uint8(0xF)).astype(jnp.int8)
+    hi = (packed >> jnp.uint8(4)).astype(jnp.int8)
+    lo = jnp.where(lo > 7, lo - 16, lo)
+    hi = jnp.where(hi > 7, hi - 16, hi)
+    return jnp.stack([lo, hi], axis=-1).reshape(*packed.shape[:-1],
+                                                2 * packed.shape[-1])
+
+
+# ---------------------------------------------------------------------------
 # Pure-JAX reference path
 
-def _quantize_jax(x_flat, block_size: int, stochastic: bool, seed):
+def _quantize_jax(x_flat, block_size: int, stochastic: bool, seed,
+                  qmax: float = QMAX):
     xb = x_flat.astype(jnp.float32).reshape(-1, block_size)
-    scales = _block_scales(xb)
+    scales = _block_scales(xb, qmax)
     y = xb / scales[:, None]
     if stochastic:
         key = jax.random.fold_in(jax.random.PRNGKey(0), seed)
@@ -85,7 +124,7 @@ def _quantize_jax(x_flat, block_size: int, stochastic: bool, seed):
         q = jnp.floor(y + u)
     else:
         q = jnp.round(y)
-    q = jnp.clip(q, -QMAX, QMAX).astype(jnp.int8)
+    q = jnp.clip(q, -qmax, qmax).astype(jnp.int8)
     return q.reshape(-1), scales
 
 
@@ -97,25 +136,25 @@ def _dequantize_jax(q_flat, scales, block_size: int):
 # ---------------------------------------------------------------------------
 # Pallas kernels — one pass per row-block of (rows_per_step, block) elements
 
-def _quant_kernel(x_ref, q_ref, s_ref):
+def _quant_kernel(x_ref, q_ref, s_ref, *, qmax=QMAX):
     x = x_ref[:].astype(jnp.float32)
     amax = jnp.max(jnp.abs(x), axis=1, keepdims=True)
-    scale = jnp.where(amax > 0, amax / QMAX, 1.0)
-    q = jnp.clip(jnp.round(x / scale), -QMAX, QMAX)
+    scale = jnp.where(amax > 0, amax / qmax, 1.0)
+    q = jnp.clip(jnp.round(x / scale), -qmax, qmax)
     q_ref[:] = q.astype(jnp.int8)
     s_ref[:] = scale
 
 
-def _quant_kernel_stochastic(x_ref, seed_ref, q_ref, s_ref):
+def _quant_kernel_stochastic(x_ref, seed_ref, q_ref, s_ref, *, qmax=QMAX):
     # one PRNG stream per grid step: the per-core PRNG is reseeded with the
     # (seed, program_id) pair so every row-block draws independent bits
     pltpu.prng_seed(seed_ref[0], pl.program_id(0))
     x = x_ref[:].astype(jnp.float32)
     amax = jnp.max(jnp.abs(x), axis=1, keepdims=True)
-    scale = jnp.where(amax > 0, amax / QMAX, 1.0)
+    scale = jnp.where(amax > 0, amax / qmax, 1.0)
     y = x / scale
     bits = pltpu.bitcast(pltpu.prng_random_bits(y.shape), jnp.uint32)
-    q = jnp.clip(jnp.floor(y + _uniform_from_bits(bits)), -QMAX, QMAX)
+    q = jnp.clip(jnp.floor(y + _uniform_from_bits(bits)), -qmax, qmax)
     q_ref[:] = q.astype(jnp.int8)
     s_ref[:] = scale
 
@@ -144,7 +183,8 @@ def _interpret_default() -> bool:
     return not _compiled_backend()
 
 
-def _quantize_pallas(x_flat, block_size: int, stochastic: bool, seed):
+def _quantize_pallas(x_flat, block_size: int, stochastic: bool, seed,
+                     qmax: float = QMAX):
     rows = x_flat.size // block_size
     x2d = x_flat.reshape(rows, block_size)
     grid = (rows // _ROWS_PER_STEP,)
@@ -159,7 +199,7 @@ def _quantize_pallas(x_flat, block_size: int, stochastic: bool, seed):
     x_spec = pl.BlockSpec((_ROWS_PER_STEP, block_size), lambda i: (i, 0))
     if stochastic:
         q, s = pl.pallas_call(
-            _quant_kernel_stochastic,
+            functools.partial(_quant_kernel_stochastic, qmax=qmax),
             grid=grid,
             in_specs=[
                 x_spec,
@@ -171,7 +211,7 @@ def _quantize_pallas(x_flat, block_size: int, stochastic: bool, seed):
         )(x2d, jnp.asarray(seed, jnp.int32).reshape((1,)))
     else:
         q, s = pl.pallas_call(
-            _quant_kernel,
+            functools.partial(_quant_kernel, qmax=qmax),
             grid=grid,
             in_specs=[x_spec],
             out_specs=out_specs,
@@ -271,3 +311,73 @@ def quantization_error(x_flat, block_size: int = 256):
     quantity error feedback re-injects (``error_feedback.py``)."""
     q, s = quantize_blockwise(x_flat, block_size)
     return x_flat.astype(jnp.float32) - dequantize_blockwise(q, s, block_size)
+
+
+# ---------------------------------------------------------------------------
+# 4-bit group-quantized codec. Same scale/rounding machinery at the ±7 code
+# range (one fp32 scale per ``group_size`` elements — "group" is the sub-8-
+# bit literature's name for the int8 codec's "block"), with the codes
+# nibble-packed two per byte: the wire/HBM payload is ``n/2 + 4·n/G`` bytes
+# vs ``4n`` fp32 (≈7.5× at G=128). The rounding (incl. the stochastic
+# Pallas path — on-core PRNG) happens in the shared kernels; the pack is a
+# fused elementwise bit op.
+
+
+def quantize_blockwise_int4(
+    x_flat: jnp.ndarray,
+    group_size: int = 128,
+    stochastic: bool = False,
+    seed=None,
+    use_pallas: Optional[bool] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Flat fp buffer -> (packed uint8 codes (n/2,), fp32 per-group scales
+    (n/G,)). ``x_flat.size`` must be a multiple of the (even) group size;
+    ``seed`` as in :func:`quantize_blockwise`."""
+    if x_flat.ndim != 1:
+        raise ValueError(f"expected flat buffer, got shape {x_flat.shape}")
+    if group_size % 2:
+        raise ValueError(
+            f"int4 group_size must be even (nibble packing): {group_size}")
+    if x_flat.size % group_size != 0:
+        raise ValueError(
+            f"size {x_flat.size} not a multiple of group_size {group_size}")
+    if stochastic and seed is None:
+        raise ValueError("stochastic quantization needs a seed")
+    if use_pallas is None:
+        use_pallas = _pallas_ok(x_flat.size, group_size,
+                                allow_interpret=False)
+    elif use_pallas and not _pallas_ok(x_flat.size, group_size,
+                                       allow_interpret=True):
+        raise ValueError(
+            f"pallas int4 quantize needs group_size % 128 == 0 and "
+            f"rows % {_ROWS_PER_STEP} == 0; got n={x_flat.size}, "
+            f"group_size={group_size}")
+    if stochastic and use_pallas and _interpret_default():
+        use_pallas = False  # pltpu.prng_* is compiled-Mosaic-only
+    if use_pallas:
+        q, s = _quantize_pallas(x_flat, group_size, stochastic, seed,
+                                qmax=QMAX4)
+    else:
+        q, s = _quantize_jax(x_flat, group_size, stochastic, seed,
+                             qmax=QMAX4)
+    return pack_int4(q), s
+
+
+def dequantize_blockwise_int4(
+    packed: jnp.ndarray,
+    scales: jnp.ndarray,
+    group_size: int = 128,
+    use_pallas: Optional[bool] = None,
+) -> jnp.ndarray:
+    """(packed uint8 codes, fp32 group scales) -> fp32 flat buffer."""
+    q = unpack_int4(packed)
+    return dequantize_blockwise(q, scales, group_size, use_pallas=use_pallas)
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def quantization_error_int4(x_flat, group_size: int = 128):
+    """Round-trip error of the deterministic int4 codec (the EF residual
+    quantity for the ``int4_ef`` policy)."""
+    q, s = quantize_blockwise_int4(x_flat, group_size)
+    return x_flat.astype(jnp.float32) - dequantize_blockwise_int4(
+        q, s, group_size)

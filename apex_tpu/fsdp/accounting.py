@@ -117,7 +117,8 @@ def param_gather_wire_bytes(meta: Pytree, world: int,
                             shard_multiple: int = 1) -> float:
     """Modeled per-device wire bytes of ONE full parameter gather (the
     FSDP forward leg): per leaf, a tiled all-gather of the model-dtype
-    shard — ``k·isz·(W-1)`` — or, with the int8 codec, codes + fp32 block
+    shard — ``k·isz·(W-1)`` — or, with a quantized codec, packed codes
+    (1 B/element int8, 0.5 B/element nibble-packed int4) + fp32 block
     scales. Matches what ``comm.accounting.collective_report`` prices on
     the compiled program (``all_gather_wire_bytes`` convention: result
     bytes × (W-1)/W)."""
@@ -129,10 +130,10 @@ def param_gather_wire_bytes(meta: Pytree, world: int,
             continue
         k = _shard_elems(n, world, shard_multiple)
         if weight_gather is not None and weight_gather.compresses(n):
-            # int8 codes + fp32 scales, both gathered tiled
-            total += all_gather_wire_bytes(k * world, 1, world)
-            total += all_gather_wire_bytes(
-                (k // weight_gather.block_size) * world, 4, world)
+            # packed codes + fp32 scales, both gathered tiled; the codec's
+            # payload_bytes is the per-pass unit, here gathered ring-style
+            total += (weight_gather.payload_bytes(k * world)
+                      * (world - 1) / world)
         else:
             total += all_gather_wire_bytes(k * world, isz, world)
     return total

@@ -98,23 +98,17 @@ def _prod(shape) -> int:
 def _gather_impl(shard, axis_name, shape, dtype, wg):
     n = _prod(shape)
     if wg is not None and wg.compresses(n):
-        from apex_tpu.comm.quantize import (
-            dequantize_blockwise,
-            quantize_blockwise,
-        )
-
         # round to the model dtype FIRST (the wire carries what the model
         # would see anyway — same contract as ZeRO's e5m2_allgather), then
-        # int8 codes + fp32 block scales on the wire. The shard is
-        # block-aligned by construction (shard_multiple), so no scale
-        # block straddles ranks.
+        # packed codes + fp32 block scales on the wire via the config's
+        # policy-dispatched codec (int8 or the nibble-packed int4 tier).
+        # The shard is block-aligned by construction (shard_multiple), so
+        # no scale block — or packed nibble pair — straddles ranks.
         vals = shard.astype(dtype).astype(jnp.float32)
-        q, s = quantize_blockwise(vals, wg.block_size,
-                                  use_pallas=wg.use_pallas)
+        q, s = wg.quantize(vals)
         qf = lax.all_gather(q, axis_name, axis=0, tiled=True)
         sf = lax.all_gather(s, axis_name, axis=0, tiled=True)
-        full = dequantize_blockwise(qf, sf, wg.block_size,
-                                    use_pallas=wg.use_pallas)
+        full = wg.dequantize(qf, sf)
         return full[:n].reshape(shape).astype(dtype)
     # uncompressed: the ZeRO-1 gather path — model dtype on the wire
     # (transport_dtype=dtype is the saturating master→model-dtype cast),

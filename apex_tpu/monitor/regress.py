@@ -51,7 +51,11 @@ _HIGHER = ("tokens_per_s", "goodput", "_rps", "mfu", "occupancy",
            # disaggregated cluster (stage 15): admitted requests/s is the
            # router headline — already matched by "_rps", listed so the
            # gate's coverage is explicit next to its shed_rate dual
-           "admitted_rps")
+           "admitted_rps",
+           # sub-8-bit round (stage 17): concurrent contexts a fixed KV
+           # budget serves — the int4-KV headline (halving pool bytes
+           # must double it; a drop is a capacity regression)
+           "contexts_max")
 _LOWER = ("_ms", "violation", "latency", "bubble", "exposed_bytes",
           # disaggregated cluster (stage 15): a rising shed fraction is a
           # capacity regression (transfer_ms falls under the generic
@@ -69,7 +73,13 @@ _LOWER = ("_ms", "violation", "latency", "bubble", "exposed_bytes",
           # f32↔bf16 convert round-trips, host syncs reachable from a
           # step, or new lint violations are all regressions
           "convert_churn", "host_syncs", "lint_violations",
-          "fp32_dots", "donated_copied")
+          "fp32_dots", "donated_copied",
+          # sub-8-bit round (stage 17): bits per cached KV element and
+          # the int4 wire-byte column (scoped like wire_bytes_fsdp — the
+          # generic "wire_bytes" fragment would gate baseline columns);
+          # a rising fp8 cast-saturation fraction means the delayed
+          # scales stopped tracking the dynamic range
+          "kv_bits", "wire_bytes_int4", "fp8_overflow_rate")
 
 
 def classify_metric(key: str,

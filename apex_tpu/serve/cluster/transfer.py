@@ -84,12 +84,19 @@ def transfer_wire_bytes(cfg: KVCacheConfig, n_blocks: int,
                         wire_mode: str = "raw") -> int:
     """Modeled bytes-on-wire to hand off ``n_blocks`` pool blocks (all
     layers, K+V, scales included when the wire is quantized). Matches the
-    packed payload's ``nbytes`` exactly: int8 wire = 1 byte/element codes
-    + one fp32 scale per ``(layer, head, token)`` ``head_dim`` vector
-    (``1 + 4/head_dim`` bytes/element — the ``kv_cache._elem_bytes``
-    amortization), float wire = the pool dtype's itemsize."""
+    packed payload's ``nbytes`` exactly: a quantized POOL ships its own
+    representation (the ``kv_cache._elem_bytes`` amortization — int8
+    codes + fp32 per-vector scales at ``1 + 4/head_dim`` B/element, int4
+    nibble pairs + bf16 group scales at ``0.5 + 2/group`` — half the int8
+    wire again); ``wire_mode="int8"`` on a float pool is the codec-side
+    int8 layout; a raw float wire is the pool dtype's itemsize."""
+    from apex_tpu.serve.kv_cache import _elem_bytes
+
+    validate_wire_mode(wire_mode)
     elems = (cfg.num_layers * cfg.num_heads * n_blocks * cfg.block_size
              * cfg.head_dim)
+    if cfg.quantized:
+        return int(round(2 * elems * _elem_bytes(cfg)))
     if payload_is_quantized(cfg, wire_mode):
         vectors = elems // cfg.head_dim
         return 2 * (elems + 4 * vectors)

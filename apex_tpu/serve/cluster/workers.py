@@ -124,7 +124,9 @@ class PrefillWorker:
             num_layers=cfg.num_layers, num_heads=cfg.num_heads,
             head_dim=cfg.head_dim, num_blocks=self._blocks_per_prompt,
             block_size=bs, dtype=cfg.dtype,
-            quantized=serve_cfg.kv_quant == "int8")
+            quantized=serve_cfg.kv_quant != "none",
+            bits=4 if serve_cfg.kv_quant == "int4" else 8,
+            group_size=serve_cfg.kv_group)
         self.allocator = BlockAllocator(self._blocks_per_prompt)
         self.cache = init_kv_cache(self.kv_cfg)
         self._base_key = (base_key if base_key is not None

@@ -100,8 +100,23 @@ def paged_attention_reference(q, cache_layer, cfg: KVCacheConfig,
 # ops/attention.py causal-clamp trick) and skip compute.
 
 
+def _nibble_dequant(packed, s, group):
+    """In-kernel int4 pool dequant: (.., bs, D/2) packed uint8 codes +
+    (.., bs, D/group) bf16 group scales -> (.., bs, D) fp32. Bit-for-bit
+    the ``kv_cache._dequant_rows_int4`` math — ``unpack_int4`` is pure
+    jnp bit ops, so it traces straight into the Pallas kernel and the
+    codes/scales never round-trip through HBM as fp."""
+    from apex_tpu.comm.quantize import unpack_int4
+
+    codes = unpack_int4(packed)
+    d = codes.shape[-1]
+    g = codes.reshape(codes.shape[:-1] + (d // group, group))
+    out = g.astype(jnp.float32) * s.astype(jnp.float32)[..., None]
+    return out.reshape(codes.shape)
+
+
 def _paged_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, *refs,
-                  scale, block_size, nb, quantized):
+                  scale, block_size, nb, quantized, kv_bits=8, kv_group=0):
     if quantized:
         ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = refs
     else:
@@ -120,9 +135,12 @@ def _paged_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, *refs,
     @pl.when(j * block_size < ctx)
     def _compute():
         q = q_ref[0]                       # (H, D)
-        k = k_ref[:, 0]                    # (H, bs, D)
+        k = k_ref[:, 0]                    # (H, bs, D) | packed (H, bs, D/2)
         v = v_ref[:, 0]
-        if quantized:
+        if quantized and kv_bits == 4:
+            k = _nibble_dequant(k, ks_ref[:, 0], kv_group)
+            v = _nibble_dequant(v, vs_ref[:, 0], kv_group)
+        elif quantized:
             k = k.astype(jnp.float32) * ks_ref[:, 0][..., None]
             v = v.astype(jnp.float32) * vs_ref[:, 0][..., None]
         s = lax.dot_general(
@@ -168,19 +186,28 @@ def _paged_pallas(q, cache_layer, cfg: KVCacheConfig, block_tables,
         jl = jnp.maximum(ln[i] - 1, 0) // bs
         return (0, bt[i * nb + jnp.minimum(j, jl)], 0)
 
+    dk = d // 2 if cfg.quantized and cfg.bits == 4 else d
     in_specs = [
         pl.BlockSpec((1, h, d), lambda i, j, bt, ln: (i, 0, 0)),
-        pl.BlockSpec((h, 1, bs, d), blk_index),
-        pl.BlockSpec((h, 1, bs, d), blk_index),
+        pl.BlockSpec((h, 1, bs, dk), blk_index),
+        pl.BlockSpec((h, 1, bs, dk), blk_index),
     ]
     inputs = [q, cache_layer["k"], cache_layer["v"]]
-    if cfg.quantized:
+    if cfg.quantized and cfg.bits == 4:
+        # group scales carry a trailing head_dim/group dim — same 4-d
+        # rank as the packed code pools, same block walk
+        gdim = d // cfg.kv_group
+        in_specs += [pl.BlockSpec((h, 1, bs, gdim), blk_index),
+                     pl.BlockSpec((h, 1, bs, gdim), blk_index)]
+        inputs += [cache_layer["k_scale"], cache_layer["v_scale"]]
+    elif cfg.quantized:
         in_specs += [pl.BlockSpec((h, 1, bs), blk_index_s),
                      pl.BlockSpec((h, 1, bs), blk_index_s)]
         inputs += [cache_layer["k_scale"], cache_layer["v_scale"]]
     kernel = functools.partial(
         _paged_kernel, scale=scale, block_size=bs, nb=nb,
-        quantized=cfg.quantized)
+        quantized=cfg.quantized, kv_bits=cfg.bits if cfg.quantized else 8,
+        kv_group=cfg.kv_group if cfg.quantized else 0)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(n, nb),

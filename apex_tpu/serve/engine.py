@@ -185,7 +185,12 @@ class ServeConfig:
     megakernel: str = "auto"
     max_context: Optional[int] = None  # default: model cfg.max_seq
     eos_id: Optional[int] = None
-    kv_quant: str = "none"  # "none" | "int8" (comm.quantize codec)
+    # "none" | "int8" | "int4" (comm.quantize codec; int4 = nibble-packed
+    # codes + bf16 group scales, half the int8 pool bytes — doubles the
+    # contexts a fixed KV budget serves)
+    kv_quant: str = "none"
+    # int4 scale-group length along head_dim (None: one scale per vector)
+    kv_group: Optional[int] = None
     sampling: SamplingConfig = dataclasses.field(
         default_factory=SamplingConfig)
 
@@ -207,9 +212,11 @@ class ServeConfig:
                              f"got {self.megakernel!r}")
         if self.max_context is not None and self.max_context <= 0:
             raise ValueError("max_context must be positive when given")
-        if self.kv_quant not in ("none", "int8"):
-            raise ValueError(f"kv_quant must be 'none' or 'int8', "
+        if self.kv_quant not in ("none", "int8", "int4"):
+            raise ValueError(f"kv_quant must be 'none', 'int8' or 'int4', "
                              f"got {self.kv_quant!r}")
+        if self.kv_group is not None and self.kv_quant != "int4":
+            raise ValueError("kv_group only applies to kv_quant='int4'")
         self.sampling.validate()
 
 
@@ -325,7 +332,9 @@ class InferenceEngine:
         self.kv_cfg = KVCacheConfig(
             num_layers=cfg.num_layers, num_heads=cfg.num_heads // tp_size,
             head_dim=cfg.head_dim, num_blocks=num_blocks, block_size=bs,
-            dtype=cfg.dtype, quantized=scfg.kv_quant == "int8")
+            dtype=cfg.dtype, quantized=scfg.kv_quant != "none",
+            bits=4 if scfg.kv_quant == "int4" else 8,
+            group_size=scfg.kv_group)
         self.allocator = BlockAllocator(num_blocks,
                                         prefix_cache=scfg.prefix_cache)
         self.cache = init_kv_cache(self.kv_cfg)
@@ -1124,6 +1133,13 @@ class InferenceEngine:
         }
         out["megakernel"] = self._megakernel
         out["decode_kernel"] = self.decode_kernel
+        # the sub-8-bit KV headline fields (watcher-gated: kv_bits and
+        # the budget are lower-better, contexts_max higher-better)
+        out["kv_bits"] = (self.kv_cfg.bits if self.kv_cfg.quantized
+                          else 8 * jnp.dtype(self.kv_cfg.dtype).itemsize)
+        out["kv_cache_bytes"] = kv_cache_bytes(self.kv_cfg)
+        out["contexts_max"] = (self.kv_cfg.tokens_capacity
+                               // self.max_context)
         out["prefill"] = {
             "chunk": self.serve_cfg.prefill_chunk,
             "chunks_run": self._chunks_run,

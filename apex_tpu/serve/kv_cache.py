@@ -61,6 +61,18 @@ class KVCacheConfig:
     TP mesh program; the global count on a single device). ``num_blocks``
     is the POOL size shared by every slot — the unit of HBM budgeting:
     ``num_blocks * block_size`` total cacheable tokens.
+
+    Quantized modes: ``quantized=True, bits=8`` is the PR-5 layout (int8
+    codes + one fp32 scale per (token, head) head_dim vector);
+    ``bits=4`` drops to the sub-8-bit tier — codes nibble-packed two per
+    byte (pool leaf last dim = ``head_dim // 2``) and GROUP-quantized
+    along head_dim: one **bf16** scale per ``group_size`` consecutive
+    channel values (default group = the whole vector, so the pool is
+    exactly HALF the int8 pool's bytes at every head_dim — a bf16 scale's
+    8-bit mantissa costs ~0.4% relative scale error, an order below the
+    4-bit codes' half-step; smaller groups trade scale bytes back for
+    code resolution). Scale pools grow a trailing
+    ``head_dim // group_size`` dim.
     """
 
     num_layers: int
@@ -69,13 +81,24 @@ class KVCacheConfig:
     num_blocks: int
     block_size: int = 16
     dtype: Any = jnp.bfloat16
-    # int8 codes + fp32 scale per (token, head) head_dim vector, via the
-    # comm.quantize blockwise codec (codec block = head_dim)
+    # quantized codes + scales via the comm.quantize codec
     quantized: bool = False
+    bits: int = 8
+    # int4 scale-group length along head_dim; None -> head_dim (one scale
+    # per vector, the exact-2x-vs-int8 default)
+    group_size: Optional[int] = None
 
     @property
     def tokens_capacity(self) -> int:
         return self.num_blocks * self.block_size
+
+    @property
+    def kv_group(self) -> int:
+        """Effective scale-group length along head_dim (the full vector
+        unless int4 ``group_size`` narrows it)."""
+        if self.bits == 8 or self.group_size is None:
+            return self.head_dim
+        return self.group_size
 
     def blocks_for_tokens(self, n_tokens: int) -> int:
         """Blocks needed to hold ``n_tokens`` (ceil)."""
@@ -86,15 +109,42 @@ class KVCacheConfig:
                      "block_size"):
             if getattr(self, name) <= 0:
                 raise ValueError(f"{name} must be positive")
+        if self.bits not in (4, 8):
+            raise ValueError(f"bits must be 4 or 8, got {self.bits}")
+        if self.group_size is not None and self.bits == 8:
+            raise ValueError("group_size only applies to the int4 mode "
+                             "(int8 scales one full head_dim vector)")
+        if self.quantized and self.bits == 4:
+            g = self.kv_group
+            if self.head_dim % 2:
+                raise ValueError(
+                    f"int4 KV needs an even head_dim (nibble packing): "
+                    f"{self.head_dim}")
+            if g % 2 or g <= 0 or self.head_dim % g:
+                raise ValueError(
+                    f"int4 KV group_size must be even and divide head_dim "
+                    f"({self.head_dim}): got {g}")
 
 
 def init_kv_cache(cfg: KVCacheConfig) -> Dict[str, jnp.ndarray]:
     """Zeroed pool pytree: ``{"k", "v"}`` (+ ``{"k_scale", "v_scale"}`` when
     quantized). One allocation for the engine's whole lifetime; every
-    prefill/decode step donates it back in."""
+    prefill/decode step donates it back in. int4 pools store nibble-packed
+    uint8 codes (last dim halved) + per-group scales (trailing
+    ``head_dim // group`` dim)."""
     cfg.validate()
     shape = (cfg.num_layers, cfg.num_heads, cfg.num_blocks, cfg.block_size,
              cfg.head_dim)
+    if cfg.quantized and cfg.bits == 4:
+        code_shape = shape[:-1] + (cfg.head_dim // 2,)
+        cache = {"k": jnp.zeros(code_shape, jnp.uint8),
+                 "v": jnp.zeros(code_shape, jnp.uint8)}
+        sshape = shape[:-1] + (cfg.head_dim // cfg.kv_group,)
+        # bf16 scales: half the int8 layout's scale bytes (see the config
+        # docstring); scale 1 keeps dequantize(0-codes) well-defined
+        cache["k_scale"] = jnp.ones(sshape, jnp.bfloat16)
+        cache["v_scale"] = jnp.ones(sshape, jnp.bfloat16)
+        return cache
     dt = jnp.int8 if cfg.quantized else cfg.dtype
     cache = {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
     if cfg.quantized:
@@ -122,6 +172,38 @@ def _quant_rows(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
 def _dequant_rows(q: jnp.ndarray, s: jnp.ndarray,
                   dtype: Any) -> jnp.ndarray:
     return (q.astype(jnp.float32) * s[..., None]).astype(dtype)
+
+
+def _quant_rows_int4(x: jnp.ndarray, group: int
+                     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(..., head_dim) vectors -> (packed uint8 codes (..., head_dim/2),
+    bf16 scales (..., head_dim/group)) — the comm.quantize int4 math
+    (absmax/7 per group, round-to-nearest, ±7 clip, nibble pack) with the
+    scale ROUNDED TO bf16 FIRST and the codes computed against that
+    stored value, so the half-step bound holds against exactly what the
+    pool holds."""
+    from apex_tpu.comm.quantize import QMAX4, pack_int4
+
+    d = x.shape[-1]
+    g = x.astype(jnp.float32).reshape(x.shape[:-1] + (d // group, group))
+    amax = jnp.max(jnp.abs(g), axis=-1)
+    scale = jnp.where(amax > 0, amax / QMAX4, 1.0).astype(jnp.bfloat16)
+    q = jnp.clip(jnp.round(g / scale.astype(jnp.float32)[..., None]),
+                 -QMAX4, QMAX4).astype(jnp.int8)
+    return pack_int4(q.reshape(x.shape)), scale
+
+
+def _dequant_rows_int4(q: jnp.ndarray, s: jnp.ndarray, group: int,
+                       dtype: Any) -> jnp.ndarray:
+    """Inverse of :func:`_quant_rows_int4`: unpack nibbles, scale per
+    group, restore (..., head_dim)."""
+    from apex_tpu.comm.quantize import unpack_int4
+
+    codes = unpack_int4(q)                                # (..., D)
+    d = codes.shape[-1]
+    g = codes.reshape(codes.shape[:-1] + (d // group, group))
+    out = g.astype(jnp.float32) * s.astype(jnp.float32)[..., None]
+    return out.reshape(codes.shape).astype(dtype)
 
 
 # ---------------------------------------------------------------------------
@@ -173,8 +255,12 @@ def paged_write(
     valid = valid & (positions < mb * bs)
     out = dict(cache_layer)
     if cfg.quantized:
-        kq, ks = _quant_rows(k_new)
-        vq, vs = _quant_rows(v_new)
+        if cfg.bits == 4:
+            kq, ks = _quant_rows_int4(k_new, cfg.kv_group)
+            vq, vs = _quant_rows_int4(v_new, cfg.kv_group)
+        else:
+            kq, ks = _quant_rows(k_new)
+            vq, vs = _quant_rows(v_new)
         out["k"] = _pool_write(cache_layer["k"], kq, block_ids, offsets,
                                valid)
         out["v"] = _pool_write(cache_layer["v"], vq, block_ids, offsets,
@@ -206,19 +292,20 @@ def gather_kv(
     context lengths.
     """
     def grab(pool):
-        g = pool[:, block_tables]  # (H, n, mb, bs, D)
-        h, n, mb, bs, d = g.shape
-        return g.transpose(1, 0, 2, 3, 4).reshape(n, h, mb * bs, d)
+        g = pool[:, block_tables]  # (H, n, mb, bs[, D])
+        h, n, mb, bs = g.shape[:4]
+        tail = g.shape[4:]
+        perm = (1, 0, 2, 3) + tuple(range(4, g.ndim))
+        return g.transpose(perm).reshape((n, h, mb * bs) + tail)
 
     k, v = grab(cache_layer["k"]), grab(cache_layer["v"])
-    if cfg.quantized:
-        def grab_s(pool):
-            g = pool[:, block_tables]  # (H, n, mb, bs)
-            h, n, mb, bs = g.shape
-            return g.transpose(1, 0, 2, 3).reshape(n, h, mb * bs)
-
-        k = _dequant_rows(k, grab_s(cache_layer["k_scale"]), cfg.dtype)
-        v = _dequant_rows(v, grab_s(cache_layer["v_scale"]), cfg.dtype)
+    if cfg.quantized and cfg.bits == 4:
+        ks, vs = grab(cache_layer["k_scale"]), grab(cache_layer["v_scale"])
+        k = _dequant_rows_int4(k, ks, cfg.kv_group, cfg.dtype)
+        v = _dequant_rows_int4(v, vs, cfg.kv_group, cfg.dtype)
+    elif cfg.quantized:
+        k = _dequant_rows(k, grab(cache_layer["k_scale"]), cfg.dtype)
+        v = _dequant_rows(v, grab(cache_layer["v_scale"]), cfg.dtype)
     else:
         k, v = k.astype(cfg.dtype), v.astype(cfg.dtype)
     return k, v
@@ -430,6 +517,10 @@ class BlockAllocator:
 
 def _elem_bytes(cfg: KVCacheConfig) -> float:
     """Bytes per cached K or V element, scale overhead amortized in."""
+    if cfg.quantized and cfg.bits == 4:
+        # nibble-packed code + bf16 scale per group along head_dim:
+        # exactly half the int8 layout at group = head_dim
+        return 0.5 + 2.0 / cfg.kv_group
     if cfg.quantized:
         return 1.0 + 4.0 / cfg.head_dim  # int8 code + fp32 scale per vector
     return float(jnp.dtype(cfg.dtype).itemsize)

@@ -144,12 +144,28 @@ def _codec_roundtrip(x):
     return q * scale
 
 
+def _codec_roundtrip4(x, group):
+    """The int4 pool round-trip (``kv_cache._quant_rows_int4`` math):
+    per-group absmax/7 scale ROUNDED TO bf16 (the stored scale dtype),
+    ±7 round/clip, dequant — bit-for-bit what the unfused path reads
+    back from an int4 pool. (H, D) fp32 in and out."""
+    from apex_tpu.comm.quantize import QMAX4
+
+    h, d = x.shape
+    g = x.reshape(h, d // group, group)
+    amax = jnp.max(jnp.abs(g), axis=-1, keepdims=True)
+    scale = jnp.where(amax > 0, amax / QMAX4, 1.0)
+    scale = scale.astype(jnp.bfloat16).astype(jnp.float32)
+    q = jnp.clip(jnp.round(g / scale), -QMAX4, QMAX4)
+    return (q * scale).reshape(h, d)
+
+
 def _fused_layer_kernel(bt_ref, len_ref, x_ref, ln1w_ref, ln1b_ref,
                         qkvk_ref, qkvb_ref, outk_ref, outb_ref,
                         ln2w_ref, ln2b_ref, fc1k_ref, fc1b_ref,
                         fc2k_ref, fc2b_ref, k_ref, v_ref, *refs,
                         scale, block_size, nb, heads, head_dim,
-                        quantized, pool_dtype, eps):
+                        quantized, pool_dtype, eps, kv_bits=8, kv_group=0):
     if quantized:
         (ks_ref, vs_ref, xo_ref, ko_ref, vo_ref,
          q_scr, kc_scr, vc_scr, m_scr, l_scr, acc_scr) = refs
@@ -185,8 +201,11 @@ def _fused_layer_kernel(bt_ref, len_ref, x_ref, ln1w_ref, ln1b_ref,
         ko_ref[0] = kq
         vo_ref[0] = vq
         # what the pool hands back for this token: the codec round-trip
-        # (int8 cache) or the pool-dtype cast (fp cache)
-        if quantized:
+        # (int8/int4 cache) or the pool-dtype cast (fp cache)
+        if quantized and kv_bits == 4:
+            kc_scr[:] = _codec_roundtrip4(kq.astype(jnp.float32), kv_group)
+            vc_scr[:] = _codec_roundtrip4(vq.astype(jnp.float32), kv_group)
+        elif quantized:
             kc_scr[:] = _codec_roundtrip(kq.astype(jnp.float32))
             vc_scr[:] = _codec_roundtrip(vq.astype(jnp.float32))
         else:
@@ -195,10 +214,15 @@ def _fused_layer_kernel(bt_ref, len_ref, x_ref, ln1w_ref, ln1b_ref,
 
     @pl.when(j * block_size < ctx)
     def _attend_block():
+        from apex_tpu.serve.decode import _nibble_dequant
+
         q = q_scr[:]                      # (H, D)
-        k = k_ref[:, 0]                   # (H, bs, D)
+        k = k_ref[:, 0]                   # (H, bs, D) | packed (H, bs, D/2)
         v = v_ref[:, 0]
-        if quantized:
+        if quantized and kv_bits == 4:
+            k = _nibble_dequant(k, ks_ref[:, 0], kv_group)
+            v = _nibble_dequant(v, vs_ref[:, 0], kv_group)
+        elif quantized:
             k = k.astype(jnp.float32) * ks_ref[:, 0][..., None]
             v = v.astype(jnp.float32) * vs_ref[:, 0][..., None]
         s = lax.dot_general(
@@ -297,6 +321,7 @@ def fused_layer_decode(x, layer_params, cache_layer, cfg,
         jl = jnp.maximum(ln[i] - 1, 0) // bs
         return (0, bt[i * nb + jnp.minimum(j, jl)], 0)
 
+    dk = d // 2 if kv_cfg.quantized and kv_cfg.bits == 4 else d
     in_specs = [
         pl.BlockSpec((1, h), row),                 # x
         pl.BlockSpec((1, h), const2),              # ln1_w
@@ -311,8 +336,8 @@ def fused_layer_decode(x, layer_params, cache_layer, cfg,
         pl.BlockSpec((1, f), const2),              # fc1_bias
         pl.BlockSpec((f, h), const2),              # fc2_kernel
         pl.BlockSpec((1, h), const2),              # fc2_bias
-        pl.BlockSpec((heads, 1, bs, d), blk_index),   # k pool
-        pl.BlockSpec((heads, 1, bs, d), blk_index),   # v pool
+        pl.BlockSpec((heads, 1, bs, dk), blk_index),  # k pool
+        pl.BlockSpec((heads, 1, bs, dk), blk_index),  # v pool
     ]
     vec = lambda a: a.reshape(1, -1)
     inputs = [
@@ -325,14 +350,21 @@ def fused_layer_decode(x, layer_params, cache_layer, cfg,
         lp["fc2_kernel"], vec(lp["fc2_bias"]),
         cache_layer["k"], cache_layer["v"],
     ]
-    if kv_cfg.quantized:
+    if kv_cfg.quantized and kv_cfg.bits == 4:
+        gdim = d // kv_cfg.kv_group
+        in_specs += [pl.BlockSpec((heads, 1, bs, gdim), blk_index),
+                     pl.BlockSpec((heads, 1, bs, gdim), blk_index)]
+        inputs += [cache_layer["k_scale"], cache_layer["v_scale"]]
+    elif kv_cfg.quantized:
         in_specs += [pl.BlockSpec((heads, 1, bs), blk_index_s),
                      pl.BlockSpec((heads, 1, bs), blk_index_s)]
         inputs += [cache_layer["k_scale"], cache_layer["v_scale"]]
     kernel = functools.partial(
         _fused_layer_kernel, scale=att_scale, block_size=bs, nb=nb,
         heads=heads, head_dim=d, quantized=kv_cfg.quantized,
-        pool_dtype=kv_cfg.dtype, eps=1e-5)
+        pool_dtype=kv_cfg.dtype, eps=1e-5,
+        kv_bits=kv_cfg.bits if kv_cfg.quantized else 8,
+        kv_group=kv_cfg.kv_group if kv_cfg.quantized else 0)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(n, nb),

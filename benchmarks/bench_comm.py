@@ -62,6 +62,10 @@ POLICIES = {
     "none": None,
     "int8": CompressionConfig(policy="int8", **CANARY),
     "int8_ef": CompressionConfig(policy="int8_ef", **CANARY),
+    # the sub-8-bit tier: group size rides the same canary block_size
+    # (256 — even, so nibble packing holds), every other knob pinned
+    "int4": CompressionConfig(policy="int4", **CANARY),
+    "int4_ef": CompressionConfig(policy="int4_ef", **CANARY),
 }
 
 
@@ -129,15 +133,20 @@ def main():
         r = run(name)
         rows[name] = r
         print(json_record(**r), flush=True)
-    ratio8 = rows["none"]["wire_bytes_per_step"] / max(
-        rows["int8"]["wire_bytes_per_step"], 1)
-    ratio_ef = rows["none"]["wire_bytes_per_step"] / max(
-        rows["int8_ef"]["wire_bytes_per_step"], 1)
+    def ratio(name):
+        return round(rows["none"]["wire_bytes_per_step"]
+                     / max(rows[name]["wire_bytes_per_step"], 1), 2)
+
     print(json_record(
         name="comm_compression_wire_reduction",
-        metric="fp32_bytes / int8_bytes",
-        int8=round(ratio8, 2),
-        int8_ef=round(ratio_ef, 2),
+        metric="fp32_bytes / quantized_bytes",
+        int8=ratio("int8"),
+        int8_ef=ratio("int8_ef"),
+        int4=ratio("int4"),
+        int4_ef=ratio("int4_ef"),
+        # the stage-17 gated column: absolute int4 wire bytes per step
+        # (lower-better under monitor.regress's wire_bytes_int4 rule)
+        wire_bytes_int4=rows["int4_ef"]["wire_bytes_per_step"],
         backend=jax.default_backend(),
         canary_config=dict(CANARY, steps=STEPS,
                            grad_elements=rows["none"]["grad_elements"]),

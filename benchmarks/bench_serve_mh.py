@@ -78,7 +78,8 @@ def main(argv=None) -> int:
     ap.add_argument("--n-requests", type=int, default=64)
     ap.add_argument("--rate-rps", type=float, default=8.0)
     ap.add_argument("--seed", type=int, default=0)
-    ap.add_argument("--kv-quant", default="none", choices=["none", "int8"])
+    ap.add_argument("--kv-quant", default="none",
+                    choices=["none", "int8", "int4"])
     ap.add_argument("--wire-mode", default="raw", choices=["raw", "int8"])
     ap.add_argument("--spec-k", type=int, default=0)
     ap.add_argument("--prefill-chunk", type=int, default=32)
@@ -187,6 +188,39 @@ def main(argv=None) -> int:
             "deadlocked": False,  # run_workload returned — by contract
         }
 
+    # -- int8-vs-int4 KV concurrency A/B (modeled, config-exact) ----------
+    # at the int8 pool's byte budget, how many pool blocks — and so
+    # concurrent max-length contexts — does each tier hold? (halving
+    # bytes/token must double both; the stage-17 regress gate covers
+    # contexts_max higher-better / kv_bits lower-better)
+    import dataclasses as _dc
+
+    from apex_tpu.serve.kv_cache import kv_cache_bytes
+
+    kv_run = cluster.decode_workers[0].engine.kv_cfg
+    max_ctx = scfg.max_context or cfg.max_seq
+    kv_ab = {}
+    budget = None
+    for bits in (8, 4):
+        kvq = _dc.replace(kv_run, quantized=True, bits=bits,
+                          group_size=None)
+        per_pool = kv_cache_bytes(kvq)
+        if budget is None:
+            budget = per_pool  # the int8 tier's budget anchors the A/B
+        blocks_at_budget = budget * kvq.num_blocks // per_pool
+        kv_ab[f"int{bits}"] = {
+            "kv_cache_bytes": per_pool,
+            "blocks_at_int8_budget": blocks_at_budget,
+            "contexts_max": blocks_at_budget * kvq.block_size // max_ctx,
+            "transfer_wire_bytes": sum(
+                transfer_wire_bytes(kvq,
+                                    kvq.blocks_for_tokens(len(r.tokens)))
+                for _, r in workload),
+        }
+    kv_ab["hbm_cut_int8_over_int4"] = round(
+        kv_ab["int8"]["kv_cache_bytes"] / kv_ab["int4"]["kv_cache_bytes"],
+        4)
+
     slo_rep = stats.get("slo_report", {})
     drained = stats.get("completed", 0) + len(cluster.shed) == len(workload)
     rec = {
@@ -210,6 +244,13 @@ def main(argv=None) -> int:
         "transfer": stats.get("transfer"),
         "wire_model_agrees": wire_model_agrees,
         "transfer_wire_bytes_modeled": modeled,
+        # sub-8-bit KV headline fields (regress-gated; wire_bytes_int4 is
+        # the modeled int4 handoff total for THIS workload)
+        "kv_bits": (kv_run.bits if kv_run.quantized
+                    else 8 * jnp.dtype(kv_run.dtype).itemsize),
+        "contexts_max": kv_run.tokens_capacity // max_ctx,
+        "wire_bytes_int4": kv_ab["int4"]["transfer_wire_bytes"],
+        "kv_ab": kv_ab,
         "router": stats.get("router"),
         "colocated": {
             "goodput_rps": colo_slo.get("goodput_rps"),

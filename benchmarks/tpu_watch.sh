@@ -30,7 +30,7 @@
 #  15 serve multihost bench_serve_mh.py --hosts 2 -> SERVE_MH_TPU.json
 #  16 contract check  analyze_contracts.py  -> ANALYZE_TPU.json
 # After the first seven, later healthy probes only refresh stage 1+3
-# (hourly) so the banked number tracks the latest code; stages 8-16
+# (hourly) so the banked number tracks the latest code; stages 8-17
 # ride the same hourly cadence until banked (additive evidence that must
 # never hold the suite out of refresh mode).
 cd /root/repo || exit 1
@@ -49,6 +49,7 @@ last_fusedupd=-3600 # stage-13 (fused update tail) same contract
 last_fsdp=-3600     # stage-14 (fsdp vs zero1 A/B) same contract
 last_mh=-3600       # stage-15 (disaggregated serve cluster) same contract
 last_analyze=-3600  # stage-16 (compiled-program contract check) same
+last_sub8=-3600     # stage-17 (sub-8-bit: int4 KV + comm wire A/B) same
 
 note() { echo "$(date '+%F %T') $*" >> "$LOG"; }
 
@@ -464,6 +465,65 @@ $(cat /tmp/tpu_stage16_regress.out)"
   return 0
 }
 
+sub8_stage() {
+  # stage 17: the sub-8-bit tier — bench_serve_mh.py at --kv-quant int4
+  # (the int4 KV pools + the int8-vs-int4 concurrency A/B sub-record:
+  # kv_bits / contexts_max / wire_bytes_int4 / hbm_cut, with the measured
+  # transfer bytes asserted against the packed-payload model into ok=)
+  # plus the none-vs-int8-vs-int4 comm wire A/B from bench_comm.py
+  # appended to the same artifact. Same promote rules as stages 10-16:
+  # CPU rehearsals never promote, ok=false never promotes, REGRESSION-
+  # GATED via monitor.regress --tol 0.15 once banked (kv_bits /
+  # wire_bytes_int4 / fp8_overflow_rate lower-is-better, contexts_max
+  # higher — the new polarity rows); hourly even after banked.
+  note "STAGE17 START: bench_serve_mh.py --kv-quant int4 + bench_comm.py"
+  rm -f /tmp/sub8_try.json
+  timeout 1800 python benchmarks/bench_serve_mh.py --hosts 2 \
+    --kv-quant int4 --out /tmp/sub8_try.json \
+    > /tmp/tpu_stage17.out 2> /tmp/tpu_stage17.err
+  local rc=$?
+  note "STAGE17 EXIT=$rc"
+  [ -s /tmp/sub8_try.json ] || return 1
+  if grep -q CPU_FALLBACK /tmp/sub8_try.json; then
+    note "STAGE17 got CPU_FALLBACK, not promoting"
+    return 1
+  fi
+  if grep -Eq '"ok": false' /tmp/sub8_try.json; then
+    note "STAGE17 record has ok false, not promoting"
+    return 1
+  fi
+  # the none-vs-int8-vs-int4 comm wire A/B banks as its OWN artifact
+  # (one json_record per file — monitor.regress reads last-line records,
+  # so the two gates stay independent); its regression never blocks the
+  # serve record, and vice versa
+  if timeout 1200 python benchmarks/bench_comm.py \
+      > /tmp/tpu_stage17_comm.out 2>> /tmp/tpu_stage17.err; then
+    tail -n 1 /tmp/tpu_stage17_comm.out > /tmp/sub8_comm_try.json
+    if [ -s COMM_SUB8_TPU.json ] && ! python -m apex_tpu.monitor.regress \
+        COMM_SUB8_TPU.json /tmp/sub8_comm_try.json --tol 0.15 \
+        >> /tmp/tpu_stage17_regress.out 2>> /tmp/tpu_stage17.err; then
+      note "STAGE17 comm A/B regressed, keeping banked COMM_SUB8_TPU"
+    else
+      cp /tmp/sub8_comm_try.json COMM_SUB8_TPU.json
+      note "STAGE17 banked COMM_SUB8_TPU $(cat COMM_SUB8_TPU.json)"
+    fi
+  fi
+  if [ -s SERVE_KV4_TPU.json ]; then
+    if ! python -m apex_tpu.monitor.regress SERVE_KV4_TPU.json \
+        /tmp/sub8_try.json --tol 0.15 \
+        > /tmp/tpu_stage17_regress.out 2>> /tmp/tpu_stage17.err; then
+      note "STAGE17 REGRESSION vs banked, keeping banked record: \
+$(cat /tmp/tpu_stage17_regress.out)"
+      return 1
+    fi
+  fi
+  cp /tmp/sub8_try.json SERVE_KV4_TPU.json
+  note "STAGE17 PROMOTED $(cat SERVE_KV4_TPU.json)"
+  [ $rc -eq 0 ] || return 1
+  [ "$(cat "$STATE")" -eq 16 ] && echo 17 > "$STATE"
+  return 0
+}
+
 smoke_stage() {
   # Smoke to a temp file; promote ANY real-TPU artifact (a failing kernel
   # on the chip is exactly the evidence we must bank) but never a CPU
@@ -568,6 +628,13 @@ while true; do
           analyze_stage
           last_analyze=$now
         fi
+        # stage 17 (sub-8-bit tier: int4 KV serve + comm wire A/B):
+        # same contract — a lost HBM cut or wire-byte regression must
+        # surface within an hour
+        if [ $((now - last_sub8)) -ge 3600 ]; then
+          sub8_stage
+          last_sub8=$now
+        fi
         last_refresh=$now
       fi
     else
@@ -654,6 +721,12 @@ while true; do
           && [ $((now - last_analyze)) -ge 3600 ]; then
         analyze_stage
         last_analyze=$now
+      fi
+      # stage 17: sub-8-bit tier (int4 KV + comm wire A/B), same contract.
+      if [ "$(cat "$STATE")" -eq 16 ] \
+          && [ $((now - last_sub8)) -ge 3600 ]; then
+        sub8_stage
+        last_sub8=$now
       fi
       last_refresh=$now
     fi

@@ -198,6 +198,67 @@ def test_flagship_overlap_comm_decomposed_and_proven(megatron_sp):
     assert rep.hidden_fraction >= 0.5, rep
 
 
+@pytest.mark.skipif(not MESH_OK, reason="needs jax.shard_map (graft jax)")
+def test_int4_allreduce_wire_byte_reduction_and_model_agreement():
+    """The sub-8-bit acceptance gate: the 4-bit EF allreduce must move
+    >= 6.5x fewer bytes than fp32 on the same model (theory:
+    8 / (1 + 8/group) ~ 7.5x at group 128 — nibble-packed codes at
+    0.5 B/elem plus the fp32 scale sidecar), asserted from the compiled
+    HLO. The packed-payload wire MODEL must agree with the HLO pricer to
+    the byte on a single flat-buffer program."""
+    from apex_tpu.comm import (
+        CompressionConfig,
+        allreduce_wire_bytes,
+        collective_report,
+        compressed_allreduce,
+    )
+
+    cfg = CompressionConfig(policy="int4_ef", block_size=128,
+                            min_elements=128)
+    fp32 = _ddp_grad_program(None, allreduce_always_fp32=True)
+    # the DDP fixture threads no EF state; the wire is policy-identical
+    # (EF only adds local element-wise math), so the program ratio is
+    # measured on plain int4 and the EF program is priced below
+    int4 = _ddp_grad_program(
+        CompressionConfig(policy="int4", block_size=128, min_elements=128),
+        allreduce_always_fp32=False)
+    assert fp32.wire_bytes > 0 and int4.wire_bytes > 0, (fp32, int4)
+    # the compressed program really rides the two-pass decomposition
+    assert int4.counts["all-to-all"] >= 2, int4
+    assert int4.counts["all-gather"] >= 2, int4
+    ratio = fp32.wire_bytes / int4.wire_bytes
+    assert ratio >= 6.5, (ratio, fp32, int4)
+
+    # model<->HLO agreement on one flat buffer: the pricer reads u8
+    # packed codes + f32 scales off the program XLA emitted; the model
+    # predicts the same bytes from (n, config) alone
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    mesh = build_mesh(tp=1, pp=1, sp=1)  # dp=8
+    n = 8192
+
+    def body(flat, r):
+        out, r2 = compressed_allreduce(flat, "dp", cfg,
+                                       residual=r.reshape(-1))
+        return out, r2.reshape(r.shape)
+
+    from jax.sharding import PartitionSpec as P2
+    compiled = jax.jit(jax.shard_map(
+        body, mesh=mesh, in_specs=(P2(), P2("dp")),
+        out_specs=(P2(), P2("dp")), check_vma=False,
+    )).lower(jnp.zeros((n,)), jnp.zeros((8, n))).compile()
+    priced = collective_report(compiled).wire_bytes
+    modeled = allreduce_wire_bytes(n, 4, 8, cfg)
+    assert priced == pytest.approx(modeled), (priced, modeled)
+    # and the EF program itself clears the gate vs a same-shape fp32 psum
+    psum = jax.jit(jax.shard_map(
+        lambda flat: jax.lax.psum(flat, "dp"), mesh=mesh, in_specs=P2(),
+        out_specs=P2(), check_vma=False,
+    )).lower(jnp.zeros((n,))).compile()
+    fp32_flat = collective_report(psum).wire_bytes
+    assert fp32_flat / priced >= 6.5, (fp32_flat, priced)
+
+
 def test_int8_allreduce_wire_byte_reduction():
     """The comm subsystem's acceptance gate: int8 gradient allreduce must
     move >= 3.5x fewer bytes than the fp32 allreduce on the same model

@@ -106,17 +106,131 @@ def test_quantization_error_is_the_ef_residual():
 
 
 # ---------------------------------------------------------------------------
+# the 4-bit codec
+
+
+def test_int4_pack_unpack_exact_inverse():
+    from apex_tpu.comm import pack_int4, unpack_int4
+
+    q = jax.random.randint(jax.random.PRNGKey(0), (256,), -8, 8
+                           ).astype(jnp.int8)
+    packed = pack_int4(q)
+    assert packed.dtype == jnp.uint8 and packed.shape == (128,)
+    np.testing.assert_array_equal(np.asarray(unpack_int4(packed)),
+                                  np.asarray(q))
+    with pytest.raises(ValueError):
+        pack_int4(jnp.zeros((3,), jnp.int8))  # odd axis
+
+
+def test_int4_roundtrip_half_step_bound():
+    """|x - dq(q(x))| <= scale/2 per element, scale = group absmax/7 —
+    the 4-bit analogue of the int8 bound (16x coarser steps: why EF
+    matters at this tier)."""
+    from apex_tpu.comm import (
+        dequantize_blockwise_int4,
+        quantize_blockwise_int4,
+    )
+
+    x = jax.random.normal(jax.random.PRNGKey(3), (4096,))
+    q, s = quantize_blockwise_int4(x, 128)
+    assert q.dtype == jnp.uint8 and q.shape == (2048,)  # two codes/byte
+    assert s.dtype == jnp.float32 and s.shape == (32,)
+    y = dequantize_blockwise_int4(q, s, 128)
+    err = np.abs(np.asarray(y) - np.asarray(x)).reshape(32, 128)
+    step = np.abs(np.asarray(x)).reshape(32, 128).max(1) / 7.0
+    assert (err <= step[:, None] * 0.5 + 1e-6).all()
+    # all-zero groups: zero codes, finite scales
+    q0, s0 = quantize_blockwise_int4(jnp.zeros((256,)), 128)
+    assert np.all(np.asarray(q0) == 0)
+    np.testing.assert_array_equal(
+        np.asarray(dequantize_blockwise_int4(q0, s0, 128)), 0.0)
+
+
+def test_int4_stochastic_unbiased_and_seeded():
+    from apex_tpu.comm import (
+        dequantize_blockwise_int4,
+        quantize_blockwise_int4,
+    )
+
+    x = jnp.full((256,), 0.3)
+    outs = []
+    for seed in range(64):
+        q, s = quantize_blockwise_int4(x, 128, stochastic=True, seed=seed)
+        outs.append(np.asarray(dequantize_blockwise_int4(q, s, 128)))
+    m = float(np.mean(outs))
+    assert abs(m - 0.3) < 0.01, m  # unbiased across seeds
+    q1, _ = quantize_blockwise_int4(x, 128, stochastic=True, seed=11)
+    q2, _ = quantize_blockwise_int4(x, 128, stochastic=True, seed=11)
+    np.testing.assert_array_equal(np.asarray(q1), np.asarray(q2))
+
+
+def test_int4_pallas_interpret_matches_reference():
+    """The shared Pallas rounding kernels at the ±7 code range: same codec
+    as the XLA path up to 1-ulp scale reassociation."""
+    from apex_tpu.comm import quantize_blockwise_int4, unpack_int4
+
+    x = jax.random.normal(jax.random.PRNGKey(4), (32 * 128,))
+    q_ref, s_ref = quantize_blockwise_int4(x, 128)
+    q_pl, s_pl = quantize_blockwise_int4(x, 128, use_pallas=True)
+    np.testing.assert_allclose(np.asarray(s_ref), np.asarray(s_pl),
+                               rtol=1e-6)
+    assert np.abs(np.asarray(unpack_int4(q_ref), np.int32)
+                  - np.asarray(unpack_int4(q_pl), np.int32)).max() <= 1
+
+
+def test_int4_validates():
+    from apex_tpu.comm import quantize_blockwise_int4
+
+    with pytest.raises(ValueError):
+        quantize_blockwise_int4(jnp.zeros((100,)), 128)  # not a multiple
+    with pytest.raises(ValueError):
+        quantize_blockwise_int4(jnp.zeros((4, 64)), 64)  # not flat
+    with pytest.raises(ValueError):
+        quantize_blockwise_int4(jnp.zeros((254,)), 127)  # odd group
+    with pytest.raises(ValueError):
+        quantize_blockwise_int4(jnp.zeros((256,)), 128,
+                                stochastic=True)  # no seed
+
+
+def test_int4_wire_models():
+    """The packed-payload wire math: codes at 0.5 B/elem + fp32 scales,
+    and the modeled fp32/int4 allreduce ratio clears the acceptance gate
+    (>=6.5x; 7.53x at group 128)."""
+    from apex_tpu.comm import allreduce_wire_bytes, psum_scatter_wire_bytes
+
+    cfg = CompressionConfig(policy="int4_ef", block_size=128,
+                            min_elements=128)
+    n, w = 4096, 8
+    fp32 = allreduce_wire_bytes(n, 4, w, None)
+    i4 = allreduce_wire_bytes(n, 4, w, cfg)
+    # two passes of (n/2 codes + 4n/128 scales), ring-scaled
+    assert i4 == pytest.approx(2.0 * (n / 2 + 4.0 * n / 128) * (w - 1) / w)
+    assert fp32 / i4 >= 6.5, fp32 / i4
+    rs4 = psum_scatter_wire_bytes(n, 4, w, cfg, shard_multiple=128)
+    assert rs4 == pytest.approx((n / 2 + 4.0 * n / 128) * (w - 1) / w)
+    # sub-min_elements buffers fall back to the fp32 path
+    assert allreduce_wire_bytes(64, 4, w, cfg) == \
+        allreduce_wire_bytes(64, 4, w, None)
+
+
+# ---------------------------------------------------------------------------
 # config
 
 def test_compression_config_validates():
     with pytest.raises(ValueError):
-        CompressionConfig(policy="int4")
+        CompressionConfig(policy="int2")  # not a codec tier
     with pytest.raises(ValueError):
         CompressionConfig(block_size=0)
+    with pytest.raises(ValueError):
+        CompressionConfig(policy="int4", block_size=129)  # odd group
     cfg = CompressionConfig(policy="int8_ef", min_elements=100)
-    assert cfg.enabled and cfg.error_feedback
+    assert cfg.enabled and cfg.error_feedback and cfg.bits == 8
     assert cfg.compresses(100) and not cfg.compresses(99)
     assert not CompressionConfig(policy="none").enabled
+    cfg4 = CompressionConfig(policy="int4_ef", block_size=128)
+    assert cfg4.enabled and cfg4.error_feedback and cfg4.bits == 4
+    # packed codes at 0.5 B/elem + fp32 scale per group
+    assert cfg4.payload_bytes(4096) == 4096 * 0.5 + 4 * 4096 / 128
 
 
 # ---------------------------------------------------------------------------

@@ -85,16 +85,19 @@ def _prefilled(kv, prompts):
 # fused decode step vs the per-op oracle
 
 
-@pytest.mark.parametrize("quantized", [False, True])
-def test_fused_decode_matches_unfused(quantized):
+@pytest.mark.parametrize("kv_mode", ["none", "int8", "int4"])
+def test_fused_decode_matches_unfused(kv_mode):
     """Multi-step decode: the fused per-layer block produces the same
     logits AND the same written pools as gpt_decode_step — fp32 within fp
-    tolerance, int8 codes bitwise (both paths quantize identical values
-    through the same codec). Includes an inactive slot (ctx 0): junk but
-    finite logits, no pool writes."""
+    tolerance, int8/int4 codes bitwise (both paths quantize identical
+    values through the same codec; the int4 path dequantizes nibble-packed
+    codes + bf16 group scales IN kernel). Includes an inactive slot
+    (ctx 0): junk but finite logits, no pool writes."""
+    quantized = kv_mode != "none"
     kv = KVCacheConfig(num_layers=2, num_heads=4, head_dim=8,
                        num_blocks=24, block_size=4, dtype=jnp.float32,
-                       quantized=quantized)
+                       quantized=quantized,
+                       bits=4 if kv_mode == "int4" else 8)
     cache, bt = _prefilled(kv, [[3, 14, 15, 92, 6], [7, 8, 9],
                                 [1]])  # slot 2 then marked inactive
     cache_f = jax.tree.map(lambda a: a, cache)
@@ -159,13 +162,15 @@ def test_engine_streams_equal_megakernel_on_off(sampling):
     assert outs["on"] == outs["off"]
 
 
-def test_engine_streams_equal_with_speculation_and_int8():
+@pytest.mark.parametrize("kv_quant", ["int8", "int4"])
+def test_engine_streams_equal_with_speculation_and_quant_kv(kv_quant):
     """The fused decode program composes with the speculative verify
-    program (which stays on the unfused q=k+1 path) and the int8 cache:
-    streams stay equal to the fully-unfused engine."""
+    program (which stays on the unfused q=k+1 path) and the quantized
+    caches: streams stay equal to the fully-unfused engine for int8 AND
+    the nibble-packed int4 pools."""
     outs = {}
     for mode in ("on", "off"):
-        eng = _engine(mode, spec_k=2, kv_quant="int8")
+        eng = _engine(mode, spec_k=2, kv_quant=kv_quant)
         outs[mode] = eng.run([Request(r.uid, r.tokens, r.max_new_tokens)
                               for r in REQS])
     assert outs["on"] == outs["off"]

@@ -123,6 +123,23 @@ def test_kv_byte_models():
                         num_blocks=10, block_size=4, quantized=True)
     # int8: 1 byte/elem + 4-byte scale per 8-elem vector = 1.5 bytes
     assert kv_cache_bytes(kv8) == int(2 * 2 * 4 * 10 * 4 * 8 * 1.5)
+    # int4: packed nibble (0.5) + bf16 scale per 8-elem group = 0.75 —
+    # exactly HALF the int8 pool (the >=1.9x acceptance gate, met at 2.0)
+    kv4 = KVCacheConfig(num_layers=2, num_heads=4, head_dim=8,
+                        num_blocks=10, block_size=4, quantized=True, bits=4)
+    assert kv_cache_bytes(kv4) == int(2 * 2 * 4 * 10 * 4 * 8 * 0.75)
+    assert kv_cache_bytes(kv8) / kv_cache_bytes(kv4) == 2.0
+    # narrower groups trade bytes back for resolution
+    kv4g = KVCacheConfig(num_layers=2, num_heads=4, head_dim=8,
+                         num_blocks=10, block_size=4, quantized=True,
+                         bits=4, group_size=4)
+    assert kv_cache_bytes(kv4g) == int(2 * 2 * 4 * 10 * 4 * 8 * 1.0)
+    with pytest.raises(ValueError):  # group must divide head_dim
+        KVCacheConfig(num_layers=1, num_heads=1, head_dim=8, num_blocks=1,
+                      quantized=True, bits=4, group_size=6).validate()
+    with pytest.raises(ValueError):  # group_size is int4-only
+        KVCacheConfig(num_layers=1, num_heads=1, head_dim=8, num_blocks=1,
+                      quantized=True, group_size=4).validate()
 
 
 # ---------------------------------------------------------------------------
@@ -196,13 +213,44 @@ def test_paged_attention_int8_kv_within_codec_tolerance():
     assert 0 < err < 0.05, err
 
 
-@pytest.mark.parametrize("quantized", [False, True])
-def test_paged_attention_pallas_interpret_parity(quantized):
+def test_paged_attention_int4_kv_within_codec_tolerance():
+    """int4 KV (nibble-packed codes + bf16 group scales): attention stays
+    within the coarser ±7-code half-step bound — lossy but bounded, and
+    the halved pool is the point."""
+    kv = KVCacheConfig(num_layers=1, num_heads=4, head_dim=8, num_blocks=12,
+                       block_size=4, dtype=jnp.float32)
+    kv4 = KVCacheConfig(num_layers=1, num_heads=4, head_dim=8,
+                        num_blocks=12, block_size=4, dtype=jnp.float32,
+                        quantized=True, bits=4)
+    bt = jnp.asarray([[0, 1, 2], [5, 6, 7], [9, 10, 11]], jnp.int32)
+    lens = jnp.asarray([12, 6, 3], jnp.int32)
+    rng = jax.random.PRNGKey(4)
+    cl, _, _ = _filled_cache(kv, 3, bt, lens, rng)
+    cl4, _, _ = _filled_cache(kv4, 3, bt, lens, rng)
+    q = jax.random.normal(jax.random.PRNGKey(5), (3, 4, 8))
+    exact = paged_attention_reference(q, cl, kv, bt, lens)
+    quant = paged_attention_reference(q, cl4, kv4, bt, lens)
+    err = np.abs(np.asarray(quant) - np.asarray(exact)).max()
+    assert 0 < err < 0.35, err
+    # a narrower scale group recovers resolution
+    kv4g = KVCacheConfig(num_layers=1, num_heads=4, head_dim=8,
+                         num_blocks=12, block_size=4, dtype=jnp.float32,
+                         quantized=True, bits=4, group_size=4)
+    cl4g, _, _ = _filled_cache(kv4g, 3, bt, lens, rng)
+    fine = paged_attention_reference(q, cl4g, kv4g, bt, lens)
+    err_g = np.abs(np.asarray(fine) - np.asarray(exact)).max()
+    assert err_g < err, (err_g, err)
+
+
+@pytest.mark.parametrize("kv_mode", ["none", "int8", "int4"])
+def test_paged_attention_pallas_interpret_parity(kv_mode):
     """The Pallas gather-attend kernel (scalar-prefetched block tables,
-    online softmax) matches the gather+reference path in interpret mode."""
+    online softmax, in-kernel int8/int4 dequant) matches the
+    gather+reference path in interpret mode."""
     kv = KVCacheConfig(num_layers=1, num_heads=4, head_dim=8, num_blocks=12,
                        block_size=4, dtype=jnp.float32,
-                       quantized=quantized)
+                       quantized=kv_mode != "none",
+                       bits=4 if kv_mode == "int4" else 8)
     bt = jnp.asarray([[0, 1, 2], [5, 6, 7], [9, 10, 11]], jnp.int32)
     lens = jnp.asarray([9, 5, 0], jnp.int32)  # incl. an empty slot
     cl, _, _ = _filled_cache(kv, 3, bt, lens, jax.random.PRNGKey(6))
@@ -364,6 +412,87 @@ def test_engine_int8_kv_runs_and_matches_shapes():
     base = _engine().run(REQS)
     assert {k: len(v) for k, v in out.items()} == \
         {k: len(v) for k, v in base.items()}
+
+
+def test_engine_int4_kv_streams_pinned_and_accounted():
+    """The int4 engine's streams are deterministic and admission-order-
+    invariant BITWISE (the pinned-stream contract — greedy and sampled),
+    and the stats record carries the sub-8-bit accounting: kv_bits=4 and
+    a pool budget exactly half the int8 engine's."""
+    for samp in (SamplingConfig(),
+                 SamplingConfig(temperature=0.8, top_k=20)):
+        batched = _engine(kv_quant="int4", sampling=samp).run(REQS)
+        singles = {}
+        for r in REQS:
+            singles.update(
+                _engine(kv_quant="int4", sampling=samp).run([r]))
+        assert batched == singles
+    eng4 = _engine(kv_quant="int4")
+    eng8 = _engine(kv_quant="int8")
+    eng4.run(REQS)
+    st = eng4.stats()
+    assert st["kv_bits"] == 4
+    assert eng8.stats()["kv_bits"] == 8
+    assert eng8.kv_budget_bytes() / eng4.kv_budget_bytes() == 2.0
+    assert st["contexts_max"] == eng4.kv_cfg.tokens_capacity \
+        // eng4.max_context
+
+
+def test_int4_decode_matches_paged_recompute_and_bounds_flash():
+    """The int4 KV bookkeeping oracle, two-sided. (a) chunk-by-chunk
+    decode against the nibble-packed pools == one-shot
+    ``gpt_paged_forward`` recompute of the whole sequence into a fresh
+    int4 pool at fp32 round-off (per-row math independent of q — the
+    PR-7 invariant — and both sides read/write the same quantized
+    representation; the q=1 and q=n programs may reassociate). (b)
+    TOLERANCE: both stay within the int4 codec's error of the bf16-free
+    ``gpt_prefill`` cold path, which reads the RAW in-flight K/V and so
+    bounds the quantization loss."""
+    from apex_tpu.serve.decode import gpt_paged_forward
+
+    kv = KVCacheConfig(num_layers=CFG.num_layers, num_heads=CFG.num_heads,
+                       head_dim=CFG.head_dim, num_blocks=8, block_size=4,
+                       dtype=jnp.float32, quantized=True, bits=4)
+    prompt = [3, 14, 15, 92, 6]
+    feed = [10, 20, 30]
+    seq = prompt + feed
+    p = len(prompt)
+    row = jnp.arange(8, dtype=jnp.int32)
+
+    def paged_all(tokens):
+        """Whole sequence through ONE paged call on a fresh int4 pool."""
+        n = len(tokens)
+        cache = {k: v for k, v in init_kv_cache(kv).items()}
+        _, lg = gpt_paged_forward(
+            PARAMS, jnp.asarray(tokens)[None, :], jnp.zeros((1,), jnp.int32),
+            jnp.asarray([n], jnp.int32), jnp.ones((1,), bool), cache,
+            row[None], CFG, kv)
+        return np.asarray(lg[0])
+
+    # incremental: prompt in one paged call, then q=1 decode steps
+    cache = init_kv_cache(kv)
+    cache, lg = gpt_paged_forward(
+        PARAMS, jnp.asarray(prompt)[None, :], jnp.zeros((1,), jnp.int32),
+        jnp.asarray([p], jnp.int32), jnp.ones((1,), bool), cache,
+        row[None], CFG, kv)
+    inc = [np.asarray(lg[0, -1])]
+    for i, t in enumerate(feed):
+        cache, lg1 = gpt_decode_step(
+            PARAMS, jnp.asarray([t]), jnp.asarray([p + i]),
+            jnp.asarray([True]), cache, row[None], CFG, kv)
+        inc.append(np.asarray(lg1[0]))
+    full = paged_all(seq)
+    for i in range(len(feed) + 1):
+        np.testing.assert_allclose(full[p - 1 + i], inc[i], atol=1e-6)
+    # (b) the codec loss vs the raw-K/V flash cold path is bounded
+    kv_raw = KVCacheConfig(num_layers=CFG.num_layers,
+                           num_heads=CFG.num_heads, head_dim=CFG.head_dim,
+                           num_blocks=8, block_size=4, dtype=jnp.float32)
+    toks = jnp.zeros((16,), jnp.int32).at[:len(seq)].set(jnp.asarray(seq))
+    _, cold = gpt_prefill(PARAMS, toks, jnp.int32(len(seq)),
+                          init_kv_cache(kv_raw), row, CFG, kv_raw)
+    err = np.abs(np.asarray(cold) - inc[-1]).max()
+    assert 0 < err < 0.05, err
 
 
 def test_engine_admission_waits_for_blocks():

@@ -116,11 +116,13 @@ def _slot_blocks(engine_like, prompt_len, bs=8):
     return [int(b) for b in row[:nb]]
 
 
-@pytest.mark.parametrize("kv_quant", ["none", "int8"])
+@pytest.mark.parametrize("kv_quant", ["none", "int8", "int4"])
 def test_transfer_lands_bitwise_vs_local_prefill(kv_quant):
     """The satellite gate: blocks shipped over the simulated transport
     land in the decode pool BITWISE identical to what local prefill
-    writes (int8 pools: codes AND scales; fp32 pools: raw wire)."""
+    writes (int8/int4 pools: codes AND scales ship verbatim — the
+    nibble-packed int4 representation never dequantizes on the wire;
+    fp32 pools: raw wire)."""
     req = Request("x", list(range(1, 20)), max_new_tokens=4)
     _, h = _prefill_one(req, kv_quant=kv_quant, wire_mode="raw")
     d = _install_on_decode(h, kv_quant=kv_quant, wire_mode="raw")
@@ -196,6 +198,17 @@ def test_wire_bytes_model_agrees_and_int8_reduces():
                         num_blocks=8, block_size=8, quantized=True)
     assert (transfer_wire_bytes(kvq, 3, "raw")
             == transfer_wire_bytes(kvq, 3, "int8"))
+    # int4 POOL: packed codes + bf16 group scales ship verbatim — the
+    # model equals the measured payload and halves the int8 wire
+    kv4 = KVCacheConfig(num_layers=2, num_heads=4, head_dim=64,
+                        num_blocks=8, block_size=8, quantized=True, bits=4)
+    payload = jax.jit(
+        lambda c, i: pack_blocks(c, kv4, i, wire_mode="raw")
+    )(init_kv_cache(kv4), ids)
+    host = {k: np.asarray(v) for k, v in payload.items()}
+    assert payload_nbytes(host, 3) == transfer_wire_bytes(kv4, 3, "raw")
+    assert (transfer_wire_bytes(kvq, 3, "raw")
+            / transfer_wire_bytes(kv4, 3, "raw")) == pytest.approx(2.0)
 
 
 def test_sim_transport_latency_and_totals():
@@ -224,12 +237,14 @@ def _single_engine_streams(scfg, reqs):
     ("none", "raw", False),
     ("int8", "raw", True),
     ("int8", "int8", False),
+    ("int4", "raw", True),
+    ("int4", "raw", False),
 ])
 def test_cluster_streams_bitwise_equal_single_engine(kv_quant, wire_mode,
                                                      greedy):
     """The parity gate: multi-host cluster streams == single-engine
-    streams, bitwise, greedy AND sampled (int8 pools ship codes+scales
-    verbatim, so even the quantized stack is exact)."""
+    streams, bitwise, greedy AND sampled (int8/int4 pools ship
+    codes+scales verbatim, so even the quantized stacks are exact)."""
     sampling = (SamplingConfig() if greedy
                 else SamplingConfig(temperature=0.7, top_k=13))
     scfg = _serve_cfg(kv_quant=kv_quant, sampling=sampling)

@@ -1,0 +1,260 @@
+"""Sub-8-bit tier tests (stock-jax-safe): the fp8 amp tier — e4m3-fwd /
+e5m2-grad ``fp8_dot`` with per-tensor delayed scaling, mid-run state_dict
+round-trip, Metrics flattening — plus the ``analyze.dtype_leak`` fp8
+policy-lattice fixture rows and the ``monitor.regress`` polarity coverage
+for the new watcher-gated record fields (``kv_bits``/``wire_bytes_int4``/
+``fp8_overflow_rate`` lower-better, ``contexts_max`` higher-better). The
+mesh-level int4 collective tests live in ``test_comm_mesh.py`` /
+``test_collective_counts.py``; the int4 KV tests in ``test_serve.py`` /
+``test_megakernel.py`` / ``test_serve_cluster.py``."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu import amp
+from apex_tpu.amp import fp8
+
+REC = fp8.Fp8Recipe(history_len=4)
+
+
+def _mlp_fixture():
+    k = jax.random.PRNGKey(0)
+    params = {
+        "w1": jax.random.normal(k, (16, 32)) * 0.1,
+        "w2": jax.random.normal(jax.random.fold_in(k, 1), (32, 8)) * 0.1,
+    }
+    x = jax.random.normal(jax.random.fold_in(k, 2), (4, 16))
+    return params, x
+
+
+def _loss_fn(params, st, x):
+    h, st1 = fp8.fp8_dot(x, params["w1"], st["l1"], REC)
+    h = jax.nn.relu(h)
+    y, st2 = fp8.fp8_dot(h, params["w2"], st["l2"], REC)
+    return jnp.mean(y ** 2), {"l1": st1, "l2": st2}
+
+
+def _make_step(x):
+    @jax.jit
+    def step(params, st):
+        (loss, fwd), grads = jax.value_and_grad(
+            lambda p, s: _loss_fn(p, s, x), argnums=(0, 1),
+            has_aux=True)(params, st)
+        st = fp8.merge_state_grads(fwd, grads[1])
+        params = jax.tree_util.tree_map(lambda p, g: p - 0.1 * g,
+                                        params, grads[0])
+        return params, st, loss
+
+    return step
+
+
+# ---------------------------------------------------------------------------
+# fp8 dot + delayed scaling
+
+
+def test_fp8_dot_matches_fp32_within_cast_tolerance():
+    """With calibrated scales, e4m3 x e4m3 (f32 accumulate) tracks the
+    fp32 dot within the e4m3 mantissa's relative error."""
+    params, x = _mlp_fixture()
+    st = fp8.init_fp8_state(["l1", "l2"], REC)
+    step = _make_step(x)
+    for _ in range(4):  # calibrate the delayed scales
+        params, st, _ = step(params, st)
+    y8, _ = fp8.fp8_dot(x, params["w1"], st["l1"], REC)
+    yf = x @ params["w1"]
+    rel = float(jnp.abs(y8 - yf).max() / jnp.abs(yf).max())
+    assert 0 < rel < 0.06, rel  # lossy but bounded (e4m3: 3 mantissa bits)
+
+
+def test_fp8_training_converges_and_scales_adapt():
+    """The delayed scales move off their init to track the data's dynamic
+    range (fwd e4m3 AND — via the state-cotangent channel — the e5m2 grad
+    side), and the loss goes down."""
+    params, x = _mlp_fixture()
+    st = fp8.init_fp8_state(["l1", "l2"], REC)
+    step = _make_step(x)
+    losses = []
+    for _ in range(6):
+        params, st, l = step(params, st)
+        losses.append(float(l))
+    assert losses[-1] < losses[0], losses
+    for site in ("l1", "l2"):
+        assert float(st[site].x.scale) != 1.0
+        assert float(st[site].w.scale) != 1.0
+        # the gradient half arrives through jax.grad's state slot
+        assert float(st[site].g.scale) != 1.0
+        assert float(jnp.max(st[site].g.amax_history)) > 0
+
+
+def test_fp8_delayed_scale_reacts_within_history_window():
+    """Feeding a 100x larger tensor drops the scale by ~100x within
+    history_len steps — and the overflow_rate telemetry spikes on the
+    step where the old scale saturates the cast."""
+    st = fp8.init_tensor_state(REC)
+    x = jnp.full((64,), 1.0)
+    for _ in range(4):
+        amax, over = fp8._observe(x, st.scale, fp8.E4M3)
+        st = fp8.update_tensor_state(st, amax, over, fp8.E4M3, REC)
+    s_small = float(st.scale)
+    big = x * 100.0
+    amax, over = fp8._observe(big, st.scale, fp8.E4M3)
+    assert float(over) > 0.99  # the stale scale saturates every element
+    st = fp8.update_tensor_state(st, amax, over, fp8.E4M3, REC)
+    assert float(st.scale) == pytest.approx(s_small / 100.0, rel=1e-5)
+    assert float(st.overflow_rate) > 0.99
+
+
+def test_fp8_state_dict_roundtrip_midrun_exact():
+    """The satellite gate: the delayed-scaling state survives a
+    state_dict round-trip MID-RUN with the continued run bit-identical
+    (the loss-scaler/EF-residual checkpoint contract)."""
+    params, x = _mlp_fixture()
+    st = fp8.init_fp8_state(["l1", "l2"], REC)
+    step = _make_step(x)
+    for _ in range(3):
+        params, st, _ = step(params, st)
+    d = fp8.state_dict(st)
+    st2 = fp8.load_state_dict(
+        jax.tree_util.tree_map(jnp.zeros_like, st), d)
+    pa, sa, la = step(params, st)
+    pb, sb, lb = step(params, st2)
+    assert float(la) == float(lb)
+    for a, b in zip(jax.tree_util.tree_leaves((pa, sa)),
+                    jax.tree_util.tree_leaves((pb, sb))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_fp8_state_dict_rejects_mismatch():
+    st = fp8.init_fp8_state(["a"], REC)
+    d = fp8.state_dict(st)
+    with pytest.raises(ValueError):  # different structure
+        fp8.load_state_dict(fp8.init_fp8_state(["b"], REC), d)
+    with pytest.raises(ValueError):  # different history length
+        fp8.load_state_dict(
+            fp8.init_fp8_state(["a"], fp8.Fp8Recipe(history_len=8)), d)
+
+
+def test_fp8_metrics_flatten_onto_metrics_pytree():
+    from apex_tpu.monitor import Metrics
+
+    st = fp8.init_fp8_state(["l1"], REC)
+    m = fp8.fp8_metrics(st)
+    assert "fp8_overflow_rate" in m
+    assert "fp8_l1_x_scale" in m and "fp8_l1_g_amax" in m
+    # every value is a Metrics-legal scalar
+    metrics = Metrics().record(**{k: v for k, v in m.items()})
+    assert float(metrics["fp8_overflow_rate"]) == 0.0
+
+
+def test_fp8_recipe_and_policy_surface():
+    with pytest.raises(ValueError):
+        fp8.Fp8Recipe(history_len=0)
+    assert fp8.fp8_max(fp8.E4M3) == 448.0
+    assert fp8.fp8_max(fp8.E5M2) == 57344.0
+    pol = amp.get_policy("FP8")
+    assert pol.opt_level == "FP8" and pol.master_weights
+    assert amp.policy_compute_dtype(pol) == jnp.dtype(jnp.float8_e4m3fn)
+    assert fp8.fp8_policy() == pol
+
+
+# ---------------------------------------------------------------------------
+# dtype_leak: the fp8 policy lattice
+
+
+def test_dtype_leak_clean_fp8_program_passes():
+    from apex_tpu.analyze.dtype_leak import assert_no_dtype_leaks
+
+    params, x = _mlp_fixture()
+    st = fp8.init_fp8_state(["l1", "l2"], REC)
+    rep = assert_no_dtype_leaks(
+        lambda p, s: _loss_fn(p, s, x)[0], params, st,
+        policy=amp.get_policy("FP8"))
+    assert rep.total_dots == 2 and rep.fp32_dots == 0
+    # the fp8 dots accumulate f32 (preferred_element_type): informational
+    assert rep.fp32_accum_dots == 2
+
+
+def test_dtype_leak_smuggled_fp32_dot_under_fp8_fails():
+    from apex_tpu.analyze.dtype_leak import (
+        DtypeLeakError,
+        assert_no_dtype_leaks,
+    )
+
+    params, x = _mlp_fixture()
+    st = fp8.init_fp8_state(["l1", "l2"], REC)
+
+    def smuggled(p, s):
+        l, _ = _loss_fn(p, s, x)
+        return l + jnp.sum(x @ p["w1"])  # fp32 dot under the fp8 policy
+
+    with pytest.raises(DtypeLeakError):
+        assert_no_dtype_leaks(smuggled, params, st,
+                              policy=amp.get_policy("FP8"))
+
+
+def test_dtype_leak_lattice_counts_half_dots_under_fp8():
+    """bf16 dots riding under an fp8 policy are one rung above: counted
+    (off_policy_half_dots) but never raised — and under a bf16 policy the
+    same program reports zero."""
+    from apex_tpu.analyze.dtype_leak import dtype_leak_report
+
+    x = jnp.ones((4, 8), jnp.bfloat16)
+    w = jnp.ones((8, 8), jnp.bfloat16)
+
+    def f(x, w):
+        return jnp.sum(jax.lax.dot_general(
+            x, w, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32))
+
+    rep8 = dtype_leak_report(f, x, w, policy=jnp.float8_e4m3fn)
+    assert rep8.off_policy_half_dots == 1 and rep8.fp32_dots == 0
+    assert rep8.ok  # informational, not a failure
+    rep16 = dtype_leak_report(f, x, w, policy=jnp.bfloat16)
+    assert rep16.off_policy_half_dots == 0
+
+
+# ---------------------------------------------------------------------------
+# regress polarity: the new watcher-gated fields
+
+
+def test_regress_polarity_covers_sub8_fields():
+    from apex_tpu.monitor.regress import classify_metric, compare_records
+
+    assert classify_metric("kv_bits") == "lower"
+    assert classify_metric("wire_bytes_int4") == "lower"
+    assert classify_metric("fp8_overflow_rate") == "lower"
+    assert classify_metric("contexts_max") == "higher"
+    # and they actually gate a record diff in the right direction
+    base = {"kv_bits": 4, "contexts_max": 8, "fp8_overflow_rate": 0.0,
+            "wire_bytes_int4": 1000}
+    worse = {"kv_bits": 8, "contexts_max": 4, "fp8_overflow_rate": 0.2,
+             "wire_bytes_int4": 2000}
+    rep = compare_records(base, worse, tol=0.1)
+    assert not rep["ok"]
+    assert {r["key"] for r in rep["regressions"]} == set(base)
+    assert compare_records(base, dict(base), tol=0.1)["ok"]
+
+
+# ---------------------------------------------------------------------------
+# the concurrency headline: a fixed KV HBM budget serves 2x the contexts
+
+
+def test_int4_doubles_contexts_at_fixed_hbm_budget():
+    """The serving claim behind the int4 KV mode: at a fixed pool byte
+    budget, halving bytes/token doubles the blocks — and so the
+    concurrent max-length contexts — the pool holds."""
+    from apex_tpu.serve.kv_cache import KVCacheConfig, kv_cache_bytes
+
+    def blocks_for_budget(bits, budget):
+        one = KVCacheConfig(num_layers=2, num_heads=4, head_dim=64,
+                            num_blocks=1, block_size=16, quantized=True,
+                            bits=bits)
+        return budget // kv_cache_bytes(one)
+
+    budget = 64 << 20
+    b8 = blocks_for_budget(8, budget)
+    b4 = blocks_for_budget(4, budget)
+    assert b4 == 2 * b8
