@@ -46,10 +46,15 @@ __all__ = [
 # between prefill and decode — ``prefill_end → transfer_start →
 # transfer_end → admitted`` — and ``shed`` is the router's terminal
 # state for a request that was never admitted (load shedding: recorded,
-# never an exception).
+# never an exception). The elastic tier adds migration: when a decode
+# worker dies or drains, an in-flight request's blocks hop hosts
+# (``migrate_start → migrate_end``) and its last unacked token is
+# re-emitted (``replay``); ``worker_join`` / ``worker_leave`` are the
+# membership events (no uid — they describe a host, not a request).
 LIFECYCLE = ("submitted", "admitted", "prefill_start", "prefill_end",
              "first_token", "transfer_start", "transfer_end",
-             "decode_chunk", "retired", "shed")
+             "decode_chunk", "migrate_start", "migrate_end", "replay",
+             "retired", "shed", "worker_join", "worker_leave")
 GAUGES = ("queue_depth", "occupancy")
 
 
@@ -115,6 +120,7 @@ _SPAN_PAIRS = {
     "queued": ("submitted", "admitted"),
     "prefill": ("prefill_start", "prefill_end"),
     "transfer": ("transfer_start", "transfer_end"),
+    "migrate": ("migrate_start", "migrate_end"),
     "decode": ("first_token", "retired"),
 }
 

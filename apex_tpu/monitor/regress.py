@@ -55,7 +55,14 @@ _HIGHER = ("tokens_per_s", "goodput", "_rps", "mfu", "occupancy",
            # sub-8-bit round (stage 17): concurrent contexts a fixed KV
            # budget serves — the int4-KV headline (halving pool bytes
            # must double it; a drop is a capacity regression)
-           "contexts_max")
+           "contexts_max",
+           # elastic/chaos round (stage 18): the goodput the cluster
+           # keeps while a worker dies mid-run, and the good-SLO
+           # fraction of the surviving traffic (both already matched by
+           # the generic goodput/good_fraction fragments — listed so
+           # the chaos gate's coverage is explicit next to its
+           # lower-is-better duals below)
+           "goodput_under_chaos_rps", "survivor_good_fraction")
 _LOWER = ("_ms", "violation", "latency", "bubble", "exposed_bytes",
           # disaggregated cluster (stage 15): a rising shed fraction is a
           # capacity regression (transfer_ms falls under the generic
@@ -79,7 +86,14 @@ _LOWER = ("_ms", "violation", "latency", "bubble", "exposed_bytes",
           # generic "wire_bytes" fragment would gate baseline columns);
           # a rising fp8 cast-saturation fraction means the delayed
           # scales stopped tracking the dynamic range
-          "kv_bits", "wire_bytes_int4", "fp8_overflow_rate")
+          "kv_bits", "wire_bytes_int4", "fp8_overflow_rate",
+          # elastic/chaos round (stage 18): more migrations, replayed
+          # tokens, worker deaths, heartbeat misses or transfer retries
+          # under the SAME deterministic chaos plan means the cluster
+          # got less stable (a retry storm, flappier membership) — all
+          # lower-is-better
+          "migrations_total", "replayed_tokens", "worker_deaths",
+          "heartbeat_misses", "transfer_retries")
 
 
 def classify_metric(key: str,

@@ -133,7 +133,13 @@ class StallWatchdog:
     JSONL record (via ``sink`` or the module logger) plus every thread's
     stack — and fires ``on_stall``. One shot per stall: it re-arms on the
     next tick. ``start()``/``stop()`` manage the daemon thread; usable as
-    a context manager."""
+    a context manager.
+
+    ``clock`` defaults to ``time.monotonic``; pass any zero-arg float
+    callable (seconds) to run the watchdog on a different clock — the
+    serve cluster drives per-worker watchdogs from its shared EventLog
+    clock, and tests drive a manual clock with :meth:`check` directly
+    (no daemon thread, no sleeps)."""
 
     def __init__(
         self,
@@ -141,6 +147,7 @@ class StallWatchdog:
         sink: Optional[Any] = None,
         on_stall: Optional[Callable[[float], Any]] = None,
         poll_s: Optional[float] = None,
+        clock: Callable[[], float] = time.monotonic,
     ):
         if timeout_s <= 0:
             raise ValueError("timeout_s must be > 0")
@@ -149,16 +156,32 @@ class StallWatchdog:
         self.sink = sink
         self.on_stall = on_stall
         self.stalls = 0
-        self._last = time.monotonic()
+        self._clock = clock
+        self._last = clock()
         self._last_step: Optional[int] = None
         self._fired = False
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
     def tick(self, step: Optional[int] = None) -> None:
-        self._last = time.monotonic()
+        self._last = self._clock()
         self._last_step = step
         self._fired = False
+
+    def check(self, now: Optional[float] = None) -> bool:
+        """Run the expiry logic once (what the daemon thread does every
+        ``poll_s``): if no tick arrived within ``timeout_s`` of ``now``
+        (default: the watchdog's clock), dump diagnostics and fire
+        ``on_stall``. Returns True iff this call fired — one shot per
+        stall, re-armed by the next tick. Callable without ``start()``
+        for manual-clock drivers."""
+        idle = (self._clock() if now is None else float(now)) - self._last
+        if idle >= self.timeout_s and not self._fired:
+            self._fired = True  # one report per stall
+            self.stalls += 1
+            self._report(idle)
+            return True
+        return False
 
     def start(self) -> "StallWatchdog":
         if self._thread is None or not self._thread.is_alive():
@@ -183,11 +206,7 @@ class StallWatchdog:
     # -- internals ---------------------------------------------------------
     def _run(self) -> None:
         while not self._stop.wait(self.poll_s):
-            idle = time.monotonic() - self._last
-            if idle >= self.timeout_s and not self._fired:
-                self._fired = True  # one report per stall
-                self.stalls += 1
-                self._report(idle)
+            self.check()
 
     def _report(self, idle: float) -> None:
         from apex_tpu._logging import get_logger

@@ -83,7 +83,10 @@ from apex_tpu.serve.sampling import (  # noqa: F401
     step_keys,
 )
 from apex_tpu.serve.cluster import (  # noqa: F401  (isort: after engine)
+    AutoscalePolicy,
+    ClusterChaos,
     ClusterConfig,
+    ClusterMembership,
     DecodeWorker,
     KVHandoff,
     PrefillWorker,
@@ -95,8 +98,11 @@ from apex_tpu.serve.cluster import (  # noqa: F401  (isort: after engine)
 )
 
 __all__ = [
+    "AutoscalePolicy",
     "BlockAllocator",
+    "ClusterChaos",
     "ClusterConfig",
+    "ClusterMembership",
     "DecodeWorker",
     "KVHandoff",
     "PrefillWorker",

@@ -16,12 +16,36 @@ The multi-host tier over the single-engine serve stack (ROADMAP item 2):
   per-tenant weighted fair queueing, explicit ``shed`` terminal states;
 * :mod:`~apex_tpu.serve.cluster.cluster` — :class:`ServeCluster`, the
   router → prefill → transfer → decode step loop with one shared
-  monotonic clock and full lifecycle events (new ``transfer`` span).
+  monotonic clock and full lifecycle events (``transfer`` and
+  ``migrate`` spans);
+* :mod:`~apex_tpu.serve.cluster.membership` — the elastic tier's health
+  ledger: :class:`ClusterMembership` (alive/draining/dead states,
+  heartbeat-miss detection, ``worker_join``/``worker_leave`` events)
+  and :class:`AutoscalePolicy` (join/drain decisions off the
+  backlog/occupancy gauges);
+* :mod:`~apex_tpu.serve.cluster.chaos` — deterministic cluster fault
+  injection (:class:`ClusterChaos`: kill/preempt/stall a worker at tick
+  k, drop/stall/corrupt the next transfers) — the harness the live-KV-
+  migration and retry claims are proven against.
 """
 
+from apex_tpu.serve.cluster.chaos import (  # noqa: F401
+    ClusterChaos,
+    CorruptTransfer,
+    DropTransfer,
+    KillWorker,
+    PreemptWorker,
+    StallLink,
+    StallWorker,
+)
 from apex_tpu.serve.cluster.cluster import (  # noqa: F401
     ClusterConfig,
     ServeCluster,
+)
+from apex_tpu.serve.cluster.membership import (  # noqa: F401
+    AutoscalePolicy,
+    ClusterMembership,
+    WorkerRecord,
 )
 from apex_tpu.serve.cluster.router import (  # noqa: F401
     Router,
@@ -30,9 +54,11 @@ from apex_tpu.serve.cluster.router import (  # noqa: F401
 )
 from apex_tpu.serve.cluster.transfer import (  # noqa: F401
     SimTransport,
+    corrupt_payload,
     extract_blocks,
     insert_blocks,
     pack_blocks,
+    payload_crc32,
     payload_nbytes,
     ppermute_blocks,
     transfer_wire_bytes,
@@ -44,18 +70,30 @@ from apex_tpu.serve.cluster.workers import (  # noqa: F401
 )
 
 __all__ = [
+    "AutoscalePolicy",
+    "ClusterChaos",
     "ClusterConfig",
+    "ClusterMembership",
+    "CorruptTransfer",
     "DecodeWorker",
+    "DropTransfer",
     "KVHandoff",
+    "KillWorker",
+    "PreemptWorker",
     "PrefillWorker",
     "Router",
     "RouterConfig",
     "ServeCluster",
     "ShedDecision",
     "SimTransport",
+    "StallLink",
+    "StallWorker",
+    "WorkerRecord",
+    "corrupt_payload",
     "extract_blocks",
     "insert_blocks",
     "pack_blocks",
+    "payload_crc32",
     "payload_nbytes",
     "ppermute_blocks",
     "transfer_wire_bytes",

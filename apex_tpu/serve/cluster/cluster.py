@@ -8,47 +8,86 @@ link built from the same payloads), and ``n_decode``
 :class:`~apex_tpu.serve.cluster.workers.DecodeWorker` hosts draining it.
 Every :meth:`ServeCluster.step` is one cluster tick:
 
-    deliver transfers → router dispatch (WFQ + TTFT feasibility, sheds
-    are terminal) → one prefill chunk per busy prefill host → ship
-    finished prefills → admit + one decode step per decode host
+    chaos plan → preemption/heartbeat/watchdog checks → deliver
+    transfers (CRC-validated; corrupt/late ones retried with backoff) →
+    router dispatch (WFQ + TTFT feasibility, sheds are terminal) → one
+    prefill chunk per busy prefill host → ship finished prefills →
+    admit + one decode step per ALIVE decode host
 
 All timestamps come from ONE :class:`~apex_tpu.monitor.events.EventLog`
-clock shared by the router, both worker kinds and every decode engine,
-so the request lifecycle — ``submitted → prefill_start/end →
-first_token → transfer_start/end → admitted → decode_chunk* → retired``
-(or ``submitted → shed``) — lines up across hosts in the JSONL stream
-and the Chrome trace (``monitor.chrome_trace`` renders the new
-``transfer`` span like any other; a request visibly hops hosts in
-Perfetto).
+clock shared by the router, both worker kinds, the membership ledger
+and every decode engine, so the request lifecycle — ``submitted →
+prefill_start/end → first_token → transfer_start/end → admitted →
+decode_chunk* → retired`` (or ``submitted → shed``) — lines up across
+hosts in the JSONL stream and the Chrome trace, and so do the elastic
+events: ``worker_join`` / ``worker_leave``, ``migrate_start →
+migrate_end`` spans when a request hops off a dying host, ``replay``
+when its unacked tail is re-emitted.
+
+**The elastic tier** (ROADMAP item 3): the dispatch set is a runtime
+quantity. Workers join and leave through a
+:class:`~apex_tpu.serve.cluster.membership.ClusterMembership` ledger
+(alive → draining → dead) with heartbeat-miss detection on the shared
+clock and optional autoscale driven by the backlog/occupancy gauges.
+When a decode worker dies (killed, heartbeat-missed, watchdog-stalled)
+or drains (preempted via its
+:class:`~apex_tpu.resilience.preemption.PreemptionHandler`), its live
+requests' pool blocks ship to a surviving worker over the SAME
+extract/pack/insert wire a prefill handoff takes — verbatim for
+quantized pools — the slot is reinstalled exactly as a handoff
+admission would, and the last unacked token is replayed: resumed
+streams are **bitwise identical** to an uninterrupted run
+(``tests/test_serve_chaos.py`` pins it, greedy and sampled, fp32 and
+int8/int4 pools). Every handoff is CRC-stamped; a transfer that rots,
+stalls past ``transfer_timeout_ms`` or drops is detected and retried
+with exponential backoff — the stream never silently diverges, and a
+transfer that exhausts ``transfer_max_retries`` becomes an explicit
+``transfer_failed`` terminal state, never a hang.
 
 Parity is the design invariant, not an aspiration: the prefill hosts run
 the engine's own chunk program, the wire ships pool blocks bitwise (raw
-mode, and int8 pools under EITHER mode), and the decode hosts install
-slots exactly as local prefill completion would — so per-request token
-streams from a multi-host cluster are **bitwise equal** to the
+mode, and quantized pools under EITHER mode), and the decode hosts
+install slots exactly as local prefill completion would — so per-request
+token streams from a multi-host cluster are **bitwise equal** to the
 single-engine path, greedy and sampled
 (``tests/test_serve_cluster.py`` pins it). Overload degrades by
-shedding: offered load beyond capacity turns into ``shed`` terminal
-records while the kept traffic's goodput-under-SLO holds — the cluster
-never deadlocks and never raises the engine's pool-exhaustion error.
+shedding and failure degrades by migrating: the cluster never deadlocks
+and never raises the engine's pool-exhaustion error.
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Callable, Dict, List, Optional, Sequence
+import heapq
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 
 from apex_tpu.monitor.events import EventLog
 from apex_tpu.monitor.hist import DEFAULT_LATENCY_SPEC, Histogram
 from apex_tpu.monitor.trace import span
+from apex_tpu.resilience.preemption import StallWatchdog
+from apex_tpu.serve.cluster.chaos import ClusterChaos
+from apex_tpu.serve.cluster.membership import (
+    ALIVE,
+    DEAD,
+    DRAINING,
+    AutoscalePolicy,
+    ClusterMembership,
+)
 from apex_tpu.serve.cluster.router import Router, RouterConfig, ShedDecision
-from apex_tpu.serve.cluster.transfer import SimTransport, validate_wire_mode
+from apex_tpu.serve.cluster.transfer import (
+    SimTransport,
+    corrupt_payload,
+    pack_blocks,
+    payload_crc32,
+    validate_wire_mode,
+)
 from apex_tpu.serve.cluster.workers import (
     DecodeWorker,
     KVHandoff,
     PrefillWorker,
+    _cache_size_of,
 )
 from apex_tpu.serve.engine import Request, ServeConfig
 
@@ -62,10 +101,21 @@ class ClusterConfig:
     """Cluster shape. ``serve`` configures each DECODE host's engine
     (slots, pool, kv_quant, spec_k, megakernel…); prefill hosts derive
     their staging config from it. ``wire_mode`` picks the transfer codec
-    (``"int8"`` on a float pool cuts wire bytes ~3.6×; int8 pools ship
-    their codes+scales verbatim either way). ``link_fixed_ms`` /
+    (``"int8"`` on a float pool cuts wire bytes ~3.6×; quantized pools
+    ship their codes+scales verbatim either way). ``link_fixed_ms`` /
     ``link_gib_per_s`` shape the simulated transport's modeled latency
-    (both 0: instant — the deterministic test default)."""
+    (both 0: instant — the deterministic test default).
+
+    Elastic knobs (all off by default — a cluster with none of them set
+    behaves exactly like the pre-elastic one): ``heartbeat_timeout_ms``
+    declares a worker dead after that long without a beat on the shared
+    clock; ``watchdog_timeout_ms`` arms one
+    :class:`~apex_tpu.resilience.preemption.StallWatchdog` per decode
+    worker on the same clock (diagnostics to the sink, then death +
+    migration); ``transfer_timeout_ms`` / ``transfer_max_retries`` /
+    ``retry_backoff_ms`` govern the CRC/timeout retry ladder on the
+    handoff wire; ``autoscale`` turns the backlog/occupancy gauges into
+    join/drain decisions."""
 
     n_prefill: int = 1
     n_decode: int = 1
@@ -75,6 +125,12 @@ class ClusterConfig:
     prefill_queue_limit: int = 1
     link_fixed_ms: float = 0.0
     link_gib_per_s: float = 0.0
+    heartbeat_timeout_ms: Optional[float] = None
+    watchdog_timeout_ms: Optional[float] = None
+    transfer_timeout_ms: Optional[float] = None
+    transfer_max_retries: int = 3
+    retry_backoff_ms: float = 10.0
+    autoscale: Optional[AutoscalePolicy] = None
 
     def validate(self) -> None:
         if self.n_prefill < 1:
@@ -86,6 +142,17 @@ class ClusterConfig:
         self.router.validate()
         if self.link_fixed_ms < 0 or self.link_gib_per_s < 0:
             raise ValueError("link latency knobs must be >= 0")
+        for knob in ("heartbeat_timeout_ms", "watchdog_timeout_ms",
+                     "transfer_timeout_ms"):
+            v = getattr(self, knob)
+            if v is not None and v <= 0:
+                raise ValueError(f"{knob} must be > 0 when given")
+        if self.transfer_max_retries < 0:
+            raise ValueError("transfer_max_retries must be >= 0")
+        if self.retry_backoff_ms < 0:
+            raise ValueError("retry_backoff_ms must be >= 0")
+        if self.autoscale is not None:
+            self.autoscale.validate()
 
 
 class ServeCluster:
@@ -98,7 +165,12 @@ class ServeCluster:
     model). Streams are retained in :attr:`finished` unless
     ``retain_streams=False`` routes them to ``on_retire``; shed requests
     land in :attr:`shed` (uid → :class:`ShedDecision`) instead — the
-    explicit terminal state."""
+    explicit terminal state (reason ``"transfer_failed"`` when the
+    retry ladder ran dry).
+
+    ``chaos``: a :class:`~apex_tpu.serve.cluster.chaos.ClusterChaos`
+    plan consulted at the top of every tick — the deterministic fault
+    harness the elastic claims are proven against."""
 
     def __init__(self, params: Pytree, cfg, cluster_cfg: ClusterConfig, *,
                  base_key=None, sink=None,
@@ -106,7 +178,8 @@ class ServeCluster:
                  retain_streams: bool = True,
                  on_retire: Optional[Callable[[str, List[int]], None]] = None,
                  use_pallas: Optional[bool] = None,
-                 peak_flops_per_s: Optional[float] = None):
+                 peak_flops_per_s: Optional[float] = None,
+                 chaos: Optional[ClusterChaos] = None):
         cluster_cfg.validate()
         self.cfg = cfg
         self.cluster_cfg = cluster_cfg
@@ -119,19 +192,28 @@ class ServeCluster:
         self.router = Router(cluster_cfg.router)
         self.transport = SimTransport(fixed_ms=cluster_cfg.link_fixed_ms,
                                       gib_per_s=cluster_cfg.link_gib_per_s)
+        self.membership = ClusterMembership(
+            heartbeat_timeout_ms=cluster_cfg.heartbeat_timeout_ms,
+            events=self._events, autoscale=cluster_cfg.autoscale)
+        self._chaos = chaos
         scfg = cluster_cfg.serve
         # decode hosts keep the full engine feature set minus the prefix
         # cache (blocks arrive by wire, not by content address); prefill
         # hosts need no speculation/megakernel — they never decode
-        decode_cfg = dataclasses.replace(scfg, prefix_cache=False)
-        prefill_cfg = dataclasses.replace(
+        self._decode_cfg = dataclasses.replace(scfg, prefix_cache=False)
+        self._prefill_cfg = dataclasses.replace(
             scfg, prefix_cache=False, spec_k=0, megakernel="off")
         self._retain_streams = retain_streams
         self._on_retire = on_retire
         self._finished: Dict[str, List[int]] = {}
         self.shed: Dict[str, ShedDecision] = {}
+        # ctor args retained so autoscale can spawn identical workers
+        self._params = params
+        self._base_key = base_key
+        self._use_pallas = use_pallas
+        self._peak_flops_per_s = peak_flops_per_s
         self.prefill_workers = [
-            PrefillWorker(params, cfg, prefill_cfg, base_key=base_key,
+            PrefillWorker(params, cfg, self._prefill_cfg, base_key=base_key,
                           wire_mode=cluster_cfg.wire_mode,
                           events=self._events,
                           now_ms=self._events.now_ms,
@@ -139,16 +221,46 @@ class ServeCluster:
                           use_pallas=use_pallas, name=f"prefill{i}")
             for i in range(cluster_cfg.n_prefill)]
         self.decode_workers = [
-            DecodeWorker(params, cfg, decode_cfg, base_key=base_key,
-                         wire_mode=cluster_cfg.wire_mode, sink=sink,
-                         events=self._events,
-                         slo=cluster_cfg.router.slo,
-                         retain_streams=False,
-                         on_retire=self._retired,
-                         use_pallas=use_pallas,
-                         peak_flops_per_s=peak_flops_per_s,
-                         name=f"decode{i}")
+            self._make_decode_worker(f"decode{i}")
             for i in range(cluster_cfg.n_decode)]
+        self._next_decode_id = cluster_cfg.n_decode
+        self._workers: Dict[str, Any] = {
+            w.name: w for w in self.prefill_workers + self.decode_workers}
+        t0 = self._now_ms()
+        for w in self.prefill_workers:
+            self.membership.join(w.name, "prefill", t0)
+        for w in self.decode_workers:
+            self.membership.join(w.name, "decode", t0)
+        # chaos-stalled workers: name -> step index the stall ends at
+        # (None: wedged until something declares it dead)
+        self._stalled: Dict[str, Optional[int]] = {}
+        # per-decode-worker stall watchdogs on the shared clock (seconds)
+        self._watchdogs: Dict[str, StallWatchdog] = {}
+        if cluster_cfg.watchdog_timeout_ms is not None:
+            for w in self.decode_workers:
+                self._arm_watchdog(w.name)
+        # the ONE extract program migration uses, shared by every decode
+        # worker (identical kv config + padded shape) — a kill-and-
+        # migrate on warmed workers mints ZERO new compilations
+        decode_kv = self.decode_workers[0].engine.kv_cfg
+        wire_mode = cluster_cfg.wire_mode
+
+        def migrate_extract(cache, ids):
+            return pack_blocks(cache, decode_kv, ids, wire_mode=wire_mode)
+
+        self._migrate_extract = jax.jit(migrate_extract)
+        # transfer reliability: uid -> {handoff, attempt, deadline};
+        # resends scheduled on the shared clock with exponential backoff
+        self._awaiting: Dict[str, Dict[str, Any]] = {}
+        self._resend_at: List[Tuple[float, int, str]] = []  # (t, seq, uid)
+        self._resend_seq = 0
+        self._redeliver: List[KVHandoff] = []  # delivered, unplaced
+        self.migrations_total = 0
+        self.transfer_retries = 0
+        self.transfer_crc_failures = 0
+        self.transfer_timeouts = 0
+        self.transfer_failed = 0
+        self.duplicates_ignored = 0
         # hard capacity for the unservable check: the roomiest decode pool
         self._max_servable_tokens = max(
             w.engine.kv_cfg.num_blocks * w.engine.kv_cfg.block_size
@@ -157,6 +269,26 @@ class ServeCluster:
         self.transfer_ms_hist = Histogram(DEFAULT_LATENCY_SPEC)
         self._step_idx = 0
         self._t_first_submit_ms: Optional[float] = None
+        # start time of the PREVIOUS tick: the heartbeat/watchdog floor
+        # (a worker that beat during that tick took its chance — one
+        # slow wall-clock tick must not age the whole fleet to death)
+        self._prev_tick_start_ms: Optional[float] = None
+
+    def _make_decode_worker(self, name: str) -> DecodeWorker:
+        return DecodeWorker(
+            self._params, self.cfg, self._decode_cfg,
+            base_key=self._base_key,
+            wire_mode=self.cluster_cfg.wire_mode, sink=self._sink,
+            events=self._events, slo=self.cluster_cfg.router.slo,
+            retain_streams=False, on_retire=self._retired,
+            use_pallas=self._use_pallas,
+            peak_flops_per_s=self._peak_flops_per_s, name=name)
+
+    def _arm_watchdog(self, name: str) -> None:
+        self._watchdogs[name] = StallWatchdog(
+            timeout_s=self.cluster_cfg.watchdog_timeout_ms / 1e3,
+            sink=self._sink,
+            clock=lambda: self._events.now_ms() / 1e3)
 
     # -- lifecycle ---------------------------------------------------------
     def _now_ms(self) -> float:
@@ -204,29 +336,317 @@ class ServeCluster:
                                if d.predicted_ttft_ms is not None else None),
             budget_ms=d.budget_ms)
 
-    # -- the cluster tick --------------------------------------------------
+    # -- membership views --------------------------------------------------
+    def _state(self, name: str) -> str:
+        return self.membership.state(name)
+
+    def _steppable(self, name: str) -> bool:
+        return self._state(name) != DEAD and name not in self._stalled
+
+    def alive_decode_workers(self) -> List[DecodeWorker]:
+        return [w for w in self.decode_workers if self._state(w.name) == ALIVE]
+
+    def alive_prefill_workers(self) -> List[PrefillWorker]:
+        return [w for w in self.prefill_workers
+                if self._state(w.name) == ALIVE]
+
+    # -- elastic transitions (chaos entry points + real operations) --------
+    def kill_worker(self, name: str) -> None:
+        """Fail-stop ``name`` NOW: out of the dispatch set, decode slots
+        migrate to survivors, staged prefill prompts re-enqueue at the
+        router. (The simulated failure keeps the dying pool readable —
+        the preemption-notice / reachable-HBM failure class the KV wire
+        can actually rescue; a hard asic loss would re-prefill instead,
+        which the prefill re-enqueue path already covers.)"""
+        t = self._now_ms()
+        if not self.membership.mark_dead(name, t, "killed"):
+            return
+        self._evacuate(name, t)
+
+    def preempt_worker(self, name: str) -> None:
+        """Deliver a preemption through the worker's PreemptionHandler —
+        the exact path a real SIGTERM takes. The drain protocol runs on
+        the next tick."""
+        self._workers[name].preemption.trigger()
+
+    def stall_worker(self, name: str, for_steps: int = 0) -> None:
+        """Chaos: ``name`` stops stepping (and beating) for
+        ``for_steps`` ticks (0: until declared dead)."""
+        self._stalled[name] = (self._step_idx + for_steps
+                               if for_steps > 0 else None)
+
+    def request_drain(self, name: str, reason: str = "drained") -> None:
+        """Voluntary exit: decode migrates its live requests now and
+        leaves; prefill finishes its current prompt, re-enqueues the
+        rest, and leaves when idle."""
+        t = self._now_ms()
+        if not self.membership.mark_draining(name, t, reason):
+            return
+        w = self._workers[name]
+        if isinstance(w, DecodeWorker):
+            self._evacuate(name, t)
+            self.membership.mark_dead(name, t, reason)
+        else:
+            for req, t_sub in reversed(w.drain_queued()):
+                self.router.requeue(req, t_sub)
+            if not w.busy:
+                self.membership.mark_dead(name, t, reason)
+
+    def _evacuate(self, name: str, t_ms: float) -> None:
+        """Move everything off a dead/draining worker: pending handoffs
+        re-dispatch, live decode slots migrate over the KV wire, staged
+        prefill prompts re-enqueue at the router."""
+        w = self._workers[name]
+        if isinstance(w, PrefillWorker):
+            aborted = w.abort_current()
+            if aborted is not None:
+                self.router.requeue(*aborted)
+            for req, t_sub in reversed(w.drain_queued()):
+                self.router.requeue(req, t_sub)
+            return
+        for h in w.drain_pending():
+            # not yet installed: just re-place on a survivor (the
+            # payload is cluster-side and its transfer already counted
+            # — no new wire transit, no new transfer telemetry)
+            self._redeliver.append(h)
+        for uid in w.live_uids():
+            self._events.emit("migrate_start", uid, t_ms=t_ms,
+                              src=name)
+            h = w.evict_to_handoff(uid, self._migrate_extract)
+            self.migrations_total += 1
+            self._send_handoff(h, t_ms)
+
+    # -- transfer reliability ----------------------------------------------
+    def _send_handoff(self, h: KVHandoff, t_ms: float,
+                      attempt: int = 1) -> None:
+        uid = h.request.uid
+        timeout = self.cluster_cfg.transfer_timeout_ms
+        self._awaiting[uid] = {
+            "handoff": h, "attempt": attempt,
+            "deadline": (t_ms + timeout) if timeout is not None else None,
+        }
+        with span("transfer"):
+            self._events.emit("transfer_start", uid, t_ms=t_ms,
+                              wire_bytes=h.wire_bytes,
+                              n_blocks=h.n_blocks, handoff_kind=h.kind,
+                              attempt=attempt)
+            self.transport.send((h, attempt), h.wire_bytes, t_ms)
+
+    def _schedule_retry(self, uid: str, t_ms: float, reason: str) -> None:
+        entry = self._awaiting.get(uid)
+        if entry is None:
+            return
+        if entry["attempt"] > self.cluster_cfg.transfer_max_retries:
+            # retry ladder ran dry: explicit terminal state, never a
+            # hang — and the router's ledger moves it admitted → shed
+            # so shed_rate reflects the loss
+            del self._awaiting[uid]
+            self.transfer_failed += 1
+            h = entry["handoff"]
+            self._record_shed(self.router.shed_admitted(
+                h.request, "transfer_failed", t_ms))
+            return
+        self.transfer_retries += 1
+        self._events.emit("transfer_retry", uid, t_ms=t_ms, reason=reason,
+                          attempt=entry["attempt"])
+        backoff = (self.cluster_cfg.retry_backoff_ms
+                   * (2 ** (entry["attempt"] - 1)))
+        entry["attempt"] += 1
+        entry["deadline"] = None  # re-armed when the resend goes out
+        self._resend_seq += 1
+        heapq.heappush(self._resend_at,
+                       (t_ms + backoff, self._resend_seq, uid))
+
+    def _pump_retries(self, t_ms: float) -> int:
+        """Resend due retries; time out overdue transfers."""
+        n = 0
+        while self._resend_at and self._resend_at[0][0] <= t_ms:
+            _, _, uid = heapq.heappop(self._resend_at)
+            entry = self._awaiting.get(uid)
+            if entry is None:
+                continue
+            self._send_handoff(entry["handoff"], t_ms,
+                               attempt=entry["attempt"])
+            n += 1
+        for uid, entry in list(self._awaiting.items()):
+            if entry["deadline"] is not None and t_ms >= entry["deadline"]:
+                self.transfer_timeouts += 1
+                self._schedule_retry(uid, t_ms, "timeout")
+                n += 1
+        return n
+
     def _deliver(self, t_ms: float) -> int:
         n = 0
         for d in self.transport.poll(t_ms):
-            h: KVHandoff = d.item
+            h, attempt = d.item
+            uid = h.request.uid
+            entry = self._awaiting.get(uid)
+            if entry is None:
+                # already satisfied by an earlier copy: true duplicate
+                self.duplicates_ignored += 1
+                continue
+            payload = (corrupt_payload(h.payload) if d.corrupted
+                       else h.payload)
+            valid = (h.crc32 is None
+                     or payload_crc32(payload) == h.crc32)
+            if attempt != entry["attempt"]:
+                # a copy from a superseded attempt (it stalled past the
+                # timeout and a retry is pending): a VALID copy still
+                # satisfies the request — first good copy wins, and the
+                # scheduled resend lapses against the empty awaiting
+                # entry, saving the backoff wait and a full KV
+                # retransmit. An invalid one is just dropped: the newer
+                # attempt is already underway.
+                if not valid:
+                    self.duplicates_ignored += 1
+                    continue
+            elif not valid:
+                self.transfer_crc_failures += 1
+                self._schedule_retry(uid, t_ms, "crc")
+                continue
+            # validated: the transfer is DONE exactly once (one
+            # transfer_end, one histogram sample) whether or not a
+            # destination is alive right now — placement is a separate
+            # concern handled below
+            del self._awaiting[uid]
             self.transfer_ms_hist.add([d.transfer_ms])
             self._events.emit(
-                "transfer_end", h.request.uid, t_ms=d.t_deliver_ms,
-                wire_bytes=d.wire_bytes,
+                "transfer_end", uid, t_ms=d.t_deliver_ms,
+                wire_bytes=d.wire_bytes, handoff_kind=h.kind,
                 transfer_ms=round(d.transfer_ms, 3))
-            worker = min(self.decode_workers, key=lambda w: w.load)
-            worker.admit(h)
+            self._redeliver.append(h)
+            n += 1
+        # place everything delivered-but-unplaced (fresh arrivals above,
+        # plus handoffs evacuated from a dead worker's pending queue —
+        # those crossed the wire once already and get NO new transfer
+        # telemetry) onto the least-loaded ALIVE worker
+        if self._redeliver and self.alive_decode_workers():
+            todo, self._redeliver = self._redeliver, []
+            for h in todo:
+                worker = min(self.alive_decode_workers(),
+                             key=lambda w: w.load)
+                worker.admit(h)
+        return n
+
+    def _abort_if_headless(self, t_ms: float) -> int:
+        """No ALIVE decode worker and no autoscale to mint one: every
+        delivered-or-in-flight handoff (and everything still queued at
+        the router) can never be served — turn them into explicit
+        ``no_decode_workers`` terminal sheds instead of waiting forever.
+        With autoscale armed the cluster instead waits for the join."""
+        if self.alive_decode_workers() or (
+                self.membership.autoscale_policy is not None):
+            return 0
+        n = 0
+        doomed: List[Request] = [h.request for h in self._redeliver]
+        self._redeliver.clear()
+        for entry in self._awaiting.values():
+            doomed.append(entry["handoff"].request)
+        self._awaiting.clear()
+        self._resend_at.clear()
+        # in-flight requests were admitted: the router moves them to its
+        # shed column; queued ones shed through the normal queue path —
+        # either way the per-tenant ledger stays exact
+        for req in doomed:
+            self._record_shed(self.router.shed_admitted(
+                req, "no_decode_workers", t_ms))
+            n += 1
+        for d in self.router.shed_queued("no_decode_workers", t_ms):
+            self._record_shed(d)
             n += 1
         return n
 
+    # -- failure detection (per tick) --------------------------------------
+    def _poll_preemptions(self, t_ms: float) -> int:
+        n = 0
+        for name, w in list(self._workers.items()):
+            if (self._state(name) == ALIVE and w.preemption.preempted()):
+                self.request_drain(name, "preempted")
+                n += 1
+        return n
+
+    def _finish_drains(self, t_ms: float) -> None:
+        # draining prefill workers leave once their current prompt ships
+        for w in self.prefill_workers:
+            if self._state(w.name) == DRAINING and not w.busy:
+                self.membership.mark_dead(
+                    w.name, t_ms,
+                    self.membership.record(w.name).reason or "drained")
+
+    def _check_watchdogs(self, t_ms: float,
+                         beat_floor_ms: Optional[float] = None) -> int:
+        n = 0
+        for name, wd in self._watchdogs.items():
+            if self._state(name) == DEAD:
+                continue
+            if (beat_floor_ms is not None
+                    and self.membership.record(name).last_beat_ms
+                    >= beat_floor_ms):
+                continue  # beat during the previous tick: not wedged
+            if wd.check(now=t_ms / 1e3):
+                w = self._workers[name]
+                if self._sink is not None:
+                    self._sink.write(
+                        step=self._step_idx, phase="watchdog",
+                        worker=name,
+                        occupied_slots=len(w.live_uids()),
+                        handoffs_pending=len(w._pending),
+                        last_beat_ms=round(
+                            self.membership.record(name).last_beat_ms, 3))
+                self.membership.mark_dead(name, t_ms, "stall")
+                self._evacuate(name, t_ms)
+                n += 1
+        return n
+
+    def _autoscale(self, t_ms: float) -> None:
+        if (self.membership.autoscale_policy is not None
+                and not self.alive_decode_workers()):
+            # headless with autoscale armed: the gauges can never ask
+            # for a join (occupancy of zero capacity is 0.0), but lost
+            # capacity must be replaced or the fleet stays headless
+            # forever — spawn immediately (0 alive is always under the
+            # fleet cap, which counts ALIVE workers)
+            self.spawn_decode_worker()
+            self.membership.autoscale_ups += 1
+            return
+        decision = self.membership.autoscale_decision(
+            self.router.queue_depth, self.occupancy(), t_ms)
+        if decision == "up":
+            self.spawn_decode_worker()
+        elif decision == "down":
+            candidates = self.alive_decode_workers()
+            if len(candidates) > 1:
+                victim = min(candidates, key=lambda w: w.load)
+                self.request_drain(victim.name, "scale_down")
+
+    def spawn_decode_worker(self) -> DecodeWorker:
+        """Join a fresh decode worker at runtime (the autoscale-up hook;
+        also callable directly to replace lost capacity). Its programs
+        compile on first use — an explicit, bounded cost the compile
+        gates exclude by construction (new worker = new program set)."""
+        name = f"decode{self._next_decode_id}"
+        self._next_decode_id += 1
+        w = self._make_decode_worker(name)
+        self.decode_workers.append(w)
+        self._workers[name] = w
+        self.membership.join(name, "decode", self._now_ms())
+        if self.cluster_cfg.watchdog_timeout_ms is not None:
+            self._arm_watchdog(name)
+        return w
+
+    # -- the cluster tick --------------------------------------------------
     def _outstanding(self) -> int:
         """Requests in flight anywhere downstream of the router: mid- or
-        awaiting prefill, on the wire, pending or occupying a decode
-        slot."""
-        n = self.transport.in_flight
+        awaiting prefill, on the wire (or awaiting a retry), pending or
+        occupying a decode slot on a non-dead worker."""
+        n = len(self._awaiting) + len(self._redeliver)
         for w in self.prefill_workers:
+            if self._state(w.name) == DEAD:
+                continue
             n += (1 if w._current is not None else 0) + len(w._queue)
         for w in self.decode_workers:
+            if self._state(w.name) == DEAD:
+                continue
             n += len(w._pending)
             n += sum(s is not None for s in w.engine._slots)
         return n
@@ -238,8 +658,11 @@ class ServeCluster:
         deliberately simple stand-in for per-stage service curves, but
         one that GROWS with congestion, which is all admission control
         needs."""
-        n = sum(w.backlog_tokens for w in self.prefill_workers)
+        n = sum(w.backlog_tokens for w in self.prefill_workers
+                if self._state(w.name) != DEAD)
         for w in self.decode_workers:
+            if self._state(w.name) == DEAD:
+                continue
             for h in w._pending:
                 n += h.request.max_new_tokens
             for s in w.engine._slots:
@@ -250,20 +673,24 @@ class ServeCluster:
 
     def _dispatch(self, t_ms: float) -> int:
         """Admit from the router while the pipeline has credit. The
-        credit bound (decode slots + one buffered handoff per decode
-        host) is BACKPRESSURE: when decode saturates, dispatch stops,
-        queue wait mounts at the ROUTER, and the TTFT feasibility check
-        — waited + pipeline-work · measured ms/token — sheds there,
-        where a rejection is still cheap. Without it, prefill would race
-        ahead and mint first tokens whose streams then stall for seconds
-        in a decode queue no budget knows about."""
+        credit bound (ALIVE decode slots + one buffered handoff per
+        alive decode host) is BACKPRESSURE: when decode saturates,
+        dispatch stops, queue wait mounts at the ROUTER, and the TTFT
+        feasibility check — waited + pipeline-work · measured ms/token —
+        sheds there, where a rejection is still cheap. Without it,
+        prefill would race ahead and mint first tokens whose streams
+        then stall for seconds in a decode queue no budget knows
+        about. Only ALIVE workers are in the dispatch set — the elastic
+        invariant."""
         n = 0
-        capacity = (sum(w.engine.serve_cfg.num_slots
-                        for w in self.decode_workers)
-                    + len(self.decode_workers))
+        alive_decode = self.alive_decode_workers()
+        if not alive_decode:
+            return 0
+        capacity = (sum(w.engine.serve_cfg.num_slots for w in alive_decode)
+                    + len(alive_decode))
         outstanding = self._outstanding()
         backlog = self._pipeline_tokens()
-        for worker in sorted(self.prefill_workers,
+        for worker in sorted(self.alive_prefill_workers(),
                              key=lambda w: w.backlog_tokens):
             while worker.can_accept and outstanding < capacity:
                 item, sheds = self.router.next_request(backlog, t_ms)
@@ -281,44 +708,80 @@ class ServeCluster:
     def step(self) -> bool:
         """One cluster tick; False when nothing moved anywhere."""
         t = self._now_ms()
+        faults = (self._chaos.apply(self, self._step_idx)
+                  if self._chaos is not None else [])
+        # expire finished chaos stalls (a dead worker's stall is moot —
+        # leaving it would make the waiting term below report progress
+        # forever after the death was already handled)
+        for name, until in list(self._stalled.items()):
+            if ((until is not None and self._step_idx >= until)
+                    or self._state(name) == DEAD):
+                del self._stalled[name]
+        moved = len(faults)
+        moved += self._poll_preemptions(t)
+        floor = self._prev_tick_start_ms
+        for name in self.membership.check_heartbeats(t,
+                                                     beat_floor_ms=floor):
+            self._evacuate(name, t)
+            moved += 1
+        moved += self._check_watchdogs(t, floor)
         with span("transfer"):
             delivered = self._deliver(t)
+            retried = self._pump_retries(t)
+        moved += self._abort_if_headless(t)
         dispatched = self._dispatch(t)
         chunks = 0
         sent = 0
         for w in self.prefill_workers:
+            if not self._steppable(w.name):
+                continue
             before = w.chunks_run
             h = w.step()
+            # beat with a FRESH timestamp: the step above may have been
+            # the slow thing (a compile, a long chunk) — the worker that
+            # just proved liveness must never look stale for it
+            self.membership.beat(w.name, self._now_ms())
             if w.chunks_run > before:  # feed only a FRESH measurement
                 self.router.observe_chunk(w.last_chunk_tokens,
                                           w.last_chunk_ms)
             if w.busy or h is not None:
                 chunks += 1
             if h is not None:
-                with span("transfer"):
-                    t_send = self._now_ms()
-                    self._events.emit("transfer_start", h.request.uid,
-                                      t_ms=t_send,
-                                      wire_bytes=h.wire_bytes,
-                                      n_blocks=h.n_blocks)
-                    self.transport.send(h, h.wire_bytes, t_send)
+                self._send_handoff(h, self._now_ms())
                 sent += 1
+        self._finish_drains(t)
         decoded = 0
         for w in self.decode_workers:
+            if self._state(w.name) != ALIVE or w.name in self._stalled:
+                continue
             if w.step():
                 decoded += 1
-        # transfers still on the (modeled-latency) wire count as pending
+            self.membership.beat(w.name, self._now_ms())
+            wd = self._watchdogs.get(w.name)
+            if wd is not None:
+                wd.tick(self._step_idx)
+        self._autoscale(t)
+        # transfers still on the (modeled-latency) wire — or waiting out
+        # a retry backoff / failure-detection timeout — count as pending
         # progress: a driver polling "did anything move?" must not
-        # declare the cluster drained while a handoff is in flight
-        progressed = bool(delivered or dispatched or chunks or sent
-                          or decoded or self.transport.in_flight)
+        # declare the cluster drained while recovery is in flight
+        detection_armed = (
+            self.cluster_cfg.heartbeat_timeout_ms is not None
+            or self.cluster_cfg.watchdog_timeout_ms is not None)
+        waiting = (self.transport.in_flight or self._awaiting
+                   or self._resend_at or self._redeliver
+                   or (bool(self._stalled) and detection_armed))
+        progressed = bool(moved or delivered or retried or dispatched
+                          or chunks or sent or decoded or waiting)
+        self._prev_tick_start_ms = t
         self._step_idx += 1
         if self._sink is not None and progressed:
             self._sink.write(
                 step=self._step_idx, phase="cluster",
                 queue_depth=self.router.queue_depth,
                 prefill_backlog_tokens=sum(
-                    w.backlog_tokens for w in self.prefill_workers),
+                    w.backlog_tokens for w in self.prefill_workers
+                    if self._state(w.name) != DEAD),
                 transfers_in_flight=self.transport.in_flight,
                 shed_total=self.router.shed)
         return progressed
@@ -327,9 +790,13 @@ class ServeCluster:
     @property
     def active(self) -> bool:
         return (self.router.queue_depth > 0
-                or any(w.busy for w in self.prefill_workers)
+                or any(w.busy for w in self.prefill_workers
+                       if self._state(w.name) != DEAD)
                 or self.transport.in_flight > 0
-                or any(w.active for w in self.decode_workers))
+                or bool(self._awaiting) or bool(self._redeliver)
+                or bool(self._resend_at)
+                or any(w.active for w in self.decode_workers
+                       if self._state(w.name) != DEAD))
 
     def run(self, requests: Sequence[Request],
             max_steps: Optional[int] = None) -> Dict[str, List[int]]:
@@ -337,8 +804,8 @@ class ServeCluster:
         :attr:`shed`); returns uid → generated tokens for the completed
         ones. Never deadlocks: a tick that moves nothing while work
         remains is impossible by construction (queued work either
-        dispatches, sheds, chunks, ships or decodes), and ``max_steps``
-        is a belt-and-braces bound for drivers."""
+        dispatches, sheds, chunks, ships, decodes, migrates or retries),
+        and ``max_steps`` is a belt-and-braces bound for drivers."""
         for r in requests:
             self.submit(r)
         steps = 0
@@ -361,21 +828,45 @@ class ServeCluster:
         return {
             "prefill": [w.compile_counts() for w in self.prefill_workers],
             "decode": [w.compile_counts() for w in self.decode_workers],
+            "migrate_extract": _cache_size_of(self._migrate_extract),
         }
+
+    def programs(self) -> Dict[str, Callable]:
+        """Every jitted program in the cluster, uniquely named — hand
+        straight to ``analyze.recompile_guard`` to pin that a
+        kill-and-migrate run on warmed workers mints ZERO new
+        compilations (migration reuses the existing
+        extract/insert/decode programs)."""
+        out: Dict[str, Callable] = {"migrate_extract": self._migrate_extract}
+        for w in self.prefill_workers:
+            out[f"{w.name}.chunk_prefill"] = w._chunk_prefill
+            out[f"{w.name}.extract"] = w._extract
+        for w in self.decode_workers:
+            for k, fn in w.engine.programs().items():
+                if fn is not None:
+                    out[f"{w.name}.{k}"] = fn
+            out[f"{w.name}.insert"] = w._insert
+        return out
 
     # -- stats -------------------------------------------------------------
     def occupancy(self) -> float:
-        tot = sum(w.engine.serve_cfg.num_slots for w in self.decode_workers)
+        """Occupied / total decode slots over the ALIVE fleet (the
+        autoscale gauge — dead capacity is not capacity)."""
+        alive = self.alive_decode_workers()
+        tot = sum(w.engine.serve_cfg.num_slots for w in alive)
         occ = sum(sum(s is not None for s in w.engine._slots)
-                  for w in self.decode_workers)
+                  for w in alive)
         return occ / tot if tot else 0.0
 
     def stats(self) -> Dict[str, Any]:
         """One JSON-serializable snapshot of the whole cluster: router
-        admission/shed accounting, transfer wire totals, merged decode
-        latency quantiles and the summed goodput-under-SLO report —
-        ``shed_rate`` / ``admitted_rps`` / ``transfer_ms_p50`` are the
-        flat headline fields ``monitor.regress`` gates."""
+        admission/shed accounting, transfer wire totals, membership and
+        elastic counters, merged decode latency quantiles and the summed
+        goodput-under-SLO report — ``shed_rate`` / ``admitted_rps`` /
+        ``transfer_ms_p50`` plus the chaos-gated ``migrations_total`` /
+        ``replayed_tokens`` / ``worker_deaths`` / ``heartbeat_misses`` /
+        ``transfer_retries`` are the flat headline fields
+        ``monitor.regress`` gates."""
         router_stats = self.router.stats()
         out: Dict[str, Any] = {
             "hosts": {"prefill": len(self.prefill_workers),
@@ -408,7 +899,26 @@ class ServeCluster:
                 round(tr.wire_bytes_total / tr.transfer_ms_total, 1)
                 if tr.transfer_ms_total > 0 else None),
             "in_flight": tr.in_flight,
+            "faults": {"drops": tr.drops_total, "stalls": tr.stalls_total,
+                       "corrupts": tr.corrupts_total},
         }
+        # the elastic ledger + flat chaos-gate headline fields
+        out["membership"] = self.membership.stats()
+        out["elastic"] = {
+            "migrations_total": self.migrations_total,
+            "replayed_tokens": sum(
+                w.replayed_tokens for w in self.decode_workers),
+            "transfer_retries": self.transfer_retries,
+            "transfer_crc_failures": self.transfer_crc_failures,
+            "transfer_timeouts": self.transfer_timeouts,
+            "transfer_failed": self.transfer_failed,
+            "duplicates_ignored": self.duplicates_ignored,
+        }
+        out["migrations_total"] = self.migrations_total
+        out["replayed_tokens"] = out["elastic"]["replayed_tokens"]
+        out["worker_deaths"] = self.membership.worker_deaths
+        out["heartbeat_misses"] = self.membership.heartbeat_misses
+        out["transfer_retries"] = self.transfer_retries
         h = self.transfer_ms_hist
         if h.total:
             out["transfer_ms_p50"] = round(h.quantile(0.5), 4)
@@ -448,14 +958,21 @@ class ServeCluster:
             out["goodput_rps"] = slo_rep["goodput_rps"]
             out["good_fraction"] = slo_rep["good_fraction"]
         out["prefill_hosts"] = [
-            {"host": w.name, "chunks_run": w.chunks_run,
+            {"host": w.name, "state": self._state(w.name),
+             "chunks_run": w.chunks_run,
              "prefills_done": w.prefills_done,
              "backlog_tokens": w.backlog_tokens}
             for w in self.prefill_workers]
         out["decode_hosts"] = [
-            {"host": w.name, "completed": w.engine.completed,
+            {"host": w.name, "state": self._state(w.name),
+             "completed": w.engine.completed,
              "handoffs_admitted": w.admitted,
              "handoffs_pending": len(w._pending),
+             "migrations_in": w.migrations_in,
+             "migrations_out": w.migrations_out,
              "occupancy": w.engine.occupancy()}
             for w in self.decode_workers]
+        if self._chaos is not None:
+            out["chaos"] = self._chaos.summary()
         return out
+

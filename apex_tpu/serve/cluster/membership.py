@@ -1,0 +1,271 @@
+"""Elastic cluster membership — who is dispatchable, right now.
+
+Production slices lose hosts mid-request (spot reclaims, maintenance
+events, link flaps, wedged runtimes); a serving cluster whose dispatch
+set is fixed at construction turns every one of those into an outage.
+This module makes the dispatch set a runtime quantity:
+
+* :class:`ClusterMembership` — one record per worker with a three-state
+  health ladder (``alive → draining → dead``). *Alive* workers receive
+  new work; *draining* workers finish (prefill) or proactively migrate
+  (decode) what they hold but receive nothing new; *dead* workers are
+  out of the dispatch set and their in-flight requests are migrated by
+  the cluster. Every transition stamps the cluster's ONE shared
+  :class:`~apex_tpu.monitor.events.EventLog` clock and emits the
+  ``worker_join`` / ``worker_leave`` lifecycle events, so membership
+  churn lines up with request lifecycles in the same JSONL stream and
+  Chrome trace.
+* **heartbeat-miss detection** — each worker that makes progress beats
+  (:meth:`ClusterMembership.beat`); :meth:`check_heartbeats` declares
+  workers whose last beat is older than ``heartbeat_timeout_ms`` dead
+  (reason ``"heartbeat"``). Deterministic under a manual clock — the
+  chaos tests stall a worker and watch it get declared dead at exactly
+  the configured timeout, no wall time involved.
+* :class:`AutoscalePolicy` — scale decisions driven by the PR-6 gauges
+  the cluster already exports (router queue depth = backlog, decode
+  occupancy): sustained backlog at high occupancy asks for a join,
+  sustained idleness asks for a drain, both rate-limited by
+  ``cooldown_ms`` on the same shared clock. The policy only *decides*;
+  :class:`~apex_tpu.serve.cluster.cluster.ServeCluster` acts (spawning
+  a :class:`~apex_tpu.serve.cluster.workers.DecodeWorker` or draining
+  the least-loaded one).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+from apex_tpu.monitor.events import EventLog
+
+__all__ = ["ALIVE", "DRAINING", "DEAD", "AutoscalePolicy",
+           "ClusterMembership", "WorkerRecord"]
+
+ALIVE = "alive"
+DRAINING = "draining"
+DEAD = "dead"
+_STATES = (ALIVE, DRAINING, DEAD)
+
+
+@dataclasses.dataclass
+class WorkerRecord:
+    """One worker's membership state. ``reason`` records why it left
+    (``"preempted"`` / ``"killed"`` / ``"heartbeat"`` / ``"stall"`` /
+    ``"scale_down"`` / ``"drained"``)."""
+
+    name: str
+    kind: str                      # "prefill" | "decode"
+    state: str = ALIVE
+    joined_ms: float = 0.0
+    last_beat_ms: float = 0.0
+    left_ms: Optional[float] = None
+    reason: Optional[str] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class AutoscalePolicy:
+    """When to grow or shrink the decode set. A join is asked for when
+    the router backlog exceeds ``scale_up_queue_depth`` AND decode
+    occupancy exceeds ``scale_up_occupancy`` (backlog alone could be a
+    prefill bottleneck — adding decode hosts would not help); a drain is
+    asked for when the queue is empty and occupancy sits below
+    ``scale_down_occupancy``. ``cooldown_ms`` rate-limits decisions on
+    the shared clock; ``min_decode`` / ``max_decode`` bound the fleet."""
+
+    scale_up_queue_depth: int = 8
+    scale_up_occupancy: float = 0.75
+    scale_down_occupancy: float = 0.15
+    min_decode: int = 1
+    max_decode: int = 8
+    cooldown_ms: float = 1000.0
+
+    def validate(self) -> None:
+        if self.min_decode < 1:
+            raise ValueError("min_decode must be >= 1")
+        if self.max_decode < self.min_decode:
+            raise ValueError("max_decode must be >= min_decode")
+        if not (0.0 <= self.scale_down_occupancy
+                < self.scale_up_occupancy <= 1.0):
+            raise ValueError(
+                "need 0 <= scale_down_occupancy < scale_up_occupancy <= 1")
+        if self.scale_up_queue_depth < 1:
+            raise ValueError("scale_up_queue_depth must be >= 1")
+        if self.cooldown_ms < 0:
+            raise ValueError("cooldown_ms must be >= 0")
+
+
+class ClusterMembership:
+    """The cluster's health ledger: join/beat/drain/dead transitions,
+    heartbeat-miss detection, and the autoscale decision — all on the
+    one shared clock, all evented."""
+
+    def __init__(self, heartbeat_timeout_ms: Optional[float] = None,
+                 events: Optional[EventLog] = None,
+                 autoscale: Optional[AutoscalePolicy] = None):
+        if heartbeat_timeout_ms is not None and heartbeat_timeout_ms <= 0:
+            raise ValueError("heartbeat_timeout_ms must be > 0 when given")
+        if autoscale is not None:
+            autoscale.validate()
+        self.heartbeat_timeout_ms = heartbeat_timeout_ms
+        self.autoscale_policy = autoscale
+        self._events = events
+        self._workers: Dict[str, WorkerRecord] = {}
+        self.joins = 0
+        self.leaves = 0
+        self.worker_deaths = 0        # dead for a non-voluntary reason
+        self.heartbeat_misses = 0
+        self.autoscale_ups = 0
+        self.autoscale_downs = 0
+        self._last_scale_ms: Optional[float] = None
+
+    # -- transitions -------------------------------------------------------
+    def join(self, name: str, kind: str, t_ms: float) -> WorkerRecord:
+        if name in self._workers and self._workers[name].state != DEAD:
+            raise ValueError(f"worker {name!r} already joined")
+        rec = WorkerRecord(name=name, kind=kind, joined_ms=float(t_ms),
+                           last_beat_ms=float(t_ms))
+        self._workers[name] = rec
+        self.joins += 1
+        if self._events is not None:
+            self._events.emit("worker_join", t_ms=t_ms, worker=name,
+                              worker_kind=kind)
+        return rec
+
+    def beat(self, name: str, t_ms: float) -> None:
+        rec = self._workers[name]
+        if rec.state != DEAD:
+            rec.last_beat_ms = float(t_ms)
+
+    def mark_draining(self, name: str, t_ms: float, reason: str) -> bool:
+        """alive → draining (idempotent; False if already leaving)."""
+        rec = self._workers[name]
+        if rec.state != ALIVE:
+            return False
+        rec.state = DRAINING
+        rec.reason = reason
+        return True
+
+    def mark_dead(self, name: str, t_ms: float, reason: str) -> bool:
+        """→ dead: out of the dispatch set, ``worker_leave`` emitted.
+        ``reason`` ``"drained"``/``"scale_down"``/``"preempted"`` is a
+        voluntary exit (the drain protocol ran — nothing was lost);
+        anything else counts as a death."""
+        rec = self._workers[name]
+        if rec.state == DEAD:
+            return False
+        rec.state = DEAD
+        rec.left_ms = float(t_ms)
+        rec.reason = reason
+        self.leaves += 1
+        if reason not in ("drained", "scale_down", "preempted"):
+            self.worker_deaths += 1
+        if self._events is not None:
+            self._events.emit("worker_leave", t_ms=t_ms, worker=name,
+                              worker_kind=rec.kind, reason=reason)
+        return True
+
+    # -- queries -----------------------------------------------------------
+    def state(self, name: str) -> str:
+        return self._workers[name].state
+
+    def record(self, name: str) -> WorkerRecord:
+        return self._workers[name]
+
+    def is_dispatchable(self, name: str) -> bool:
+        """Only ALIVE workers receive new work."""
+        return self._workers[name].state == ALIVE
+
+    def names(self, kind: Optional[str] = None,
+              state: Optional[str] = None) -> List[str]:
+        return [n for n, r in self._workers.items()
+                if (kind is None or r.kind == kind)
+                and (state is None or r.state == state)]
+
+    # -- failure detection -------------------------------------------------
+    def check_heartbeats(self, t_ms: float,
+                         beat_floor_ms: Optional[float] = None
+                         ) -> List[str]:
+        """Declare workers dead whose last beat is older than the
+        timeout; returns the newly-dead names (the cluster migrates
+        their requests). No-op when detection is off.
+
+        ``beat_floor_ms`` guards against the self-inflicted outage a
+        wall clock invites: a single SLOW tick (a fresh worker's first
+        compile, one long prefill chunk) would otherwise age EVERY
+        worker's beat past the timeout at once and the detector would
+        kill the whole healthy fleet. The cluster passes the previous
+        tick's start time — a worker that beat during that tick had its
+        chance and took it, so only workers that actually MISSED a full
+        tick opportunity (chaos-stalled, wedged) are eligible, no
+        matter how much wall time one tick burned."""
+        if self.heartbeat_timeout_ms is None:
+            return []
+        newly_dead = []
+        for name, rec in self._workers.items():
+            if rec.state == DEAD:
+                continue
+            if (beat_floor_ms is not None
+                    and rec.last_beat_ms >= beat_floor_ms):
+                continue
+            if t_ms - rec.last_beat_ms >= self.heartbeat_timeout_ms:
+                self.heartbeat_misses += 1
+                self.mark_dead(name, t_ms, "heartbeat")
+                newly_dead.append(name)
+        return newly_dead
+
+    # -- autoscale ---------------------------------------------------------
+    def autoscale_decision(self, queue_depth: int, occupancy: float,
+                           t_ms: float) -> Optional[str]:
+        """``"up"`` / ``"down"`` / None from the policy against the
+        live backlog/occupancy gauges, cooldown-limited. The caller
+        performs the action and the resulting join/drain is what shows
+        up in the ledger — a decision during cooldown is simply not
+        made."""
+        pol = self.autoscale_policy
+        if pol is None:
+            return None
+        if (self._last_scale_ms is not None
+                and t_ms - self._last_scale_ms < pol.cooldown_ms):
+            return None
+        n_alive = len(self.names(kind="decode", state=ALIVE))
+        if (queue_depth >= pol.scale_up_queue_depth
+                and occupancy >= pol.scale_up_occupancy
+                and n_alive < pol.max_decode):
+            self._last_scale_ms = float(t_ms)
+            self.autoscale_ups += 1
+            return "up"
+        if (queue_depth == 0 and occupancy <= pol.scale_down_occupancy
+                and n_alive > pol.min_decode):
+            self._last_scale_ms = float(t_ms)
+            self.autoscale_downs += 1
+            return "down"
+        return None
+
+    # -- reporting ---------------------------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        by_state = {s: 0 for s in _STATES}
+        for r in self._workers.values():
+            by_state[r.state] += 1
+        return {
+            # timestamp keys deliberately avoid the "_ms" suffix: these
+            # are clock POSITIONS, not latencies — monitor.regress would
+            # otherwise gate them lower-is-better and flag every fresh
+            # run as a regression
+            "workers": {
+                n: {"kind": r.kind, "state": r.state,
+                    "reason": r.reason,
+                    "joined_at": round(r.joined_ms, 3),
+                    "last_beat_at": round(r.last_beat_ms, 3),
+                    "left_at": (round(r.left_ms, 3)
+                                if r.left_ms is not None else None)}
+                for n, r in sorted(self._workers.items())},
+            "alive": by_state[ALIVE],
+            "draining": by_state[DRAINING],
+            "dead": by_state[DEAD],
+            "joins": self.joins,
+            "leaves": self.leaves,
+            "worker_deaths": self.worker_deaths,
+            "heartbeat_misses": self.heartbeat_misses,
+            "autoscale_ups": self.autoscale_ups,
+            "autoscale_downs": self.autoscale_downs,
+        }

@@ -52,11 +52,21 @@ class RouterConfig:
     tenant_weights: Optional[Mapping[str, float]] = None
     shed_headroom: float = 1.0
     hist_spec: Optional[HistSpec] = None
+    # bound on per-tenant state (vtime + counters) the router retains.
+    # IDLE tenants (empty queue) beyond the bound are garbage-collected
+    # least-recently-seen first — WFQ-safe, because a re-activating
+    # tenant restarts at the global virtual clock either way. Without
+    # the bound, a tenant whose every request was shed leaves its vtime
+    # and counter entries behind forever (millions of one-shot tenants
+    # = an unbounded host-side leak). None disables.
+    max_tenant_states: Optional[int] = 1024
 
     def validate(self) -> None:
         self.slo.validate()
         if self.shed_headroom <= 0:
             raise ValueError("shed_headroom must be positive")
+        if self.max_tenant_states is not None and self.max_tenant_states < 1:
+            raise ValueError("max_tenant_states must be >= 1 when given")
         for t, w in (self.tenant_weights or {}).items():
             if w <= 0:
                 raise ValueError(f"tenant {t!r} weight must be positive, "
@@ -97,12 +107,50 @@ class Router:
         self.admitted = 0
         self.shed = 0
         self.tenants: Dict[str, Dict[str, int]] = {}
-        self.sheds: List[ShedDecision] = []
+        # recent shed decisions, bounded like the tenant tables (the
+        # cluster keeps its own terminal-state map; this is a debugging
+        # window, not the ledger — self.shed is the count)
+        self.sheds: collections.deque = collections.deque(
+            maxlen=self.cfg.max_tenant_states)
+        # tenant-state GC bookkeeping: last time each tenant was seen,
+        # plus an aggregate bucket the evicted tenants' counters fold
+        # into (top-level submitted/admitted/shed totals never lose
+        # requests to eviction)
+        self._last_seen: Dict[str, float] = {}
+        self.tenants_evicted = 0
+        self._evicted_totals = {"submitted": 0, "admitted": 0, "shed": 0}
+        self.requeued = 0
 
     # -- accounting --------------------------------------------------------
     def _tenant(self, name: str) -> Dict[str, int]:
         return self.tenants.setdefault(
             name, {"submitted": 0, "admitted": 0, "shed": 0})
+
+    def _gc_tenants(self) -> None:
+        """Bound the per-tenant state tables: beyond
+        ``cfg.max_tenant_states``, IDLE tenants (no queued requests) are
+        evicted least-recently-seen first — their counters fold into the
+        aggregate eviction bucket, their vtime is dropped (safe: a
+        returning tenant restarts at the global virtual clock, exactly
+        like any newly-seen tenant). Tenants with queued work are never
+        evicted."""
+        limit = self.cfg.max_tenant_states
+        if limit is None:
+            return
+        known = set(self.tenants) | set(self._vtime)
+        if len(known) <= limit:
+            return
+        idle = [t for t in known if not self._queues.get(t)]
+        idle.sort(key=lambda t: self._last_seen.get(t, 0.0))
+        for t in idle[: len(known) - limit]:
+            self._vtime.pop(t, None)
+            self._queues.pop(t, None)
+            self._last_seen.pop(t, None)
+            rec = self.tenants.pop(t, None)
+            if rec is not None:
+                for k in self._evicted_totals:
+                    self._evicted_totals[k] += rec.get(k, 0)
+            self.tenants_evicted += 1
 
     def _weight(self, tenant: str) -> float:
         if self.cfg.tenant_weights is None:
@@ -132,9 +180,12 @@ class Router:
         self.submitted += 1
         rec = self._tenant(tenant)
         rec["submitted"] += 1
+        self._last_seen[tenant] = float(t_ms)
         if (max_servable_tokens is not None and total_tokens is not None
                 and total_tokens > max_servable_tokens):
-            return self._shed(request, tenant, "unservable", None, t_ms)
+            d = self._shed(request, tenant, "unservable", None, t_ms)
+            self._gc_tenants()
+            return d
         q = self._queues.setdefault(tenant, collections.deque())
         if not q:
             # tenant is (re-)activating: start at the global virtual
@@ -144,7 +195,69 @@ class Router:
             self._vtime[tenant] = max(self._vtime.get(tenant, 0.0),
                                       self._vclock)
         q.append((request, float(t_ms)))
+        self._gc_tenants()
         return None
+
+    def requeue(self, request: Request, t_submit_ms: float) -> None:
+        """Put an already-admitted request BACK at the head of its
+        tenant's queue with its original submit time (the drain /
+        worker-death path: a prompt staged on a dying prefill host
+        re-enters dispatch without double-counting submission, and its
+        queue-wait keeps accruing from the true arrival — SLO accounting
+        stays honest)."""
+        tenant = getattr(request, "tenant", "default")
+        q = self._queues.setdefault(tenant, collections.deque())
+        q.appendleft((request, float(t_submit_ms)))
+        # the earlier dispatch is void (its prefill never finished): undo
+        # its admitted counts so submitted == admitted + shed + queued
+        # stays an invariant across worker deaths, and REFUND its WFQ
+        # vtime charge — re-dispatch will charge again, and without the
+        # refund the tenant would pay twice for one request and fall
+        # under its weighted share. No vclock floor on the refund: this
+        # is a voided dispatch, not a tenant re-activating after idling.
+        # A tenant GC-evicted while its request was in flight has no
+        # vtime left to refund — it re-activates at the global clock
+        # like any newly-seen tenant (no queue jumping), and counters
+        # are floored (its history already folded into the eviction
+        # bucket).
+        if tenant in self._vtime:
+            self._vtime[tenant] = max(
+                0.0, self._vtime[tenant]
+                - len(request.tokens) / self._weight(tenant))
+        else:
+            self._vtime[tenant] = self._vclock
+        self._last_seen[tenant] = max(
+            self._last_seen.get(tenant, 0.0), float(t_submit_ms))
+        self.admitted = max(0, self.admitted - 1)
+        rec = self._tenant(tenant)
+        rec["admitted"] = max(0, rec["admitted"] - 1)
+        self.requeued += 1
+
+    def shed_admitted(self, request: Request, reason: str,
+                      t_ms: float) -> ShedDecision:
+        """Terminal failure of an ADMITTED request downstream of the
+        router (transfer retry ladder ran dry, no decode worker left to
+        serve it): move it from the admitted column to the shed column
+        so the ledger stays exact — ``submitted == admitted + shed +
+        queued`` holds across every failure mode, and ``shed_rate``
+        (the regress-gated headline) reflects the loss."""
+        tenant = getattr(request, "tenant", "default")
+        self.admitted = max(0, self.admitted - 1)
+        rec = self._tenant(tenant)
+        rec["admitted"] = max(0, rec["admitted"] - 1)
+        return self._shed(request, tenant, reason, None, t_ms)
+
+    def shed_queued(self, reason: str, t_ms: float) -> List[ShedDecision]:
+        """Shed EVERY queued request (the cluster's fatal-by-config
+        path: no decode worker can ever serve them) through the normal
+        shed accounting; returns the decisions, queues left empty."""
+        out: List[ShedDecision] = []
+        for tenant, q in self._queues.items():
+            while q:
+                request, _ = q.popleft()
+                out.append(self._shed(request, tenant, reason, t_ms=t_ms,
+                                      predicted=None))
+        return out
 
     def _shed(self, request: Request, tenant: str, reason: str,
               predicted: Optional[float], t_ms: float) -> ShedDecision:
@@ -209,6 +322,8 @@ class Router:
                 continue
             self.admitted += 1
             self._tenant(tenant)["admitted"] += 1
+            self._last_seen[tenant] = max(
+                self._last_seen.get(tenant, 0.0), float(t_ms))
             self._vtime[tenant] += len(request.tokens) / self._weight(tenant)
             # the served tenant had the MINIMUM vtime, so tracking it
             # keeps the clock monotone
@@ -224,9 +339,12 @@ class Router:
             "shed": self.shed,
             "shed_rate": (round(self.shed / self.submitted, 4)
                           if self.submitted else None),
+            "requeued": self.requeued,
             "queue_depth": self.queue_depth,
             "queued_tokens": self.queued_tokens(),
             "prefill_ms_per_token_p50": (round(mpt, 4)
                                          if mpt is not None else None),
             "tenants": {t: dict(v) for t, v in sorted(self.tenants.items())},
+            "tenants_evicted": self.tenants_evicted,
+            "evicted_totals": dict(self._evicted_totals),
         }

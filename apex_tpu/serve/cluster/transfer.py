@@ -183,6 +183,37 @@ def payload_nbytes(payload: Dict[str, Any], n_blocks: int) -> int:
     return total
 
 
+def payload_crc32(payload: Dict[str, Any]) -> int:
+    """crc32 over a packed payload's bytes (leaves in sorted-name order)
+    — stamped on every :class:`~apex_tpu.serve.cluster.workers.KVHandoff`
+    at pack time and re-checked at delivery, so a corrupted transfer is
+    DETECTED and re-requested instead of silently diverging the stream
+    (the ``resilience.checkpoint`` per-leaf-crc discipline applied to
+    the wire)."""
+    import zlib
+
+    crc = 0
+    for name in sorted(payload):
+        a = np.ascontiguousarray(np.asarray(payload[name]))
+        crc = zlib.crc32(a.tobytes(), crc)
+    return crc
+
+
+def corrupt_payload(payload: Dict[str, Any]) -> Dict[str, Any]:
+    """Bit-rot a COPY of a payload (first leaf, middle bytes XOR-flipped
+    — the ``resilience.chaos.corrupt_file`` "flip" mode applied to a
+    wire payload). The original is untouched: the sender's retry copy
+    must survive the corruption of the bytes on the wire."""
+    out = {k: np.array(np.asarray(v), copy=True)
+           for k, v in payload.items()}
+    name = sorted(out)[0]
+    flat = out[name].reshape(-1).view(np.uint8)
+    off = flat.size // 2
+    n = min(64, flat.size - off)
+    flat[off:off + n] ^= 0xFF
+    return out
+
+
 # ---------------------------------------------------------------------------
 # Real-mesh hop: the ppermute primitive the decomposed comm.overlap rings
 # are built from, applied to a whole block payload. Runs inside a
@@ -209,16 +240,27 @@ def ppermute_blocks(payload: Pytree, axis_name: str,
 
 @dataclasses.dataclass
 class Delivery:
-    """One in-flight handoff: the opaque item plus its wire accounting."""
+    """One in-flight handoff: the opaque item plus its wire accounting.
+    ``corrupted`` marks a fault-injected delivery whose payload bytes
+    must be treated as rotted at the receiver (the receiver's CRC check
+    is what must catch it); ``dropped`` marks a send the link ate."""
 
     item: Any
     wire_bytes: int
     t_send_ms: float
     t_deliver_ms: float
+    corrupted: bool = False
+    dropped: bool = False
 
     @property
     def transfer_ms(self) -> float:
         return self.t_deliver_ms - self.t_send_ms
+
+
+# deterministic link fault modes (serve.cluster.chaos injects these):
+# drop — the send never arrives; stall — delivery delayed stall_ms;
+# corrupt — the payload bytes rot on the wire (CRC must catch it)
+FAULT_MODES = ("drop", "stall", "corrupt")
 
 
 class SimTransport:
@@ -228,7 +270,16 @@ class SimTransport:
     link bandwidth (0 disables the byte term — instant delivery, the
     deterministic test default). Totals (``wire_bytes_total``,
     ``transfer_ms_total``, ``transfers_total``) feed the cluster's
-    transfer telemetry."""
+    transfer telemetry.
+
+    **Fault injection** (the chaos harness's link half):
+    :meth:`inject_fault` queues deterministic faults consumed by the
+    NEXT sends, in order — ``drop`` (the delivery never happens),
+    ``stall`` (delivery delayed ``stall_ms``) and ``corrupt`` (delivery
+    arrives flagged ``corrupted`` — the receiver's CRC validation, not
+    the transport, is what must notice). Fault counters
+    (``drops_total`` / ``stalls_total`` / ``corrupts_total``) feed the
+    chaos record."""
 
     def __init__(self, fixed_ms: float = 0.0, gib_per_s: float = 0.0):
         if fixed_ms < 0 or gib_per_s < 0:
@@ -236,9 +287,29 @@ class SimTransport:
         self.fixed_ms = float(fixed_ms)
         self.gib_per_s = float(gib_per_s)
         self._inflight: List[Delivery] = []
+        self._faults: List[Tuple[str, float]] = []
         self.wire_bytes_total = 0
         self.transfer_ms_total = 0.0
         self.transfers_total = 0
+        self.drops_total = 0
+        self.stalls_total = 0
+        self.corrupts_total = 0
+
+    def inject_fault(self, mode: str, count: int = 1,
+                     stall_ms: float = 0.0) -> None:
+        """Queue ``count`` link faults for the next sends (FIFO)."""
+        if mode not in FAULT_MODES:
+            raise ValueError(
+                f"fault mode must be one of {FAULT_MODES}, got {mode!r}")
+        if count < 1:
+            raise ValueError("count must be >= 1")
+        if mode == "stall" and stall_ms <= 0:
+            raise ValueError("stall fault needs stall_ms > 0")
+        self._faults.extend([(mode, float(stall_ms))] * int(count))
+
+    @property
+    def pending_faults(self) -> int:
+        return len(self._faults)
 
     def modeled_ms(self, wire_bytes: int) -> float:
         ms = self.fixed_ms
@@ -250,10 +321,30 @@ class SimTransport:
         d = Delivery(item=item, wire_bytes=int(wire_bytes),
                      t_send_ms=float(t_ms),
                      t_deliver_ms=float(t_ms) + self.modeled_ms(wire_bytes))
-        self._inflight.append(d)
+        if self._faults:
+            mode, stall_ms = self._faults.pop(0)
+            if mode == "drop":
+                # the bytes transited the wire, but no transfer
+                # completed: count the bytes and the drop, not a
+                # delivery — transfers_total must not overstate link
+                # health under the exact chaos plans the gate compares
+                d.dropped = True
+                self.drops_total += 1
+                self.wire_bytes_total += d.wire_bytes
+                return d  # eaten: never enters the in-flight set
+            if mode == "stall":
+                d.t_deliver_ms += stall_ms
+                self.stalls_total += 1
+            elif mode == "corrupt":
+                d.corrupted = True
+                self.corrupts_total += 1
+        # totals AFTER fault application: a stalled delivery's extra
+        # latency belongs in transfer_ms_total (it agrees with the
+        # per-delivery transfer_ms the receiver histograms)
         self.wire_bytes_total += d.wire_bytes
         self.transfer_ms_total += d.transfer_ms
         self.transfers_total += 1
+        self._inflight.append(d)
         return d
 
     def poll(self, t_ms: float) -> List[Delivery]:

@@ -44,9 +44,11 @@ import jax.numpy as jnp
 from apex_tpu.monitor.events import EventLog
 from apex_tpu.monitor.slo import SloSpec
 from apex_tpu.monitor.trace import span
+from apex_tpu.resilience.preemption import PreemptionHandler
 from apex_tpu.serve.cluster.transfer import (
     insert_blocks,
     pack_blocks,
+    payload_crc32,
     payload_nbytes,
     transfer_wire_bytes,
     validate_wire_mode,
@@ -56,7 +58,6 @@ from apex_tpu.serve.engine import (
     InferenceEngine,
     Request,
     ServeConfig,
-    _SlotState,
 )
 from apex_tpu.serve.kv_cache import (
     BlockAllocator,
@@ -74,7 +75,19 @@ class KVHandoff:
     the packed KV payload (host numpy, trimmed to ``n_blocks`` valid
     blocks), the first sampled token, and the request's timeline so far
     (ms on the cluster's one clock — retirement folds these into the
-    decode engine's histograms/SLO tracker unchanged)."""
+    decode engine's histograms/SLO tracker unchanged).
+
+    The elastic tier ships a second kind over the same wire:
+    ``kind="migration"`` carries a LIVE request mid-decode off a dying
+    or draining worker — ``seq_len`` context tokens already written
+    (``n_blocks`` holds exactly those), the ``generated`` stream so far
+    and the ``last_token`` to feed next, so the destination resumes the
+    stream bitwise. ``acked_tokens`` is the client-delivered watermark:
+    tokens past it are re-emitted on arrival (the ``replay`` event) so a
+    mid-flight failure never loses the unacked tail. ``crc32``
+    (:func:`~apex_tpu.serve.cluster.transfer.payload_crc32`) guards BOTH
+    kinds: a transfer that rots on the wire is detected at delivery and
+    re-requested instead of silently diverging the stream."""
 
     request: Request
     payload: Dict[str, np.ndarray]
@@ -86,6 +99,12 @@ class KVHandoff:
     queue_ms: float
     t_first_ms: float
     ttft_ms: float
+    kind: str = "prefill"              # "prefill" | "migration"
+    seq_len: Optional[int] = None      # migration: context tokens written
+    last_token: Optional[int] = None   # migration: next token to feed
+    generated: Optional[List[int]] = None   # migration: stream so far
+    acked_tokens: Optional[int] = None      # migration: delivered watermark
+    crc32: Optional[int] = None
 
 
 def _cache_size_of(jitted) -> Optional[int]:
@@ -106,11 +125,18 @@ class PrefillWorker:
                  events: Optional[EventLog] = None,
                  now_ms: Optional[Callable[[], float]] = None,
                  queue_limit: int = 1, use_pallas: Optional[bool] = None,
+                 preemption: Optional[PreemptionHandler] = None,
                  name: str = "prefill0"):
         serve_cfg.validate()
         validate_wire_mode(wire_mode)
         if queue_limit < 0:
             raise ValueError("queue_limit must be >= 0")
+        # every worker owns a PreemptionHandler: a real deployment
+        # installs it on SIGTERM (install=True in the worker process);
+        # the in-process cluster polls the flag each tick and the chaos
+        # harness fires trigger() — the same code path either way
+        self.preemption = (preemption if preemption is not None
+                           else PreemptionHandler(install=False))
         self.cfg = cfg
         self.serve_cfg = serve_cfg
         self.wire_mode = wire_mode
@@ -191,6 +217,30 @@ class PrefillWorker:
     def compile_counts(self) -> Dict[str, Optional[int]]:
         return {"chunk_prefill": _cache_size_of(self._chunk_prefill),
                 "extract": _cache_size_of(self._extract)}
+
+    # -- drain / failure (the elastic tier) --------------------------------
+    def drain_queued(self) -> List:
+        """Hand back every accepted-but-unstarted ``(request,
+        t_submit_ms)`` — the drain protocol's re-enqueue-at-the-router
+        half. The mid-prefill request (if any) is NOT included: a
+        draining worker finishes it (cheap, and its staging state is
+        useless anywhere else)."""
+        out = list(self._queue)
+        self._queue.clear()
+        return out
+
+    def abort_current(self) -> Optional[Any]:
+        """Abandon the mid-prefill request (the KILL path — no grace to
+        finish): frees its staging blocks and returns its ``(request,
+        t_submit_ms)`` for router re-enqueue, or None when idle. Prefill
+        is deterministic, so a restart from scratch on another host
+        reproduces the same stream."""
+        cur = self._current
+        if cur is None:
+            return None
+        self.allocator.free(cur["blocks"])
+        self._current = None
+        return (cur["request"], cur["t_submit_ms"])
 
     # -- stepping ----------------------------------------------------------
     def _start_next(self) -> None:
@@ -274,7 +324,8 @@ class PrefillWorker:
             request=cur["request"], payload=payload, n_blocks=n_blocks,
             prompt_len=p, first_token=first, wire_bytes=wire,
             t_submit_ms=cur["t_submit_ms"], queue_ms=cur["queue_ms"],
-            t_first_ms=t_first, ttft_ms=t_first - cur["t_submit_ms"])
+            t_first_ms=t_first, ttft_ms=t_first - cur["t_submit_ms"],
+            crc32=payload_crc32(payload))
 
 
 class DecodeWorker:
@@ -291,10 +342,13 @@ class DecodeWorker:
                  on_retire: Optional[Callable[[str, List[int]], None]] = None,
                  use_pallas: Optional[bool] = None,
                  peak_flops_per_s: Optional[float] = None,
+                 preemption: Optional[PreemptionHandler] = None,
                  name: str = "decode0"):
         validate_wire_mode(wire_mode)
         self.name = name
         self.wire_mode = wire_mode
+        self.preemption = (preemption if preemption is not None
+                           else PreemptionHandler(install=False))
         self.engine = InferenceEngine(
             params, cfg, serve_cfg, base_key=base_key, sink=sink,
             events=events, slo=slo, retain_streams=retain_streams,
@@ -303,6 +357,9 @@ class DecodeWorker:
         self._events = events
         self._pending: collections.deque = collections.deque()
         self.admitted = 0
+        self.migrations_in = 0
+        self.migrations_out = 0
+        self.replayed_tokens = 0
         kv_cfg = self.engine.kv_cfg
 
         def insert(cache, payload, dst_ids):
@@ -327,7 +384,25 @@ class DecodeWorker:
         out["insert"] = _cache_size_of(self._insert)
         return out
 
+    def _land_payload(self, h: KVHandoff, blocks: List[int]) -> None:
+        """Run the ONE compiled insert: destination ids padded out of
+        range (insert drops them), payload zero-padded to the fixed
+        shape."""
+        eng = self.engine
+        nbp = h.n_blocks
+        bpp = eng._blocks_per_slot
+        dst = np.full((bpp,), eng.kv_cfg.num_blocks, np.int32)
+        dst[:nbp] = blocks[:nbp]
+        payload = {}
+        for k, arr in h.payload.items():
+            pad = np.zeros(arr.shape[:2] + (bpp - nbp,) + arr.shape[3:],
+                           arr.dtype)
+            payload[k] = jnp.asarray(np.concatenate([arr, pad], axis=2))
+        eng.cache = self._insert(eng.cache, payload, jnp.asarray(dst))
+
     def _install(self, h: KVHandoff) -> bool:
+        if h.kind == "migration":
+            return self._install_migration(h)
         eng = self.engine
         slot = eng._free_slot()
         if slot is None:
@@ -338,53 +413,130 @@ class DecodeWorker:
         blocks = eng.allocator.alloc(n_blocks)
         if blocks is None:
             return False
-        nbp = h.n_blocks
-        bpp = eng._blocks_per_slot
-        # destination ids padded out of range (insert drops them), payload
-        # zero-padded to the one compiled insert shape
-        dst = np.full((bpp,), eng.kv_cfg.num_blocks, np.int32)
-        dst[:nbp] = blocks[:nbp]
-        payload = {}
-        for k, arr in h.payload.items():
-            pad = np.zeros(arr.shape[:2] + (bpp - nbp,) + arr.shape[3:],
-                           arr.dtype)
-            payload[k] = jnp.asarray(np.concatenate([arr, pad], axis=2))
-        eng.cache = self._insert(eng.cache, payload, jnp.asarray(dst))
-        state = _SlotState(
-            request=h.request, blocks=blocks,
-            generated=[h.first_token],
-            history=[int(t) for t in h.request.tokens] + [h.first_token],
-            prompt_len=h.prompt_len, prefill_pos=h.prompt_len,
-            cached_tokens=0, pending_commits=[],
-            t_submit_ms=h.t_submit_ms, t_first_ms=h.t_first_ms,
-            queue_ms=h.queue_ms, ttft_ms=h.ttft_ms,
-            chunk_start_ms=h.t_first_ms, chunk_done=1)
-        eng._slots[slot] = state
-        row = np.zeros((bpp,), np.int32)
-        row[:len(blocks)] = blocks
-        eng._block_tables[slot] = row
-        eng._keys[slot] = np.asarray(
-            request_key(eng._base_key, h.request.sampling_seed()),
-            np.uint32)
-        eng._seq_lens[slot] = h.prompt_len
-        eng._last_tokens[slot] = h.first_token
-        eng._active[slot] = True
-        eng._dirty("block_tables", "keys", "seq_lens", "last_tokens",
-                   "active")
-        if eng._t_start is None:
-            eng._t_start = time.perf_counter()
-        eng._tokens_generated += 1
+        self._land_payload(h, blocks)
+        # ONE slot-install implementation: the engine's restore_slot is
+        # the canonical grid-state writer for handoff admission AND
+        # migration — a prefill handoff is just a restore whose stream
+        # is one token long
+        record = {
+            "request": h.request, "blocks": blocks,
+            "generated": [h.first_token],
+            "history": [int(t) for t in h.request.tokens] + [h.first_token],
+            "prompt_len": h.prompt_len, "cached_tokens": 0,
+            "seq_len": h.prompt_len, "last_token": h.first_token,
+            "t_submit_ms": h.t_submit_ms, "t_first_ms": h.t_first_ms,
+            "queue_ms": h.queue_ms, "ttft_ms": h.ttft_ms,
+        }
+        slot = eng.restore_slot(record, blocks=blocks)
+        eng._tokens_generated += 1  # the first token rode the handoff
         self.admitted += 1
         if self._events is not None:
             self._events.emit("admitted", h.request.uid,
                               t_ms=self.engine._now_ms(), host=self.name,
                               slot=slot, queue_ms=round(h.queue_ms, 3))
-            self._events.gauge("occupancy", eng.occupancy())
         # a 1-token request (or an immediate EOS) retires without ever
         # reaching the decode grid — same as the engine's prefill tail
+        state = eng._slots[slot]
         if eng._should_retire(state, h.first_token):
             eng._retire(slot)
         return True
+
+    # -- migration (the elastic tier) --------------------------------------
+    def _install_migration(self, h: KVHandoff) -> bool:
+        """Land a migrated LIVE request: transferred blocks into fresh
+        pool blocks, the slot reinstalled exactly as
+        :meth:`~apex_tpu.serve.engine.InferenceEngine.restore_slot`
+        would locally, and the unacked tail of the stream re-emitted
+        (the ``replay`` event) so the client never loses a token to the
+        failure. Bitwise resumption for free: the blocks are the pool
+        representation (verbatim for quantized pools), the sampling key
+        is request-intrinsic, and every draw is position-keyed."""
+        eng = self.engine
+        if eng._free_slot() is None:
+            return False
+        total = min(h.prompt_len + h.request.max_new_tokens,
+                    eng.max_context)
+        blocks = eng.allocator.alloc(eng.kv_cfg.blocks_for_tokens(total))
+        if blocks is None:
+            return False
+        self._land_payload(h, blocks)
+        generated = list(h.generated or [])
+        record = {
+            "request": h.request, "blocks": blocks,
+            "generated": generated,
+            "history": [int(t) for t in h.request.tokens] + generated,
+            "prompt_len": h.prompt_len, "cached_tokens": 0,
+            "seq_len": h.seq_len, "last_token": h.last_token,
+            "t_submit_ms": h.t_submit_ms, "t_first_ms": h.t_first_ms,
+            "queue_ms": h.queue_ms, "ttft_ms": h.ttft_ms,
+        }
+        slot = eng.restore_slot(record, blocks=blocks)
+        self.admitted += 1
+        self.migrations_in += 1
+        acked = (h.acked_tokens if h.acked_tokens is not None
+                 else max(0, len(generated) - 1))
+        replayed = len(generated) - acked
+        self.replayed_tokens += replayed
+        if self._events is not None:
+            now = eng._now_ms()
+            self._events.emit("migrate_end", h.request.uid, t_ms=now,
+                              host=self.name, slot=slot,
+                              n_blocks=h.n_blocks, seq_len=h.seq_len)
+            if replayed > 0:
+                self._events.emit("replay", h.request.uid, t_ms=now,
+                                  host=self.name, n_tokens=replayed)
+            # re-admitted on the new host: the slot-residency track gets
+            # the fresh slot; request_spans anchors on the FIRST
+            # admitted, so the queued span is untouched
+            self._events.emit("admitted", h.request.uid, t_ms=now,
+                              host=self.name, slot=slot, migrated=True,
+                              queue_ms=round(h.queue_ms, 3))
+            self._events.gauge("occupancy", eng.occupancy())
+        return True
+
+    def evict_to_handoff(self, uid: str, extract_fn) -> KVHandoff:
+        """Evict one live slot and pack it as a ``kind="migration"``
+        handoff: the written-context blocks through ``extract_fn`` (the
+        cluster's ONE jitted extract program — migration mints no new
+        compilations), trimmed, CRC-stamped, blocks freed back to this
+        worker's pool. The caller ships it over the same wire a prefill
+        handoff takes."""
+        eng = self.engine
+        rec = eng.evict_slot(uid)
+        kv = eng.kv_cfg
+        n_blocks = kv.blocks_for_tokens(rec["seq_len"])
+        bpp = eng._blocks_per_slot
+        ids = np.full((bpp,), rec["blocks"][0], np.int32)
+        ids[:n_blocks] = rec["blocks"][:n_blocks]
+        payload_dev = extract_fn(eng.cache, jnp.asarray(ids))
+        payload = {k: np.asarray(v)[:, :, :n_blocks]
+                   for k, v in payload_dev.items()}
+        eng.allocator.free(rec["blocks"])
+        wire = transfer_wire_bytes(kv, n_blocks, self.wire_mode)
+        assert payload_nbytes(payload, n_blocks) == wire
+        gen = rec["generated"]
+        self.migrations_out += 1
+        return KVHandoff(
+            request=rec["request"], payload=payload, n_blocks=n_blocks,
+            prompt_len=rec["prompt_len"],
+            first_token=gen[0] if gen else rec["last_token"],
+            wire_bytes=wire, t_submit_ms=rec["t_submit_ms"],
+            queue_ms=rec["queue_ms"], t_first_ms=rec["t_first_ms"],
+            ttft_ms=rec["ttft_ms"], kind="migration",
+            seq_len=rec["seq_len"], last_token=rec["last_token"],
+            generated=gen, acked_tokens=max(0, len(gen) - 1),
+            crc32=payload_crc32(payload))
+
+    def live_uids(self) -> List[str]:
+        """Requests currently occupying slots (the migration worklist)."""
+        return [s.request.uid for s in self.engine._slots if s is not None]
+
+    def drain_pending(self) -> List[KVHandoff]:
+        """Hand back every not-yet-installed handoff (re-dispatched to a
+        surviving worker by the cluster)."""
+        out = list(self._pending)
+        self._pending.clear()
+        return out
 
     def try_admit(self) -> int:
         """Install as many pending handoffs as currently fit (in arrival
@@ -412,4 +564,7 @@ class DecodeWorker:
         out["host"] = self.name
         out["handoffs_admitted"] = self.admitted
         out["handoffs_pending"] = len(self._pending)
+        out["migrations_in"] = self.migrations_in
+        out["migrations_out"] = self.migrations_out
+        out["replayed_tokens"] = self.replayed_tokens
         return out

@@ -859,6 +859,12 @@ class InferenceEngine:
         if self._on_retire is not None:
             self._on_retire(uid, state.generated)
         self.allocator.free(state.blocks)
+        self._release_slot(slot, now)
+
+    def _release_slot(self, slot: int, now: float) -> None:
+        """Clear one slot's grid state (the shared tail of retirement and
+        eviction — block ownership is the caller's concern: retirement
+        frees, eviction hands the blocks to the evicted record)."""
         self._slots[slot] = None
         self._active[slot] = False
         self._seq_lens[slot] = 0
@@ -867,6 +873,99 @@ class InferenceEngine:
         self._dirty("block_tables", "seq_lens", "last_tokens", "active")
         if self._events is not None:
             self._events.gauge("occupancy", self.occupancy(), t_ms=now)
+
+    # -- live-slot eviction (the migration primitive) ----------------------
+    def evict_slot(self, uid: str) -> Dict[str, Any]:
+        """Extract a LIVE decoding slot's full state and free the slot —
+        the request is neither retired nor forgotten, it is *portable*:
+        :meth:`restore_slot` (here or on another engine with the same
+        model/kv config, after its pool blocks were shipped) resumes the
+        stream bitwise where it stopped, because everything the decode
+        program reads is in the record: the written-context length
+        (``seq_len``), the next token to feed (``last_token``), the
+        request (whose seed reproduces the sampling key), and the block
+        ids holding the K/V.
+
+        The record OWNS the listed blocks: they stay allocated (and
+        refcounted — shared prefix-cache blocks are safe to read) until
+        the caller either restores the slot locally or, after extracting
+        their contents for the wire, releases them with
+        ``engine.allocator.free(record["blocks"])``.
+
+        Only fully-prefilled slots are evictable — a mid-prefill slot
+        has no resumable decode state yet (its prompt is host-side;
+        re-enqueue the request instead)."""
+        for slot, state in enumerate(self._slots):
+            if state is not None and state.request.uid == uid:
+                break
+        else:
+            raise KeyError(f"no occupied slot holds request {uid!r}")
+        if state.prefill_pos < state.prompt_len or not self._active[slot]:
+            raise RuntimeError(
+                f"{uid}: slot is mid-prefill — only decoding slots are "
+                f"evictable (re-enqueue the request instead)")
+        record: Dict[str, Any] = {
+            "request": state.request,
+            "blocks": list(state.blocks),
+            "generated": list(state.generated),
+            "history": list(state.history),
+            "prompt_len": state.prompt_len,
+            "cached_tokens": state.cached_tokens,
+            "seq_len": int(self._seq_lens[slot]),
+            "last_token": int(self._last_tokens[slot]),
+            "t_submit_ms": state.t_submit_ms,
+            "t_first_ms": state.t_first_ms,
+            "queue_ms": state.queue_ms,
+            "ttft_ms": state.ttft_ms,
+        }
+        self._release_slot(slot, self._now_ms())
+        return record
+
+    def restore_slot(self, record: Dict[str, Any],
+                     blocks: Optional[List[int]] = None) -> int:
+        """Re-install an :meth:`evict_slot` record into a free slot.
+        ``blocks=None`` reuses the record's own block ids (local evict +
+        restore is bitwise a no-op — the pool never moved); a migration
+        destination passes the freshly allocated ids its ``insert``
+        program landed the transferred blocks in. Returns the slot
+        index; raises when no slot is free (callers check capacity
+        first — this is an installation primitive, not an admission
+        queue)."""
+        slot = self._free_slot()
+        if slot is None:
+            raise RuntimeError(
+                f"{record['request'].uid}: no free slot to restore into")
+        blocks = list(record["blocks"] if blocks is None else blocks)
+        now = self._now_ms()
+        state = _SlotState(
+            request=record["request"], blocks=blocks,
+            generated=list(record["generated"]),
+            history=list(record["history"]),
+            prompt_len=record["prompt_len"],
+            prefill_pos=record["prompt_len"],
+            cached_tokens=record.get("cached_tokens", 0),
+            pending_commits=[],
+            t_submit_ms=record["t_submit_ms"],
+            t_first_ms=record["t_first_ms"],
+            queue_ms=record["queue_ms"], ttft_ms=record["ttft_ms"],
+            chunk_start_ms=now, chunk_done=len(record["generated"]))
+        self._slots[slot] = state
+        row = np.zeros((self._blocks_per_slot,), np.int32)
+        row[:len(blocks)] = blocks
+        self._block_tables[slot] = row
+        self._keys[slot] = np.asarray(
+            request_key(self._base_key, record["request"].sampling_seed()),
+            np.uint32)
+        self._seq_lens[slot] = record["seq_len"]
+        self._last_tokens[slot] = record["last_token"]
+        self._active[slot] = True
+        self._dirty("block_tables", "keys", "seq_lens", "last_tokens",
+                    "active")
+        if self._t_start is None:
+            self._t_start = time.perf_counter()
+        if self._events is not None:
+            self._events.gauge("occupancy", self.occupancy(), t_ms=now)
+        return slot
 
     # -- speculative drafting ---------------------------------------------
     def _collect_drafts(self) -> Optional[Dict[int, List[int]]]:

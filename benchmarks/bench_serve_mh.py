@@ -22,11 +22,23 @@ ONE ``json_record`` line with:
   colocated engine with the same total decode slots, so the record
   carries what the split bought (or cost) on this hardware.
 
+``--chaos`` adds the ISSUE-13 **goodput-under-chaos** pass: the same
+workload at ``--overload-factor``× (min 2×) with 1 of N decode workers
+KILLED at ``--chaos-kill-step`` — its live requests migrate to the
+survivors over the KV wire and the record carries
+``goodput_under_chaos_rps`` / ``survivor_good_fraction`` (higher-better)
+next to the recovery-noise counters (``migrations_total`` /
+``replayed_tokens`` / ``worker_deaths`` / ``heartbeat_misses`` /
+``transfer_retries``, lower-better). A chaos pass that fails to drain or
+whose kill did not land makes the record ``ok: false``.
+
 Run: ``python benchmarks/bench_serve_mh.py [--hosts 2] [--wire-mode
 int8] [--out FILE]``. ``tpu_watch.sh`` stage 15 banks
 ``SERVE_MH_TPU.json`` from ``--hosts 2``, regression-gated via
 ``python -m apex_tpu.monitor.regress --tol 0.15``; CPU rehearsals carry
-``_CPU_FALLBACK`` and never promote.
+``_CPU_FALLBACK`` and never promote. Stage 18 banks
+``SERVE_CHAOS_TPU.json`` from ``--hosts 3 --chaos`` under the same
+promote rules.
 """
 
 from __future__ import annotations
@@ -95,6 +107,15 @@ def main(argv=None) -> int:
                     help="second pass at this multiple of the offered "
                          "rate (0: skip) — the graceful-degradation "
                          "evidence")
+    ap.add_argument("--chaos", action="store_true",
+                    help="third pass: kill 1 of N decode workers at "
+                         "--chaos-kill-step while running at "
+                         "--overload-factor x — emits the goodput-under-"
+                         "chaos fields (needs >= 2 decode hosts)")
+    ap.add_argument("--chaos-kill-step", type=int, default=12,
+                    help="cluster tick the chaos kill fires at (early "
+                         "enough that even a hard-shedding overload run "
+                         "is still mid-flight)")
     ap.add_argument("--link-fixed-ms", type=float, default=0.0)
     ap.add_argument("--link-gib-per-s", type=float, default=0.0,
                     help="simulated link bandwidth (0: instant)")
@@ -104,9 +125,13 @@ def main(argv=None) -> int:
         ap.error("--hosts must be >= 2 (that is the point)")
     n_prefill = args.prefill_hosts or max(1, args.hosts // 2)
     n_decode = args.decode_hosts or max(1, args.hosts - n_prefill)
+    if args.chaos and n_decode < 2:
+        ap.error("--chaos kills a decode worker mid-run: it needs >= 2 "
+                 "decode hosts to have a survivor (use --hosts 3)")
 
     on_tpu = jax.default_backend() == "tpu"
-    name = "gpt_serve_mh_goodput"
+    name = "gpt_serve_mh_chaos_goodput" if args.chaos \
+        else "gpt_serve_mh_goodput"
     if not on_tpu:
         name += "_CPU_FALLBACK"
 
@@ -140,8 +165,9 @@ def main(argv=None) -> int:
         link_fixed_ms=args.link_fixed_ms,
         link_gib_per_s=args.link_gib_per_s)
 
-    def run_cluster(time_scale: float):
-        cl = ServeCluster(params, cfg, ccfg, retain_streams=False)
+    def run_cluster(time_scale: float, chaos=None):
+        cl = ServeCluster(params, cfg, ccfg, retain_streams=False,
+                          chaos=chaos)
         stats = run_workload(cl, workload, time_scale=time_scale)
         return cl, stats
 
@@ -188,6 +214,46 @@ def main(argv=None) -> int:
             "deadlocked": False,  # run_workload returned — by contract
         }
 
+    # -- chaos pass: kill 1 of N decode workers at overload ---------------
+    # the ISSUE-13 deliverable: goodput-under-chaos — the same 2x-offered
+    # workload, but a decode worker fail-stops mid-run and its live
+    # requests migrate to the survivors over the KV wire. The record
+    # carries what the failure cost (goodput_under_chaos_rps /
+    # survivor_good_fraction, regress-gated higher-is-better) and how
+    # noisy the recovery was (migrations/replays/retries, lower-better).
+    chaos_rec = None
+    chaos_ok = True
+    if args.chaos:
+        from apex_tpu.serve import ClusterChaos
+        from apex_tpu.serve.cluster.chaos import KillWorker
+
+        factor = max(args.overload_factor or 0.0, 2.0)
+        plan = ClusterChaos([KillWorker(at_step=args.chaos_kill_step,
+                                        worker="decode0")])
+        ch_cluster, ch = run_cluster(1.0 / factor, chaos=plan)
+        ch_slo = ch.get("slo_report", {})
+        ch_drained = (ch.get("completed", 0) + len(ch_cluster.shed)
+                      == len(workload))
+        chaos_ok = bool(ch_drained and ch.get("worker_deaths") == 1)
+        chaos_rec = {
+            "factor": factor,
+            "kill_step": args.chaos_kill_step,
+            "killed": "decode0",
+            "offered": ch.get("offered"),
+            "completed": ch.get("completed"),
+            "shed_rate": ch.get("shed_rate"),
+            "goodput_under_chaos_rps": ch_slo.get("goodput_rps"),
+            "survivor_good_fraction": ch_slo.get("good_fraction"),
+            "worker_deaths": ch.get("worker_deaths"),
+            "migrations_total": ch.get("migrations_total"),
+            "replayed_tokens": ch.get("replayed_tokens"),
+            "heartbeat_misses": ch.get("heartbeat_misses"),
+            "transfer_retries": ch.get("transfer_retries"),
+            "drained": ch_drained,
+            "deadlocked": False,  # run_workload returned — by contract
+            "faults": plan.summary(),
+        }
+
     # -- int8-vs-int4 KV concurrency A/B (modeled, config-exact) ----------
     # at the int8 pool's byte budget, how many pool blocks — and so
     # concurrent max-length contexts — does each tier hold? (halving
@@ -225,7 +291,7 @@ def main(argv=None) -> int:
     drained = stats.get("completed", 0) + len(cluster.shed) == len(workload)
     rec = {
         "metric": name,
-        "ok": bool(drained and wire_model_agrees),
+        "ok": bool(drained and wire_model_agrees and chaos_ok),
         "hosts": {"prefill": n_prefill, "decode": n_decode,
                   "total": n_prefill + n_decode},
         "goodput_rps": slo_rep.get("goodput_rps"),
@@ -267,6 +333,10 @@ def main(argv=None) -> int:
             if slo_rep.get("goodput_rps") and colo_slo.get("goodput_rps")
             else None),
         "overload": overload,
+        "chaos": chaos_rec,
+        # elastic counters of the CLEAN pass (all zero unless the run
+        # hit real faults — regress gates them lower-is-better)
+        "elastic": stats.get("elastic"),
         "compilations": cluster.compile_counts(),
         "slo": slo.to_dict(),
         "workload": {"mode": wcfg.mode, "n": wcfg.n_requests,
@@ -280,6 +350,14 @@ def main(argv=None) -> int:
                      "spec_k": args.spec_k},
         "backend": jax.default_backend(),
     }
+    if chaos_rec is not None:
+        # flat goodput-under-chaos headline fields (the stage-18 gate:
+        # goodput/survivor fraction higher-is-better, recovery noise
+        # lower-is-better)
+        for k in ("goodput_under_chaos_rps", "survivor_good_fraction",
+                  "migrations_total", "replayed_tokens", "worker_deaths",
+                  "heartbeat_misses", "transfer_retries"):
+            rec[k] = chaos_rec[k]
     line = json_record(**rec)
     print(line, flush=True)
     if args.out:

@@ -50,6 +50,7 @@ last_fsdp=-3600     # stage-14 (fsdp vs zero1 A/B) same contract
 last_mh=-3600       # stage-15 (disaggregated serve cluster) same contract
 last_analyze=-3600  # stage-16 (compiled-program contract check) same
 last_sub8=-3600     # stage-17 (sub-8-bit: int4 KV + comm wire A/B) same
+last_chaos=-3600    # stage-18 (elastic serve chaos: kill-and-migrate) same
 
 note() { echo "$(date '+%F %T') $*" >> "$LOG"; }
 
@@ -524,6 +525,50 @@ $(cat /tmp/tpu_stage17_regress.out)"
   return 0
 }
 
+chaos_stage() {
+  # stage 18: elastic fault-tolerant serving — bench_serve_mh.py --chaos
+  # kills 1 of 2 decode hosts at 2x overload mid-run; the survivors
+  # absorb the migrated live requests over the KV wire and the record
+  # carries goodput_under_chaos_rps / survivor_good_fraction (higher-
+  # better) plus the recovery-noise counters (migrations_total /
+  # replayed_tokens / worker_deaths / heartbeat_misses /
+  # transfer_retries, lower-better — the new regress polarity rows).
+  # Same promote rules as stages 10-17: CPU rehearsals never promote,
+  # ok=false (kill did not land / cluster failed to drain) never
+  # promotes, REGRESSION-GATED via monitor.regress --tol 0.15 once
+  # banked; hourly even after banked.
+  note "STAGE18 START: bench_serve_mh.py --hosts 3 --chaos"
+  rm -f /tmp/serve_chaos_try.json
+  timeout 1800 python benchmarks/bench_serve_mh.py --hosts 3 --chaos \
+    --out /tmp/serve_chaos_try.json \
+    > /tmp/tpu_stage18.out 2> /tmp/tpu_stage18.err
+  local rc=$?
+  note "STAGE18 EXIT=$rc"
+  [ -s /tmp/serve_chaos_try.json ] || return 1
+  if grep -q CPU_FALLBACK /tmp/serve_chaos_try.json; then
+    note "STAGE18 got CPU_FALLBACK, not promoting"
+    return 1
+  fi
+  if grep -Eq '"ok": false' /tmp/serve_chaos_try.json; then
+    note "STAGE18 record has ok false, not promoting"
+    return 1
+  fi
+  if [ -s SERVE_CHAOS_TPU.json ]; then
+    if ! python -m apex_tpu.monitor.regress SERVE_CHAOS_TPU.json \
+        /tmp/serve_chaos_try.json --tol 0.15 \
+        > /tmp/tpu_stage18_regress.out 2>> /tmp/tpu_stage18.err; then
+      note "STAGE18 REGRESSION vs banked, keeping banked record: \
+$(cat /tmp/tpu_stage18_regress.out)"
+      return 1
+    fi
+  fi
+  cp /tmp/serve_chaos_try.json SERVE_CHAOS_TPU.json
+  note "STAGE18 PROMOTED $(cat SERVE_CHAOS_TPU.json)"
+  [ $rc -eq 0 ] || return 1
+  [ "$(cat "$STATE")" -eq 17 ] && echo 18 > "$STATE"
+  return 0
+}
+
 smoke_stage() {
   # Smoke to a temp file; promote ANY real-TPU artifact (a failing kernel
   # on the chip is exactly the evidence we must bank) but never a CPU
@@ -635,6 +680,13 @@ while true; do
           sub8_stage
           last_sub8=$now
         fi
+        # stage 18 (elastic serve chaos: kill-and-migrate at overload):
+        # same contract — a goodput-under-chaos collapse or a recovery-
+        # noise storm must surface within an hour
+        if [ $((now - last_chaos)) -ge 3600 ]; then
+          chaos_stage
+          last_chaos=$now
+        fi
         last_refresh=$now
       fi
     else
@@ -727,6 +779,12 @@ while true; do
           && [ $((now - last_sub8)) -ge 3600 ]; then
         sub8_stage
         last_sub8=$now
+      fi
+      # stage 18: elastic serve chaos (kill-and-migrate), same contract.
+      if [ "$(cat "$STATE")" -eq 17 ] \
+          && [ $((now - last_chaos)) -ge 3600 ]; then
+        chaos_stage
+        last_chaos=$now
       fi
       last_refresh=$now
     fi

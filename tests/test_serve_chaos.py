@@ -1,0 +1,746 @@
+"""Elastic fault-tolerant serving — the ISSUE-13 acceptance gates.
+
+All stock-jax-safe (single device; the multi-"host" cluster runs on the
+in-process SimTransport, chaos is step-keyed and deterministic, failure
+detection runs on a MANUAL clock — no sleeps, no wall time):
+
+* **chaos acceptance** — a decode worker killed mid-decode under a
+  burst at ~2× capacity: zero stream corruption (surviving AND migrated
+  request streams BITWISE equal the fault-free run, greedy and sampled,
+  fp32 and int8/int4 KV pools), bounded goodput loss, and the cluster
+  drains (no deadlock);
+* **transfer reliability** — corrupted / dropped / stalled transfers
+  are detected (CRC / timeout), retried with exponential backoff, and
+  the stream still lands bitwise; a retry ladder that runs dry becomes
+  an explicit ``transfer_failed`` terminal state;
+* **preemptible workers** — SIGTERM (via PreemptionHandler.trigger, the
+  exact signal code path) drains: prefill re-enqueues staged prompts at
+  the router, decode proactively migrates before exit;
+* **membership** — heartbeat-miss and StallWatchdog detection mark a
+  stalled worker dead so its requests migrate; autoscale joins/drains
+  workers off the backlog/occupancy gauges;
+* **compile gate** — a kill-and-migrate run on warmed workers mints
+  ZERO new compilations (migration reuses the existing
+  extract/insert/decode programs);
+* satellites: ``InferenceEngine.evict_slot``/``restore_slot`` local
+  no-op pin, the router tenant-table GC bound, the chaos-field
+  ``monitor.regress`` polarity.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.analyze.recompile import recompile_guard
+from apex_tpu.monitor.events import EventLog, request_spans
+from apex_tpu.monitor.regress import classify_metric, compare_records
+from apex_tpu.monitor.slo import SloSpec
+from apex_tpu.resilience.preemption import StallWatchdog
+from apex_tpu.serve import (
+    AutoscalePolicy,
+    ClusterChaos,
+    ClusterConfig,
+    InferenceEngine,
+    Request,
+    Router,
+    RouterConfig,
+    SamplingConfig,
+    ServeCluster,
+    ServeConfig,
+)
+from apex_tpu.serve.cluster.chaos import (
+    CorruptTransfer,
+    DropTransfer,
+    KillWorker,
+    PreemptWorker,
+    StallLink,
+    StallWorker,
+)
+from apex_tpu.transformer.testing import GPTConfig, init_gpt_params
+
+CFG = GPTConfig(vocab_size=97, max_seq=64, hidden=32, num_layers=2,
+                num_heads=4, dtype=jnp.float32, fused_loss=False)
+PARAMS = init_gpt_params(jax.random.PRNGKey(0), CFG)
+
+REQS = [
+    Request("a", [1, 2, 3, 4, 5], max_new_tokens=6),
+    Request("b", [7, 8, 9], max_new_tokens=8),
+    Request("c", list(range(20, 42)), max_new_tokens=8),
+    Request("d", [11, 3, 11, 3, 11, 3, 7], max_new_tokens=9),
+    Request("e", list(range(60, 73)), max_new_tokens=7),
+]
+
+
+def _serve_cfg(**kw):
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("prefill_chunk", 8)
+    return ServeConfig(**kw)
+
+
+class _ManualClock:
+    """Deterministic cluster time: one .advance per tick."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, s):
+        self.t += s
+
+
+class _ListSink:
+    def __init__(self):
+        self.records = []
+
+    def write(self, **fields):
+        self.records.append(fields)
+
+    def flush(self):
+        pass
+
+
+def _drive(cl, clock=None, tick_ms=5.0, max_steps=20000):
+    steps = 0
+    while cl.active and steps < max_steps:
+        cl.step()
+        if clock is not None:
+            clock.advance(tick_ms / 1e3)
+        steps += 1
+    assert steps < max_steps, "cluster failed to drain"
+    return steps
+
+
+# ---------------------------------------------------------------------------
+# ACCEPTANCE: worker killed mid-decode → bitwise streams, bounded goodput
+
+
+@pytest.mark.parametrize("kv_quant,greedy", [
+    ("none", True),
+    ("none", False),
+    ("int8", True),
+    ("int8", False),
+    ("int4", True),
+    ("int4", False),
+])
+def test_kill_mid_decode_streams_bitwise(kv_quant, greedy):
+    """The chaos acceptance gate: a decode worker dies mid-run under a
+    burst of ~2× slot capacity; every request still completes, and
+    every stream — the migrated ones included — is BITWISE equal to the
+    fault-free run. Manual clock: the run is exactly reproducible."""
+    sampling = (SamplingConfig() if greedy
+                else SamplingConfig(temperature=0.7, top_k=13))
+    scfg = _serve_cfg(kv_quant=kv_quant, sampling=sampling)
+    slo = SloSpec(ttft_ms=600000.0)
+
+    def run(chaos):
+        clock = _ManualClock()
+        events = EventLog(keep=True, clock=clock)
+        ccfg = ClusterConfig(n_prefill=1, n_decode=2, serve=scfg,
+                             router=RouterConfig(slo=slo))
+        cl = ServeCluster(PARAMS, CFG, ccfg, events=events, chaos=chaos)
+        for r in REQS:  # one burst: ~2.5x the 2 slots a decode host has
+            cl.submit(r)
+        _drive(cl, clock)
+        return cl, events
+
+    cl_ff, _ = run(None)
+    chaos = ClusterChaos([KillWorker(at_step=12, worker="decode0")])
+    cl_ch, events = run(chaos)
+    st = cl_ch.stats()
+    # the fault happened and was survived: a real death, real migrations
+    assert st["worker_deaths"] == 1
+    assert st["migrations_total"] >= 1
+    assert st["replayed_tokens"] >= 1
+    assert st["completed"] + len(cl_ch.shed) == len(REQS)  # drained
+    # zero stream corruption: bitwise vs the fault-free run
+    ff = cl_ff.finished
+    ch = cl_ch.finished
+    assert set(ch) == set(ff) == {r.uid for r in REQS}
+    for uid in ff:
+        assert ch[uid] == ff[uid], uid
+    # bounded goodput loss (here: generous budgets -> no loss at all)
+    gf_ff = cl_ff.stats()["slo_report"]["good_fraction"]
+    gf_ch = st["slo_report"]["good_fraction"]
+    assert gf_ch is not None and gf_ch >= gf_ff - 0.3
+    # the elastic lifecycle is in the ONE shared event stream
+    evs = [r for r in events.records if r.get("kind") == "event"]
+    names = {r["event"] for r in evs}
+    assert {"worker_join", "worker_leave", "migrate_start",
+            "migrate_end", "replay"} <= names
+    leave = [r for r in evs if r["event"] == "worker_leave"]
+    assert [r["reason"] for r in leave] == ["killed"]
+
+
+def test_migrate_span_in_trace_on_one_clock():
+    """The migrate span renders in the Chrome trace next to the other
+    lifecycle spans, all on the one shared clock."""
+    clock = _ManualClock()
+    events = EventLog(keep=True, clock=clock)
+    ccfg = ClusterConfig(n_prefill=1, n_decode=2, serve=_serve_cfg(),
+                         router=RouterConfig(slo=SloSpec(ttft_ms=600000.0)))
+    chaos = ClusterChaos([KillWorker(at_step=12, worker="decode0")])
+    cl = ServeCluster(PARAMS, CFG, ccfg, events=events, chaos=chaos)
+    for r in REQS:
+        cl.submit(r)
+    _drive(cl, clock)
+    spans = request_spans(events.records)
+    migrated = {r["uid"] for r in events.records
+                if r.get("kind") == "event" and r["event"] == "migrate_start"}
+    assert migrated
+    for uid in migrated:
+        names = {s["name"] for s in spans[uid]}
+        assert "migrate" in names
+        mig = [s for s in spans[uid] if s["name"] == "migrate"][0]
+        assert mig["t1_ms"] >= mig["t0_ms"]
+        # ordering on the shared clock: the hop happens mid-lifecycle
+        by_ev = {}
+        for r in events.records:
+            if r.get("kind") == "event" and r.get("uid") == uid:
+                by_ev.setdefault(r["event"], r["t_ms"])
+        assert (by_ev["first_token"] <= by_ev["migrate_start"]
+                <= by_ev["migrate_end"] <= by_ev["retired"])
+
+
+# ---------------------------------------------------------------------------
+# Transfer reliability: CRC, timeout, backoff, terminal failure
+
+
+def test_corrupt_transfer_detected_retried_bitwise():
+    """A corrupted transfer is caught by the CRC at delivery, retried
+    with backoff, and the stream lands bitwise — never a silent
+    divergence. Retry counters surface in stats()."""
+    scfg = _serve_cfg()
+    ref = InferenceEngine(PARAMS, CFG, scfg).run(REQS)
+    clock = _ManualClock()
+    events = EventLog(keep=True, clock=clock)
+    chaos = ClusterChaos([CorruptTransfer(at_step=0, count=2)])
+    ccfg = ClusterConfig(n_prefill=1, n_decode=1, serve=scfg,
+                         router=RouterConfig(slo=SloSpec(ttft_ms=600000.0)),
+                         retry_backoff_ms=2.0)
+    cl = ServeCluster(PARAMS, CFG, ccfg, events=events, chaos=chaos)
+    for r in REQS:
+        cl.submit(r)
+    _drive(cl, clock)
+    st = cl.stats()
+    assert st["transfer"]["faults"]["corrupts"] == 2
+    assert st["elastic"]["transfer_crc_failures"] == 2
+    assert st["transfer_retries"] == 2
+    assert not cl.shed
+    out = cl.finished
+    assert out == ref  # bitwise, corruption and all
+    retry_evs = [r for r in events.records if r.get("kind") == "event"
+                 and r["event"] == "transfer_retry"]
+    assert len(retry_evs) == 2
+    assert all(r["reason"] == "crc" for r in retry_evs)
+
+
+def test_dropped_transfer_times_out_and_retries():
+    scfg = _serve_cfg()
+    ref = InferenceEngine(PARAMS, CFG, scfg).run(REQS)
+    clock = _ManualClock()
+    chaos = ClusterChaos([DropTransfer(at_step=0, count=1)])
+    ccfg = ClusterConfig(n_prefill=1, n_decode=1, serve=scfg,
+                         router=RouterConfig(slo=SloSpec(ttft_ms=600000.0)),
+                         transfer_timeout_ms=40.0, retry_backoff_ms=2.0)
+    cl = ServeCluster(PARAMS, CFG, ccfg,
+                      events=EventLog(keep=True, clock=clock), chaos=chaos)
+    for r in REQS:
+        cl.submit(r)
+    _drive(cl, clock)
+    st = cl.stats()
+    assert st["transfer"]["faults"]["drops"] == 1
+    assert st["elastic"]["transfer_timeouts"] >= 1
+    assert st["transfer_retries"] >= 1
+    assert cl.finished == ref
+
+
+def test_stalled_transfer_times_out_and_late_copy_is_ignored():
+    """A transfer stalled past the timeout is retried; when the stalled
+    original finally limps in, the receiver drops it as a duplicate
+    instead of double-installing."""
+    scfg = _serve_cfg()
+    ref = InferenceEngine(PARAMS, CFG, scfg).run(REQS)
+    clock = _ManualClock()
+    chaos = ClusterChaos([StallLink(at_step=0, stall_ms=60.0, count=1)])
+    ccfg = ClusterConfig(n_prefill=1, n_decode=1, serve=scfg,
+                         router=RouterConfig(slo=SloSpec(ttft_ms=600000.0)),
+                         transfer_timeout_ms=25.0, retry_backoff_ms=2.0)
+    cl = ServeCluster(PARAMS, CFG, ccfg,
+                      events=EventLog(keep=True, clock=clock), chaos=chaos)
+    for r in REQS:
+        cl.submit(r)
+    _drive(cl, clock)
+    st = cl.stats()
+    assert st["transfer"]["faults"]["stalls"] == 1
+    assert st["elastic"]["transfer_timeouts"] >= 1
+    assert st["elastic"]["duplicates_ignored"] >= 1
+    assert cl.finished == ref
+
+
+def test_transfer_failed_is_terminal_not_a_hang():
+    """Every attempt corrupted: the retry ladder runs dry and the
+    request becomes an explicit transfer_failed terminal state — the
+    cluster still drains and keeps serving everything else."""
+    scfg = _serve_cfg()
+    clock = _ManualClock()
+    # enough corrupt faults to rot EVERY attempt (initial + 2 retries);
+    # one victim request first, then clean traffic behind it
+    chaos = ClusterChaos([CorruptTransfer(at_step=0, count=3)])
+    ccfg = ClusterConfig(n_prefill=1, n_decode=1, serve=scfg,
+                         router=RouterConfig(slo=SloSpec(ttft_ms=600000.0)),
+                         transfer_max_retries=2, retry_backoff_ms=2.0)
+    cl = ServeCluster(PARAMS, CFG, ccfg,
+                      events=EventLog(keep=True, clock=clock), chaos=chaos)
+    victim = Request("victim", list(range(1, 9)), max_new_tokens=4)
+    cl.submit(victim)
+    # drive the victim's retry ladder dry before offering more traffic
+    steps = 0
+    while cl.active and steps < 20000:
+        cl.step()
+        clock.advance(0.005)
+        steps += 1
+    for r in REQS:
+        cl.submit(r)
+    _drive(cl, clock)
+    st = cl.stats()
+    assert st["elastic"]["transfer_crc_failures"] == 3
+    assert st["elastic"]["transfer_failed"] == 1
+    failed = [d for d in cl.shed.values() if d.reason == "transfer_failed"]
+    assert len(failed) == 1 and failed[0].request.uid == "victim"
+    assert st["completed"] == len(REQS)   # everything else still served
+    assert st["completed"] + len(cl.shed) == len(REQS) + 1  # drained
+    # the router ledger moved the victim admitted -> shed: the invariant
+    # submitted == admitted + shed + queued holds and shed_rate shows it
+    r = st["router"]
+    assert r["submitted"] == r["admitted"] + r["shed"] + r["queue_depth"]
+    assert r["shed"] == 1 and r["shed_rate"] > 0
+
+
+def test_drop_without_timeout_is_a_loud_config_error():
+    """A dropped send is only detectable by timeout; injecting one into
+    a cluster that cannot notice must fail the configuration loudly
+    instead of hanging the stream forever."""
+    ccfg = ClusterConfig(n_prefill=1, n_decode=1, serve=_serve_cfg(),
+                         router=RouterConfig(slo=SloSpec(ttft_ms=600000.0)))
+    chaos = ClusterChaos([DropTransfer(at_step=0)])
+    cl = ServeCluster(PARAMS, CFG, ccfg, chaos=chaos)
+    cl.submit(Request("x", [1, 2, 3], max_new_tokens=2))
+    with pytest.raises(ValueError, match="transfer_timeout_ms"):
+        cl.step()
+
+
+def test_forever_stall_without_detection_is_a_loud_config_error():
+    """A wedged worker is only detectable by heartbeat or watchdog;
+    injecting an unbounded stall with neither armed must fail loudly
+    instead of hanging its requests forever."""
+    ccfg = ClusterConfig(n_prefill=1, n_decode=2, serve=_serve_cfg(),
+                         router=RouterConfig(slo=SloSpec(ttft_ms=600000.0)))
+    cl = ServeCluster(PARAMS, CFG, ccfg, chaos=ClusterChaos(
+        [StallWorker(at_step=0, worker="decode0")]))
+    with pytest.raises(ValueError, match="heartbeat_timeout_ms"):
+        cl.step()
+
+
+def test_headless_fleet_with_autoscale_respawns_and_serves():
+    """Losing EVERY decode worker with autoscale armed replaces the
+    capacity instead of shedding: the fleet respawns and every request
+    still completes bitwise."""
+    scfg = _serve_cfg()
+    ref = InferenceEngine(PARAMS, CFG, scfg).run(REQS)
+    clock = _ManualClock()
+    chaos = ClusterChaos([KillWorker(at_step=10, worker="decode0")])
+    ccfg = ClusterConfig(n_prefill=1, n_decode=1, serve=scfg,
+                         router=RouterConfig(slo=SloSpec(ttft_ms=600000.0)),
+                         autoscale=AutoscalePolicy(max_decode=2,
+                                                   cooldown_ms=0.0))
+    cl = ServeCluster(PARAMS, CFG, ccfg,
+                      events=EventLog(keep=True, clock=clock), chaos=chaos)
+    for r in REQS:
+        cl.submit(r)
+    _drive(cl, clock)
+    assert not cl.shed
+    assert cl.finished == ref
+    assert cl.membership.autoscale_ups >= 1
+    assert len(cl.alive_decode_workers()) >= 1
+
+
+def test_all_decode_workers_dead_sheds_instead_of_hanging():
+    """Losing EVERY decode worker with no autoscale to replace them is
+    fatal-by-config: in-flight handoffs and queued work become explicit
+    no_decode_workers terminal sheds and the cluster drains."""
+    clock = _ManualClock()
+    chaos = ClusterChaos([KillWorker(at_step=10, worker="decode0")])
+    ccfg = ClusterConfig(n_prefill=1, n_decode=1, serve=_serve_cfg(),
+                         router=RouterConfig(slo=SloSpec(ttft_ms=600000.0)))
+    cl = ServeCluster(PARAMS, CFG, ccfg,
+                      events=EventLog(keep=True, clock=clock), chaos=chaos)
+    for r in REQS:
+        cl.submit(r)
+    _drive(cl, clock)   # asserts drain inside
+    assert not cl.active
+    assert cl.stats()["completed"] + len(cl.shed) == len(REQS)
+    assert {d.reason for d in cl.shed.values()} == {"no_decode_workers"}
+
+
+# ---------------------------------------------------------------------------
+# Preemptible workers: SIGTERM → drain protocol
+
+
+def test_preempted_decode_worker_migrates_then_leaves():
+    scfg = _serve_cfg()
+    ref = InferenceEngine(PARAMS, CFG, scfg).run(REQS)
+    clock = _ManualClock()
+    events = EventLog(keep=True, clock=clock)
+    chaos = ClusterChaos([PreemptWorker(at_step=12, worker="decode0")])
+    ccfg = ClusterConfig(n_prefill=1, n_decode=2, serve=scfg,
+                         router=RouterConfig(slo=SloSpec(ttft_ms=600000.0)))
+    cl = ServeCluster(PARAMS, CFG, ccfg, events=events, chaos=chaos)
+    for r in REQS:
+        cl.submit(r)
+    _drive(cl, clock)
+    st = cl.stats()
+    assert cl.membership.state("decode0") == "dead"
+    assert cl.membership.record("decode0").reason == "preempted"
+    # a drained exit is voluntary: not a death
+    assert st["worker_deaths"] == 0
+    assert st["migrations_total"] >= 1
+    assert cl.finished == ref
+    leave = [r for r in events.records if r.get("kind") == "event"
+             and r["event"] == "worker_leave"]
+    assert [r["reason"] for r in leave] == ["preempted"]
+
+
+def test_preempted_prefill_worker_requeues_staged_prompts():
+    scfg = _serve_cfg()
+    ref = InferenceEngine(PARAMS, CFG, scfg).run(REQS)
+    clock = _ManualClock()
+    chaos = ClusterChaos([PreemptWorker(at_step=2, worker="prefill0")])
+    ccfg = ClusterConfig(n_prefill=2, n_decode=1, serve=scfg,
+                         router=RouterConfig(slo=SloSpec(ttft_ms=600000.0)))
+    cl = ServeCluster(PARAMS, CFG, ccfg,
+                      events=EventLog(keep=True, clock=clock), chaos=chaos)
+    for r in REQS:
+        cl.submit(r)
+    _drive(cl, clock)
+    assert cl.membership.state("prefill0") == "dead"
+    assert cl.membership.record("prefill0").reason == "preempted"
+    assert cl.finished == ref  # everything still served, bitwise
+    # the drain finished the in-flight prompt instead of re-prefilling it
+    assert cl.stats()["worker_deaths"] == 0
+
+
+def test_killed_prefill_worker_requeues_even_midflight():
+    """A KILLED prefill host loses its staging pool; its mid-flight
+    prompt restarts from scratch elsewhere — prefill is deterministic,
+    so streams are unchanged."""
+    scfg = _serve_cfg()
+    ref = InferenceEngine(PARAMS, CFG, scfg).run(REQS)
+    clock = _ManualClock()
+    chaos = ClusterChaos([KillWorker(at_step=3, worker="prefill0")])
+    ccfg = ClusterConfig(n_prefill=2, n_decode=1, serve=scfg,
+                         router=RouterConfig(slo=SloSpec(ttft_ms=600000.0)))
+    cl = ServeCluster(PARAMS, CFG, ccfg,
+                      events=EventLog(keep=True, clock=clock), chaos=chaos)
+    for r in REQS:
+        cl.submit(r)
+    _drive(cl, clock)
+    assert cl.finished == ref
+    assert cl.router.requeued >= 1
+    assert cl.stats()["worker_deaths"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Membership: heartbeat-miss death, stall watchdog, autoscale
+
+
+def test_stalled_worker_heartbeat_death_migrates():
+    """A wedged decode worker stops beating; the heartbeat detector
+    declares it dead at the configured timeout on the MANUAL clock and
+    its requests migrate — streams bitwise."""
+    scfg = _serve_cfg()
+    ref = InferenceEngine(PARAMS, CFG, scfg).run(REQS)
+    clock = _ManualClock()
+    events = EventLog(keep=True, clock=clock)
+    chaos = ClusterChaos([StallWorker(at_step=12, worker="decode0")])
+    ccfg = ClusterConfig(n_prefill=1, n_decode=2, serve=scfg,
+                         router=RouterConfig(slo=SloSpec(ttft_ms=600000.0)),
+                         heartbeat_timeout_ms=50.0)
+    cl = ServeCluster(PARAMS, CFG, ccfg, events=events, chaos=chaos)
+    for r in REQS:
+        cl.submit(r)
+    _drive(cl, clock)
+    st = cl.stats()
+    assert cl.membership.state("decode0") == "dead"
+    assert cl.membership.record("decode0").reason == "heartbeat"
+    assert st["heartbeat_misses"] == 1
+    assert st["worker_deaths"] == 1
+    assert cl.finished == ref
+    # the death stamp sits one timeout after the last beat, exactly
+    rec = cl.membership.record("decode0")
+    assert rec.left_ms - rec.last_beat_ms >= 50.0
+
+
+def test_short_stall_recovers_without_death():
+    scfg = _serve_cfg()
+    ref = InferenceEngine(PARAMS, CFG, scfg).run(REQS)
+    clock = _ManualClock()
+    chaos = ClusterChaos([StallWorker(at_step=12, worker="decode0",
+                                      for_steps=4)])  # 20 "ms" < 100
+    ccfg = ClusterConfig(n_prefill=1, n_decode=2, serve=scfg,
+                         router=RouterConfig(slo=SloSpec(ttft_ms=600000.0)),
+                         heartbeat_timeout_ms=100.0)
+    cl = ServeCluster(PARAMS, CFG, ccfg,
+                      events=EventLog(keep=True, clock=clock), chaos=chaos)
+    for r in REQS:
+        cl.submit(r)
+    _drive(cl, clock)
+    st = cl.stats()
+    assert st["worker_deaths"] == 0 and st["heartbeat_misses"] == 0
+    assert st["migrations_total"] == 0
+    assert cl.finished == ref
+
+
+def test_stall_watchdog_dumps_diagnostics_and_migrates():
+    """resilience.StallWatchdog + cluster: the stalled decode worker
+    trips its per-worker watchdog on the shared manual clock (no
+    sleeps, no daemon thread), per-worker diagnostics land in the sink,
+    the worker is marked dead and its requests migrate."""
+    scfg = _serve_cfg()
+    ref = InferenceEngine(PARAMS, CFG, scfg).run(REQS)
+    clock = _ManualClock()
+    sink = _ListSink()
+    chaos = ClusterChaos([StallWorker(at_step=12, worker="decode0")])
+    ccfg = ClusterConfig(n_prefill=1, n_decode=2, serve=scfg,
+                         router=RouterConfig(slo=SloSpec(ttft_ms=600000.0)),
+                         watchdog_timeout_ms=50.0)
+    cl = ServeCluster(PARAMS, CFG, ccfg, sink=sink,
+                      events=EventLog(keep=True, clock=clock), chaos=chaos)
+    for r in REQS:
+        cl.submit(r)
+    _drive(cl, clock)
+    assert cl.membership.state("decode0") == "dead"
+    assert cl.membership.record("decode0").reason == "stall"
+    assert cl.finished == ref
+    # the watchdog's own diagnostic record (thread stacks) AND the
+    # cluster's per-worker snapshot both reached the sink
+    stall_recs = [r for r in sink.records if "stalls_total" in r]
+    assert len(stall_recs) == 1 and "stacks" in stall_recs[0]
+    wd_recs = [r for r in sink.records
+               if r.get("phase") == "watchdog" and r.get("worker") == "decode0"]
+    assert len(wd_recs) == 1
+    assert wd_recs[0]["occupied_slots"] >= 1  # it held live requests
+
+
+def test_stall_watchdog_manual_clock_unit():
+    """The new StallWatchdog clock/check surface: drivable without the
+    daemon thread, fires once per stall, re-arms on tick."""
+    t = {"v": 0.0}
+    fired = []
+    wd = StallWatchdog(timeout_s=1.0, clock=lambda: t["v"],
+                       on_stall=fired.append)
+    wd.tick(0)
+    assert not wd.check()
+    t["v"] = 0.9
+    assert not wd.check()
+    t["v"] = 1.1
+    assert wd.check() and len(fired) == 1
+    assert not wd.check()  # one shot per stall
+    wd.tick(1)             # re-armed
+    t["v"] = 2.0
+    assert not wd.check()
+    t["v"] = 2.2
+    assert wd.check() and wd.stalls == 2
+
+
+def test_autoscale_up_and_down_on_gauges():
+    """Backlog at saturated occupancy joins a worker; an idle fleet
+    drains one back down — both decisions off the live gauges, both
+    evented, neither counted as a death."""
+    clock = _ManualClock()
+    events = EventLog(keep=True, clock=clock)
+    pol = AutoscalePolicy(scale_up_queue_depth=3, scale_up_occupancy=0.5,
+                          scale_down_occupancy=0.1, min_decode=1,
+                          max_decode=2, cooldown_ms=0.0)
+    scfg = _serve_cfg(num_slots=1)
+    ccfg = ClusterConfig(n_prefill=1, n_decode=1, serve=scfg,
+                         router=RouterConfig(slo=SloSpec(ttft_ms=600000.0)),
+                         autoscale=pol)
+    cl = ServeCluster(PARAMS, CFG, ccfg, events=events)
+    rng = np.random.default_rng(0)
+    reqs = [Request(f"r{i}", rng.integers(0, 97, size=12).tolist(),
+                    max_new_tokens=6) for i in range(10)]
+    for r in reqs:
+        cl.submit(r)
+    _drive(cl, clock)
+    assert len(cl.decode_workers) == 2           # scaled up mid-run
+    assert cl.membership.autoscale_ups == 1
+    assert cl.stats()["completed"] == len(reqs)
+    # drained and idle now: keep ticking -> scale back down
+    for _ in range(5):
+        cl.step()
+        clock.advance(0.005)
+    assert cl.membership.autoscale_downs == 1
+    assert len(cl.alive_decode_workers()) == 1
+    assert cl.stats()["worker_deaths"] == 0
+    names = [r["event"] for r in events.records if r.get("kind") == "event"]
+    assert names.count("worker_join") == 3       # 1 prefill + 2 decode
+    leave = [r for r in events.records if r.get("kind") == "event"
+             and r["event"] == "worker_leave"]
+    assert [r["reason"] for r in leave] == ["scale_down"]
+
+
+# ---------------------------------------------------------------------------
+# Compile gate: migration mints no new programs on warmed workers
+
+
+def test_kill_and_migrate_zero_new_compiles_when_warm():
+    scfg = _serve_cfg()
+    ccfg = ClusterConfig(n_prefill=1, n_decode=3, serve=scfg,
+                         router=RouterConfig(slo=SloSpec(ttft_ms=600000.0)))
+    cl = ServeCluster(PARAMS, CFG, ccfg)
+    # warm round: every worker prefills/inserts/decodes, and one kill
+    # compiles the ONE shared migrate-extract program
+    for r in REQS:
+        cl.submit(r)
+    steps = 0
+    while cl.active and steps < 20000:
+        if steps == 12:
+            cl.kill_worker("decode0")
+        cl.step()
+        steps += 1
+    assert cl.stats()["migrations_total"] >= 1
+    # guarded round: a fresh workload + a SECOND kill recompiles nothing
+    reqs2 = [Request(f"g{i}", [3 + i, 5, 7, 11], max_new_tokens=5)
+             for i in range(4)]
+    ref = InferenceEngine(PARAMS, CFG, scfg).run(
+        [Request(r.uid, r.tokens, max_new_tokens=r.max_new_tokens)
+         for r in reqs2])
+    with recompile_guard(cl.programs(), budget=0):
+        for r in reqs2:
+            cl.submit(r)
+        steps = 0
+        killed = False
+        while cl.active and steps < 20000:
+            if not killed and any(
+                    cl._workers["decode1"].live_uids()):
+                cl.kill_worker("decode1")
+                killed = True
+            cl.step()
+            steps += 1
+    assert killed and cl.stats()["worker_deaths"] == 2
+    out = cl.finished
+    for r in reqs2:
+        assert out[r.uid] == ref[r.uid], r.uid
+
+
+# ---------------------------------------------------------------------------
+# Engine satellite: evict_slot / restore_slot local no-op
+
+
+@pytest.mark.parametrize("greedy", [True, False])
+def test_evict_restore_local_noop_bitwise(greedy):
+    sampling = (SamplingConfig() if greedy
+                else SamplingConfig(temperature=0.7, top_k=13))
+    scfg = _serve_cfg(num_slots=2, sampling=sampling)
+    ref = InferenceEngine(PARAMS, CFG, scfg).run(REQS[:2])
+    eng = InferenceEngine(PARAMS, CFG, scfg)
+    for r in REQS[:2]:
+        eng.submit(r)
+    # step until both are mid-decode
+    while not (eng._active.all() and all(
+            s is not None and len(s.generated) >= 2 for s in eng._slots)):
+        eng.step()
+    st = eng.stats()
+    rec = eng.evict_slot("a")
+    assert rec["seq_len"] > rec["prompt_len"] - 1
+    assert eng.occupancy() == 0.5
+    eng.restore_slot(rec)   # same blocks, same pool: a pure no-op
+    while eng.active:
+        eng.step()
+    assert eng.finished == ref  # bitwise
+    assert eng.stats()["completed"] == 2  # eviction is not a retirement
+    assert st["completed"] == 0
+
+
+def test_evict_slot_validation():
+    scfg = _serve_cfg(num_slots=2)
+    eng = InferenceEngine(PARAMS, CFG, scfg)
+    with pytest.raises(KeyError, match="no occupied slot"):
+        eng.evict_slot("ghost")
+    long_req = Request("mid", list(range(30)), max_new_tokens=4)
+    eng.submit(long_req)
+    eng.step()  # first chunk only: mid-prefill
+    with pytest.raises(RuntimeError, match="mid-prefill"):
+        eng.evict_slot("mid")
+
+
+# ---------------------------------------------------------------------------
+# Router satellite: the tenant-state tables are bounded
+
+
+def test_router_tenant_table_bounded_under_churn():
+    """A tenant whose every request was shed used to leave vtime +
+    counter state behind forever; the table is now bounded and the
+    aggregate counters stay exact."""
+    r = Router(RouterConfig(max_tenant_states=64))
+    n = 2000
+    for i in range(n):
+        d = r.submit(Request(f"u{i}", [1] * 10, max_new_tokens=10,
+                             tenant=f"t{i}"),
+                     t_ms=float(i), total_tokens=999999,
+                     max_servable_tokens=16)
+        assert d is not None and d.reason == "unservable"
+    assert r.submitted == n and r.shed == n
+    assert len(r.tenants) <= 64
+    assert len(r._vtime) <= 64
+    assert len(r._last_seen) <= 64
+    assert len(r.sheds) <= 64          # the debug window is bounded too
+    st = r.stats()
+    assert st["tenants_evicted"] == n - len(r.tenants)
+    # no request lost to eviction: aggregate + retained == totals
+    kept = sum(v["submitted"] for v in st["tenants"].values())
+    assert st["evicted_totals"]["submitted"] + kept == n
+    # tenants with QUEUED work are never evicted
+    r2 = Router(RouterConfig(max_tenant_states=8))
+    for i in range(20):
+        r2.submit(Request(f"q{i}", [1] * 4, tenant=f"live{i}"), t_ms=0.0)
+    assert r2.queue_depth == 20        # all still dispatchable
+    served = 0
+    while r2.next_request(0, 0.0)[0] is not None:
+        served += 1
+    assert served == 20
+
+
+# ---------------------------------------------------------------------------
+# regress satellite: chaos-field polarity + record gating
+
+
+def test_regress_polarity_covers_chaos_fields():
+    for k in ("migrations_total", "replayed_tokens", "worker_deaths",
+              "heartbeat_misses", "transfer_retries",
+              "elastic.transfer_retries", "overload.worker_deaths"):
+        assert classify_metric(k) == "lower", k
+    for k in ("goodput_under_chaos_rps", "survivor_good_fraction",
+              "chaos.goodput_under_chaos_rps"):
+        assert classify_metric(k) == "higher", k
+
+
+def test_regress_gates_chaos_records():
+    base = {"goodput_under_chaos_rps": 10.0, "survivor_good_fraction": 1.0,
+            "worker_deaths": 1, "migrations_total": 4,
+            "transfer_retries": 0, "replayed_tokens": 4}
+    worse = dict(base, survivor_good_fraction=0.5, transfer_retries=3)
+    rep = compare_records(base, worse, tol=0.15)
+    assert not rep["ok"]
+    keys = {e["key"] for e in rep["regressions"]}
+    assert {"survivor_good_fraction", "transfer_retries"} <= keys
+    # same chaos plan, same outcome: clean
+    assert compare_records(base, dict(base), tol=0.15)["ok"]
+    # a retry storm from zero must flag even at infinite relative delta
+    assert not compare_records({"transfer_retries": 0},
+                               {"transfer_retries": 2}, tol=0.15)["ok"]
