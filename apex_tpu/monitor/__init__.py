@@ -47,12 +47,58 @@ Tier 2 (the serving side — request-level attribution, not step averages):
   diffing of bench records (the ``tpu_watch.sh`` stage-10 gate);
 * :mod:`~apex_tpu.monitor.view` — ``python -m apex_tpu.monitor.view``
   latency/SLO summary CLI over any monitor JSONL file.
+
+Tier 3 (the fleet side — live cross-host signal, not per-worker logs):
+
+* **distributed tracing** — :meth:`EventLog.bind` threads a trace id
+  (minted at router submission) plus the request's current host through
+  every producer's events; :func:`request_spans` reconstructs per
+  trace across merged multi-worker logs, :func:`stitch_traces` verifies
+  the cross-host structure, and :func:`chrome_trace` renders one
+  Perfetto track per HOST — a request that hops hosts or migrates under
+  chaos is visibly one trace id in causal order;
+* :mod:`~apex_tpu.monitor.registry` — :class:`MetricsRegistry`
+  cardinality-bounded named series (counters/gauges/histograms) with
+  Prometheus text exposition, snapshot/merge aggregation (histogram
+  merge is associative — this is what it was built for), and the
+  :class:`FleetScraper` pulling worker snapshots on the cluster clock
+  into one :class:`~apex_tpu.monitor.registry.FleetView` (per-worker,
+  per-tenant and rolled-up series; scrape_ms/coverage self-measured);
+* :mod:`~apex_tpu.monitor.alerts` — declarative threshold / absence /
+  rate rules evaluated over scraped series; firings are first-class
+  ``alert_fire``/``alert_resolve`` events that drive the cluster's
+  autoscaler and land in the JSONL stream;
+* :mod:`~apex_tpu.monitor.flight` — :class:`FlightRecorder` bounded
+  in-memory rings of recent records, dumped atomically (the
+  ``resilience.checkpoint`` tmp+replace discipline) on chaos kill /
+  watchdog fire / alert escalation;
+* :mod:`~apex_tpu.monitor.postmortem` — ``python -m
+  apex_tpu.monitor.postmortem DIR`` rebuilds the merged pre-failure
+  timeline from flight dumps alone.
 """
 
+from apex_tpu.monitor.alerts import (  # noqa: F401
+    AbsenceRule,
+    AlertEngine,
+    AlertRule,
+    Condition,
+    RateRule,
+)
 from apex_tpu.monitor.events import (  # noqa: F401
     EventLog,
     chrome_trace,
+    request_spans,
+    stitch_traces,
     write_chrome_trace,
+)
+from apex_tpu.monitor.flight import (  # noqa: F401
+    FlightRecorder,
+)
+from apex_tpu.monitor.registry import (  # noqa: F401
+    FleetScraper,
+    FleetView,
+    MetricsRegistry,
+    merge_snapshots,
 )
 from apex_tpu.monitor.hist import (  # noqa: F401
     DEFAULT_LATENCY_SPEC,
@@ -108,19 +154,29 @@ def __getattr__(name):
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 __all__ = [
+    "AbsenceRule",
+    "AlertEngine",
+    "AlertRule",
+    "Condition",
     "DEFAULT_LATENCY_SPEC",
     "EventLog",
+    "FleetScraper",
+    "FleetView",
+    "FlightRecorder",
     "HistSpec",
     "Histogram",
     "JsonlSink",
     "Metrics",
+    "MetricsRegistry",
     "PHASES",
+    "RateRule",
     "SCHEMA_VERSION",
     "SloSpec",
     "SloTracker",
     "accumulate_hist",
     "chrome_trace",
     "compare_records",
+    "merge_snapshots",
     "format_step_report",
     "global_norm",
     "gpt_analytic_flops_per_token",
@@ -134,8 +190,10 @@ __all__ = [
     "phase_breakdown",
     "pipeline_bubble_fraction",
     "read_jsonl",
+    "request_spans",
     "rotated_segments",
     "span",
+    "stitch_traces",
     "span_function",
     "step_annotation",
     "step_report",

@@ -1,0 +1,316 @@
+"""Declarative alert rules over scraped fleet series — firings as events.
+
+Monitor tier 3's decision layer. Before this module, the autoscaler and
+chaos recovery paths peeked gauges ad hoc (``queue_depth >= N and
+occupancy >= x`` inline in the cluster tick); a scaling or recovery
+decision left no artifact saying WHY it fired. Here the conditions are
+**data** — declarative rules evaluated over the
+:class:`~apex_tpu.monitor.registry.FleetView` the
+:class:`~apex_tpu.monitor.registry.FleetScraper` produces — and every
+transition is a first-class event (``alert_fire`` / ``alert_resolve``)
+on the cluster's one shared clock, in the same JSONL stream and Chrome
+trace as the request lifecycles it explains.
+
+Rule shapes (all deterministic, all clock-free — they count consecutive
+EVALUATIONS, which the cluster runs once per scrape tick):
+
+* :class:`AlertRule` — a conjunction of :class:`Condition` thresholds
+  (``backlog_tokens > X`` AND ``occupancy >= y``) that must hold for
+  ``for_ticks`` consecutive evaluations before firing (the Prometheus
+  ``for:`` clause). Each condition aggregates its matching series
+  (``sum``/``max``/``min``/``avg``) so one rule reads per-worker,
+  per-tenant or rolled-up values.
+* :class:`AbsenceRule` — fires when a series (optionally
+  label-filtered) is MISSING from the view for ``for_ticks``
+  evaluations — the "heartbeat absent" / "worker stopped exporting"
+  shape. A scrape miss IS the signal.
+* :class:`RateRule` — fires when a series has RISEN by more than
+  ``min_increase`` over the last ``window_ticks`` evaluations
+  (``shed_rate rising``) — trend detection over the scrape history,
+  O(window) state.
+
+:class:`AlertEngine` evaluates the rule set, maintains firing state
+(fire once on the False→True transition, resolve on True→False),
+emits the events, and keeps the ledger (``alerts_fired_total``,
+``active()``, ``summary()``). Detectors that cannot be expressed as a
+scrape-series rule (the membership heartbeat check with its slow-tick
+beat floor) route their verdicts through :meth:`AlertEngine.fire` so
+the ledger, the events and the consumers see ONE alert plane either
+way — the cluster's autoscaler and migration paths act on firings, not
+on gauges.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence
+
+from apex_tpu.monitor.registry import FleetView
+
+__all__ = ["AbsenceRule", "AlertEngine", "AlertFiring", "AlertRule",
+           "Condition", "RateRule"]
+
+_OPS: Dict[str, Callable[[float, float], bool]] = {
+    ">": lambda a, b: a > b,
+    ">=": lambda a, b: a >= b,
+    "<": lambda a, b: a < b,
+    "<=": lambda a, b: a <= b,
+    "==": lambda a, b: a == b,
+}
+_AGGS = ("sum", "max", "min", "avg")
+
+
+@dataclasses.dataclass(frozen=True)
+class Condition:
+    """One threshold over a (possibly label-filtered) series set:
+    ``agg(series(name) where labels ⊆ series.labels) op value``.
+    Missing series never satisfy a condition (use :class:`AbsenceRule`
+    to alert on absence)."""
+
+    series: str
+    op: str
+    value: float
+    agg: str = "sum"
+    labels: Optional[Mapping[str, str]] = None
+
+    def validate(self) -> None:
+        if self.op not in _OPS:
+            raise ValueError(f"op must be one of {tuple(_OPS)}, "
+                             f"got {self.op!r}")
+        if self.agg not in _AGGS:
+            raise ValueError(f"agg must be one of {_AGGS}, "
+                             f"got {self.agg!r}")
+
+    def evaluate(self, view: FleetView) -> Optional[float]:
+        """The aggregated value (None when no series matches)."""
+        vals = []
+        want = dict(self.labels or {})
+        for labels, v in view.series(self.series):
+            if all(labels.get(k) == str(v2) for k, v2 in want.items()):
+                vals.append(v)
+        if not vals:
+            return None
+        if self.agg == "sum":
+            return float(sum(vals))
+        if self.agg == "max":
+            return float(max(vals))
+        if self.agg == "min":
+            return float(min(vals))
+        return float(sum(vals) / len(vals))
+
+    def holds(self, view: FleetView) -> bool:
+        v = self.evaluate(view)
+        return v is not None and _OPS[self.op](v, self.value)
+
+
+@dataclasses.dataclass(frozen=True)
+class AlertRule:
+    """Threshold rule: every condition must hold for ``for_ticks``
+    consecutive evaluations. ``severity="page"`` firings additionally
+    trigger the flight-recorder escalation dump in the cluster."""
+
+    name: str
+    conditions: Sequence[Condition] = ()
+    for_ticks: int = 1
+    severity: str = "warn"          # "warn" | "page"
+
+    def validate(self) -> None:
+        if not self.conditions:
+            raise ValueError(f"{self.name}: needs at least one condition")
+        if self.for_ticks < 1:
+            raise ValueError(f"{self.name}: for_ticks must be >= 1")
+        if self.severity not in ("warn", "page"):
+            raise ValueError(f"{self.name}: severity must be 'warn' or "
+                             f"'page', got {self.severity!r}")
+        for c in self.conditions:
+            c.validate()
+
+    def holds(self, view: FleetView) -> bool:
+        return all(c.holds(view) for c in self.conditions)
+
+    def context(self, view: FleetView) -> Dict[str, Any]:
+        return {c.series: c.evaluate(view) for c in self.conditions}
+
+
+@dataclasses.dataclass(frozen=True)
+class AbsenceRule:
+    """Fires when ``series`` (with ``labels``, when given) is absent
+    from the view for ``for_ticks`` consecutive evaluations."""
+
+    name: str
+    series: str
+    labels: Optional[Mapping[str, str]] = None
+    for_ticks: int = 1
+    severity: str = "warn"
+
+    def validate(self) -> None:
+        if self.for_ticks < 1:
+            raise ValueError(f"{self.name}: for_ticks must be >= 1")
+        if self.severity not in ("warn", "page"):
+            raise ValueError(f"{self.name}: severity must be 'warn' or "
+                             f"'page', got {self.severity!r}")
+
+    def holds(self, view: FleetView) -> bool:
+        want = dict(self.labels or {})
+        for labels, _ in view.series(self.series):
+            if all(labels.get(k) == str(v) for k, v in want.items()):
+                return False
+        return True
+
+    def context(self, view: FleetView) -> Dict[str, Any]:
+        return {"absent": self.series,
+                **({"labels": dict(self.labels)} if self.labels else {})}
+
+
+@dataclasses.dataclass(frozen=True)
+class RateRule:
+    """Fires when the aggregated series rose by more than
+    ``min_increase`` between the evaluation ``window_ticks`` ago and
+    now (strictly rising trend — the "shed_rate rising" shape)."""
+
+    name: str
+    series: str
+    min_increase: float = 0.0
+    window_ticks: int = 3
+    agg: str = "sum"
+    severity: str = "warn"
+
+    def validate(self) -> None:
+        if self.window_ticks < 1:
+            raise ValueError(f"{self.name}: window_ticks must be >= 1")
+        if self.severity not in ("warn", "page"):
+            raise ValueError(f"{self.name}: severity must be 'warn' or "
+                             f"'page', got {self.severity!r}")
+        if self.agg not in _AGGS:
+            raise ValueError(f"{self.name}: agg must be one of {_AGGS}")
+
+
+@dataclasses.dataclass
+class AlertFiring:
+    """One fire transition (the ledger entry and the event payload)."""
+
+    rule: str
+    severity: str
+    t_ms: float
+    context: Dict[str, Any]
+
+
+class AlertEngine:
+    """Evaluates a rule set per scrape tick; fires on transitions.
+
+    ``events``: an :class:`~apex_tpu.monitor.events.EventLog` receiving
+    ``alert_fire``/``alert_resolve`` (the JSONL/trace artifact);
+    ``on_fire``: callable per firing (the cluster's escalation hook)."""
+
+    def __init__(self, rules: Sequence[Any] = (), events: Any = None,
+                 on_fire: Optional[Callable[[AlertFiring], Any]] = None):
+        names = set()
+        for r in rules:
+            if not isinstance(r, (AlertRule, AbsenceRule, RateRule)):
+                raise TypeError(f"not an alert rule: {r!r}")
+            r.validate()
+            if r.name in names:
+                raise ValueError(f"duplicate rule name {r.name!r}")
+            names.add(r.name)
+        self.rules = list(rules)
+        self._events = events
+        self._on_fire = on_fire
+        self._true_ticks: Dict[str, int] = {r.name: 0 for r in self.rules}
+        self._active: Dict[str, AlertFiring] = {}
+        # RateRule history: per-rule deque of the last window+1 values
+        self._history: Dict[str, collections.deque] = {
+            r.name: collections.deque(maxlen=r.window_ticks + 1)
+            for r in self.rules if isinstance(r, RateRule)}
+        self.alerts_fired_total = 0
+        self.alerts_resolved_total = 0
+        self.firings: List[AlertFiring] = []
+
+    # -- evaluation --------------------------------------------------------
+    def _rule_state(self, rule: Any, view: FleetView) -> bool:
+        if isinstance(rule, RateRule):
+            cond = Condition(series=rule.series, op=">",
+                             value=float("-inf"), agg=rule.agg)
+            v = cond.evaluate(view)
+            hist = self._history[rule.name]
+            if v is not None:
+                hist.append(v)
+            if v is None or len(hist) <= rule.window_ticks:
+                return False
+            return (hist[-1] - hist[0]) > rule.min_increase
+        return rule.holds(view)
+
+    def evaluate(self, view: FleetView,
+                 t_ms: float = 0.0) -> List[AlertFiring]:
+        """One evaluation pass; returns the NEW firings (transitions to
+        active this pass). Resolve transitions emit events but are not
+        returned — consumers act on fires."""
+        fired: List[AlertFiring] = []
+        for rule in self.rules:
+            holds = self._rule_state(rule, view)
+            n = self._true_ticks[rule.name] + 1 if holds else 0
+            self._true_ticks[rule.name] = n
+            need = getattr(rule, "for_ticks", 1)
+            if holds and n >= need and rule.name not in self._active:
+                ctx = (rule.context(view)
+                       if hasattr(rule, "context") else
+                       {rule.series: self._history[rule.name][-1]})
+                fired.append(self._fire(rule.name, rule.severity, t_ms,
+                                        ctx))
+            elif not holds and rule.name in self._active:
+                del self._active[rule.name]
+                self.alerts_resolved_total += 1
+                if self._events is not None:
+                    self._events.emit("alert_resolve", t_ms=t_ms,
+                                      rule=rule.name)
+        return fired
+
+    def fire(self, name: str, t_ms: float, severity: str = "warn",
+             **context: Any) -> AlertFiring:
+        """External-detector entry point: a verdict reached OUTSIDE the
+        scrape loop (the membership heartbeat check, a watchdog) lands
+        in the same ledger, events and hooks as an evaluated rule. The
+        firing is one-shot (no active state to resolve — the external
+        detector owns its lifecycle)."""
+        return self._fire(name, severity, t_ms, dict(context),
+                          track_active=False)
+
+    def _fire(self, name: str, severity: str, t_ms: float,
+              context: Dict[str, Any],
+              track_active: bool = True) -> AlertFiring:
+        firing = AlertFiring(rule=name, severity=severity, t_ms=t_ms,
+                             context=context)
+        if track_active:
+            self._active[name] = firing
+        self.alerts_fired_total += 1
+        self.firings.append(firing)
+        if self._events is not None:
+            self._events.emit("alert_fire", t_ms=t_ms, rule=name,
+                              severity=severity,
+                              **{f"ctx_{k}": v for k, v in context.items()
+                                 if isinstance(v, (int, float, str,
+                                                   type(None)))})
+        if self._on_fire is not None:
+            self._on_fire(firing)
+        return firing
+
+    # -- readout -----------------------------------------------------------
+    def active(self, name: Optional[str] = None) -> Any:
+        """Active alert names (or whether ``name`` is active)."""
+        if name is not None:
+            return name in self._active
+        return sorted(self._active)
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "rules": len(self.rules),
+            "alerts_fired_total": self.alerts_fired_total,
+            "alerts_resolved_total": self.alerts_resolved_total,
+            "active": self.active(),
+        }
+
+    def summary(self) -> List[Dict[str, Any]]:
+        """JSON-ready firing ledger (for bench records)."""
+        return [{"rule": f.rule, "severity": f.severity,
+                 "t_ms": round(f.t_ms, 3), "context": f.context}
+                for f in self.firings]

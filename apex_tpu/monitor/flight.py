@@ -1,0 +1,204 @@
+"""Flight recorder — a bounded ring of recent telemetry, dumped on death.
+
+The chaos harness (PR 13) exposed the tier-2 blind spot: when a worker
+is killed its JSONL tail may still sit in the sink buffer, and the
+cluster-level log says WHAT died but not what the dying worker saw in
+its last seconds. This module is the black box:
+
+* :class:`FlightRecorder` — a fixed-capacity in-memory ring of the most
+  recent records (events, step records, gauges — anything
+  ``write(**fields)``-shaped; it duck-types the
+  :class:`~apex_tpu.monitor.sink.JsonlSink` protocol so it can sit
+  anywhere a sink does, forwarding to an ``inner`` sink when given).
+  O(capacity) memory forever; ``dropped_records`` counts what the ring
+  forgot.
+* **atomic dump** — :meth:`FlightRecorder.dump` publishes the ring as
+  one JSON file with the ``resilience.checkpoint`` discipline: write to
+  a ``.tmp.<pid>`` sibling, fsync, ``os.replace`` — a crash mid-dump
+  leaves either nothing or a complete file, never a torn one (the same
+  reason a torn checkpoint never binds). Dumps carry the worker name,
+  the dump reason (``killed`` / ``stall`` / ``alert:<rule>`` / manual)
+  and the shared-clock stamp, so ``postmortem`` can order them.
+* the cluster arms one recorder per worker plus a cluster-scope ring,
+  and dumps on chaos kill, StallWatchdog fire and page-severity alert
+  escalation — ``python -m apex_tpu.monitor.postmortem DIR`` then
+  rebuilds the merged pre-failure timeline from the dumps alone.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import time
+from typing import Any, Dict, List, Mapping, Optional
+
+__all__ = ["FlightRecorder", "load_dump", "load_dumps"]
+
+DUMP_SCHEMA = 1
+DUMP_PREFIX = "flight-"
+
+
+class FlightRecorder:
+    """Bounded ring of recent records; sink-protocol compatible.
+
+    ``inner``: an optional downstream sink every record is forwarded to
+    (the ring observes, it never swallows). ``worker`` names the ring in
+    dumps; ``clock`` (ms) stamps dumps on the cluster's shared clock."""
+
+    def __init__(self, capacity: int = 2048, worker: str = "worker",
+                 inner: Any = None,
+                 clock: Optional[Any] = None):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self.worker = worker
+        self._inner = inner
+        self._clock = clock
+        self._ring: collections.deque = collections.deque(maxlen=capacity)
+        self.records_total = 0
+        self.dumps_total = 0
+
+    # -- sink protocol -----------------------------------------------------
+    def write(self, step: Optional[int] = None, metrics: Any = None,
+              **extra: Any) -> None:
+        rec: Dict[str, Any] = {}
+        if step is not None:
+            rec["step"] = int(step)
+        # stamp the shared clock: postmortem's merged timeline sorts by
+        # t_ms, and a step record without one would sort to t=0 — the
+        # head of a timeline it belongs at the tail of
+        if self._clock is not None and "t_ms" not in extra:
+            rec["t_ms"] = round(float(self._clock()), 3)
+        if metrics is not None:
+            # defer materialization: reading a Metrics pytree is a
+            # device transfer, and the ring must stay off the step's
+            # critical path — the object rides the ring and is read out
+            # only if this record survives to a dump (the inner sink
+            # makes its own read, exactly as without the ring)
+            rec["_metrics"] = metrics
+        rec.update(extra)
+        self.record(rec)
+        if self._inner is not None:
+            self._inner.write(step=step, metrics=metrics, **extra)
+
+    @staticmethod
+    def _materialize(rec: Dict[str, Any]) -> Dict[str, Any]:
+        m = rec.get("_metrics")
+        if m is None:
+            return dict(rec)
+        out = {k: v for k, v in rec.items() if k != "_metrics"}
+        vals = m.as_dict() if hasattr(m, "as_dict") else dict(m)
+        for k, v in vals.items():
+            out.setdefault(k, float(v) if hasattr(v, "__float__") else v)
+        return out
+
+    def flush(self) -> None:
+        if self._inner is not None:
+            self._inner.flush()
+
+    def record(self, rec: Mapping[str, Any]) -> None:
+        """Ring one already-shaped record (the EventLog tap path)."""
+        self._ring.append(dict(rec))
+        self.records_total += 1
+
+    # -- readout -----------------------------------------------------------
+    def __len__(self) -> int:
+        return len(self._ring)
+
+    @property
+    def dropped_records(self) -> int:
+        return self.records_total - len(self._ring)
+
+    def records(self) -> List[Dict[str, Any]]:
+        return [self._materialize(r) for r in self._ring]
+
+    # -- the dump ----------------------------------------------------------
+    def dump(self, directory: str, reason: str = "manual",
+             t_ms: Optional[float] = None) -> str:
+        """Atomically publish the ring into ``directory`` as
+        ``flight-<worker>-<n>.json``; returns the path. Atomic the
+        checkpoint way: a complete ``.tmp.<pid>`` sibling is fsynced,
+        then ONE ``os.replace`` publishes — the postmortem reader never
+        sees a torn dump. The ring is NOT cleared: a later escalation
+        re-dumps the fuller window under the next index."""
+        os.makedirs(directory, exist_ok=True)
+        if t_ms is None:
+            t_ms = self._clock() if self._clock is not None else 0.0
+        self.dumps_total += 1
+        payload = {
+            "schema": DUMP_SCHEMA,
+            "worker": self.worker,
+            "reason": reason,
+            "t_dump_ms": round(float(t_ms), 3),
+            "wall_ts": round(time.time(), 3),
+            "capacity": self.capacity,
+            "records_total": self.records_total,
+            "dropped_records": self.dropped_records,
+            "records": self.records(),
+        }
+        final = os.path.join(
+            directory, f"{DUMP_PREFIX}{self.worker}-{self.dumps_total}.json")
+        tmp = f"{final}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, final)
+        return final
+
+    def dump_to_sink(self, sink: Any, reason: str = "manual",
+                     t_ms: Optional[float] = None) -> int:
+        """Stream the ring into a shared :class:`~apex_tpu.monitor.sink.
+        JsonlSink` as ONE contiguous batch (``write_many`` — lock-scoped,
+        so a concurrent step-record writer can neither interleave the
+        batch nor split a record across a rotation boundary). The
+        no-filesystem dump path: when a cluster has a durable log but no
+        flight directory, the black box lands in the log itself, fenced
+        by a header record. Returns the number of records written."""
+        if t_ms is None:
+            t_ms = self._clock() if self._clock is not None else 0.0
+        self.dumps_total += 1
+        # every dumped record is MARKED: most of a ring's contents were
+        # already written live to the same log, and an unmarked copy
+        # would double-count steps/gauges/events in every reader —
+        # view/chrome_trace skip flight_worker-tagged records, humans
+        # grep the fenced window
+        records = [{**r, "flight_worker": self.worker}
+                   for r in self.records()]
+        header = {"kind": "flight_dump_header", "worker": self.worker,
+                  "reason": reason, "t_dump_ms": round(float(t_ms), 3),
+                  "n_records": len(records),
+                  "dropped_records": self.dropped_records}
+        sink.write_many([header] + records)
+        return len(records)
+
+
+def load_dump(path: str) -> Dict[str, Any]:
+    """Read one flight dump (raises on schema mismatch — a reader from
+    before the ring format would otherwise misparse silently)."""
+    with open(path) as f:
+        payload = json.load(f)
+    if payload.get("schema") != DUMP_SCHEMA:
+        raise ValueError(
+            f"{path}: flight-dump schema {payload.get('schema')!r} != "
+            f"{DUMP_SCHEMA}")
+    return payload
+
+
+def load_dumps(directory: str) -> List[Dict[str, Any]]:
+    """Every complete ``flight-*.json`` under ``directory``, dump-time
+    ordered. ``.tmp.*`` staging leftovers (a dumper died mid-write) are
+    skipped — the atomic-publish contract means they are never valid."""
+    out = []
+    try:
+        names = sorted(os.listdir(directory))
+    except FileNotFoundError:
+        return []
+    for name in names:
+        if (not name.startswith(DUMP_PREFIX)
+                or not name.endswith(".json")):
+            continue
+        out.append(load_dump(os.path.join(directory, name)))
+    out.sort(key=lambda d: d["t_dump_ms"])
+    return out

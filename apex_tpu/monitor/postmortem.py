@@ -1,0 +1,193 @@
+"""``python -m apex_tpu.monitor.postmortem DIR`` — rebuild the crash
+timeline from flight-recorder dumps alone.
+
+The read side of :mod:`~apex_tpu.monitor.flight`: after a chaos kill, a
+watchdog fire or an alert escalation, each worker's bounded ring was
+dumped atomically into a directory. This CLI merges every surviving
+dump into ONE causally-ordered timeline — the per-worker rings share
+the cluster's one monotonic clock, so sorting by ``t_ms`` IS the fleet
+timeline — and answers the postmortem questions without any other
+artifact:
+
+* what happened in the last N seconds before each dump (``--last-s``,
+  default: everything the rings held);
+* which requests were in flight, per TRACE id (the merged streams are
+  deduplicated and reconstructed per trace — a migrated request whose
+  events span two workers' dumps reads as one request, not two);
+* which alerts fired, which workers died, what each worker's final
+  records were.
+
+Human table to **stderr**, one machine-readable ``json_record`` line to
+**stdout** (the repo's bench pipe convention); ``--trace FILE`` also
+writes the merged Chrome trace for Perfetto.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Any, Dict, List, Optional
+
+__all__ = ["main", "merge_dumps", "rebuild"]
+
+
+def merge_dumps(dumps: List[Dict[str, Any]],
+                last_s: Optional[float] = None) -> List[Dict[str, Any]]:
+    """One deduplicated, time-ordered record stream from many dumps.
+
+    Records are tagged ``_worker`` (which ring held them) before the
+    merge; duplicates — the same event captured by two rings — collapse
+    via the shared-clock identity ``(uid, event, t_ms, start_ms)`` for
+    uid events and ``(kind/event/gauge, t_ms, worker fields)`` for the
+    rest. ``last_s`` keeps only records within that many seconds of the
+    newest record across ALL dumps (the "last N seconds" window)."""
+    from apex_tpu.monitor.events import _dedupe_events
+
+    records: List[Dict[str, Any]] = []
+    for d in dumps:
+        for r in d.get("records", []):
+            rec = dict(r)
+            rec.setdefault("_worker", d.get("worker"))
+            records.append(rec)
+    # non-uid records dedupe on their full identity minus the ring tag
+    seen = set()
+    uniq: List[Dict[str, Any]] = []
+    for r in records:
+        if r.get("kind") == "event" and "uid" in r:
+            uniq.append(r)   # _dedupe_events handles these below
+            continue
+        key = tuple(sorted((k, repr(v)) for k, v in r.items()
+                           if k != "_worker"))
+        if key in seen:
+            continue
+        seen.add(key)
+        uniq.append(r)
+    records = _dedupe_events(uniq)
+    records.sort(key=lambda r: (float(r.get("t_ms", r.get("ts", 0.0))
+                                      or 0.0)))
+    if last_s is not None and records:
+        stamps = [float(r["t_ms"]) for r in records
+                  if r.get("t_ms") is not None]
+        if stamps:
+            cutoff = max(stamps) - last_s * 1e3
+            # explicit None check: t_ms == 0.0 is a REAL stamp (the log
+            # epoch) and must be windowed out like any other old
+            # record; only records with no clock stamp at all are kept
+            records = [r for r in records
+                       if r.get("t_ms") is None
+                       or float(r["t_ms"]) >= cutoff]
+    return records
+
+
+def rebuild(dumps: List[Dict[str, Any]],
+            last_s: Optional[float] = None,
+            records: Optional[List[Dict[str, Any]]] = None
+            ) -> Dict[str, Any]:
+    """The merged postmortem record: window, per-worker dump accounting,
+    per-trace request reconstruction (the ``view`` derivation over the
+    merged stream), alert firings and deaths inside the window.
+    ``records``: a pre-merged stream from :func:`merge_dumps` (same
+    dumps, same window) so callers that also render the timeline run
+    the merge once."""
+    from apex_tpu.monitor.events import stitch_traces
+    from apex_tpu.monitor.view import summarize
+
+    if records is None:
+        records = merge_dumps(dumps, last_s=last_s)
+    events = [r for r in records if r.get("kind") == "event"]
+    summary = summarize(records)
+    stitch = stitch_traces(records)
+    alerts = [r for r in events if r["event"] == "alert_fire"]
+    deaths = [r for r in events if r["event"] == "worker_leave"]
+    ts = [float(r["t_ms"]) for r in records if "t_ms" in r]
+    out: Dict[str, Any] = {
+        "n_dumps": len(dumps),
+        "workers": sorted({d.get("worker") for d in dumps}),
+        "dump_reasons": sorted({d.get("reason") for d in dumps}),
+        "dropped_records": sum(int(d.get("dropped_records", 0))
+                               for d in dumps),
+        "window_ms": (round(max(ts) - min(ts), 3) if ts else 0.0),
+        "n_records": len(records),
+        "n_traces": len(stitch["traces"]),
+        "trace_stitch_failures": stitch["stitch_failures"],
+        "alerts_fired": [{k: r.get(k) for k in ("rule", "severity",
+                                                "t_ms")}
+                         for r in alerts],
+        "worker_leaves": [{k: r.get(k) for k in ("worker", "reason",
+                                                 "t_ms")}
+                          for r in deaths],
+        **summary,
+    }
+    return out
+
+
+def _timeline_lines(records: List[Dict[str, Any]],
+                    limit: int = 80) -> List[str]:
+    lines = []
+    shown = records[-limit:]
+    if len(records) > len(shown):
+        lines.append(f"  ... {len(records) - len(shown)} earlier records")
+    for r in shown:
+        t = r.get("t_ms", r.get("ts", ""))
+        w = r.get("host", r.get("worker", r.get("_worker", "")))
+        if r.get("kind") == "event":
+            what = r["event"]
+            who = r.get("uid", r.get("rule", ""))
+        elif r.get("kind") == "gauge":
+            what = f"gauge {r['gauge']}={r.get('value')}"
+            who = ""
+        else:
+            what = f"step {r.get('step', '?')} {r.get('phase', '')}"
+            who = ""
+        lines.append(f"  {t:>10} ms  {str(w):<10} {what:<16} {who}")
+    return lines
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    from apex_tpu.monitor.events import write_chrome_trace
+    from apex_tpu.monitor.flight import load_dumps
+    from apex_tpu.monitor.sink import json_record
+
+    ap = argparse.ArgumentParser(
+        description="rebuild the merged pre-failure timeline from "
+                    "flight-recorder dumps")
+    ap.add_argument("directory", help="directory holding flight-*.json")
+    ap.add_argument("--last-s", type=float, default=None,
+                    help="keep only the last N seconds before the newest "
+                         "record (default: everything the rings held)")
+    ap.add_argument("--trace", default=None,
+                    help="also write the merged Chrome trace here")
+    ap.add_argument("--timeline", type=int, default=40,
+                    help="timeline rows to print (0: none)")
+    args = ap.parse_args(argv)
+    dumps = load_dumps(args.directory)
+    if not dumps:
+        print(f"no flight dumps under {args.directory}", file=sys.stderr)
+        return 1
+    records = merge_dumps(dumps, last_s=args.last_s)
+    rec = rebuild(dumps, last_s=args.last_s, records=records)
+    print(f"{rec['n_dumps']} dumps from {rec['workers']} "
+          f"({rec['dump_reasons']}), {rec['n_records']} records over "
+          f"{rec['window_ms']} ms, {rec['n_traces']} traces "
+          f"({rec['trace_stitch_failures']} stitch failures)",
+          file=sys.stderr)
+    for a in rec["alerts_fired"]:
+        print(f"  ALERT {a['rule']} ({a['severity']}) @ {a['t_ms']} ms",
+              file=sys.stderr)
+    for d in rec["worker_leaves"]:
+        print(f"  LEAVE {d['worker']} ({d['reason']}) @ {d['t_ms']} ms",
+              file=sys.stderr)
+    if args.timeline:
+        for line in _timeline_lines(records, args.timeline):
+            print(line, file=sys.stderr)
+    if args.trace:
+        write_chrome_trace(args.trace, records)
+        print(f"chrome trace -> {args.trace}", file=sys.stderr)
+    print(json_record(metric="postmortem", directory=args.directory,
+                      **rec), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

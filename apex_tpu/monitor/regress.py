@@ -62,7 +62,12 @@ _HIGHER = ("tokens_per_s", "goodput", "_rps", "mfu", "occupancy",
            # the generic goodput/good_fraction fragments — listed so
            # the chaos gate's coverage is explicit next to its
            # lower-is-better duals below)
-           "goodput_under_chaos_rps", "survivor_good_fraction")
+           "goodput_under_chaos_rps", "survivor_good_fraction",
+           # fleet observability round (stage 19): the fraction of
+           # workers the FleetScraper reached (a scrape hole is a blind
+           # spot) and the fleet-wide goodput roll-up (already matched
+           # by the goodput fragment; listed for explicit coverage)
+           "scrape_coverage", "fleet_goodput_rps")
 _LOWER = ("_ms", "violation", "latency", "bubble", "exposed_bytes",
           # disaggregated cluster (stage 15): a rising shed fraction is a
           # capacity regression (transfer_ms falls under the generic
@@ -93,7 +98,15 @@ _LOWER = ("_ms", "violation", "latency", "bubble", "exposed_bytes",
           # got less stable (a retry storm, flappier membership) — all
           # lower-is-better
           "migrations_total", "replayed_tokens", "worker_deaths",
-          "heartbeat_misses", "transfer_retries")
+          "heartbeat_misses", "transfer_retries",
+          # fleet observability round (stage 19): more alert firings
+          # under the same plan means a flappier fleet, scrape_ms is the
+          # cost of the scrape itself (also caught by the generic "_ms"
+          # rule; listed so the gate's coverage is explicit), and a
+          # trace that stopped stitching across hosts is broken
+          # observability, not a style issue
+          "alerts_fired_total", "scrape_ms", "trace_stitch_failures",
+          "series_dropped_total", "scrape_misses", "dropped_records")
 
 
 def classify_metric(key: str,

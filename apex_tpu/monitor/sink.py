@@ -136,6 +136,23 @@ class JsonlSink:
         if self.log_every and step is not None and step % self.log_every == 0:
             self._log_line(fields)
 
+    def write_many(self, records: "List[Dict[str, Any]]") -> None:
+        """Append a BATCH of records contiguously — one lock scope, one
+        flush. The flight-recorder dump path needs this: a ring dumped
+        record-by-record from another thread could interleave with the
+        step loop's writes and have its records split across a rotation
+        boundary mid-batch. Here the whole batch lands in one buffered
+        flush, so every record is whole, the batch is contiguous in the
+        stream, and rotation (which only ever runs AFTER a whole-line
+        flush, under the same lock) can only happen between batches."""
+        if not self.enabled or not records:
+            return
+        ts = round(time.time(), 3)
+        lines = [json_record(**{"ts": ts, **r}) for r in records]
+        with self._iolock:
+            self._buf.extend(lines)
+            self._flush_locked()
+
     def flush(self) -> None:
         """Write buffered records as whole lines and flush the OS buffer."""
         with self._iolock:

@@ -40,8 +40,11 @@ import jax
 # apex_tpu.serve engine traces its two jitted programs under; "transfer"
 # is the disaggregated cluster's KV-block handoff between hosts
 # (serve.cluster — pack/ship/unpack around the SimTransport or ICI hop).
+# "scrape" is the fleet-observability tier's host-side phase: the
+# FleetScraper pulling worker snapshots on the cluster clock (its cost
+# is itself measured — scrape_ms — and gated by bench_observe.py).
 PHASES = ("fwd", "bwd", "comm", "opt", "ckpt", "prefill", "decode",
-          "transfer")
+          "transfer", "scrape")
 
 
 @contextlib.contextmanager

@@ -33,13 +33,37 @@ def _pct(vals: List[float], q: float) -> Optional[float]:
 def _request_latencies(events: List[Dict[str, Any]]
                        ) -> Dict[str, Dict[str, Optional[float]]]:
     """uid -> {ttft_ms, queue_ms, e2e_ms, tpot_ms, n_tokens} from the
-    lifecycle events (dimensions missing when the log lacks the events)."""
+    lifecycle events (dimensions missing when the log lacks the events).
+
+    Reconstruction is per TRACE, not per (uid, log): merged multi-worker
+    streams are deduplicated first, and a migrated request — which
+    carries a SECOND ``admitted`` (on the destination host) plus
+    ``replay``-re-emitted chunks — anchors on the FIRST ``submitted`` /
+    ``admitted`` / ``first_token`` and the LAST ``retired``, so its
+    queue wait, TTFT and e2e are the client-observed ones, not the
+    resumption bookkeeping's. (Before this, the last ``admitted`` won
+    and a migrated request double-counted its queue wait.)"""
+    from apex_tpu.monitor.events import _dedupe_events
+
+    # the EARLIEST occurrence anchors every event except the terminal
+    # ones, where the LATEST is the real end of the request — min/max by
+    # timestamp, not stream position, so merged logs read identically in
+    # any concatenation order
+    _LAST = ("retired", "shed")
     by_uid: Dict[str, Dict[str, Any]] = {}
-    for r in events:
+    for r in _dedupe_events(events):
         uid = r.get("uid")
         if uid is None:
             continue
-        by_uid.setdefault(uid, {})[r["event"]] = r
+        evs = by_uid.setdefault(uid, {})
+        cur = evs.get(r["event"])
+        if cur is None:
+            evs[r["event"]] = r
+        elif r["event"] in _LAST:
+            if float(r["t_ms"]) > float(cur["t_ms"]):
+                evs[r["event"]] = r
+        elif float(r["t_ms"]) < float(cur["t_ms"]):
+            evs[r["event"]] = r
     out: Dict[str, Dict[str, Optional[float]]] = {}
     for uid, evs in by_uid.items():
         t = {k: float(v["t_ms"]) for k, v in evs.items()}
@@ -68,7 +92,12 @@ def summarize(records: List[Dict[str, Any]],
     """The view record: event/step/gauge counts, per-request latency
     quantiles, optional SLO accounting (``slo``: an
     :class:`~apex_tpu.monitor.slo.SloSpec`)."""
-    events = [r for r in records if r.get("kind") == "event"]
+    from apex_tpu.monitor.events import _dedupe_events
+
+    # in-log flight-dump copies are marked and never counted twice
+    records = [r for r in records if "flight_worker" not in r]
+    events = [r for r in _dedupe_events(records)
+              if r.get("kind") == "event"]
     gauges = [r for r in records if r.get("kind") == "gauge"]
     steps = [r for r in records if "kind" not in r]
     lats = _request_latencies(events)
@@ -78,6 +107,14 @@ def summarize(records: List[Dict[str, Any]],
         "n_requests": len(lats),
         "n_retired": sum(1 for r in events if r["event"] == "retired"),
     }
+    # fleet-tier events, when the log carries them
+    for name, ev in (("n_migrations", "migrate_start"),
+                     ("n_replays", "replay"),
+                     ("n_alerts_fired", "alert_fire"),
+                     ("n_flight_dumps", "flight_dump")):
+        n = sum(1 for r in events if r["event"] == ev)
+        if n:
+            rec[name] = n
     for dim in ("ttft_ms", "queue_ms", "tpot_ms", "e2e_ms"):
         vals = [v[dim] for v in lats.values() if v.get(dim) is not None]
         if vals:

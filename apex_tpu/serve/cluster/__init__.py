@@ -27,6 +27,16 @@ The multi-host tier over the single-engine serve stack (ROADMAP item 2):
   injection (:class:`ClusterChaos`: kill/preempt/stall a worker at tick
   k, drop/stall/corrupt the next transfers) — the harness the live-KV-
   migration and retry claims are proven against.
+
+The fleet observability plane (monitor tier 3) is wired through the
+cluster: a trace id minted per submission threads every worker's
+events (one Perfetto track per host), each worker is a
+:class:`~apex_tpu.monitor.registry.FleetScraper` target (Prometheus-
+style snapshots merged on the cluster clock), the
+:class:`~apex_tpu.monitor.alerts.AlertEngine` drives autoscaling and
+brands heartbeat/watchdog deaths, and per-worker
+:class:`~apex_tpu.monitor.flight.FlightRecorder` rings dump atomically
+on kill/stall/escalation for ``python -m apex_tpu.monitor.postmortem``.
 """
 
 from apex_tpu.serve.cluster.chaos import (  # noqa: F401

@@ -63,8 +63,11 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 
+from apex_tpu.monitor.alerts import AlertEngine, AlertRule, Condition
 from apex_tpu.monitor.events import EventLog
+from apex_tpu.monitor.flight import FlightRecorder
 from apex_tpu.monitor.hist import DEFAULT_LATENCY_SPEC, Histogram
+from apex_tpu.monitor.registry import FleetScraper, MetricsRegistry
 from apex_tpu.monitor.trace import span
 from apex_tpu.resilience.preemption import StallWatchdog
 from apex_tpu.serve.cluster.chaos import ClusterChaos
@@ -94,6 +97,23 @@ from apex_tpu.serve.engine import Request, ServeConfig
 Pytree = Any
 
 __all__ = ["ClusterConfig", "ServeCluster"]
+
+
+class _WorkerSink:
+    """Per-worker step-record shim: stamps ``host=`` on every record so
+    step records join the host-attributed event stream, rings it into
+    the worker's flight recorder (which forwards to the shared sink)."""
+
+    def __init__(self, ring: FlightRecorder, host: str):
+        self._ring = ring
+        self._host = host
+
+    def write(self, step=None, metrics=None, **extra) -> None:
+        extra.setdefault("host", self._host)
+        self._ring.write(step=step, metrics=metrics, **extra)
+
+    def flush(self) -> None:
+        self._ring.flush()
 
 
 @dataclasses.dataclass(frozen=True)
@@ -131,6 +151,18 @@ class ClusterConfig:
     transfer_max_retries: int = 3
     retry_backoff_ms: float = 10.0
     autoscale: Optional[AutoscalePolicy] = None
+    # fleet observability (monitor tier 3). scrape_every: FleetScraper
+    # cadence in cluster ticks; extra declarative alert rules ride
+    # alert_rules (the autoscale policy's thresholds compile into
+    # scale_up/scale_down rules automatically). flight_capacity bounds
+    # the per-worker flight-recorder rings; flight_dir (when set) is
+    # where rings dump on chaos kill / watchdog fire / page-severity
+    # alert escalation (unset: rings still record, dump on demand via
+    # ServeCluster.dump_flight).
+    scrape_every: int = 1
+    alert_rules: Tuple[Any, ...] = ()
+    flight_capacity: int = 2048
+    flight_dir: Optional[str] = None
 
     def validate(self) -> None:
         if self.n_prefill < 1:
@@ -153,6 +185,21 @@ class ClusterConfig:
             raise ValueError("retry_backoff_ms must be >= 0")
         if self.autoscale is not None:
             self.autoscale.validate()
+        if self.scrape_every < 0:
+            raise ValueError("scrape_every must be >= 0 (0: scraping off)")
+        if ((self.autoscale is not None or self.alert_rules)
+                and self.scrape_every == 0):
+            # autoscaling and alert rules act on the alert engine, and
+            # the alert engine evaluates over scraped views — with
+            # scraping off every rule would silently never fire; fail
+            # the configuration loudly instead
+            raise ValueError(
+                "autoscale/alert_rules need scrape_every >= 1: alert "
+                "rules evaluate over the scraped fleet view, so a "
+                "non-scraping cluster can never fire them")
+        if self.flight_capacity < 0:
+            raise ValueError(
+                "flight_capacity must be >= 0 (0: flight recorder off)")
 
 
 class ServeCluster:
@@ -196,6 +243,39 @@ class ServeCluster:
             heartbeat_timeout_ms=cluster_cfg.heartbeat_timeout_ms,
             events=self._events, autoscale=cluster_cfg.autoscale)
         self._chaos = chaos
+        # -- fleet observability (monitor tier 3) --------------------------
+        # distributed tracing: one trace id minted per submission, bound
+        # to the uid so EVERY producer's events carry it
+        self._trace_seq = 0
+        # flight recorders: one bounded ring per worker + one
+        # cluster-scope ring for router/transfer/membership records;
+        # records route by their host attribution via an EventLog tap
+        self._flight: Dict[str, FlightRecorder] = {}
+        self._flight_cluster: Optional[FlightRecorder] = None
+        if cluster_cfg.flight_capacity > 0:
+            self._flight_cluster = FlightRecorder(
+                cluster_cfg.flight_capacity, worker="cluster",
+                clock=self._events.now_ms)
+            self._events.tap(self._route_flight)
+        # alert rules: user rules + the autoscale policy's thresholds
+        # compiled into scale_up/scale_down rules — the engine's
+        # firings, not raw gauge peeks, are what trigger scaling
+        rules = list(cluster_cfg.alert_rules)
+        if cluster_cfg.autoscale is not None:
+            pol = cluster_cfg.autoscale
+            rules.append(AlertRule("scale_up", conditions=(
+                Condition("cluster_queue_depth", ">=",
+                          pol.scale_up_queue_depth),
+                Condition("occupancy", ">=", pol.scale_up_occupancy,
+                          agg="avg"))))
+            rules.append(AlertRule("scale_down", conditions=(
+                Condition("cluster_queue_depth", "<=", 0),
+                Condition("occupancy", "<=", pol.scale_down_occupancy,
+                          agg="avg"))))
+        self._alerts = AlertEngine(rules, events=self._events,
+                                   on_fire=self._on_alert)
+        self.scraper = FleetScraper(self._scrape_targets,
+                                    clock=self._events.now_ms)
         scfg = cluster_cfg.serve
         # decode hosts keep the full engine feature set minus the prefix
         # cache (blocks arrive by wire, not by content address); prefill
@@ -220,6 +300,8 @@ class ServeCluster:
                           queue_limit=cluster_cfg.prefill_queue_limit,
                           use_pallas=use_pallas, name=f"prefill{i}")
             for i in range(cluster_cfg.n_prefill)]
+        for w in self.prefill_workers:
+            self._arm_flight(w.name)
         self.decode_workers = [
             self._make_decode_worker(f"decode{i}")
             for i in range(cluster_cfg.n_decode)]
@@ -275,20 +357,163 @@ class ServeCluster:
         self._prev_tick_start_ms: Optional[float] = None
 
     def _make_decode_worker(self, name: str) -> DecodeWorker:
+        ring = self._arm_flight(name)
+        # the engine's step records flow host-stamped through the
+        # worker's flight ring (which forwards to the shared sink) —
+        # the ring is the black box, the sink stays the durable log
+        sink = (_WorkerSink(ring, name) if ring is not None
+                else self._sink)
         return DecodeWorker(
             self._params, self.cfg, self._decode_cfg,
             base_key=self._base_key,
-            wire_mode=self.cluster_cfg.wire_mode, sink=self._sink,
+            wire_mode=self.cluster_cfg.wire_mode, sink=sink,
             events=self._events, slo=self.cluster_cfg.router.slo,
             retain_streams=False, on_retire=self._retired,
             use_pallas=self._use_pallas,
             peak_flops_per_s=self._peak_flops_per_s, name=name)
+
+    # -- flight recorders (monitor tier 3) ---------------------------------
+    def _arm_flight(self, name: str) -> Optional[FlightRecorder]:
+        if self.cluster_cfg.flight_capacity <= 0:
+            return None
+        ring = self._flight.get(name)
+        if ring is None:
+            ring = FlightRecorder(
+                self.cluster_cfg.flight_capacity, worker=name,
+                inner=self._sink, clock=self._events.now_ms)
+            self._flight[name] = ring
+        return ring
+
+    def _route_flight(self, rec: Dict[str, Any]) -> None:
+        """EventLog tap: every event/gauge record lands in exactly one
+        ring — the named worker's when the record is host-attributed
+        (bound or explicit), else the cluster-scope ring."""
+        host = rec.get("host") or rec.get("worker")
+        ring = self._flight.get(host) if host is not None else None
+        if ring is not None:
+            ring.record(rec)
+        elif self._flight_cluster is not None:
+            self._flight_cluster.record(rec)
+
+    def _flight_rings(self) -> Dict[str, FlightRecorder]:
+        out = dict(self._flight)
+        if self._flight_cluster is not None:
+            out["cluster"] = self._flight_cluster
+        return out
+
+    def dump_flight(self, directory: Optional[str] = None,
+                    reason: str = "manual",
+                    workers: Optional[Sequence[str]] = None) -> List[str]:
+        """Atomically dump flight rings (all, or ``workers``) into
+        ``directory`` (default ``ClusterConfig.flight_dir``); returns
+        the dump paths and events each dump. ``python -m
+        apex_tpu.monitor.postmortem DIR`` rebuilds the merged timeline
+        from these files alone. With NO directory configured but a
+        durable sink wired, each ring instead streams into the shared
+        JSONL as one contiguous ``write_many`` batch (header-fenced) —
+        the black box lands in the log the operator already has."""
+        directory = directory or self.cluster_cfg.flight_dir
+        if self.cluster_cfg.flight_capacity <= 0:
+            return []
+        t = self._now_ms()
+        paths = []
+        to_sink = (directory is None and self._sink is not None
+                   and hasattr(self._sink, "write_many"))
+        if directory is None and not to_sink:
+            return []
+        for name, ring in sorted(self._flight_rings().items()):
+            if workers is not None and name not in workers:
+                continue
+            if to_sink:
+                ring.dump_to_sink(self._sink, reason=reason, t_ms=t)
+                path = f"sink:{name}"
+            else:
+                path = ring.dump(directory, reason=reason, t_ms=t)
+            paths.append(path)
+            self._events.emit("flight_dump", t_ms=t, worker=name,
+                              reason=reason, path=path)
+        return paths
+
+    def _flight_sink_ok(self) -> bool:
+        return (self.cluster_cfg.flight_dir is not None
+                or (self._sink is not None
+                    and hasattr(self._sink, "write_many")))
+
+    def _dump_on_death(self, name: str, reason: str) -> None:
+        """A worker died for a non-voluntary reason: preserve ITS ring
+        and the cluster-scope ring (router/transfer context) before the
+        telemetry goes stale — the chaos-kill black-box path."""
+        if self._flight_sink_ok():
+            self.dump_flight(reason=reason,
+                             workers=(name, "cluster"))
+
+    def _on_alert(self, firing) -> None:
+        """Page-severity firings escalate: every surviving ring dumps
+        (the 'capture the whole fleet's last seconds' trigger)."""
+        if firing.severity == "page" and self._flight_sink_ok():
+            self.dump_flight(reason=f"alert:{firing.rule}")
 
     def _arm_watchdog(self, name: str) -> None:
         self._watchdogs[name] = StallWatchdog(
             timeout_s=self.cluster_cfg.watchdog_timeout_ms / 1e3,
             sink=self._sink,
             clock=lambda: self._events.now_ms() / 1e3)
+
+    # -- fleet scraping (monitor tier 3) -----------------------------------
+    def _scrape_targets(self) -> List:
+        """The FleetScraper's live target set: the cluster's own series
+        plus every non-dead worker. A chaos-stalled worker is a SCRAPE
+        MISS (its target answers None) — coverage drops below 1.0 and
+        an absence rule over its series can fire, exactly how a wedged
+        exporter looks to a real scraper."""
+        out: List = [("cluster", self._scrape_self)]
+        for w in self.prefill_workers + self.decode_workers:
+            if self._state(w.name) == DEAD:
+                continue
+            if w.name in self._stalled:
+                out.append((w.name, lambda: None))
+            else:
+                out.append((w.name, w.scrape))
+        return out
+
+    def _scrape_self(self) -> Dict[str, Any]:
+        """Router/transport/membership series (the per-tenant plane
+        rides tenant labels; the registry bound tracks the router's own
+        tenant-state bound so a tenant flood degrades loudly, never
+        unboundedly)."""
+        limit = self.cluster_cfg.router.max_tenant_states or 1024
+        reg = MetricsRegistry(max_series=4 * limit + 64)
+        t = self._now_ms()
+        L = {"worker": "cluster"}
+        r = self.router
+        reg.gauge("cluster_queue_depth", float(r.queue_depth), t_ms=t, **L)
+        reg.gauge("queued_tokens", float(r.queued_tokens()), t_ms=t, **L)
+        reg.counter("submitted_total", r.submitted, **L)
+        reg.counter("admitted_total", r.admitted, **L)
+        reg.counter("shed_total", r.shed, **L)
+        reg.gauge("shed_rate",
+                  (r.shed / r.submitted) if r.submitted else 0.0,
+                  t_ms=t, **L)
+        reg.gauge("transfers_in_flight", float(self.transport.in_flight),
+                  t_ms=t, **L)
+        reg.counter("transfer_retries_total", self.transfer_retries, **L)
+        reg.counter("migrations_total", self.migrations_total, **L)
+        reg.counter("worker_deaths_total", self.membership.worker_deaths,
+                    **L)
+        for tenant, rec in self.router.tenants.items():
+            reg.counter("tenant_submitted_total", rec["submitted"],
+                        tenant=tenant)
+            reg.counter("tenant_admitted_total", rec["admitted"],
+                        tenant=tenant)
+            reg.counter("tenant_shed_total", rec["shed"], tenant=tenant)
+        if self.membership.heartbeat_timeout_ms is not None:
+            for name in self.membership.names():
+                wrec = self.membership.record(name)
+                if wrec.state != DEAD:
+                    reg.gauge("heartbeat_age_ms",
+                              max(0.0, t - wrec.last_beat_ms),
+                              t_ms=t, worker=name)
+        return reg.snapshot(t)
 
     # -- lifecycle ---------------------------------------------------------
     def _now_ms(self) -> float:
@@ -299,6 +524,9 @@ class ServeCluster:
             self._finished[uid] = tokens
         if self._on_retire is not None:
             self._on_retire(uid, tokens)
+        # terminal: the trace's bound fields (trace id, tenant, host)
+        # are no longer needed — the table stays O(in-flight)
+        self._events.unbind(uid)
 
     def submit(self, request: Request) -> None:
         """Route one request in. Input validation mirrors the engine's
@@ -316,6 +544,14 @@ class ServeCluster:
         t = self._now_ms()
         if self._t_first_submit_ms is None:
             self._t_first_submit_ms = t
+        # mint the request's trace id HERE — router submission is the
+        # start of the distributed trace; binding threads it (plus the
+        # tenant) through every later producer's events, across hosts
+        # and migrations, without any producer knowing about tracing
+        self._trace_seq += 1
+        self._events.bind(request.uid,
+                          trace=f"tr{self._trace_seq:06d}",
+                          tenant=getattr(request, "tenant", "default"))
         self._events.emit("submitted", request.uid, t_ms=t,
                           prompt_tokens=p,
                           max_new_tokens=request.max_new_tokens,
@@ -335,6 +571,7 @@ class ServeCluster:
             predicted_ttft_ms=(round(d.predicted_ttft_ms, 3)
                                if d.predicted_ttft_ms is not None else None),
             budget_ms=d.budget_ms)
+        self._events.unbind(d.request.uid)  # terminal state
 
     # -- membership views --------------------------------------------------
     def _state(self, name: str) -> str:
@@ -362,6 +599,11 @@ class ServeCluster:
         if not self.membership.mark_dead(name, t, "killed"):
             return
         self._evacuate(name, t)
+        # black box: the dying worker's ring (holding its last records
+        # INCLUDING the migrate_start exits evacuation just stamped) and
+        # the cluster ring's router-side context dump atomically — the
+        # postmortem CLI rebuilds the pre-kill timeline from these alone
+        self._dump_on_death(name, "killed")
 
     def preempt_worker(self, name: str) -> None:
         """Deliver a preemption through the worker's PreemptionHandler —
@@ -593,29 +835,41 @@ class ServeCluster:
                         handoffs_pending=len(w._pending),
                         last_beat_ms=round(
                             self.membership.record(name).last_beat_ms, 3))
+                # the watchdog verdict is an alert: same ledger, same
+                # events, same escalation plane as an evaluated rule
+                self._alerts.fire("watchdog_stall", t_ms, worker=name)
                 self.membership.mark_dead(name, t_ms, "stall")
                 self._evacuate(name, t_ms)
+                self._dump_on_death(name, "stall")
                 n += 1
         return n
 
     def _autoscale(self, t_ms: float) -> None:
-        if (self.membership.autoscale_policy is not None
-                and not self.alive_decode_workers()):
-            # headless with autoscale armed: the gauges can never ask
-            # for a join (occupancy of zero capacity is 0.0), but lost
-            # capacity must be replaced or the fleet stays headless
-            # forever — spawn immediately (0 alive is always under the
-            # fleet cap, which counts ALIVE workers)
+        """Act on the ALERT ENGINE's scale firings (the thresholds are
+        declarative rules over the scraped fleet view — no gauge
+        peeking here); membership's ``approve_scale`` stays the one
+        cooldown/fleet-bounds actuation gate."""
+        if self.membership.autoscale_policy is None:
+            return
+        if not self.alive_decode_workers():
+            # headless with autoscale armed: no occupancy series exists
+            # for a rule to fire on (zero capacity exports nothing), but
+            # lost capacity must be replaced or the fleet stays headless
+            # forever — an explicit page-severity firing records WHY the
+            # spawn happened, then spawn immediately (0 alive is always
+            # under the fleet cap, which counts ALIVE workers)
+            self._alerts.fire("fleet_headless", t_ms, severity="page",
+                              alive_decode=0)
             self.spawn_decode_worker()
             self.membership.autoscale_ups += 1
             return
-        decision = self.membership.autoscale_decision(
-            self.router.queue_depth, self.occupancy(), t_ms)
-        if decision == "up":
+        if (self._alerts.active("scale_up")
+                and self.membership.approve_scale("up", t_ms)):
             self.spawn_decode_worker()
-        elif decision == "down":
+        elif self._alerts.active("scale_down"):
             candidates = self.alive_decode_workers()
-            if len(candidates) > 1:
+            if (len(candidates) > 1
+                    and self.membership.approve_scale("down", t_ms)):
                 victim = min(candidates, key=lambda w: w.load)
                 self.request_drain(victim.name, "scale_down")
 
@@ -722,7 +976,17 @@ class ServeCluster:
         floor = self._prev_tick_start_ms
         for name in self.membership.check_heartbeats(t,
                                                      beat_floor_ms=floor):
+            # the heartbeat verdict (reached by the beat-floor detector,
+            # not a scraped rule — the floor logic needs per-tick state
+            # a series can't carry) lands in the alert plane: one
+            # ledger, one event stream, and the firing is what precedes
+            # the migration in the trace
+            self._alerts.fire(
+                "heartbeat_absent", t, worker=name,
+                last_beat_ms=round(
+                    self.membership.record(name).last_beat_ms, 3))
             self._evacuate(name, t)
+            self._dump_on_death(name, "heartbeat")
             moved += 1
         moved += self._check_watchdogs(t, floor)
         with span("transfer"):
@@ -760,6 +1024,14 @@ class ServeCluster:
             wd = self._watchdogs.get(w.name)
             if wd is not None:
                 wd.tick(self._step_idx)
+        # fleet observability tick: scrape the live workers into one
+        # view, evaluate the alert rules over it — autoscale (below)
+        # acts on the engine's ACTIVE alerts, not on raw gauges
+        if (self.cluster_cfg.scrape_every
+                and self._step_idx % self.cluster_cfg.scrape_every == 0):
+            with span("scrape"):
+                view = self.scraper.scrape(self._now_ms())
+            self._alerts.evaluate(view, self._now_ms())
         self._autoscale(t)
         # transfers still on the (modeled-latency) wire — or waiting out
         # a retry backoff / failure-detection timeout — count as pending
@@ -957,6 +1229,9 @@ class ServeCluster:
             out["slo_report"] = slo_rep
             out["goodput_rps"] = slo_rep["goodput_rps"]
             out["good_fraction"] = slo_rep["good_fraction"]
+            # the fleet roll-up alias (regress-gated higher-is-better):
+            # cluster-wide goodput as the scrape/alert plane reports it
+            out["fleet_goodput_rps"] = slo_rep["goodput_rps"]
         out["prefill_hosts"] = [
             {"host": w.name, "state": self._state(w.name),
              "chunks_run": w.chunks_run,
@@ -972,6 +1247,25 @@ class ServeCluster:
              "migrations_out": w.migrations_out,
              "occupancy": w.engine.occupancy()}
             for w in self.decode_workers]
+        # the fleet observability plane's own accounting (monitor tier
+        # 3): scrape cost/coverage, alert ledger, flight-ring fill —
+        # flat headline duals (alerts_fired_total / scrape_ms /
+        # scrape_coverage / trace stitch) are regress-gated
+        fleet: Dict[str, Any] = dict(self.scraper.stats())
+        fleet["alerts"] = self._alerts.stats()
+        fleet["traces_minted"] = self._trace_seq
+        if self._flight_cluster is not None:
+            fleet["flight"] = {
+                name: {"records": len(ring),
+                       "dropped_records": ring.dropped_records,
+                       "dumps": ring.dumps_total}
+                for name, ring in sorted(self._flight_rings().items())}
+        out["fleet"] = fleet
+        out["alerts_fired_total"] = self._alerts.alerts_fired_total
+        if self.scraper.last_coverage is not None:
+            out["scrape_coverage"] = self.scraper.last_coverage
+        if self.scraper.scrape_ms_hist.total:
+            out["scrape_ms_p50"] = fleet.get("scrape_ms_p50")
         if self._chaos is not None:
             out["chaos"] = self._chaos.summary()
         return out

@@ -214,30 +214,47 @@ class ClusterMembership:
         return newly_dead
 
     # -- autoscale ---------------------------------------------------------
+    def approve_scale(self, direction: str, t_ms: float) -> bool:
+        """Gate one scale action: cooldown + fleet bounds + the
+        counters. The THRESHOLD half of autoscaling now lives in the
+        alert-rules engine (``scale_up``/``scale_down`` rules evaluated
+        over scraped series — see ``ServeCluster``); this method is the
+        actuation gate an active alert must still pass, so rate
+        limiting and min/max fleet size stay enforced in one place no
+        matter who asks."""
+        pol = self.autoscale_policy
+        if pol is None or direction not in ("up", "down"):
+            return False
+        if (self._last_scale_ms is not None
+                and t_ms - self._last_scale_ms < pol.cooldown_ms):
+            return False
+        n_alive = len(self.names(kind="decode", state=ALIVE))
+        if direction == "up" and n_alive < pol.max_decode:
+            self._last_scale_ms = float(t_ms)
+            self.autoscale_ups += 1
+            return True
+        if direction == "down" and n_alive > pol.min_decode:
+            self._last_scale_ms = float(t_ms)
+            self.autoscale_downs += 1
+            return True
+        return False
+
     def autoscale_decision(self, queue_depth: int, occupancy: float,
                            t_ms: float) -> Optional[str]:
-        """``"up"`` / ``"down"`` / None from the policy against the
-        live backlog/occupancy gauges, cooldown-limited. The caller
-        performs the action and the resulting join/drain is what shows
-        up in the ledger — a decision during cooldown is simply not
-        made."""
+        """COMPAT: ``"up"`` / ``"down"`` / None straight off the gauge
+        values (threshold + cooldown + bounds in one call). The cluster
+        no longer calls this — its thresholds are alert rules and only
+        :meth:`approve_scale` runs here — but external callers sizing a
+        fleet off raw gauges keep working."""
         pol = self.autoscale_policy
         if pol is None:
             return None
-        if (self._last_scale_ms is not None
-                and t_ms - self._last_scale_ms < pol.cooldown_ms):
-            return None
-        n_alive = len(self.names(kind="decode", state=ALIVE))
         if (queue_depth >= pol.scale_up_queue_depth
                 and occupancy >= pol.scale_up_occupancy
-                and n_alive < pol.max_decode):
-            self._last_scale_ms = float(t_ms)
-            self.autoscale_ups += 1
+                and self.approve_scale("up", t_ms)):
             return "up"
         if (queue_depth == 0 and occupancy <= pol.scale_down_occupancy
-                and n_alive > pol.min_decode):
-            self._last_scale_ms = float(t_ms)
-            self.autoscale_downs += 1
+                and self.approve_scale("down", t_ms)):
             return "down"
         return None
 

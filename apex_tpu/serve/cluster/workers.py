@@ -218,6 +218,21 @@ class PrefillWorker:
         return {"chunk_prefill": _cache_size_of(self._chunk_prefill),
                 "extract": _cache_size_of(self._extract)}
 
+    def scrape(self) -> Dict[str, Any]:
+        """FleetScraper target: this host's live series as one registry
+        snapshot (``worker=``/``kind="prefill"`` labeled)."""
+        from apex_tpu.monitor.registry import MetricsRegistry
+
+        reg = MetricsRegistry()
+        t = self._now_ms()
+        L = {"worker": self.name, "kind": "prefill"}
+        reg.gauge("worker_up", 1.0, t_ms=t, **L)
+        reg.gauge("backlog_tokens", float(self.backlog_tokens), t_ms=t,
+                  **L)
+        reg.counter("prefill_chunks_total", self.chunks_run, **L)
+        reg.counter("prefills_done_total", self.prefills_done, **L)
+        return reg.snapshot(t)
+
     # -- drain / failure (the elastic tier) --------------------------------
     def drain_queued(self) -> List:
         """Hand back every accepted-but-unstarted ``(request,
@@ -252,6 +267,12 @@ class PrefillWorker:
         row[:len(blocks)] = blocks
         t = self._now_ms()
         if self._events is not None:
+            # the request's CURRENT host: every event it emits from here
+            # (incl. the cluster's transfer_start, stamped while it
+            # still belongs to this host) defaults to this host track
+            # until the decode side rebinds — the distributed-tracing
+            # contract
+            self._events.bind(request.uid, host=self.name)
             self._events.emit("prefill_start", request.uid, t_ms=t,
                               host=self.name, prompt_tokens=p,
                               chunk=self.serve_cfg.prefill_chunk)
@@ -384,6 +405,23 @@ class DecodeWorker:
         out["insert"] = _cache_size_of(self._insert)
         return out
 
+    def scrape(self) -> Dict[str, Any]:
+        """FleetScraper target: the engine's series plus this worker's
+        handoff/migration counters, one registry snapshot."""
+        from apex_tpu.monitor.registry import MetricsRegistry
+
+        reg = MetricsRegistry()
+        t = self.engine._now_ms()
+        self.engine.collect_registry(reg, worker=self.name, t_ms=t)
+        L = {"worker": self.name, "kind": "decode"}
+        reg.gauge("handoffs_pending", float(len(self._pending)), t_ms=t,
+                  **L)
+        reg.counter("handoffs_admitted_total", self.admitted, **L)
+        reg.counter("migrations_in_total", self.migrations_in, **L)
+        reg.counter("migrations_out_total", self.migrations_out, **L)
+        reg.counter("replayed_tokens_total", self.replayed_tokens, **L)
+        return reg.snapshot(t)
+
     def _land_payload(self, h: KVHandoff, blocks: List[int]) -> None:
         """Run the ONE compiled insert: destination ids padded out of
         range (insert drops them), payload zero-padded to the fixed
@@ -413,6 +451,10 @@ class DecodeWorker:
         blocks = eng.allocator.alloc(n_blocks)
         if blocks is None:
             return False
+        if self._events is not None:
+            # the request now lives HERE: engine-emitted events
+            # (decode_chunk, retired) default to this host track
+            self._events.bind(h.request.uid, host=self.name)
         self._land_payload(h, blocks)
         # ONE slot-install implementation: the engine's restore_slot is
         # the canonical grid-state writer for handoff admission AND
@@ -459,6 +501,10 @@ class DecodeWorker:
         blocks = eng.allocator.alloc(eng.kv_cfg.blocks_for_tokens(total))
         if blocks is None:
             return False
+        if self._events is not None:
+            # migration landed: rebind the trace's host so the resumed
+            # stream's events sit on the NEW host track
+            self._events.bind(h.request.uid, host=self.name)
         self._land_payload(h, blocks)
         generated = list(h.generated or [])
         record = {

@@ -1263,6 +1263,51 @@ class InferenceEngine:
             out["slo_report"] = self._slo.report()
         return out
 
+    # -- fleet exposition (monitor tier 3) --------------------------------
+    def collect_registry(self, reg, worker: str = "engine",
+                         t_ms: Optional[float] = None,
+                         include_hists: bool = False) -> None:
+        """Populate a :class:`~apex_tpu.monitor.registry.MetricsRegistry`
+        with this engine's live series, labeled ``worker=``. Counters
+        are cumulative-at-scrape (the Prometheus pull model: the fleet
+        view sums across WORKERS, never across time); ``include_hists``
+        additionally snapshots the latency histograms (skipped on the
+        per-tick scrape cadence — quantile merges belong to stats())."""
+        if t_ms is None:
+            t_ms = self._now_ms()
+        L = {"worker": worker}
+        reg.gauge("worker_up", 1.0, t_ms=t_ms, **L)
+        reg.counter("requests_completed_total", self._completed, **L)
+        reg.counter("requests_rejected_total", self._rejected, **L)
+        reg.counter("tokens_generated_total", self._tokens_generated, **L)
+        reg.counter("decode_steps_total",
+                    self._decode_steps + self._verify_steps, **L)
+        reg.gauge("occupancy", self.occupancy(), t_ms=t_ms, **L)
+        reg.gauge("queue_depth", float(len(self._pending)), t_ms=t_ms, **L)
+        reg.gauge("backlog_tokens", float(self._prefill_backlog_tokens()),
+                  t_ms=t_ms, **L)
+        if self._slo is not None:
+            reg.counter("slo_good_total", self._slo.good, **L)
+        if include_hists:
+            for name, h in self.hists.items():
+                reg.set_histogram(name, h, **L)
+
+    def scrape(self, worker: str = "engine",
+               t_ms: Optional[float] = None,
+               include_hists: bool = False) -> Dict[str, Any]:
+        """One :class:`~apex_tpu.monitor.registry.MetricsRegistry`
+        snapshot of this engine (what a ``FleetScraper`` target
+        returns; ``MetricsRegistry.expose_text`` of the same registry
+        is the Prometheus text endpoint)."""
+        from apex_tpu.monitor.registry import MetricsRegistry
+
+        reg = MetricsRegistry()
+        if t_ms is None:
+            t_ms = self._now_ms()
+        self.collect_registry(reg, worker=worker, t_ms=t_ms,
+                              include_hists=include_hists)
+        return reg.snapshot(t_ms)
+
     @property
     def active(self) -> bool:
         """Whether the engine still has work: a slot mid-generation or

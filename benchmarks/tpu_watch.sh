@@ -29,8 +29,14 @@
 #  14 fsdp A/B        bench_fsdp.py         -> FSDP_TPU.json
 #  15 serve multihost bench_serve_mh.py --hosts 2 -> SERVE_MH_TPU.json
 #  16 contract check  analyze_contracts.py  -> ANALYZE_TPU.json
+#  17 sub-8-bit tier  bench_serve_mh.py --kv-quant int4 + bench_comm.py
+#                                           -> SERVE_KV4_TPU.json
+#                                              + COMM_SUB8_TPU.json
+#  18 serve chaos     bench_serve_mh.py --hosts 3 --chaos
+#                                           -> SERVE_CHAOS_TPU.json
+#  19 observe A/B     bench_observe.py      -> OBSERVE_TPU.json
 # After the first seven, later healthy probes only refresh stage 1+3
-# (hourly) so the banked number tracks the latest code; stages 8-17
+# (hourly) so the banked number tracks the latest code; stages 8-19
 # ride the same hourly cadence until banked (additive evidence that must
 # never hold the suite out of refresh mode).
 cd /root/repo || exit 1
@@ -51,6 +57,7 @@ last_mh=-3600       # stage-15 (disaggregated serve cluster) same contract
 last_analyze=-3600  # stage-16 (compiled-program contract check) same
 last_sub8=-3600     # stage-17 (sub-8-bit: int4 KV + comm wire A/B) same
 last_chaos=-3600    # stage-18 (elastic serve chaos: kill-and-migrate) same
+last_observe=-3600  # stage-19 (fleet observability overhead A/B) same
 
 note() { echo "$(date '+%F %T') $*" >> "$LOG"; }
 
@@ -569,6 +576,53 @@ $(cat /tmp/tpu_stage18_regress.out)"
   return 0
 }
 
+observe_stage() {
+  # stage 19: fleet observability overhead A/B — bench_observe.py runs
+  # the loadgen workload through a disaggregated cluster twice (full
+  # tracing + flight rings + FleetScraper + alert rules vs all off) and
+  # records tokens/s both sides, observe_overhead_pct (ok=false past
+  # the 5% budget), scrape_ms p50/p99, events/s, alerts_fired_total and
+  # trace_stitch_failures (must be 0). Same promote rules as stages
+  # 10-18: CPU rehearsals never promote (CPU decode steps flatter the
+  # overhead ~10x), ok=false (overhead blown / stitching broken /
+  # streams perturbed) never promotes, REGRESSION-GATED via
+  # monitor.regress --tol 0.15 once banked (alerts_fired_total /
+  # scrape_ms / trace_stitch_failures lower-is-better, scrape_coverage
+  # / fleet_goodput_rps higher — the new polarity rows); hourly even
+  # after banked so a creeping observability tax surfaces within an
+  # hour.
+  note "STAGE19 START: bench_observe.py"
+  rm -f /tmp/observe_try.json
+  timeout 1800 python benchmarks/bench_observe.py \
+    --out /tmp/observe_try.json \
+    > /tmp/tpu_stage19.out 2> /tmp/tpu_stage19.err
+  local rc=$?
+  note "STAGE19 EXIT=$rc"
+  [ -s /tmp/observe_try.json ] || return 1
+  if grep -q CPU_FALLBACK /tmp/observe_try.json; then
+    note "STAGE19 got CPU_FALLBACK, not promoting"
+    return 1
+  fi
+  if grep -Eq '"ok": false' /tmp/observe_try.json; then
+    note "STAGE19 record has ok false, not promoting"
+    return 1
+  fi
+  if [ -s OBSERVE_TPU.json ]; then
+    if ! python -m apex_tpu.monitor.regress OBSERVE_TPU.json \
+        /tmp/observe_try.json --tol 0.15 \
+        > /tmp/tpu_stage19_regress.out 2>> /tmp/tpu_stage19.err; then
+      note "STAGE19 REGRESSION vs banked, keeping banked record: \
+$(cat /tmp/tpu_stage19_regress.out)"
+      return 1
+    fi
+  fi
+  cp /tmp/observe_try.json OBSERVE_TPU.json
+  note "STAGE19 PROMOTED $(cat OBSERVE_TPU.json)"
+  [ $rc -eq 0 ] || return 1
+  [ "$(cat "$STATE")" -eq 18 ] && echo 19 > "$STATE"
+  return 0
+}
+
 smoke_stage() {
   # Smoke to a temp file; promote ANY real-TPU artifact (a failing kernel
   # on the chip is exactly the evidence we must bank) but never a CPU
@@ -687,6 +741,13 @@ while true; do
           chaos_stage
           last_chaos=$now
         fi
+        # stage 19 (fleet observability overhead A/B): same contract —
+        # an observability tax past 5% or broken trace stitching must
+        # surface within an hour
+        if [ $((now - last_observe)) -ge 3600 ]; then
+          observe_stage
+          last_observe=$now
+        fi
         last_refresh=$now
       fi
     else
@@ -785,6 +846,12 @@ while true; do
           && [ $((now - last_chaos)) -ge 3600 ]; then
         chaos_stage
         last_chaos=$now
+      fi
+      # stage 19: fleet observability overhead A/B, same contract.
+      if [ "$(cat "$STATE")" -eq 18 ] \
+          && [ $((now - last_observe)) -ge 3600 ]; then
+        observe_stage
+        last_observe=$now
       fi
       last_refresh=$now
     fi

@@ -72,7 +72,15 @@ def parse_args(argv=None):
     ap.add_argument("--link-gib-per-s", type=float, default=0.0)
     ap.add_argument("--metrics", default="serve_cluster_metrics.jsonl")
     ap.add_argument("--trace", default=None,
-                    help="write a Chrome trace here (open in Perfetto)")
+                    help="write a Chrome trace here (open in Perfetto; "
+                         "one track per host — requests visibly hop)")
+    ap.add_argument("--flight-dir", default=None,
+                    help="arm the flight-recorder dump dir (the black "
+                         "box `python -m apex_tpu.monitor.postmortem` "
+                         "reads); rings dump on exit too")
+    ap.add_argument("--expose", action="store_true",
+                    help="print one worker's Prometheus text exposition "
+                         "at the end (the external-scraper surface)")
     ap.add_argument("--seed", type=int, default=0)
     return ap.parse_args(argv)
 
@@ -99,7 +107,8 @@ def main(argv=None) -> int:
                             tenant_weights={"free": 1.0, "paid": 3.0}),
         wire_mode=args.wire_mode,
         link_fixed_ms=args.link_fixed_ms,
-        link_gib_per_s=args.link_gib_per_s)
+        link_gib_per_s=args.link_gib_per_s,
+        flight_dir=args.flight_dir)
     cluster = ServeCluster(params, cfg, ccfg, events=events)
 
     rng = np.random.default_rng(args.seed)
@@ -147,6 +156,25 @@ def main(argv=None) -> int:
             print(f"  {dim}: p50 {stats[f'{dim}_p50']} "
                   f"p99 {stats[f'{dim}_p99']}")
 
+    fleet = stats.get("fleet", {})
+    print(f"fleet: {fleet.get('scrapes_total')} scrapes "
+          f"(coverage {fleet.get('scrape_coverage')}, "
+          f"p50 {fleet.get('scrape_ms_p50')} ms), "
+          f"{fleet.get('alerts', {}).get('alerts_fired_total')} alerts, "
+          f"{fleet.get('traces_minted')} traces")
+    if args.flight_dir:
+        paths = cluster.dump_flight(reason="shutdown")
+        print(f"flight dumps -> {len(paths)} files in {args.flight_dir} "
+              f"(read: python -m apex_tpu.monitor.postmortem "
+              f"{args.flight_dir})")
+    if args.expose:
+        from apex_tpu.monitor import MetricsRegistry
+
+        reg = MetricsRegistry()
+        w = cluster.decode_workers[0]
+        w.engine.collect_registry(reg, worker=w.name)
+        print("\n# Prometheus exposition (decode0):")
+        print(reg.expose_text())
     sink.close()
     if args.trace:
         write_chrome_trace(args.trace, read_jsonl(args.metrics))

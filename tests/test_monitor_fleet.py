@@ -1,0 +1,737 @@
+"""monitor tier 3 — fleet observability plane (ISSUE-14).
+
+All stock-jax-safe and host-side (no model, no device work): the
+registry/exposition/aggregation plane, the alert-rules engine, the
+flight recorder + postmortem CLI, the distributed-tracing
+reconstruction fixes, the ``JsonlSink.write_many`` rotation contract
+and the new regress polarity rows. The cluster-integrated acceptance
+(one trace id across host tracks under chaos, alert-driven autoscale,
+postmortem-from-dumps) lives in ``tests/test_serve_chaos.py``.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from apex_tpu.monitor.alerts import (
+    AbsenceRule,
+    AlertEngine,
+    AlertRule,
+    Condition,
+    RateRule,
+)
+from apex_tpu.monitor.events import (
+    EventLog,
+    chrome_trace,
+    request_spans,
+    stitch_traces,
+)
+from apex_tpu.monitor.flight import FlightRecorder, load_dump, load_dumps
+from apex_tpu.monitor.hist import Histogram
+from apex_tpu.monitor.postmortem import merge_dumps, rebuild
+from apex_tpu.monitor.regress import classify_metric, compare_records
+from apex_tpu.monitor.registry import (
+    FleetScraper,
+    MetricsRegistry,
+    merge_snapshots,
+)
+from apex_tpu.monitor.sink import JsonlSink, read_jsonl
+from apex_tpu.monitor.view import summarize
+
+
+# ---------------------------------------------------------------------------
+# MetricsRegistry: instruments, labels, cardinality bound, exposition
+
+
+def test_registry_instruments_and_labels():
+    r = MetricsRegistry()
+    r.counter("reqs_total", 2, worker="d0")
+    r.counter("reqs_total", 3, worker="d0")
+    r.counter("reqs_total", 1, worker="d1")
+    r.gauge("occupancy", 0.25, t_ms=10.0, worker="d0")
+    r.gauge("occupancy", 0.75, t_ms=20.0, worker="d0")  # overwrites
+    r.observe("lat_ms", [1.0, 2.0, 4.0], worker="d0")
+    snap = r.snapshot(t_ms=30.0)
+    json.dumps(snap)  # JSON-serializable by contract
+    by = {(s["name"], s["labels"].get("worker")): s
+          for s in snap["series"]}
+    assert by[("reqs_total", "d0")]["value"] == 5.0
+    assert by[("reqs_total", "d1")]["value"] == 1.0
+    assert by[("occupancy", "d0")]["value"] == 0.75
+    assert by[("lat_ms", "d0")]["hist"]["count"] == 3
+    # type confusion is loud, counters are monotonic
+    with pytest.raises(ValueError, match="registered as counter"):
+        r.gauge("reqs_total", 1.0, worker="d0")
+    with pytest.raises(ValueError, match="only go up"):
+        r.counter("reqs_total", -1, worker="d0")
+
+
+def test_registry_cardinality_bound_folds_to_overflow():
+    r = MetricsRegistry(max_series=4)
+    for i in range(10):
+        r.counter("per_tenant_total", 1, tenant=f"t{i}")
+    # the table is bounded (the fold target may sit one past the bound)
+    assert len(r) <= 5
+    assert r.series_dropped_total == 6
+    snap = r.snapshot()
+    overflow = [s for s in snap["series"]
+                if s["labels"].get("overflow") == "true"]
+    assert len(overflow) == 1 and overflow[0]["value"] == 6.0
+    assert snap["series_dropped_total"] == 6
+    # no request lost: retained + overflow == all increments
+    total = sum(s["value"] for s in snap["series"]
+                if s["name"] == "per_tenant_total")
+    assert total == 10.0
+
+
+def test_prometheus_text_exposition():
+    r = MetricsRegistry()
+    r.counter("apex_reqs_total", 7, worker="d0", kind="decode")
+    r.gauge("apex_occupancy", 0.5, worker="d0")
+    r.observe("apex_lat_ms", [0.5, 50.0], worker="d0")
+    text = r.expose_text()
+    lines = text.splitlines()
+    assert "# TYPE apex_reqs_total counter" in lines
+    assert "# TYPE apex_occupancy gauge" in lines
+    assert "# TYPE apex_lat_ms histogram" in lines
+    assert 'apex_reqs_total{kind="decode",worker="d0"} 7' in lines
+    assert 'apex_occupancy{worker="d0"} 0.5' in lines
+    # histogram: cumulative buckets + the terminal +Inf + sum/count
+    buckets = [ln for ln in lines if ln.startswith("apex_lat_ms_bucket")]
+    assert buckets, text
+    counts = [int(ln.rsplit(" ", 1)[1]) for ln in buckets]
+    assert counts == sorted(counts)          # cumulative by construction
+    assert 'le="+Inf"' in buckets[-1] and counts[-1] == 2
+    assert 'apex_lat_ms_count{worker="d0"} 2' in lines
+    sum_line = [ln for ln in lines
+                if ln.startswith("apex_lat_ms_sum")][0]
+    assert float(sum_line.rsplit(" ", 1)[1]) == pytest.approx(50.5)
+
+
+# ---------------------------------------------------------------------------
+# Aggregation: merge semantics + FleetView selectors
+
+
+def test_merge_snapshots_counter_sum_gauge_freshest_hist_merge():
+    def worker_snap(name, n, occ, t, lats):
+        r = MetricsRegistry()
+        r.counter("reqs_total", n, worker=name)
+        r.counter("fleet_reqs_total", n)          # shared key: sums
+        r.gauge("newest", occ, t_ms=t)            # shared key: freshest
+        r.observe("lat_ms", lats)                 # shared key: merges
+        return r.snapshot(t_ms=t)
+
+    a = worker_snap("d0", 3, 0.1, 10.0, [1.0, 2.0])
+    b = worker_snap("d1", 5, 0.9, 20.0, [4.0, 8.0])
+    view = merge_snapshots([("d0", a), ("d1", b)], t_ms=21.0)
+    assert view.sources == ["d0", "d1"]
+    assert view.value("reqs_total", worker="d0") == 3.0
+    assert view.total("reqs_total") == 8.0
+    assert view.total("fleet_reqs_total") == 8.0      # summed
+    assert view.value("newest") == 0.9                # freshest stamp won
+    merged = view.hist("lat_ms")
+    one_shot = Histogram().add([1.0, 2.0, 4.0, 8.0])
+    assert merged.total == 4
+    assert (merged.counts == one_shot.counts).all()   # merge == one-shot
+    # order independence (associative+commutative)
+    view2 = merge_snapshots([("d1", b), ("d0", a)])
+    assert view2.total("reqs_total") == 8.0
+    assert view2.value("newest") == 0.9
+    d = view.as_dict()
+    assert d["reqs_total"] == 8.0 and "lat_ms_p50" in d
+    json.dumps(d)
+
+
+def test_fleet_scraper_coverage_and_timing():
+    reg = MetricsRegistry()
+    reg.gauge("up", 1.0, worker="d0")
+
+    def targets():
+        return [("d0", lambda: reg.snapshot()),
+                ("d1", lambda: None),                     # a scrape miss
+                ("d2", lambda: (_ for _ in ()).throw(RuntimeError()))]
+
+    sc = FleetScraper(targets, clock=lambda: 123.0)
+    view = sc.scrape()
+    assert view.t_ms == 123.0
+    assert view.sources == ["d0"] and set(view.missed) == {"d1", "d2"}
+    st = sc.stats()
+    assert st["scrapes_total"] == 1
+    assert st["scrape_misses_total"] == 2
+    assert st["scrape_coverage"] == pytest.approx(1 / 3)
+    assert st["scrape_ms_p50"] is not None  # the scrape measured itself
+
+
+# ---------------------------------------------------------------------------
+# Alert engine: thresholds, for_ticks, absence, rate, external fires
+
+
+def _view(**scalars):
+    r = MetricsRegistry()
+    for k, v in scalars.items():
+        if isinstance(v, dict):
+            for labels, val in v.items():
+                r.gauge(k, val, worker=labels)
+        else:
+            r.gauge(k, v)
+    return merge_snapshots([("t", r.snapshot())])
+
+
+def test_threshold_rule_for_ticks_and_resolve():
+    log = EventLog(keep=True)
+    eng = AlertEngine([AlertRule(
+        "backlog_high",
+        conditions=(Condition("backlog_tokens", ">", 100.0),),
+        for_ticks=3)], events=log)
+    assert eng.evaluate(_view(backlog_tokens=500.0), 1.0) == []
+    assert eng.evaluate(_view(backlog_tokens=500.0), 2.0) == []
+    fired = eng.evaluate(_view(backlog_tokens=500.0), 3.0)
+    assert [f.rule for f in fired] == ["backlog_high"]
+    assert eng.active("backlog_high")
+    # stays active without re-firing
+    assert eng.evaluate(_view(backlog_tokens=500.0), 4.0) == []
+    assert eng.alerts_fired_total == 1
+    # a dip resets BOTH the firing and the consecutive counter
+    assert eng.evaluate(_view(backlog_tokens=0.0), 5.0) == []
+    assert not eng.active("backlog_high")
+    assert eng.evaluate(_view(backlog_tokens=500.0), 6.0) == []
+    names = [(r["event"], r.get("rule")) for r in log.records]
+    assert ("alert_fire", "backlog_high") in names
+    assert ("alert_resolve", "backlog_high") in names
+    assert eng.alerts_resolved_total == 1
+
+
+def test_condition_aggregates_and_label_filters():
+    view = _view(occupancy={"d0": 0.2, "d1": 1.0})
+    assert Condition("occupancy", ">=", 0.5, agg="avg").holds(view) is True
+    assert Condition("occupancy", ">=", 0.7, agg="avg").holds(view) is False
+    assert Condition("occupancy", ">=", 1.0, agg="max").holds(view)
+    assert Condition("occupancy", "<=", 0.2, agg="min").holds(view)
+    assert Condition("occupancy", ">=", 0.9,
+                     labels={"worker": "d1"}).holds(view)
+    # a missing series never satisfies a threshold
+    assert not Condition("ghost", ">", -1e9).holds(view)
+
+
+def test_absence_rule_heartbeat_shape():
+    eng = AlertEngine([AbsenceRule("hb_d1", series="worker_up",
+                                   labels={"worker": "d1"},
+                                   for_ticks=2)])
+    both = _view(worker_up={"d0": 1.0, "d1": 1.0})
+    only0 = _view(worker_up={"d0": 1.0})
+    assert eng.evaluate(both, 1.0) == []
+    assert eng.evaluate(only0, 2.0) == []          # 1 consecutive miss
+    fired = eng.evaluate(only0, 3.0)               # 2: fires
+    assert [f.rule for f in fired] == ["hb_d1"]
+    assert eng.evaluate(both, 4.0) == [] and not eng.active("hb_d1")
+
+
+def test_rate_rule_rising_trend():
+    eng = AlertEngine([RateRule("shed_rising", series="shed_rate",
+                                min_increase=0.1, window_ticks=2)])
+    for t, v in ((1, 0.0), (2, 0.05), (3, 0.1)):   # +0.1 not > 0.1
+        assert eng.evaluate(_view(shed_rate=float(v)), float(t)) == []
+    fired = eng.evaluate(_view(shed_rate=0.5), 4.0)  # 0.5-0.05 > 0.1
+    assert [f.rule for f in fired] == ["shed_rising"]
+    # flat series resolves
+    for t in (5, 6, 7):
+        eng.evaluate(_view(shed_rate=0.5), float(t))
+    assert not eng.active("shed_rising")
+
+
+def test_external_fire_shares_ledger_and_events():
+    log = EventLog(keep=True)
+    hits = []
+    eng = AlertEngine([], events=log, on_fire=hits.append)
+    f = eng.fire("heartbeat_absent", 42.0, worker="d0", severity="page")
+    assert f.rule == "heartbeat_absent" and f.severity == "page"
+    assert eng.alerts_fired_total == 1 and len(hits) == 1
+    rec = [r for r in log.records if r["event"] == "alert_fire"][0]
+    assert rec["rule"] == "heartbeat_absent"
+    assert rec["severity"] == "page" and rec["ctx_worker"] == "d0"
+    assert eng.summary()[0]["rule"] == "heartbeat_absent"
+
+
+def test_alert_engine_validation():
+    with pytest.raises(ValueError, match="at least one condition"):
+        AlertEngine([AlertRule("empty")])
+    with pytest.raises(ValueError, match="duplicate rule name"):
+        AlertEngine([AbsenceRule("x", series="a"),
+                     AbsenceRule("x", series="b")])
+    with pytest.raises(ValueError, match="op must be"):
+        AlertEngine([AlertRule("bad", conditions=(
+            Condition("s", "!!", 1.0),))])
+    with pytest.raises(TypeError, match="not an alert rule"):
+        AlertEngine(["nope"])
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder: bounded ring, sink protocol, atomic dump
+
+
+def test_flight_ring_bounds_and_sink_protocol(tmp_path):
+    inner = JsonlSink(str(tmp_path / "log.jsonl"), buffer_steps=1)
+    fr = FlightRecorder(capacity=4, worker="d0", inner=inner,
+                        clock=lambda: 99.0)
+    for i in range(10):
+        fr.write(step=i, phase="decode", tokens_per_s=float(i))
+    inner.close()
+    assert len(fr) == 4 and fr.dropped_records == 6
+    assert [r["step"] for r in fr.records()] == [6, 7, 8, 9]
+    # the ring observed, never swallowed: the inner sink got all 10
+    assert len(list(read_jsonl(str(tmp_path / "log.jsonl")))) == 10
+
+
+def test_flight_step_records_ride_the_shared_clock():
+    """Step records written through the sink protocol get the ring's
+    clock stamped — postmortem's merged timeline sorts by t_ms, and an
+    unstamped step record would sort to the head of a timeline it
+    belongs at the tail of."""
+    t = {"v": 100.0}
+    fr = FlightRecorder(capacity=8, worker="d0", clock=lambda: t["v"])
+    fr.record({"kind": "event", "event": "submitted", "uid": "a",
+               "t_ms": 1.0})
+    t["v"] = 200.0
+    fr.write(step=7, phase="decode")
+    dump = _mk_dump("d0", "manual", 300.0, fr.records())
+    merged = merge_dumps([dump])
+    assert [r.get("t_ms") for r in merged] == [1.0, 200.0]
+    assert merged[-1]["step"] == 7          # the step record sorts LAST
+
+
+def test_flight_dump_to_sink_uses_write_many(tmp_path):
+    """The no-filesystem dump path: the ring streams into the shared
+    JSONL as ONE contiguous header-fenced batch via write_many."""
+    path = str(tmp_path / "log.jsonl")
+    sink = JsonlSink(path, buffer_steps=1)
+    fr = FlightRecorder(capacity=4, worker="d0", clock=lambda: 55.0)
+    for i in range(3):
+        fr.record({"kind": "event", "event": "decode_chunk",
+                   "uid": f"r{i}", "t_ms": float(i)})
+    n = fr.dump_to_sink(sink, reason="heartbeat")
+    sink.close()
+    assert n == 3 and fr.dumps_total == 1
+    recs = list(read_jsonl(path))
+    hdr = recs[0]
+    assert hdr["kind"] == "flight_dump_header"
+    assert hdr["worker"] == "d0" and hdr["reason"] == "heartbeat"
+    assert hdr["t_dump_ms"] == 55.0 and hdr["n_records"] == 3
+    assert [r["uid"] for r in recs[1:]] == ["r0", "r1", "r2"]
+
+
+def test_exposition_escapes_client_labels():
+    """Tenant ids are client-supplied: a quote/backslash/newline in a
+    label value must escape, or one tenant invalidates the whole
+    Prometheus scrape."""
+    r = MetricsRegistry()
+    r.counter("t_total", 1, tenant='a"b\\c\nd')
+    line = [ln for ln in r.expose_text().splitlines()
+            if ln.startswith("t_total{")][0]
+    assert line == 't_total{tenant="a\\"b\\\\c\\nd"} 1'
+
+
+def test_inlog_dump_copies_never_double_count(tmp_path):
+    """An in-log flight dump re-writes records already present live in
+    the same JSONL; the copies are marked and every reader skips them —
+    view counts and chrome-trace tracks are identical before and after
+    the dump."""
+    path = str(tmp_path / "log.jsonl")
+    sink = JsonlSink(path, buffer_steps=1)
+    fr = FlightRecorder(capacity=16, worker="decode0", inner=sink,
+                        clock=lambda: 50.0)
+    log = EventLog(sink=fr, keep=False)
+    log.emit("submitted", "a", t_ms=1.0, trace="tr1")
+    log.emit("retired", "a", t_ms=9.0, n_tokens=3, host="decode0",
+             trace="tr1")
+    log.gauge("occupancy", 0.5, t_ms=2.0)
+    fr.write(step=1, phase="decode", t_ms=5.0)
+    before = summarize(list(read_jsonl(path)))
+    fr.dump_to_sink(sink, reason="killed")
+    sink.close()
+    after_recs = list(read_jsonl(path))
+    after = summarize(after_recs)
+    for k in ("n_events", "n_gauges", "n_steps", "n_retired",
+              "n_requests"):
+        assert after[k] == before[k], k
+    # chrome trace: no phantom 'host cluster' track from dump/alert
+    # worker= fields, and the real host track is there exactly once
+    trace = chrome_trace(after_recs)
+    host_meta = [e["args"]["name"] for e in trace["traceEvents"]
+                 if e["ph"] == "M" and e["name"] == "process_name"
+                 and e["args"]["name"].startswith("host ")]
+    assert host_meta == ["host decode0"]
+
+
+def test_postmortem_window_cuts_epoch_stamps():
+    """t_ms == 0.0 is a real stamp (the log epoch) — --last-s must
+    window it out like any other old record."""
+    d = _mk_dump("d0", "manual", 100.0, [
+        {"kind": "event", "event": "submitted", "uid": "a", "t_ms": 0.0},
+        {"kind": "event", "event": "retired", "uid": "a",
+         "t_ms": 5000.0, "n_tokens": 1},
+    ])
+    win = merge_dumps([d], last_s=1.0)
+    assert [r["t_ms"] for r in win] == [5000.0]
+
+
+def test_registry_overflow_keeps_kind_contract():
+    r = MetricsRegistry(max_series=2)
+    r.counter("c", 1, tenant="t0")
+    r.counter("c", 1, tenant="t1")
+    r.counter("c", 1, tenant="t2")        # folds into overflow (counter)
+    with pytest.raises(ValueError, match="registered as counter"):
+        r.gauge("c", 1.0, tenant="t3")    # folded write, same contract
+
+
+def test_flight_dump_atomic_and_loadable(tmp_path):
+    d = str(tmp_path / "dumps")
+    fr = FlightRecorder(capacity=8, worker="decode0",
+                        clock=lambda: 1234.5)
+    for i in range(12):
+        fr.record({"kind": "event", "event": "decode_chunk",
+                   "uid": f"r{i}", "t_ms": float(i)})
+    p1 = fr.dump(d, reason="killed")
+    fr.record({"kind": "event", "event": "retired", "uid": "r99",
+               "t_ms": 99.0})
+    p2 = fr.dump(d, reason="manual")
+    assert os.path.basename(p1) == "flight-decode0-1.json"
+    assert os.path.basename(p2) == "flight-decode0-2.json"
+    one = load_dump(p1)
+    assert one["worker"] == "decode0" and one["reason"] == "killed"
+    assert one["t_dump_ms"] == 1234.5
+    assert len(one["records"]) == 8 and one["dropped_records"] == 4
+    # a torn .tmp leftover (a dumper that died mid-write) is never read
+    with open(os.path.join(d, "flight-ghost-1.json.tmp.123"), "w") as f:
+        f.write('{"torn":')
+    dumps = load_dumps(d)
+    assert [x["reason"] for x in dumps] == ["killed", "manual"]
+    # schema gate
+    with open(os.path.join(d, "flight-bad-1.json"), "w") as f:
+        json.dump({"schema": 99, "records": []}, f)
+    with pytest.raises(ValueError, match="schema"):
+        load_dumps(d)
+
+
+# ---------------------------------------------------------------------------
+# Postmortem: merge/dedupe/window + CLI
+
+
+def _mk_dump(worker, reason, t_dump, records):
+    return {"schema": 1, "worker": worker, "reason": reason,
+            "t_dump_ms": t_dump, "capacity": 100, "records_total":
+            len(records), "dropped_records": 0, "records": records}
+
+
+def test_postmortem_merge_dedupes_and_windows():
+    shared = {"kind": "event", "event": "submitted", "uid": "a",
+              "t_ms": 1.0, "trace": "tr1"}
+    da = _mk_dump("decode0", "killed", 50.0, [
+        shared,
+        {"kind": "event", "event": "admitted", "uid": "a", "t_ms": 2.0,
+         "host": "decode0", "trace": "tr1"},
+        {"kind": "event", "event": "decode_chunk", "uid": "a",
+         "t_ms": 10.0, "start_ms": 2.0, "n_tokens": 8,
+         "host": "decode0", "trace": "tr1"},
+        {"kind": "event", "event": "migrate_start", "uid": "a",
+         "t_ms": 11.0, "host": "decode0", "trace": "tr1"},
+    ])
+    db = _mk_dump("decode1", "manual", 60.0, [
+        shared,                                   # duplicated record
+        {"kind": "event", "event": "migrate_end", "uid": "a",
+         "t_ms": 12.0, "host": "decode1", "trace": "tr1"},
+        {"kind": "event", "event": "retired", "uid": "a", "t_ms": 20.0,
+         "n_tokens": 9, "host": "decode1", "trace": "tr1"},
+        {"step": 3, "phase": "decode", "t_ms": 19.0, "host": "decode1"},
+    ])
+    merged = merge_dumps([da, db])
+    subs = [r for r in merged if r.get("event") == "submitted"]
+    assert len(subs) == 1                         # deduplicated
+    ts = [r.get("t_ms") for r in merged]
+    assert ts == sorted(ts)                       # one ordered timeline
+    # window: last 10 "seconds" (ms-scaled clock in this synthetic log)
+    win = merge_dumps([da, db], last_s=0.0105)
+    assert all(r["t_ms"] >= 20.0 - 10.5 for r in win)
+    rec = rebuild([da, db])
+    assert rec["n_dumps"] == 2
+    assert rec["workers"] == ["decode0", "decode1"]
+    assert rec["n_traces"] == 1
+    assert rec["trace_stitch_failures"] == 0
+    assert rec["n_retired"] == 1
+    json.dumps(rec)
+
+
+def test_postmortem_cli_runnable(tmp_path):
+    d = str(tmp_path / "dumps")
+    fr0 = FlightRecorder(capacity=16, worker="cluster",
+                         clock=lambda: 30.0)
+    fr0.record({"kind": "event", "event": "submitted", "uid": "a",
+                "t_ms": 1.0, "trace": "tr1"})
+    fr0.record({"kind": "event", "event": "alert_fire", "t_ms": 5.0,
+                "rule": "scale_up", "severity": "warn"})
+    fr1 = FlightRecorder(capacity=16, worker="decode0",
+                         clock=lambda: 30.0)
+    fr1.record({"kind": "event", "event": "retired", "uid": "a",
+                "t_ms": 9.0, "n_tokens": 3, "host": "decode0",
+                "trace": "tr1"})
+    fr0.dump(d, reason="killed")
+    fr1.dump(d, reason="killed")
+    out = subprocess.run(
+        [sys.executable, "-m", "apex_tpu.monitor.postmortem", d,
+         "--trace", str(tmp_path / "pm_trace.json")],
+        capture_output=True, text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert out.returncode == 0, out.stderr
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["metric"] == "postmortem"
+    assert rec["n_dumps"] == 2 and rec["n_traces"] == 1
+    assert rec["alerts_fired"][0]["rule"] == "scale_up"
+    with open(tmp_path / "pm_trace.json") as f:
+        json.load(f)                              # valid trace JSON
+    # empty dir exits 1
+    out2 = subprocess.run(
+        [sys.executable, "-m", "apex_tpu.monitor.postmortem",
+         str(tmp_path / "empty")],
+        capture_output=True, text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert out2.returncode == 1
+
+
+# ---------------------------------------------------------------------------
+# Distributed tracing: per-trace reconstruction fixes (satellite 1)
+
+
+def _migrated_two_log_records():
+    """A request whose lifecycle spans two workers' logs: log A holds
+    the pre-kill half, log B the post-migration half; both captured the
+    cluster-global submitted/transfer records (the merge duplicates)."""
+    shared = [
+        {"kind": "event", "event": "submitted", "uid": "a", "t_ms": 0.0,
+         "trace": "tr1"},
+        {"kind": "event", "event": "transfer_start", "uid": "a",
+         "t_ms": 3.0, "host": "prefill0", "trace": "tr1"},
+        {"kind": "event", "event": "transfer_end", "uid": "a",
+         "t_ms": 4.0, "host": "prefill0", "trace": "tr1"},
+    ]
+    log_a = shared + [
+        {"kind": "event", "event": "prefill_start", "uid": "a",
+         "t_ms": 1.0, "host": "prefill0", "trace": "tr1"},
+        {"kind": "event", "event": "prefill_end", "uid": "a",
+         "t_ms": 2.5, "host": "prefill0", "trace": "tr1"},
+        {"kind": "event", "event": "first_token", "uid": "a",
+         "t_ms": 2.5, "host": "prefill0", "trace": "tr1"},
+        {"kind": "event", "event": "admitted", "uid": "a", "t_ms": 5.0,
+         "slot": 0, "host": "decode0", "trace": "tr1"},
+        {"kind": "event", "event": "decode_chunk", "uid": "a",
+         "t_ms": 8.0, "start_ms": 5.0, "n_tokens": 4, "host": "decode0",
+         "trace": "tr1"},
+        {"kind": "event", "event": "migrate_start", "uid": "a",
+         "t_ms": 9.0, "host": "decode0", "trace": "tr1"},
+    ]
+    log_b = shared + [
+        {"kind": "event", "event": "migrate_end", "uid": "a",
+         "t_ms": 10.0, "host": "decode1", "trace": "tr1"},
+        {"kind": "event", "event": "replay", "uid": "a", "t_ms": 10.0,
+         "n_tokens": 1, "host": "decode1", "trace": "tr1"},
+        {"kind": "event", "event": "admitted", "uid": "a", "t_ms": 10.0,
+         "slot": 1, "migrated": True, "host": "decode1", "trace": "tr1"},
+        {"kind": "event", "event": "decode_chunk", "uid": "a",
+         "t_ms": 14.0, "start_ms": 10.0, "n_tokens": 5,
+         "host": "decode1", "trace": "tr1"},
+        {"kind": "event", "event": "retired", "uid": "a", "t_ms": 14.0,
+         "n_tokens": 9, "host": "decode1", "trace": "tr1"},
+    ]
+    return log_a, log_b
+
+
+def test_view_reconstructs_migrated_request_per_trace():
+    """THE satellite fix: merged two-log events of a migrated request
+    must anchor queue/TTFT on the FIRST admitted/first_token (the
+    client-observed ones), e2e on the LAST retired, and count the
+    request once."""
+    log_a, log_b = _migrated_two_log_records()
+    rec = summarize(log_a + log_b)
+    assert rec["n_requests"] == 1
+    assert rec["n_retired"] == 1                 # not double-counted
+    assert rec["queue_ms_p50"] == 5.0            # FIRST admitted (5.0)
+    assert rec["ttft_ms_p50"] == 2.5             # first_token - submitted
+    assert rec["e2e_ms_p50"] == 14.0             # last retired
+    # tpot over the true stream: (14 - 2.5) / (9 - 1)
+    assert rec["tpot_ms_p50"] == pytest.approx(11.5 / 8, abs=1e-3)
+    assert rec["n_migrations"] == 1 and rec["n_replays"] == 1
+    # order independence: B-then-A reads identically
+    rec2 = summarize(log_b + log_a)
+    for k in ("queue_ms_p50", "ttft_ms_p50", "e2e_ms_p50"):
+        assert rec2[k] == rec[k]
+
+
+def test_request_spans_dedupe_across_merged_logs():
+    log_a, log_b = _migrated_two_log_records()
+    spans = request_spans(log_a + log_b)["a"]
+    chunks = [s for s in spans if s["name"] == "decode_chunk"]
+    assert len(chunks) == 2                      # one per REAL chunk
+    names = {s["name"] for s in spans}
+    assert {"queued", "prefill", "transfer", "migrate", "decode"} <= names
+    queued = [s for s in spans if s["name"] == "queued"][0]
+    assert queued["t1_ms"] == 5.0                # first admitted
+    assert all(s.get("trace") == "tr1" for s in spans
+               if s["name"] != "decode_chunk" or "trace" in s)
+
+
+def test_stitch_traces_cross_host_structure():
+    log_a, log_b = _migrated_two_log_records()
+    st = stitch_traces(log_a + log_b)
+    assert st["stitch_failures"] == 0
+    tr = st["traces"]["tr1"]
+    assert tr["hosts"] == ["prefill0", "decode0", "decode1"]
+    assert tr["ordered"] and tr["terminal"] == "retired"
+    # losing the migrate_end half (an unstitched log) is a failure
+    broken = [r for r in log_a + log_b if r["event"] != "migrate_end"]
+    st2 = stitch_traces(broken)
+    assert st2["stitch_failures"] == 1
+    assert st2["traces"]["tr1"]["unmatched_pairs"] == {"migrate": 1}
+    # a transfer RETRY (attempt 2 start, one end) is NOT a failure
+    retry = log_a + log_b + [
+        {"kind": "event", "event": "transfer_start", "uid": "a",
+         "t_ms": 3.5, "attempt": 2, "host": "prefill0", "trace": "tr1"}]
+    assert stitch_traces(retry)["stitch_failures"] == 0
+
+
+def test_chrome_trace_host_tracks_one_trace_id():
+    log_a, log_b = _migrated_two_log_records()
+    trace = chrome_trace(log_a + log_b)
+    json.dumps(trace)
+    assert trace["stitch"]["stitch_failures"] == 0
+    # one process per host, each holding a span named by THE trace id
+    host_names = {e["args"]["name"]: e["pid"]
+                  for e in trace["traceEvents"]
+                  if e["ph"] == "M" and e["name"] == "process_name"
+                  and e["args"]["name"].startswith("host ")}
+    assert set(host_names) == {"host prefill0", "host decode0",
+                               "host decode1"}
+    host_spans = [e for e in trace["traceEvents"] if e["ph"] == "X"
+                  and e["pid"] in host_names.values()]
+    assert {e["name"] for e in host_spans} == {"tr1"}
+    assert len(host_spans) == 3                  # one segment per host
+    # causally ordered on the one clock
+    host_spans.sort(key=lambda e: e["ts"])
+    for a, b in zip(host_spans, host_spans[1:]):
+        assert a["ts"] + a["dur"] <= b["ts"] + 1e-6
+    # request/slot tracks unchanged by the host tier
+    req_names = {e["name"] for e in trace["traceEvents"]
+                 if e["ph"] == "X" and e["pid"] == 1}
+    assert {"queued", "prefill", "transfer", "migrate", "decode"} \
+        <= req_names
+
+
+def test_eventlog_bind_defaults_and_unbind():
+    log = EventLog(keep=True)
+    log.bind("a", trace="tr9", tenant="t0")
+    log.bind("a", host="d0")                     # binds accumulate
+    log.emit("decode_chunk", "a", t_ms=1.0, start_ms=0.0, n_tokens=2)
+    log.emit("retired", "a", t_ms=2.0, host="d1")  # explicit wins
+    assert log.records[0]["trace"] == "tr9"
+    assert log.records[0]["host"] == "d0"
+    assert log.records[1]["host"] == "d1"
+    assert log.records[1]["tenant"] == "t0"
+    log.unbind("a")
+    log.emit("shed", "a", t_ms=3.0)
+    assert "trace" not in log.records[2]
+    # taps observe every record in order
+    seen = []
+    log.tap(seen.append)
+    log.emit("submitted", "b", t_ms=4.0)
+    log.gauge("queue_depth", 2, t_ms=4.0)
+    assert [r.get("event", r.get("gauge")) for r in seen] == \
+        ["submitted", "queue_depth"]
+
+
+# ---------------------------------------------------------------------------
+# JsonlSink.write_many: lock-scoped batches under concurrent rotation
+
+
+def test_write_many_batches_stay_whole_under_rotation(tmp_path):
+    """The satellite gate: a flight-ring dump written concurrently with
+    a rotating step-record writer must land every record whole and
+    every batch contiguous — no record ever splits across a segment
+    boundary, no batch interleaves with the other writer."""
+    path = str(tmp_path / "rot.jsonl")
+    sink = JsonlSink(path, buffer_steps=1, rotate_bytes=600)
+    n_batches, batch_sz, n_steps = 40, 8, 300
+    errs = []
+
+    def dumper():
+        try:
+            for b in range(n_batches):
+                sink.write_many([
+                    {"kind": "flight", "batch": b, "i": i,
+                     "pad": "x" * 40} for i in range(batch_sz)])
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    th = threading.Thread(target=dumper)
+    th.start()
+    for s in range(n_steps):
+        sink.write(step=s, phase="decode", pad="y" * 30)
+    th.join()
+    sink.close()
+    assert not errs
+    # every line in every segment parses (no torn/interleaved records)
+    recs = list(read_jsonl(path, strict=True))
+    steps = [r for r in recs if "step" in r]
+    flights = [r for r in recs if r.get("kind") == "flight"]
+    assert len(steps) == n_steps
+    assert len(flights) == n_batches * batch_sz
+    # batches are contiguous in the stream: once a batch starts, its
+    # batch_sz records follow back-to-back
+    i = 0
+    while i < len(flights):
+        b = flights[i]["batch"]
+        chunk = flights[i:i + batch_sz]
+        assert [r["batch"] for r in chunk] == [b] * batch_sz
+        assert [r["i"] for r in chunk] == list(range(batch_sz))
+        i += batch_sz
+    # and contiguous means adjacent in the FULL stream too
+    stream = [(r.get("batch"), r.get("i")) for r in recs
+              if r.get("kind") == "flight" or "step" in r]
+    flight_pos = [j for j, r in enumerate(recs)
+                  if r.get("kind") == "flight"]
+    for a, b in zip(flight_pos, flight_pos[1:]):
+        if recs[a]["batch"] == recs[b]["batch"]:
+            assert b == a + 1, "batch interleaved with other writers"
+    assert stream  # rotation actually happened and everything is whole
+    assert os.path.exists(path + ".1")
+
+
+# ---------------------------------------------------------------------------
+# regress polarity: the fleet fields (satellite 3)
+
+
+def test_regress_polarity_covers_fleet_fields():
+    for k in ("alerts_fired_total", "scrape_ms_p50", "scrape_ms_p99",
+              "trace_stitch_failures", "fleet.alerts_fired_total",
+              "series_dropped_total", "scrape_misses_total",
+              "dropped_records"):
+        assert classify_metric(k) == "lower", k
+    for k in ("scrape_coverage", "fleet_goodput_rps",
+              "fleet.scrape_coverage"):
+        assert classify_metric(k) == "higher", k
+
+
+def test_regress_gates_fleet_records():
+    base = {"fleet_goodput_rps": 10.0, "scrape_coverage": 1.0,
+            "alerts_fired_total": 2, "scrape_ms_p50": 0.5,
+            "trace_stitch_failures": 0}
+    worse = dict(base, scrape_coverage=0.5, trace_stitch_failures=3)
+    rep = compare_records(base, worse, tol=0.15)
+    assert not rep["ok"]
+    keys = {e["key"] for e in rep["regressions"]}
+    assert {"scrape_coverage", "trace_stitch_failures"} <= keys
+    assert compare_records(base, dict(base), tol=0.15)["ok"]
+    # a stitch failure appearing from zero must flag at any tolerance
+    assert not compare_records({"trace_stitch_failures": 0},
+                               {"trace_stitch_failures": 1},
+                               tol=0.5)["ok"]

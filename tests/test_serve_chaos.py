@@ -34,7 +34,14 @@ import jax
 import jax.numpy as jnp
 
 from apex_tpu.analyze.recompile import recompile_guard
-from apex_tpu.monitor.events import EventLog, request_spans
+from apex_tpu.monitor.alerts import AlertRule, Condition
+from apex_tpu.monitor.events import (
+    EventLog,
+    chrome_trace,
+    request_spans,
+    stitch_traces,
+)
+from apex_tpu.monitor.flight import load_dumps
 from apex_tpu.monitor.regress import classify_metric, compare_records
 from apex_tpu.monitor.slo import SloSpec
 from apex_tpu.resilience.preemption import StallWatchdog
@@ -714,6 +721,266 @@ def test_router_tenant_table_bounded_under_churn():
     while r2.next_request(0, 0.0)[0] is not None:
         served += 1
     assert served == 20
+
+
+# ---------------------------------------------------------------------------
+# Fleet observability (monitor tier 3, ISSUE-14): cross-host traces,
+# alert-driven decisions, flight-recorder postmortem
+
+
+def test_chaos_cross_host_trace_acceptance():
+    """ISSUE-14 acceptance: a chaos run (worker killed at step k)
+    produces ONE Perfetto trace where the migrated request's spans sit
+    on BOTH hosts under one trace id, causally ordered on the single
+    shared clock, with zero stitch failures."""
+    clock = _ManualClock()
+    events = EventLog(keep=True, clock=clock)
+    ccfg = ClusterConfig(n_prefill=1, n_decode=2, serve=_serve_cfg(),
+                         router=RouterConfig(slo=SloSpec(ttft_ms=600000.0)))
+    chaos = ClusterChaos([KillWorker(at_step=12, worker="decode0")])
+    cl = ServeCluster(PARAMS, CFG, ccfg, events=events, chaos=chaos)
+    for r in REQS:
+        cl.submit(r)
+    _drive(cl, clock)
+    assert cl.stats()["migrations_total"] >= 1
+    # every request minted exactly one trace id, threaded everywhere
+    uid_traces = {}
+    for r in events.records:
+        if r.get("kind") == "event" and "uid" in r and "trace" in r:
+            uid_traces.setdefault(r["uid"], set()).add(r["trace"])
+    assert set(uid_traces) == {r.uid for r in REQS}
+    assert all(len(ts) == 1 for ts in uid_traces.values())
+    st = stitch_traces(events.records)
+    assert st["stitch_failures"] == 0          # zero, fleet-wide
+    migrated = {r["uid"] for r in events.records
+                if r.get("kind") == "event"
+                and r["event"] == "migrate_start"}
+    assert migrated
+    trace = chrome_trace(events.records)
+    host_pids = {e["args"]["name"][len("host "):]: e["pid"]
+                 for e in trace["traceEvents"]
+                 if e["ph"] == "M" and e["name"] == "process_name"
+                 and e["args"]["name"].startswith("host ")}
+    assert {"prefill0", "decode0", "decode1"} <= set(host_pids)
+    assert trace["stitch"]["stitch_failures"] == 0
+    for uid in migrated:
+        tid = next(iter(uid_traces[uid]))
+        tr = st["traces"][tid]
+        # the migrated request touched BOTH decode hosts
+        assert {"decode0", "decode1"} <= set(tr["hosts"])
+        assert tr["ordered"] and tr["terminal"] == "retired"
+        # ...and renders on >= 2 decode-host TRACKS under one trace id
+        spans = [e for e in trace["traceEvents"] if e["ph"] == "X"
+                 and e["name"] == tid
+                 and e["pid"] in (host_pids["decode0"],
+                                  host_pids["decode1"])]
+        assert len({e["pid"] for e in spans}) >= 2
+        spans.sort(key=lambda e: e["ts"])
+        for a, b in zip(spans, spans[1:]):     # causal on the one clock
+            assert a["ts"] + a["dur"] <= b["ts"] + 1e-6
+
+
+def test_autoscale_is_alert_driven_pinned_via_events():
+    """The autoscaler no longer peeks gauges: the scale_up/scale_down
+    thresholds are alert rules over the scraped fleet view, and the
+    alert_fire events PRECEDE the join/drain they cause in the one
+    event stream."""
+    clock = _ManualClock()
+    events = EventLog(keep=True, clock=clock)
+    pol = AutoscalePolicy(scale_up_queue_depth=3, scale_up_occupancy=0.5,
+                          scale_down_occupancy=0.1, min_decode=1,
+                          max_decode=2, cooldown_ms=0.0)
+    ccfg = ClusterConfig(n_prefill=1, n_decode=1,
+                         serve=_serve_cfg(num_slots=1),
+                         router=RouterConfig(slo=SloSpec(ttft_ms=600000.0)),
+                         autoscale=pol)
+    cl = ServeCluster(PARAMS, CFG, ccfg, events=events)
+    rng = np.random.default_rng(0)
+    reqs = [Request(f"r{i}", rng.integers(0, 97, size=12).tolist(),
+                    max_new_tokens=6) for i in range(10)]
+    for r in reqs:
+        cl.submit(r)
+    _drive(cl, clock)
+    for _ in range(5):
+        cl.step()
+        clock.advance(0.005)
+    evs = [r for r in events.records if r.get("kind") == "event"]
+    t_up = next(r["t_ms"] for r in evs if r["event"] == "alert_fire"
+                and r["rule"] == "scale_up")
+    t_join2 = [r["t_ms"] for r in evs if r["event"] == "worker_join"
+               and r["worker"] == "decode1"][0]
+    assert t_up <= t_join2                     # the alert caused the join
+    t_down = next(r["t_ms"] for r in evs if r["event"] == "alert_fire"
+                  and r["rule"] == "scale_down")
+    t_leave = next(r["t_ms"] for r in evs if r["event"] == "worker_leave"
+                   and r["reason"] == "scale_down")
+    assert t_down <= t_leave                   # and the drain
+    st = cl.stats()
+    assert st["alerts_fired_total"] >= 2
+    assert st["fleet"]["alerts"]["alerts_fired_total"] >= 2
+    assert cl.membership.autoscale_ups == 1    # actuation gate unchanged
+    assert cl.membership.autoscale_downs == 1
+
+
+def test_heartbeat_death_is_alert_evidenced():
+    """A heartbeat-missed death routes through the alert plane: the
+    heartbeat_absent firing is a first-class event that precedes the
+    migration it triggers."""
+    clock = _ManualClock()
+    events = EventLog(keep=True, clock=clock)
+    chaos = ClusterChaos([StallWorker(at_step=12, worker="decode0")])
+    ccfg = ClusterConfig(n_prefill=1, n_decode=2, serve=_serve_cfg(),
+                         router=RouterConfig(slo=SloSpec(ttft_ms=600000.0)),
+                         heartbeat_timeout_ms=50.0)
+    cl = ServeCluster(PARAMS, CFG, ccfg, events=events, chaos=chaos)
+    for r in REQS:
+        cl.submit(r)
+    _drive(cl, clock)
+    evs = [r for r in events.records if r.get("kind") == "event"]
+    fire = next(r for r in evs if r["event"] == "alert_fire"
+                and r["rule"] == "heartbeat_absent")
+    assert fire["ctx_worker"] == "decode0"
+    t_mig = min(r["t_ms"] for r in evs if r["event"] == "migrate_start")
+    assert fire["t_ms"] <= t_mig
+    # a stalled worker is also a scrape miss while it is wedged
+    assert cl.scraper.scrape_misses_total >= 1
+
+
+def test_postmortem_rebuilds_prekill_timeline_from_dumps(tmp_path,
+                                                         capsys):
+    """ISSUE-14 acceptance: the kill dumps the dying worker's flight
+    ring (plus the cluster ring) atomically; with the survivors dumped
+    too, ``python -m apex_tpu.monitor.postmortem`` rebuilds the merged
+    pre-kill timeline — every trace, both hosts, zero stitch failures —
+    from the dump files ALONE."""
+    from apex_tpu.monitor.postmortem import main as postmortem_main
+
+    d = str(tmp_path / "flight")
+    clock = _ManualClock()
+    events = EventLog(keep=True, clock=clock)
+    ccfg = ClusterConfig(n_prefill=1, n_decode=2, serve=_serve_cfg(),
+                         router=RouterConfig(slo=SloSpec(ttft_ms=600000.0)),
+                         flight_dir=d)
+    chaos = ClusterChaos([KillWorker(at_step=12, worker="decode0")])
+    cl = ServeCluster(PARAMS, CFG, ccfg, events=events, chaos=chaos)
+    for r in REQS:
+        cl.submit(r)
+    _drive(cl, clock)
+    # the kill itself dumped the dying worker + the cluster ring
+    auto = load_dumps(d)
+    assert {x["worker"] for x in auto} == {"decode0", "cluster"}
+    assert all(x["reason"] == "killed" for x in auto)
+    # flight_dump events recorded the escalation in the stream
+    assert sum(1 for r in events.records if r.get("kind") == "event"
+               and r["event"] == "flight_dump") == 2
+    # survivors dump at end-of-incident (reason manual)
+    cl.dump_flight(reason="manual")
+    # the CLI (main == python -m) rebuilds from the dumps alone
+    rc = postmortem_main([d, "--timeline", "0"])
+    assert rc == 0
+    import json as _json
+
+    rec = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["metric"] == "postmortem"
+    assert rec["n_traces"] == len(REQS)
+    assert rec["trace_stitch_failures"] == 0
+    assert rec["n_retired"] == len(REQS)
+    assert {"worker": "decode0", "reason": "killed",
+            "t_ms": rec["worker_leaves"][0]["t_ms"]} \
+        in rec["worker_leaves"]
+    # the pre-kill half is genuinely there: decode0-hosted decode
+    # activity from BEFORE the kill, and the migration out of it
+    n_mig = rec.get("n_migrations", 0)
+    assert n_mig >= 1
+    st = cl.stats()
+    assert st["fleet"]["flight"]["decode0"]["dumps"] == 2  # kill + manual
+
+
+def test_autoscale_without_scraping_is_a_loud_config_error():
+    """Autoscale (and user alert rules) act on the alert engine, which
+    evaluates over scraped views — a non-scraping cluster could never
+    fire them, so the combination fails at construction."""
+    with pytest.raises(ValueError, match="scrape_every"):
+        ClusterConfig(n_prefill=1, n_decode=1, serve=_serve_cfg(),
+                      scrape_every=0,
+                      autoscale=AutoscalePolicy()).validate()
+    with pytest.raises(ValueError, match="scrape_every"):
+        ClusterConfig(n_prefill=1, n_decode=1, serve=_serve_cfg(),
+                      scrape_every=0,
+                      alert_rules=(AlertRule("x", conditions=(
+                          Condition("s", ">", 0.0),)),)).validate()
+    # scraping off WITHOUT rules is a legal floor (the bench's off arm)
+    ClusterConfig(n_prefill=1, n_decode=1, serve=_serve_cfg(),
+                  scrape_every=0, flight_capacity=0).validate()
+
+
+def test_death_dump_streams_to_sink_without_flight_dir(tmp_path):
+    """No flight_dir but a durable JsonlSink: the kill's black box
+    streams into the shared log as header-fenced write_many batches
+    instead of being dropped."""
+    from apex_tpu.monitor import JsonlSink, read_jsonl
+
+    path = str(tmp_path / "fleet.jsonl")
+    clock = _ManualClock()
+    chaos = ClusterChaos([KillWorker(at_step=12, worker="decode0")])
+    ccfg = ClusterConfig(n_prefill=1, n_decode=2, serve=_serve_cfg(),
+                         router=RouterConfig(slo=SloSpec(ttft_ms=600000.0)))
+    sink = JsonlSink(path, buffer_steps=4)
+    cl = ServeCluster(PARAMS, CFG, ccfg, sink=sink,
+                      events=EventLog(keep=True, clock=clock),
+                      chaos=chaos)
+    for r in REQS:
+        cl.submit(r)
+    _drive(cl, clock)
+    sink.close()
+    recs = list(read_jsonl(path))
+    headers = [r for r in recs if r.get("kind") == "flight_dump_header"]
+    assert {h["worker"] for h in headers} == {"decode0", "cluster"}
+    assert all(h["reason"] == "killed" for h in headers)
+    # each header is immediately followed by its n_records batch
+    for h in headers:
+        i = recs.index(h)
+        batch = recs[i + 1:i + 1 + h["n_records"]]
+        assert len(batch) == h["n_records"]
+
+
+def test_custom_alert_rules_and_scrape_plane_in_stats():
+    """User-declared rules evaluate over the scraped series; the scrape
+    plane accounts for itself in stats() (scrapes_total, coverage,
+    scrape_ms) and the worker scrape snapshots carry the engine
+    series."""
+    clock = _ManualClock()
+    events = EventLog(keep=True, clock=clock)
+    ccfg = ClusterConfig(
+        n_prefill=1, n_decode=1, serve=_serve_cfg(),
+        router=RouterConfig(slo=SloSpec(ttft_ms=600000.0)),
+        alert_rules=(AlertRule("backlog_high", conditions=(
+            Condition("queued_tokens", ">", 0.0),)),))
+    cl = ServeCluster(PARAMS, CFG, ccfg, events=events)
+    for r in REQS:
+        cl.submit(r)
+    _drive(cl, clock)
+    st = cl.stats()
+    fleet = st["fleet"]
+    assert fleet["scrapes_total"] > 0
+    assert fleet["scrape_coverage"] == 1.0
+    assert fleet["scrape_ms_p50"] is not None
+    assert st["scrape_coverage"] == 1.0
+    rules = [f.rule for f in cl._alerts.firings]
+    assert "backlog_high" in rules             # it fired while loaded
+    assert not cl._alerts.active("backlog_high")  # and resolved, drained
+    # worker scrape snapshot: engine + worker series, Prometheus-ready
+    snap = cl.decode_workers[0].scrape()
+    names = {s["name"] for s in snap["series"]}
+    assert {"worker_up", "requests_completed_total", "occupancy",
+            "tokens_generated_total", "handoffs_admitted_total"} <= names
+    assert all(s["labels"].get("worker") == "decode0"
+               for s in snap["series"])
+    import json as _json
+
+    _json.dumps(snap)
+    # fleet_goodput_rps rides the stats record for the stage-19 gate
+    assert st["fleet_goodput_rps"] == st["goodput_rps"]
 
 
 # ---------------------------------------------------------------------------
