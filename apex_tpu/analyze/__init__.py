@@ -14,6 +14,10 @@ source), in two tiers:
 :mod:`~.donation`          ``assert_donated`` / ``check_donation`` — are
                            declared-donated buffers ALIASED in the
                            compiled executable, or silently copied?
+:mod:`~.adapters`          ``assert_adapter_donated`` — the serve LoRA
+                           AdapterPool rides EVERY serve jit site as a
+                           donated, aliased input (no per-adapter-swap
+                           recompiles, no pool-copy donation leak).
 :mod:`~.recompile`         ``recompile_guard`` / ``jit_cache_size`` — jit
                            cache sizes pinned to a declared budget across
                            N invocations (the serve compile gate,
@@ -61,6 +65,10 @@ _EXPORTS = {
     "DonationError": "donation", "DonationReport": "donation",
     "assert_donated": "donation", "check_donation": "donation",
     "donation_report": "donation",
+    "adapter_contract_record": "adapters",
+    "adapter_donation_report": "adapters",
+    "adapter_jit_sites": "adapters",
+    "assert_adapter_donated": "adapters",
     "RecompileError": "recompile", "RecompileGuard": "recompile",
     "compile_counts": "recompile", "jit_cache_size": "recompile",
     "recompile_guard": "recompile",

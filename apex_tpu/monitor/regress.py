@@ -67,7 +67,13 @@ _HIGHER = ("tokens_per_s", "goodput", "_rps", "mfu", "occupancy",
            # workers the FleetScraper reached (a scrape hole is a blind
            # spot) and the fleet-wide goodput roll-up (already matched
            # by the goodput fragment; listed for explicit coverage)
-           "scrape_coverage", "fleet_goodput_rps")
+           "scrape_coverage", "fleet_goodput_rps",
+           # per-tenant LoRA round (stage 20): registry hit rate
+           # (already matched by the generic hit_rate fragment; listed
+           # for explicit coverage) and the fraction of adapter-bound
+           # handoffs the router landed adapter-warm — a falling warm
+           # rate means the fleet-mix placement stopped working
+           "adapter_hit_rate", "adapter_warm_dispatch_rate")
 _LOWER = ("_ms", "violation", "latency", "bubble", "exposed_bytes",
           # disaggregated cluster (stage 15): a rising shed fraction is a
           # capacity regression (transfer_ms falls under the generic
@@ -106,7 +112,13 @@ _LOWER = ("_ms", "violation", "latency", "bubble", "exposed_bytes",
           # trace that stopped stitching across hosts is broken
           # observability, not a style issue
           "alerts_fired_total", "scrape_ms", "trace_stitch_failures",
-          "series_dropped_total", "scrape_misses", "dropped_records")
+          "series_dropped_total", "scrape_misses", "dropped_records",
+          # per-tenant LoRA round (stage 20): time spent installing
+          # adapters into pools (also caught by the generic "_ms" rule;
+          # listed for explicit coverage) and LRU eviction churn — more
+          # evictions under the same tenant mix means the pool is
+          # thrashing
+          "adapter_load_ms", "adapter_evictions")
 
 
 def classify_metric(key: str,

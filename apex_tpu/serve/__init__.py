@@ -29,6 +29,14 @@ inference story is ``amp.initialize`` eval-mode half precision):
   (+ one optional verify program), prefix-cached admission, speculative
   decode, EOS/max-len retirement, checkpoint loading via ``resilience``,
   telemetry via ``monitor``;
+* :mod:`~apex_tpu.serve.adapters` — per-tenant paged LoRA serving:
+  rank-r A/B deltas for QKV / out-proj / FC1 / FC2 as ONE donated paged
+  pytree beside the KV pools, a host-side :class:`AdapterRegistry`
+  (load/unload at runtime, refcounts while slots decode, LRU eviction of
+  idle adapters — the BlockAllocator discipline applied to weights), and
+  Punica-style gathered BGMV threaded through ``gpt_paged_forward`` so
+  one compiled program serves every tenant (``adapter_id 0`` = base =
+  exact zero delta);
 * :mod:`~apex_tpu.serve.cluster` — disaggregated prefill/decode serving
   past one host: :class:`~apex_tpu.serve.cluster.ServeCluster` =
   SLO-aware router (TTFT feasibility, per-tenant WFQ, explicit ``shed``)
@@ -37,6 +45,16 @@ inference story is ``amp.initialize`` eval-mode half precision):
   parity against the single engine.
 """
 
+from apex_tpu.serve.adapters import (  # noqa: F401
+    ADAPTER_TARGETS,
+    AdapterRegistry,
+    adapter_pool_bytes,
+    init_adapter_pool,
+    lora_delta,
+    make_adapter_weights,
+    merge_adapter_params,
+    write_adapter,
+)
 from apex_tpu.serve.decode import (  # noqa: F401
     gpt_decode_step,
     gpt_paged_forward,
@@ -98,6 +116,8 @@ from apex_tpu.serve.cluster import (  # noqa: F401  (isort: after engine)
 )
 
 __all__ = [
+    "ADAPTER_TARGETS",
+    "AdapterRegistry",
     "AutoscalePolicy",
     "BlockAllocator",
     "ClusterChaos",
@@ -111,6 +131,7 @@ __all__ = [
     "ServeCluster",
     "SimTransport",
     "transfer_wire_bytes",
+    "adapter_pool_bytes",
     "Drafter",
     "InferenceEngine",
     "KVCacheConfig",
@@ -130,11 +151,15 @@ __all__ = [
     "gpt_prefill_chunk",
     "gpt_verify_step",
     "hash_block_tokens",
+    "init_adapter_pool",
     "init_kv_cache",
     "kv_cache_bytes",
     "kv_read_bytes",
     "kv_write_bytes_per_token",
+    "lora_delta",
+    "make_adapter_weights",
     "megakernel_ok",
+    "merge_adapter_params",
     "paged_attention",
     "paged_attention_reference",
     "paged_write",
@@ -143,4 +168,5 @@ __all__ = [
     "sample",
     "serve_logits",
     "step_keys",
+    "write_adapter",
 ]

@@ -1,0 +1,332 @@
+"""Per-tenant paged LoRA adapters — ROADMAP item 5's weight-side pager.
+
+One base model, thousands of tenant variants, zero recompiles: rank-r
+LoRA deltas (A/B pairs for the four adapted projections — fused QKV,
+attention out-proj, FC1, FC2) live in an :func:`init_adapter_pool`
+**AdapterPool** — ONE donated pytree of fixed-shape per-layer slot
+stacks that rides alongside the paged KV pools through every serve
+program. Slot 0 is the base model: all-zeros A/B, so ``adapter_id == 0``
+is an EXACT zero delta and base-traffic streams are bitwise the
+pre-adapter engine's. Application is Punica-style gathered BGMV
+(:func:`lora_delta`): each batch row gathers ITS adapter's factors by
+id and adds ``(x @ A[aid]) @ B[aid]`` — per-row math, so decode,
+speculative verify and chunked prefill all honor adapters from the SAME
+compiled program per jit site regardless of which adapters are resident
+or active.
+
+Host-side, :class:`AdapterRegistry` is the ``kv_cache.BlockAllocator``
+discipline applied to weights: named adapters load/unload into pool
+slots at runtime, every decoding slot holds a refcount on its adapter,
+idle (refcount-0) residents park in an LRU and are evicted under pool
+pressure, and ``assert_consistent`` keeps the bookkeeping loud. The
+LoRA scale is folded into B at :func:`write_adapter` time, so the
+device pool needs no per-adapter scale array and the compiled programs
+never see it.
+
+The offline oracle lives here too: :func:`merge_adapter_params` bakes
+``W + A @ B * scale`` into a dense parameter pytree — run it through the
+cold flash-prefill oracle (``decode.gpt_prefill``) and the paged
+adapter stream must match within fp tolerance (tests pin it).
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Pytree = Any
+
+# the four adapted projections; pool keys are f"{target}_a"/f"{target}_b"
+ADAPTER_TARGETS = ("qkv", "out", "fc1", "fc2")
+
+
+def _target_dims(cfg) -> Dict[str, Tuple[int, int]]:
+    """(d_in, d_out) per adapted projection — the standalone_gpt layer
+    kernel shapes (qkv (h, 3h), out (h, h), fc1 (h, f), fc2 (f, h))."""
+    h, f = cfg.hidden, cfg.ffn_hidden
+    return {"qkv": (h, 3 * h), "out": (h, h), "fc1": (h, f), "fc2": (f, h)}
+
+
+def init_adapter_pool(cfg, rank: int, max_adapters: int,
+                      dtype=None) -> Pytree:
+    """The AdapterPool: one zero-initialized pytree of per-layer slot
+    stacks — ``f"{t}_a"`` of shape (L, S, d_in, r) and ``f"{t}_b"`` of
+    (L, S, r, d_out) for each target t, with S = ``max_adapters + 1``
+    slots (slot 0 reserved for the base model's exact zero delta).
+
+    The leading layer dim rides the serve programs' layer scan as a
+    read-only xs alongside the stacked layer params; the whole pool is
+    donated through every jit site and returned untouched, so no decode
+    step ever copies it and no adapter load/swap ever retraces.
+    """
+    if rank < 1:
+        raise ValueError(f"rank must be >= 1, got {rank}")
+    if max_adapters < 1:
+        raise ValueError(f"max_adapters must be >= 1, got {max_adapters}")
+    dt = dtype if dtype is not None else cfg.dtype
+    L, S = cfg.num_layers, max_adapters + 1
+    pool = {}
+    for t, (d_in, d_out) in _target_dims(cfg).items():
+        pool[f"{t}_a"] = jnp.zeros((L, S, d_in, rank), dt)
+        pool[f"{t}_b"] = jnp.zeros((L, S, rank, d_out), dt)
+    return pool
+
+
+def adapter_pool_bytes(cfg, rank: int, max_adapters: int,
+                       dtype=None) -> int:
+    """HBM bytes :func:`init_adapter_pool` allocates (capacity planning
+    next to ``kv_cache_bytes``)."""
+    dt = jnp.dtype(dtype if dtype is not None else cfg.dtype)
+    S = max_adapters + 1
+    elems = sum((d_in + d_out) * rank
+                for d_in, d_out in _target_dims(cfg).values())
+    return cfg.num_layers * S * elems * dt.itemsize
+
+
+def make_adapter_weights(cfg, rank: int, key, std: float = 0.02) -> Pytree:
+    """Random host-side adapter weights for tests/benches: per target,
+    ``f"{t}_a"`` (L, d_in, r) and ``f"{t}_b"`` (L, r, d_out), both
+    normal(std) so the delta is nonzero (unlike training-style B=0
+    init, a zero delta would make the merged-oracle test vacuous)."""
+    out = {}
+    keys = jax.random.split(key, 2 * len(ADAPTER_TARGETS))
+    dims = _target_dims(cfg)
+    for i, t in enumerate(ADAPTER_TARGETS):
+        d_in, d_out = dims[t]
+        out[f"{t}_a"] = (jax.random.normal(
+            keys[2 * i], (cfg.num_layers, d_in, rank)) * std
+        ).astype(cfg.dtype)
+        out[f"{t}_b"] = (jax.random.normal(
+            keys[2 * i + 1], (cfg.num_layers, rank, d_out)) * std
+        ).astype(cfg.dtype)
+    return out
+
+
+def _check_weights(pool: Pytree, weights: Pytree) -> None:
+    for t in ADAPTER_TARGETS:
+        for side in ("a", "b"):
+            k = f"{t}_{side}"
+            if k not in weights:
+                raise ValueError(f"adapter weights missing {k!r}")
+            want = pool[k].shape[:1] + pool[k].shape[2:]  # (L, ...) sans S
+            got = jnp.shape(weights[k])
+            if tuple(got) != want:
+                raise ValueError(
+                    f"adapter weights[{k!r}] shape {tuple(got)} != pool "
+                    f"slot shape {want}")
+
+
+def write_adapter(pool: Pytree, slot: int, weights: Pytree,
+                  scale: float = 1.0) -> Pytree:
+    """Write one adapter's A/B factors into pool ``slot`` (host-side
+    eager update — never a jit site, so loads can't mint compiles).
+    ``scale`` (the LoRA alpha/r) is folded into B here; the programs
+    apply a bare ``(x @ A) @ B``. Slot 0 is the base model's zero delta
+    and refuses writes."""
+    if not 1 <= slot <= pool["qkv_a"].shape[1] - 1:
+        raise ValueError(
+            f"slot must be in [1, {pool['qkv_a'].shape[1] - 1}] "
+            f"(slot 0 is the reserved base zero-delta), got {slot}")
+    _check_weights(pool, weights)
+    out = dict(pool)
+    for t in ADAPTER_TARGETS:
+        a = jnp.asarray(weights[f"{t}_a"]).astype(pool[f"{t}_a"].dtype)
+        b = (jnp.asarray(weights[f"{t}_b"]) * scale).astype(
+            pool[f"{t}_b"].dtype)
+        out[f"{t}_a"] = pool[f"{t}_a"].at[:, slot].set(a)
+        out[f"{t}_b"] = pool[f"{t}_b"].at[:, slot].set(b)
+    return out
+
+
+def merge_adapter_params(params: Pytree, weights: Pytree,
+                         scale: float = 1.0) -> Pytree:
+    """The dense merged-weight ORACLE: a new parameter pytree with every
+    adapted kernel replaced by ``W + A @ B * scale`` — what a per-tenant
+    merged checkpoint would serve. Run it through the cold flash-prefill
+    oracle and the paged adapter stream must agree within fp tolerance
+    (the MIGRATION.md "per-tenant fine-tunes" recipe inverted)."""
+    layers = dict(params["layers"])
+    for t, kern in (("qkv", "qkv_kernel"), ("out", "out_kernel"),
+                    ("fc1", "fc1_kernel"), ("fc2", "fc2_kernel")):
+        a = jnp.asarray(weights[f"{t}_a"])
+        b = jnp.asarray(weights[f"{t}_b"])
+        w = layers[kern]
+        delta = jnp.einsum("lir,lro->lio", a.astype(w.dtype),
+                           b.astype(w.dtype)) * scale
+        layers[kern] = w + delta.astype(w.dtype)
+    return {**params, "layers": layers}
+
+
+def lora_delta(x, a, b, adapter_ids):
+    """Punica-style gathered BGMV: ``x`` (n, q, d_in) against one
+    layer's slot stacks ``a`` (S, d_in, r) / ``b`` (S, r, d_out), each
+    row applying ITS adapter — returns ``(x @ A[aid]) @ B[aid]``
+    (n, q, d_out). ``adapter_ids`` (n,) int32; id 0 gathers the
+    all-zeros base slot, an EXACT zero delta (zero matmul, not a
+    select), which is what keeps base-traffic streams bitwise intact.
+    The scale is pre-folded into ``b`` by :func:`write_adapter`."""
+    ag = jnp.take(a, adapter_ids, axis=0).astype(x.dtype)  # (n, d_in, r)
+    bg = jnp.take(b, adapter_ids, axis=0).astype(x.dtype)  # (n, r, d_out)
+    t = jnp.einsum("nqi,nir->nqr", x, ag)
+    return jnp.einsum("nqr,nro->nqo", t, bg)
+
+
+class AdapterRegistry:
+    """Host-side slot bookkeeping for the AdapterPool — the
+    ``BlockAllocator`` discipline applied to weights.
+
+    Named adapters map to pool slots ``1..max_adapters`` (slot 0 is the
+    base model and never allocated). :meth:`acquire` pins an adapter for
+    a decoding slot (refcount up, LRU touch); :meth:`release` unpins;
+    :meth:`load` assigns a slot to a new name, LRU-evicting an IDLE
+    (refcount-0) resident under pool pressure and refusing — loudly —
+    when every resident is pinned. The registry never touches the
+    device pool; callers pair ``load`` with :func:`write_adapter`.
+
+    Counters mirror the allocator's: ``hits_total`` / ``misses_total``
+    (acquire outcomes), ``loads_total`` / ``unloads_total`` /
+    ``evictions_total``. :meth:`assert_consistent` checks the slot
+    partition, refcount and LRU invariants (the chaos test drives it
+    every step)."""
+
+    def __init__(self, max_adapters: int):
+        if max_adapters < 1:
+            raise ValueError(
+                f"max_adapters must be >= 1, got {max_adapters}")
+        self.max_adapters = max_adapters
+        # LIFO free list, slot 1 on top (deterministic assignment order)
+        self._free: List[int] = list(range(max_adapters, 0, -1))
+        self._slots: Dict[str, int] = {}
+        self._refs: Dict[str, int] = {}
+        # idle (refcount-0) residents in LRU order: front = evict first
+        self._idle: "collections.OrderedDict[str, None]" = (
+            collections.OrderedDict())
+        self.hits_total = 0
+        self.misses_total = 0
+        self.loads_total = 0
+        self.unloads_total = 0
+        self.evictions_total = 0
+
+    # -- queries -----------------------------------------------------------
+    def lookup(self, name: str) -> Optional[int]:
+        """Resident slot of ``name`` (no refcount, no counters)."""
+        return self._slots.get(name)
+
+    def resident(self) -> Dict[str, int]:
+        """name -> slot for every resident adapter (the membership
+        heartbeat advertisement reads this)."""
+        return dict(self._slots)
+
+    @property
+    def resident_count(self) -> int:
+        return len(self._slots)
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    def refcount(self, name: str) -> int:
+        if name not in self._slots:
+            raise KeyError(f"adapter {name!r} is not resident")
+        return self._refs[name]
+
+    def counters(self) -> Dict[str, int]:
+        return {"hits_total": self.hits_total,
+                "misses_total": self.misses_total,
+                "loads_total": self.loads_total,
+                "unloads_total": self.unloads_total,
+                "evictions_total": self.evictions_total}
+
+    # -- refcounting (one ref per decoding slot) ---------------------------
+    def acquire(self, name: str) -> Optional[int]:
+        """Pin ``name`` for a decoding slot: refcount up, slot returned.
+        ``None`` when the adapter is not resident (a MISS — the engine
+        sheds, the cluster cold-loads); a pinned adapter can never be
+        evicted out from under a live stream."""
+        slot = self._slots.get(name)
+        if slot is None:
+            self.misses_total += 1
+            return None
+        self.hits_total += 1
+        self._refs[name] += 1
+        self._idle.pop(name, None)
+        return slot
+
+    def release(self, name: str) -> None:
+        """Drop one ref; at zero the adapter parks in the idle LRU
+        (most-recently-released evicts last)."""
+        if name not in self._slots:
+            raise RuntimeError(f"release of non-resident adapter {name!r}")
+        if self._refs[name] <= 0:
+            raise RuntimeError(f"release of unreferenced adapter {name!r}")
+        self._refs[name] -= 1
+        if self._refs[name] == 0:
+            self._idle[name] = None
+
+    # -- load / unload / evict ---------------------------------------------
+    def load(self, name: str) -> int:
+        """Assign a pool slot to ``name`` (idempotent refresh when
+        already resident). Under pool pressure the LEAST-recently-idle
+        resident is evicted; when every resident is pinned by a decoding
+        slot the load refuses instead of corrupting a live stream."""
+        slot = self._slots.get(name)
+        if slot is not None:
+            self.loads_total += 1
+            return slot
+        if not self._free:
+            if not self._idle:
+                raise RuntimeError(
+                    f"adapter pool exhausted: all {self.max_adapters} "
+                    f"resident adapters are pinned by decoding slots — "
+                    f"retire or migrate their requests first")
+            victim, _ = self._idle.popitem(last=False)
+            self._free.append(self._slots.pop(victim))
+            del self._refs[victim]
+            self.evictions_total += 1
+        slot = self._free.pop()
+        self._slots[name] = slot
+        self._refs[name] = 0
+        self._idle[name] = None
+        self.loads_total += 1
+        return slot
+
+    def unload(self, name: str) -> None:
+        """Explicitly remove an IDLE resident (refcount must be 0)."""
+        if name not in self._slots:
+            raise KeyError(f"adapter {name!r} is not resident")
+        if self._refs[name] > 0:
+            raise RuntimeError(
+                f"cannot unload adapter {name!r}: "
+                f"{self._refs[name]} decoding slot(s) still reference it")
+        self._free.append(self._slots.pop(name))
+        del self._refs[name]
+        self._idle.pop(name, None)
+        self.unloads_total += 1
+
+    # -- invariants ---------------------------------------------------------
+    def assert_consistent(self) -> None:
+        """Loud invariant check (the chaos-test hook): resident slots +
+        free slots exactly partition 1..max_adapters, refcounts exist
+        for precisely the residents and are never negative, and the
+        idle LRU is exactly the refcount-0 residents."""
+        used = sorted(self._slots.values())
+        if len(set(used)) != len(used):
+            raise AssertionError(f"duplicate slot assignment: {used}")
+        if set(used) & set(self._free):
+            raise AssertionError("slot both resident and free")
+        if sorted(used + self._free) != list(
+                range(1, self.max_adapters + 1)):
+            raise AssertionError(
+                f"slots {sorted(used + self._free)} do not partition "
+                f"1..{self.max_adapters}")
+        if set(self._refs) != set(self._slots):
+            raise AssertionError("refcount keys != resident keys")
+        if any(r < 0 for r in self._refs.values()):
+            raise AssertionError(f"negative refcount: {self._refs}")
+        idle = {n for n, r in self._refs.items() if r == 0}
+        if set(self._idle) != idle:
+            raise AssertionError(
+                f"idle LRU {set(self._idle)} != refcount-0 set {idle}")

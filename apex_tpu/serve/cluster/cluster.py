@@ -343,6 +343,13 @@ class ServeCluster:
         self.transfer_timeouts = 0
         self.transfer_failed = 0
         self.duplicates_ignored = 0
+        # per-tenant LoRA: the cluster-level adapter CATALOG (name ->
+        # (weights, scale)). Loading puts the adapter eagerly into every
+        # prefill host (prompts place by feasibility, not warmth) and
+        # lazily into decode hosts on first cold placement — the
+        # router's warm preference keeps cold loads rare at steady state
+        self._adapter_catalog: Dict[str, Tuple[Any, float]] = {}
+        self.adapter_loads = 0        # cold decode-side catalog loads
         # hard capacity for the unservable check: the roomiest decode pool
         self._max_servable_tokens = max(
             w.engine.kv_cfg.num_blocks * w.engine.kv_cfg.block_size
@@ -498,6 +505,13 @@ class ServeCluster:
                   t_ms=t, **L)
         reg.counter("transfer_retries_total", self.transfer_retries, **L)
         reg.counter("migrations_total", self.migrations_total, **L)
+        if self.cluster_cfg.serve.lora_rank > 0:
+            reg.counter("adapter_warm_dispatches_total",
+                        r.adapter_warm_dispatches, **L)
+            reg.counter("adapter_cold_dispatches_total",
+                        r.adapter_cold_dispatches, **L)
+            reg.counter("adapter_catalog_loads_total",
+                        self.adapter_loads, **L)
         reg.counter("worker_deaths_total", self.membership.worker_deaths,
                     **L)
         for tenant, rec in self.router.tenants.items():
@@ -514,6 +528,51 @@ class ServeCluster:
                               max(0.0, t - wrec.last_beat_ms),
                               t_ms=t, worker=name)
         return reg.snapshot(t)
+
+    # -- adapter catalog (per-tenant LoRA) ---------------------------------
+    def load_adapter(self, name: str, weights: Any, *,
+                     scale: float = 1.0) -> None:
+        """Register a named LoRA adapter fleet-wide. Eager into every
+        prefill host NOW (the prompt's K/V must be written with adapted
+        projections wherever it lands); decode hosts pick it up lazily —
+        the router prefers adapter-warm workers, and a cold placement
+        triggers the worker-local ``adapter_load`` there. Requires
+        ``ServeConfig(lora_rank > 0)``."""
+        if self.cluster_cfg.serve.lora_rank <= 0:
+            raise RuntimeError(
+                "adapters are disabled (ServeConfig.lora_rank == 0) — "
+                "configure lora_rank/max_adapters to serve adapters")
+        self._adapter_catalog[name] = (weights, float(scale))
+        for w in self.prefill_workers:
+            if self._state(w.name) != DEAD and w.adapters is not None:
+                if w.adapters.lookup(name) is None:
+                    w.load_adapter(name, weights, scale=scale)
+                    self._events.emit("adapter_load", name,
+                                      worker=w.name, eager=True)
+
+    def adapter_catalog(self) -> List[str]:
+        return sorted(self._adapter_catalog)
+
+    def _ensure_adapter_on(self, worker: DecodeWorker,
+                           name: str, t_ms: float) -> bool:
+        """Make ``name`` resident on ``worker`` before a handoff bound
+        to it is admitted (restore raises on a cold registry). False
+        when the worker's pool is wholly pinned by decoding slots —
+        the caller defers placement, never crashes."""
+        eng = worker.engine
+        if eng.adapters is not None and eng.adapters.lookup(name) is not None:
+            return True
+        weights, scale = self._adapter_catalog[name]
+        try:
+            worker.load_adapter(name, weights, scale=scale)
+        except RuntimeError:
+            return False
+        self.adapter_loads += 1
+        # the load IS liveness — advertise immediately so handoffs later
+        # this same tick see the fresh resident set, not last tick's
+        self.membership.beat(worker.name, t_ms,
+                             adapters=worker.resident_adapters())
+        return True
 
     # -- lifecycle ---------------------------------------------------------
     def _now_ms(self) -> float:
@@ -556,6 +615,16 @@ class ServeCluster:
                           prompt_tokens=p,
                           max_new_tokens=request.max_new_tokens,
                           tenant=getattr(request, "tenant", "default"))
+        adapter = getattr(request, "adapter", None)
+        if adapter is not None and adapter not in self._adapter_catalog:
+            # bound to an adapter nobody registered: terminal shed at
+            # the front door — NEVER served on the base model by
+            # accident, never a crash deep in a worker
+            self._record_shed(self.router.shed_submitted(
+                request, "unknown_adapter", t))
+            self._events.gauge("queue_depth", self.router.queue_depth,
+                               t_ms=t)
+            return
         total = min(p + request.max_new_tokens, self.max_context)
         decision = self.router.submit(
             request, t, total_tokens=total,
@@ -761,13 +830,37 @@ class ServeCluster:
         # place everything delivered-but-unplaced (fresh arrivals above,
         # plus handoffs evacuated from a dead worker's pending queue —
         # those crossed the wire once already and get NO new transfer
-        # telemetry) onto the least-loaded ALIVE worker
+        # telemetry). Placement is the router's adapter-aware pick over
+        # the membership advertisements: least-loaded among the
+        # ADAPTER-WARM workers when the handoff is adapter-bound, else
+        # classic least-loaded; a cold pick loads the adapter from the
+        # catalog first (the explicit adapter_load lifecycle event).
         if self._redeliver and self.alive_decode_workers():
             todo, self._redeliver = self._redeliver, []
             for h in todo:
-                worker = min(self.alive_decode_workers(),
-                             key=lambda w: w.load)
-                worker.admit(h)
+                alive = self.alive_decode_workers()
+                cands = [(w.name, w.load,
+                          self.membership.record(w.name).adapters)
+                         for w in alive]
+                name = self.router.select_worker(cands, adapter=h.adapter)
+                if h.adapter is None:
+                    self._workers[name].admit(h)
+                    continue
+                # adapter-bound: the adapter must be RESIDENT before the
+                # restore lands. Try the router's pick first, then the
+                # rest by load; a fleet whose every pool is pinned
+                # defers to the next tick (never a crash, never a hang
+                # — retiring slots free pool capacity)
+                ordered = [name] + [
+                    c[0] for c in sorted(cands, key=lambda c: c[1])
+                    if c[0] != name]
+                for wname in ordered:
+                    w2 = self._workers[wname]
+                    if self._ensure_adapter_on(w2, h.adapter, t_ms):
+                        w2.admit(h)
+                        break
+                else:
+                    self._redeliver.append(h)
         return n
 
     def _abort_if_headless(self, t_ms: float) -> int:
@@ -1003,8 +1096,14 @@ class ServeCluster:
             h = w.step()
             # beat with a FRESH timestamp: the step above may have been
             # the slow thing (a compile, a long chunk) — the worker that
-            # just proved liveness must never look stale for it
-            self.membership.beat(w.name, self._now_ms())
+            # just proved liveness must never look stale for it. The
+            # beat carries the worker's ADVERTISEMENT: resident adapter
+            # set + quant mode (the heterogeneous-fleet gossip)
+            self.membership.beat(
+                w.name, self._now_ms(),
+                adapters=(sorted(w.adapters.resident())
+                          if w.adapters is not None else None),
+                quant=w.serve_cfg.kv_quant)
             if w.chunks_run > before:  # feed only a FRESH measurement
                 self.router.observe_chunk(w.last_chunk_tokens,
                                           w.last_chunk_ms)
@@ -1020,7 +1119,11 @@ class ServeCluster:
                 continue
             if w.step():
                 decoded += 1
-            self.membership.beat(w.name, self._now_ms())
+            self.membership.beat(
+                w.name, self._now_ms(),
+                adapters=(w.resident_adapters()
+                          if w.engine.adapters is not None else None),
+                quant=w.engine.serve_cfg.kv_quant)
             wd = self._watchdogs.get(w.name)
             if wd is not None:
                 wd.tick(self._step_idx)
@@ -1191,6 +1294,35 @@ class ServeCluster:
         out["worker_deaths"] = self.membership.worker_deaths
         out["heartbeat_misses"] = self.membership.heartbeat_misses
         out["transfer_retries"] = self.transfer_retries
+        # the per-tenant adapter plane: catalog + warm-dispatch ledger
+        # (adapter_hit_rate / adapter_warm_dispatch_rate higher-better,
+        # adapter_load_ms / adapter_evictions lower-better — all four
+        # are monitor.regress polarity entries)
+        if self.cluster_cfg.serve.lora_rank > 0:
+            regs = [w.engine.adapters for w in self.decode_workers
+                    if w.engine.adapters is not None]
+            hits = sum(r.hits_total for r in regs)
+            misses = sum(r.misses_total for r in regs)
+            out["adapters"] = {
+                "catalog": self.adapter_catalog(),
+                "rank": self.cluster_cfg.serve.lora_rank,
+                "max_adapters": self.cluster_cfg.serve.max_adapters,
+                "catalog_loads": self.adapter_loads,
+                "hits": hits,
+                "misses": misses,
+                "evictions": sum(r.evictions_total for r in regs),
+                "warm_dispatches": self.router.adapter_warm_dispatches,
+                "cold_dispatches": self.router.adapter_cold_dispatches,
+            }
+            out["adapter_hit_rate"] = (
+                round(hits / (hits + misses), 4)
+                if (hits + misses) else None)
+            out["adapter_evictions"] = out["adapters"]["evictions"]
+            out["adapter_warm_dispatch_rate"] = router_stats[
+                "adapter_warm_dispatch_rate"]
+            out["adapter_load_ms"] = round(
+                sum(w.engine._adapter_load_ms_total
+                    for w in self.decode_workers), 3)
         h = self.transfer_ms_hist
         if h.total:
             out["transfer_ms_p50"] = round(h.quantile(0.5), 4)

@@ -51,7 +51,13 @@ _STATES = (ALIVE, DRAINING, DEAD)
 class WorkerRecord:
     """One worker's membership state. ``reason`` records why it left
     (``"preempted"`` / ``"killed"`` / ``"heartbeat"`` / ``"stall"`` /
-    ``"scale_down"`` / ``"drained"``)."""
+    ``"scale_down"`` / ``"drained"``).
+
+    ``adapters`` / ``quant`` are the heterogeneous-fleet ADVERTISEMENT:
+    each beat refreshes the worker's resident LoRA adapter set and its
+    KV quant mode, so the router's adapter-warm placement and any
+    fleet-mix policy read membership state instead of poking workers —
+    the gossip half of item 5c."""
 
     name: str
     kind: str                      # "prefill" | "decode"
@@ -60,6 +66,8 @@ class WorkerRecord:
     last_beat_ms: float = 0.0
     left_ms: Optional[float] = None
     reason: Optional[str] = None
+    adapters: tuple = ()           # resident adapter names, sorted
+    quant: str = "none"            # the worker's kv_quant mode
 
 
 @dataclasses.dataclass(frozen=True)
@@ -131,10 +139,18 @@ class ClusterMembership:
                               worker_kind=kind)
         return rec
 
-    def beat(self, name: str, t_ms: float) -> None:
+    def beat(self, name: str, t_ms: float,
+             adapters: Optional[List[str]] = None,
+             quant: Optional[str] = None) -> None:
+        """Record liveness (and, when given, refresh the worker's
+        advertisement: resident adapter set + quant mode)."""
         rec = self._workers[name]
         if rec.state != DEAD:
             rec.last_beat_ms = float(t_ms)
+            if adapters is not None:
+                rec.adapters = tuple(sorted(adapters))
+            if quant is not None:
+                rec.quant = quant
 
     def mark_draining(self, name: str, t_ms: float, reason: str) -> bool:
         """alive → draining (idempotent; False if already leaving)."""
@@ -274,7 +290,9 @@ class ClusterMembership:
                     "joined_at": round(r.joined_ms, 3),
                     "last_beat_at": round(r.last_beat_ms, 3),
                     "left_at": (round(r.left_ms, 3)
-                                if r.left_ms is not None else None)}
+                                if r.left_ms is not None else None),
+                    "adapters": list(r.adapters),
+                    "quant": r.quant}
                 for n, r in sorted(self._workers.items())},
             "alive": by_state[ALIVE],
             "draining": by_state[DRAINING],

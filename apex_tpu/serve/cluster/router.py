@@ -120,6 +120,12 @@ class Router:
         self.tenants_evicted = 0
         self._evicted_totals = {"submitted": 0, "admitted": 0, "shed": 0}
         self.requeued = 0
+        # adapter-aware decode placement (the fleet-mix seed): how often
+        # an adapter-bound handoff landed on a worker already holding its
+        # adapter vs forced a cold adapter_load. Base (adapter-less)
+        # traffic does not touch these.
+        self.adapter_warm_dispatches = 0
+        self.adapter_cold_dispatches = 0
 
     # -- accounting --------------------------------------------------------
     def _tenant(self, name: str) -> Dict[str, int]:
@@ -233,6 +239,21 @@ class Router:
         rec["admitted"] = max(0, rec["admitted"] - 1)
         self.requeued += 1
 
+    def shed_submitted(self, request: Request, reason: str,
+                       t_ms: float) -> ShedDecision:
+        """Terminal shed AT the front door, before the request ever
+        queues (the cluster's unknown-adapter path: a tenant bound to an
+        adapter nobody has loaded can never be served correctly — shed
+        explicitly, with full per-tenant accounting, never served on the
+        base model by accident)."""
+        tenant = getattr(request, "tenant", "default")
+        self.submitted += 1
+        self._tenant(tenant)["submitted"] += 1
+        self._last_seen[tenant] = float(t_ms)
+        d = self._shed(request, tenant, reason, None, t_ms)
+        self._gc_tenants()
+        return d
+
     def shed_admitted(self, request: Request, reason: str,
                       t_ms: float) -> ShedDecision:
         """Terminal failure of an ADMITTED request downstream of the
@@ -330,6 +351,30 @@ class Router:
             self._vclock = max(self._vclock, self._vtime[tenant])
             return (request, t_submit), sheds
 
+    # -- adapter-aware decode placement ------------------------------------
+    def select_worker(self, candidates: List[Tuple[str, int, Any]],
+                      adapter: Optional[str] = None) -> Optional[str]:
+        """Pick the decode worker for one handoff over a heterogeneous
+        fleet. ``candidates``: ``(name, load, resident_adapters)`` rows
+        built from the membership advertisements. An adapter-bound
+        handoff prefers the least-loaded ADAPTER-WARM worker (its pool
+        already holds the adapter — dispatch costs nothing extra); only
+        when no warm worker exists does it fall back to the least-loaded
+        cold one, which the cluster then loads explicitly (the
+        ``adapter_load`` lifecycle event). Base traffic and the
+        no-candidates case keep the classic least-loaded rule. Returns
+        the chosen name (None when ``candidates`` is empty)."""
+        cands = list(candidates)
+        if not cands:
+            return None
+        if adapter is not None:
+            warm = [c for c in cands if adapter in (c[2] or ())]
+            if warm:
+                self.adapter_warm_dispatches += 1
+                return min(warm, key=lambda c: c[1])[0]
+            self.adapter_cold_dispatches += 1
+        return min(cands, key=lambda c: c[1])[0]
+
     # -- stats -------------------------------------------------------------
     def stats(self) -> Dict[str, Any]:
         mpt = self.ms_per_token()
@@ -347,4 +392,12 @@ class Router:
             "tenants": {t: dict(v) for t, v in sorted(self.tenants.items())},
             "tenants_evicted": self.tenants_evicted,
             "evicted_totals": dict(self._evicted_totals),
+            "adapter_warm_dispatches": self.adapter_warm_dispatches,
+            "adapter_cold_dispatches": self.adapter_cold_dispatches,
+            "adapter_warm_dispatch_rate": (
+                round(self.adapter_warm_dispatches
+                      / (self.adapter_warm_dispatches
+                         + self.adapter_cold_dispatches), 4)
+                if (self.adapter_warm_dispatches
+                    + self.adapter_cold_dispatches) else None),
         }

@@ -53,6 +53,11 @@ from apex_tpu.serve.cluster.transfer import (
     transfer_wire_bytes,
     validate_wire_mode,
 )
+from apex_tpu.serve.adapters import (
+    AdapterRegistry,
+    init_adapter_pool,
+    write_adapter,
+)
 from apex_tpu.serve.decode import gpt_prefill_chunk
 from apex_tpu.serve.engine import (
     InferenceEngine,
@@ -105,6 +110,10 @@ class KVHandoff:
     generated: Optional[List[int]] = None   # migration: stream so far
     acked_tokens: Optional[int] = None      # migration: delivered watermark
     crc32: Optional[int] = None
+    # the adapter BINDING travels with the KV blocks (by NAME — pool slot
+    # ids are per-worker; the destination re-resolves against its own
+    # registry, loading from the cluster catalog first if cold)
+    adapter: Optional[str] = None
 
 
 def _cache_size_of(jitted) -> Optional[int]:
@@ -170,25 +179,76 @@ class PrefillWorker:
         self.last_chunk_tokens = 0
         self.last_chunk_ms = 0.0
         kv_cfg, scfg = self.kv_cfg, serve_cfg
+        # per-tenant LoRA: the prefill host owns its own paged pool +
+        # registry (the prompt's K/V must be written with the SAME adapted
+        # projections decode will read — an unadapted prefill would
+        # silently corrupt every adapter stream)
+        self._lora_pool = None
+        self.adapters: Optional[AdapterRegistry] = None
+        if scfg.lora_rank > 0:
+            self._lora_pool = init_adapter_pool(
+                cfg, scfg.lora_rank, scfg.max_adapters)
+            self.adapters = AdapterRegistry(scfg.max_adapters)
 
-        def chunk_prefill(params, cache, tokens, start, n_valid, block_row,
-                          key):
-            # the engine's chunk closure verbatim — same program, same
-            # first-token draw, which is why cluster streams are bitwise
-            # the single-engine ones
-            cache, logits = gpt_prefill_chunk(
-                params, tokens, start, n_valid, cache, block_row, cfg,
-                kv_cfg, use_pallas=use_pallas)
-            tok = sample(logits[None], key[None],
-                         jnp.reshape(start + n_valid, (1,)), scfg.sampling)
-            return cache, tok[0]
+        if scfg.lora_rank > 0:
+            def chunk_prefill(params, cache, lora, tokens, start, n_valid,
+                              block_row, key, aid):
+                # the engine's LoRA chunk closure verbatim: the pool rides
+                # as its own donated leaf set and is returned untouched
+                cache, logits = gpt_prefill_chunk(
+                    params, tokens, start, n_valid, cache, block_row, cfg,
+                    kv_cfg, use_pallas=use_pallas, adapters=lora,
+                    adapter_id=aid)
+                tok = sample(logits[None], key[None],
+                             jnp.reshape(start + n_valid, (1,)),
+                             scfg.sampling)
+                return cache, lora, tok[0]
+
+            self._chunk_prefill = jax.jit(chunk_prefill,
+                                          donate_argnums=(1, 2))
+        else:
+            def chunk_prefill(params, cache, tokens, start, n_valid,
+                              block_row, key):
+                # the engine's chunk closure verbatim — same program, same
+                # first-token draw, which is why cluster streams are
+                # bitwise the single-engine ones
+                cache, logits = gpt_prefill_chunk(
+                    params, tokens, start, n_valid, cache, block_row, cfg,
+                    kv_cfg, use_pallas=use_pallas)
+                tok = sample(logits[None], key[None],
+                             jnp.reshape(start + n_valid, (1,)),
+                             scfg.sampling)
+                return cache, tok[0]
+
+            self._chunk_prefill = jax.jit(chunk_prefill,
+                                          donate_argnums=(1,))
 
         def extract(cache, ids):
             return pack_blocks(cache, kv_cfg, ids, wire_mode=wire_mode)
 
         self.params = params
-        self._chunk_prefill = jax.jit(chunk_prefill, donate_argnums=(1,))
         self._extract = jax.jit(extract)
+
+    # -- adapter lifecycle -------------------------------------------------
+    def load_adapter(self, name: str, weights: Dict[str, Any], *,
+                     scale: float = 1.0) -> int:
+        """Install a named adapter into this prefill host's paged pool
+        (host-side eager write — never traces). The cluster loads the
+        whole catalog eagerly into every prefill worker: prompts are
+        placed by feasibility, not adapter warmth."""
+        if self.adapters is None:
+            raise RuntimeError(
+                f"{self.name}: adapters are disabled "
+                "(ServeConfig.lora_rank == 0)")
+        slot = self.adapters.load(name)
+        self._lora_pool = write_adapter(self._lora_pool, slot, weights,
+                                        scale=scale)
+        return slot
+
+    def unload_adapter(self, name: str) -> None:
+        if self.adapters is None:
+            raise RuntimeError(f"{self.name}: adapters are disabled")
+        self.adapters.unload(name)
 
     # -- capacity / submission --------------------------------------------
     @property
@@ -254,12 +314,29 @@ class PrefillWorker:
         if cur is None:
             return None
         self.allocator.free(cur["blocks"])
+        if cur["aid"] and self.adapters is not None:
+            self.adapters.release(cur["request"].adapter)
         self._current = None
         return (cur["request"], cur["t_submit_ms"])
 
     # -- stepping ----------------------------------------------------------
     def _start_next(self) -> None:
         request, t_submit = self._queue.popleft()
+        aid = 0
+        if request.adapter is not None:
+            if self.adapters is None:
+                raise RuntimeError(
+                    f"{self.name}: {request.uid} is bound to adapter "
+                    f"{request.adapter!r} but this prefill host has "
+                    "adapters disabled")
+            aid = self.adapters.acquire(request.adapter)
+            if aid is None:
+                # the cluster loads the catalog eagerly into every
+                # prefill worker — a miss here is a routing bug, not a
+                # recoverable condition
+                raise RuntimeError(
+                    f"{self.name}: adapter {request.adapter!r} is not "
+                    f"resident (catalog load missed this host?)")
         p = len(request.tokens)
         blocks = self.allocator.alloc(self.kv_cfg.blocks_for_tokens(p))
         assert blocks is not None  # staging pool fits any valid prompt
@@ -282,6 +359,7 @@ class PrefillWorker:
             "key": jnp.asarray(
                 request_key(self._base_key, request.sampling_seed())),
             "t_submit_ms": t_submit, "queue_ms": t - t_submit,
+            "aid": aid,
         }
 
     def step(self) -> Optional[KVHandoff]:
@@ -302,9 +380,16 @@ class PrefillWorker:
             cur["request"].tokens[c:c + n_valid], np.int32)
         t0 = time.perf_counter()
         with span("prefill"):
-            self.cache, tok = self._chunk_prefill(
-                self.params, self.cache, jnp.asarray(tokens),
-                jnp.int32(c), jnp.int32(n_valid), cur["row"], cur["key"])
+            if self._lora_pool is not None:
+                self.cache, self._lora_pool, tok = self._chunk_prefill(
+                    self.params, self.cache, self._lora_pool,
+                    jnp.asarray(tokens), jnp.int32(c), jnp.int32(n_valid),
+                    cur["row"], cur["key"], jnp.int32(cur["aid"]))
+            else:
+                self.cache, tok = self._chunk_prefill(
+                    self.params, self.cache, jnp.asarray(tokens),
+                    jnp.int32(c), jnp.int32(n_valid), cur["row"],
+                    cur["key"])
             cur["pos"] = c + n_valid
             done = cur["pos"] >= p
             if done:
@@ -339,6 +424,8 @@ class PrefillWorker:
         wire = transfer_wire_bytes(self.kv_cfg, n_blocks, self.wire_mode)
         assert payload_nbytes(payload, n_blocks) == wire
         self.allocator.free(cur["blocks"])
+        if cur["aid"] and self.adapters is not None:
+            self.adapters.release(cur["request"].adapter)
         self._current = None
         self.prefills_done += 1
         return KVHandoff(
@@ -346,7 +433,8 @@ class PrefillWorker:
             prompt_len=p, first_token=first, wire_bytes=wire,
             t_submit_ms=cur["t_submit_ms"], queue_ms=cur["queue_ms"],
             t_first_ms=t_first, ttft_ms=t_first - cur["t_submit_ms"],
-            crc32=payload_crc32(payload))
+            crc32=payload_crc32(payload),
+            adapter=cur["request"].adapter)
 
 
 class DecodeWorker:
@@ -404,6 +492,22 @@ class DecodeWorker:
         out = self.engine.compile_counts()
         out["insert"] = _cache_size_of(self._insert)
         return out
+
+    # -- adapter lifecycle (lazy: loaded on first warm-miss placement) -----
+    def load_adapter(self, name: str, weights: Dict[str, Any], *,
+                     scale: float = 1.0) -> int:
+        return self.engine.load_adapter(name, weights, scale=scale)
+
+    def unload_adapter(self, name: str) -> None:
+        self.engine.unload_adapter(name)
+
+    def resident_adapters(self) -> List[str]:
+        """Adapter names resident in this worker's pool — the membership
+        heartbeat advertisement (what the router's warm-preference
+        placement reads)."""
+        if self.engine.adapters is None:
+            return []
+        return sorted(self.engine.adapters.resident())
 
     def scrape(self) -> Dict[str, Any]:
         """FleetScraper target: the engine's series plus this worker's
@@ -468,6 +572,7 @@ class DecodeWorker:
             "seq_len": h.prompt_len, "last_token": h.first_token,
             "t_submit_ms": h.t_submit_ms, "t_first_ms": h.t_first_ms,
             "queue_ms": h.queue_ms, "ttft_ms": h.ttft_ms,
+            "adapter": h.adapter,
         }
         slot = eng.restore_slot(record, blocks=blocks)
         eng._tokens_generated += 1  # the first token rode the handoff
@@ -515,6 +620,7 @@ class DecodeWorker:
             "seq_len": h.seq_len, "last_token": h.last_token,
             "t_submit_ms": h.t_submit_ms, "t_first_ms": h.t_first_ms,
             "queue_ms": h.queue_ms, "ttft_ms": h.ttft_ms,
+            "adapter": h.adapter,
         }
         slot = eng.restore_slot(record, blocks=blocks)
         self.admitted += 1
@@ -571,7 +677,8 @@ class DecodeWorker:
             ttft_ms=rec["ttft_ms"], kind="migration",
             seq_len=rec["seq_len"], last_token=rec["last_token"],
             generated=gen, acked_tokens=max(0, len(gen) - 1),
-            crc32=payload_crc32(payload))
+            crc32=payload_crc32(payload),
+            adapter=rec.get("adapter"))
 
     def live_uids(self) -> List[str]:
         """Requests currently occupying slots (the migration worklist)."""

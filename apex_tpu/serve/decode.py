@@ -375,10 +375,19 @@ def _serve_heads(cfg, tp_axis: Optional[str]) -> int:
     return cfg.num_heads // tp
 
 
-def _check_serve_cfg(cfg, kv_cfg: KVCacheConfig, tp_axis) -> None:
-    if cfg.num_experts:
+def ensure_dense_ffn(num_experts: int) -> None:
+    """The ONE MoE serving refusal (shared by every serve entry point —
+    the paged forward programs and the engine constructor): the decode
+    path assumes a dense FFN; routed-expert serving is ROADMAP item 5a."""
+    if num_experts:
         raise NotImplementedError(
-            "serve does not support MoE layers yet (num_experts > 0)")
+            "serve does not support MoE layers (num_experts > 0) yet — "
+            "the paged decode/prefill programs assume a dense FFN. "
+            "MoE serving is ROADMAP item 5a.")
+
+
+def _check_serve_cfg(cfg, kv_cfg: KVCacheConfig, tp_axis) -> None:
+    ensure_dense_ffn(cfg.num_experts)
     heads_local = _serve_heads(cfg, tp_axis)
     if kv_cfg.num_heads != heads_local or kv_cfg.head_dim != cfg.head_dim:
         raise ValueError(
@@ -464,7 +473,9 @@ def gpt_prefill(params, tokens, prompt_len, cache, block_row,
 def gpt_paged_forward(params, tokens, start_lens, n_valid, active, cache,
                       block_tables, cfg, kv_cfg: KVCacheConfig,
                       tp_axis: Optional[str] = None,
-                      use_pallas: Optional[bool] = None
+                      use_pallas: Optional[bool] = None,
+                      adapters: Optional[Pytree] = None,
+                      adapter_ids=None
                       ) -> Tuple[Pytree, jnp.ndarray]:
     """Process ``tokens`` (n, q) — per slot, q consecutive tokens starting
     at position ``start_lens[slot]`` — against the paged cache.
@@ -475,8 +486,24 @@ def gpt_paged_forward(params, tokens, start_lens, n_valid, active, cache,
     next-token distribution after feeding tokens[i, j] at position
     ``start_lens[i] + j``. Inactive slots and invalid positions produce
     finite junk logits the engine ignores.
+
+    ``adapters``: an optional ``serve.adapters`` AdapterPool — per-layer
+    LoRA slot stacks riding the layer scan as read-only xs; each row adds
+    its adapter's gathered BGMV delta (``lora_delta``) to the four
+    adapted projections, with ``adapter_ids`` (n,) int32 selecting the
+    pool slot per batch row (id 0 = base = exact zero delta). Per-ROW
+    like everything else here, so the same pool serves decode, verify
+    and chunked prefill from one compiled program each.
     """
     _check_serve_cfg(cfg, kv_cfg, tp_axis)
+    if adapters is not None:
+        if tp_axis is not None:
+            raise NotImplementedError(
+                "paged LoRA adapters are single-device for now — the pool "
+                "is not TP-sharded (pass tp_axis=None)")
+        if adapter_ids is None:
+            raise ValueError("adapters given without adapter_ids")
+        from apex_tpu.serve.adapters import lora_delta
     heads_local = _serve_heads(cfg, tp_axis)
     n, q = tokens.shape
     offs = jnp.arange(q)
@@ -492,10 +519,17 @@ def gpt_paged_forward(params, tokens, start_lens, n_valid, active, cache,
     x = _embed(params["embed"], tokens, positions_c, tp_axis)  # (n, q, h)
 
     def body(x, xs):
-        lp, cl = xs
+        if adapters is None:
+            lp, cl = xs
+            ad = None
+        else:
+            lp, cl, ad = xs
         h1 = layer_norm(x, lp["ln1_w"], lp["ln1_b"],
                         use_pallas=cfg.ln_pallas)
         qkv = _col(h1, lp["qkv_kernel"], lp["qkv_bias"], tp_axis)
+        if ad is not None:
+            qkv = qkv + lora_delta(h1, ad["qkv_a"], ad["qkv_b"],
+                                   adapter_ids)
         qh, k, v = _split_qkv(qkv, heads_local, cfg.head_dim)  # (n,q,H,D)
         k_flat = k.reshape(n * q, heads_local, cfg.head_dim)
         v_flat = v.reshape(n * q, heads_local, cfg.head_dim)
@@ -505,24 +539,39 @@ def gpt_paged_forward(params, tokens, start_lens, n_valid, active, cache,
         ctx = paged_attention(qh.reshape(n * q, heads_local, cfg.head_dim),
                               cl, kv_cfg, bt_rows,
                               ctx_lens.reshape(-1), use_pallas=use_pallas)
-        a = _row(ctx.reshape(n, q, heads_local * cfg.head_dim),
-                 lp["out_kernel"], lp["out_bias"], tp_axis)
+        ctx = ctx.reshape(n, q, heads_local * cfg.head_dim)
+        a = _row(ctx, lp["out_kernel"], lp["out_bias"], tp_axis)
+        if ad is not None:
+            a = a + lora_delta(ctx, ad["out_a"], ad["out_b"], adapter_ids)
         x = x + a
         h2 = layer_norm(x, lp["ln2_w"], lp["ln2_b"],
                         use_pallas=cfg.ln_pallas)
-        y = jax.nn.gelu(_col(h2, lp["fc1_kernel"], lp["fc1_bias"], tp_axis),
-                        approximate=True)
-        x = x + _row(y, lp["fc2_kernel"], lp["fc2_bias"], tp_axis)
+        pre = _col(h2, lp["fc1_kernel"], lp["fc1_bias"], tp_axis)
+        if ad is not None:
+            pre = pre + lora_delta(h2, ad["fc1_a"], ad["fc1_b"],
+                                   adapter_ids)
+        y = jax.nn.gelu(pre, approximate=True)
+        m = _row(y, lp["fc2_kernel"], lp["fc2_bias"], tp_axis)
+        if ad is not None:
+            m = m + lora_delta(y, ad["fc2_a"], ad["fc2_b"], adapter_ids)
+        x = x + m
         return x, cl
 
-    x, cache = lax.scan(body, x, (params["layers"], cache))
+    # the adapter pool rides the scan as read-only xs (sliced per layer,
+    # never restacked into ys — no per-step pool copy); the caller's jit
+    # site donates it and returns it untouched
+    xs = ((params["layers"], cache) if adapters is None
+          else (params["layers"], cache, adapters))
+    x, cache = lax.scan(body, x, xs)
     return cache, serve_logits(params, x, cfg, tp_axis)
 
 
 def gpt_decode_step(params, last_tokens, seq_lens, active, cache,
                     block_tables, cfg, kv_cfg: KVCacheConfig,
                     tp_axis: Optional[str] = None,
-                    use_pallas: Optional[bool] = None
+                    use_pallas: Optional[bool] = None,
+                    adapters: Optional[Pytree] = None,
+                    adapter_ids=None
                     ) -> Tuple[Pytree, jnp.ndarray]:
     """Advance every active slot by one token (q=1 paged forward).
 
@@ -530,20 +579,24 @@ def gpt_decode_step(params, last_tokens, seq_lens, active, cache,
     sampled last step). ``seq_lens``: (n,) tokens already cached — the fed
     token's position. ``active``: (n,) bool. Returns ``(cache', logits
     (n, vocab) fp32)``; inactive slots produce finite junk logits the
-    engine ignores.
+    engine ignores. ``adapters``/``adapter_ids``: optional per-slot LoRA
+    (see :func:`gpt_paged_forward`).
     """
     n = last_tokens.shape[0]
     cache, logits = gpt_paged_forward(
         params, last_tokens[:, None], seq_lens,
         jnp.ones((n,), jnp.int32), active, cache, block_tables, cfg,
-        kv_cfg, tp_axis=tp_axis, use_pallas=use_pallas)
+        kv_cfg, tp_axis=tp_axis, use_pallas=use_pallas,
+        adapters=adapters, adapter_ids=adapter_ids)
     return cache, logits[:, 0]
 
 
 def gpt_verify_step(params, fed_tokens, seq_lens, n_fed, active, cache,
                     block_tables, cfg, kv_cfg: KVCacheConfig,
                     tp_axis: Optional[str] = None,
-                    use_pallas: Optional[bool] = None
+                    use_pallas: Optional[bool] = None,
+                    adapters: Optional[Pytree] = None,
+                    adapter_ids=None
                     ) -> Tuple[Pytree, jnp.ndarray]:
     """Speculative verify: feed ``fed_tokens`` (n, k+1) — each slot's last
     sampled token followed by up to k drafted tokens — in ONE paged call
@@ -557,13 +610,16 @@ def gpt_verify_step(params, fed_tokens, seq_lens, n_fed, active, cache,
     ``mode="drop"``/masking contract that drops padded writes)."""
     return gpt_paged_forward(params, fed_tokens, seq_lens, n_fed, active,
                              cache, block_tables, cfg, kv_cfg,
-                             tp_axis=tp_axis, use_pallas=use_pallas)
+                             tp_axis=tp_axis, use_pallas=use_pallas,
+                             adapters=adapters, adapter_ids=adapter_ids)
 
 
 def gpt_prefill_chunk(params, tokens, start, n_valid, cache, block_row,
                       cfg, kv_cfg: KVCacheConfig,
                       tp_axis: Optional[str] = None,
-                      use_pallas: Optional[bool] = None
+                      use_pallas: Optional[bool] = None,
+                      adapters: Optional[Pytree] = None,
+                      adapter_id=None
                       ) -> Tuple[Pytree, jnp.ndarray]:
     """Process one fixed-size chunk of ONE prompt into the cache.
 
@@ -578,11 +634,17 @@ def gpt_prefill_chunk(params, tokens, start, n_valid, cache, block_row,
     lifetime, replacing the PR-5 bucket ladder: the chunk interleaves
     into decode steps, so long prompts neither stall running decodes nor
     mint per-bucket compilations.
+
+    ``adapters``/``adapter_id``: optional LoRA — ``adapter_id`` is the
+    ONE prefilling slot's pool id (scalar; the prompt's K/V must be
+    written with the same adapted projections decode will use).
     """
+    aids = (None if adapters is None
+            else jnp.reshape(jnp.asarray(adapter_id, jnp.int32), (1,)))
     cache, logits = gpt_paged_forward(
         params, tokens[None, :], jnp.asarray(start)[None],
         jnp.asarray(n_valid)[None], jnp.ones((1,), bool), cache,
         block_row[None, :], cfg, kv_cfg, tp_axis=tp_axis,
-        use_pallas=use_pallas)
+        use_pallas=use_pallas, adapters=adapters, adapter_ids=aids)
     last = jnp.take(logits[0], jnp.maximum(n_valid - 1, 0), axis=0)
     return cache, last

@@ -97,7 +97,14 @@ from apex_tpu.monitor.hist import DEFAULT_LATENCY_SPEC, HistSpec, Histogram
 from apex_tpu.monitor.metrics import Metrics
 from apex_tpu.monitor.slo import SloSpec, SloTracker
 from apex_tpu.monitor.trace import span
+from apex_tpu.serve.adapters import (
+    AdapterRegistry,
+    adapter_pool_bytes,
+    init_adapter_pool,
+    write_adapter,
+)
 from apex_tpu.serve.decode import (
+    ensure_dense_ffn,
     gpt_decode_step,
     gpt_prefill_chunk,
     gpt_verify_step,
@@ -139,13 +146,17 @@ class Request:
     (default: crc32 of the uid — stable across runs and admission orders);
     irrelevant under greedy decoding. ``tenant`` names the paying party
     for the cluster router's weighted fair queueing (the single engine
-    ignores it)."""
+    ignores it). ``adapter`` names the tenant's LoRA adapter (None =
+    the base model): admission binds it to a resident pool slot and an
+    unknown name is SHED via ``on_reject``, never served on the wrong
+    weights."""
 
     uid: str
     tokens: Sequence[int]
     max_new_tokens: int = 64
     seed: Optional[int] = None
     tenant: str = "default"
+    adapter: Optional[str] = None
 
     def sampling_seed(self) -> int:
         if self.seed is not None:
@@ -191,6 +202,13 @@ class ServeConfig:
     kv_quant: str = "none"
     # int4 scale-group length along head_dim (None: one scale per vector)
     kv_group: Optional[int] = None
+    # per-tenant paged LoRA (serve.adapters): rank of the A/B factors
+    # (0 disables — the programs are built WITHOUT adapter arguments and
+    # trace identically to the pre-adapter engine) and the number of
+    # concurrently-resident adapters (pool slots beyond the reserved
+    # base slot 0)
+    lora_rank: int = 0
+    max_adapters: int = 0
     sampling: SamplingConfig = dataclasses.field(
         default_factory=SamplingConfig)
 
@@ -217,6 +235,14 @@ class ServeConfig:
                              f"got {self.kv_quant!r}")
         if self.kv_group is not None and self.kv_quant != "int4":
             raise ValueError("kv_group only applies to kv_quant='int4'")
+        if self.lora_rank < 0:
+            raise ValueError("lora_rank must be >= 0")
+        if self.max_adapters < 0:
+            raise ValueError("max_adapters must be >= 0")
+        if self.lora_rank > 0 and self.max_adapters < 1:
+            raise ValueError("lora_rank > 0 needs max_adapters >= 1")
+        if self.max_adapters > 0 and self.lora_rank == 0:
+            raise ValueError("max_adapters > 0 needs lora_rank > 0")
         self.sampling.validate()
 
 
@@ -226,7 +252,7 @@ _HIST_NAMES = ("ttft_ms", "tpot_ms", "queue_ms", "e2e_ms",
 
 # host arrays with cached device mirrors (uploaded only when dirty)
 _MIRROR_NAMES = ("block_tables", "seq_lens", "last_tokens", "active",
-                 "keys")
+                 "keys", "adapter_ids")
 
 
 @dataclasses.dataclass
@@ -250,6 +276,7 @@ class _SlotState:
     ttft_ms: float = 0.0
     chunk_start_ms: float = 0.0  # start of the decode chunk being accumulated
     chunk_done: int = 0          # tokens already covered by emitted chunks
+    adapter_id: int = 0          # resident pool slot this request decodes on
 
 
 class InferenceEngine:
@@ -308,8 +335,7 @@ class InferenceEngine:
     ):
         scfg = serve_cfg or ServeConfig()
         scfg.validate()
-        if cfg.num_experts:
-            raise NotImplementedError("serve does not support MoE yet")
+        ensure_dense_ffn(cfg.num_experts)
         if (tp_axis is None) != (tp_size == 1):
             raise ValueError("pass tp_axis together with tp_size > 1 "
                              "(and a shard_map transform)")
@@ -345,12 +371,29 @@ class InferenceEngine:
         elif drafter is not None:
             raise ValueError("drafter given but spec_k == 0 — set "
                              "ServeConfig.spec_k to enable speculation")
+        # per-tenant paged LoRA: the donated AdapterPool + the host-side
+        # registry (None/None when disabled — the programs are then built
+        # WITHOUT adapter arguments, trace-identical to the pre-adapter
+        # engine)
+        self._lora_pool = None
+        self.adapters: Optional[AdapterRegistry] = None
+        self._adapter_load_ms_total = 0.0
+        if scfg.lora_rank > 0:
+            if tp_axis is not None:
+                raise NotImplementedError(
+                    "paged LoRA adapters are single-device for now — the "
+                    "AdapterPool is not TP-sharded (lora_rank needs "
+                    "tp_axis=None)")
+            self._lora_pool = init_adapter_pool(cfg, scfg.lora_rank,
+                                                scfg.max_adapters)
+            self.adapters = AdapterRegistry(scfg.max_adapters)
         n = scfg.num_slots
         self._block_tables = np.zeros((n, self._blocks_per_slot), np.int32)
         self._seq_lens = np.zeros((n,), np.int32)
         self._last_tokens = np.zeros((n,), np.int32)
         self._active = np.zeros((n,), bool)
         self._keys = np.zeros((n, 2), np.uint32)
+        self._adapter_ids = np.zeros((n,), np.int32)
         # device mirrors of the host arrays above: uploaded lazily, reused
         # until a host mutation marks them dirty (the satellite gate —
         # steady-state decode re-uploads ONLY what changed)
@@ -428,6 +471,7 @@ class InferenceEngine:
         if mode == "off":
             return False
         supported = (self._tp_axis is None
+                     and self.serve_cfg.lora_rank == 0
                      and megakernel_ok(self.cfg, self.kv_cfg,
                                        allow_interpret=(mode == "on")))
         if mode == "on":
@@ -435,8 +479,9 @@ class InferenceEngine:
                 raise ValueError(
                     "megakernel='on' but the fused decode block does not "
                     "support this configuration (TP-sharded programs, MoE, "
-                    "head_dim % 8 != 0, or per-layer weights over the VMEM "
-                    "budget) — use megakernel='off'/'auto'")
+                    "LoRA adapters, head_dim % 8 != 0, or per-layer "
+                    "weights over the VMEM budget) — use "
+                    "megakernel='off'/'auto'")
             return True
         return supported
 
@@ -484,6 +529,11 @@ class InferenceEngine:
         cfg, kv_cfg, scfg = self.cfg, self.kv_cfg, self.serve_cfg
 
         tp_axis = self._tp_axis
+        if self._lora_pool is not None:
+            # the adapter-enabled closures take the donated pool as a
+            # second donated argument and return it untouched
+            self._build_lora_programs(wrap)
+            return
 
         def chunk_prefill(params, cache, tokens, start, n_valid, block_row,
                           key):
@@ -549,6 +599,68 @@ class InferenceEngine:
         # copy-on-write block copy (src/dst traced -> one compile, ever)
         self._cow = jax.jit(wrap(cow), donate_argnums=(0,))
 
+    def _build_lora_programs(self, wrap) -> None:
+        """The adapter-enabled program set: same jit sites, same keys,
+        ONE compile each — the AdapterPool rides every call as a SECOND
+        donated argument (argnum 2, next to the KV cache at 1) and is
+        returned untouched (identity output aliasing: no copy, no leak —
+        ``analyze.adapters`` pins it). Which adapters are resident or
+        active is pure DATA (pool contents + the ``adapter_ids`` mirror),
+        so loads/unloads/swaps never retrace."""
+        cfg, kv_cfg, scfg = self.cfg, self.kv_cfg, self.serve_cfg
+
+        tp_axis = self._tp_axis
+
+        def chunk_prefill(params, cache, lora, tokens, start, n_valid,
+                          block_row, key, aid):
+            cache, logits = gpt_prefill_chunk(
+                params, tokens, start, n_valid, cache, block_row, cfg,
+                kv_cfg, tp_axis=tp_axis, use_pallas=self._use_pallas,
+                adapters=lora, adapter_id=aid)
+            tok = sample(logits[None], key[None],
+                         jnp.reshape(start + n_valid, (1,)), scfg.sampling)
+            return cache, lora, tok[0]
+
+        def decode(params, cache, lora, last_tokens, seq_lens, active,
+                   block_tables, keys, adapter_ids):
+            cache, logits = gpt_decode_step(
+                params, last_tokens, seq_lens, active, cache,
+                block_tables, cfg, kv_cfg, tp_axis=tp_axis,
+                use_pallas=self._use_pallas, adapters=lora,
+                adapter_ids=adapter_ids)
+            toks = sample(logits, keys, seq_lens + 1, scfg.sampling)
+            m = Metrics().record(
+                active_slots=jnp.sum(active),
+                context_tokens=jnp.sum(
+                    jnp.where(active, seq_lens + 1, 0)))
+            return cache, lora, toks, m
+
+        def verify(params, cache, lora, fed_tokens, seq_lens, n_fed,
+                   active, block_tables, keys, adapter_ids):
+            cache, logits = gpt_verify_step(
+                params, fed_tokens, seq_lens, n_fed, active, cache,
+                block_tables, cfg, kv_cfg, tp_axis=tp_axis,
+                use_pallas=self._use_pallas, adapters=lora,
+                adapter_ids=adapter_ids)
+            k1 = fed_tokens.shape[1]
+            draw_pos = seq_lens[:, None] + 1 + jnp.arange(k1)[None, :]
+            toks = sample(logits, keys, draw_pos, scfg.sampling)
+            m = Metrics().record(
+                active_slots=jnp.sum(active),
+                context_tokens=jnp.sum(
+                    jnp.where(active, seq_lens + 1, 0)))
+            return cache, lora, toks, m
+
+        def cow(cache, src, dst):
+            return copy_block(cache, src, dst)
+
+        self._chunk_prefill = jax.jit(wrap(chunk_prefill),
+                                      donate_argnums=(1, 2))
+        self._decode = jax.jit(wrap(decode), donate_argnums=(1, 2))
+        self._verify = (jax.jit(wrap(verify), donate_argnums=(1, 2))
+                        if scfg.spec_k > 0 else None)
+        self._cow = jax.jit(wrap(cow), donate_argnums=(0,))
+
     def programs(self) -> Dict[str, Optional[Callable]]:
         """The engine's jitted programs, keyed like :meth:`compile_counts`
         — hand this straight to ``analyze.recompile_guard`` to pin a
@@ -599,6 +711,10 @@ class InferenceEngine:
             raise ValueError(
                 f"{request.uid}: prompt ({p}) must leave room to generate "
                 f"(max_context {self.max_context})")
+        if request.adapter is not None and self.adapters is None:
+            raise ValueError(
+                f"{request.uid}: adapter {request.adapter!r} requested "
+                f"but adapters are disabled (ServeConfig.lora_rank == 0)")
         t = self._now_ms()
         self._pending.append((request, t))
         if self._events is not None:
@@ -627,6 +743,35 @@ class InferenceEngine:
         return min(len(request.tokens) + request.max_new_tokens,
                    self.max_context)
 
+    def _resolve_adapter(self, request: Request) -> Optional[int]:
+        """Bind the head request to its adapter's pool slot (refcount
+        acquired — released at retirement/eviction). None means the
+        request was SHED (unknown adapter, reject hook wired): the head
+        was popped, the admission loop continues. Without a hook the
+        unknown adapter raises — the single-engine analogue of run()'s
+        deadlock-loud pool_exhausted."""
+        if request.adapter is None:
+            return 0
+        assert self.adapters is not None  # submit() refused otherwise
+        aid = self.adapters.acquire(request.adapter)
+        if aid is not None:
+            return aid
+        self._pending.popleft()
+        self._rejected += 1
+        info = {"reason": "unknown_adapter", "adapter": request.adapter,
+                "resident": sorted(self.adapters.resident())}
+        if self._on_reject is not None:
+            self._on_reject(request, info)
+            if self._events is not None:
+                self._events.emit("shed", request.uid,
+                                  reason="unknown_adapter",
+                                  adapter=request.adapter)
+            return None
+        raise KeyError(
+            f"{request.uid}: unknown adapter {request.adapter!r} "
+            f"(resident: {info['resident']}) — load_adapter() it first "
+            f"or wire on_reject to shed")
+
     def _try_admit(self) -> int:
         admitted = 0
         while self._pending:
@@ -634,6 +779,9 @@ class InferenceEngine:
             if slot is None:
                 break
             request, t_submit = self._pending[0]
+            aid = self._resolve_adapter(request)
+            if aid is None:
+                continue  # shed: head popped, try the next request
             n_blocks = self.kv_cfg.blocks_for_tokens(
                 self._total_tokens(request))
             bs = self.kv_cfg.block_size
@@ -661,15 +809,19 @@ class InferenceEngine:
             if fresh is None:
                 if hit:
                     self.allocator.free(hit)  # release the acquired refs
+                if aid and request.adapter is not None:
+                    # drop the adapter pin too — re-acquired on retry
+                    self.adapters.release(request.adapter)
                 break  # pool full: wait for a retirement to free blocks
             self._pending.popleft()
-            self._admit(slot, request, hit, fresh, cow, hashes, t_submit)
+            self._admit(slot, request, hit, fresh, cow, hashes, t_submit,
+                        aid)
             admitted += 1
         return admitted
 
     def _admit(self, slot: int, request: Request, hit: List[int],
                fresh: List[int], cow: bool, hashes: List[int],
-               t_submit_ms: float) -> None:
+               t_submit_ms: float, adapter_id: int = 0) -> None:
         p = len(request.tokens)
         bs = self.kv_cfg.block_size
         n_hit = len(hit)
@@ -727,11 +879,13 @@ class InferenceEngine:
                            history=[int(t) for t in request.tokens],
                            prompt_len=p, prefill_pos=cached,
                            cached_tokens=cached, pending_commits=commits,
-                           t_submit_ms=t_submit_ms, queue_ms=queue_ms)
+                           t_submit_ms=t_submit_ms, queue_ms=queue_ms,
+                           adapter_id=adapter_id)
         self._slots[slot] = state
         self._block_tables[slot] = row
         self._keys[slot] = key
-        self._dirty("block_tables", "keys")
+        self._adapter_ids[slot] = adapter_id
+        self._dirty("block_tables", "keys", "adapter_ids")
         self._prefill_queue.append(slot)
 
     # -- chunked prefill ---------------------------------------------------
@@ -759,10 +913,17 @@ class InferenceEngine:
         tokens[:n_valid] = np.asarray(
             state.request.tokens[c:c + n_valid], np.int32)
         with span("prefill"):
-            self.cache, tok = self._chunk_prefill(
-                self.params, self.cache, jnp.asarray(tokens),
-                jnp.int32(c), jnp.int32(n_valid),
-                self._dev("block_tables")[slot], self._dev("keys")[slot])
+            if self._lora_pool is None:
+                self.cache, tok = self._chunk_prefill(
+                    self.params, self.cache, jnp.asarray(tokens),
+                    jnp.int32(c), jnp.int32(n_valid),
+                    self._dev("block_tables")[slot], self._dev("keys")[slot])
+            else:
+                self.cache, self._lora_pool, tok = self._chunk_prefill(
+                    self.params, self.cache, self._lora_pool,
+                    jnp.asarray(tokens), jnp.int32(c), jnp.int32(n_valid),
+                    self._dev("block_tables")[slot], self._dev("keys")[slot],
+                    self._dev("adapter_ids")[slot])
             state.prefill_pos = c + n_valid
             self._chunks_run += 1
             done = state.prefill_pos >= p
@@ -859,6 +1020,8 @@ class InferenceEngine:
         if self._on_retire is not None:
             self._on_retire(uid, state.generated)
         self.allocator.free(state.blocks)
+        if state.adapter_id and state.request.adapter is not None:
+            self.adapters.release(state.request.adapter)
         self._release_slot(slot, now)
 
     def _release_slot(self, slot: int, now: float) -> None:
@@ -870,7 +1033,9 @@ class InferenceEngine:
         self._seq_lens[slot] = 0
         self._last_tokens[slot] = 0
         self._block_tables[slot] = 0
-        self._dirty("block_tables", "seq_lens", "last_tokens", "active")
+        self._adapter_ids[slot] = 0
+        self._dirty("block_tables", "seq_lens", "last_tokens", "active",
+                    "adapter_ids")
         if self._events is not None:
             self._events.gauge("occupancy", self.occupancy(), t_ms=now)
 
@@ -917,7 +1082,13 @@ class InferenceEngine:
             "t_first_ms": state.t_first_ms,
             "queue_ms": state.queue_ms,
             "ttft_ms": state.ttft_ms,
+            # the adapter BINDING travels with the KV blocks: the name
+            # (per-worker slot ids don't survive migration) — the restore
+            # target re-resolves it against ITS registry
+            "adapter": state.request.adapter,
         }
+        if state.adapter_id and state.request.adapter is not None:
+            self.adapters.release(state.request.adapter)
         self._release_slot(slot, self._now_ms())
         return record
 
@@ -937,6 +1108,19 @@ class InferenceEngine:
                 f"{record['request'].uid}: no free slot to restore into")
         blocks = list(record["blocks"] if blocks is None else blocks)
         now = self._now_ms()
+        aname = record.get("adapter")
+        aid = 0
+        if aname is not None:
+            if self.adapters is None:
+                raise RuntimeError(
+                    f"{record['request'].uid}: record is bound to adapter "
+                    f"{aname!r} but this engine has adapters disabled")
+            aid = self.adapters.acquire(aname)
+            if aid is None:
+                raise RuntimeError(
+                    f"{record['request'].uid}: adapter {aname!r} is not "
+                    f"resident on the restore target — load_adapter() it "
+                    f"before restoring (the cluster's adapter_load path)")
         state = _SlotState(
             request=record["request"], blocks=blocks,
             generated=list(record["generated"]),
@@ -948,7 +1132,8 @@ class InferenceEngine:
             t_submit_ms=record["t_submit_ms"],
             t_first_ms=record["t_first_ms"],
             queue_ms=record["queue_ms"], ttft_ms=record["ttft_ms"],
-            chunk_start_ms=now, chunk_done=len(record["generated"]))
+            chunk_start_ms=now, chunk_done=len(record["generated"]),
+            adapter_id=aid)
         self._slots[slot] = state
         row = np.zeros((self._blocks_per_slot,), np.int32)
         row[:len(blocks)] = blocks
@@ -959,13 +1144,50 @@ class InferenceEngine:
         self._seq_lens[slot] = record["seq_len"]
         self._last_tokens[slot] = record["last_token"]
         self._active[slot] = True
+        self._adapter_ids[slot] = aid
         self._dirty("block_tables", "keys", "seq_lens", "last_tokens",
-                    "active")
+                    "active", "adapter_ids")
         if self._t_start is None:
             self._t_start = time.perf_counter()
         if self._events is not None:
             self._events.gauge("occupancy", self.occupancy(), t_ms=now)
         return slot
+
+    # -- adapter lifecycle -------------------------------------------------
+    def load_adapter(self, name: str, weights: Dict[str, Any], *,
+                     scale: float = 1.0) -> int:
+        """Install (or refresh) a named LoRA adapter into the paged pool.
+        Host-side eager writes into the donated pool leaves — loading an
+        adapter never traces, so compile counts stay flat no matter how
+        many tenants churn through. Under pool pressure the registry
+        evicts the least-recently-used IDLE adapter (refcount 0); loading
+        while every slot is pinned by a decoding request raises. Returns
+        the pool slot the adapter landed in."""
+        if self.adapters is None:
+            raise RuntimeError(
+                "adapters are disabled (ServeConfig.lora_rank == 0) — "
+                "construct the engine with lora_rank > 0 to load adapters")
+        t0 = time.perf_counter()
+        slot = self.adapters.load(name)
+        self._lora_pool = write_adapter(self._lora_pool, slot, weights,
+                                        scale=scale)
+        ms = (time.perf_counter() - t0) * 1e3
+        self._adapter_load_ms_total += ms
+        if self._events is not None:
+            self._events.emit("adapter_load", name, slot=slot,
+                              load_ms=round(ms, 3))
+        return slot
+
+    def unload_adapter(self, name: str) -> None:
+        """Drop a named adapter from the pool (must be idle — refcount 0).
+        The pool slot's weights are left in place and overwritten by the
+        next load; correctness never reads a free slot (per-slot
+        adapter-id rows only ever point at resident adapters)."""
+        if self.adapters is None:
+            raise RuntimeError("adapters are disabled")
+        self.adapters.unload(name)
+        if self._events is not None:
+            self._events.emit("adapter_unload", name)
 
     # -- speculative drafting ---------------------------------------------
     def _collect_drafts(self) -> Optional[Dict[int, List[int]]]:
@@ -1002,7 +1224,11 @@ class InferenceEngine:
         prefill, then advance every decode-ready slot — one token via the
         decode program, or up to spec_k+1 via the speculative verify
         program when the drafter proposed. Returns False when nothing
-        happened (no admission, no prefill, no active slots)."""
+        happened (no admission, no prefill, no active slots). An
+        admission-time shed (unknown adapter popped via ``on_reject``)
+        counts as progress: the queue moved, even though no slot did —
+        otherwise ``run()`` would misread the step as a pool stall."""
+        shed0 = self._rejected
         admitted = self._try_admit()
         chunked = self._run_prefill_chunk()
         if not self._active.any():
@@ -1013,17 +1239,25 @@ class InferenceEngine:
                                      self._prefill_backlog_tokens()))
             if chunked:
                 self._step_idx += 1
-            return admitted > 0 or chunked
+            return admitted > 0 or chunked or self._rejected > shed0
         t0 = time.perf_counter()
         drafts = self._collect_drafts()
         with span("decode"):
             if drafts is None:
                 self._decode_steps += 1
-                self.cache, toks, metrics = self._decode(
-                    self.params, self.cache,
-                    self._dev("last_tokens"), self._dev("seq_lens"),
-                    self._dev("active"), self._dev("block_tables"),
-                    self._dev("keys"))
+                if self._lora_pool is None:
+                    self.cache, toks, metrics = self._decode(
+                        self.params, self.cache,
+                        self._dev("last_tokens"), self._dev("seq_lens"),
+                        self._dev("active"), self._dev("block_tables"),
+                        self._dev("keys"))
+                else:
+                    (self.cache, self._lora_pool, toks,
+                     metrics) = self._decode(
+                        self.params, self.cache, self._lora_pool,
+                        self._dev("last_tokens"), self._dev("seq_lens"),
+                        self._dev("active"), self._dev("block_tables"),
+                        self._dev("keys"), self._dev("adapter_ids"))
             else:
                 self._verify_steps += 1
                 k1 = self.serve_cfg.spec_k + 1
@@ -1034,11 +1268,20 @@ class InferenceEngine:
                 for i, d in drafts.items():
                     fed[i, 1:1 + len(d)] = d
                     n_fed[i] = 1 + len(d)
-                self.cache, toks, metrics = self._verify(
-                    self.params, self.cache, jnp.asarray(fed),
-                    self._dev("seq_lens"), jnp.asarray(n_fed),
-                    self._dev("active"), self._dev("block_tables"),
-                    self._dev("keys"))
+                if self._lora_pool is None:
+                    self.cache, toks, metrics = self._verify(
+                        self.params, self.cache, jnp.asarray(fed),
+                        self._dev("seq_lens"), jnp.asarray(n_fed),
+                        self._dev("active"), self._dev("block_tables"),
+                        self._dev("keys"))
+                else:
+                    (self.cache, self._lora_pool, toks,
+                     metrics) = self._verify(
+                        self.params, self.cache, self._lora_pool,
+                        jnp.asarray(fed), self._dev("seq_lens"),
+                        jnp.asarray(n_fed), self._dev("active"),
+                        self._dev("block_tables"), self._dev("keys"),
+                        self._dev("adapter_ids"))
             toks = np.asarray(toks)  # fence — the iteration-level sync
         dt = time.perf_counter() - t0
         self.hists["decode_step_ms"].add([dt * 1e3])
@@ -1254,6 +1497,28 @@ class InferenceEngine:
             "verify_steps": self._verify_steps,
             "decode_steps": self._decode_steps,
         }
+        if self.adapters is not None:
+            a = self.adapters
+            lookups = a.hits_total + a.misses_total
+            out["adapters"] = {
+                "rank": self.serve_cfg.lora_rank,
+                "max_adapters": self.serve_cfg.max_adapters,
+                "resident": a.resident_count,
+                "pool_bytes": adapter_pool_bytes(
+                    self.cfg, self.serve_cfg.lora_rank,
+                    self.serve_cfg.max_adapters),
+                "hits": a.hits_total,
+                "misses": a.misses_total,
+                "loads": a.loads_total,
+                "unloads": a.unloads_total,
+                "evictions": a.evictions_total,
+            }
+            # flat watcher-gated fields: hit rate higher-better,
+            # load latency and eviction churn lower-better
+            out["adapter_hit_rate"] = (
+                round(a.hits_total / lookups, 4) if lookups else None)
+            out["adapter_evictions"] = a.evictions_total
+            out["adapter_load_ms"] = round(self._adapter_load_ms_total, 3)
         # flat aliases for regression gating (monitor.regress flattens
         # dotted keys; these are the two headline rates)
         out["prefix_hit_rate"] = out["prefix_cache"]["hit_rate"]
@@ -1288,6 +1553,16 @@ class InferenceEngine:
                   t_ms=t_ms, **L)
         if self._slo is not None:
             reg.counter("slo_good_total", self._slo.good, **L)
+        if self.adapters is not None:
+            reg.gauge("adapters_resident", float(
+                self.adapters.resident_count), t_ms=t_ms, **L)
+            reg.counter("adapter_hits_total", self.adapters.hits_total, **L)
+            reg.counter("adapter_misses_total",
+                        self.adapters.misses_total, **L)
+            reg.counter("adapter_loads_total",
+                        self.adapters.loads_total, **L)
+            reg.counter("adapter_evictions_total",
+                        self.adapters.evictions_total, **L)
         if include_hists:
             for name, h in self.hists.items():
                 reg.set_histogram(name, h, **L)

@@ -11,6 +11,9 @@ compiled-program contracts on THIS box's toolchain, staged as
 * **recompile** — 3 train steps reuse ONE compilation and a warmed serve
   engine runs a fresh mixed-length workload with ZERO new compiles
   (``analyze.recompile_guard``);
+* **adapters** — the serve LoRA pool rides every jit site donated AND
+  aliased (``analyze.adapters``, ``adapter_donated_copied`` stays 0) and
+  an adapter swap on a warm engine compiles NOTHING new;
 * **dtype** — the bf16 serve decode program's jaxpr profile:
   ``fp32_dots`` (the two fp32 attention-stability dots are the accepted
   level — regress flags growth) and ``convert_churn_ops`` (must stay 0);
@@ -156,6 +159,41 @@ def serve_contracts() -> dict:
     return out
 
 
+def adapter_contracts() -> dict:
+    """The serve LoRA contract (PR-16): the adapter pool rides every jit
+    site donated-and-aliased (``analyze.adapters``), and swapping which
+    adapters are resident is pure data — zero new compiles."""
+    from apex_tpu import analyze
+    from apex_tpu.serve import (
+        InferenceEngine, Request, SamplingConfig, ServeConfig,
+        make_adapter_weights,
+    )
+
+    cfg, params, _kv, _cache = _serve_fixture(jnp.float32)
+    eng = InferenceEngine(params, cfg, ServeConfig(
+        num_slots=3, block_size=8, prefill_chunk=8,
+        sampling=SamplingConfig(), lora_rank=4, max_adapters=2))
+    eng.load_adapter("t0", make_adapter_weights(
+        cfg, 4, jax.random.PRNGKey(11)), scale=0.5)
+    eng.run([Request("warm-base", [1, 2, 3], max_new_tokens=2),
+             Request("warm-t0", list(range(12)), max_new_tokens=2,
+                     adapter="t0")])
+    out = analyze.adapter_contract_record(eng)
+    try:
+        # an adapter SWAP (unload + load into the freed slot) must not
+        # retrace — residency is pool data, never a constant
+        with analyze.recompile_guard(eng.programs(), budget=0):
+            eng.unload_adapter("t0")
+            eng.load_adapter("t1", make_adapter_weights(
+                cfg, 4, jax.random.PRNGKey(12)), scale=0.5)
+            eng.run([Request("a", [5, 6], max_new_tokens=3, adapter="t1"),
+                     Request("b", list(range(17)), max_new_tokens=2)])
+        out["adapter_recompile_ok"] = True
+    except analyze.RecompileError:
+        out["adapter_recompile_ok"] = False
+    return out
+
+
 def ring_exposed() -> dict:
     """The stage-14 gather-ring MLP recompiled, hidden/exposed split via
     ``analyze.exposed_report`` on the compiled HLO (all collective
@@ -224,6 +262,7 @@ def main() -> int:
            "n_devices": len(jax.devices())}
     rec.update(gpt_step_contracts())
     rec.update(serve_contracts())
+    rec.update(adapter_contracts())
     rec.update(lint_gate())
     if MESH_OK and len(jax.devices()) >= 2:
         rec.update(ring_exposed())
@@ -233,6 +272,8 @@ def main() -> int:
     rec["ok"] = bool(
         rec.get("gpt_donation_ok") and rec.get("decode_donation_ok")
         and rec.get("gpt_recompile_ok") and rec.get("serve_recompile_ok")
+        and rec.get("adapter_donation_ok")
+        and rec.get("adapter_recompile_ok")
         and rec.get("convert_churn_ops") == 0
         and rec.get("host_syncs") == 0 and rec.get("gpt_host_syncs") == 0
         and rec.get("lint_violations") == 0)

@@ -32,13 +32,23 @@ next to the recovery-noise counters (``migrations_total`` /
 ``transfer_retries``, lower-better). A chaos pass that fails to drain or
 whose kill did not land makes the record ``ok: false``.
 
+``--lora`` adds the PR-16 **per-tenant adapter A/B**: the same tenant
+mix with every tenant bound to a LoRA adapter (loadgen's fixed
+``t{i} -> ad{i % M}`` mapping) through an adapter-enabled fleet — the
+record carries tokens/s + TTFT p99 next to the adapter-free pass, the
+registry ``adapter_hit_rate`` and the router ``adapter_warm_dispatch_
+rate`` (higher-better), ``adapter_load_ms`` / ``adapter_evictions``
+(lower-better), and ``streams_equal``: the aid=0 cohort replayed
+through both fleets must match BITWISE or the record is ``ok: false``.
+
 Run: ``python benchmarks/bench_serve_mh.py [--hosts 2] [--wire-mode
 int8] [--out FILE]``. ``tpu_watch.sh`` stage 15 banks
 ``SERVE_MH_TPU.json`` from ``--hosts 2``, regression-gated via
 ``python -m apex_tpu.monitor.regress --tol 0.15``; CPU rehearsals carry
 ``_CPU_FALLBACK`` and never promote. Stage 18 banks
-``SERVE_CHAOS_TPU.json`` from ``--hosts 3 --chaos`` under the same
-promote rules.
+``SERVE_CHAOS_TPU.json`` from ``--hosts 3 --chaos``, stage 20 banks
+``SERVE_LORA_TPU.json`` from ``--lora``, both under the same promote
+rules.
 """
 
 from __future__ import annotations
@@ -119,6 +129,16 @@ def main(argv=None) -> int:
     ap.add_argument("--link-fixed-ms", type=float, default=0.0)
     ap.add_argument("--link-gib-per-s", type=float, default=0.0,
                     help="simulated link bandwidth (0: instant)")
+    ap.add_argument("--lora", action="store_true",
+                    help="per-tenant LoRA A/B (PR-16): the same workload "
+                         "with every tenant bound to an adapter, through "
+                         "an adapter-enabled fleet — emits adapter hit/"
+                         "warm-dispatch rates and asserts the aid=0 "
+                         "cohort streams BITWISE the adapter-free fleet")
+    ap.add_argument("--lora-rank", type=int, default=8)
+    ap.add_argument("--n-adapters", type=int, default=None,
+                    help="distinct adapters ad0..ad{M-1} (default: one "
+                         "per tenant)")
     args = ap.parse_args(argv)
 
     if args.hosts < 2:
@@ -130,8 +150,12 @@ def main(argv=None) -> int:
                  "decode hosts to have a survivor (use --hosts 3)")
 
     on_tpu = jax.default_backend() == "tpu"
-    name = "gpt_serve_mh_chaos_goodput" if args.chaos \
-        else "gpt_serve_mh_goodput"
+    if args.chaos:
+        name = "gpt_serve_mh_chaos_goodput"
+    elif args.lora:
+        name = "gpt_serve_mh_lora_goodput"
+    else:
+        name = "gpt_serve_mh_goodput"
     if not on_tpu:
         name += "_CPU_FALLBACK"
 
@@ -254,6 +278,84 @@ def main(argv=None) -> int:
             "faults": plan.summary(),
         }
 
+    # -- per-tenant LoRA A/B: adapters off vs N tenants x M adapters ------
+    # the PR-16 stage-20 record: the same tenant mix with every tenant
+    # bound to an adapter (loadgen's fixed t{i} -> ad{i % M} mapping)
+    # through an adapter-enabled fleet. Carries tokens/s + TTFT p99 next
+    # to the baseline pass above, the registry hit rate and the router's
+    # warm-dispatch rate (both regress-gated higher-is-better), and
+    # asserts the aid=0 cohort streams BITWISE what an adapter-free
+    # fleet streams — transparency, not tolerance.
+    lora_rec = None
+    lora_ok = True
+    if args.lora:
+        import dataclasses
+
+        from apex_tpu.serve import make_adapter_weights
+
+        n_adapters = args.n_adapters or args.n_tenants
+        lora_scfg = dataclasses.replace(scfg, lora_rank=args.lora_rank,
+                                        max_adapters=n_adapters)
+        lora_ccfg = dataclasses.replace(ccfg, serve=lora_scfg)
+        lora_workload = build_workload(
+            dataclasses.replace(wcfg, n_adapters=n_adapters),
+            VOCAB, MAX_SEQ)
+        adapters = {
+            f"ad{i}": make_adapter_weights(cfg, args.lora_rank,
+                                           jax.random.PRNGKey(100 + i))
+            for i in range(n_adapters)}
+        lora_cluster = ServeCluster(params, cfg, lora_ccfg,
+                                    retain_streams=False)
+        for aname, w in adapters.items():
+            lora_cluster.load_adapter(aname, w)
+        lora_stats = run_workload(lora_cluster, lora_workload)
+        lora_slo = lora_stats.get("slo_report", {})
+        lora_drained = (lora_stats.get("completed", 0)
+                        + len(lora_cluster.shed) == len(lora_workload))
+        lst = lora_cluster.stats()
+
+        # aid=0 transparency cohort: the first requests of the BASE
+        # workload (no adapter bound), replayed through a fresh
+        # adapter-free fleet and a fresh adapter-ENABLED fleet — the
+        # streams must be bitwise equal or the record refuses to bank
+        from apex_tpu.serve import Request as _Req
+
+        cohort = [_Req(f"eq{i}", list(r.tokens),
+                       max_new_tokens=min(r.max_new_tokens, 8),
+                       tenant=r.tenant)
+                  for i, (_, r) in enumerate(workload[:6])]
+        base_streams = ServeCluster(params, cfg, ccfg).run(
+            cohort, max_steps=200000)
+        lora_fleet = ServeCluster(params, cfg, lora_ccfg)
+        for aname, w in adapters.items():
+            lora_fleet.load_adapter(aname, w)
+        lora_streams = lora_fleet.run(cohort, max_steps=200000)
+        streams_equal = base_streams == lora_streams
+
+        lora_ok = bool(lora_drained and streams_equal)
+        tps = (round(lora_stats.get("generated_tokens", 0)
+                     / lora_stats["wall_s"], 3)
+               if lora_stats.get("wall_s") else None)
+        lora_rec = {
+            "rank": args.lora_rank,
+            "n_adapters": n_adapters,
+            "n_tenants": args.n_tenants,
+            "completed": lora_stats.get("completed"),
+            "shed_rate": lora_stats.get("shed_rate"),
+            "tokens_per_s": tps,
+            "goodput_rps": lora_slo.get("goodput_rps"),
+            "ttft_ms_p99": lora_stats.get("ttft_ms_p99"),
+            "tpot_ms_p99": lora_stats.get("tpot_ms_p99"),
+            "adapter_hit_rate": lst.get("adapter_hit_rate"),
+            "adapter_warm_dispatch_rate":
+                lst.get("adapter_warm_dispatch_rate"),
+            "adapter_evictions": lst.get("adapter_evictions"),
+            "adapter_load_ms": lst.get("adapter_load_ms"),
+            "catalog_loads": lst["adapters"]["catalog_loads"],
+            "streams_equal": streams_equal,
+            "drained": lora_drained,
+        }
+
     # -- int8-vs-int4 KV concurrency A/B (modeled, config-exact) ----------
     # at the int8 pool's byte budget, how many pool blocks — and so
     # concurrent max-length contexts — does each tier hold? (halving
@@ -291,7 +393,8 @@ def main(argv=None) -> int:
     drained = stats.get("completed", 0) + len(cluster.shed) == len(workload)
     rec = {
         "metric": name,
-        "ok": bool(drained and wire_model_agrees and chaos_ok),
+        "ok": bool(drained and wire_model_agrees and chaos_ok
+                   and lora_ok),
         "hosts": {"prefill": n_prefill, "decode": n_decode,
                   "total": n_prefill + n_decode},
         "goodput_rps": slo_rep.get("goodput_rps"),
@@ -334,6 +437,7 @@ def main(argv=None) -> int:
             else None),
         "overload": overload,
         "chaos": chaos_rec,
+        "lora": lora_rec,
         # elastic counters of the CLEAN pass (all zero unless the run
         # hit real faults — regress gates them lower-is-better)
         "elastic": stats.get("elastic"),
@@ -358,6 +462,14 @@ def main(argv=None) -> int:
                   "migrations_total", "replayed_tokens", "worker_deaths",
                   "heartbeat_misses", "transfer_retries"):
             rec[k] = chaos_rec[k]
+    if lora_rec is not None:
+        # flat per-tenant LoRA headline fields (the stage-20 gate: hit
+        # and warm-dispatch rates higher-is-better, load time and LRU
+        # churn lower-is-better)
+        for k in ("adapter_hit_rate", "adapter_warm_dispatch_rate",
+                  "adapter_evictions", "adapter_load_ms",
+                  "streams_equal"):
+            rec[k] = lora_rec[k]
     line = json_record(**rec)
     print(line, flush=True)
     if args.out:

@@ -25,6 +25,12 @@ through it:
   ``tpu_watch.sh`` stage 11 (``SERVE_PREFIX_TPU.json``) runs it with
   ``--prefix-pool`` + ``--spec-k`` and must beat the stage-10 plain
   record on the same hardware.
+* **per-tenant adapters** — ``n_adapters`` binds tenant ``t{i}`` to LoRA
+  adapter ``ad{i % n_adapters}`` deterministically (no extra rng draws:
+  an ``n_adapters=0`` workload is bit-identical to the pre-adapter one).
+  This is the fleet-mix workload ``bench_serve_mh.py --lora`` drives for
+  the ``tpu_watch.sh`` stage-20 record (adapter hit rate, warm-dispatch
+  rate, aid=0 ``streams_equal``).
 
 ``run_workload`` drives the engine with ``retain_streams=False`` — state
 stays O(slots + backlog) no matter how many requests flow — and returns
@@ -87,6 +93,15 @@ class WorkloadConfig:
     # the bench and tests. 0 disables (every request tenant "default").
     n_tenants: int = 0
     tenant_weights: Optional[Tuple[float, ...]] = None
+    # per-tenant LoRA adapter traffic (the serve.adapters knob): tenant
+    # "t{i}" is bound to adapter "ad{i % n_adapters}" — a FIXED mapping,
+    # no extra rng draws, so an n_adapters=0 workload stays bit-identical
+    # to the pre-adapter one and the adapter mix follows the tenant mix
+    # (tenant_weights skews which adapters are hot). Requires n_tenants
+    # >= 1; the driver must load_adapter() "ad0".."ad{M-1}" before the
+    # run or admission sheds the bound requests. 0 disables (no request
+    # carries an adapter — the aid=0 transparency cohort).
+    n_adapters: int = 0
     seed: int = 0
 
     def validate(self) -> None:
@@ -118,6 +133,11 @@ class WorkloadConfig:
                     f"entries for n_tenants={self.n_tenants}")
             if any(w <= 0 for w in self.tenant_weights):
                 raise ValueError("tenant_weights must be positive")
+        if self.n_adapters < 0:
+            raise ValueError("n_adapters must be >= 0")
+        if self.n_adapters and self.n_tenants < 1:
+            raise ValueError("n_adapters > 0 needs n_tenants >= 1 "
+                             "(adapters are bound per tenant)")
 
 
 def _lognormal_int(rng, median: float, sigma: float, lo: int, hi: int,
@@ -189,10 +209,12 @@ def build_workload(cfg: WorkloadConfig, vocab_size: int,
             toks = (prefixes[int(pick[i])] + toks)[:max_context - 1]
         tenant = (f"t{int(tenants[i])}" if tenants is not None
                   else "default")
+        adapter = (f"ad{int(tenants[i]) % cfg.n_adapters}"
+                   if cfg.n_adapters and tenants is not None else None)
         out.append((float(arrivals[i]),
                     Request(f"lg{i:05d}", toks,
                             max_new_tokens=int(glens[i]),
-                            tenant=tenant)))
+                            tenant=tenant, adapter=adapter)))
     return out
 
 

@@ -58,6 +58,7 @@ last_analyze=-3600  # stage-16 (compiled-program contract check) same
 last_sub8=-3600     # stage-17 (sub-8-bit: int4 KV + comm wire A/B) same
 last_chaos=-3600    # stage-18 (elastic serve chaos: kill-and-migrate) same
 last_observe=-3600  # stage-19 (fleet observability overhead A/B) same
+last_lora=-3600     # stage-20 (per-tenant LoRA serve A/B) same
 
 note() { echo "$(date '+%F %T') $*" >> "$LOG"; }
 
@@ -623,6 +624,50 @@ $(cat /tmp/tpu_stage19_regress.out)"
   return 0
 }
 
+lora_stage() {
+  # stage 20: per-tenant LoRA serve A/B — bench_serve_mh.py --lora runs
+  # the same tenant mix adapter-free and adapter-bound (loadgen's fixed
+  # t{i} -> ad{i % M} mapping) and records tokens/s + TTFT p99 both
+  # sides, adapter_hit_rate and adapter_warm_dispatch_rate
+  # (higher-better), adapter_load_ms / adapter_evictions (lower-better)
+  # and streams_equal: the aid=0 cohort through both fleets must match
+  # BITWISE (ok=false otherwise). Same promote rules as stages 10-19:
+  # CPU rehearsals never promote, ok=false never promotes,
+  # REGRESSION-GATED via monitor.regress --tol 0.15 once banked; hourly
+  # even after banked so a fleet-mix placement regression surfaces
+  # within an hour.
+  note "STAGE20 START: bench_serve_mh.py --lora"
+  rm -f /tmp/serve_lora_try.json
+  timeout 1800 python benchmarks/bench_serve_mh.py --lora \
+    --out /tmp/serve_lora_try.json \
+    > /tmp/tpu_stage20.out 2> /tmp/tpu_stage20.err
+  local rc=$?
+  note "STAGE20 EXIT=$rc"
+  [ -s /tmp/serve_lora_try.json ] || return 1
+  if grep -q CPU_FALLBACK /tmp/serve_lora_try.json; then
+    note "STAGE20 got CPU_FALLBACK, not promoting"
+    return 1
+  fi
+  if grep -Eq '"ok": false' /tmp/serve_lora_try.json; then
+    note "STAGE20 record has ok false, not promoting"
+    return 1
+  fi
+  if [ -s SERVE_LORA_TPU.json ]; then
+    if ! python -m apex_tpu.monitor.regress SERVE_LORA_TPU.json \
+        /tmp/serve_lora_try.json --tol 0.15 \
+        > /tmp/tpu_stage20_regress.out 2>> /tmp/tpu_stage20.err; then
+      note "STAGE20 REGRESSION vs banked, keeping banked record: \
+$(cat /tmp/tpu_stage20_regress.out)"
+      return 1
+    fi
+  fi
+  cp /tmp/serve_lora_try.json SERVE_LORA_TPU.json
+  note "STAGE20 PROMOTED $(cat SERVE_LORA_TPU.json)"
+  [ $rc -eq 0 ] || return 1
+  [ "$(cat "$STATE")" -eq 19 ] && echo 20 > "$STATE"
+  return 0
+}
+
 smoke_stage() {
   # Smoke to a temp file; promote ANY real-TPU artifact (a failing kernel
   # on the chip is exactly the evidence we must bank) but never a CPU
@@ -748,6 +793,13 @@ while true; do
           observe_stage
           last_observe=$now
         fi
+        # stage 20 (per-tenant LoRA serve A/B): same contract — a
+        # broken aid=0 transparency, a collapsing adapter hit rate or
+        # a cold-dispatching router must surface within an hour
+        if [ $((now - last_lora)) -ge 3600 ]; then
+          lora_stage
+          last_lora=$now
+        fi
         last_refresh=$now
       fi
     else
@@ -852,6 +904,12 @@ while true; do
           && [ $((now - last_observe)) -ge 3600 ]; then
         observe_stage
         last_observe=$now
+      fi
+      # stage 20: per-tenant LoRA serve A/B, same contract.
+      if [ "$(cat "$STATE")" -eq 19 ] \
+          && [ $((now - last_lora)) -ge 3600 ]; then
+        lora_stage
+        last_lora=$now
       fi
       last_refresh=$now
     fi

@@ -727,3 +727,87 @@ def test_flagship_engine_steady_state_no_new_compiles():
     if counts["decode"] is not None:
         assert counts == {"chunk_prefill": 1, "decode": 1, "verify": 0,
                           "cow_copy": 0}
+
+
+# ---------------------------------------------------------------------------
+# analyze.adapters — the serve LoRA pool donation contract (PR-16)
+
+
+def _lora_engine(spec_k=0):
+    from apex_tpu.serve import (
+        InferenceEngine, Request, SamplingConfig, ServeConfig,
+        make_adapter_weights,
+    )
+
+    cfg, params, _, _ = _serve_fixture()
+    eng = InferenceEngine(params, cfg, ServeConfig(
+        num_slots=3, block_size=8, prefill_chunk=8, spec_k=spec_k,
+        sampling=SamplingConfig(), lora_rank=4, max_adapters=2))
+    eng.load_adapter("t0", make_adapter_weights(
+        cfg, 4, jax.random.PRNGKey(11)), scale=0.5)
+    eng.run([Request("warm-base", [1, 2, 3], max_new_tokens=2),
+             Request("warm-t0", list(range(12)), max_new_tokens=2,
+                     adapter="t0")])
+    return cfg, eng
+
+
+def test_flagship_adapter_pool_rides_every_jit_site_donated():
+    """Acceptance: the AdapterPool is a donated, ALIASED input of every
+    serve jit site — a copied pool would double adapter HBM per step."""
+    cfg, eng = _lora_engine()
+    reports = analyze.assert_adapter_donated(eng)
+    assert set(reports) == {"chunk_prefill", "decode"}
+    cache_leaves = len(jax.tree_util.tree_leaves(eng.cache))
+    pool_leaves = len(jax.tree_util.tree_leaves(eng._lora_pool))
+    for site, rep in reports.items():
+        assert rep.expected_leaves == cache_leaves + pool_leaves, site
+        assert rep.n_aliased >= rep.expected_leaves, site
+        assert not rep.unusable, site
+    rec = analyze.adapter_contract_record(eng)
+    assert rec["adapter_donation_ok"] is True
+    assert rec["adapter_donated_copied"] == 0
+    assert rec["adapter_sites_checked"] == 2
+
+
+def test_flagship_adapter_sites_include_verify_under_spec_k():
+    cfg, eng = _lora_engine(spec_k=2)
+    reports = analyze.adapter_donation_report(eng)
+    assert set(reports) == {"chunk_prefill", "decode", "verify"}
+    assert all(r.ok for r in reports.values())
+
+
+def test_adapter_contract_refuses_lora_free_engine():
+    from apex_tpu.serve import (
+        InferenceEngine, SamplingConfig, ServeConfig,
+    )
+
+    cfg, params, _, _ = _serve_fixture()
+    eng = InferenceEngine(params, cfg, ServeConfig(
+        num_slots=3, block_size=8, prefill_chunk=8,
+        sampling=SamplingConfig()))
+    with pytest.raises(ValueError, match="lora_rank"):
+        analyze.adapter_jit_sites(eng)
+
+
+def test_flagship_adapter_swap_zero_new_compiles():
+    """Acceptance: loading/unloading adapters on a warm engine and
+    serving an adapter-bound workload compiles NOTHING new — residency
+    is pool data, not a program constant (the aid=0 base path and the
+    adapter path share one executable per site), and the AOT donation
+    check itself leaves the jit caches untouched."""
+    from apex_tpu.serve import Request, make_adapter_weights
+
+    cfg, eng = _lora_engine()
+    analyze.assert_adapter_donated(eng)  # AOT: must not pollute caches
+    with analyze.recompile_guard(eng.programs(), budget=0):
+        eng.unload_adapter("t0")
+        eng.load_adapter("t1", make_adapter_weights(
+            cfg, 4, jax.random.PRNGKey(12)), scale=0.5)
+        out = eng.run([Request("a", [5, 6], max_new_tokens=3,
+                               adapter="t1"),
+                       Request("b", list(range(17)), max_new_tokens=2)])
+    assert len(out["a"]) == 3 and len(out["b"]) == 2
+    counts = eng.compile_counts()
+    if counts["decode"] is not None:
+        assert counts == {"chunk_prefill": 1, "decode": 1, "verify": 0,
+                          "cow_copy": 0}

@@ -1,0 +1,590 @@
+"""apex_tpu.serve.adapters — per-tenant paged LoRA serving.
+
+The acceptance oracles from the PR-16 issue, all stock-jax-safe:
+
+* **aid=0 transparency** — an adapter-ENABLED engine serving base-only
+  traffic streams BITWISE what the pre-adapter engine streams (greedy,
+  same-key sampled, speculative and int8-KV included): slot 0 of the
+  pool is all-zeros, so the gathered BGMV delta is exact zero, not
+  epsilon;
+* **merged-weight oracle** — a nonzero adapter's output matches the
+  offline dense model ``W + B@A * scale`` (logit tolerance through the
+  cold flash-prefill, stream equality through the engine);
+* **compile-count gate** — adapters ride the SAME compiled program per
+  jit site: one chunked prefill + one decode, loads/swaps retrace
+  nothing (``analyze.adapters`` pins the donation side);
+* **registry discipline** — BlockAllocator semantics for weights:
+  refcounts pin residents against eviction, LRU evicts idle under
+  pressure, a wholly-pinned pool refuses loudly, and a randomized chaos
+  loop reconciles refcounts exactly (zero leaks);
+* **fleet mix** — workers advertise resident adapters + quant mode in
+  membership heartbeats, the router lands adapter-bound handoffs on
+  warm hosts (cold fallback emits ``adapter_load``), and unknown
+  adapters shed at admission — never a crash.
+
+The mid-decode migration row (adapter binding survives a worker death
+bitwise) lives with its chaos siblings in ``tests/test_serve_chaos.py``.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.monitor.events import EventLog
+from apex_tpu.monitor.regress import classify_metric
+from apex_tpu.serve import (
+    ADAPTER_TARGETS,
+    AdapterRegistry,
+    ClusterConfig,
+    InferenceEngine,
+    KVCacheConfig,
+    Request,
+    SamplingConfig,
+    ServeCluster,
+    ServeConfig,
+    adapter_pool_bytes,
+    init_adapter_pool,
+    init_kv_cache,
+    lora_delta,
+    make_adapter_weights,
+    merge_adapter_params,
+    write_adapter,
+)
+from apex_tpu.serve.decode import ensure_dense_ffn, gpt_prefill_chunk
+from apex_tpu.transformer.testing import GPTConfig, init_gpt_params
+
+CFG = GPTConfig(vocab_size=97, max_seq=64, hidden=32, num_layers=2,
+                num_heads=4, dtype=jnp.float32, fused_loss=False)
+PARAMS = init_gpt_params(jax.random.PRNGKey(0), CFG)
+KV = KVCacheConfig(num_layers=2, num_heads=4, head_dim=8,
+                   num_blocks=8, block_size=8, dtype=jnp.float32)
+
+REQS = [
+    Request("a", [1, 2, 3, 4, 5], max_new_tokens=6),
+    Request("b", [7, 8, 9], max_new_tokens=4),
+    Request("c", list(range(10, 22)), max_new_tokens=5),
+]
+
+W1 = make_adapter_weights(CFG, 4, jax.random.PRNGKey(42), std=0.05)
+W2 = make_adapter_weights(CFG, 4, jax.random.PRNGKey(43), std=0.05)
+
+SAMPLED = SamplingConfig(temperature=0.8, top_k=20, top_p=0.9)
+
+
+def _engine(sampling=None, **kw):
+    scfg = ServeConfig(num_slots=3, block_size=8, prefill_chunk=8,
+                       sampling=sampling or SamplingConfig(), **kw)
+    return InferenceEngine(PARAMS, CFG, scfg)
+
+
+def _lora_engine(sampling=None, rank=4, max_adapters=3, **kw):
+    return _engine(sampling=sampling, lora_rank=rank,
+                   max_adapters=max_adapters, **kw)
+
+
+# ---------------------------------------------------------------------------
+# AdapterPool: shapes, the zero base slot, scale folding
+
+
+def test_pool_shapes_and_reserved_base_slot():
+    pool = init_adapter_pool(CFG, 4, 3)
+    assert set(pool) == {f"{t}_{ab}" for t in ADAPTER_TARGETS
+                         for ab in ("a", "b")}
+    h, f = CFG.hidden, CFG.ffn_hidden
+    assert pool["qkv_a"].shape == (CFG.num_layers, 4, h, 4)
+    assert pool["qkv_b"].shape == (CFG.num_layers, 4, 4, 3 * h)
+    assert pool["fc1_b"].shape == (CFG.num_layers, 4, 4, f)
+    assert pool["fc2_a"].shape == (CFG.num_layers, 4, f, 4)
+    # slot axis = max_adapters + 1: slot 0 is the base model, all-zero
+    for leaf in pool.values():
+        assert not np.asarray(leaf[:, 0]).any()
+    assert adapter_pool_bytes(CFG, 4, 3) == sum(
+        np.asarray(v).nbytes for v in pool.values())
+
+
+def test_write_adapter_folds_scale_and_guards_slot0():
+    pool = init_adapter_pool(CFG, 4, 2)
+    pool = write_adapter(pool, 1, W1, scale=2.0)
+    np.testing.assert_array_equal(pool["qkv_a"][:, 1], W1["qkv_a"])
+    np.testing.assert_array_equal(pool["qkv_b"][:, 1],
+                                  np.asarray(W1["qkv_b"]) * 2.0)
+    # slot 0 (base) untouched and refused
+    assert not np.asarray(pool["qkv_b"][:, 0]).any()
+    with pytest.raises(ValueError, match="slot 0"):
+        write_adapter(pool, 0, W1)
+    with pytest.raises(ValueError):
+        write_adapter(pool, 3, W1)  # beyond max_adapters
+
+
+def test_lora_delta_slot0_is_exact_zero():
+    pool = init_adapter_pool(CFG, 4, 2)
+    pool = write_adapter(pool, 1, W1, scale=1.5)
+    x = jax.random.normal(jax.random.PRNGKey(7), (2, 3, CFG.hidden))
+    layer = jax.tree_util.tree_map(lambda v: v[0], pool)
+    zero = lora_delta(x, layer["qkv_a"], layer["qkv_b"],
+                      jnp.zeros((2,), jnp.int32))
+    # EXACT zero — the aid=0 bitwise gate rests on this, not on allclose
+    assert not np.asarray(zero).any()
+    got = lora_delta(x, layer["qkv_a"], layer["qkv_b"],
+                     jnp.array([1, 0], jnp.int32))
+    want = (x[0] @ W1["qkv_a"][0]) @ (np.asarray(W1["qkv_b"][0]) * 1.5)
+    np.testing.assert_allclose(np.asarray(got[0]), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+    assert not np.asarray(got[1]).any()
+
+
+def test_merged_weight_oracle_logits_through_cold_prefill():
+    """The paged forward with a nonzero adapter == the dense merged
+    model ``W + B@A*scale`` through the SAME prefill — logit level."""
+    merged = merge_adapter_params(PARAMS, W1, scale=2.0)
+    pool = write_adapter(init_adapter_pool(CFG, 4, 2), 1, W1, scale=2.0)
+    toks = jnp.zeros((8,), jnp.int32).at[:6].set(
+        jnp.arange(1, 7, dtype=jnp.int32))
+    row = jnp.arange(2, dtype=jnp.int32)
+    _, logits_adapter = gpt_prefill_chunk(
+        PARAMS, toks, jnp.int32(0), jnp.int32(6), init_kv_cache(KV),
+        row, CFG, KV, adapters=pool, adapter_id=1)
+    _, logits_merged = gpt_prefill_chunk(
+        merged, toks, jnp.int32(0), jnp.int32(6), init_kv_cache(KV),
+        row, CFG, KV)
+    np.testing.assert_allclose(np.asarray(logits_adapter),
+                               np.asarray(logits_merged),
+                               rtol=1e-4, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# AdapterRegistry: BlockAllocator discipline for weights
+
+
+def test_registry_load_acquire_release_cycle():
+    reg = AdapterRegistry(2)
+    assert reg.load("t1") == 1          # deterministic LIFO: slot 1 first
+    assert reg.load("t2") == 2
+    assert reg.load("t1") == 1          # idempotent refresh
+    assert reg.free_count == 0 and reg.resident_count == 2
+    assert reg.acquire("t1") == 1
+    assert reg.refcount("t1") == 1
+    assert reg.acquire("nope") is None  # miss, counted
+    reg.release("t1")
+    assert reg.refcount("t1") == 0
+    c = reg.counters()
+    assert c["hits_total"] == 1 and c["misses_total"] == 1
+    assert c["loads_total"] == 3
+
+
+def test_registry_lru_evicts_idle_under_pressure():
+    reg = AdapterRegistry(2)
+    reg.load("t1")
+    reg.load("t2")
+    reg.acquire("t2")                   # pin t2: only t1 is evictable
+    slot = reg.load("t3")               # pressure: evicts idle t1
+    assert slot == 1 and reg.lookup("t1") is None
+    assert reg.evictions_total == 1
+    reg.acquire("t3")
+    with pytest.raises(RuntimeError, match="pinned"):
+        reg.load("t4")                  # everything pinned: loud refusal
+    reg.release("t2")
+    assert reg.load("t4") == 2          # t2 idle now — LRU victim
+    assert reg.lookup("t2") is None
+
+
+def test_registry_unload_guards():
+    reg = AdapterRegistry(2)
+    reg.load("t1")
+    reg.acquire("t1")
+    with pytest.raises(RuntimeError, match="reference"):
+        reg.unload("t1")                # pinned: refuse
+    reg.release("t1")
+    reg.unload("t1")
+    assert reg.free_count == 2
+    with pytest.raises(KeyError):
+        reg.unload("t1")                # not resident anymore
+    with pytest.raises(RuntimeError):
+        reg.release("t1")               # release of non-resident
+
+
+def test_registry_chaos_refcounts_reconcile_exactly():
+    """Satellite: randomized load/unload/acquire/release/evict against a
+    shadow model, ``assert_consistent`` EVERY step — the chaos-allocator
+    pattern from test_serve_prefix applied to adapter slots. Final
+    teardown drains every ref and unloads every resident: zero leaks."""
+    rng = random.Random(7)
+    reg = AdapterRegistry(4)
+    names = [f"t{i}" for i in range(8)]
+    pins = {}                           # name -> outstanding refs (shadow)
+    for _ in range(400):
+        op = rng.choice(("load", "unload", "acquire", "release"))
+        name = rng.choice(names)
+        if op == "load":
+            try:
+                slot = reg.load(name)
+                assert 1 <= slot <= 4
+            except RuntimeError:
+                # only legal when all 4 residents are pinned
+                assert len([n for n, r in pins.items() if r > 0]) >= 4
+        elif op == "unload":
+            if reg.lookup(name) is not None and pins.get(name, 0) == 0:
+                reg.unload(name)
+            else:
+                with pytest.raises((KeyError, RuntimeError)):
+                    reg.unload(name)
+        elif op == "acquire":
+            slot = reg.acquire(name)
+            if slot is not None:
+                pins[name] = pins.get(name, 0) + 1
+        else:
+            if pins.get(name, 0) > 0:
+                reg.release(name)
+                pins[name] -= 1
+            elif reg.lookup(name) is not None:
+                with pytest.raises(RuntimeError):
+                    reg.release(name)
+        # evicted names cannot carry refs — their pins must be zero
+        for n, r in pins.items():
+            if r > 0:
+                assert reg.lookup(n) is not None, n
+                assert reg.refcount(n) == r, n
+        reg.assert_consistent()
+    for n, r in list(pins.items()):
+        for _ in range(r):
+            reg.release(n)
+        pins[n] = 0
+    for n in list(reg.resident()):
+        reg.unload(n)
+    reg.assert_consistent()
+    assert reg.resident_count == 0 and reg.free_count == 4
+
+
+# ---------------------------------------------------------------------------
+# ACCEPTANCE: aid=0 transparency — bitwise vs the pre-adapter engine
+
+
+@pytest.mark.parametrize("sampling,extra", [
+    (SamplingConfig(), {}),
+    (SAMPLED, {}),
+    (SamplingConfig(), {"spec_k": 4}),
+    (SamplingConfig(), {"kv_quant": "int8"}),
+    (SAMPLED, {"kv_quant": "int8"}),
+], ids=["greedy", "sampled", "spec_k", "int8_kv", "sampled_int8"])
+def test_aid0_streams_bitwise_equal_pre_adapter_engine(sampling, extra):
+    """An adapter-ENABLED engine serving base traffic is bitwise the
+    pre-adapter engine — slot 0's zero delta is exact, and the lora
+    program set draws from the same position-keyed streams."""
+    reqs = REQS + [Request("rep", ([5, 6, 7, 8] * 4)[:14],
+                           max_new_tokens=8)]
+    base = _engine(sampling=sampling, **extra).run(reqs)
+    lora = _lora_engine(sampling=sampling, **extra).run(reqs)
+    assert base == lora
+
+
+def test_compile_counts_unchanged_with_adapters_enabled():
+    """One chunked prefill + one decode, with adapters enabled AND in
+    use; loading/swapping adapters compiles nothing new."""
+    eng = _lora_engine()
+    eng.load_adapter("t1", W1, scale=2.0)
+    eng.run([Request("a", [1, 2, 3, 4, 5], max_new_tokens=6,
+                     adapter="t1")] + REQS[1:])
+    eng.load_adapter("t2", W2)          # swap after warmup
+    eng.run([Request("d", [4, 4, 2], max_new_tokens=3, adapter="t2")])
+    counts = eng.compile_counts()
+    if counts["decode"] is not None:
+        assert counts == {"chunk_prefill": 1, "decode": 1, "verify": 0,
+                          "cow_copy": 0}
+
+
+# ---------------------------------------------------------------------------
+# ACCEPTANCE: nonzero adapters — the merged-weight engine oracle
+
+
+@pytest.mark.parametrize("sampling", [SamplingConfig(), SAMPLED],
+                         ids=["greedy", "sampled"])
+def test_adapter_stream_matches_merged_weight_engine(sampling):
+    eng = _lora_engine(sampling=sampling)
+    eng.load_adapter("t1", W1, scale=2.0)
+    reqs = [Request("a", [1, 2, 3, 4, 5], max_new_tokens=6,
+                    adapter="t1")]
+    got = eng.run(reqs)["a"]
+    merged_eng = InferenceEngine(
+        merge_adapter_params(PARAMS, W1, scale=2.0), CFG,
+        ServeConfig(num_slots=3, block_size=8, prefill_chunk=8,
+                    sampling=sampling))
+    want = merged_eng.run([Request("a", [1, 2, 3, 4, 5],
+                                   max_new_tokens=6)])["a"]
+    assert got == want
+
+
+def test_multi_tenant_batch_no_cross_contamination():
+    """t1 + t2 + base interleaved in ONE continuous batch: every stream
+    equals its own single-tenant oracle — the per-slot adapter-id table
+    keeps deltas tenant-local."""
+    eng = _lora_engine()
+    eng.load_adapter("t1", W1, scale=2.0)
+    eng.load_adapter("t2", W2)
+    mixed = [Request("a", [1, 2, 3, 4, 5], max_new_tokens=6,
+                     adapter="t1"),
+             Request("b", [7, 8, 9], max_new_tokens=4, adapter="t2"),
+             Request("c", list(range(10, 22)), max_new_tokens=5)]
+    got = eng.run(mixed)
+    for uid, w, s in (("a", W1, 2.0), ("b", W2, 1.0)):
+        oracle = InferenceEngine(
+            merge_adapter_params(PARAMS, w, scale=s), CFG,
+            ServeConfig(num_slots=3, block_size=8, prefill_chunk=8,
+                        sampling=SamplingConfig()))
+        req = next(r for r in mixed if r.uid == uid)
+        want = oracle.run([Request(uid, list(req.tokens),
+                                   max_new_tokens=req.max_new_tokens)])
+        assert got[uid] == want[uid], uid
+    assert got["c"] == _engine().run([mixed[2]])["c"]
+
+
+# ---------------------------------------------------------------------------
+# admission: unknown adapters shed (or raise loudly), never corrupt
+
+
+def test_unknown_adapter_sheds_via_on_reject():
+    shed = []
+    eng = InferenceEngine(
+        PARAMS, CFG,
+        ServeConfig(num_slots=3, block_size=8, prefill_chunk=8,
+                    sampling=SamplingConfig(), lora_rank=4,
+                    max_adapters=2),
+        on_reject=lambda req, info: shed.append((req.uid,
+                                                 info["reason"])))
+    out = eng.run([Request("x", [1, 2], max_new_tokens=2,
+                           adapter="nope"),
+                   Request("y", [3, 4], max_new_tokens=2)])
+    assert shed == [("x", "unknown_adapter")]
+    assert "y" in out and "x" not in out
+    assert eng.stats()["rejected"] == 1
+
+
+def test_all_requests_shed_drains_cleanly():
+    # the ONLY pending request sheds at admission: the queue moving is
+    # progress, so run() drains to {} instead of misreading the step as
+    # a pool stall (regression: IndexError on the emptied deque)
+    shed = []
+    eng = InferenceEngine(
+        PARAMS, CFG,
+        ServeConfig(num_slots=3, block_size=8, prefill_chunk=8,
+                    lora_rank=4, max_adapters=2),
+        on_reject=lambda req, info: shed.append((req.uid,
+                                                 info["reason"])))
+    out = eng.run([Request("x", [1, 2, 3], max_new_tokens=4,
+                           adapter="nope")])
+    assert out == {}
+    assert shed == [("x", "unknown_adapter")]
+    assert eng.stats()["rejected"] == 1
+
+
+def test_unknown_adapter_without_hook_raises():
+    eng = _lora_engine()
+    with pytest.raises(KeyError, match="unknown adapter"):
+        eng.run([Request("x", [1, 2], max_new_tokens=2, adapter="nope")])
+
+
+def test_adapter_request_on_lora_free_engine_refused_at_submit():
+    eng = _engine()
+    with pytest.raises(ValueError, match="lora_rank"):
+        eng.submit(Request("x", [1, 2], max_new_tokens=2, adapter="t1"))
+
+
+def test_serve_config_lora_validation():
+    with pytest.raises(ValueError, match="max_adapters"):
+        ServeConfig(lora_rank=4).validate()
+    with pytest.raises(ValueError, match="lora_rank"):
+        ServeConfig(max_adapters=2).validate()
+
+
+# ---------------------------------------------------------------------------
+# engine lifecycle: load/unload events, stats, eviction under pressure
+
+
+def test_engine_adapter_lifecycle_events_and_stats():
+    events = EventLog(keep=True)
+    eng = InferenceEngine(
+        PARAMS, CFG,
+        ServeConfig(num_slots=3, block_size=8, prefill_chunk=8,
+                    sampling=SamplingConfig(), lora_rank=4,
+                    max_adapters=2),
+        events=events)
+    eng.load_adapter("t1", W1, scale=2.0)
+    eng.run([Request("a", [1, 2, 3], max_new_tokens=3, adapter="t1")])
+    eng.load_adapter("t2", W2)
+    eng.load_adapter("t3", W1)          # pool pressure: evicts idle LRU
+    eng.unload_adapter("t3")
+    st = eng.stats()
+    assert st["adapters"]["rank"] == 4
+    assert st["adapters"]["max_adapters"] == 2
+    assert st["adapters"]["resident"] == 1
+    assert st["adapters"]["pool_bytes"] == adapter_pool_bytes(CFG, 4, 2)
+    assert st["adapter_evictions"] == 1
+    assert st["adapter_hit_rate"] == 1.0
+    assert st["adapter_load_ms"] >= 0.0
+    evs = [r for r in events.records if r.get("kind") == "event"]
+    names = [r["event"] for r in evs]
+    assert names.count("adapter_load") == 3
+    assert names.count("adapter_unload") == 1
+    from apex_tpu.monitor.registry import MetricsRegistry
+
+    reg = MetricsRegistry()
+    eng.collect_registry(reg)
+    by = {s["name"]: s["value"] for s in reg.snapshot()["series"]}
+    assert by["adapters_resident"] == 1.0
+    assert by["adapter_evictions_total"] == 1.0
+
+
+def test_engine_decoding_adapter_pinned_against_eviction():
+    """While a stream decodes on an adapter, loading new adapters under
+    pool pressure must not evict it — load refuses instead."""
+    eng = _lora_engine(max_adapters=1)
+    eng.load_adapter("t1", W1)
+    eng.submit(Request("a", [1, 2, 3], max_new_tokens=4, adapter="t1"))
+    eng.step()                          # prefill begins: t1 is pinned
+    with pytest.raises(RuntimeError, match="pinned"):
+        eng.load_adapter("t2", W2)
+    while eng.active:
+        eng.step()
+    eng.load_adapter("t2", W2)          # retired: t1 idle, evictable
+    assert eng.adapters.lookup("t1") is None
+
+
+# ---------------------------------------------------------------------------
+# satellite: the ONE MoE serving refusal, pinned on both entry paths
+
+
+def test_moe_refusal_is_single_sourced():
+    moe_cfg = GPTConfig(vocab_size=97, max_seq=64, hidden=32,
+                        num_layers=2, num_heads=4, dtype=jnp.float32,
+                        fused_loss=False, num_experts=2, moe_top_k=1)
+    with pytest.raises(NotImplementedError, match="ROADMAP item 5a"):
+        ensure_dense_ffn(moe_cfg.num_experts)
+    # path 1: the paged forward's config check
+    with pytest.raises(NotImplementedError, match="ROADMAP item 5a"):
+        gpt_prefill_chunk(PARAMS, jnp.zeros((8,), jnp.int32),
+                          jnp.int32(0), jnp.int32(4), init_kv_cache(KV),
+                          jnp.arange(2, dtype=jnp.int32), moe_cfg, KV)
+    # path 2: the engine constructor
+    with pytest.raises(NotImplementedError, match="ROADMAP item 5a"):
+        InferenceEngine(PARAMS, moe_cfg, ServeConfig(
+            num_slots=3, block_size=8, prefill_chunk=8,
+            sampling=SamplingConfig()))
+
+
+# ---------------------------------------------------------------------------
+# satellite: regress polarity of the new headline fields
+
+
+def test_regress_polarity_covers_adapter_fields():
+    assert classify_metric("adapter_hit_rate") == "higher"
+    assert classify_metric("adapters.adapter_hit_rate") == "higher"
+    assert classify_metric("adapter_warm_dispatch_rate") == "higher"
+    assert classify_metric("adapter_load_ms") == "lower"
+    assert classify_metric("adapter_load_ms_total") == "lower"
+    assert classify_metric("adapter_evictions") == "lower"
+    assert classify_metric("adapters.adapter_evictions") == "lower"
+    # the generic hit_rate fragment must not have flipped
+    assert classify_metric("prefix_hit_rate") == "higher"
+
+
+# ---------------------------------------------------------------------------
+# cluster: fleet-mix routing, advertisement, catalog, cold loads
+
+
+def _cluster(serve, **kw):
+    return ServeCluster(PARAMS, CFG, ClusterConfig(
+        n_prefill=1, n_decode=2, serve=serve, **kw))
+
+
+def _scfg(**kw):
+    return ServeConfig(num_slots=3, block_size=8, prefill_chunk=8,
+                       sampling=SamplingConfig(), **kw)
+
+
+def test_cluster_aid0_bitwise_vs_pre_adapter_cluster():
+    base = _cluster(_scfg()).run(REQS, max_steps=20000)
+    lora = _cluster(_scfg(lora_rank=4, max_adapters=3)).run(
+        REQS, max_steps=20000)
+    assert base == lora
+
+
+def test_cluster_adapter_streams_match_single_engine():
+    areqs = [Request("a", [1, 2, 3, 4, 5], max_new_tokens=6,
+                     adapter="t1"),
+             Request("b", [7, 8, 9], max_new_tokens=4, adapter="t2"),
+             Request("c", list(range(10, 22)), max_new_tokens=5)]
+    cl = _cluster(_scfg(lora_rank=4, max_adapters=3))
+    cl.load_adapter("t1", W1, scale=2.0)
+    cl.load_adapter("t2", W2)
+    got = cl.run(areqs, max_steps=20000)
+    eng = _lora_engine()
+    eng.load_adapter("t1", W1, scale=2.0)
+    eng.load_adapter("t2", W2)
+    assert got == eng.run(areqs)
+    assert cl.adapter_catalog() == ["t1", "t2"]
+
+
+def test_cluster_membership_advertises_adapters_and_quant():
+    cl = _cluster(_scfg(lora_rank=4, max_adapters=3, kv_quant="int8"))
+    cl.load_adapter("t1", W1)
+    cl.run([Request("a", [1, 2, 3], max_new_tokens=3, adapter="t1")],
+           max_steps=20000)
+    workers = cl.membership.stats()["workers"]
+    # prefill hosts eager-load the catalog; the decode host that served
+    # "a" cold-loaded t1 and re-advertised in its next heartbeat
+    assert all(w["quant"] == "int8" for w in workers.values())
+    assert any("t1" in w["adapters"] for n, w in workers.items()
+               if n.startswith("prefill"))
+    assert any("t1" in w["adapters"] for n, w in workers.items()
+               if n.startswith("decode"))
+
+
+def test_cluster_unknown_adapter_sheds_at_submit():
+    cl = _cluster(_scfg(lora_rank=4, max_adapters=3))
+    cl.load_adapter("t1", W1)
+    out = cl.run([Request("x", [1, 2], max_new_tokens=2,
+                          adapter="nope"),
+                  Request("y", [3, 4], max_new_tokens=2, adapter="t1")],
+                 max_steps=20000)
+    assert "x" not in out and "y" in out
+    assert cl.shed["x"].reason == "unknown_adapter"
+
+
+def test_cluster_steady_state_dispatch_is_adapter_warm():
+    """ACCEPTANCE: with one hot adapter and two decode hosts, ≥90% of
+    steady-state adapter-bound handoffs land adapter-warm (the first
+    placement per host is the unavoidable cold load)."""
+    cl = _cluster(_scfg(lora_rank=4, max_adapters=3))
+    cl.load_adapter("t1", W1)
+    many = [Request(f"r{i}", [1 + i % 9, 2, 3], max_new_tokens=3,
+                    adapter="t1") for i in range(12)]
+    out = cl.run(many, max_steps=40000)
+    assert len(out) == 12
+    st = cl.stats()
+    assert st["adapter_warm_dispatch_rate"] >= 0.9
+    assert st["adapters"]["warm_dispatches"] >= 10
+    # cold loads happened through the explicit lifecycle (catalog pulls)
+    assert st["adapters"]["catalog_loads"] >= 1
+    assert st["adapter_hit_rate"] is not None
+
+
+def test_cluster_adapter_lifecycle_event_on_cold_load():
+    events = EventLog(keep=True)
+    cl = ServeCluster(PARAMS, CFG, ClusterConfig(
+        n_prefill=1, n_decode=2,
+        serve=_scfg(lora_rank=4, max_adapters=3)), events=events)
+    cl.load_adapter("t1", W1)
+    cl.run([Request("a", [1, 2, 3], max_new_tokens=3, adapter="t1")],
+           max_steps=20000)
+    evs = [r for r in events.records if r.get("kind") == "event"]
+    # at least the prefill-eager load and the decode cold load
+    assert sum(1 for r in evs if r["event"] == "adapter_load") >= 2
+
+
+def test_cluster_load_adapter_refused_when_lora_disabled():
+    cl = _cluster(_scfg())
+    with pytest.raises(RuntimeError, match="lora_rank"):
+        cl.load_adapter("t1", W1)

@@ -183,6 +183,56 @@ def test_kill_mid_decode_streams_bitwise(kv_quant, greedy):
     assert [r["reason"] for r in leave] == ["killed"]
 
 
+@pytest.mark.parametrize("greedy", [True, False],
+                         ids=["greedy", "sampled"])
+def test_kill_mid_decode_adapter_binding_survives_bitwise(greedy):
+    """PR-16 satellite: a decode worker dies while adapter-bound streams
+    decode on it; migration carries the adapter binding (by NAME — the
+    destination re-resolves its own pool slot, cold-loading from the
+    catalog if needed) and every stream, adapter-bound or base, is
+    BITWISE the fault-free run."""
+    from apex_tpu.serve import make_adapter_weights
+
+    w1 = make_adapter_weights(CFG, 4, jax.random.PRNGKey(42), std=0.05)
+    sampling = (SamplingConfig() if greedy
+                else SamplingConfig(temperature=0.7, top_k=13))
+    scfg = _serve_cfg(sampling=sampling, lora_rank=4, max_adapters=3)
+    areqs = [
+        Request("a", [1, 2, 3, 4, 5], max_new_tokens=6, adapter="t1"),
+        Request("b", [7, 8, 9], max_new_tokens=8),
+        Request("c", list(range(20, 42)), max_new_tokens=8, adapter="t1"),
+        Request("d", [11, 3, 11, 3, 11, 3, 7], max_new_tokens=9,
+                adapter="t1"),
+        Request("e", list(range(60, 73)), max_new_tokens=7),
+    ]
+
+    def run(chaos):
+        clock = _ManualClock()
+        events = EventLog(keep=True, clock=clock)
+        ccfg = ClusterConfig(n_prefill=1, n_decode=2, serve=scfg,
+                             router=RouterConfig(
+                                 slo=SloSpec(ttft_ms=600000.0)))
+        cl = ServeCluster(PARAMS, CFG, ccfg, events=events, chaos=chaos)
+        cl.load_adapter("t1", w1, scale=1.5)
+        for r in areqs:
+            cl.submit(r)
+        _drive(cl, clock)
+        return cl
+
+    cl_ff = run(None)
+    cl_ch = run(ClusterChaos([KillWorker(at_step=12, worker="decode0")]))
+    st = cl_ch.stats()
+    assert st["worker_deaths"] == 1
+    assert st["migrations_total"] >= 1
+    ff, ch = cl_ff.finished, cl_ch.finished
+    assert set(ch) == set(ff) == {r.uid for r in areqs}
+    for uid in ff:
+        assert ch[uid] == ff[uid], uid
+    # the survivor actually serves the adapter traffic adapter-warm
+    assert st["adapters"]["warm_dispatches"] + \
+        st["adapters"]["cold_dispatches"] >= 1
+
+
 def test_migrate_span_in_trace_on_one_clock():
     """The migrate span renders in the Chrome trace next to the other
     lifecycle spans, all on the one shared clock."""
