@@ -75,6 +75,28 @@ Tier 3 (the fleet side — live cross-host signal, not per-worker logs):
 * :mod:`~apex_tpu.monitor.postmortem` — ``python -m
   apex_tpu.monitor.postmortem DIR`` rebuilds the merged pre-failure
   timeline from flight dumps alone.
+
+Tier 4 (performance forensics — why, who pays, and since when):
+
+* :mod:`~apex_tpu.monitor.attrib` — per-request latency attribution
+  derived purely from the EventLog lifecycle: every retired request's
+  e2e decomposes into queue/prefill/transfer/decode/stall components
+  that SUM to the measured e2e (migration/replay-safe, concatenation-
+  order-independent); :class:`AttributionAccumulator` streams it into
+  per-component histograms on ``engine.stats()``/``cluster.stats()``,
+  and :func:`explain_regression` turns a stage-gate verdict into a
+  diagnosis;
+* :mod:`~apex_tpu.monitor.meter` — per-tenant resource metering
+  (modeled flops, KV block-seconds, adapter residency, wire bytes)
+  rolled up under a declarative :class:`CostModel` with
+  ``cost_per_token``/``cost_per_request`` surfaced in stats, per-worker
+  cost rates advertised on the membership heartbeat, and loud
+  cardinality-bounded overflow accounting;
+* :mod:`~apex_tpu.monitor.trend` — append-only per-stage history of
+  banked watcher records (provenance-stamped via
+  :func:`sink.set_provenance`) with robust median+MAD / Theil–Sen
+  drift detection; ``python -m apex_tpu.monitor.trend check`` exits 1
+  on drift — the longitudinal gate next to the pairwise regress gate.
 """
 
 from apex_tpu.monitor.alerts import (  # noqa: F401
@@ -84,12 +106,25 @@ from apex_tpu.monitor.alerts import (  # noqa: F401
     Condition,
     RateRule,
 )
+from apex_tpu.monitor.attrib import (  # noqa: F401
+    COMPONENTS,
+    AttributionAccumulator,
+    attribute_requests,
+    attribution_summary,
+    explain_regression,
+)
 from apex_tpu.monitor.events import (  # noqa: F401
     EventLog,
     chrome_trace,
+    dedupe_events,
     request_spans,
     stitch_traces,
     write_chrome_trace,
+)
+from apex_tpu.monitor.meter import (  # noqa: F401
+    CostModel,
+    Meter,
+    modeled_request_flops,
 )
 from apex_tpu.monitor.flight import (  # noqa: F401
     FlightRecorder,
@@ -127,9 +162,11 @@ from apex_tpu.monitor.report import (  # noqa: F401
 from apex_tpu.monitor.sink import (  # noqa: F401
     SCHEMA_VERSION,
     JsonlSink,
+    collect_provenance,
     json_record,
     read_jsonl,
     rotated_segments,
+    set_provenance,
 )
 from apex_tpu.monitor.slo import (  # noqa: F401
     SloSpec,
@@ -144,20 +181,28 @@ from apex_tpu.monitor.trace import (  # noqa: F401
 
 
 def __getattr__(name):
-    # regress doubles as `python -m apex_tpu.monitor.regress`; importing
-    # it eagerly here would make runpy warn about the pre-imported module
-    # every CLI run, so its two package-level names resolve lazily
+    # regress and trend double as `python -m apex_tpu.monitor.<mod>`;
+    # importing them eagerly here would make runpy warn about the
+    # pre-imported module every CLI run, so their package-level names
+    # resolve lazily
     if name in ("compare_records", "load_record"):
         from apex_tpu.monitor import regress
 
         return getattr(regress, name)
+    if name in ("append_history", "detect_trends", "load_history"):
+        from apex_tpu.monitor import trend
+
+        return getattr(trend, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 __all__ = [
     "AbsenceRule",
     "AlertEngine",
     "AlertRule",
+    "AttributionAccumulator",
+    "COMPONENTS",
     "Condition",
+    "CostModel",
     "DEFAULT_LATENCY_SPEC",
     "EventLog",
     "FleetScraper",
@@ -166,6 +211,7 @@ __all__ = [
     "HistSpec",
     "Histogram",
     "JsonlSink",
+    "Meter",
     "Metrics",
     "MetricsRegistry",
     "PHASES",
@@ -174,9 +220,18 @@ __all__ = [
     "SloSpec",
     "SloTracker",
     "accumulate_hist",
+    "append_history",
+    "attribute_requests",
+    "attribution_summary",
     "chrome_trace",
+    "collect_provenance",
     "compare_records",
+    "dedupe_events",
+    "detect_trends",
+    "explain_regression",
+    "load_history",
     "merge_snapshots",
+    "modeled_request_flops",
     "format_step_report",
     "global_norm",
     "gpt_analytic_flops_per_token",
@@ -192,6 +247,7 @@ __all__ = [
     "read_jsonl",
     "request_spans",
     "rotated_segments",
+    "set_provenance",
     "span",
     "stitch_traces",
     "span_function",

@@ -1,0 +1,370 @@
+"""Per-request latency attribution from the EventLog lifecycle (tier 4).
+
+The fleet plane (tier 3) says *that* e2e or goodput regressed; this module
+says *why*: every retired request's end-to-end time decomposes into five
+disjoint components —
+
+* ``queue``    — submitted until the first ``prefill_start`` (router +
+  admission wait; falls back to the first ``admitted`` for logs that
+  never prefilled locally),
+* ``prefill``  — union of the request's ``prefill_start → prefill_end``
+  intervals,
+* ``transfer`` — union of the ``transfer_*`` and ``migrate_*`` intervals
+  (the KV-block wire: the disaggregated handoff AND any chaos
+  migration; a migrate window encloses its own transfer, so the union
+  never double-counts),
+* ``decode``   — the ``first_token → retired`` window minus its overlap
+  with the transfer/migrate union (replayed tokens after a migration
+  decode again — their time is decode time, the hop itself is not),
+* ``stall``    — the residual, so the components ALWAYS sum to the
+  event-derived e2e exactly; the pinned identity is therefore that
+  ``stall`` stays non-negative (within clock-rounding tolerance) — a
+  materially negative stall means components double-counted.
+
+Derivation follows the ``request_spans`` discipline exactly: records are
+deduplicated first (``_dedupe_events`` — merged worker logs replay shared
+records), anchors are min-by-timestamp (max for the terminal ``retired``),
+and retried ``transfer_start`` re-emissions (``attempt > 1``) never open a
+second interval — so ANY concatenation order of the same logs attributes
+identically, the same order-independence contract the chaos trace gate
+pins.
+
+Three consumers:
+
+* :func:`attribute_requests` / :func:`attribution_summary` — batch
+  attribution over a finished event stream (tests, ``monitor.view``,
+  ``explain_regression``).
+* :class:`AttributionAccumulator` — the streaming form: tap an
+  :class:`~apex_tpu.monitor.events.EventLog`, keep O(in-flight) state,
+  fold components into per-component :class:`Histogram`\\ s at each
+  ``retired`` — what ``ServeCluster.stats()`` reports as
+  ``{component}_component_ms_p50/p99`` + ``attrib_coverage``.
+* :func:`explain_regression` — decompose a baseline-vs-new e2e delta into
+  per-component deltas so a stage gate emits a *diagnosis* ("decode grew
+  41 ms of the 44 ms regression"), not just a verdict.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Mapping, Optional, Tuple
+
+from apex_tpu.monitor.events import _dedupe_events
+from apex_tpu.monitor.hist import HistSpec, Histogram
+
+__all__ = [
+    "COMPONENTS",
+    "AttributionAccumulator",
+    "attribute_requests",
+    "attribution_summary",
+    "component_hists",
+    "explain_regression",
+]
+
+COMPONENTS = ("queue", "prefill", "transfer", "decode", "stall")
+
+# clock stamps round to 3 decimals (events.py), so per-request sums can
+# miss the measured e2e by a few microseconds per event — anything past
+# this is a real double-count, not rounding
+DEFAULT_TOL_MS = 1.0
+
+# interval-shaped event pairs (the _SPAN_PAIRS subset attribution needs);
+# transfer and migrate fold into ONE "transfer" component via interval
+# union — a migration's migrate window encloses its own wire transfer
+_PAIR_EVENTS = {
+    "prefill": ("prefill_start", "prefill_end"),
+    "transfer": ("transfer_start", "transfer_end"),
+    "migrate": ("migrate_start", "migrate_end"),
+}
+
+
+def _new_times() -> Dict[str, Any]:
+    return {"submitted": None, "admitted": None, "first_token": None,
+            "retired": None,
+            "starts": {k: [] for k in _PAIR_EVENTS},
+            "ends": {k: [] for k in _PAIR_EVENTS},
+            "replayed_tokens": 0, "migrations": 0,
+            "tenant": None, "trace": None}
+
+
+def _feed(times: Dict[str, Any], ev: str, t: float,
+          rec: Mapping[str, Any]) -> None:
+    """Fold one deduplicated event into a uid's anchor state — pure
+    min/max/append, so feeding order never matters."""
+    if times["tenant"] is None and "tenant" in rec:
+        times["tenant"] = rec["tenant"]
+    if times["trace"] is None and "trace" in rec:
+        times["trace"] = rec["trace"]
+    if ev in ("submitted", "admitted", "first_token"):
+        cur = times[ev]
+        times[ev] = t if cur is None else min(cur, t)
+        return
+    if ev == "retired":
+        cur = times["retired"]
+        times["retired"] = t if cur is None else max(cur, t)
+        return
+    if ev == "replay":
+        times["replayed_tokens"] += int(rec.get("n_tokens", 0) or 0)
+        return
+    for kind, (a, b) in _PAIR_EVENTS.items():
+        if ev == a:
+            # a transfer RETRY re-emits the start with attempt > 1; only
+            # first attempts open an interval (the retry is covered by
+            # the original's span — same rule as stitch_traces)
+            if int(rec.get("attempt", 1) or 1) <= 1:
+                times["starts"][kind].append(t)
+                if kind == "migrate":
+                    times["migrations"] += 1
+            return
+        if ev == b:
+            times["ends"][kind].append(t)
+            return
+
+
+def _pair(starts: List[float], ends: List[float]
+          ) -> List[Tuple[float, float]]:
+    """FIFO-pair sorted starts with sorted ends into intervals (an
+    unmatched trailing side — a truncated log — is dropped)."""
+    return [(s, e) for s, e in zip(sorted(starts), sorted(ends)) if e > s]
+
+
+def _union(intervals: List[Tuple[float, float]]
+           ) -> List[Tuple[float, float]]:
+    out: List[Tuple[float, float]] = []
+    for a, b in sorted(intervals):
+        if out and a <= out[-1][1]:
+            out[-1] = (out[-1][0], max(out[-1][1], b))
+        else:
+            out.append((a, b))
+    return out
+
+
+def _clipped_len(merged: List[Tuple[float, float]],
+                 lo: float, hi: float) -> float:
+    return sum(max(0.0, min(b, hi) - max(a, lo)) for a, b in merged)
+
+
+def _components(times: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """The per-request decomposition; ``None`` until the request has both
+    a ``submitted`` and a ``retired`` anchor (shed / in-flight requests
+    are not attributable)."""
+    t0, tf = times["submitted"], times["retired"]
+    if t0 is None or tf is None:
+        return None
+    e2e = max(0.0, tf - t0)
+    ps = times["starts"]["prefill"]
+    anchor = min(ps) if ps else times["admitted"]
+    queue = min(max(0.0, anchor - t0), e2e) if anchor is not None else 0.0
+    prefill_u = _union(_pair(ps, times["ends"]["prefill"]))
+    xfer_u = _union(_pair(times["starts"]["transfer"],
+                          times["ends"]["transfer"])
+                    + _pair(times["starts"]["migrate"],
+                            times["ends"]["migrate"]))
+    prefill = _clipped_len(prefill_u, t0, tf)
+    transfer = _clipped_len(xfer_u, t0, tf)
+    ft = times["first_token"]
+    if ft is not None:
+        decode = max(0.0, (tf - ft) - _clipped_len(xfer_u, ft, tf))
+    else:
+        decode = 0.0
+    stall = e2e - (queue + prefill + transfer + decode)
+    out: Dict[str, Any] = {
+        "queue": round(queue, 3), "prefill": round(prefill, 3),
+        "transfer": round(transfer, 3), "decode": round(decode, 3),
+        "stall": round(stall, 3), "e2e_ms": round(e2e, 3),
+        "migrated": times["migrations"] > 0,
+        "replayed_tokens": times["replayed_tokens"],
+    }
+    if times["tenant"] is not None:
+        out["tenant"] = times["tenant"]
+    if times["trace"] is not None:
+        out["trace"] = times["trace"]
+    return out
+
+
+def attribute_requests(records: Iterable[Mapping[str, Any]], *,
+                       deduped: bool = False
+                       ) -> Dict[str, Dict[str, Any]]:
+    """uid -> component decomposition for every RETIRED request in the
+    stream. Identity: the five :data:`COMPONENTS` sum to ``e2e_ms``
+    exactly (stall is the residual); a well-formed log keeps
+    ``stall >= -DEFAULT_TOL_MS``."""
+    per_uid: Dict[str, Dict[str, Any]] = {}
+    for r in (records if deduped else _dedupe_events(records)):
+        if r.get("kind") != "event" or "uid" not in r:
+            continue
+        times = per_uid.setdefault(r["uid"], _new_times())
+        _feed(times, r["event"], float(r["t_ms"]), r)
+    out: Dict[str, Dict[str, Any]] = {}
+    for uid, times in per_uid.items():
+        c = _components(times)
+        if c is not None:
+            out[uid] = c
+    return out
+
+
+def component_hists(records: Iterable[Mapping[str, Any]], *,
+                    spec: Optional[HistSpec] = None
+                    ) -> Dict[str, Histogram]:
+    """Per-component Histograms over a finished event stream (the batch
+    twin of :class:`AttributionAccumulator`)."""
+    hists = {c: Histogram(spec) for c in COMPONENTS}
+    for comp in attribute_requests(records).values():
+        for c in COMPONENTS:
+            hists[c].add([max(0.0, comp[c])])
+    return hists
+
+
+def _summary_from(hists: Mapping[str, Histogram], n_retired: int,
+                  n_attributed: int, tol_ms: float,
+                  n_clean: int) -> Dict[str, Any]:
+    out: Dict[str, Any] = {
+        "n_retired": n_retired,
+        "n_attributed": n_attributed,
+        # coverage counts requests whose decomposition exists AND holds
+        # the identity (stall within -tol): the regress-gated health of
+        # the attribution plane itself
+        "attrib_coverage": (round(n_clean / n_retired, 4)
+                            if n_retired else None),
+        "tol_ms": tol_ms,
+    }
+    for c in COMPONENTS:
+        h = hists[c]
+        if h.total == 0:
+            continue
+        out[f"{c}_component_ms_p50"] = round(h.quantile(0.5), 3)
+        out[f"{c}_component_ms_p99"] = round(h.quantile(0.99), 3)
+        mean = h.mean()
+        if mean is not None:
+            out[f"{c}_component_ms_mean"] = round(mean, 3)
+    return out
+
+
+def attribution_summary(records: Iterable[Mapping[str, Any]], *,
+                        spec: Optional[HistSpec] = None,
+                        tol_ms: float = DEFAULT_TOL_MS
+                        ) -> Dict[str, Any]:
+    """JSON-flat attribution aggregate over a finished event stream:
+    ``{component}_component_ms_p50/p99/mean`` + ``attrib_coverage``
+    (``monitor.regress`` gates both — component latencies lower-better,
+    coverage higher-better)."""
+    records = list(records)
+    deduped = _dedupe_events(records)
+    n_retired = len({r["uid"] for r in deduped
+                     if r.get("kind") == "event" and "uid" in r
+                     and r.get("event") == "retired"})
+    attrib = attribute_requests(deduped, deduped=True)
+    hists = {c: Histogram(spec) for c in COMPONENTS}
+    n_clean = 0
+    for comp in attrib.values():
+        for c in COMPONENTS:
+            hists[c].add([max(0.0, comp[c])])
+        if comp["stall"] >= -tol_ms:
+            n_clean += 1
+    return _summary_from(hists, n_retired, len(attrib), tol_ms, n_clean)
+
+
+class AttributionAccumulator:
+    """Streaming attribution for a live :class:`EventLog`: register with
+    ``events.tap(acc.tap)``; per-uid anchor state lives only while the
+    request is in flight, and every ``retired`` folds the decomposition
+    into per-component Histograms — O(in-flight) memory on week-long
+    runs, the same contract as the engine's own histograms.
+
+    The live tap sees each record exactly once (flight-recorder dump
+    COPIES go through the sink, never the tap), so no dedupe pass is
+    needed; the pairing rules are identical to the batch path."""
+
+    def __init__(self, spec: Optional[HistSpec] = None,
+                 tol_ms: float = DEFAULT_TOL_MS):
+        self.hists: Dict[str, Histogram] = {
+            c: Histogram(spec) for c in COMPONENTS}
+        self.e2e = Histogram(spec)
+        self.tol_ms = tol_ms
+        self.n_retired = 0
+        self.n_attributed = 0
+        self.n_clean = 0
+        self._open: Dict[str, Dict[str, Any]] = {}
+
+    def tap(self, rec: Mapping[str, Any]) -> None:
+        if rec.get("kind") != "event" or "uid" not in rec:
+            return
+        uid, ev = rec["uid"], rec["event"]
+        if ev == "shed":
+            # terminal without attribution — drop the open state
+            self._open.pop(uid, None)
+            return
+        times = self._open.setdefault(uid, _new_times())
+        _feed(times, ev, float(rec["t_ms"]), rec)
+        if ev != "retired":
+            return
+        self.n_retired += 1
+        comp = _components(self._open.pop(uid))
+        if comp is None:
+            return
+        self.n_attributed += 1
+        if comp["stall"] >= -self.tol_ms:
+            self.n_clean += 1
+        for c in COMPONENTS:
+            self.hists[c].add([max(0.0, comp[c])])
+        self.e2e.add([comp["e2e_ms"]])
+
+    @property
+    def in_flight(self) -> int:
+        return len(self._open)
+
+    def summary(self) -> Dict[str, Any]:
+        return _summary_from(self.hists, self.n_retired,
+                             self.n_attributed, self.tol_ms, self.n_clean)
+
+
+def _component_means(attrib: Mapping[str, Mapping[str, Any]]
+                     ) -> Dict[str, float]:
+    n = len(attrib)
+    out = {c: 0.0 for c in COMPONENTS}
+    out["e2e_ms"] = 0.0
+    if not n:
+        return out
+    for comp in attrib.values():
+        for c in COMPONENTS:
+            out[c] += comp[c]
+        out["e2e_ms"] += comp["e2e_ms"]
+    return {k: v / n for k, v in out.items()}
+
+
+def explain_regression(baseline_records: Iterable[Mapping[str, Any]],
+                       new_records: Iterable[Mapping[str, Any]], *,
+                       top: int = 3) -> Dict[str, Any]:
+    """Decompose an e2e regression between two event streams into
+    per-component deltas. Means (not quantiles) because means are
+    additive: the component deltas sum to the e2e delta exactly, so the
+    diagnosis accounts for ALL of the regression. Returns the component
+    ranking (worst first), the ``top`` regressed component names, and a
+    one-word ``diagnosis`` — the component that grew the most (``None``
+    when e2e did not regress)."""
+    base = _component_means(attribute_requests(baseline_records))
+    new = _component_means(attribute_requests(new_records))
+    delta_e2e = new["e2e_ms"] - base["e2e_ms"]
+    comps = []
+    for c in COMPONENTS:
+        d = new[c] - base[c]
+        comps.append({
+            "component": c,
+            "baseline_ms": round(base[c], 3),
+            "new_ms": round(new[c], 3),
+            "delta_ms": round(d, 3),
+            "share": (round(d / delta_e2e, 4) if abs(delta_e2e) > 1e-9
+                      else None),
+        })
+    comps.sort(key=lambda e: -e["delta_ms"])
+    regressed = [e["component"] for e in comps if e["delta_ms"] > 0.0]
+    return {
+        "metric": "e2e_ms",
+        "baseline_mean_ms": round(base["e2e_ms"], 3),
+        "new_mean_ms": round(new["e2e_ms"], 3),
+        "delta_ms": round(delta_e2e, 3),
+        "components": comps,
+        "top_regressed": regressed[:top],
+        "diagnosis": (comps[0]["component"]
+                      if delta_e2e > 0 and comps[0]["delta_ms"] > 0
+                      else None),
+    }

@@ -37,7 +37,9 @@ __all__ = [
     "EventLog",
     "GAUGES",
     "LIFECYCLE",
+    "SPAN_PAIRS",
     "chrome_trace",
+    "dedupe_events",
     "request_spans",
     "stitch_traces",
     "write_chrome_trace",
@@ -206,6 +208,13 @@ def _dedupe_events(records: Iterable[Dict[str, Any]]
         seen.add(key)
         out.append(r)
     return out
+
+
+# the public names tier-4 consumers (monitor.attrib, external tooling)
+# build on: the span-pair table and the merged-log dedupe pass share one
+# definition with the Chrome-trace renderer above
+SPAN_PAIRS = _SPAN_PAIRS
+dedupe_events = _dedupe_events
 
 
 def request_spans(records: Iterable[Dict[str, Any]], *,

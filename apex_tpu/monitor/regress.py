@@ -73,7 +73,12 @@ _HIGHER = ("tokens_per_s", "goodput", "_rps", "mfu", "occupancy",
            # for explicit coverage) and the fraction of adapter-bound
            # handoffs the router landed adapter-warm — a falling warm
            # rate means the fleet-mix placement stopped working
-           "adapter_hit_rate", "adapter_warm_dispatch_rate")
+           "adapter_hit_rate", "adapter_warm_dispatch_rate",
+           # performance-forensics round (stage 21): the fraction of
+           # retired requests the attribution plane decomposed with the
+           # sum identity intact, and the fraction the meter charged —
+           # a coverage hole is a blind billing/diagnosis spot
+           "attrib_coverage", "meter_coverage")
 _LOWER = ("_ms", "violation", "latency", "bubble", "exposed_bytes",
           # disaggregated cluster (stage 15): a rising shed fraction is a
           # capacity regression (transfer_ms falls under the generic
@@ -118,7 +123,15 @@ _LOWER = ("_ms", "violation", "latency", "bubble", "exposed_bytes",
           # listed for explicit coverage) and LRU eviction churn — more
           # evictions under the same tenant mix means the pool is
           # thrashing
-          "adapter_load_ms", "adapter_evictions")
+          "adapter_load_ms", "adapter_evictions",
+          # performance-forensics round (stage 21): per-component
+          # latency attribution (also caught by the generic "_ms" rule;
+          # listed so the diagnosis fields' coverage is explicit), the
+          # per-tenant billing headline rates, and the trend gate's own
+          # drift score — a rising score means the longitudinal series
+          # is walking away from its history
+          "_component_ms", "cost_per_token", "cost_per_request",
+          "drift_score")
 
 
 def classify_metric(key: str,
@@ -147,7 +160,8 @@ def flatten_record(rec: Mapping[str, Any], prefix: str = ""
     out: Dict[str, float] = {}
     for k, v in rec.items():
         key = f"{prefix}{k}"
-        if k in ("schema", "ts", "buckets", "spec", "config", "hists"):
+        if k in ("schema", "ts", "buckets", "spec", "config", "hists",
+                 "provenance"):
             continue
         if isinstance(v, Mapping):
             if "buckets" in v and "spec" in v:

@@ -39,11 +39,70 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import threading
 import time
-from typing import Any, Dict, Iterator, List, Optional
+from typing import Any, Dict, Iterator, List, Mapping, Optional
 
 SCHEMA_VERSION = 1
+
+# process-wide provenance stamp (tier 4): when set, every json_record
+# line carries it under "provenance" — the trend history is useless
+# without knowing what changed between points. None (the default) keeps
+# records byte-for-byte identical to the pre-provenance format.
+_PROVENANCE: Optional[Dict[str, Any]] = None
+
+
+def collect_provenance(extra: Optional[Mapping[str, Any]] = None
+                       ) -> Dict[str, Any]:
+    """Best-effort provenance for bench records: git sha, jax version,
+    backend, hostname. Never raises, never initializes jax — the backend
+    field appears only when the caller already imported jax (a bench),
+    so tooling CLIs (trend append) don't grab a TPU just to stamp a
+    line."""
+    prov: Dict[str, Any] = {}
+    try:
+        import socket
+
+        prov["hostname"] = socket.gethostname()
+    except Exception:  # best-effort stamp: no hostname beats no record
+        pass
+    try:
+        import subprocess
+
+        repo = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        out = subprocess.run(["git", "rev-parse", "HEAD"], cwd=repo,
+                             capture_output=True, text=True, timeout=5)
+        if out.returncode == 0 and out.stdout.strip():
+            prov["git_sha"] = out.stdout.strip()
+    except Exception:  # no git / not a checkout — stamp without a sha
+        pass
+    if "jax" in sys.modules:
+        try:
+            import jax
+
+            prov["jax_version"] = jax.__version__
+            prov["backend"] = jax.default_backend()
+        except Exception:  # backend probe must never kill a bench record
+            pass
+    else:
+        try:
+            from importlib.metadata import version
+
+            prov["jax_version"] = version("jax")
+        except Exception:  # jax not installed — version stays unstamped
+            pass
+    if extra:
+        prov.update(extra)
+    return prov
+
+
+def set_provenance(prov: Optional[Mapping[str, Any]]) -> None:
+    """Install (or clear, with ``None``) the process-wide provenance
+    stamp attached to every subsequent :func:`json_record` line."""
+    global _PROVENANCE
+    _PROVENANCE = dict(prov) if prov else None
 
 
 def _is_process_zero() -> bool:
@@ -58,9 +117,14 @@ def _is_process_zero() -> bool:
 def json_record(**fields: Any) -> str:
     """Render one schema-stamped JSON line (no trailing newline) — the
     shared convention for sink records AND bench one-liners, so every
-    emitter in the repo is parseable by the same reader."""
+    emitter in the repo is parseable by the same reader. When a
+    process-wide provenance stamp is set (:func:`set_provenance`), it
+    rides under ``"provenance"`` (explicit fields win); records emitted
+    without one are byte-for-byte the pre-provenance format."""
     rec: Dict[str, Any] = {"schema": SCHEMA_VERSION}
     rec.update(fields)
+    if _PROVENANCE is not None and "provenance" not in rec:
+        rec["provenance"] = _PROVENANCE
     return json.dumps(rec)
 
 

@@ -12,6 +12,16 @@ Per-request latencies are reconstructed from the lifecycle events
 ``--e2e-budget``, ms) to get goodput/violation accounting through
 :class:`~apex_tpu.monitor.slo.SloTracker` on the same records. Rotated
 sinks (``FILE.jsonl.1`` …) are read transparently via ``read_jsonl``.
+
+Tier 4: logs whose lifecycle carries prefill/transfer anchors
+additionally get the per-component **latency attribution** table
+(queue/prefill/transfer/decode/stall p50/p99 via
+:func:`~apex_tpu.monitor.attrib.attribution_summary`) and a per-tenant
+rollup (requests / tokens / per-component time totals — "who consumed
+the fleet's time" straight from the event stream, no meter required);
+``--baseline OTHER.jsonl`` diffs the two logs through
+:func:`~apex_tpu.monitor.attrib.explain_regression` and names the top-3
+regressed components — the diagnosis, not just the verdict.
 """
 
 from __future__ import annotations
@@ -152,6 +162,46 @@ def summarize(records: List[Dict[str, Any]],
             / last["prefix_blocks_needed_total"], 4)
         rec["prefill_flops_saved"] = last.get(
             "prefill_flops_saved_total")
+    # tier-4 latency attribution: only when the log's lifecycle carries
+    # the anchors the decomposition needs (an engine-only log without
+    # prefill_start events yields nothing — the keys just stay absent)
+    from apex_tpu.monitor.attrib import (
+        COMPONENTS,
+        attribute_requests,
+        attribution_summary,
+    )
+
+    attrib = attribute_requests(events, deduped=True)
+    if attrib:
+        summ = attribution_summary(events)
+        rec["attrib_coverage"] = summ["attrib_coverage"]
+        for c in COMPONENTS:
+            for q in ("p50", "p99"):
+                k = f"{c}_component_ms_{q}"
+                if summ.get(k) is not None:
+                    rec[k] = summ[k]
+        # per-tenant rollup: requests / tokens / per-component time
+        # totals from the event stream alone ("who consumed the
+        # fleet's time" — the meterless half of the billing view; the
+        # priced half lives on cluster.stats()["meter"])
+        tenants: Dict[str, Dict[str, Any]] = {}
+        for uid, comp in attrib.items():
+            tname = comp.get("tenant")
+            if tname is None:
+                continue
+            led = tenants.setdefault(
+                tname, {"requests": 0, "tokens": 0, "e2e_ms_total": 0.0,
+                        **{f"{c}_ms_total": 0.0 for c in COMPONENTS}})
+            led["requests"] += 1
+            n = lats.get(uid, {}).get("n_tokens")
+            led["tokens"] += int(n or 0)
+            led["e2e_ms_total"] = round(
+                led["e2e_ms_total"] + comp["e2e_ms"], 3)
+            for c in COMPONENTS:
+                led[f"{c}_ms_total"] = round(
+                    led[f"{c}_ms_total"] + max(0.0, comp[c]), 3)
+        if tenants:
+            rec["tenants"] = dict(sorted(tenants.items()))
     if slo is not None and slo.budgets():
         from apex_tpu.monitor.slo import SloTracker
 
@@ -205,6 +255,39 @@ def _table(rec: Dict[str, Any]) -> List[str]:
         v = " ".join(f"{k}={n}" for k, n in rec["violations"].items())
         lines.append(f"  SLO: good {rec['good']}/{rec['n_retired']} "
                      f"({rec['good_fraction']}) violations: {v or 'none'}")
+    comp_rows = [(c, rec.get(f"{c}_component_ms_p50"),
+                  rec.get(f"{c}_component_ms_p99"))
+                 for c in ("queue", "prefill", "transfer", "decode",
+                           "stall")]
+    comp_rows = [r for r in comp_rows if r[1] is not None]
+    if comp_rows:
+        lines.append(f"  attribution (coverage "
+                     f"{rec.get('attrib_coverage')}):")
+        lines.append(f"  {'component':<16} {'p50':>10} {'p99':>10}")
+        for name, p50, p99 in comp_rows:
+            lines.append(f"  {name:<16} {p50:>10.3f} {p99:>10.3f}")
+    if rec.get("tenants"):
+        lines.append(f"  {'tenant':<16} {'reqs':>6} {'tokens':>8} "
+                     f"{'e2e_s':>8} {'decode_s':>9} {'queue_s':>8}")
+        for tname, led in rec["tenants"].items():
+            lines.append(
+                f"  {tname:<16} {led['requests']:>6} {led['tokens']:>8} "
+                f"{led['e2e_ms_total'] / 1e3:>8.2f} "
+                f"{led['decode_ms_total'] / 1e3:>9.2f} "
+                f"{led['queue_ms_total'] / 1e3:>8.2f}")
+    if rec.get("explain") is not None:
+        ex = rec["explain"]
+        lines.append(
+            f"  vs baseline: e2e {ex['baseline_mean_ms']} -> "
+            f"{ex['new_mean_ms']} ms ({ex['delta_ms']:+.3f})")
+        for e in ex["components"][:3]:
+            share = (f" ({e['share'] * 100:.0f}% of the move)"
+                     if e["share"] is not None else "")
+            lines.append(
+                f"    {e['component']:<10} {e['baseline_ms']} -> "
+                f"{e['new_ms']} ms ({e['delta_ms']:+.3f}){share}")
+        if ex["diagnosis"] is not None:
+            lines.append(f"    diagnosis: {ex['diagnosis']} grew the most")
     return lines
 
 
@@ -221,11 +304,24 @@ def main(argv=None) -> int:
     ap.add_argument("--tpot-budget", type=float, default=None)
     ap.add_argument("--queue-budget", type=float, default=None)
     ap.add_argument("--e2e-budget", type=float, default=None)
+    ap.add_argument("--baseline", default=None, metavar="FILE.jsonl",
+                    help="second event log to attribute an e2e move "
+                         "against (explain_regression: top-3 regressed "
+                         "components + diagnosis)")
     args = ap.parse_args(argv)
     slo = SloSpec(ttft_ms=args.ttft_budget, tpot_ms=args.tpot_budget,
                   queue_ms=args.queue_budget, e2e_ms=args.e2e_budget)
     records = list(read_jsonl(args.path))
     rec = summarize(records, slo=slo if slo.budgets() else None)
+    if args.baseline is not None:
+        from apex_tpu.monitor.attrib import explain_regression
+
+        base_events = [r for r in read_jsonl(args.baseline)
+                       if r.get("kind") == "event"
+                       and "flight_worker" not in r]
+        new_events = [r for r in records if r.get("kind") == "event"
+                      and "flight_worker" not in r]
+        rec["explain"] = explain_regression(base_events, new_events)
     for line in _table(rec):
         print(line, file=sys.stderr)
     print(json_record(metric="monitor_view", file=args.path, **rec),

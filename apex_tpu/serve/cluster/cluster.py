@@ -64,8 +64,10 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 import jax
 
 from apex_tpu.monitor.alerts import AlertEngine, AlertRule, Condition
+from apex_tpu.monitor.attrib import AttributionAccumulator
 from apex_tpu.monitor.events import EventLog
 from apex_tpu.monitor.flight import FlightRecorder
+from apex_tpu.monitor.meter import CostModel, Meter
 from apex_tpu.monitor.hist import DEFAULT_LATENCY_SPEC, Histogram
 from apex_tpu.monitor.registry import FleetScraper, MetricsRegistry
 from apex_tpu.monitor.trace import span
@@ -163,6 +165,21 @@ class ClusterConfig:
     alert_rules: Tuple[Any, ...] = ()
     flight_capacity: int = 2048
     flight_dir: Optional[str] = None
+    # performance forensics (monitor tier 4). metering: one shared
+    # Meter across the decode fleet — every retirement charges its
+    # tenant (modeled flops, KV block-seconds, adapter residency), the
+    # wire charges at delivery, sheds at the shed funnel; cost_model
+    # prices the resources (None: DEFAULT_WEIGHTS); meter_max_tenants
+    # bounds the ledger (overflow folds loudly into "_overflow").
+    # attribution: an AttributionAccumulator tapped on the shared
+    # EventLog decomposes every retired request's e2e into queue/
+    # prefill/transfer/decode/stall components on cluster.stats().
+    # Both default ON (host-side dict work only — bench_attrib_cost
+    # pins the A/B overhead ≤ 5%); OFF restores the tier-3 cluster.
+    metering: bool = True
+    attribution: bool = True
+    cost_model: Optional[CostModel] = None
+    meter_max_tenants: int = 1024
 
     def validate(self) -> None:
         if self.n_prefill < 1:
@@ -200,6 +217,8 @@ class ClusterConfig:
         if self.flight_capacity < 0:
             raise ValueError(
                 "flight_capacity must be >= 0 (0: flight recorder off)")
+        if self.meter_max_tenants < 1:
+            raise ValueError("meter_max_tenants must be >= 1")
 
 
 class ServeCluster:
@@ -292,6 +311,21 @@ class ServeCluster:
         self._base_key = base_key
         self._use_pallas = use_pallas
         self._peak_flops_per_s = peak_flops_per_s
+        # -- performance forensics (monitor tier 4) ------------------------
+        # ONE meter shared by every decode host (each charge stamps the
+        # retiring worker's name, so per-worker cost rates fall out of
+        # the shared pool), created BEFORE the workers that hold it
+        self.meter: Optional[Meter] = None
+        if cluster_cfg.metering:
+            self.meter = Meter(model=cluster_cfg.cost_model,
+                               max_tenants=cluster_cfg.meter_max_tenants)
+        # latency attribution: a tap on the shared EventLog streams
+        # every retirement's lifecycle into the five-component
+        # decomposition — no producer knows it exists
+        self.attrib: Optional[AttributionAccumulator] = None
+        if cluster_cfg.attribution:
+            self.attrib = AttributionAccumulator()
+            self._events.tap(self.attrib.tap)
         self.prefill_workers = [
             PrefillWorker(params, cfg, self._prefill_cfg, base_key=base_key,
                           wire_mode=cluster_cfg.wire_mode,
@@ -377,7 +411,8 @@ class ServeCluster:
             events=self._events, slo=self.cluster_cfg.router.slo,
             retain_streams=False, on_retire=self._retired,
             use_pallas=self._use_pallas,
-            peak_flops_per_s=self._peak_flops_per_s, name=name)
+            peak_flops_per_s=self._peak_flops_per_s,
+            meter=self.meter, name=name)
 
     # -- flight recorders (monitor tier 3) ---------------------------------
     def _arm_flight(self, name: str) -> Optional[FlightRecorder]:
@@ -489,7 +524,10 @@ class ServeCluster:
         tenant-state bound so a tenant flood degrades loudly, never
         unboundedly)."""
         limit = self.cluster_cfg.router.max_tenant_states or 1024
-        reg = MetricsRegistry(max_series=4 * limit + 64)
+        # headroom: 3 router series + 3 meter series per tenant, plus
+        # the fixed cluster series — both tenant planes are themselves
+        # cardinality-bounded (router GC, meter overflow fold)
+        reg = MetricsRegistry(max_series=8 * limit + 64)
         t = self._now_ms()
         L = {"worker": "cluster"}
         r = self.router
@@ -527,6 +565,8 @@ class ServeCluster:
                     reg.gauge("heartbeat_age_ms",
                               max(0.0, t - wrec.last_beat_ms),
                               t_ms=t, worker=name)
+        if self.meter is not None:
+            self.meter.collect_registry(reg, t_ms=t)
         return reg.snapshot(t)
 
     # -- adapter catalog (per-tenant LoRA) ---------------------------------
@@ -635,6 +675,13 @@ class ServeCluster:
 
     def _record_shed(self, d: ShedDecision) -> None:
         self.shed[d.request.uid] = d
+        if self.meter is not None:
+            # the single shed-charge funnel: EVERY terminal shed (front
+            # door, infeasible dispatch, transfer_failed, headless)
+            # flows through here exactly once — the engine deliberately
+            # never charges sheds, so there is no double-count
+            self.meter.charge(getattr(d.request, "tenant", "default"),
+                              t_ms=d.t_ms, shed=1)
         self._events.emit(
             "shed", d.request.uid, t_ms=d.t_ms, reason=d.reason,
             predicted_ttft_ms=(round(d.predicted_ttft_ms, 3)
@@ -825,6 +872,13 @@ class ServeCluster:
                 "transfer_end", uid, t_ms=d.t_deliver_ms,
                 wire_bytes=d.wire_bytes, handoff_kind=h.kind,
                 transfer_ms=round(d.transfer_ms, 3))
+            if self.meter is not None:
+                # the wire is fleet infrastructure, not a worker — the
+                # charge carries no worker attribution, and a retried
+                # transfer bills each transit (retries cost real bytes)
+                self.meter.charge(
+                    getattr(h.request, "tenant", "default"),
+                    t_ms=d.t_deliver_ms, wire_bytes=d.wire_bytes)
             self._redeliver.append(h)
             n += 1
         # place everything delivered-but-unplaced (fresh arrivals above,
@@ -1119,11 +1173,18 @@ class ServeCluster:
                 continue
             if w.step():
                 decoded += 1
+            t_beat = self._now_ms()
             self.membership.beat(
-                w.name, self._now_ms(),
+                w.name, t_beat,
                 adapters=(w.resident_adapters()
                           if w.engine.adapters is not None else None),
-                quant=w.engine.serve_cfg.kv_quant)
+                quant=w.engine.serve_cfg.kv_quant,
+                # the tier-4 half of the advertisement: this worker's
+                # accrued cost units/second (the ROADMAP 5c routing
+                # signal — a fleet-mix policy reads membership, not
+                # the meter)
+                cost_rate=(self.meter.worker_cost_rate(w.name, t_beat)
+                           if self.meter is not None else None))
             wd = self._watchdogs.get(w.name)
             if wd is not None:
                 wd.tick(self._step_idx)
@@ -1364,6 +1425,29 @@ class ServeCluster:
             # the fleet roll-up alias (regress-gated higher-is-better):
             # cluster-wide goodput as the scrape/alert plane reports it
             out["fleet_goodput_rps"] = slo_rep["goodput_rps"]
+        # performance forensics (monitor tier 4): the event-derived
+        # per-component decomposition and the per-tenant ledger, with
+        # the flat regress-gated duals (attrib_coverage /
+        # {c}_component_ms_* / cost_per_token / cost_per_request /
+        # meter_coverage) hoisted next to the other headline fields
+        if self.attrib is not None:
+            att = self.attrib.summary()
+            out["attribution"] = att
+            if att.get("attrib_coverage") is not None:
+                out["attrib_coverage"] = att["attrib_coverage"]
+            for c in ("queue", "prefill", "transfer", "decode", "stall"):
+                for q in ("p50", "p99"):
+                    k = f"{c}_component_ms_{q}"
+                    if att.get(k) is not None:
+                        out[k] = att[k]
+        if self.meter is not None:
+            m = self.meter.stats(completed=self.completed)
+            m["worker_cost_rates"] = self.meter.worker_rates(
+                self._now_ms())
+            out["meter"] = m
+            out["cost_per_token"] = m["cost_per_token"]
+            out["cost_per_request"] = m["cost_per_request"]
+            out["meter_coverage"] = m["meter_coverage"]
         out["prefill_hosts"] = [
             {"host": w.name, "state": self._state(w.name),
              "chunks_run": w.chunks_run,

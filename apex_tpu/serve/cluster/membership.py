@@ -68,6 +68,12 @@ class WorkerRecord:
     reason: Optional[str] = None
     adapters: tuple = ()           # resident adapter names, sorted
     quant: str = "none"            # the worker's kv_quant mode
+    # tier-4 metering advertisement: the worker's recent cost accrual
+    # rate (CostModel units/second) — the routing-signal half of the
+    # heartbeat (ROADMAP 5c): a fleet-mix policy can weigh "cheap" vs
+    # "expensive" hosts from membership state alone. None until the
+    # worker's meter has accrued anything.
+    cost_rate: Optional[float] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -141,9 +147,10 @@ class ClusterMembership:
 
     def beat(self, name: str, t_ms: float,
              adapters: Optional[List[str]] = None,
-             quant: Optional[str] = None) -> None:
+             quant: Optional[str] = None,
+             cost_rate: Optional[float] = None) -> None:
         """Record liveness (and, when given, refresh the worker's
-        advertisement: resident adapter set + quant mode)."""
+        advertisement: resident adapter set + quant mode + cost rate)."""
         rec = self._workers[name]
         if rec.state != DEAD:
             rec.last_beat_ms = float(t_ms)
@@ -151,6 +158,8 @@ class ClusterMembership:
                 rec.adapters = tuple(sorted(adapters))
             if quant is not None:
                 rec.quant = quant
+            if cost_rate is not None:
+                rec.cost_rate = float(cost_rate)
 
     def mark_draining(self, name: str, t_ms: float, reason: str) -> bool:
         """alive → draining (idempotent; False if already leaving)."""
@@ -292,7 +301,11 @@ class ClusterMembership:
                     "left_at": (round(r.left_ms, 3)
                                 if r.left_ms is not None else None),
                     "adapters": list(r.adapters),
-                    "quant": r.quant}
+                    "quant": r.quant,
+                    # deliberately NOT "_cost_rate_ms" or similar — the
+                    # rate is load-dependent, so regress must not gate it
+                    "cost_rate": (round(r.cost_rate, 6)
+                                  if r.cost_rate is not None else None)}
                 for n, r in sorted(self._workers.items())},
             "alive": by_state[ALIVE],
             "draining": by_state[DRAINING],

@@ -353,7 +353,9 @@ class Router:
 
     # -- adapter-aware decode placement ------------------------------------
     def select_worker(self, candidates: List[Tuple[str, int, Any]],
-                      adapter: Optional[str] = None) -> Optional[str]:
+                      adapter: Optional[str] = None,
+                      cost_rates: Optional[Mapping[str, float]] = None
+                      ) -> Optional[str]:
         """Pick the decode worker for one handoff over a heterogeneous
         fleet. ``candidates``: ``(name, load, resident_adapters)`` rows
         built from the membership advertisements. An adapter-bound
@@ -362,18 +364,30 @@ class Router:
         when no warm worker exists does it fall back to the least-loaded
         cold one, which the cluster then loads explicitly (the
         ``adapter_load`` lifecycle event). Base traffic and the
-        no-candidates case keep the classic least-loaded rule. Returns
-        the chosen name (None when ``candidates`` is empty)."""
+        no-candidates case keep the classic least-loaded rule.
+
+        ``cost_rates`` (tier 4, opt-in): the membership-advertised
+        per-worker cost rates (``WorkerRecord.cost_rate``). When given,
+        load ties break toward the CHEAPER worker — the SLO-vs-cost
+        placement hook of ROADMAP 5c; omitted, placement is exactly the
+        pre-metering least-loaded rule. Returns the chosen name (None
+        when ``candidates`` is empty)."""
         cands = list(candidates)
         if not cands:
             return None
+
+        def key(c):
+            if cost_rates is None:
+                return c[1]
+            return (c[1], cost_rates.get(c[0]) or 0.0)
+
         if adapter is not None:
             warm = [c for c in cands if adapter in (c[2] or ())]
             if warm:
                 self.adapter_warm_dispatches += 1
-                return min(warm, key=lambda c: c[1])[0]
+                return min(warm, key=key)[0]
             self.adapter_cold_dispatches += 1
-        return min(cands, key=lambda c: c[1])[0]
+        return min(cands, key=key)[0]
 
     # -- stats -------------------------------------------------------------
     def stats(self) -> Dict[str, Any]:

@@ -452,6 +452,7 @@ class DecodeWorker:
                  use_pallas: Optional[bool] = None,
                  peak_flops_per_s: Optional[float] = None,
                  preemption: Optional[PreemptionHandler] = None,
+                 meter=None, meter_worker: Optional[str] = None,
                  name: str = "decode0"):
         validate_wire_mode(wire_mode)
         self.name = name
@@ -462,7 +463,11 @@ class DecodeWorker:
             params, cfg, serve_cfg, base_key=base_key, sink=sink,
             events=events, slo=slo, retain_streams=retain_streams,
             on_retire=on_retire, use_pallas=use_pallas,
-            peak_flops_per_s=peak_flops_per_s)
+            peak_flops_per_s=peak_flops_per_s,
+            # tier-4 metering: the cluster shares ONE ledger across
+            # decode hosts; each charge is stamped with this worker's
+            # name so per-worker cost rates fall out of the same pool
+            meter=meter, meter_worker=meter_worker or name)
         self._events = events
         self._pending: collections.deque = collections.deque()
         self.admitted = 0

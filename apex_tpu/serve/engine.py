@@ -94,6 +94,7 @@ import jax.numpy as jnp
 
 from apex_tpu.monitor.events import EventLog
 from apex_tpu.monitor.hist import DEFAULT_LATENCY_SPEC, HistSpec, Histogram
+from apex_tpu.monitor.meter import Meter, modeled_request_flops
 from apex_tpu.monitor.metrics import Metrics
 from apex_tpu.monitor.slo import SloSpec, SloTracker
 from apex_tpu.monitor.trace import span
@@ -332,6 +333,8 @@ class InferenceEngine:
         drafter: Optional[Drafter] = None,
         on_reject: Optional[Callable[[Request, Dict[str, Any]],
                                      None]] = None,
+        meter: Optional[Meter] = None,
+        meter_worker: str = "engine",
     ):
         scfg = serve_cfg or ServeConfig()
         scfg.validate()
@@ -426,6 +429,17 @@ class InferenceEngine:
         hspec = hist_spec or DEFAULT_LATENCY_SPEC
         self.hists: Dict[str, Histogram] = {
             name: Histogram(hspec) for name in _HIST_NAMES}
+        # tier-4 attribution: the engine-LOCAL decomposition from the slot
+        # timeline (queue/prefill/decode; transfer and stall only exist at
+        # the cluster, whose event-tap AttributionAccumulator owns them)
+        self._attrib_hists: Dict[str, Histogram] = {
+            c: Histogram(hspec) for c in ("queue", "prefill", "decode")}
+        self._attrib_n = 0
+        # tier-4 metering: retirement charges the request's tenant into
+        # the (possibly cluster-shared) ledger — exactly once, by
+        # whichever engine retires it
+        self._meter = meter
+        self._meter_worker = meter_worker
         # the tracker SHARES the engine's histograms (decode_step_ms is
         # engine-only): one fold per retirement, one source of truth for
         # both the stats() quantiles and the slo_report
@@ -1014,6 +1028,31 @@ class InferenceEngine:
                 ttft_ms=round(state.ttft_ms, 3), e2e_ms=round(e2e_ms, 3),
                 tpot_ms=(round(tpot_ms, 3) if tpot_ms is not None
                          else None))
+        # tier-4: engine-local latency attribution — the three local
+        # components partition e2e exactly (queue + prefill + decode,
+        # with prefill = ttft - queue and decode = e2e - ttft)
+        self._attrib_hists["queue"].add([max(0.0, state.queue_ms)])
+        self._attrib_hists["prefill"].add(
+            [max(0.0, state.ttft_ms - state.queue_ms)])
+        self._attrib_hists["decode"].add([max(0.0, e2e_ms - state.ttft_ms)])
+        self._attrib_n += 1
+        if self._meter is not None:
+            # charge-once-at-retirement: a migrated request's source
+            # engine EVICTS (never retires), so the destination's single
+            # charge covers the whole request — Σ tenants == fleet totals
+            held_s = max(0.0, now - (state.t_submit_ms
+                                     + state.queue_ms)) / 1e3
+            usage = {
+                "flops": modeled_request_flops(
+                    self._n_params, self.cfg.num_layers, self.cfg.hidden,
+                    state.prompt_len, n_gen, state.cached_tokens),
+                "kv_block_s": len(state.blocks) * held_s,
+            }
+            if state.adapter_id and state.request.adapter is not None:
+                usage["adapter_s"] = held_s
+            self._meter.charge(state.request.tenant,
+                               worker=self._meter_worker, t_ms=now,
+                               tokens=n_gen, requests=1, **usage)
         self._completed += 1
         if self._retain_streams:
             self._finished[uid] = state.generated
@@ -1173,6 +1212,11 @@ class InferenceEngine:
                                         scale=scale)
         ms = (time.perf_counter() - t0) * 1e3
         self._adapter_load_ms_total += ms
+        if self._meter is not None:
+            # install time precedes any tenant binding — the _fleet
+            # pseudo-tenant pays (a per-tenant amortization would guess)
+            self._meter.charge("_fleet", worker=self._meter_worker,
+                               adapter_load_ms=ms)
         if self._events is not None:
             self._events.emit("adapter_load", name, slot=slot,
                               load_ms=round(ms, 3))
@@ -1460,6 +1504,22 @@ class InferenceEngine:
                 continue
             out[f"{name}_p50"] = round(h.quantile(0.5), 3)
             out[f"{name}_p99"] = round(h.quantile(0.99), 3)
+        # tier-4 forensics: per-component latency attribution (flat keys,
+        # lower-better under regress) + the plane's own coverage
+        for c, h in self._attrib_hists.items():
+            if h.total == 0:
+                continue
+            out[f"{c}_component_ms_p50"] = round(h.quantile(0.5), 3)
+            out[f"{c}_component_ms_p99"] = round(h.quantile(0.99), 3)
+        if self._completed:
+            out["attrib_coverage"] = round(
+                self._attrib_n / self._completed, 4)
+        if self._meter is not None:
+            m = self._meter.stats(completed=self._completed)
+            out["meter"] = m
+            out["cost_per_token"] = m["cost_per_token"]
+            out["cost_per_request"] = m["cost_per_request"]
+            out["meter_coverage"] = m["meter_coverage"]
         out["prefix_cache"] = {
             "enabled": self.serve_cfg.prefix_cache,
             "blocks_hit": self._prefix_blocks_hit,

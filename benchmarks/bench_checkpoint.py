@@ -69,6 +69,9 @@ def timed(fn) -> float:
 
 
 def main() -> None:
+    from apex_tpu.monitor.sink import collect_provenance, set_provenance
+
+    set_provenance(collect_provenance())
     state = build_state()
     jax.block_until_ready(state)
     root = tempfile.mkdtemp(prefix="bench_ckpt_")

@@ -128,6 +128,9 @@ def run(policy_name):
 
 
 def main():
+    from apex_tpu.monitor.sink import collect_provenance, set_provenance
+
+    set_provenance(collect_provenance())
     rows = {}
     for name in POLICIES:
         r = run(name)

@@ -232,7 +232,9 @@ def main() -> int:
     import argparse
 
     from apex_tpu.monitor import json_record
+    from apex_tpu.monitor.sink import collect_provenance, set_provenance
 
+    set_provenance(collect_provenance())
     ap = argparse.ArgumentParser()
     ap.add_argument("--plan", default="fsdp", choices=["fsdp", "fsdp+tp"])
     ap.add_argument("--out", default=None)

@@ -67,6 +67,9 @@ def main() -> int:
     import time
 
     from apex_tpu.monitor import json_record
+    from apex_tpu.monitor.sink import collect_provenance, set_provenance
+
+    set_provenance(collect_provenance())
     from apex_tpu.ops.fused_update import (
         adam_tail_reference,
         fused_adam_tail,

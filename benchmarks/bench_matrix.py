@@ -314,6 +314,9 @@ def _run_virtual(names, n_devices):
 
 
 def main(argv=None):
+    from apex_tpu.monitor.sink import collect_provenance, set_provenance
+
+    set_provenance(collect_provenance())
     names = list((argv if argv is not None else sys.argv[1:]) or CONFIGS)
     unknown = [n for n in names if n not in CONFIGS]
     for n in unknown:

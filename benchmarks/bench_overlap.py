@@ -105,7 +105,9 @@ def main() -> int:
 
     from apex_tpu.comm import collective_report, overlap_report
     from apex_tpu.monitor import json_record
+    from apex_tpu.monitor.sink import collect_provenance, set_provenance
 
+    set_provenance(collect_provenance())
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default=None)
     args = ap.parse_args()

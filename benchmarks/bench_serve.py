@@ -78,6 +78,9 @@ def main() -> int:
     import tempfile
 
     from apex_tpu.monitor import JsonlSink, json_record, read_jsonl
+    from apex_tpu.monitor.sink import collect_provenance, set_provenance
+
+    set_provenance(collect_provenance())
     from apex_tpu.serve import InferenceEngine, Request, ServeConfig
     from apex_tpu.transformer.testing import GPTConfig, init_gpt_params
 

@@ -278,6 +278,10 @@ def main(argv=None) -> int:
     import jax
     import jax.numpy as jnp
 
+    from apex_tpu.monitor.sink import collect_provenance, set_provenance
+
+    set_provenance(collect_provenance())  # after the pin: backend is final
+
     from apex_tpu.monitor import (
         EventLog,
         JsonlSink,

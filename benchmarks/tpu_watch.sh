@@ -35,10 +35,19 @@
 #  18 serve chaos     bench_serve_mh.py --hosts 3 --chaos
 #                                           -> SERVE_CHAOS_TPU.json
 #  19 observe A/B     bench_observe.py      -> OBSERVE_TPU.json
+#  20 LoRA serve A/B  bench_serve_mh.py --lora -> SERVE_LORA_TPU.json
+#  21 forensics A/B   bench_attrib_cost.py  -> ATTRIB_COST_TPU.json
 # After the first seven, later healthy probes only refresh stage 1+3
-# (hourly) so the banked number tracks the latest code; stages 8-19
+# (hourly) so the banked number tracks the latest code; stages 8-21
 # ride the same hourly cadence until banked (additive evidence that must
 # never hold the suite out of refresh mode).
+#
+# Tier 4 (monitor.trend): every promoted JSON record ALSO appends a
+# trend_point to TREND_HISTORY.jsonl and drift-checks the per-stage
+# series — the longitudinal gate that catches 3%-per-hop drifts the
+# pairwise 15% regress gate structurally cannot. The check runs NEXT TO
+# the regress gates, never instead of them: drift notes loudly in the
+# log but cannot un-promote a record that already passed its stage.
 cd /root/repo || exit 1
 export APEX_TPU_PROBE_NO_CACHE=1
 LOG=/tmp/tpu_health.log
@@ -59,8 +68,25 @@ last_sub8=-3600     # stage-17 (sub-8-bit: int4 KV + comm wire A/B) same
 last_chaos=-3600    # stage-18 (elastic serve chaos: kill-and-migrate) same
 last_observe=-3600  # stage-19 (fleet observability overhead A/B) same
 last_lora=-3600     # stage-20 (per-tenant LoRA serve A/B) same
+last_attrib=-3600   # stage-21 (attribution + cost forensics A/B) same
 
 note() { echo "$(date '+%F %T') $*" >> "$LOG"; }
+
+TREND=TREND_HISTORY.jsonl
+trend_bank() {  # trend_bank <stage-name> <promoted-artifact>
+  # tier-4 longitudinal gate: append the just-promoted record to the
+  # per-stage history, then drift-check the series (monitor.trend:
+  # median+MAD step changes, Theil-Sen slow drifts). Additive only —
+  # a drift is loud evidence in the log, never a reason to claw back a
+  # promotion that already passed its own CPU_FALLBACK/ok/regress gates.
+  local stage=$1 art=$2
+  python -m apex_tpu.monitor.trend append "$TREND" "$art" \
+    --stage "$stage" >> /tmp/tpu_trend.out 2>> /tmp/tpu_trend.err
+  if ! python -m apex_tpu.monitor.trend check "$TREND" --stage "$stage" \
+      > "/tmp/tpu_trend_${stage}.json" 2>> /tmp/tpu_trend.err; then
+    note "TREND DRIFT stage=$stage: $(cat "/tmp/tpu_trend_${stage}.json")"
+  fi
+}
 
 run_stage() {  # run_stage <n> <timeout> <artifact-check-file> <cmd...>
   local n=$1 to=$2 art=$3; shift 3
@@ -107,6 +133,7 @@ PY
   fi
   cp /tmp/bench_try.json BENCH_watch.json
   note "STAGE$n PROMOTED $(cat BENCH_watch.json)"
+  trend_bank bench BENCH_watch.json
   [ $rc -eq 0 ] || return 1
   [ "$(cat "$STATE")" -lt "$n" ] && echo "$n" > "$STATE"
   return 0
@@ -135,6 +162,7 @@ longseq_stage() {
   fi
   cp /tmp/longseq_try.json LONGSEQ_TPU.json
   note "STAGE7 PROMOTED (rc=$rc)"
+  trend_bank longseq LONGSEQ_TPU.json
   [ $rc -eq 0 ] || return 1
   [ "$(cat "$STATE")" -lt 7 ] && echo 7 > "$STATE"
   return 0
@@ -161,6 +189,7 @@ overlap_stage() {
   fi
   cp /tmp/overlap_try.json OVERLAP_TPU.json
   note "STAGE8 PROMOTED $(cat OVERLAP_TPU.json)"
+  trend_bank overlap OVERLAP_TPU.json
   [ $rc -eq 0 ] || return 1
   [ "$(cat "$STATE")" -lt 8 ] && echo 8 > "$STATE"
   return 0
@@ -186,6 +215,7 @@ serve_stage() {
   fi
   cp /tmp/serve_try.json SERVE_TPU.json
   note "STAGE9 PROMOTED $(cat SERVE_TPU.json)"
+  trend_bank serve SERVE_TPU.json
   [ $rc -eq 0 ] || return 1
   # advance only from exactly 8: jumping 7->9 would kill stage 8's
   # hourly retry gates before OVERLAP_TPU.json ever banks (the artifact
@@ -224,6 +254,7 @@ $(cat /tmp/tpu_stage10_regress.out)"
   fi
   cp /tmp/serve_slo_try.json SERVE_SLO_TPU.json
   note "STAGE10 PROMOTED $(cat SERVE_SLO_TPU.json)"
+  trend_bank serve_slo SERVE_SLO_TPU.json
   [ $rc -eq 0 ] || return 1
   # advance only from exactly 9 (same reasoning as stage 9's 8-gate)
   [ "$(cat "$STATE")" -eq 9 ] && echo 10 > "$STATE"
@@ -261,6 +292,7 @@ $(cat /tmp/tpu_stage11_regress.out)"
   fi
   cp /tmp/serve_prefix_try.json SERVE_PREFIX_TPU.json
   note "STAGE11 PROMOTED $(cat SERVE_PREFIX_TPU.json)"
+  trend_bank serve_prefix SERVE_PREFIX_TPU.json
   [ $rc -eq 0 ] || return 1
   # advance only from exactly 10 (same reasoning as stage 9's 8-gate)
   [ "$(cat "$STATE")" -eq 10 ] && echo 11 > "$STATE"
@@ -304,6 +336,7 @@ $(cat /tmp/tpu_stage12_regress.out)"
   fi
   cp /tmp/decode_fused_try.json DECODE_FUSED_TPU.json
   note "STAGE12 PROMOTED $(cat DECODE_FUSED_TPU.json)"
+  trend_bank decode_fused DECODE_FUSED_TPU.json
   [ $rc -eq 0 ] || return 1
   # advance only from exactly 11 (same reasoning as stage 9's 8-gate)
   [ "$(cat "$STATE")" -eq 11 ] && echo 12 > "$STATE"
@@ -338,6 +371,7 @@ $(cat /tmp/tpu_stage13_regress.out)"
   fi
   cp /tmp/fused_update_try.json FUSED_UPDATE_TPU.json
   note "STAGE13 PROMOTED $(cat FUSED_UPDATE_TPU.json)"
+  trend_bank fused_update FUSED_UPDATE_TPU.json
   [ $rc -eq 0 ] || return 1
   [ "$(cat "$STATE")" -eq 12 ] && echo 13 > "$STATE"
   return 0
@@ -381,6 +415,7 @@ $(cat /tmp/tpu_stage14_regress.out)"
   fi
   cp /tmp/fsdp_try.json FSDP_TPU.json
   note "STAGE14 PROMOTED $(cat FSDP_TPU.json)"
+  trend_bank fsdp FSDP_TPU.json
   [ $rc -eq 0 ] || return 1
   [ "$(cat "$STATE")" -eq 13 ] && echo 14 > "$STATE"
   return 0
@@ -424,6 +459,7 @@ $(cat /tmp/tpu_stage15_regress.out)"
   fi
   cp /tmp/serve_mh_try.json SERVE_MH_TPU.json
   note "STAGE15 PROMOTED $(cat SERVE_MH_TPU.json)"
+  trend_bank serve_mh SERVE_MH_TPU.json
   [ $rc -eq 0 ] || return 1
   [ "$(cat "$STATE")" -eq 14 ] && echo 15 > "$STATE"
   return 0
@@ -469,6 +505,7 @@ $(cat /tmp/tpu_stage16_regress.out)"
   fi
   cp /tmp/analyze_try.json ANALYZE_TPU.json
   note "STAGE16 PROMOTED $(cat ANALYZE_TPU.json)"
+  trend_bank analyze ANALYZE_TPU.json
   [ $rc -eq 0 ] || return 1
   [ "$(cat "$STATE")" -eq 15 ] && echo 16 > "$STATE"
   return 0
@@ -515,6 +552,7 @@ sub8_stage() {
     else
       cp /tmp/sub8_comm_try.json COMM_SUB8_TPU.json
       note "STAGE17 banked COMM_SUB8_TPU $(cat COMM_SUB8_TPU.json)"
+      trend_bank comm_sub8 COMM_SUB8_TPU.json
     fi
   fi
   if [ -s SERVE_KV4_TPU.json ]; then
@@ -528,6 +566,7 @@ $(cat /tmp/tpu_stage17_regress.out)"
   fi
   cp /tmp/sub8_try.json SERVE_KV4_TPU.json
   note "STAGE17 PROMOTED $(cat SERVE_KV4_TPU.json)"
+  trend_bank serve_kv4 SERVE_KV4_TPU.json
   [ $rc -eq 0 ] || return 1
   [ "$(cat "$STATE")" -eq 16 ] && echo 17 > "$STATE"
   return 0
@@ -572,6 +611,7 @@ $(cat /tmp/tpu_stage18_regress.out)"
   fi
   cp /tmp/serve_chaos_try.json SERVE_CHAOS_TPU.json
   note "STAGE18 PROMOTED $(cat SERVE_CHAOS_TPU.json)"
+  trend_bank serve_chaos SERVE_CHAOS_TPU.json
   [ $rc -eq 0 ] || return 1
   [ "$(cat "$STATE")" -eq 17 ] && echo 18 > "$STATE"
   return 0
@@ -619,6 +659,7 @@ $(cat /tmp/tpu_stage19_regress.out)"
   fi
   cp /tmp/observe_try.json OBSERVE_TPU.json
   note "STAGE19 PROMOTED $(cat OBSERVE_TPU.json)"
+  trend_bank observe OBSERVE_TPU.json
   [ $rc -eq 0 ] || return 1
   [ "$(cat "$STATE")" -eq 18 ] && echo 19 > "$STATE"
   return 0
@@ -663,8 +704,58 @@ $(cat /tmp/tpu_stage20_regress.out)"
   fi
   cp /tmp/serve_lora_try.json SERVE_LORA_TPU.json
   note "STAGE20 PROMOTED $(cat SERVE_LORA_TPU.json)"
+  trend_bank serve_lora SERVE_LORA_TPU.json
   [ $rc -eq 0 ] || return 1
   [ "$(cat "$STATE")" -eq 19 ] && echo 20 > "$STATE"
+  return 0
+}
+
+attrib_stage() {
+  # stage 21: forensics overhead A/B — bench_attrib_cost.py runs the
+  # multi-tenant loadgen workload through a disaggregated cluster twice
+  # (per-request attribution + per-tenant metering on vs off) and
+  # records tokens/s both sides, forensics_overhead_pct (ok=false past
+  # the 5% budget), attrib_coverage / meter_coverage (must be 1.0),
+  # the queue/prefill/transfer/decode/stall component quantiles,
+  # cost_per_token and the rollup-vs-totals identity. Same promote
+  # rules as stages 10-20: CPU rehearsals never promote (CPU decode
+  # steps flatter the overhead ~10x), ok=false (overhead blown /
+  # coverage hole / rollup mismatch / streams perturbed) never
+  # promotes, REGRESSION-GATED via monitor.regress --tol 0.15 once
+  # banked (component ms / cost_per_token lower-is-better,
+  # attrib_coverage / meter_coverage higher — the new polarity rows);
+  # hourly even after banked so a creeping cost-per-token or a new
+  # stall component surfaces within an hour.
+  note "STAGE21 START: bench_attrib_cost.py"
+  rm -f /tmp/attrib_cost_try.json
+  timeout 1800 python benchmarks/bench_attrib_cost.py \
+    --out /tmp/attrib_cost_try.json \
+    > /tmp/tpu_stage21.out 2> /tmp/tpu_stage21.err
+  local rc=$?
+  note "STAGE21 EXIT=$rc"
+  [ -s /tmp/attrib_cost_try.json ] || return 1
+  if grep -q CPU_FALLBACK /tmp/attrib_cost_try.json; then
+    note "STAGE21 got CPU_FALLBACK, not promoting"
+    return 1
+  fi
+  if grep -Eq '"ok": false' /tmp/attrib_cost_try.json; then
+    note "STAGE21 record has ok false, not promoting"
+    return 1
+  fi
+  if [ -s ATTRIB_COST_TPU.json ]; then
+    if ! python -m apex_tpu.monitor.regress ATTRIB_COST_TPU.json \
+        /tmp/attrib_cost_try.json --tol 0.15 \
+        > /tmp/tpu_stage21_regress.out 2>> /tmp/tpu_stage21.err; then
+      note "STAGE21 REGRESSION vs banked, keeping banked record: \
+$(cat /tmp/tpu_stage21_regress.out)"
+      return 1
+    fi
+  fi
+  cp /tmp/attrib_cost_try.json ATTRIB_COST_TPU.json
+  note "STAGE21 PROMOTED $(cat ATTRIB_COST_TPU.json)"
+  trend_bank attrib_cost ATTRIB_COST_TPU.json
+  [ $rc -eq 0 ] || return 1
+  [ "$(cat "$STATE")" -eq 20 ] && echo 21 > "$STATE"
   return 0
 }
 
@@ -800,6 +891,13 @@ while true; do
           lora_stage
           last_lora=$now
         fi
+        # stage 21 (attribution + cost forensics A/B): same contract —
+        # a forensics tax past 5%, an attribution coverage hole or a
+        # rollup-vs-totals mismatch must surface within an hour
+        if [ $((now - last_attrib)) -ge 3600 ]; then
+          attrib_stage
+          last_attrib=$now
+        fi
         last_refresh=$now
       fi
     else
@@ -910,6 +1008,12 @@ while true; do
           && [ $((now - last_lora)) -ge 3600 ]; then
         lora_stage
         last_lora=$now
+      fi
+      # stage 21: attribution + cost forensics A/B, same contract.
+      if [ "$(cat "$STATE")" -eq 20 ] \
+          && [ $((now - last_attrib)) -ge 3600 ]; then
+        attrib_stage
+        last_attrib=$now
       fi
       last_refresh=$now
     fi
