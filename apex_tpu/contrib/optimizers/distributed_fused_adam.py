@@ -206,24 +206,73 @@ class DistributedFusedAdam:
         return None
 
     # -- checkpointing (the resilience manifest path) ----------------------
-    def state_dict(self, state: DistAdamState) -> dict:
+    def state_dict(self, state: DistAdamState,
+                   params: Optional[Pytree] = None,
+                   dp: Optional[int] = None) -> dict:
         """Sharded state (count + master/moment shards) → flat
         fingerprinted dict. The fingerprint pins the treedef AND every
         shard's shape/dtype, so a checkpoint written at a different dp
         degree or shard alignment (``compression.block_size``) is refused
         at restore instead of silently mis-binding shards — the failure
-        mode ZeRO adds over replicated optimizers."""
+        mode ZeRO adds over replicated optimizers.
+
+        Pass ``params`` + ``dp`` to stamp the :meth:`elastic_spec`
+        manifest into the dict, making it topology-elastic: a restore at
+        a different dp degree becomes legal with ``allow_reshard=True``."""
         from apex_tpu.resilience.checkpoint import state_dict
 
-        return state_dict(state)
+        elastic = None
+        if params is not None:
+            if dp is None:
+                raise ValueError("state_dict(params=...) needs dp= (the dp "
+                                 "degree the shards were built at)")
+            elastic = self.elastic_spec(params, dp)
+        return state_dict(state, elastic=elastic)
 
-    def load_state_dict(self, template: DistAdamState,
-                        d: dict) -> DistAdamState:
-        """Restore onto a live ``init(params)`` structure (same mesh, same
-        dp degree); refuses a fingerprint mismatch."""
+    def load_state_dict(self, template: DistAdamState, d: dict,
+                        allow_reshard: bool = False) -> DistAdamState:
+        """Restore onto a live ``init(params)`` structure; refuses a
+        fingerprint mismatch unless ``allow_reshard=True`` AND the dict
+        carries an elastic manifest (written by ``state_dict(params=...,
+        dp=...)``) — then each shard leaf is re-sliced onto the live dp
+        degree's block-aligned layout (pure arithmetic, bitwise exact;
+        see :mod:`apex_tpu.resilience.reshard`)."""
         from apex_tpu.resilience.checkpoint import load_state_dict
 
-        return load_state_dict(template, d)
+        return load_state_dict(template, d, allow_reshard=allow_reshard)
+
+    def elastic_spec(self, params: Pytree, dp: int) -> DistAdamState:
+        """Per-leaf :class:`~apex_tpu.resilience.reshard.LeafSpec` tree
+        matching :meth:`init`'s state structure: masters/moments are
+        ``dp_flat`` slices of each logical param (size, dp, the
+        compression block multiple), ``count`` is replicated. Pass as
+        ``elastic=`` to ``CheckpointManager.save`` / :meth:`state_dict`."""
+        import math
+
+        from apex_tpu.resilience.reshard import dp_flat_spec, replicated_spec
+
+        mult = _shard_multiple(self.compression)
+        flat = jax.tree.map(
+            lambda p: dp_flat_spec(math.prod(jnp.shape(p)), int(dp), mult),
+            params)
+        return DistAdamState(
+            count=replicated_spec(), master=flat, mu=flat, nu=flat)
+
+    def elastic_comm_spec(self, params: Pytree, dp: int) -> Optional[Pytree]:
+        """Elastic spec for :meth:`init_comm_state`'s EF residuals,
+        checkpointed in the STACKED convention (leaf shape ``(dp, *grad
+        .shape)`` — each rank's residual compensates its OWN quantization
+        error, so the per-rank copies genuinely differ and are saved
+        side-by-side). Across a topology change the leaves are
+        ``dp_stacked``: grown ranks start at zero residual, shrunk ranks
+        fold their predecessors' rows so the rank-SUM — the psum'd EF
+        correction the next step applies — is conserved exactly.
+        ``None`` when EF is off."""
+        if self.compression is None or not self.compression.error_feedback:
+            return None
+        from apex_tpu.resilience.reshard import dp_stacked_spec
+
+        return jax.tree.map(lambda p: dp_stacked_spec(int(dp)), params)
 
     def _global_norm(self, shards) -> jnp.ndarray:
         return _global_norm_shards(shards, self.axis_name)

@@ -82,19 +82,50 @@ class FSDPAdam:
             nu=jax.tree_util.tree_map(jnp.zeros_like, master))
 
     # -- checkpointing (the resilience manifest path) ----------------------
-    def state_dict(self, state: FSDPAdamState) -> dict:
+    def state_dict(self, state: FSDPAdamState,
+                   params: Optional[Pytree] = None,
+                   dp: Optional[int] = None) -> dict:
         """Flat fingerprinted dict via the shared manifest path — the
         fingerprint pins every shard's shape/dtype, so a checkpoint from a
-        different dp degree or shard alignment is refused at restore."""
+        different dp degree or shard alignment is refused at restore.
+
+        Pass ``params`` (the LOGICAL unsharded params) + ``dp`` to stamp
+        the :meth:`elastic_spec` manifest, making the checkpoint
+        topology-elastic (restorable at a different dp degree with
+        ``allow_reshard=True``). Flat-sharded leaves only — the module
+        mode's column shards have no flat-layout spec."""
         from apex_tpu.resilience.checkpoint import state_dict
 
-        return state_dict(state)
+        elastic = None
+        if params is not None:
+            if dp is None:
+                raise ValueError("state_dict(params=...) needs dp= (the dp "
+                                 "degree the shards were built at)")
+            elastic = self.elastic_spec(params, dp)
+        return state_dict(state, elastic=elastic)
 
-    def load_state_dict(self, template: FSDPAdamState,
-                        d: dict) -> FSDPAdamState:
+    def load_state_dict(self, template: FSDPAdamState, d: dict,
+                        allow_reshard: bool = False) -> FSDPAdamState:
         from apex_tpu.resilience.checkpoint import load_state_dict
 
-        return load_state_dict(template, d)
+        return load_state_dict(template, d, allow_reshard=allow_reshard)
+
+    def elastic_spec(self, params: Pytree, dp: int) -> FSDPAdamState:
+        """Per-leaf :class:`~apex_tpu.resilience.reshard.LeafSpec` tree
+        matching :meth:`init`'s state: master/moment shards are
+        ``dp_flat`` slices of each logical param (size, dp, the FSDP
+        shard multiple), ``count`` replicated — same arithmetic ZeRO-1
+        uses, so a dp=N FSDP checkpoint re-slices to dp=M exactly."""
+        import math
+
+        from apex_tpu.resilience.reshard import dp_flat_spec, replicated_spec
+
+        mult = self.fsdp.shard_multiple
+        flat = jax.tree_util.tree_map(
+            lambda p: dp_flat_spec(math.prod(jnp.shape(p)), int(dp), mult),
+            params)
+        return FSDPAdamState(
+            count=replicated_spec(), master=flat, mu=flat, nu=flat)
 
     # -- step --------------------------------------------------------------
     def step(

@@ -131,7 +131,18 @@ _LOWER = ("_ms", "violation", "latency", "bubble", "exposed_bytes",
           # drift score — a rising score means the longitudinal series
           # is walking away from its history
           "_component_ms", "cost_per_token", "cost_per_request",
-          "drift_score")
+          "drift_score",
+          # elastic-training round (stage 22): reshard arithmetic time
+          # (also caught by the generic "_ms" rule; listed so the elastic
+          # gate's coverage is explicit), SDC disagreements and straggler
+          # flags under the SAME deterministic chaos plan (more means the
+          # sentinels got noisier or the fleet sicker), and step retries
+          # (a retry storm is a regression even when every retry
+          # eventually succeeds). elastic_resumes_total is deliberately
+          # NOT listed: how many times a run resumed at a new topology is
+          # the scheduler's business, informational either way
+          "reshard_ms", "sdc_disagreements_total",
+          "straggler_flags_total", "retries_total")
 
 
 def classify_metric(key: str,

@@ -194,13 +194,23 @@ class ParallelismPlan:
         cls = FusedAdam if self.optimizer == "adam" else FusedLAMB
         return cls(lr=lr, **kw)
 
-    def checkpoint_manager(self, directory: str, **kw):
+    def checkpoint_manager(self, directory: str,
+                           allow_reshard: bool = False, **kw):
         """The resilience composition hook: an atomic manifested
         ``CheckpointManager`` — FSDP/ZeRO shard pytrees ride its
-        fingerprinted (per-shard, under multi-process) manifest path."""
+        fingerprinted (per-shard, under multi-process) manifest path.
+
+        ``allow_reshard=True`` opts the manager's restores into the
+        topology-elastic path (:mod:`apex_tpu.resilience.reshard`): a
+        checkpoint saved with an ``elastic=`` spec (the plan's optimizers
+        build one via ``elastic_spec(params, dp)``) restores onto a
+        DIFFERENT dp degree's block-aligned layout, bitwise — the elastic
+        resume `examples/*/--elastic` drives through
+        :class:`~apex_tpu.resilience.TrainSupervisor`."""
         from apex_tpu.resilience import CheckpointManager
 
-        return CheckpointManager(directory, **kw)
+        return CheckpointManager(directory, allow_reshard=allow_reshard,
+                                 **kw)
 
     def gpt_overrides(self) -> dict:
         """``GPTConfig`` fields this plan pins (benchmarks/tests splice

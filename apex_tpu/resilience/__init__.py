@@ -19,17 +19,38 @@ This subsystem is the durability layer:
   treedef fingerprint and per-leaf crc32, async save off the critical
   path, keep-last-N / keep-every-K retention, and :meth:`latest_valid`
   discovery that skips torn/corrupt checkpoints for auto-resume.
+* :mod:`~apex_tpu.resilience.reshard` — topology-elastic restore: the flat
+  block-aligned dp shard layout is a deterministic function of
+  ``(leaf, dp, shard_multiple)``, so a dp=N checkpoint re-slices to a live
+  dp=M topology by pure (bitwise-verifiable) arithmetic — exposed as
+  ``CheckpointManager.restore(..., allow_reshard=True)`` with
+  :class:`LeafSpec` elastic manifests stamped at save time.
+* :mod:`~apex_tpu.resilience.supervisor` — :class:`TrainSupervisor`, the
+  host-side step-loop driver: retry-with-backoff on transient failures,
+  host-side GuardPolicy skip→rollback→halt escalation, preemption →
+  synchronized save → clean exit, and an elastic ``restart.json`` naming
+  the checkpoint + the dp degrees it can legally resume at.
 * :mod:`~apex_tpu.resilience.preemption` — :class:`PreemptionHandler`
   (SIGTERM → multihost-agreed save step → atomic save inside the grace
   window) and :class:`StallWatchdog` (wall-clock step-stall detector that
   dumps thread stacks + a JSONL diagnostic record).
+* :mod:`~apex_tpu.resilience.sentinel` — :class:`StragglerSentinel`
+  (per-rank step-time robust-z through the alert plane) and
+  :class:`SDCSentinel` (cross-replica grad-checksum agreement, rank-
+  uniform by construction, riding the guard ladder).
 * :mod:`~apex_tpu.resilience.chaos` — the deterministic fault-injection
-  harness (NaN at step k, torn/corrupt checkpoints, simulated preemption)
-  the recovery tests drive.
+  harness (NaN at step k, torn/corrupt checkpoints — sharded dirs
+  included, simulated preemption) plus :class:`TrainChaosPlan`, the
+  step-keyed training fault plan (kill/corrupt-shard/slow-rank) the
+  elastic recovery tests drive.
 """
 
 from apex_tpu.resilience.chaos import (  # noqa: F401
+    CorruptShardFile,
+    KillRankAtStep,
     PreemptionAtStep,
+    SlowRank,
+    TrainChaosPlan,
     corrupt_checkpoint,
     corrupt_file,
     inject_nonfinite,
@@ -54,24 +75,58 @@ from apex_tpu.resilience.preemption import (  # noqa: F401
     PreemptionHandler,
     StallWatchdog,
 )
+from apex_tpu.resilience.reshard import (  # noqa: F401
+    LeafSpec,
+    ReshardError,
+    dp_flat_spec,
+    dp_stacked_spec,
+    elastic_manifest,
+    legal_resume_degrees,
+    replicated_spec,
+    spec_like,
+)
+from apex_tpu.resilience.sentinel import (  # noqa: F401
+    SDCSentinel,
+    StragglerSentinel,
+    grad_checksum,
+)
+from apex_tpu.resilience.supervisor import (  # noqa: F401
+    TrainSupervisor,
+)
 
 __all__ = [
     "AnomalyGuard",
     "AnomalyHalted",
     "CheckpointError",
     "CheckpointManager",
+    "CorruptShardFile",
     "GuardPolicy",
     "GuardState",
+    "KillRankAtStep",
+    "LeafSpec",
     "MANIFEST_SCHEMA",
     "PreemptionAtStep",
     "PreemptionHandler",
+    "ReshardError",
+    "SDCSentinel",
+    "SlowRank",
     "StallWatchdog",
+    "StragglerSentinel",
+    "TrainChaosPlan",
+    "TrainSupervisor",
     "corrupt_checkpoint",
     "corrupt_file",
+    "dp_flat_spec",
+    "dp_stacked_spec",
+    "elastic_manifest",
     "fingerprint",
+    "grad_checksum",
     "inject_nonfinite",
+    "legal_resume_degrees",
     "load_state_dict",
     "make_manifest_lie",
     "nonfinite_count",
+    "replicated_spec",
+    "spec_like",
     "state_dict",
 ]

@@ -23,9 +23,10 @@ chaos-test their own train loops.
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
-from typing import Any, Optional
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -108,12 +109,28 @@ def _payload_files(ckpt_dir: str) -> list:
 
 
 def corrupt_checkpoint(ckpt_dir: str, part: str = "payload",
-                       mode: str = "truncate") -> str:
+                       mode: str = "truncate",
+                       shard: Optional[int] = None) -> str:
     """Corrupt one member of a published checkpoint directory so that
     verification must fail. ``part``: ``"payload"`` (largest data file) or
-    ``"manifest"``. Returns the path corrupted."""
+    ``"manifest"``. ``shard``: for a PR-9 SHARDED checkpoint, target
+    process ``shard``'s ``shard-p{K}/`` subdirectory instead of the top
+    level — its payload (or its per-shard manifest) is corrupted, and
+    ``verify()``'s cross-shard crc sweep must reject the whole step so
+    ``latest_valid()`` skips it. Loud ``FileNotFoundError`` when the
+    checkpoint has no such shard dir (a plain checkpoint, or a dp degree
+    that never had that process) — an undetectable fault configuration is
+    a test bug, not a no-op. Returns the path corrupted."""
     from apex_tpu.resilience.checkpoint import MANIFEST_NAME
 
+    if shard is not None:
+        sub = os.path.join(ckpt_dir, f"shard-p{int(shard)}")
+        if not os.path.isdir(sub):
+            raise FileNotFoundError(
+                f"{ckpt_dir} has no shard-p{int(shard)}/ — not a sharded "
+                "checkpoint, or no such process index; this fault would "
+                "be undetectable")
+        ckpt_dir = sub
     if part == "manifest":
         p = os.path.join(ckpt_dir, MANIFEST_NAME)
         if mode == "flip":
@@ -171,3 +188,107 @@ class PreemptionAtStep:
             self.fired = True
             self.handler.trigger()
         return self.fired
+
+
+# -- the step-keyed training fault plan ------------------------------------
+#
+# ``serve/cluster/chaos.py`` gave the SERVING cluster its ordered,
+# deterministic fault plan; this is the same discipline for the training
+# supervisor. Every fault is keyed on the step counter — no randomness, no
+# wall time — and an undetectable configuration fails loudly at fire time
+# instead of silently doing nothing.
+
+
+@dataclasses.dataclass(frozen=True)
+class KillRankAtStep:
+    """Fail-stop ``rank`` at step ``at_step``: the supervisor exits
+    IMMEDIATELY without saving (no grace window — harsher than
+    preemption), leaving a restart manifest that points at the last
+    already-durable checkpoint. The recovery claim under test is the
+    elastic resume: re-launch (possibly at a different dp degree) +
+    :meth:`~apex_tpu.resilience.supervisor.TrainSupervisor.resume`."""
+
+    at_step: int
+    rank: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class CorruptShardFile:
+    """Bit-rot process ``shard``'s ``shard-p{K}/`` member of the latest
+    valid checkpoint at step ``at_step`` (via :func:`corrupt_checkpoint`
+    with ``shard=``): ``verify()``'s cross-shard crc sweep must reject
+    the step and ``latest_valid()`` must fall back to the previous one."""
+
+    at_step: int
+    shard: int = 0
+    part: str = "payload"
+    mode: str = "flip"
+
+
+@dataclasses.dataclass(frozen=True)
+class SlowRank:
+    """Inflate ``rank``'s step time by ``factor`` for ``for_steps`` steps
+    starting at ``at_step`` — the straggler the robust-z sentinel must
+    flag (and a clean fleet must not)."""
+
+    at_step: int
+    rank: int
+    factor: float = 4.0
+    for_steps: int = 1
+
+
+_TRAIN_FAULT_TYPES = (KillRankAtStep, CorruptShardFile, SlowRank)
+
+
+class TrainChaosPlan:
+    """An ordered, deterministic training fault plan (the ``ClusterChaos``
+    architecture). The supervisor calls :meth:`apply` at the top of every
+    step; each fault fires exactly once, at the first step >= its
+    ``at_step``. ``fired`` keeps the (step, fault) ledger for the chaos
+    record."""
+
+    def __init__(self, faults: Sequence[Any]):
+        for f in faults:
+            if not isinstance(f, _TRAIN_FAULT_TYPES):
+                raise TypeError(f"not a training fault: {f!r}")
+            if f.at_step < 0:
+                raise ValueError(f"at_step must be >= 0: {f!r}")
+        self._pending: List[Any] = sorted(faults, key=lambda f: f.at_step)
+        self.fired: List[Tuple[int, Any]] = []
+
+    @property
+    def pending(self) -> int:
+        return len(self._pending)
+
+    def apply(self, supervisor, step_idx: int) -> List[Any]:
+        """Fire every not-yet-fired fault whose ``at_step`` has arrived;
+        returns the faults fired this step."""
+        fired_now: List[Any] = []
+        while self._pending and self._pending[0].at_step <= step_idx:
+            f = self._pending.pop(0)
+            self._fire(supervisor, f, step_idx)
+            self.fired.append((step_idx, f))
+            fired_now.append(f)
+        return fired_now
+
+    def _fire(self, supervisor, f: Any, step_idx: int) -> None:
+        if isinstance(f, KillRankAtStep):
+            supervisor.kill()
+        elif isinstance(f, CorruptShardFile):
+            mgr = getattr(supervisor, "manager", None)
+            latest = mgr.latest_valid() if mgr is not None else None
+            if latest is None:
+                # corrupting nothing proves nothing — fail the plan loudly
+                raise ValueError(
+                    "CorruptShardFile fired but no valid checkpoint has "
+                    "been published yet — schedule it after a save_freq "
+                    "boundary")
+            corrupt_checkpoint(latest, part=f.part, mode=f.mode,
+                               shard=f.shard)
+        elif isinstance(f, SlowRank):
+            supervisor.inject_slow(f.rank, f.factor, f.for_steps)
+
+    def summary(self) -> List[Dict[str, Any]]:
+        """JSON-ready ledger of fired faults (for the bench record)."""
+        return [{"step": step, "fault": type(f).__name__,
+                 **dataclasses.asdict(f)} for step, f in self.fired]

@@ -134,7 +134,8 @@ def _process_info():
         return 0, 1
 
 
-def state_dict(state: Pytree) -> Dict[str, Any]:
+def state_dict(state: Pytree, elastic: Optional[Any] = None
+               ) -> Dict[str, Any]:
     """Pytree → flat fingerprinted dict (the manifest path's in-memory
     form): leaves keyed by flat index plus the structure fingerprint, so a
     restore against different code fails loudly instead of mis-binding.
@@ -144,10 +145,19 @@ def state_dict(state: Pytree) -> Dict[str, Any]:
     ..., "shards": {index_key: array}}``) stamped with the process
     index/count — :func:`load_state_dict` validates the dp degree and
     every shard's placement before rebinding. Only a leaf with no
-    addressable replica-0 shard is refused."""
+    addressable replica-0 shard is refused.
+
+    ``elastic``: an optional per-leaf ``reshard.LeafSpec`` tree (or
+    pre-flattened mapping) stamped into the dict, so a later
+    ``load_state_dict(..., allow_reshard=True)`` at a different dp degree
+    can redo the shard arithmetic instead of refusing."""
     leaves = jax.tree_util.tree_leaves(state)
     pidx, pcount = _process_info()
     out: Dict[str, Any] = {"fingerprint": fingerprint(state), "leaves": {}}
+    if elastic is not None:
+        from apex_tpu.resilience.reshard import elastic_manifest
+
+        out["elastic"] = elastic_manifest(state, elastic)
     host_idx = [i for i, x in enumerate(leaves) if not _is_cross_process(x)]
     fetched = jax.device_get([leaves[i] for i in host_idx])
     for i, h in zip(host_idx, fetched):
@@ -221,32 +231,139 @@ def _restore_sharded_leaf(template_leaf, entry: Dict[str, Any], i: int):
         template_leaf.shape, template_leaf.sharding, arrays)
 
 
-def load_state_dict(template: Pytree, d: Dict[str, Any]) -> Pytree:
+def _treedef_compatible(saved_fp: Optional[str], template: Pytree) -> bool:
+    """True iff ``saved_fp`` names the same tree STRUCTURE as ``template``
+    (the treedef prefix of the fingerprint — per-leaf shapes may differ,
+    which is exactly what an elastic reshard changes)."""
+    if saved_fp is None:
+        return True
+    _, treedef = jax.tree_util.tree_flatten(template)
+    return str(saved_fp).startswith(f"{treedef}|")
+
+
+def _rebind_global(leaf, i: int, full: np.ndarray):
+    """Bind one assembled-and-retargeted GLOBAL array onto the live leaf:
+    slice per live placement + device_put for a cross-process-sharded
+    target, a plain asarray otherwise."""
+    if not _is_cross_process(leaf):
+        return jnp.asarray(full, jnp.result_type(leaf))
+    if tuple(full.shape) != tuple(leaf.shape):
+        raise CheckpointError(
+            f"leaf {i}: resharded global shape {tuple(full.shape)} != "
+            f"live {tuple(leaf.shape)}")
+    arrays = []
+    for s in leaf.addressable_shards:
+        piece = np.ascontiguousarray(full[s.index]).astype(
+            jnp.result_type(leaf), copy=False)
+        arrays.append(jax.device_put(piece, s.device))
+    return jax.make_array_from_single_device_arrays(
+        leaf.shape, leaf.sharding, arrays)
+
+
+def _sharded_layout_skew(leaf, entry: Dict[str, Any]) -> bool:
+    """True iff a ``__sharded__`` entry cannot rebind exactly onto the
+    live leaf: different process count, global shape, or shard placement
+    set. (The fingerprint misses the mesh-slicing case — a (64,) leaf
+    sharded 8-ways and 2-ways fingerprints identically.)"""
+    pidx, pcount = _process_info()
+    if entry.get("process_count") != pcount:
+        return True
+    if list(jnp.shape(leaf)) != list(entry["global_shape"]):
+        return True
+    live_keys = {_index_key(s.index, leaf.shape)
+                 for s in leaf.addressable_shards
+                 if getattr(s, "replica_id", 0) == 0}
+    return set(entry["shards"]) != live_keys
+
+
+def _reshard_entry_leaf(leaf, entry: Dict[str, Any], i: int,
+                        espec: Optional[Dict[str, Any]]):
+    """Elastic restore of one ``__sharded__`` entry onto a live leaf whose
+    layout differs: reassemble the logical leaf from its placements,
+    retarget via the elastic spec when the global shape changed, re-slice
+    to the live placements. Needs the FULL placement set — a
+    multi-process state_dict holds only the local shards, in which case
+    :func:`assemble_leaf`'s coverage check refuses loudly."""
+    from apex_tpu.resilience import reshard as _rs
+
+    full = _rs.assemble_leaf(entry["global_shape"], entry["dtype"],
+                             entry["shards"])
+    if tuple(full.shape) != tuple(jnp.shape(leaf)):
+        if espec is None:
+            raise CheckpointError(
+                f"leaf {i}: saved global shape {entry['global_shape']} != "
+                f"live {list(jnp.shape(leaf))} and the checkpoint carries "
+                "no elastic spec for it — re-save with elastic= (the "
+                "optimizers' elastic_spec()) or restore on the original "
+                "topology")
+        full = _rs.retarget_leaf(full, espec, jnp.shape(leaf))
+    return _rebind_global(leaf, i, full)
+
+
+def load_state_dict(template: Pytree, d: Dict[str, Any],
+                    allow_reshard: bool = False) -> Pytree:
     """Restore a :func:`state_dict` blob onto ``template``'s structure,
     refusing a fingerprint mismatch (and, for per-shard entries, any
-    dp-degree or shard-shape skew against the live sharding)."""
+    dp-degree or shard-shape skew against the live sharding).
+
+    ``allow_reshard=True`` relaxes the refusal for TOPOLOGY skew only:
+    the treedef and leaf count must still match, but leaves whose
+    shard layout (or dp-flat size) changed are reassembled and re-sliced
+    via the dict's ``elastic`` specs (see
+    :mod:`apex_tpu.resilience.reshard`). Without the flag, behavior is
+    byte-for-byte the old refusal."""
     live = fingerprint(template)
     saved = d.get("fingerprint")
+    reshard_mode = False
     if saved is not None and saved != live:
-        raise CheckpointError(
-            "state_dict was written by a different state revision — "
-            f"refusing to mis-bind.\n   saved: {str(saved)[:200]}\n"
-            f"   live:  {live[:200]}")
+        if not allow_reshard:
+            raise CheckpointError(
+                "state_dict was written by a different state revision — "
+                f"refusing to mis-bind.\n   saved: {str(saved)[:200]}\n"
+                f"   live:  {live[:200]}")
+        if not _treedef_compatible(saved, template):
+            raise CheckpointError(
+                "allow_reshard only relaxes per-leaf shard layouts; this "
+                "state_dict has a different tree STRUCTURE — revision "
+                "skew, not topology skew")
+        reshard_mode = True
     leaves, treedef = jax.tree_util.tree_flatten(template)
     if len(d["leaves"]) != len(leaves):
         raise CheckpointError(
             f"state_dict has {len(d['leaves'])} leaves, live structure "
             f"has {len(leaves)}")
+    elastic = d.get("elastic") or {}
     out = []
     for i, leaf in enumerate(leaves):
         entry = d["leaves"][str(i)]
         if isinstance(entry, dict) and entry.get("__sharded__"):
+            if allow_reshard and (
+                    not _is_cross_process(leaf)
+                    or _sharded_layout_skew(leaf, entry)):
+                out.append(_reshard_entry_leaf(leaf, entry, i,
+                                               elastic.get(str(i))))
+                continue
             if not _is_cross_process(leaf):
                 raise CheckpointError(
                     f"leaf {i} was checkpointed as per-process shards but "
                     "the live template is fully addressable — dp-degree "
                     "skew; restore on the original topology")
             out.append(_restore_sharded_leaf(leaf, entry, i))
+        elif reshard_mode and (
+                tuple(np.shape(entry)) != tuple(jnp.shape(leaf))):
+            from apex_tpu.resilience import reshard as _rs
+
+            espec = elastic.get(str(i))
+            if espec is None:
+                raise CheckpointError(
+                    f"leaf {i}: shape changed "
+                    f"{tuple(np.shape(entry))} -> "
+                    f"{tuple(jnp.shape(leaf))} and the state_dict carries "
+                    "no elastic spec for it — save with elastic= or "
+                    "restore on the original topology")
+            full = _rs.retarget_leaf(np.asarray(entry), espec,
+                                     jnp.shape(leaf))
+            out.append(_rebind_global(leaf, i, full))
         else:
             out.append(jnp.asarray(entry, jnp.result_type(leaf)))
     return jax.tree_util.tree_unflatten(treedef, out)
@@ -295,8 +412,12 @@ class CheckpointManager:
         sink: Optional[Any] = None,
         process0_only: bool = True,
         shard_publish_timeout_s: float = 60.0,
+        allow_reshard: bool = False,
     ):
         self.directory = os.path.abspath(directory)
+        # default for restore(): opt into topology-elastic restores (a
+        # per-call allow_reshard= overrides)
+        self.allow_reshard = bool(allow_reshard)
         self.keep_last_n = max(1, int(keep_last_n))
         self.keep_every_k = max(0, int(keep_every_k))
         self.async_save = async_save
@@ -318,6 +439,10 @@ class CheckpointManager:
         self._save_seq = 0
         self.last_save_ms: Optional[float] = None
         self.last_save_bytes: Optional[int] = None
+        # host ms spent in the reshard arithmetic of the last elastic
+        # restore (0.0 when the last restore bound exactly) — the
+        # bench_elastic reshard_ms source
+        self.last_reshard_ms: float = 0.0
         self._pool: Optional[ThreadPoolExecutor] = None
         self._pending: List[Future] = []
         self._lock = threading.Lock()
@@ -334,8 +459,8 @@ class CheckpointManager:
         return sorted(s for s in steps if s is not None)
 
     # -- save --------------------------------------------------------------
-    def save(self, state: Pytree, step: int, block: Optional[bool] = None
-             ) -> str:
+    def save(self, state: Pytree, step: int, block: Optional[bool] = None,
+             elastic: Optional[Any] = None) -> str:
         """Write ``state`` at ``step``; returns the (future) final path.
 
         ``block=None`` follows the manager's ``async_save`` setting. Only
@@ -344,6 +469,13 @@ class CheckpointManager:
         the atomic publish run on the worker thread. Errors from an async
         save surface on the next :meth:`save` / :meth:`wait` /
         :meth:`close`.
+
+        ``elastic``: optional per-leaf ``reshard.LeafSpec`` tree (or
+        pre-flattened mapping) stamped into the manifest so
+        :meth:`restore` with ``allow_reshard=True`` can rebuild the state
+        at a DIFFERENT dp degree (see
+        :mod:`apex_tpu.resilience.reshard`; the ZeRO-1/FSDP optimizers
+        build it via ``elastic_spec()``).
         """
         from apex_tpu.monitor.trace import span
 
@@ -422,6 +554,10 @@ class CheckpointManager:
             "fingerprint": fingerprint(state),
             "num_leaves": len(leaves),
         }
+        if elastic is not None:
+            from apex_tpu.resilience.reshard import elastic_manifest
+
+            meta["elastic"] = elastic_manifest(state, elastic)
         if shard_entries:
             sharded = {}
             for i, _, _ in shard_entries:
@@ -667,15 +803,17 @@ class CheckpointManager:
                 f"{path}: payload is missing leaf {e} of "
                 f"{len(entries)}") from e
 
-    def _load_shard_dir(self, path: str, manifest: Dict[str, Any]):
-        """This process's shard payload of a sharded checkpoint:
-        ``{leaf_index: {index_key: np.ndarray}}`` after verifying the
-        shard manifest + per-shard crc32s; raises CheckpointError on a
-        missing/torn shard dir (a crash between process 0's publish and
-        this process's shard rename)."""
+    def _load_shard_dir(self, path: str, manifest: Dict[str, Any],
+                        pidx: Optional[int] = None):
+        """One process's shard payload of a sharded checkpoint (default:
+        this process's): ``{leaf_index: {index_key: np.ndarray}}`` after
+        verifying the shard manifest + per-shard crc32s; raises
+        CheckpointError on a missing/torn shard dir (a crash between
+        process 0's publish and this process's shard rename)."""
         from apex_tpu.utils.checkpoint import load_checkpoint
 
-        pidx, _ = _process_info()
+        if pidx is None:
+            pidx, _ = _process_info()
         sub = os.path.join(path, f"shard-p{pidx}")
         try:
             with open(os.path.join(sub, MANIFEST_NAME)) as f:
@@ -732,21 +870,25 @@ class CheckpointManager:
             if _crc(h) != spec["crc32"]:
                 raise CheckpointError(
                     f"{path}: leaf {i} fails its crc32 — corrupt payload")
-        shards = None
+        by_proc = None
         if manifest.get("sharded"):
-            self._check_all_shard_dirs(path, manifest)
-            shards, _ = self._load_shard_dir(path, manifest)
-        return manifest, host, shards
+            by_proc = self._check_all_shard_dirs(path, manifest)
+        return manifest, host, by_proc
 
-    def _check_all_shard_dirs(self, path: str,
-                              manifest: Dict[str, Any]) -> None:
-        """EVERY process's shard dir must be present and step-consistent.
-        Checked by every process (not just for its own shard) so all ranks
-        reach the same verify()/latest_valid() verdict — a torn save makes
-        the whole job fall back to the previous checkpoint instead of rank
-        K alone restoring older state and diverging from its peers."""
+    def _check_all_shard_dirs(self, path: str, manifest: Dict[str, Any]
+                              ) -> Dict[int, Dict[int, Dict[str, Any]]]:
+        """EVERY process's shard dir must be present, step-consistent AND
+        pass its own manifest's per-shard crc32s. Checked by every process
+        (not just for its own shard) so all ranks reach the same
+        verify()/latest_valid() verdict — a torn or bit-rotted shard dir
+        (even another rank's) makes the whole job fall back to the
+        previous checkpoint instead of rank K alone restoring older state
+        and diverging from its peers. Returns the verified payloads keyed
+        by process index — restore's exact path uses its own, the elastic
+        reshard path assembles from all of them."""
         degree = max(int(s["dp_degree"])
                      for s in manifest["sharded"].values())
+        by_proc: Dict[int, Dict[int, Dict[str, Any]]] = {}
         for p in range(degree):
             sub = os.path.join(path, f"shard-p{p}")
             try:
@@ -761,6 +903,8 @@ class CheckpointManager:
                 raise CheckpointError(
                     f"{sub}: shard dir step {sm.get('step')} != manifest "
                     f"step {manifest['step']} — stale shard dir")
+            by_proc[p], _ = self._load_shard_dir(path, manifest, pidx=p)
+        return by_proc
 
     def latest_valid(self) -> Optional[str]:
         """Path of the newest checkpoint that verifies; torn or corrupt
@@ -777,19 +921,41 @@ class CheckpointManager:
         return None
 
     # -- restore -----------------------------------------------------------
-    def restore(self, target: Pytree, path: Optional[str] = None
-                ) -> Tuple[Pytree, int]:
+    @staticmethod
+    def _merged_shards(by_proc, leaf_idx: int) -> Dict[str, Any]:
+        """Every process's placements of one leaf, merged (the elastic
+        assembly input — replica-0 placements are disjoint by
+        construction; overlap is caught downstream by assemble_leaf)."""
+        merged: Dict[str, Any] = {}
+        for shards in (by_proc or {}).values():
+            merged.update(shards.get(leaf_idx, {}))
+        return merged
+
+    def restore(self, target: Pytree, path: Optional[str] = None,
+                allow_reshard: Optional[bool] = None) -> Tuple[Pytree, int]:
         """Load a checkpoint onto ``target``'s structure; returns
         ``(state, step)``. ``path=None`` discovers :meth:`latest_valid`.
         The manifest fingerprint must match ``target``'s — a checkpoint
-        from a different train-state revision is refused, not mis-bound."""
+        from a different train-state revision is refused, not mis-bound.
+
+        ``allow_reshard`` (default: the manager's constructor setting)
+        relaxes the refusal for TOPOLOGY skew only: the treedef and leaf
+        count must still match, but leaves whose dp shard layout changed
+        are reassembled from EVERY process's crc-verified shard dir and
+        re-sliced onto the live layout via the manifest's ``elastic``
+        specs (:mod:`apex_tpu.resilience.reshard`) — save at dp=N,
+        resume at dp=M. The host ms spent resharding lands on
+        :attr:`last_reshard_ms`. Without the flag the old loud refusal is
+        unchanged."""
+        allow = (self.allow_reshard if allow_reshard is None
+                 else bool(allow_reshard))
         if path is None:
             path = self.latest_valid()
             if path is None:
                 raise CheckpointError(
                     f"no valid checkpoint under {self.directory}")
         try:
-            manifest, host, shards = self._verify_or_raise(path)
+            manifest, host, by_proc = self._verify_or_raise(path)
         except CheckpointError:
             raise
         except Exception as e:
@@ -798,25 +964,60 @@ class CheckpointManager:
             raise CheckpointError(
                 f"'{path}' is not a readable checkpoint "
                 f"({type(e).__name__}: {e})") from e
+        self.last_reshard_ms = 0.0
         live = fingerprint(target)
-        if manifest["fingerprint"] != live:
-            raise CheckpointError(
-                f"checkpoint '{path}' was written by a different "
-                "train-state revision — refusing to mis-bind state.\n"
-                f"   saved: {manifest['fingerprint'][:200]}...\n"
-                f"   live:  {live[:200]}...")
+        reshard_mode = manifest["fingerprint"] != live
+        if reshard_mode:
+            if not allow:
+                raise CheckpointError(
+                    f"checkpoint '{path}' was written by a different "
+                    "train-state revision — refusing to mis-bind state.\n"
+                    f"   saved: {manifest['fingerprint'][:200]}...\n"
+                    f"   live:  {live[:200]}...")
+            if not _treedef_compatible(manifest["fingerprint"], target):
+                raise CheckpointError(
+                    f"checkpoint '{path}': allow_reshard only relaxes "
+                    "per-leaf shard layouts; this checkpoint has a "
+                    "different tree STRUCTURE — revision skew, not "
+                    "topology skew")
         leaves, treedef = jax.tree_util.tree_flatten(target)
+        if reshard_mode and manifest.get("num_leaves") != len(leaves):
+            raise CheckpointError(
+                f"checkpoint '{path}' has {manifest.get('num_leaves')} "
+                f"leaves, live structure has {len(leaves)}")
         sharded = manifest.get("sharded", {})
-        if not sharded:
+        if not sharded and not reshard_mode:
             state = jax.tree_util.tree_unflatten(
                 treedef, [jnp.asarray(h) for h in host])
             return state, int(manifest["step"])
+        pidx, _ = _process_info()
+        shards = (by_proc or {}).get(pidx) or {}
+        elastic = manifest.get("elastic") or {}
         by_idx = {e.get("leaf_index", j): h
                   for j, (e, h) in enumerate(zip(manifest["leaves"], host))}
+        from apex_tpu.resilience import reshard as _rs
+
         out = []
         for i, leaf in enumerate(leaves):
             if str(i) in sharded:
                 spec = sharded[str(i)]
+                entry = {
+                    "__sharded__": True,
+                    "global_shape": spec["global_shape"],
+                    "dtype": spec["dtype"],
+                    "process_count": spec["dp_degree"],
+                    "shards": shards.get(i, {}),
+                }
+                if allow and (not _is_cross_process(leaf)
+                              or _sharded_layout_skew(leaf, entry)):
+                    t0 = time.perf_counter()
+                    out.append(_reshard_entry_leaf(
+                        leaf,
+                        dict(entry, shards=self._merged_shards(by_proc, i)),
+                        i, elastic.get(str(i))))
+                    self.last_reshard_ms += (
+                        time.perf_counter() - t0) * 1000.0
+                    continue
                 if not _is_cross_process(leaf):
                     raise CheckpointError(
                         f"{path}: leaf {i} was saved as per-process shards "
@@ -824,16 +1025,33 @@ class CheckpointManager:
                         f"{spec['dp_degree']}) but the live target is "
                         "fully addressable — dp-degree skew; restore on "
                         "the original topology")
-                entry = {
-                    "__sharded__": True,
-                    "global_shape": spec["global_shape"],
-                    "dtype": spec["dtype"],
-                    "process_count": spec["dp_degree"],
-                    "shards": shards[i],
-                }
                 out.append(_restore_sharded_leaf(leaf, entry, i))
             else:
-                out.append(jnp.asarray(by_idx[i]))
+                h = by_idx[i]
+                shape_skew = tuple(h.shape) != tuple(jnp.shape(leaf))
+                if reshard_mode and shape_skew:
+                    espec = elastic.get(str(i))
+                    if espec is None:
+                        raise CheckpointError(
+                            f"{path}: leaf {i} shape changed "
+                            f"{tuple(h.shape)} -> "
+                            f"{tuple(jnp.shape(leaf))} and the checkpoint "
+                            "carries no elastic spec for it — re-save "
+                            "with elastic= (the optimizers' "
+                            "elastic_spec()) or restore on the original "
+                            "topology")
+                    t0 = time.perf_counter()
+                    full = _rs.retarget_leaf(h, espec, jnp.shape(leaf))
+                    self.last_reshard_ms += (
+                        time.perf_counter() - t0) * 1000.0
+                    out.append(_rebind_global(leaf, i, full))
+                elif reshard_mode and _is_cross_process(leaf):
+                    # plain-saved leaf binding onto a sharded live layout
+                    # (e.g. a replicated leaf the new topology shards):
+                    # pure placement retarget, no arithmetic needed
+                    out.append(_rebind_global(leaf, i, np.asarray(h)))
+                else:
+                    out.append(jnp.asarray(h))
         return (jax.tree_util.tree_unflatten(treedef, out),
                 int(manifest["step"]))
 

@@ -1,0 +1,188 @@
+"""Straggler and silent-data-corruption sentinels for the train loop.
+
+Two failure modes the guard ladder cannot see on its own:
+
+* **Stragglers** — a rank that still makes progress but 4× slower than
+  its peers (thermal throttling, a sick host, a noisy neighbour) drags
+  the whole synchronous step down without ever producing a NaN. The
+  :class:`StragglerSentinel` is a host-side robust-z detector over the
+  per-rank step-time gauge: median + MAD across ranks, flag a rank whose
+  modified z-score clears the threshold AND whose time clears a relative
+  slack (so microsecond jitter on a fast step never flags). Flags count
+  into ``straggler_flags_total`` and fire through the PR-14 alert plane
+  (``AlertEngine.fire`` — the external-detector one-shot entry), so a
+  straggler pages exactly like an SLO burn.
+
+* **Silent data corruption** — a chip that flips bits without faulting
+  poisons the run through the grads while every value stays finite
+  (fleet-scale SDC is routine at TPU-pod scale). The :class:`SDCSentinel`
+  is a periodic cross-replica agreement check: after the grad psum the
+  gradients are identical on every rank BY CONSTRUCTION, so a rank-local
+  f32 checksum all-gathered to a ``(dp,)`` vector must be constant — any
+  spread means a rank computed different bytes. The disagreement flag is
+  computed from the SAME gathered vector on every rank, so it is
+  rank-uniform by construction (no desynchronized branches), counts into
+  ``sdc_disagreements_total``, and feeds the guard ladder through
+  ``AnomalyGuard.check(found_inf=flag)`` — a corrupting chip trips
+  skip → rollback → halt instead of silently walking the loss away.
+
+Both are zero-false-positive on a clean run: identical step times give
+MAD 0 and no flags; identical post-psum grads give spread 0.
+:meth:`SDCSentinel.disagreement` is the stock-jax-safe core (pure math on
+a ``(dp,)`` array); :meth:`SDCSentinel.check` adds the in-graph
+``all_gather`` for real mesh programs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from apex_tpu.parallel.mesh import DP_AXIS
+
+Pytree = Any
+
+__all__ = ["SDCSentinel", "StragglerSentinel", "grad_checksum"]
+
+
+def grad_checksum(grads: Pytree) -> jnp.ndarray:
+    """Deterministic f32 checksum of a grad pytree: Σ leaf-sums. Cheap
+    (fuses into the sweep that already reads the leaves), and identical
+    across ranks whenever the grads are — the SDC agreement quantity."""
+    leaves = [x for x in jax.tree_util.tree_leaves(grads)
+              if jnp.issubdtype(jnp.result_type(x), jnp.inexact)]
+    if not leaves:
+        return jnp.float32(0.0)
+    return sum(jnp.sum(x.astype(jnp.float32)) for x in leaves)
+
+
+@dataclasses.dataclass(frozen=True)
+class SDCSentinel:
+    """Cross-replica grad-checksum agreement (static config; pure
+    methods — the guard/scaler architecture).
+
+    ``axis_name``: the dp mesh axis the check gathers over.
+    ``every``: check period in steps (the checksum itself is nearly
+    free; the knob exists so the gather can be amortized on latency-bound
+    multi-host meshes).
+    ``tol``: absolute spread tolerated before flagging — 0.0 for the
+    post-psum case (bitwise-identical by construction); set a small
+    epsilon only if the checksum is computed pre-reduction.
+    """
+
+    axis_name: str = DP_AXIS
+    every: int = 1
+    tol: float = 0.0
+
+    def __post_init__(self):
+        if self.every < 1:
+            raise ValueError(f"every must be >= 1, got {self.every}")
+        if self.tol < 0:
+            raise ValueError(f"tol must be >= 0, got {self.tol}")
+
+    @staticmethod
+    def disagreement(checksums: jnp.ndarray,
+                     tol: float = 0.0) -> jnp.ndarray:
+        """f32 0/1 flag from the gathered ``(dp,)`` checksum vector —
+        rank-uniform because every rank evaluates the same reduction of
+        the same gathered values. NaN-safe: a non-finite checksum on any
+        rank also flags (it cannot agree with anything)."""
+        checksums = jnp.asarray(checksums, jnp.float32)
+        spread = jnp.max(checksums) - jnp.min(checksums)
+        bad = (spread > tol) | ~jnp.isfinite(spread)
+        return bad.astype(jnp.float32)
+
+    def check(
+        self,
+        grads: Pytree,
+        step: Optional[jnp.ndarray] = None,
+        metrics: Optional[Any] = None,
+    ) -> Union[jnp.ndarray, Tuple[jnp.ndarray, Any]]:
+        """In-graph check (call inside the mesh program, AFTER the grad
+        psum/reduce-scatter consumed the same tensors): returns the
+        rank-uniform f32 0/1 disagreement flag, gated to fire only on
+        ``step % every == 0`` steps when ``step`` is passed. With
+        ``metrics``, accumulates ``sdc_disagreements_total`` and returns
+        ``(flag, metrics)``. Feed the flag to
+        ``AnomalyGuard.check(found_inf=...)`` to ride the ladder."""
+        local = grad_checksum(grads)
+        sums = lax.all_gather(local, self.axis_name)
+        flag = self.disagreement(sums, self.tol)
+        if step is not None and self.every > 1:
+            due = (jnp.asarray(step) % self.every) == 0
+            flag = jnp.where(due, flag, 0.0)
+        if metrics is not None:
+            return flag, metrics.accumulate(sdc_disagreements_total=flag)
+        return flag
+
+
+class StragglerSentinel:
+    """Host-side per-rank step-time straggler detector (robust z over the
+    cross-rank distribution at each step).
+
+    ``threshold``: modified z-score (0.6745·dev/MAD) above which a rank
+    flags. ``slack``: the rank's time must ALSO exceed ``slack ×
+    median`` — the absolute guard that keeps MAD-relative jitter on a
+    fast step from flagging. ``min_ranks``: below this many ranks the
+    median is meaningless and the sentinel stays quiet.
+
+    ``alerts``: an optional :class:`apex_tpu.monitor.alerts.AlertEngine`
+    — each flag fires a one-shot ``straggler`` alert with the rank and
+    times in context (the PR-14 external-detector entry). ``sink``: an
+    optional monitor JSONL sink for a per-flag record.
+    """
+
+    def __init__(self, threshold: float = 4.0, slack: float = 1.5,
+                 min_ranks: int = 3, alerts: Optional[Any] = None,
+                 sink: Optional[Any] = None):
+        if threshold <= 0 or slack < 1.0:
+            raise ValueError(
+                f"threshold must be > 0 and slack >= 1.0, got "
+                f"{threshold}/{slack}")
+        self.threshold = float(threshold)
+        self.slack = float(slack)
+        self.min_ranks = int(min_ranks)
+        self.alerts = alerts
+        self.sink = sink
+        self.flags_total = 0
+        self.flagged: List[Tuple[int, int, float, float]] = []
+
+    def observe(self, step: int, rank_times: Sequence[float]) -> List[int]:
+        """One step's per-rank wall times (seconds); returns the flagged
+        rank indices (usually empty). Zero false positives on a uniform
+        fleet: identical times give deviation 0 everywhere."""
+        times = np.asarray(list(rank_times), dtype=np.float64)
+        if times.size < self.min_ranks or not np.all(np.isfinite(times)):
+            return []
+        med = float(np.median(times))
+        if med <= 0.0:
+            return []
+        mad = float(np.median(np.abs(times - med)))
+        # MAD collapses to 0 when >half the ranks tie (the common clean
+        # case AND the one-outlier case) — fall back to a small fraction
+        # of the median so a genuine outlier still scores, while exact
+        # ties score z=0
+        scale = mad if mad > 0.0 else 0.01 * med
+        out = []
+        for r, t in enumerate(times):
+            z = 0.6745 * (t - med) / scale
+            if z > self.threshold and t > self.slack * med:
+                out.append(r)
+        for r in out:
+            self.flags_total += 1
+            self.flagged.append((int(step), r, float(times[r]), med))
+            if self.alerts is not None:
+                self.alerts.fire(
+                    "straggler", float(step), severity="warn", rank=r,
+                    step_time_s=float(times[r]), median_s=med)
+            if self.sink is not None:
+                self.sink.write(step=int(step), straggler_rank=r,
+                                step_time_s=float(times[r]),
+                                median_step_time_s=med,
+                                straggler_flags_total=self.flags_total)
+        return out

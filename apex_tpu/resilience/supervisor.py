@@ -1,0 +1,389 @@
+"""TrainSupervisor — the host-side driver that makes a step loop elastic.
+
+Reference context: the reference stack's "supervisor" is torchrun's
+``--max-restarts`` — a process-level hammer that re-execs the whole job and
+relies on the user's hand-rolled resume code. This module is the train-side
+counterpart of ``ServeCluster.step``: ONE object owns the step loop and
+wires the resilience tiers together so every failure path is a tested
+state-machine transition, not an exception stack unwinding through user
+code:
+
+* **retry with backoff** — a transient step failure (flaky host collective,
+  an input-pipeline hiccup) is retried up to ``max_retries`` times with
+  exponential backoff before the ladder is consulted.
+  :class:`~apex_tpu.resilience.guard.AnomalyHalted` (the in-graph guard
+  already escalated), ``KeyboardInterrupt`` and ``SystemExit`` are never
+  treated as transient.
+* **escalation ladder** — retries exhausted → the supervisor walks the same
+  :class:`~apex_tpu.resilience.guard.GuardPolicy` skip→rollback→halt ladder
+  the in-graph guard uses, but host-side: *skip* drops the step (state
+  unchanged), *rollback* restores ``latest_valid()`` through the manager,
+  *halt* writes a restart manifest and raises ``AnomalyHalted``.
+  Consecutive-failure counters reset on every clean step, mirroring
+  ``GuardState``.
+* **preemption** — SIGTERM lands in the
+  :class:`~apex_tpu.resilience.preemption.PreemptionHandler`; the loop
+  polls ``sync_save_step`` once per step, performs the synchronized save
+  (``block=True``), writes the restart manifest and exits cleanly inside
+  the grace window.
+* **elastic restart manifest** — every non-running exit (preempted, killed,
+  halted, completed-with-checkpoints) leaves ``restart.json`` next to the
+  checkpoints naming the checkpoint to resume from, the dp degree it was
+  written at, and — when an elastic spec is attached — the dp degrees it
+  can LEGALLY resume at (:func:`~apex_tpu.resilience.reshard
+  .legal_resume_degrees`), so an elastic scheduler re-launches on whatever
+  slice it got back and calls :meth:`TrainSupervisor.resume` with
+  ``allow_reshard=True``.
+* **chaos hooks** — ``clock``/``sleep`` are injectable (manual clock, no
+  real sleeps in tests) and a :class:`~apex_tpu.resilience.chaos
+  .TrainChaosPlan` fires step-keyed faults through :meth:`kill` /
+  :meth:`inject_slow` / the manager, exactly like ``ServeCluster``'s
+  ``ClusterChaos``.
+
+Sentinels ride along: a :class:`~apex_tpu.resilience.sentinel
+.StragglerSentinel` gets the per-rank step-time gauge every step (chaos
+``SlowRank`` inflates the injected rank's time), and the in-graph SDC check
+lives inside ``step_fn`` where the grads are.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from apex_tpu._logging import get_logger
+from apex_tpu.resilience.guard import AnomalyHalted, GuardPolicy
+from apex_tpu.resilience.reshard import legal_resume_degrees
+
+Pytree = Any
+
+RESTART_NAME = "restart.json"
+
+_NON_TRANSIENT = (AnomalyHalted, KeyboardInterrupt, SystemExit)
+
+
+class TrainSupervisor:
+    """Drives ``step_fn(state, step) -> state`` with retries, escalation,
+    preemption and elastic restart manifests.
+
+    ``step_fn``: one training step; raises on failure. ``manager``: a
+    :class:`~apex_tpu.resilience.checkpoint.CheckpointManager` (required
+    for rollback, periodic saves and restart manifests). ``policy``: the
+    GuardPolicy reused as HOST-side escalation config (entry rung +
+    budgets). ``elastic``: a spec tree / flat mapping for
+    :func:`~apex_tpu.resilience.reshard.elastic_manifest` — stamped into
+    every save and the restart manifest so the checkpoint is resharding-
+    capable. ``dp_degree``: the live dp degree (recorded in the manifest;
+    also the fan-out of the per-rank step-time gauge). ``save_freq``:
+    checkpoint every N clean steps (0 = only on preemption/halt).
+    ``max_retries``/``backoff_s``: transient-failure retry knobs —
+    ``sleep`` is only called when ``backoff_s > 0``, and both ``clock``
+    and ``sleep`` are injectable so chaos tests run on a manual clock
+    with no real sleeps.
+    """
+
+    def __init__(
+        self,
+        step_fn: Callable[[Pytree, int], Pytree],
+        manager: Optional[Any] = None,
+        *,
+        policy: Optional[GuardPolicy] = None,
+        preemption: Optional[Any] = None,
+        elastic: Optional[Any] = None,
+        dp_degree: int = 1,
+        save_freq: int = 0,
+        max_retries: int = 2,
+        backoff_s: float = 0.0,
+        clock: Callable[[], float] = time.monotonic,
+        sleep: Callable[[float], None] = time.sleep,
+        chaos: Optional[Any] = None,
+        straggler: Optional[Any] = None,
+        sink: Optional[Any] = None,
+    ):
+        if dp_degree < 1:
+            raise ValueError(f"dp_degree must be >= 1, got {dp_degree}")
+        if max_retries < 0 or backoff_s < 0 or save_freq < 0:
+            raise ValueError("max_retries, backoff_s and save_freq must "
+                             "be >= 0")
+        self.step_fn = step_fn
+        self.manager = manager
+        self.policy = policy or GuardPolicy()
+        self.preemption = preemption
+        self.elastic = elastic
+        self.dp_degree = int(dp_degree)
+        self.save_freq = int(save_freq)
+        self.max_retries = int(max_retries)
+        self.backoff_s = float(backoff_s)
+        self.clock = clock
+        self.sleep = sleep
+        self.chaos = chaos
+        self.straggler = straggler
+        self.sink = sink
+        self.log = get_logger("apex_tpu.resilience")
+
+        self.counters: Dict[str, int] = {
+            "steps_total": 0, "retries_total": 0, "skips_total": 0,
+            "rollbacks_total": 0, "saves_total": 0,
+            "elastic_resumes_total": 0,
+        }
+        self.exited: Optional[str] = None  # "completed"|"preempted"|"killed"
+        self._killed = False
+        self._slow: Dict[int, Tuple[float, int]] = {}  # rank → (factor, left)
+        self._consecutive_failed = 0
+        self._consecutive_rollbacks = 0
+
+    # -- chaos entry points ------------------------------------------------
+    def kill(self) -> None:
+        """Hard-kill this rank at the next step boundary: the loop exits
+        WITHOUT saving (harsher than preemption — no grace window), and
+        the restart manifest points at ``latest_valid()``. What chaos
+        ``KillRankAtStep`` fires."""
+        self._killed = True
+
+    def inject_slow(self, rank: int, factor: float, for_steps: int) -> None:
+        """Inflate ``rank``'s reported step time by ``factor`` for the
+        next ``for_steps`` steps (chaos ``SlowRank`` — consumed by the
+        straggler sentinel through the per-rank gauge)."""
+        if not (0 <= rank < self.dp_degree):
+            raise ValueError(
+                f"SlowRank rank {rank} out of range for dp={self.dp_degree}")
+        self._slow[int(rank)] = (float(factor), int(for_steps))
+
+    # -- the loop ----------------------------------------------------------
+    def run(self, state: Pytree, start_step: int = 0,
+            num_steps: int = 1) -> Tuple[Pytree, int]:
+        """Run up to ``num_steps`` steps from ``start_step``; returns
+        ``(state, next_step)`` — ``next_step`` is where a resume should
+        continue. Check :attr:`exited` for why the loop ended."""
+        self.exited = None
+        step = int(start_step)
+        end = step + int(num_steps)
+        while step < end:
+            if self.chaos is not None:
+                self.chaos.apply(self, step)
+            if self._killed:
+                # killed ranks get no save: the manifest points at the
+                # last checkpoint that was already durable
+                self.exited = "killed"
+                self._write_restart(self._latest(), step, reason="killed")
+                self.log.warning(
+                    "rank killed at step %d — exiting without save; "
+                    "resume from %s", step, self._latest())
+                return state, step
+            t0 = self.clock()
+            try:
+                state = self._attempt(state, step)
+            except AnomalyHalted:
+                self._write_restart(self._latest(), step, reason="halted")
+                raise
+            except _EscalationNeeded as esc:
+                state, moved = self._escalate(state, step, esc.cause)
+                if not moved:
+                    continue  # rolled back — retry the same step range
+            else:
+                self._consecutive_failed = 0
+                self._consecutive_rollbacks = 0
+            self.counters["steps_total"] += 1
+            self._observe_times(step, self.clock() - t0)
+            step += 1
+            if (self.manager is not None and self.save_freq
+                    and step % self.save_freq == 0):
+                self._save(state, step)
+            if self.preemption is not None:
+                save_at = self.preemption.sync_save_step(step)
+                if save_at is not None:
+                    if self.manager is not None:
+                        self._save(state, save_at + 1, block=True)
+                    self.exited = "preempted"
+                    self._write_restart(
+                        self._latest(), save_at + 1, reason="preempted")
+                    self.log.warning(
+                        "preempted at step %d — synchronized save done, "
+                        "exiting inside the grace window", save_at)
+                    return state, save_at + 1
+        self.exited = "completed"
+        if self.manager is not None and self._latest() is not None:
+            self._write_restart(self._latest(), step, reason="completed")
+        return state, step
+
+    def _attempt(self, state: Pytree, step: int) -> Pytree:
+        """One step with the transient-retry loop; raises
+        :class:`_EscalationNeeded` when retries are exhausted."""
+        attempt = 0
+        while True:
+            try:
+                return self.step_fn(state, step)
+            except _NON_TRANSIENT:
+                raise
+            # anything else is treated as transient (flaky I/O, preempted
+            # collectives) and retried up to max_retries before escalating
+            except Exception as exc:
+                attempt += 1
+                self.counters["retries_total"] += 1
+                if attempt > self.max_retries:
+                    raise _EscalationNeeded(exc) from exc
+                if self.backoff_s > 0:
+                    self.sleep(self.backoff_s * (2 ** (attempt - 1)))
+                self.log.warning(
+                    "step %d failed (%s) — retry %d/%d", step, exc,
+                    attempt, self.max_retries)
+
+    def _escalate(self, state: Pytree, step: int,
+                  cause: BaseException) -> Tuple[Pytree, bool]:
+        """Retries exhausted: walk the GuardPolicy ladder host-side.
+        Returns ``(state, moved)`` — ``moved`` False means the state was
+        rolled back and the SAME step index should be retried."""
+        pol = self.policy
+        self._consecutive_failed += 1
+        if (pol.on_anomaly == "skip"
+                and self._consecutive_failed <= pol.skip_budget):
+            self.counters["skips_total"] += 1
+            self.log.warning(
+                "step %d failed after retries — SKIPPED (%d/%d budget): %s",
+                step, self._consecutive_failed, pol.skip_budget, cause)
+            return state, True  # advance past the poisoned step
+        if pol.on_anomaly in ("skip", "rollback"):
+            self._consecutive_rollbacks += 1
+            if self._consecutive_rollbacks <= pol.rollback_budget:
+                latest = self._latest()
+                if self.manager is None or latest is None:
+                    self._halt(step, cause,
+                               "rollback rung reached but no valid "
+                               "checkpoint to roll back to")
+                self.counters["rollbacks_total"] += 1
+                self.log.warning(
+                    "step %d failed — ROLLBACK to %s (%d/%d budget): %s",
+                    step, latest, self._consecutive_rollbacks,
+                    pol.rollback_budget, cause)
+                state, _ = self.manager.restore(target=state, path=latest)
+                return state, False
+        self._halt(step, cause, "escalation budgets exhausted")
+
+    def _halt(self, step: int, cause: BaseException, why: str) -> None:
+        self._write_restart(self._latest(), step, reason="halted")
+        raise AnomalyHalted(
+            f"supervisor halted at step {step} ({why}); last failure: "
+            f"{cause!r}; restart manifest written") from cause
+
+    # -- sentinel feed -----------------------------------------------------
+    def _observe_times(self, step: int, dt: float) -> None:
+        times = [dt] * self.dp_degree
+        for rank in list(self._slow):
+            factor, left = self._slow[rank]
+            times[rank] = dt * factor
+            self._slow[rank] = (factor, left - 1)
+            if left - 1 <= 0:
+                del self._slow[rank]
+        if self.straggler is not None:
+            self.straggler.observe(step, times)
+        if self.sink is not None:
+            self.sink.write(step=step, step_time_s=dt,
+                            rank_step_time_s=times)
+
+    # -- checkpoints + the restart manifest --------------------------------
+    def _latest(self) -> Optional[str]:
+        return None if self.manager is None else self.manager.latest_valid()
+
+    def _save(self, state: Pytree, step: int, block: Optional[bool] = None):
+        self.manager.save(state, step, block=block, elastic=self.elastic)
+        self.counters["saves_total"] += 1
+
+    def _write_restart(self, checkpoint: Optional[str], step: int,
+                       reason: str) -> None:
+        if self.manager is None:
+            return
+        legal = [self.dp_degree]
+        if self.elastic is not None and checkpoint is not None:
+            # the saved manifest's stamped spec is authoritative (matches
+            # what is actually on disk); a flat digit-keyed spec mapping
+            # passed at construction works as a fallback
+            specs = self._specs_from_checkpoint(checkpoint)
+            if not specs and isinstance(self.elastic, dict) \
+                    and all(str(k).isdigit() for k in self.elastic):
+                specs = self.elastic
+            if specs:
+                legal = legal_resume_degrees(specs)
+        info = {
+            "checkpoint": checkpoint, "step": int(step),
+            "dp_degree": self.dp_degree, "legal_resume_dp": legal,
+            "reason": reason, "allow_reshard": self.elastic is not None,
+        }
+        path = os.path.join(self.manager.directory, RESTART_NAME)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(info, f, indent=2, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, path)
+
+    def _specs_from_checkpoint(self, checkpoint: str) -> Dict[str, Any]:
+        try:
+            from apex_tpu.resilience.checkpoint import MANIFEST_NAME
+            with open(os.path.join(checkpoint, MANIFEST_NAME)) as f:
+                manifest = json.load(f)
+            return manifest.get("elastic") or {}
+        # best-effort read: a missing/corrupt manifest just means no
+        # elastic specs ride the restart hint — restore will still refuse
+        except Exception:
+            return {}
+
+    # -- resume ------------------------------------------------------------
+    @staticmethod
+    def read_restart(directory: str) -> Optional[Dict[str, Any]]:
+        """Parse ``restart.json`` from a checkpoint directory (what the
+        re-launched job — possibly at a different dp degree — reads
+        first). ``None`` when no manifest exists (fresh start)."""
+        path = os.path.join(directory, RESTART_NAME)
+        if not os.path.exists(path):
+            return None
+        with open(path) as f:
+            return json.load(f)
+
+    def resume(self, template: Pytree,
+               allow_reshard: Optional[bool] = None) -> Tuple[Pytree, int]:
+        """Restore from the restart manifest (falling back to
+        ``latest_valid()``): returns ``(state, step)`` ready for
+        :meth:`run`. ``allow_reshard`` defaults to what the manifest
+        granted — a manifest written WITH an elastic spec opts in, so a
+        resume at a different dp degree just works; pass ``False`` to
+        insist on the exact topology."""
+        if self.manager is None:
+            raise ValueError("resume() needs a CheckpointManager")
+        info = self.read_restart(self.manager.directory)
+        path = info.get("checkpoint") if info else None
+        if allow_reshard is None:
+            allow_reshard = bool(info.get("allow_reshard")) if info else False
+        if (info and info.get("legal_resume_dp")
+                and self.dp_degree not in info["legal_resume_dp"]):
+            raise ValueError(
+                f"dp={self.dp_degree} is not a legal resume degree for "
+                f"{path} (legal: {info['legal_resume_dp']}) — the "
+                "shard_multiple arithmetic cannot divide this topology")
+        state, step = self.manager.restore(
+            target=template, path=path, allow_reshard=allow_reshard)
+        if info and info.get("dp_degree") != self.dp_degree:
+            self.counters["elastic_resumes_total"] += 1
+            self.log.warning(
+                "elastic resume: checkpoint written at dp=%s, resuming at "
+                "dp=%d (reshard %s)", info.get("dp_degree"), self.dp_degree,
+                "on" if allow_reshard else "OFF")
+        return state, step
+
+    # -- reporting ---------------------------------------------------------
+    def summary(self) -> Dict[str, Any]:
+        out = dict(self.counters)
+        out["exited"] = self.exited
+        if self.straggler is not None:
+            out["straggler_flags_total"] = self.straggler.flags_total
+        if self.chaos is not None:
+            out["chaos"] = self.chaos.summary()
+        return out
+
+
+class _EscalationNeeded(Exception):
+    """Internal: transient retries exhausted, consult the ladder."""
+
+    def __init__(self, cause: BaseException):
+        super().__init__(str(cause))
+        self.cause = cause

@@ -37,8 +37,9 @@
 #  19 observe A/B     bench_observe.py      -> OBSERVE_TPU.json
 #  20 LoRA serve A/B  bench_serve_mh.py --lora -> SERVE_LORA_TPU.json
 #  21 forensics A/B   bench_attrib_cost.py  -> ATTRIB_COST_TPU.json
+#  22 elastic train   bench_elastic.py      -> ELASTIC_TPU.json
 # After the first seven, later healthy probes only refresh stage 1+3
-# (hourly) so the banked number tracks the latest code; stages 8-21
+# (hourly) so the banked number tracks the latest code; stages 8-22
 # ride the same hourly cadence until banked (additive evidence that must
 # never hold the suite out of refresh mode).
 #
@@ -69,6 +70,7 @@ last_chaos=-3600    # stage-18 (elastic serve chaos: kill-and-migrate) same
 last_observe=-3600  # stage-19 (fleet observability overhead A/B) same
 last_lora=-3600     # stage-20 (per-tenant LoRA serve A/B) same
 last_attrib=-3600   # stage-21 (attribution + cost forensics A/B) same
+last_elastic=-3600  # stage-22 (elastic train: reshard + kill-resume) same
 
 note() { echo "$(date '+%F %T') $*" >> "$LOG"; }
 
@@ -759,6 +761,54 @@ $(cat /tmp/tpu_stage21_regress.out)"
   return 0
 }
 
+elastic_stage() {
+  # stage 22: elastic fault-tolerant training — bench_elastic.py runs
+  # the full topology-elastic story (dp=4 checkpoint with the elastic
+  # manifest restored onto a dp=2 layout, a chaos KillRankAtStep mid-run
+  # and a supervisor resume at the new degree) and records reshard_ms /
+  # reshard_ms_per_gb, kill_resume_wall_ms, loss_rejoin_delta (ok=false
+  # past --rejoin-tol: a resume that drifted is corruption, not cost)
+  # and sentinel_overhead_pct (ok=false past the 5% always-on budget or
+  # on any straggler/SDC false positive on the clean run). Same promote
+  # rules as stages 10-21: CPU rehearsals never promote (host-loop
+  # timing flatters nothing on a TPU), ok=false never promotes,
+  # REGRESSION-GATED via monitor.regress --tol 0.15 once banked
+  # (reshard_ms / sentinel counters lower-is-better,
+  # elastic_resumes_total informational); hourly even after banked so a
+  # reshard slowdown or a sentinel noise storm surfaces within an hour.
+  note "STAGE22 START: bench_elastic.py"
+  rm -f /tmp/elastic_try.json
+  timeout 1800 python benchmarks/bench_elastic.py \
+    --out /tmp/elastic_try.json \
+    > /tmp/tpu_stage22.out 2> /tmp/tpu_stage22.err
+  local rc=$?
+  note "STAGE22 EXIT=$rc"
+  [ -s /tmp/elastic_try.json ] || return 1
+  if grep -q CPU_FALLBACK /tmp/elastic_try.json; then
+    note "STAGE22 got CPU_FALLBACK, not promoting"
+    return 1
+  fi
+  if grep -Eq '"ok": false' /tmp/elastic_try.json; then
+    note "STAGE22 record has ok false, not promoting"
+    return 1
+  fi
+  if [ -s ELASTIC_TPU.json ]; then
+    if ! python -m apex_tpu.monitor.regress ELASTIC_TPU.json \
+        /tmp/elastic_try.json --tol 0.15 \
+        > /tmp/tpu_stage22_regress.out 2>> /tmp/tpu_stage22.err; then
+      note "STAGE22 REGRESSION vs banked, keeping banked record: \
+$(cat /tmp/tpu_stage22_regress.out)"
+      return 1
+    fi
+  fi
+  cp /tmp/elastic_try.json ELASTIC_TPU.json
+  note "STAGE22 PROMOTED $(cat ELASTIC_TPU.json)"
+  trend_bank elastic ELASTIC_TPU.json
+  [ $rc -eq 0 ] || return 1
+  [ "$(cat "$STATE")" -eq 21 ] && echo 22 > "$STATE"
+  return 0
+}
+
 smoke_stage() {
   # Smoke to a temp file; promote ANY real-TPU artifact (a failing kernel
   # on the chip is exactly the evidence we must bank) but never a CPU
@@ -898,6 +948,13 @@ while true; do
           attrib_stage
           last_attrib=$now
         fi
+        # stage 22 (elastic train: reshard + kill-resume + sentinels):
+        # same contract — a reshard slowdown, a resume that drifts or a
+        # sentinel noise storm must surface within an hour
+        if [ $((now - last_elastic)) -ge 3600 ]; then
+          elastic_stage
+          last_elastic=$now
+        fi
         last_refresh=$now
       fi
     else
@@ -1014,6 +1071,12 @@ while true; do
           && [ $((now - last_attrib)) -ge 3600 ]; then
         attrib_stage
         last_attrib=$now
+      fi
+      # stage 22: elastic train (reshard + kill-resume), same contract.
+      if [ "$(cat "$STATE")" -eq 21 ] \
+          && [ $((now - last_elastic)) -ge 3600 ]; then
+        elastic_stage
+        last_elastic=$now
       fi
       last_refresh=$now
     fi

@@ -94,6 +94,12 @@ def parse_args(argv=None):
     p.add_argument("--preempt-save", action="store_true",
                    help="on SIGTERM: save a checkpoint at the agreed step "
                         "and exit cleanly (requires --checkpoint-dir)")
+    p.add_argument("--elastic", action="store_true",
+                   help="drive the sharded loop through TrainSupervisor "
+                        "with an elastic checkpoint spec: a checkpoint "
+                        "saved here restores at a DIFFERENT --plan dp "
+                        "degree (restart manifest names the legal ones); "
+                        "needs --checkpoint-dir and a zero1/fsdp plan")
     return p.parse_args(argv)
 
 
@@ -294,6 +300,10 @@ def _train_sharded(args, plan, mesh, model, params, batch_stats
         out_specs=(sspec, P()), check_vma=False))
     state = init(params, batch_stats)
 
+    if args.elastic:
+        return _run_elastic_sharded(args, plan, mesh, opt, params, state,
+                                    step)
+
     mgr = _make_manager(args) if args.checkpoint_dir else None
     state, start_it = _resolve_resume(args, mgr, state)
 
@@ -320,6 +330,75 @@ def _train_sharded(args, plan, mesh, model, params, batch_stats
             print(f"=> saved checkpoint '{p}' (iter {it + 1})")
     if mgr is not None:
         mgr.close()
+    return losses
+
+
+def _run_elastic_sharded(args, plan, mesh, opt, params, state,
+                         step) -> List[float]:
+    """The --elastic sharded loop: TrainSupervisor drives the step with an
+    elastic spec stamped into every checkpoint, so a preempted/killed run
+    relaunched on a different slice (different dp degree, new --plan mesh)
+    resumes through the reshard path — the restart manifest's
+    ``legal_resume_dp`` names the degrees the shard arithmetic divides."""
+    from apex_tpu.parallel.mesh import DP_AXIS
+    from apex_tpu.resilience import (
+        PreemptionHandler,
+        TrainSupervisor,
+        replicated_spec,
+    )
+
+    dp = mesh.shape[DP_AXIS]
+    ospec = opt.elastic_spec(params, dp)
+    repl = lambda tree: jax.tree_util.tree_map(  # noqa: E731
+        lambda _: replicated_spec(), tree)
+    # mirror the state tuples _train_sharded builds: batch stats (and
+    # zero1's replicated param copy) never reshard
+    if plan.data == "fsdp":
+        espec = (ospec, repl(state[1]))
+    else:
+        espec = (repl(params), ospec, repl(state[2]))
+    mgr = plan.checkpoint_manager(
+        args.checkpoint_dir, allow_reshard=True,
+        keep_last_n=args.keep_last_n, keep_every_k=args.keep_every_k,
+        async_save=args.async_save)
+
+    losses: List[float] = []
+    data_rng = jax.random.PRNGKey(args.seed + 1)
+
+    def step_fn(st, it):
+        k = jax.random.fold_in(data_rng, it)
+        images = jax.random.normal(
+            k, (args.batch_size, args.image_size, args.image_size, 3))
+        labels = jax.random.randint(
+            jax.random.fold_in(k, 1), (args.batch_size,), 0,
+            args.num_classes)
+        st, loss = step(st, images, labels)
+        losses.append(float(loss))
+        if it % args.print_freq == 0 or it == args.iters - 1:
+            print(f"iter {it:4d}  loss {losses[-1]:.6f}")
+        return st
+
+    sup = TrainSupervisor(
+        step_fn, mgr, elastic=espec, dp_degree=dp,
+        save_freq=args.save_freq or args.iters,
+        preemption=PreemptionHandler() if args.preempt_save else None)
+    start_it = 0
+    info = TrainSupervisor.read_restart(args.checkpoint_dir)
+    if info is not None or mgr.latest_valid() is not None:
+        state, start_it = sup.resume(state)
+        prev_dp = info.get("dp_degree") if info else dp
+        print(f"=> elastic resume at iter {start_it} "
+              f"(checkpoint dp={prev_dp}, live dp={dp})")
+        if start_it >= args.iters:
+            raise SystemExit(
+                f"checkpoint is already at iter {start_it} >= --iters "
+                f"{args.iters}; nothing to resume (raise --iters)")
+    state, nxt = sup.run(state, start_it, args.iters - start_it)
+    if sup.exited != "killed":
+        mgr.save(state, nxt, elastic=espec)
+    mgr.close()
+    if sup.exited == "preempted":
+        print(f"=> preempted at iter {nxt}; restart manifest written")
     return losses
 
 

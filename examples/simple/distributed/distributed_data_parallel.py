@@ -47,7 +47,9 @@ from apex_tpu.resilience import (
     CheckpointManager,
     GuardPolicy,
     PreemptionHandler,
+    TrainSupervisor,
     chaos,
+    replicated_spec,
 )
 
 
@@ -65,6 +67,12 @@ def parse_args(argv=None):
     ap.add_argument("--chaos-step", type=int, default=-1,
                     help="inject a NaN gradient at this step "
                          "(guard demo; --plan ddp only)")
+    ap.add_argument("--elastic", action="store_true",
+                    help="drive the sharded loop through TrainSupervisor "
+                         "with an elastic checkpoint spec: checkpoints "
+                         "restore at a DIFFERENT --plan dp degree (the "
+                         "restart manifest names the legal ones); needs "
+                         "--checkpoint-dir and a zero1/fsdp plan")
     return ap.parse_args(argv)
 
 
@@ -199,6 +207,9 @@ def _train_sharded(args, plan, mesh, params, x, y):
         check_vma=False))
 
     state = init(params)
+    if args.elastic:
+        return _run_elastic(args, plan, mesh, params, opt, state, step,
+                            finalize, x, y)
     mgr = CheckpointManager(args.checkpoint_dir) if args.checkpoint_dir \
         else None
     start = 0
@@ -220,9 +231,52 @@ def _train_sharded(args, plan, mesh, params, x, y):
     return finalize(state), None
 
 
+def _run_elastic(args, plan, mesh, params, opt, state, step, finalize, x, y):
+    """The --elastic loop: TrainSupervisor + an elastic checkpoint spec.
+    Saves are topology-portable — a later run with a different dp degree
+    resumes from the restart manifest via the reshard path (the manifest's
+    ``legal_resume_dp`` names the degrees that divide cleanly)."""
+    dp = mesh.shape[DP_AXIS]
+    # per-leaf reshard specs mirroring the state structure: the optimizer
+    # knows its shard arithmetic; the replicated params tree (zero1's
+    # first element) never reshards
+    espec = opt.elastic_spec(params, dp)
+    if plan.data != "fsdp":
+        espec = (jax.tree.map(lambda _: replicated_spec(), params), espec)
+    mgr = plan.checkpoint_manager(args.checkpoint_dir, allow_reshard=True)
+    last = {}
+
+    def step_fn(st, it):
+        st, last["loss"] = step(st, x, y)
+        return st
+
+    sup = TrainSupervisor(step_fn, mgr, elastic=espec, dp_degree=dp,
+                          save_freq=args.save_freq,
+                          preemption=PreemptionHandler())
+    start = 0
+    info = TrainSupervisor.read_restart(args.checkpoint_dir)
+    if info is not None or mgr.latest_valid() is not None:
+        state, start = sup.resume(state)
+        prev_dp = info.get("dp_degree") if info else dp
+        print(f"=> elastic resume at step {start} "
+              f"(checkpoint dp={prev_dp}, live dp={dp})")
+    state, nxt = sup.run(state, start, max(0, args.steps - start))
+    mgr.close()
+    if sup.exited == "preempted":
+        print(f"=> preempted: saved at step {nxt}, restart manifest "
+              "written — rerun (any legal dp) to continue")
+        return None, None
+    if "loss" in last:
+        print(f"final loss {float(last['loss']):.6f}")
+    return finalize(state), None
+
+
 def main(argv=None):
     args = parse_args(argv)
     plan = ParallelismPlan.preset(args.plan)
+    if args.elastic and (plan.data == "ddp" or not args.checkpoint_dir):
+        raise SystemExit("--elastic needs --checkpoint-dir and a sharded "
+                         "plan (zero1/fsdp/fsdp+tp)")
     print(plan.describe())
 
     # TPU matmuls default to bf16 accumulation; this toy regression needs f32
