@@ -78,7 +78,13 @@ _HIGHER = ("tokens_per_s", "goodput", "_rps", "mfu", "occupancy",
            # retired requests the attribution plane decomposed with the
            # sum identity intact, and the fraction the meter charged —
            # a coverage hole is a blind billing/diagnosis spot
-           "attrib_coverage", "meter_coverage")
+           "attrib_coverage", "meter_coverage",
+           # megakernel tier-2 round (stage 23): the speculative-decode
+           # draft acceptance rate at the fused verify step (already
+           # matched by the generic acceptance_rate fragment; listed so
+           # the verify A/B gate's coverage is explicit next to its
+           # verify_step_ms dual in _LOWER)
+           "spec_acceptance_rate")
 _LOWER = ("_ms", "violation", "latency", "bubble", "exposed_bytes",
           # disaggregated cluster (stage 15): a rising shed fraction is a
           # capacity regression (transfer_ms falls under the generic
@@ -142,7 +148,12 @@ _LOWER = ("_ms", "violation", "latency", "bubble", "exposed_bytes",
           # NOT listed: how many times a run resumed at a new topology is
           # the scheduler's business, informational either way
           "reshard_ms", "sdc_disagreements_total",
-          "straggler_flags_total", "retries_total")
+          "straggler_flags_total", "retries_total",
+          # megakernel tier-2 round (stage 23): the fused-vs-unfused
+          # decode/verify step latencies (also caught by the generic
+          # "_ms" rule; listed so the verify A/B gate's coverage is
+          # explicit — these are the headline quantiles the stage banks)
+          "verify_step_ms", "decode_step_ms")
 
 
 def classify_metric(key: str,

@@ -90,9 +90,14 @@ from apex_tpu.serve.kv_cache import (  # noqa: F401
     prefix_block_hashes,
 )
 from apex_tpu.serve.megakernel import (  # noqa: F401
+    default_tiles,
     fused_layer_decode,
+    fused_layer_verify,
+    fused_live_bytes,
     gpt_decode_step_fused,
+    gpt_verify_step_fused,
     megakernel_ok,
+    megakernel_refusal,
 )
 from apex_tpu.serve.sampling import (  # noqa: F401
     SamplingConfig,
@@ -142,7 +147,10 @@ __all__ = [
     "copy_block",
     "decode_flops_per_token",
     "default_bucket_ladder",
+    "default_tiles",
     "fused_layer_decode",
+    "fused_layer_verify",
+    "fused_live_bytes",
     "gather_kv",
     "gpt_decode_step",
     "gpt_decode_step_fused",
@@ -150,6 +158,7 @@ __all__ = [
     "gpt_prefill",
     "gpt_prefill_chunk",
     "gpt_verify_step",
+    "gpt_verify_step_fused",
     "hash_block_tokens",
     "init_adapter_pool",
     "init_kv_cache",
@@ -159,6 +168,7 @@ __all__ = [
     "lora_delta",
     "make_adapter_weights",
     "megakernel_ok",
+    "megakernel_refusal",
     "merge_adapter_params",
     "paged_attention",
     "paged_attention_reference",

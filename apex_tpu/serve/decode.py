@@ -607,7 +607,12 @@ def gpt_verify_step(params, fed_tokens, seq_lens, n_fed, active, cache,
     drafts' K/V writes need no rollback: the accepted length caps
     ``seq_lens``, the stale positions are masked by every later context
     window and overwritten when real tokens reach them (the same
-    ``mode="drop"``/masking contract that drops padded writes)."""
+    ``mode="drop"``/masking contract that drops padded writes).
+
+    :func:`megakernel.gpt_verify_step_fused` is the fused sibling —
+    same semantics, one Pallas block per layer — which the engine wires
+    in when ``ServeConfig.megakernel`` resolves on; this per-op path is
+    the parity oracle the fused one is pinned against."""
     return gpt_paged_forward(params, fed_tokens, seq_lens, n_fed, active,
                              cache, block_tables, cfg, kv_cfg,
                              tp_axis=tp_axis, use_pallas=use_pallas,

@@ -249,7 +249,7 @@ class ServeConfig:
 
 # the engine's latency dimensions; each gets a streaming Histogram
 _HIST_NAMES = ("ttft_ms", "tpot_ms", "queue_ms", "e2e_ms",
-               "decode_step_ms")
+               "decode_step_ms", "verify_step_ms")
 
 # host arrays with cached device mirrors (uploaded only when dirty)
 _MIRROR_NAMES = ("block_tables", "seq_lens", "last_tokens", "active",
@@ -475,29 +475,46 @@ class InferenceEngine:
         self._build_programs(wrap)
 
     def _resolve_megakernel(self) -> bool:
-        """ServeConfig.megakernel -> whether the decode program is the
-        fused per-layer block. ``auto`` requires a compiled Mosaic backend
-        (the interpreter saves no dispatch); ``on`` forces it and raises
-        on unsupported shapes (TP, MoE, VMEM-oversized layers)."""
-        from apex_tpu.serve.megakernel import megakernel_ok
+        """ServeConfig.megakernel -> whether the decode AND verify
+        programs are the fused per-layer block. ``auto`` requires a
+        compiled Mosaic backend (the interpreter saves no dispatch);
+        ``on`` forces it and raises on unsupported shapes (TP, LoRA,
+        MoE, layers whose live TILE set exceeds the VMEM budget) with
+        the measured refusal reason. An ``auto`` fallback on a COMPILED
+        backend warns once per reason — a 10x slower serve run must be
+        diagnosable from the log, not only from the bench line's
+        ``decode_kernel`` field."""
+        from apex_tpu.ops._pallas_util import compiled_backend
+        from apex_tpu.serve.megakernel import (megakernel_refusal,
+                                               warn_megakernel_fallback)
 
         mode = self.serve_cfg.megakernel
         if mode == "off":
             return False
-        supported = (self._tp_axis is None
-                     and self.serve_cfg.lora_rank == 0
-                     and megakernel_ok(self.cfg, self.kv_cfg,
-                                       allow_interpret=(mode == "on")))
+        # the verify step feeds spec_k+1 rows per slot; gate on the
+        # larger live set so speculation never flips the kernel choice
+        q = self.serve_cfg.spec_k + 1
+        if self._tp_axis is not None:
+            reason = "TP-sharded programs ride the per-op layer body"
+        elif self.serve_cfg.lora_rank > 0:
+            reason = ("per-slot LoRA adapters (lora_rank > 0) ride the "
+                      "per-op layer body")
+        else:
+            reason = megakernel_refusal(self.cfg, self.kv_cfg,
+                                        allow_interpret=(mode == "on"),
+                                        q=q)
         if mode == "on":
-            if not supported:
+            if reason is not None:
                 raise ValueError(
-                    "megakernel='on' but the fused decode block does not "
-                    "support this configuration (TP-sharded programs, MoE, "
-                    "LoRA adapters, head_dim % 8 != 0, or per-layer "
-                    "weights over the VMEM budget) — use "
-                    "megakernel='off'/'auto'")
+                    f"megakernel='on' but the fused decode block does "
+                    f"not support this configuration: {reason} — use "
+                    f"megakernel='off'/'auto'")
             return True
-        return supported
+        if reason is not None:
+            if compiled_backend():
+                warn_megakernel_fallback(reason)
+            return False
+        return True
 
     @property
     def megakernel_enabled(self) -> bool:
@@ -521,6 +538,18 @@ class InferenceEngine:
             use_pallas = _pallas_ok(self.cfg.head_dim,
                                     allow_interpret=False)
         return "pallas" if use_pallas else "reference"
+
+    @property
+    def verify_kernel(self) -> Optional[str]:
+        """The speculative verify path this engine actually runs:
+        ``None`` when ``spec_k == 0`` (no verify program exists), else
+        ``fused``/``pallas``/``reference`` — the same resolution as
+        :attr:`decode_kernel`, because one ``megakernel`` flag drives
+        both jit sites. Emitted in :meth:`stats` so the verify A/B gate
+        can tell a kernel fallback from a regression."""
+        if self.serve_cfg.spec_k <= 0:
+            return None
+        return self.decode_kernel
 
     # -- device mirrors ---------------------------------------------------
     def _dirty(self, *names: str) -> None:
@@ -586,10 +615,17 @@ class InferenceEngine:
 
         def verify(params, cache, fed_tokens, seq_lens, n_fed, active,
                    block_tables, keys):
-            cache, logits = gpt_verify_step(
-                params, fed_tokens, seq_lens, n_fed, active, cache,
-                block_tables, cfg, kv_cfg, tp_axis=tp_axis,
-                use_pallas=self._use_pallas)
+            if use_mega:
+                from apex_tpu.serve.megakernel import gpt_verify_step_fused
+
+                cache, logits = gpt_verify_step_fused(
+                    params, fed_tokens, seq_lens, n_fed, active, cache,
+                    block_tables, cfg, kv_cfg)
+            else:
+                cache, logits = gpt_verify_step(
+                    params, fed_tokens, seq_lens, n_fed, active, cache,
+                    block_tables, cfg, kv_cfg, tp_axis=tp_axis,
+                    use_pallas=self._use_pallas)
             k1 = fed_tokens.shape[1]
             draw_pos = seq_lens[:, None] + 1 + jnp.arange(k1)[None, :]
             toks = sample(logits, keys, draw_pos, scfg.sampling)
@@ -1329,6 +1365,10 @@ class InferenceEngine:
             toks = np.asarray(toks)  # fence — the iteration-level sync
         dt = time.perf_counter() - t0
         self.hists["decode_step_ms"].add([dt * 1e3])
+        if drafts is not None:
+            # the verify A/B's own latency dimension — spec steps also
+            # land in decode_step_ms (one engine iteration either way)
+            self.hists["verify_step_ms"].add([dt * 1e3])
         now_ms = self._now_ms()
         active_lens = [int(s) + 1 for s, a
                        in zip(self._seq_lens, self._active) if a]
@@ -1535,6 +1575,7 @@ class InferenceEngine:
         }
         out["megakernel"] = self._megakernel
         out["decode_kernel"] = self.decode_kernel
+        out["verify_kernel"] = self.verify_kernel
         # the sub-8-bit KV headline fields (watcher-gated: kv_bits and
         # the budget are lower-better, contexts_max higher-better)
         out["kv_bits"] = (self.kv_cfg.bits if self.kv_cfg.quantized

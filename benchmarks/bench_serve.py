@@ -27,6 +27,15 @@ perf number), so the record honestly says ``megakernel_ab: needs a
 chip`` and carries the per-op-path numbers under the ``_CPU_FALLBACK``
 metric suffix.
 
+``--model {pinned,flagship}`` picks the served model: ``pinned`` is the
+small canary above; ``flagship`` is the GPT-2-124M serve shape (768
+hidden, 12 layers, 50304 vocab — per-layer bf16 weights OVER the 10 MB
+VMEM budget, so only the tier-2 weight-streaming tiles can serve it
+fused). Watcher stage 23 runs ``--megakernel-ab --spec-k 4 --model
+flagship`` (``DECODE_FUSED_T2_TPU.json``): the record must show
+``decode_kernel`` AND ``verify_kernel`` ``== "fused"`` on the fused
+side — the lifted-gate acceptance measurement.
+
 ``--loadgen`` switches to the monitor-tier-2 goodput-under-SLO bench:
 ``benchmarks/loadgen.py`` drives the engine with a seeded Poisson+burst
 workload and the line becomes goodput req/s + TTFT/TPOT p50/p99 from the
@@ -65,8 +74,15 @@ import numpy as np  # noqa: E402
 ON_TPU = jax.default_backend() == "tpu"
 
 # the pinned protocol (canary discipline, see bench_comm.py): one fixed
-# model + workload so the line is comparable round-over-round
-HIDDEN, LAYERS, HEADS, VOCAB, MAX_SEQ = 128, 2, 8, 512, 256
+# model + workload so the line is comparable round-over-round. The
+# flagship row is the GPT-2-124M serve shape the tier-2 megakernel
+# gate-lift targets (per-layer bf16 weights > 10 MB — full residency
+# refuses, weight-tile streaming serves it fused).
+MODELS = {
+    "pinned": dict(hidden=128, layers=2, heads=8, vocab=512, max_seq=256),
+    "flagship": dict(hidden=768, layers=12, heads=12, vocab=50304,
+                     max_seq=1024),
+}
 SLOTS, BLOCK_SIZE, MAX_NEW = 4, 16, 32
 PREFILL_CHUNK = 32
 PROMPT_LENS = (5, 17, 40, 9, 33, 12, 60, 25)
@@ -95,6 +111,9 @@ def main() -> int:
     ap.add_argument("--megakernel-ab", action="store_true",
                     help="run the workload megakernel-on AND -off, emit "
                          "one A/B record (watcher stage 12)")
+    ap.add_argument("--model", default="pinned", choices=sorted(MODELS),
+                    help="served model: the pinned canary or the GPT-2-"
+                         "124M flagship serve shape (watcher stage 23)")
     ap.add_argument("--loadgen", action="store_true",
                     help="run the goodput-under-SLO loadgen bench instead")
     args, extra = ap.parse_known_args()
@@ -122,15 +141,20 @@ def main() -> int:
 
     name = ("gpt_serve_decode_fused_ab" if args.megakernel_ab
             else "gpt_serve_engine")
+    if args.model == "flagship":
+        name += "_124m"
     if not ON_TPU:
         name += "_CPU_FALLBACK"
 
-    cfg = GPTConfig(vocab_size=VOCAB, max_seq=MAX_SEQ, hidden=HIDDEN,
-                    num_layers=LAYERS, num_heads=HEADS,
+    model = MODELS[args.model]
+    cfg = GPTConfig(vocab_size=model["vocab"], max_seq=model["max_seq"],
+                    hidden=model["hidden"], num_layers=model["layers"],
+                    num_heads=model["heads"],
                     dtype=jnp.bfloat16 if ON_TPU else jnp.float32)
     params = init_gpt_params(jax.random.PRNGKey(0), cfg)
     rng = np.random.default_rng(0)
-    prompts = [rng.integers(0, VOCAB, size=p).tolist() for p in PROMPT_LENS]
+    prompts = [rng.integers(0, model["vocab"], size=p).tolist()
+               for p in PROMPT_LENS]
 
     def run_engine(megakernel):
         """One full workload pass -> (measurement sub-record, streams);
@@ -167,6 +191,11 @@ def main() -> int:
             "tpot_ms_p50": stats.get("tpot_ms_p50"),
             "decode_step_ms_p50": stats.get("decode_step_ms_p50"),
             "decode_step_ms_p99": stats.get("decode_step_ms_p99"),
+            # the verify jit site's path + latency (None when spec_k=0
+            # or no slot ever proposed): the stage-23 verify A/B columns
+            "verify_kernel": stats.get("verify_kernel"),
+            "verify_step_ms_p50": stats.get("verify_step_ms_p50"),
+            "verify_step_ms_p99": stats.get("verify_step_ms_p99"),
             "mean_occupancy": round(
                 statistics.fmean(r["occupancy"] for r in steps), 4)
             if steps else None,
@@ -217,8 +246,9 @@ def main() -> int:
         # needs a multi-chip slice; a single chip has nothing to shard
         "tp_sharded_serving": ("needs a slice"
                                if len(jax.devices()) < 2 else "untested"),
-        "config": {"hidden": HIDDEN, "layers": LAYERS, "heads": HEADS,
-                   "vocab": VOCAB, "slots": SLOTS,
+        "config": {"model": args.model, "hidden": model["hidden"],
+                   "layers": model["layers"], "heads": model["heads"],
+                   "vocab": model["vocab"], "slots": SLOTS,
                    "block_size": BLOCK_SIZE, "max_new": MAX_NEW,
                    "prompts": list(PROMPT_LENS),
                    "megakernel": mega},  # the mode actually run

@@ -38,8 +38,10 @@
 #  20 LoRA serve A/B  bench_serve_mh.py --lora -> SERVE_LORA_TPU.json
 #  21 forensics A/B   bench_attrib_cost.py  -> ATTRIB_COST_TPU.json
 #  22 elastic train   bench_elastic.py      -> ELASTIC_TPU.json
+#  23 mega tier-2 A/B bench_serve.py --megakernel-ab --spec-k 4
+#                       --model flagship    -> DECODE_FUSED_T2_TPU.json
 # After the first seven, later healthy probes only refresh stage 1+3
-# (hourly) so the banked number tracks the latest code; stages 8-22
+# (hourly) so the banked number tracks the latest code; stages 8-23
 # ride the same hourly cadence until banked (additive evidence that must
 # never hold the suite out of refresh mode).
 #
@@ -71,6 +73,7 @@ last_observe=-3600  # stage-19 (fleet observability overhead A/B) same
 last_lora=-3600     # stage-20 (per-tenant LoRA serve A/B) same
 last_attrib=-3600   # stage-21 (attribution + cost forensics A/B) same
 last_elastic=-3600  # stage-22 (elastic train: reshard + kill-resume) same
+last_megat2=-3600   # stage-23 (megakernel tier-2 flagship A/B) same
 
 note() { echo "$(date '+%F %T') $*" >> "$LOG"; }
 
@@ -809,6 +812,61 @@ $(cat /tmp/tpu_stage22_regress.out)"
   return 0
 }
 
+megat2_stage() {
+  # stage 23: megakernel tier 2 — the stage-12 decode A/B rerun at the
+  # GPT-2-124M flagship shape (768 hidden, 12 layers, 50304 vocab) that
+  # tier 1's 10 MB full-residency gate refused. The record only counts
+  # if BOTH jit sites actually took the fused path: the weight-streaming
+  # decode block ("decode_kernel": "fused") AND the q_len=k+1 fused
+  # verify step ("verify_kernel": "fused") — a silent auto-fallback to
+  # the per-op body would otherwise bank an unfused number under the
+  # tier-2 headline. Same promote rules as stages 10-22: CPU rehearsals
+  # (honest _CPU_FALLBACK metric suffix) never promote, a diverged or
+  # failed A/B (streams_equal/ok false) never promotes, REGRESSION-GATED
+  # via monitor.regress --tol 0.15 once banked (verify_step_ms /
+  # decode_step_ms lower-is-better, spec_acceptance_rate higher — the
+  # stage-23 polarity entries); hourly even after banked so a fused
+  # verify regression surfaces within an hour.
+  note "STAGE23 START: bench_serve.py --megakernel-ab --spec-k 4 --model flagship"
+  rm -f /tmp/decode_fused_t2_try.json
+  timeout 1800 python benchmarks/bench_serve.py --megakernel-ab \
+    --spec-k 4 --model flagship --out /tmp/decode_fused_t2_try.json \
+    > /tmp/tpu_stage23.out 2> /tmp/tpu_stage23.err
+  local rc=$?
+  note "STAGE23 EXIT=$rc"
+  [ -s /tmp/decode_fused_t2_try.json ] || return 1
+  if grep -q CPU_FALLBACK /tmp/decode_fused_t2_try.json; then
+    note "STAGE23 got CPU_FALLBACK, not promoting"
+    return 1
+  fi
+  if grep -Eq '"(streams_equal|ok)": false' /tmp/decode_fused_t2_try.json; then
+    note "STAGE23 record has ok/streams_equal false, not promoting"
+    return 1
+  fi
+  # tier-2 specific: the flagship record must prove the VMEM gate really
+  # lifted — both the decode and the verify jit site on the fused path
+  if ! grep -q '"decode_kernel": "fused"' /tmp/decode_fused_t2_try.json \
+      || ! grep -q '"verify_kernel": "fused"' /tmp/decode_fused_t2_try.json; then
+    note "STAGE23 fused_on side not actually fused (gate refused or fell back), not promoting"
+    return 1
+  fi
+  if [ -s DECODE_FUSED_T2_TPU.json ]; then
+    if ! python -m apex_tpu.monitor.regress DECODE_FUSED_T2_TPU.json \
+        /tmp/decode_fused_t2_try.json --tol 0.15 \
+        > /tmp/tpu_stage23_regress.out 2>> /tmp/tpu_stage23.err; then
+      note "STAGE23 REGRESSION vs banked, keeping banked record: \
+$(cat /tmp/tpu_stage23_regress.out)"
+      return 1
+    fi
+  fi
+  cp /tmp/decode_fused_t2_try.json DECODE_FUSED_T2_TPU.json
+  note "STAGE23 PROMOTED $(cat DECODE_FUSED_T2_TPU.json)"
+  trend_bank decode_fused_t2 DECODE_FUSED_T2_TPU.json
+  [ $rc -eq 0 ] || return 1
+  [ "$(cat "$STATE")" -eq 22 ] && echo 23 > "$STATE"
+  return 0
+}
+
 smoke_stage() {
   # Smoke to a temp file; promote ANY real-TPU artifact (a failing kernel
   # on the chip is exactly the evidence we must bank) but never a CPU
@@ -955,6 +1013,13 @@ while true; do
           elastic_stage
           last_elastic=$now
         fi
+        # stage 23 (megakernel tier-2 flagship A/B): same contract — a
+        # fused verify/decode regression at the 124M shape, or a gate
+        # that quietly stopped lifting, must surface within an hour
+        if [ $((now - last_megat2)) -ge 3600 ]; then
+          megat2_stage
+          last_megat2=$now
+        fi
         last_refresh=$now
       fi
     else
@@ -1077,6 +1142,12 @@ while true; do
           && [ $((now - last_elastic)) -ge 3600 ]; then
         elastic_stage
         last_elastic=$now
+      fi
+      # stage 23: megakernel tier-2 flagship A/B, same contract.
+      if [ "$(cat "$STATE")" -eq 22 ] \
+          && [ $((now - last_megat2)) -ge 3600 ]; then
+        megat2_stage
+        last_megat2=$now
       fi
       last_refresh=$now
     fi

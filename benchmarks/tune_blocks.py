@@ -198,22 +198,151 @@ def sweep_ln_impl(steps: int):
     return _full_step_ab(steps, "ln_pallas", (True, False))
 
 
+# the serve shapes the --megakernel-tiles sweep covers: the GPT-2-124M
+# flagship layer plus its nearest production neighbours
+MEGA_TILE_SHAPES = ((768, 4, 64), (512, 4, 64), (1024, 4, 64))
+
+
+def sweep_megakernel_tiles(steps: int, out=None):
+    """Time the fused decode block (serve.megakernel) at every VMEM-
+    feasible lane-aligned weight tiling per serve shape and emit ONE
+    ``json_record`` line naming the best tile config per (hidden,
+    ffn_mult, head_dim). The greedy ``default_tiles`` pick is timed in
+    the same sweep, so the record says whether the static heuristic
+    left latency on the table (the knob to commit if it did:
+    ``fused_layer_decode(..., tiles=...)``)."""
+    import itertools as it
+
+    import jax.numpy as jnp
+
+    from apex_tpu.monitor import json_record
+    from apex_tpu.monitor.sink import collect_provenance, set_provenance
+    from apex_tpu.serve import KVCacheConfig, init_kv_cache
+    from apex_tpu.serve.megakernel import (
+        _VMEM_BUDGET_BYTES,
+        _tiled_dims,
+        _valid_tile_counts,
+        default_tiles,
+        fused_layer_decode,
+        fused_live_bytes,
+    )
+    from apex_tpu.transformer.testing import GPTConfig
+
+    set_provenance(collect_provenance())
+    sweeps = []
+    for hidden, ffn_mult, head_dim in MEGA_TILE_SHAPES:
+        heads = hidden // head_dim
+        cfg = GPTConfig(vocab_size=512, max_seq=1024, hidden=hidden,
+                        num_layers=1, num_heads=heads, ffn_mult=ffn_mult,
+                        dtype=jnp.bfloat16, fused_loss=False)
+        kv = KVCacheConfig(num_layers=1, num_heads=heads,
+                           head_dim=head_dim, num_blocks=16,
+                           block_size=128, dtype=jnp.bfloat16)
+        # every lane-aligned tiling whose live set fits the budget,
+        # coarsest (fewest streaming DMAs) first
+        cands = [t for t in it.product(*(
+            _valid_tile_counts(d, True) for d in _tiled_dims(cfg)))
+            if fused_live_bytes(cfg, kv, t) <= _VMEM_BUDGET_BYTES]
+        cands.sort(key=lambda t: (t[0] * t[1] * t[2], t))
+        cands = cands[:24]  # bound the sweep; coarse tilings dominate
+        greedy = default_tiles(cfg, kv)
+        h = cfg.hidden
+        dt_ = jnp.bfloat16
+        f3, hd, f = 3 * h, heads * head_dim, cfg.ffn_hidden
+        k = jax.random.PRNGKey(0)
+        lp = {
+            "ln1_w": jnp.ones((h,), dt_), "ln1_b": jnp.zeros((h,), dt_),
+            "qkv_kernel": jax.random.normal(k, (h, f3), dt_) * 0.02,
+            "qkv_bias": jnp.zeros((f3,), dt_),
+            "out_kernel": jax.random.normal(
+                jax.random.fold_in(k, 1), (hd, h), dt_) * 0.02,
+            "out_bias": jnp.zeros((h,), dt_),
+            "ln2_w": jnp.ones((h,), dt_), "ln2_b": jnp.zeros((h,), dt_),
+            "fc1_kernel": jax.random.normal(
+                jax.random.fold_in(k, 2), (h, f), dt_) * 0.02,
+            "fc1_bias": jnp.zeros((f,), dt_),
+            "fc2_kernel": jax.random.normal(
+                jax.random.fold_in(k, 3), (f, h), dt_) * 0.02,
+            "fc2_bias": jnp.zeros((h,), dt_),
+        }
+        cl = {kk: v[0] for kk, v in init_kv_cache(kv).items()}
+        x = jax.random.normal(jax.random.fold_in(k, 4),
+                              (8, h), dt_) * 0.1
+        bt = jnp.tile(jnp.arange(2, dtype=jnp.int32), (8, 1))
+        lens = jnp.full((8,), 200, jnp.int32)
+        rows = []
+        for tiles in cands:
+            def fn(x, lp, cl, bt, lens, tiles=tiles):
+                return fused_layer_decode(x, lp, cl, cfg, kv, bt, lens,
+                                          interpret=False, tiles=tiles)
+
+            try:
+                dt = _time(jax.jit(fn), x, lp, cl, bt, lens, steps=steps)
+            except Exception as e:
+                print(f"mega h={hidden} tiles={tiles}  FAILED "
+                      f"{type(e).__name__}", flush=True)
+                continue
+            print(f"mega h={hidden} tiles={tiles}  {dt * 1e6:8.1f} us "
+                  f"(live {fused_live_bytes(cfg, kv, tiles)} B)",
+                  flush=True)
+            rows.append((dt, tiles))
+        if not rows:
+            continue
+        dt_best, best = min(rows)
+        dt_greedy = next((d for d, t in rows if t == greedy), None)
+        sweeps.append({
+            "hidden": hidden, "ffn_mult": ffn_mult, "head_dim": head_dim,
+            "best_tiles": list(best),
+            "best_us": round(dt_best * 1e6, 1),
+            "greedy_tiles": list(greedy) if greedy else None,
+            "greedy_us": (round(dt_greedy * 1e6, 1)
+                          if dt_greedy is not None else None),
+            "live_bytes": fused_live_bytes(cfg, kv, best),
+            "candidates_timed": len(rows),
+        })
+        print(f"BEST mega h={hidden} ffn_mult={ffn_mult} "
+              f"hd={head_dim}: tiles={best} ({dt_best * 1e6:.1f} us)")
+    line = json_record(metric="megakernel_tile_sweep",
+                       ok=bool(sweeps), sweeps=sweeps,
+                       vmem_budget_bytes=_VMEM_BUDGET_BYTES,
+                       backend=jax.default_backend())
+    print(line, flush=True)
+    if out:
+        with open(out, "w") as fh:
+            fh.write(line + "\n")
+    return 0 if sweeps else 1
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=5)
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--megakernel-tiles", action="store_true",
+                    help="sweep fused-decode weight tilings instead of "
+                         "the training-kernel block knobs")
     args = ap.parse_args()
 
     from apex_tpu.utils.platform import probe_backend
 
     if os.environ.get("JAX_PLATFORMS") == "cpu" or probe_backend() == 0:
-        print(f"tune_blocks: needs the real TPU (would sweep attention "
-              f"(b={B}, h={HEADS}, s={S}, d={HEAD_DIM}) bf16 and lm_head "
-              f"(n={B * S}, h={HIDDEN}, v={VOCAB}); backend unavailable)")
+        if args.megakernel_tiles:
+            shapes = ", ".join(f"(h={h}, ffn={m}x, d={d})"
+                               for h, m, d in MEGA_TILE_SHAPES)
+            print(f"tune_blocks: needs the real TPU (would sweep "
+                  f"megakernel weight tiles at {shapes}; backend "
+                  f"unavailable)")
+        else:
+            print(f"tune_blocks: needs the real TPU (would sweep "
+                  f"attention (b={B}, h={HEADS}, s={S}, d={HEAD_DIM}) "
+                  f"bf16 and lm_head (n={B * S}, h={HIDDEN}, "
+                  f"v={VOCAB}); backend unavailable)")
         return 0
     if jax.default_backend() != "tpu":
         print(f"tune_blocks: backend is {jax.default_backend()}, not tpu; "
               f"refusing to sweep (interpret timings are meaningless)")
         return 0
+    if args.megakernel_tiles:
+        return sweep_megakernel_tiles(args.steps, out=args.out)
     sweep_attention(args.steps)
     sweep_lm_head(args.steps)
     sweep_ln_impl(args.steps)

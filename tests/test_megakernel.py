@@ -40,11 +40,19 @@ from apex_tpu.serve import (
     init_kv_cache,
     megakernel_ok,
 )
-from apex_tpu.serve.decode import gpt_decode_step, gpt_prefill
+from apex_tpu.serve.decode import (
+    gpt_decode_step,
+    gpt_prefill,
+    gpt_verify_step,
+)
 from apex_tpu.serve.megakernel import (
+    default_tiles,
     fused_layer_decode,
+    fused_live_bytes,
     gpt_decode_step_fused,
+    gpt_verify_step_fused,
     layer_weight_bytes,
+    megakernel_refusal,
 )
 from apex_tpu.transformer.testing import GPTConfig, init_gpt_params
 
@@ -125,6 +133,110 @@ def test_fused_decode_matches_unfused(kv_mode):
         lens = lens + np.array([1, 1, 0], np.int32)
 
 
+@pytest.mark.parametrize("kv_mode", ["none", "int8", "int4"])
+def test_fused_verify_matches_unfused(kv_mode):
+    """Multi-round VERIFY parity: gpt_verify_step_fused (q=k+1 rows per
+    slot, causal-within-window fold in-kernel) produces the same
+    valid-row logits AND the same written pools as the unfused
+    gpt_verify_step — fp32 within fp tolerance, int8/int4 codes bitwise.
+    Rounds 2-3 accept FEWER tokens than were fed (rejected drafts), so
+    the stale K/V those rows wrote must be masked by the next window and
+    overwritten identically on both paths — the no-rollback contract."""
+    quantized = kv_mode != "none"
+    kv = KVCacheConfig(num_layers=2, num_heads=4, head_dim=8,
+                       num_blocks=24, block_size=4, dtype=jnp.float32,
+                       quantized=quantized,
+                       bits=4 if kv_mode == "int4" else 8)
+    cache, bt = _prefilled(kv, [[3, 14, 15, 92, 6], [7, 8, 9], [1]])
+    cache_f = jax.tree.map(lambda a: a, cache)
+    lens = np.array([5, 3, 0], np.int32)
+    active = jnp.asarray([True, True, False])
+    rng = np.random.default_rng(7)
+    fed = rng.integers(1, 96, (3, 3)).astype(np.int32)
+    for n_fed, accept in [(np.array([3, 2, 0], np.int32), (1, 2)),
+                          (np.array([2, 3, 0], np.int32), (2, 1)),
+                          (np.array([3, 1, 0], np.int32), (3, 1))]:
+        cache, lg_u = gpt_verify_step(
+            PARAMS, jnp.asarray(fed), jnp.asarray(lens),
+            jnp.asarray(n_fed), active, cache, bt, CFG, kv)
+        cache_f, lg_f = gpt_verify_step_fused(
+            PARAMS, jnp.asarray(fed), jnp.asarray(lens),
+            jnp.asarray(n_fed), active, cache_f, bt, CFG, kv)
+        valid = np.asarray(active)[:, None] & (
+            np.arange(3)[None, :] < n_fed[:, None])
+        np.testing.assert_allclose(np.asarray(lg_f)[valid],
+                                   np.asarray(lg_u)[valid], atol=5e-5)
+        assert np.isfinite(np.asarray(lg_f)).all()
+        for key, pool in cache.items():
+            if quantized and key in ("k", "v"):
+                np.testing.assert_array_equal(np.asarray(pool),
+                                              np.asarray(cache_f[key]))
+            else:
+                np.testing.assert_allclose(np.asarray(cache_f[key]),
+                                           np.asarray(pool), atol=1e-5)
+        # accept a PREFIX of what was fed (possibly rejecting drafts):
+        # only the accepted count advances the context
+        lens = lens + np.array([accept[0], accept[1], 0], np.int32)
+        fed = rng.integers(1, 96, (3, 3)).astype(np.int32)
+
+
+def test_fused_verify_single_row_matches_decode():
+    """q=1 verify (no drafts proposed) degenerates to the decode step:
+    same logits, same pools — the fused block's q generalization is a
+    strict superset of the PR-8 q=1 kernel."""
+    kv = KVCacheConfig(num_layers=2, num_heads=4, head_dim=8,
+                       num_blocks=24, block_size=4, dtype=jnp.float32)
+    cache, bt = _prefilled(kv, [[3, 14, 15], [7, 8, 9, 10]])
+    cache_v = jax.tree.map(lambda a: a, cache)
+    lens = jnp.asarray([3, 4], jnp.int32)
+    active = jnp.asarray([True, True])
+    last = jnp.asarray([10, 20], jnp.int32)
+    cache, lg_d = gpt_decode_step_fused(
+        PARAMS, last, lens, active, cache, bt, CFG, kv)
+    cache_v, lg_v = gpt_verify_step_fused(
+        PARAMS, last[:, None], lens, jnp.asarray([1, 1], jnp.int32),
+        active, cache_v, bt, CFG, kv)
+    np.testing.assert_array_equal(np.asarray(lg_v[:, 0]), np.asarray(lg_d))
+    for key, pool in cache.items():
+        np.testing.assert_array_equal(np.asarray(pool),
+                                      np.asarray(cache_v[key]))
+
+
+def test_tile_validation_and_multi_tile_parity():
+    """Tile-boundary edges: a count that does not divide its dim refuses
+    loudly with the valid counts listed; compiled Mosaic additionally
+    refuses lane-misaligned tiles; explicit ``(1, 1, 1)`` is the SAME
+    program as ``tiles=None`` here (default_tiles resolves to full
+    residency — the PR-8 path — bitwise); multi-tile streaming agrees
+    with full residency (column tiles keep contractions whole, only the
+    fc2 row tiles reassociate the fp32 ffn accumulation)."""
+    from apex_tpu.serve.megakernel import _check_tiles
+
+    kv = KVCacheConfig(num_layers=2, num_heads=4, head_dim=8,
+                       num_blocks=8, block_size=8, dtype=jnp.float32)
+    cache, bt = _prefilled(kv, [[5, 6, 7], [11, 12]])
+    x = jax.random.normal(jax.random.PRNGKey(3), (2, CFG.hidden))
+    lp = jax.tree.map(lambda a: a[0], PARAMS["layers"])
+    cl = {k: v[0] for k, v in cache.items()}
+    lens = jnp.asarray([3, 2], jnp.int32)
+    with pytest.raises(ValueError, match="does not divide"):
+        fused_layer_decode(x, lp, cl, CFG, kv, bt, lens, tiles=(5, 1, 1))
+    with pytest.raises(ValueError, match="lane-aligned"):
+        _check_tiles(CFG, (2, 1, 1), True)  # 96 / 2 = 48: not 128-aligned
+    assert default_tiles(CFG, kv, compiled=False) == (1, 1, 1)
+    base = fused_layer_decode(x, lp, cl, CFG, kv, bt, lens,
+                              tiles=(1, 1, 1))
+    auto = fused_layer_decode(x, lp, cl, CFG, kv, bt, lens)
+    for a, b in zip(base, auto):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for tiles in [(2, 2, 2), (3, 1, 4)]:
+        got = fused_layer_decode(x, lp, cl, CFG, kv, bt, lens,
+                                 tiles=tiles)
+        for a, b in zip(base, got):
+            np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                       atol=1e-5)
+
+
 def test_fused_layer_single_block_table():
     """nb == 1 edge: the j==0 grid step is also the last — init, QKV,
     block attend and the current-token fold all land in one step."""
@@ -164,10 +276,10 @@ def test_engine_streams_equal_megakernel_on_off(sampling):
 
 @pytest.mark.parametrize("kv_quant", ["int8", "int4"])
 def test_engine_streams_equal_with_speculation_and_quant_kv(kv_quant):
-    """The fused decode program composes with the speculative verify
-    program (which stays on the unfused q=k+1 path) and the quantized
-    caches: streams stay equal to the fully-unfused engine for int8 AND
-    the nibble-packed int4 pools."""
+    """The fused decode program composes with the FUSED speculative
+    verify program (megakernel='on' now drives both jit sites) and the
+    quantized caches: streams stay equal to the fully-unfused engine for
+    int8 AND the nibble-packed int4 pools."""
     outs = {}
     for mode in ("on", "off"):
         eng = _engine(mode, spec_k=2, kv_quant=kv_quant)
@@ -192,8 +304,13 @@ def test_engine_compile_gate_holds_with_megakernel():
 
 
 def test_megakernel_gating_and_validation():
-    """auto falls back off-TPU; unsupported shapes refuse 'on' loudly;
-    the VMEM budget gates honestly (GPT-2-124M-class layers do NOT fit)."""
+    """auto falls back off-TPU; unsupported shapes refuse 'on' loudly
+    WITH the reason; the VMEM gate is now a tile-budget computation —
+    GPT-2-124M-class layers (whose full weight set is over budget) gate
+    ON because their weight TILES fit, and only never-fits shapes
+    refuse, reporting the measured bytes."""
+    from apex_tpu.ops._pallas_util import force_compiled
+
     kv = KVCacheConfig(num_layers=2, num_heads=4, head_dim=8,
                        num_blocks=8, block_size=8, dtype=jnp.float32)
     assert megakernel_ok(CFG, kv)
@@ -206,24 +323,188 @@ def test_megakernel_gating_and_validation():
     moe = GPTConfig(vocab_size=97, max_seq=64, hidden=32, num_layers=2,
                     num_heads=4, num_experts=2, dtype=jnp.float32)
     assert not megakernel_ok(moe, kv)
-    # head_dim % 8 gate
+    assert "dense FFN" in megakernel_refusal(moe, kv)
+    # head_dim % 8 gate — and 'on' surfaces the reason in the raise
     odd = GPTConfig(vocab_size=97, max_seq=64, hidden=36, num_layers=2,
                     num_heads=4, dtype=jnp.float32)
     kv9 = KVCacheConfig(num_layers=2, num_heads=4, head_dim=9,
                         num_blocks=8, block_size=8, dtype=jnp.float32)
     assert not megakernel_ok(odd, kv9)
-    # VMEM budget: a 124M-shaped layer (768 hidden, 3072 ffn) in fp32 is
-    # ~28 MB of weights — over budget, honestly gated off
+    with pytest.raises(ValueError, match="megakernel='on'.*head_dim"):
+        InferenceEngine(init_gpt_params(jax.random.PRNGKey(0), odd), odd,
+                        ServeConfig(num_slots=1, block_size=8,
+                                    megakernel="on"))
+    # THE LIFTED GATE: a 124M-shaped layer (768 hidden, 3072 ffn) in
+    # fp32 is ~28 MB of weights — over the old full-residency budget —
+    # but its streamed tile set fits, so it now gates ON
     big = GPTConfig(vocab_size=128, max_seq=64, hidden=768, num_layers=2,
                     num_heads=12, dtype=jnp.float32)
     kv_big = KVCacheConfig(num_layers=2, num_heads=12, head_dim=64,
                            num_blocks=8, block_size=8, dtype=jnp.float32)
     assert layer_weight_bytes(big) > 10 * 1024 * 1024
-    assert not megakernel_ok(big, kv_big)
-    with pytest.raises(ValueError, match="megakernel='on'"):
-        InferenceEngine(init_gpt_params(jax.random.PRNGKey(0), big), big,
-                        ServeConfig(num_slots=1, block_size=8,
-                                    megakernel="on"))
+    assert megakernel_ok(big, kv_big)
+    tiles = default_tiles(big, kv_big, compiled=False)
+    assert tiles is not None and tiles != (1, 1, 1)
+    assert fused_live_bytes(big, kv_big, tiles) <= 10 * 1024 * 1024
+    # the GPT-2-124M flagship serve shape (bf16, lane-aligned tiles on
+    # a compiled backend) gates ON too — the acceptance criterion
+    flag = GPTConfig(vocab_size=50304, max_seq=1024, hidden=768,
+                     num_layers=12, num_heads=12, dtype=jnp.bfloat16)
+    kv_flag = KVCacheConfig(num_layers=12, num_heads=12, head_dim=64,
+                            num_blocks=64, block_size=16,
+                            dtype=jnp.bfloat16)
+    assert layer_weight_bytes(flag) > 10 * 1024 * 1024
+    with force_compiled():
+        assert megakernel_ok(flag, kv_flag)
+        assert megakernel_ok(flag, kv_flag, q=5)  # spec_k=4 verify fits
+        # never-fits: even the finest lane-aligned tiling of an 8192-
+        # hidden fp32 layer keeps >10 MB live; the refusal reports the
+        # MEASURED bytes, not a bare no
+        huge = GPTConfig(vocab_size=128, max_seq=64, hidden=8192,
+                         num_layers=1, num_heads=64, dtype=jnp.float32)
+        kv_huge = KVCacheConfig(num_layers=1, num_heads=64, head_dim=128,
+                                num_blocks=8, block_size=8,
+                                dtype=jnp.float32)
+        refusal = megakernel_refusal(huge, kv_huge)
+        assert refusal is not None and "VMEM" in refusal
+        assert str(layer_weight_bytes(huge)) in refusal
+        assert "finest weight tiling" in refusal
+
+
+def test_engine_streams_equal_at_124m_shaped_config():
+    """ACCEPTANCE: a GPT-2-124M-shaped config (768 hidden, fp32 — the
+    shape the old full-residency gate refused) now serves with
+    megakernel='on' + spec_k, and its streams equal both the unfused
+    speculative engine AND the no-speculation reference. An oracle
+    drafter (replays the reference continuation) guarantees the FUSED
+    verify program actually runs."""
+    big = GPTConfig(vocab_size=256, max_seq=64, hidden=768, num_layers=1,
+                    num_heads=12, dtype=jnp.float32, fused_loss=False)
+    assert layer_weight_bytes(big) > 10 * 1024 * 1024  # previously OFF
+    params = init_gpt_params(jax.random.PRNGKey(1), big)
+    reqs = [Request("a", [5, 6, 7, 8], max_new_tokens=4),
+            Request("b", [9, 10, 11], max_new_tokens=3)]
+    base = InferenceEngine(params, big, ServeConfig(
+        num_slots=2, block_size=8, prefill_chunk=8, megakernel="off"))
+    ref = base.run([Request(r.uid, r.tokens, r.max_new_tokens)
+                    for r in reqs])
+    conts = [list(r.tokens) + ref[r.uid] for r in reqs]
+    outs, stats = {}, {}
+    for mode in ("on", "off"):
+        scfg = ServeConfig(num_slots=2, block_size=8, prefill_chunk=8,
+                           megakernel=mode, spec_k=2)
+        eng = InferenceEngine(params, big, scfg,
+                              drafter=_OracleDrafter(conts))
+        assert eng.megakernel_enabled == (mode == "on")
+        outs[mode] = eng.run([Request(r.uid, r.tokens, r.max_new_tokens)
+                              for r in reqs])
+        stats[mode] = eng.stats()
+    assert outs["on"] == outs["off"] == ref
+    assert stats["on"]["decode_kernel"] == "fused"
+    assert stats["on"]["verify_kernel"] == "fused"
+    assert stats["on"]["speculative"]["verify_steps"] > 0
+    assert stats["on"]["spec_acceptance_rate"] == 1.0
+
+
+class _OracleDrafter:
+    """Proposes exactly the continuation a reference run produced —
+    every draft matches, so acceptance must be 1.0 and every speculative
+    step emits k+1 tokens."""
+
+    def __init__(self, continuations):
+        self._conts = continuations  # full prompt+generated token lists
+
+    def propose(self, tokens, k):
+        t = list(tokens)
+        for full in self._conts:
+            if len(full) >= len(t) and full[:len(t)] == t:
+                return full[len(t):len(t) + k]
+        return []
+
+
+@pytest.mark.parametrize("sampling", [
+    SamplingConfig(),
+    SamplingConfig(temperature=0.8, top_k=20),
+])
+def test_oracle_drafter_full_acceptance_on_fused_verify(sampling):
+    """ACCEPTANCE: with an oracle drafter (proposes the recorded
+    baseline continuation) the fused verify path accepts EVERY draft —
+    acceptance_rate == 1.0 greedy AND sampled — and the streams stay
+    equal to the unfused no-speculation baseline. Sampling draws are
+    position-keyed, so the verify step's parallel draws equal the
+    sequential ones."""
+    base = _engine("off", sampling=sampling)
+    ref = base.run([Request(r.uid, r.tokens, r.max_new_tokens)
+                    for r in REQS])
+    conts = [list(r.tokens) + ref[r.uid] for r in REQS]
+    scfg = ServeConfig(num_slots=3, block_size=8, prefill_chunk=8,
+                       megakernel="on", spec_k=2, sampling=sampling)
+    eng = InferenceEngine(PARAMS, CFG, scfg,
+                          drafter=_OracleDrafter(conts))
+    outs = eng.run([Request(r.uid, r.tokens, r.max_new_tokens)
+                    for r in REQS])
+    assert outs == ref
+    st = eng.stats()
+    assert st["speculative"]["proposed"] > 0
+    assert st["spec_acceptance_rate"] == 1.0
+    assert st["verify_kernel"] == "fused"
+
+
+def test_verify_kernel_field_reports_actual_path():
+    """stats()/record field ``verify_kernel``: None without a verify
+    program (spec_k == 0), 'fused' when the megakernel drives the verify
+    jit site, 'reference'/'pallas' mirroring decode_kernel otherwise —
+    the verify A/B gate's fallback-vs-regression discriminator."""
+    from apex_tpu.ops._pallas_util import force_compiled
+
+    assert _engine("on").verify_kernel is None  # no verify program
+    eng_on = _engine("on", spec_k=2)
+    assert eng_on.verify_kernel == "fused"
+    assert eng_on.stats()["verify_kernel"] == "fused"
+    eng_off = _engine("off", spec_k=2)
+    assert eng_off.verify_kernel == "reference"  # CPU: no compiled Mosaic
+    with force_compiled():
+        assert eng_off.verify_kernel == "pallas"
+
+
+def test_megakernel_auto_fallback_warns_once_with_reason():
+    """megakernel='auto' falling back on a COMPILED backend logs ONE
+    warning per reason, carrying the reason text (here: LoRA adapters
+    ride the per-op path) — a slower serve run must be diagnosable from
+    the log. The normal CPU auto fallback (no compiled Mosaic — nothing
+    to miss) stays silent."""
+    import logging
+
+    from apex_tpu.ops._pallas_util import force_compiled
+    from apex_tpu.serve.megakernel import _FALLBACK_WARNED
+
+    records = []
+
+    class Grab(logging.Handler):
+        def emit(self, record):
+            records.append(record)
+
+    logger = logging.getLogger("apex_tpu.serve")
+    handler = Grab(level=logging.WARNING)
+    logger.addHandler(handler)
+    try:
+        _FALLBACK_WARNED.clear()
+        with force_compiled():
+            for _ in range(2):  # second construction: no duplicate warn
+                eng = InferenceEngine(PARAMS, CFG, ServeConfig(
+                    num_slots=2, block_size=8, prefill_chunk=8,
+                    megakernel="auto", lora_rank=4, max_adapters=2))
+                assert eng.megakernel_enabled is False
+        warns = [r for r in records if "falling back" in r.getMessage()]
+        assert len(warns) == 1
+        assert "LoRA" in warns[0].getMessage()
+        # off-TPU auto-resolution (the normal CPU path) does not warn
+        records.clear()
+        _FALLBACK_WARNED.clear()
+        assert _engine("auto").megakernel_enabled is False
+        assert not [r for r in records if "falling back" in r.getMessage()]
+    finally:
+        logger.removeHandler(handler)
 
 
 # ---------------------------------------------------------------------------

@@ -309,6 +309,30 @@ def test_regress_flags_both_polarities_with_tolerance():
     assert [e["key"] for e in rep3["regressions"]] == ["weird"]
 
 
+def test_regress_classifies_verify_ab_fields():
+    """Polarity pins for the megakernel tier-2 verify A/B gate: the
+    fused-vs-unfused step latencies are lower-is-better (explicitly
+    listed next to the generic '_ms' rule), the speculative acceptance
+    rate is higher-is-better — a slower verify step or a collapsing
+    acceptance rate must flag, a faster/more-accepting record must not."""
+    from apex_tpu.monitor.regress import classify_metric
+
+    assert classify_metric("verify_step_ms_p50") == "lower"
+    assert classify_metric("decode_step_ms_p50") == "lower"
+    assert classify_metric("fused_on.verify_step_ms_p50") == "lower"
+    assert classify_metric("spec_acceptance_rate") == "higher"
+    assert classify_metric("decode_step_speedup_p50") == "higher"
+    base = {"verify_step_ms_p50": 2.0, "spec_acceptance_rate": 0.9}
+    bad = {"verify_step_ms_p50": 3.0, "spec_acceptance_rate": 0.5}
+    rep = compare_records(base, bad, tol=0.15)
+    assert not rep["ok"]
+    assert {e["key"] for e in rep["regressions"]} == {
+        "verify_step_ms_p50", "spec_acceptance_rate"}
+    good = {"verify_step_ms_p50": 1.5, "spec_acceptance_rate": 1.0}
+    rep2 = compare_records(base, good, tol=0.15)
+    assert rep2["ok"] and not rep2["regressions"]
+
+
 def test_regress_skips_embedded_histogram_dumps():
     """A fuller run's hist count/sum/min must never read as a latency
     regression: histogram dumps are excluded from the comparison."""

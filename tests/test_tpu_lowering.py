@@ -370,6 +370,85 @@ def test_fused_layer_decode_kernel_lowers_for_tpu(quantized):
         _lower_tpu(fn, x, lp, cl, bt, lens)
 
 
+def _mega_layer_fixture(quantized):
+    """Shared serving-sized layer for the tier-2 megakernel lowering
+    rows: 512 hidden bf16, lane-aligned weight tiles available."""
+    from apex_tpu.serve import KVCacheConfig, init_kv_cache
+    from apex_tpu.transformer.testing import GPTConfig
+
+    cfg = GPTConfig(vocab_size=512, max_seq=1024, hidden=512, num_layers=1,
+                    num_heads=8, dtype=jnp.bfloat16, fused_loss=False)
+    kv = KVCacheConfig(num_layers=1, num_heads=8, head_dim=64,
+                       num_blocks=16, block_size=128, dtype=jnp.bfloat16,
+                       quantized=quantized)
+    h, f3, hd = cfg.hidden, 3 * cfg.hidden, cfg.num_heads * cfg.head_dim
+    f = cfg.ffn_hidden
+    dt = jnp.bfloat16
+    lp = {
+        "ln1_w": jnp.ones((h,), dt), "ln1_b": jnp.zeros((h,), dt),
+        "qkv_kernel": jnp.zeros((h, f3), dt),
+        "qkv_bias": jnp.zeros((f3,), dt),
+        "out_kernel": jnp.zeros((hd, h), dt),
+        "out_bias": jnp.zeros((h,), dt),
+        "ln2_w": jnp.ones((h,), dt), "ln2_b": jnp.zeros((h,), dt),
+        "fc1_kernel": jnp.zeros((h, f), dt),
+        "fc1_bias": jnp.zeros((f,), dt),
+        "fc2_kernel": jnp.zeros((f, h), dt),
+        "fc2_bias": jnp.zeros((h,), dt),
+    }
+    cl = {k: v[0] for k, v in init_kv_cache(kv).items()}
+    return cfg, kv, lp, cl
+
+
+@pytest.mark.skipif(not _PALLAS_PARAMS_OK,
+                    reason="pltpu.CompilerParams needs graft-era pallas")
+@pytest.mark.parametrize("quantized", [False, True])
+def test_fused_layer_decode_tiled_kernel_lowers_for_tpu(quantized):
+    """AOT TPU lowering of the WEIGHT-STREAMING fused layer: multi-tile
+    BlockSpecs with phase-clamped index maps over the flattened grid
+    axis (qkv 3-way, out-proj 2-way, ffn 4-way column/row tiles), fp32
+    partial accumulation across fc2 row tiles — the tier-2 path that
+    lifts the VMEM residency gate past Mosaic's tiling rules."""
+    from apex_tpu.serve.megakernel import _check_tiles, fused_layer_decode
+
+    cfg, kv, lp, cl = _mega_layer_fixture(quantized)
+    tiles = (3, 2, 4)  # 1536/3, 512/2, 2048/4 — all lane-aligned
+    _check_tiles(cfg, tiles, True)
+    x = jnp.zeros((4, cfg.hidden), jnp.bfloat16)
+    bt = jnp.zeros((4, 4), jnp.int32)
+    lens = jnp.zeros((4,), jnp.int32)
+
+    def fn(x, lp, cl, bt, lens):
+        return fused_layer_decode(x, lp, cl, cfg, kv, bt, lens,
+                                  interpret=False, tiles=tiles)
+
+    with force_compiled():
+        _lower_tpu(fn, x, lp, cl, bt, lens)
+
+
+@pytest.mark.skipif(not _PALLAS_PARAMS_OK,
+                    reason="pltpu.CompilerParams needs graft-era pallas")
+@pytest.mark.parametrize("quantized", [False, True])
+def test_fused_layer_verify_kernel_lowers_for_tpu(quantized):
+    """AOT TPU lowering of the fused VERIFY layer (q_len = k+1 = 3 rows
+    per slot): the per-row-unrolled online softmax, the causal
+    within-window fold across fed rows and the per-row codec round-trip
+    emission all pass Mosaic's layout rules."""
+    from apex_tpu.serve.megakernel import fused_layer_verify
+
+    cfg, kv, lp, cl = _mega_layer_fixture(quantized)
+    x = jnp.zeros((4, 3, cfg.hidden), jnp.bfloat16)
+    bt = jnp.zeros((4, 4), jnp.int32)
+    start_ctx = jnp.zeros((4,), jnp.int32)
+
+    def fn(x, lp, cl, bt, start_ctx):
+        return fused_layer_verify(x, lp, cl, cfg, kv, bt, start_ctx,
+                                  interpret=False)
+
+    with force_compiled():
+        _lower_tpu(fn, x, lp, cl, bt, start_ctx)
+
+
 @pytest.mark.skipif(not _PALLAS_PARAMS_OK,
                     reason="pltpu.CompilerParams needs graft-era pallas")
 @pytest.mark.parametrize("with_norms", [False, True])
