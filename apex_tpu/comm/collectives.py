@@ -50,6 +50,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from apex_tpu.parallel.mesh import axis_size as _axis_size
 from apex_tpu.comm.quantize import (
     dequantize_blockwise,
     dequantize_blockwise_int4,
@@ -261,7 +262,7 @@ def _pass_seed(seed, axis: str, pass_idx: int):
 def _exchange_and_sum(flat_padded, axis: str, cfg: CompressionConfig, seed):
     """Pass 1+2: quantize + all_to_all + local fp32 sum -> (summed shard,
     local quantization error over the full padded buffer)."""
-    world = lax.axis_size(axis)
+    world = _axis_size(axis)
     n = flat_padded.size
     q, s = cfg.quantize(flat_padded, _pass_seed(seed, axis, 1))
     err = flat_padded - cfg.dequantize(q, s)
@@ -308,7 +309,7 @@ def compressed_allreduce(
     if config.stochastic_rounding and seed is None:
         raise ValueError("stochastic_rounding needs a per-step seed")
 
-    world = lax.axis_size(axis)
+    world = _axis_size(axis)
     comp = flat.astype(jnp.float32)
     if residual is not None:
         comp = comp + residual.astype(jnp.float32).reshape(-1)
@@ -360,7 +361,7 @@ def compressed_psum_scatter(
         raise ValueError(
             f"policy {config.policy!r} needs the residual carried in: "
             "init with error_feedback.init_error_feedback")
-    world = lax.axis_size(axis)
+    world = _axis_size(axis)
     n = flat.size
     k = -(-n // world)
     k = -(-k // shard_multiple) * shard_multiple

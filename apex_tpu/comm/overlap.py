@@ -94,6 +94,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from apex_tpu.parallel.mesh import DP_AXIS, TP_AXIS
+from apex_tpu.parallel.mesh import axis_size as _axis_size
 
 __all__ = [
     "all_gather_matmul",
@@ -193,7 +194,7 @@ def _gather_ring(x, axis_name: str, bidirectional: bool):
     in-flight hop — the decomposition's whole point. Unidirectional: one
     stream, ``W-1`` hops deep; bidirectional: two counter-rotating
     streams, ``⌈(W-1)/2⌉`` hops deep, same total bytes."""
-    world = lax.axis_size(axis_name)
+    world = _axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     if world == 1:
         yield x, idx
@@ -254,7 +255,7 @@ def _contract_leading(a, b):
 def _ag_matmul_impl(x, kernel, axis_name, gather_axis, bidirectional):
     """all_gather(x, gather_axis) @ kernel, as a ppermute ring of partial
     GEMMs landing in the output slices."""
-    world = lax.axis_size(axis_name)
+    world = _axis_size(axis_name)
     s_loc = x.shape[gather_axis]
     if world == 1:
         return jnp.dot(x, kernel)
@@ -274,7 +275,7 @@ def _matmul_rs_impl(x, kernel, axis_name, scatter_axis):
     every rank once collecting its partial GEMM, and arrives home after
     ``W-1`` hops — each hop independent of the partial GEMM the receiving
     rank computes next."""
-    world = lax.axis_size(axis_name)
+    world = _axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     s = x.shape[scatter_axis]
     if s % world:
@@ -300,7 +301,7 @@ def _ring_broadcast(shard, axis_name, gather_axis):
     """all_gather as a ppermute ring (the broadcast leg of
     matmul_all_reduce): every hop's payload is placed as it arrives, so
     trailing consumers of early slices can start before the ring drains."""
-    world = lax.axis_size(axis_name)
+    world = _axis_size(axis_name)
     if world == 1:
         return shard
     s_loc = shard.shape[gather_axis]
@@ -378,7 +379,7 @@ def _mm_rs_bwd(axis_name, scatter_axis, res, dy):
     # ONE ring over the cotangent shard computes both grads per hop:
     # dX slice = dy_src @ Wᵀ placed at src, dW += x[src]ᵀ dy_src — two
     # independent GEMMs behind every in-flight hop
-    world = lax.axis_size(axis_name)
+    world = _axis_size(axis_name)
     s_loc = dy.shape[scatter_axis]
     shape = list(dy.shape[:-1]) + [kernel.shape[0]]
     shape[scatter_axis] = s_loc * world
@@ -466,7 +467,7 @@ def _mm_pg_impl(x, w_shard, axis_name, bidirectional):
     """x @ all_gather(w_shard, axis=-1): ring-gather the weight shards,
     one partial GEMM per hop landing in the output COLUMN slice. Exact —
     the gathered dim is non-contracting, no reduction is reordered."""
-    world = lax.axis_size(axis_name)
+    world = _axis_size(axis_name)
     if world == 1:
         return jnp.dot(x, w_shard)
     n_loc = w_shard.shape[-1]
@@ -493,7 +494,7 @@ def _mm_pg_fwd(x, w_shard, axis_name, bidirectional):
 
 def _mm_pg_bwd(axis_name, bidirectional, res, dy):
     x, w_shard = res
-    world = lax.axis_size(axis_name)
+    world = _axis_size(axis_name)
     if world == 1:
         dx = jnp.dot(dy, w_shard.T).astype(x.dtype)
         dw = _contract_leading(x, dy).astype(w_shard.dtype)

@@ -24,6 +24,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from apex_tpu.parallel.mesh import axis_size as _axis_size
+
 Pytree = Any
 
 
@@ -106,7 +108,7 @@ def shard_size(n: int, world: int, multiple: int = 1) -> int:
 def scatter_leaf(x, axis_name: str, multiple: int = 1):
     """flatten + pad + reduce-scatter: (shape) -> (shard_size(n, world),),
     summed over the axis (the grad reduce-scatter)."""
-    world = lax.axis_size(axis_name)
+    world = _axis_size(axis_name)
     flat = x.reshape(-1)
     k = shard_size(flat.size, world, multiple)
     pad = k * world - flat.size
@@ -118,7 +120,7 @@ def scatter_leaf(x, axis_name: str, multiple: int = 1):
 def slice_leaf(x, axis_name: str, multiple: int = 1):
     """This rank's shard of a replicated leaf (no reduction): used to build
     the initial sharded master/moment state."""
-    world = lax.axis_size(axis_name)
+    world = _axis_size(axis_name)
     rank = lax.axis_index(axis_name)
     flat = x.reshape(-1)
     k = shard_size(flat.size, world, multiple)

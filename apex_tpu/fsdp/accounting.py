@@ -24,6 +24,12 @@ on the ladder:
     leaf, not the model).
 
 Activations are deliberately out of scope (unchanged by the ZeRO stage).
+
+``hbm_model_bytes`` / ``hbm_serve_bytes`` are the INFERENCE-mode siblings
+(``apex_tpu.serve.sharded``): params + KV cache, no grads or optimizer
+state — the terms a serving chip actually holds — modeled per residency
+strategy so a plan can prove which strategies fit a chip budget before
+any program compiles.
 """
 
 from __future__ import annotations
@@ -36,6 +42,9 @@ import numpy as np
 Pytree = Any
 
 STRATEGIES = ("ddp", "zero1", "fsdp")
+# inference residency strategies (serve.sharded): "single" is the
+# unsharded baseline the >1-chip-HBM headline is proven against
+SERVE_STRATEGIES = ("single", "tp", "pp", "fsdp")
 
 
 def _leaf_meta(tree: Pytree):
@@ -98,6 +107,71 @@ def hbm_params_bytes(params_or_meta: Pytree, *, strategy: str, world: int,
         "opt_state_bytes": opt,
         "gather_workspace_bytes": workspace,
         "total": params + grads + opt,
+    }
+
+
+def hbm_model_bytes(params_or_meta: Pytree) -> float:
+    """Unsharded model-dtype parameter bytes — the "does it fit one
+    chip" numerator of the serve-plan headline (``engine.stats()``
+    surfaces it as ``hbm_model_bytes``; a model is plan-worthy exactly
+    when this exceeds the chip's budget minus its KV pool)."""
+    return float(sum(n * isz for n, isz in _leaf_meta(params_or_meta)))
+
+
+def hbm_serve_bytes(params_or_meta: Pytree, *, strategy: str, world: int,
+                    kv_bytes: float = 0.0, num_layers: Optional[int] = None,
+                    shard_multiple: int = 1) -> Dict[str, float]:
+    """Modeled per-chip HBM for one SERVE residency strategy — params +
+    KV, NO grads or optimizer state (inference holds neither).
+
+    ``params_or_meta``: the full params pytree (or an ``FSDP.meta``
+    mirror). When it is a dict exposing the ``standalone_gpt`` structure
+    (a ``"layers"`` key), the stacked layer weights are modeled apart
+    from the embed/head leaves — ``pp`` and ``fsdp`` shard only the
+    layer stack (embed/head stay replicated: every stage embeds or
+    samples eventually, and the fsdp gather ring would pay the vocab
+    table's full gather every step). ``kv_bytes``: this chip's KV pool
+    bytes — pass the LOCAL pool (``kv_cache_bytes`` of the per-chip
+    config); the model adds it verbatim. ``num_layers``: layer count of
+    the stacked leaves — sizes the per-LAYER fsdp gather workspace
+    (omitted: the whole stacked leaf is assumed gathered at once).
+
+    Returns ``{"params_bytes", "kv_bytes", "gather_workspace_bytes",
+    "total"}``; ``total`` excludes the transient gather workspace (same
+    reporting convention as :func:`hbm_params_bytes`).
+    """
+    if strategy not in SERVE_STRATEGIES:
+        raise ValueError(
+            f"strategy must be one of {SERVE_STRATEGIES}, got {strategy!r}")
+    if isinstance(params_or_meta, dict) and "layers" in params_or_meta:
+        layer_leaves = _leaf_meta(params_or_meta["layers"])
+        other_leaves = _leaf_meta({k: v for k, v in params_or_meta.items()
+                                   if k != "layers"})
+    else:
+        layer_leaves = _leaf_meta(params_or_meta)
+        other_leaves = []
+    layers_total = sum(n * isz for n, isz in layer_leaves)
+    other_total = sum(n * isz for n, isz in other_leaves)
+    workspace = 0.0
+    if strategy == "single":
+        params = layers_total + other_total
+    elif strategy == "tp":
+        # every megatron dim sharded (embed/head vocab-sharded too);
+        # replicated LN/bias leaves are noise against the kernels
+        params = (layers_total + other_total) / world
+    elif strategy == "pp":
+        params = layers_total / world + other_total
+    else:  # fsdp
+        params = other_total
+        for n, isz in layer_leaves:
+            params += _shard_elems(n, world, shard_multiple) * isz
+            per_layer = n * isz / (num_layers or 1)
+            workspace = max(workspace, 2.0 * per_layer)
+    return {
+        "params_bytes": params,
+        "kv_bytes": float(kv_bytes),
+        "gather_workspace_bytes": workspace,
+        "total": params + float(kv_bytes),
     }
 
 

@@ -59,6 +59,7 @@ from apex_tpu.contrib.optimizers._sharding import (
     slice_leaf,
 )
 from apex_tpu.parallel.mesh import DP_AXIS
+from apex_tpu.parallel.mesh import axis_size as _axis_size
 
 Pytree = Any
 
@@ -260,7 +261,7 @@ class FSDP:
         if w.ndim != 2:
             raise ValueError(
                 f"shard_linear_weight needs a 2-D kernel, got {w.shape}")
-        world = lax.axis_size(self.axis_name)
+        world = _axis_size(self.axis_name)
         if w.shape[-1] % world:
             raise ValueError(
                 f"linear weight out dim {w.shape[-1]} not divisible by "

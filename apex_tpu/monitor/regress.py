@@ -153,7 +153,17 @@ _LOWER = ("_ms", "violation", "latency", "bubble", "exposed_bytes",
           # decode/verify step latencies (also caught by the generic
           # "_ms" rule; listed so the verify A/B gate's coverage is
           # explicit — these are the headline quantiles the stage banks)
-          "verify_step_ms", "decode_step_ms")
+          "verify_step_ms", "decode_step_ms",
+          # plan-sharded serving round (stage 24): per-layer weight
+          # gather latency and the PP stage-idle fraction (both also
+          # caught by the generic "_ms"/"bubble" rules; listed so the
+          # serve-plan gate's coverage is explicit), and the modeled
+          # model-residency bytes — a growing footprint for the same
+          # checkpoint means the residency accounting (or the plan's
+          # shard math) regressed; hbm_chip_bytes is the per-chip
+          # residency the budget headline compares against
+          "weight_gather_ms", "pp_bubble_fraction", "hbm_model_bytes",
+          "hbm_chip_bytes")
 
 
 def classify_metric(key: str,

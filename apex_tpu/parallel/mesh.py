@@ -31,6 +31,32 @@ TP_AXIS = "tp"
 AXIS_ORDER: Tuple[str, ...] = (DP_AXIS, PP_AXIS, SP_AXIS, TP_AXIS)
 
 
+def axis_size(axis_name, mesh: Optional[Mesh] = None) -> int:
+    """Size of a mesh axis.
+
+    Two calling conventions share this door:
+
+    * ``axis_size(name)`` — the bound size from inside a mesh program.
+      ``lax.axis_size`` on graft jax; on stock 0.4.37 that spelling does
+      not exist, so ``jax.core.axis_frame(name)`` reads the traced axis
+      env instead. Modules on the serve-plan path resolve the world size
+      through here so a ``ParallelismPlan``-sharded engine runs on either
+      toolchain (the same compatibility contract as the shard_map
+      ``check_vma``/``check_rep`` shim in ``serve.sharded``).
+    * ``axis_size(mesh, name)`` — static lookup outside any trace,
+      ``mesh.shape[name]``.
+    """
+    if isinstance(axis_name, Mesh):  # legacy (mesh, axis) argument order
+        return axis_name.shape[mesh]
+    if mesh is not None:
+        return mesh.shape[axis_name]
+    from jax import lax
+
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return jax.core.axis_frame(axis_name)
+
+
 def build_mesh(
     tp: int = 1,
     pp: int = 1,
@@ -127,10 +153,6 @@ def build_hybrid_mesh(
         dcn_mesh_shape=(num_slices, 1, 1, 1),
         devices=devices)
     return Mesh(dev_array, axis_names=AXIS_ORDER)
-
-
-def axis_size(mesh: Mesh, axis: str) -> int:
-    return mesh.shape[axis]
 
 
 def model_parallel_axes(mesh: Mesh) -> Tuple[str, ...]:

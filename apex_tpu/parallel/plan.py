@@ -44,6 +44,9 @@ from apex_tpu.parallel.mesh import AXIS_ORDER, DP_AXIS, build_mesh
 DATA_STRATEGIES = ("ddp", "zero1", "fsdp")
 PRESETS = ("ddp", "zero1", "fsdp", "fsdp+tp")
 OPTIMIZERS = ("adam", "lamb")
+# inference residency strategies (apex_tpu.serve.sharded): which term of
+# the plan carries the model when it does not fit one chip's HBM
+SERVE_STRATEGIES = ("tp", "pp", "fsdp")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -221,6 +224,102 @@ class ParallelismPlan:
             out["overlap_comm"] = self.overlap_comm
         return out
 
+    # -- serving (apex_tpu.serve.sharded) ----------------------------------
+    def serve_strategy(self) -> str:
+        """Which residency strategy carries the model at inference:
+        ``"tp"`` (head/vocab-sharded compute), ``"pp"`` (staged layer
+        shards streaming activations) or ``"fsdp"`` (resident weight
+        shards, gather-on-demand). Exactly ONE plan term may shard the
+        model — the serving tier has no composed-strategy programs yet —
+        and a plan that shards nothing is refused: the single-chip
+        engine needs no plan."""
+        sharded = []
+        if self.tp > 1:
+            sharded.append("tp")
+        if self.pp > 1:
+            sharded.append("pp")
+        if self.data == "fsdp":
+            sharded.append("fsdp")
+        if len(sharded) > 1:
+            raise NotImplementedError(
+                f"plan shards the model {len(sharded)} ways at once "
+                f"({'+'.join(sharded)}); serve.sharded composes ONE "
+                "residency strategy per engine — split tp/pp/fsdp into "
+                "separate plans (composed-strategy serving is future "
+                "work; 'fsdp+tp' is a TRAINING preset)")
+        if not sharded:
+            raise ValueError(
+                f"plan (data={self.data!r}, tp=1, pp=1) shards nothing "
+                "at inference — the model fits or it doesn't, and this "
+                "plan keeps it whole either way. Use the plain "
+                "InferenceEngine, or set tp=/pp= or data='fsdp'")
+        return sharded[0]
+
+    def serve_overrides(self) -> dict:
+        """The engine fields this plan pins at INFERENCE — the serving
+        mirror of :meth:`gpt_overrides` (``serve.sharded.build_engine``
+        splices them). Validates that the plan is inference-legal:
+        knobs that exist only to feed an optimizer step are refused
+        here, with the arithmetic, because serving would carry their
+        cost and never cash it in.
+        """
+        if self.e5m2_allgather:
+            # before the blanket zero1 refusal: the knob deserves its own
+            # arithmetic (construction already pins e5m2 to data='zero1')
+            raise ValueError(
+                "e5m2_allgather is the ZeRO-1 optimizer param-gather "
+                "transport (master shards -> model params, once per "
+                "step); inference gathers from no optimizer — the "
+                "serving analogue is weight_gather= on an fsdp plan")
+        if self.data == "zero1":
+            raise ValueError(
+                "data='zero1' shards OPTIMIZER state only — params and "
+                "grads stay replicated full-model, so a ZeRO-1 plan "
+                "serves nothing a single chip doesn't (inference runs "
+                "zero optimizer steps). Use tp=/pp= or data='fsdp'")
+        if self.compression is not None and self.compression.error_feedback:
+            raise ValueError(
+                f"compression policy {self.compression.policy!r} carries "
+                "an fp32 error-feedback residual (4 B/element — more HBM "
+                "than the int8 wire it compensates saves) that telescopes "
+                "into the NEXT optimizer step; inference runs none, so "
+                "the residual is dead weight. Use policy 'int8'/'int4' "
+                "or compression=None for serving plans")
+        strategy = self.serve_strategy()
+        out: dict = {"strategy": strategy,
+                     "overlap_comm": self.overlap_comm}
+        if strategy == "tp":
+            out["tp"] = self.tp
+        elif strategy == "pp":
+            out["pp"] = self.pp
+        else:
+            out["dp_axis"] = self.dp_axis
+            out["weight_gather"] = self.weight_gather
+        return out
+
+    def _serve_story(self) -> str:
+        """One line of residency story for :meth:`describe` — field-based
+        (never raises: a training-only plan still describes itself)."""
+        wgather = (self.weight_gather.policy if self.weight_gather
+                   else "model-dtype")
+        if self.tp > 1 and self.pp == 1 and self.data != "fsdp":
+            exits = ("overlapped rings" if self.overlap_comm
+                     else "monolithic psum")
+            return (f"TP — heads/vocab sharded {self.tp}-way, KV pools "
+                    f"hold local heads; q_len>1 row exits {exits}, "
+                    "q_len=1 monolithic")
+        if self.pp > 1 and self.tp == 1 and self.data != "fsdp":
+            return (f"PP — {self.pp} staged layer shards stream "
+                    "activations (credit-windowed microbatches); each "
+                    "stage owns its layers' KV pools")
+        if self.data == "fsdp" and self.tp == 1 and self.pp == 1:
+            return ("FSDP — block-aligned layer-weight shards resident, "
+                    f"gathered on demand per layer ({wgather} wire); "
+                    "embed/head + KV replicated")
+        if self.tp > 1 or self.pp > 1 or self.data == "fsdp":
+            return "composed model sharding — training-only (no serve tier)"
+        return "single-chip engine (model unsharded at inference)"
+
     # -- accounting / description ------------------------------------------
     def hbm_params_bytes(self, params_or_meta, world: int) -> dict:
         """Modeled per-chip param+grad+optimizer-state HBM of THIS plan's
@@ -232,6 +331,23 @@ class ParallelismPlan:
             params_or_meta, strategy=self.data, world=world,
             shard_multiple=shard_multiple_lcm(self.compression,
                                               self.weight_gather))
+
+    def hbm_serve_bytes(self, params_or_meta, world: int,
+                        kv_bytes: float = 0.0,
+                        num_layers: Optional[int] = None) -> dict:
+        """Modeled per-chip HBM of THIS plan's serve residency strategy —
+        params + KV cache, NO grads or optimizer state (the inference-mode
+        model in ``fsdp/accounting.py``). ``kv_bytes``: this chip's KV
+        pool bytes (``serve.kv_cache.kv_cache_bytes`` of the LOCAL
+        config). The headline proof: ``hbm_model_bytes`` of the unsharded
+        model vs a chip budget, then ``total`` of each strategy under it."""
+        from apex_tpu.contrib.optimizers._sharding import shard_multiple_lcm
+        from apex_tpu.fsdp.accounting import hbm_serve_bytes
+
+        return hbm_serve_bytes(
+            params_or_meta, strategy=self.serve_strategy(), world=world,
+            kv_bytes=kv_bytes, num_layers=num_layers,
+            shard_multiple=shard_multiple_lcm(None, self.weight_gather))
 
     def describe(self) -> str:
         """The resolved plan, printable — the examples' ``--plan`` echo."""
@@ -247,5 +363,6 @@ class ParallelismPlan:
             f"  overlap_comm={self.overlap_comm}"
             f" bidirectional={self.bidirectional}"
             f" fused_update={self.fused_update}",
+            f"  serve: {self._serve_story()}",
         ]
         return "\n".join(lines)

@@ -42,7 +42,18 @@ inference story is ``amp.initialize`` eval-mode half precision):
   SLO-aware router (TTFT feasibility, per-tenant WFQ, explicit ``shed``)
   → prefill workers → KV-block transfer (raw or int8 wire, modeled +
   measured byte accounting) → decode workers, with bitwise stream
-  parity against the single engine.
+  parity against the single engine;
+* :mod:`~apex_tpu.serve.sharded` — pod-scale model-parallel serving:
+  ``ServeConfig(plan=ParallelismPlan(...))`` +
+  :func:`~apex_tpu.serve.sharded.build_engine` serve a model too big
+  for one chip's HBM from a mesh slice under the SAME frozen plan that
+  configures the train step — TP serving (q_len>1 exits ride the
+  ``comm.overlap`` rings, proven from compiled HLO; q=1 decode stays
+  monolithic), PP-staged serving (activations stream between layer
+  shards, backpressure credits, ``pp_bubble_fraction``), and FSDP
+  weight residency (gather-on-demand per layer via the stateless
+  ``matmul_param_gather`` forward, int8 ``weight_gather`` codec) —
+  streams bitwise the single-chip engine, compile gate intact.
 """
 
 from apex_tpu.serve.adapters import (  # noqa: F401
@@ -99,6 +110,13 @@ from apex_tpu.serve.megakernel import (  # noqa: F401
     megakernel_ok,
     megakernel_refusal,
 )
+from apex_tpu.serve.sharded import (  # noqa: F401
+    PPStagedEngine,
+    build_engine,
+    plan_world,
+    program_hlo,
+    tp_transform,
+)
 from apex_tpu.serve.sampling import (  # noqa: F401
     SamplingConfig,
     request_key,
@@ -138,6 +156,11 @@ __all__ = [
     "transfer_wire_bytes",
     "adapter_pool_bytes",
     "Drafter",
+    "PPStagedEngine",
+    "build_engine",
+    "plan_world",
+    "program_hlo",
+    "tp_transform",
     "InferenceEngine",
     "KVCacheConfig",
     "NGramDrafter",

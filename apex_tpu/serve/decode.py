@@ -33,8 +33,13 @@ Two halves:
   ``cfg.overlap_comm`` (the decomposed ``comm.overlap`` rings) — and the
   vocab-sharded logits are all-gathered for sampling; with ``tp_axis=None``
   (single device, stock-jax serving) the same math runs as plain dots.
-  The decode step's TP exits stay monolithic by design: a small-q GEMM
-  has no flops to hide a ring behind.
+  The q_len=1 decode step's TP exits stay monolithic by design — a
+  single-row GEMM has no flops to hide a ring behind — while the
+  q_len>1 paths (speculative verify, chunked prefill) honor
+  ``cfg.overlap_comm`` exactly like the flash prefill: k+1 or
+  chunk-many rows give the ``comm.overlap.matmul_all_reduce`` ring
+  partial GEMMs to travel behind (``apex_tpu.serve.sharded`` is the
+  plan-driven engine builder that wires this up).
 
 Layers scan over the stacked layer params with the per-layer cache pools
 riding the scan's xs/ys — one compiled layer body regardless of depth,
@@ -55,6 +60,7 @@ from apex_tpu.ops._pallas_util import compiled_backend as _compiled_backend
 from apex_tpu.ops._pallas_util import sds as _sds
 from apex_tpu.ops.attention import NEG_INF, attention_reference, flash_attention
 from apex_tpu.ops.layer_norm import layer_norm
+from apex_tpu.parallel.mesh import axis_size as _axis_size
 from apex_tpu.serve.kv_cache import KVCacheConfig, gather_kv, paged_write
 
 try:
@@ -295,7 +301,7 @@ def paged_attention(q, cache_layer, cfg: KVCacheConfig, block_tables,
 def _tp_size(tp_axis: Optional[str]) -> int:
     if tp_axis is None:
         return 1
-    return lax.axis_size(tp_axis)
+    return _axis_size(tp_axis)
 
 
 def _col(x, kernel, bias, tp_axis: Optional[str]):
@@ -382,11 +388,16 @@ def ensure_dense_ffn(num_experts: int) -> None:
     if num_experts:
         raise NotImplementedError(
             "serve does not support MoE layers (num_experts > 0) yet — "
-            "the paged decode/prefill programs assume a dense FFN. "
-            "MoE serving is ROADMAP item 5a.")
+            "the paged decode/prefill programs assume a dense FFN, and "
+            "no ServeConfig.plan residency strategy (tp/pp/fsdp, "
+            "apex_tpu.serve.sharded) shards experts either: a plan moves "
+            "dense weights, it does not route tokens. One refusal for "
+            "both stacks; routed-expert serving is ROADMAP item 5a.")
 
 
-def _check_serve_cfg(cfg, kv_cfg: KVCacheConfig, tp_axis) -> None:
+def _check_stack_cfg(cfg, kv_cfg: KVCacheConfig, tp_axis) -> None:
+    """The layer-stack-local half of the serve config check (no layer
+    COUNT assertion — a PP stage's pools hold its own layer slice)."""
     ensure_dense_ffn(cfg.num_experts)
     heads_local = _serve_heads(cfg, tp_axis)
     if kv_cfg.num_heads != heads_local or kv_cfg.head_dim != cfg.head_dim:
@@ -394,6 +405,10 @@ def _check_serve_cfg(cfg, kv_cfg: KVCacheConfig, tp_axis) -> None:
             f"KVCacheConfig ({kv_cfg.num_heads} heads x {kv_cfg.head_dim}) "
             f"does not match the model's local layout ({heads_local} x "
             f"{cfg.head_dim})")
+
+
+def _check_serve_cfg(cfg, kv_cfg: KVCacheConfig, tp_axis) -> None:
+    _check_stack_cfg(cfg, kv_cfg, tp_axis)
     if kv_cfg.num_layers != cfg.num_layers:
         raise ValueError(
             f"KVCacheConfig.num_layers ({kv_cfg.num_layers}) != "
@@ -470,12 +485,115 @@ def gpt_prefill(params, tokens, prompt_len, cache, block_row,
 # oracle tests in tests/test_serve_prefix.py pin it.
 
 
+def paged_layer_stack(x, layers, start_lens, n_valid, active, cache,
+                      block_tables, cfg, kv_cfg: KVCacheConfig, *,
+                      tp_axis: Optional[str] = None,
+                      use_pallas: Optional[bool] = None,
+                      adapters: Optional[Pytree] = None,
+                      adapter_ids=None,
+                      gather_layer=None
+                      ) -> Tuple[jnp.ndarray, Pytree]:
+    """Scan embedded activations ``x`` (n, q, h) through a STACK of
+    transformer layers against their paged pools — the body of
+    :func:`gpt_paged_forward`, exposed so the PP-staged serving tier
+    (``serve.sharded``) can run layer SLICES: stage s streams the x'
+    this returns to stage s+1 instead of feeding the LM head, and each
+    stage's ``cache`` holds pools for ITS layers only (same block ids,
+    shared host allocator).
+
+    ``layers``: stacked (L, ...) layer params — or, with
+    ``gather_layer``, whatever per-layer pytree that hook turns into the
+    full layer dict. ``gather_layer`` is the FSDP weight-residency hook:
+    the scan's xs carry resident block-aligned SHARDS and each layer's
+    full weights materialize for exactly one body evaluation
+    (gather-on-demand; nothing is restacked, so the gathered copy dies
+    with the scan step). Returns ``(x', cache')``.
+    """
+    _check_stack_cfg(cfg, kv_cfg, tp_axis)
+    if adapters is not None:
+        if tp_axis is not None:
+            raise NotImplementedError(
+                "paged LoRA adapters are single-device for now — the pool "
+                "is not TP-sharded (pass tp_axis=None)")
+        if adapter_ids is None:
+            raise ValueError("adapters given without adapter_ids")
+        from apex_tpu.serve.adapters import lora_delta
+    heads_local = _serve_heads(cfg, tp_axis)
+    n, q = x.shape[:2]
+    offs = jnp.arange(q)
+    positions = start_lens[:, None] + offs[None, :]            # (n, q)
+    valid = active[:, None] & (offs[None, :] < n_valid[:, None])
+    ctx_lens = jnp.where(valid, positions + 1, 0)
+    # flat row views for the paged write/gather (each token is its own
+    # "slot" sharing its owner's block-table row)
+    bt_rows = jnp.repeat(block_tables, q, axis=0)   # (n*q, max_blocks)
+    pos_flat = positions.reshape(-1)
+    valid_flat = valid.reshape(-1)
+    # q_len>1 row exits honor cfg.overlap_comm: the decomposed ring
+    # scatters over the q dim, so it needs q divisible by the axis size;
+    # q=1 decode stays monolithic (the PR-5 pin — a single-row GEMM has
+    # nothing to hide a hop behind)
+    overlap = (tp_axis is not None and cfg.overlap_comm
+               and q > 1 and q % _tp_size(tp_axis) == 0)
+
+    def body(x, xs):
+        if adapters is None:
+            lp, cl = xs
+            ad = None
+        else:
+            lp, cl, ad = xs
+        if gather_layer is not None:
+            lp = gather_layer(lp)
+        h1 = layer_norm(x, lp["ln1_w"], lp["ln1_b"],
+                        use_pallas=cfg.ln_pallas)
+        qkv = _col(h1, lp["qkv_kernel"], lp["qkv_bias"], tp_axis)
+        if ad is not None:
+            qkv = qkv + lora_delta(h1, ad["qkv_a"], ad["qkv_b"],
+                                   adapter_ids)
+        qh, k, v = _split_qkv(qkv, heads_local, cfg.head_dim)  # (n,q,H,D)
+        k_flat = k.reshape(n * q, heads_local, cfg.head_dim)
+        v_flat = v.reshape(n * q, heads_local, cfg.head_dim)
+        cl = paged_write(cl, kv_cfg, k_flat.transpose(1, 0, 2),
+                         v_flat.transpose(1, 0, 2), bt_rows, pos_flat,
+                         valid_flat)
+        ctx = paged_attention(qh.reshape(n * q, heads_local, cfg.head_dim),
+                              cl, kv_cfg, bt_rows,
+                              ctx_lens.reshape(-1), use_pallas=use_pallas)
+        ctx = ctx.reshape(n, q, heads_local * cfg.head_dim)
+        a = _row(ctx, lp["out_kernel"], lp["out_bias"], tp_axis,
+                 overlap=overlap)
+        if ad is not None:
+            a = a + lora_delta(ctx, ad["out_a"], ad["out_b"], adapter_ids)
+        x = x + a
+        h2 = layer_norm(x, lp["ln2_w"], lp["ln2_b"],
+                        use_pallas=cfg.ln_pallas)
+        pre = _col(h2, lp["fc1_kernel"], lp["fc1_bias"], tp_axis)
+        if ad is not None:
+            pre = pre + lora_delta(h2, ad["fc1_a"], ad["fc1_b"],
+                                   adapter_ids)
+        y = jax.nn.gelu(pre, approximate=True)
+        m = _row(y, lp["fc2_kernel"], lp["fc2_bias"], tp_axis,
+                 overlap=overlap)
+        if ad is not None:
+            m = m + lora_delta(y, ad["fc2_a"], ad["fc2_b"], adapter_ids)
+        x = x + m
+        return x, cl
+
+    # the adapter pool rides the scan as read-only xs (sliced per layer,
+    # never restacked into ys — no per-step pool copy); the caller's jit
+    # site donates it and returns it untouched
+    xs = ((layers, cache) if adapters is None
+          else (layers, cache, adapters))
+    return lax.scan(body, x, xs)
+
+
 def gpt_paged_forward(params, tokens, start_lens, n_valid, active, cache,
                       block_tables, cfg, kv_cfg: KVCacheConfig,
                       tp_axis: Optional[str] = None,
                       use_pallas: Optional[bool] = None,
                       adapters: Optional[Pytree] = None,
-                      adapter_ids=None
+                      adapter_ids=None,
+                      gather_layer=None
                       ) -> Tuple[Pytree, jnp.ndarray]:
     """Process ``tokens`` (n, q) — per slot, q consecutive tokens starting
     at position ``start_lens[slot]`` — against the paged cache.
@@ -494,75 +612,22 @@ def gpt_paged_forward(params, tokens, start_lens, n_valid, active, cache,
     pool slot per batch row (id 0 = base = exact zero delta). Per-ROW
     like everything else here, so the same pool serves decode, verify
     and chunked prefill from one compiled program each.
+
+    ``gather_layer``: optional per-layer param materializer — see
+    :func:`paged_layer_stack` (``params["layers"]`` then carries FSDP
+    shard leaves instead of full stacked weights).
     """
     _check_serve_cfg(cfg, kv_cfg, tp_axis)
-    if adapters is not None:
-        if tp_axis is not None:
-            raise NotImplementedError(
-                "paged LoRA adapters are single-device for now — the pool "
-                "is not TP-sharded (pass tp_axis=None)")
-        if adapter_ids is None:
-            raise ValueError("adapters given without adapter_ids")
-        from apex_tpu.serve.adapters import lora_delta
-    heads_local = _serve_heads(cfg, tp_axis)
     n, q = tokens.shape
     offs = jnp.arange(q)
     positions = start_lens[:, None] + offs[None, :]            # (n, q)
-    valid = active[:, None] & (offs[None, :] < n_valid[:, None])
     positions_c = jnp.minimum(positions, cfg.max_seq - 1)
-    ctx_lens = jnp.where(valid, positions + 1, 0)
-    # flat row views for the paged write/gather (each token is its own
-    # "slot" sharing its owner's block-table row)
-    bt_rows = jnp.repeat(block_tables, q, axis=0)   # (n*q, max_blocks)
-    pos_flat = positions.reshape(-1)
-    valid_flat = valid.reshape(-1)
     x = _embed(params["embed"], tokens, positions_c, tp_axis)  # (n, q, h)
-
-    def body(x, xs):
-        if adapters is None:
-            lp, cl = xs
-            ad = None
-        else:
-            lp, cl, ad = xs
-        h1 = layer_norm(x, lp["ln1_w"], lp["ln1_b"],
-                        use_pallas=cfg.ln_pallas)
-        qkv = _col(h1, lp["qkv_kernel"], lp["qkv_bias"], tp_axis)
-        if ad is not None:
-            qkv = qkv + lora_delta(h1, ad["qkv_a"], ad["qkv_b"],
-                                   adapter_ids)
-        qh, k, v = _split_qkv(qkv, heads_local, cfg.head_dim)  # (n,q,H,D)
-        k_flat = k.reshape(n * q, heads_local, cfg.head_dim)
-        v_flat = v.reshape(n * q, heads_local, cfg.head_dim)
-        cl = paged_write(cl, kv_cfg, k_flat.transpose(1, 0, 2),
-                         v_flat.transpose(1, 0, 2), bt_rows, pos_flat,
-                         valid_flat)
-        ctx = paged_attention(qh.reshape(n * q, heads_local, cfg.head_dim),
-                              cl, kv_cfg, bt_rows,
-                              ctx_lens.reshape(-1), use_pallas=use_pallas)
-        ctx = ctx.reshape(n, q, heads_local * cfg.head_dim)
-        a = _row(ctx, lp["out_kernel"], lp["out_bias"], tp_axis)
-        if ad is not None:
-            a = a + lora_delta(ctx, ad["out_a"], ad["out_b"], adapter_ids)
-        x = x + a
-        h2 = layer_norm(x, lp["ln2_w"], lp["ln2_b"],
-                        use_pallas=cfg.ln_pallas)
-        pre = _col(h2, lp["fc1_kernel"], lp["fc1_bias"], tp_axis)
-        if ad is not None:
-            pre = pre + lora_delta(h2, ad["fc1_a"], ad["fc1_b"],
-                                   adapter_ids)
-        y = jax.nn.gelu(pre, approximate=True)
-        m = _row(y, lp["fc2_kernel"], lp["fc2_bias"], tp_axis)
-        if ad is not None:
-            m = m + lora_delta(y, ad["fc2_a"], ad["fc2_b"], adapter_ids)
-        x = x + m
-        return x, cl
-
-    # the adapter pool rides the scan as read-only xs (sliced per layer,
-    # never restacked into ys — no per-step pool copy); the caller's jit
-    # site donates it and returns it untouched
-    xs = ((params["layers"], cache) if adapters is None
-          else (params["layers"], cache, adapters))
-    x, cache = lax.scan(body, x, xs)
+    x, cache = paged_layer_stack(
+        x, params["layers"], start_lens, n_valid, active, cache,
+        block_tables, cfg, kv_cfg, tp_axis=tp_axis, use_pallas=use_pallas,
+        adapters=adapters, adapter_ids=adapter_ids,
+        gather_layer=gather_layer)
     return cache, serve_logits(params, x, cfg, tp_axis)
 
 
@@ -571,7 +636,8 @@ def gpt_decode_step(params, last_tokens, seq_lens, active, cache,
                     tp_axis: Optional[str] = None,
                     use_pallas: Optional[bool] = None,
                     adapters: Optional[Pytree] = None,
-                    adapter_ids=None
+                    adapter_ids=None,
+                    gather_layer=None
                     ) -> Tuple[Pytree, jnp.ndarray]:
     """Advance every active slot by one token (q=1 paged forward).
 
@@ -587,7 +653,8 @@ def gpt_decode_step(params, last_tokens, seq_lens, active, cache,
         params, last_tokens[:, None], seq_lens,
         jnp.ones((n,), jnp.int32), active, cache, block_tables, cfg,
         kv_cfg, tp_axis=tp_axis, use_pallas=use_pallas,
-        adapters=adapters, adapter_ids=adapter_ids)
+        adapters=adapters, adapter_ids=adapter_ids,
+        gather_layer=gather_layer)
     return cache, logits[:, 0]
 
 
@@ -596,7 +663,8 @@ def gpt_verify_step(params, fed_tokens, seq_lens, n_fed, active, cache,
                     tp_axis: Optional[str] = None,
                     use_pallas: Optional[bool] = None,
                     adapters: Optional[Pytree] = None,
-                    adapter_ids=None
+                    adapter_ids=None,
+                    gather_layer=None
                     ) -> Tuple[Pytree, jnp.ndarray]:
     """Speculative verify: feed ``fed_tokens`` (n, k+1) — each slot's last
     sampled token followed by up to k drafted tokens — in ONE paged call
@@ -616,7 +684,8 @@ def gpt_verify_step(params, fed_tokens, seq_lens, n_fed, active, cache,
     return gpt_paged_forward(params, fed_tokens, seq_lens, n_fed, active,
                              cache, block_tables, cfg, kv_cfg,
                              tp_axis=tp_axis, use_pallas=use_pallas,
-                             adapters=adapters, adapter_ids=adapter_ids)
+                             adapters=adapters, adapter_ids=adapter_ids,
+                             gather_layer=gather_layer)
 
 
 def gpt_prefill_chunk(params, tokens, start, n_valid, cache, block_row,
@@ -624,7 +693,8 @@ def gpt_prefill_chunk(params, tokens, start, n_valid, cache, block_row,
                       tp_axis: Optional[str] = None,
                       use_pallas: Optional[bool] = None,
                       adapters: Optional[Pytree] = None,
-                      adapter_id=None
+                      adapter_id=None,
+                      gather_layer=None
                       ) -> Tuple[Pytree, jnp.ndarray]:
     """Process one fixed-size chunk of ONE prompt into the cache.
 
@@ -650,6 +720,7 @@ def gpt_prefill_chunk(params, tokens, start, n_valid, cache, block_row,
         params, tokens[None, :], jnp.asarray(start)[None],
         jnp.asarray(n_valid)[None], jnp.ones((1,), bool), cache,
         block_row[None, :], cfg, kv_cfg, tp_axis=tp_axis,
-        use_pallas=use_pallas, adapters=adapters, adapter_ids=aids)
+        use_pallas=use_pallas, adapters=adapters, adapter_ids=aids,
+        gather_layer=gather_layer)
     last = jnp.take(logits[0], jnp.maximum(n_valid - 1, 0), axis=0)
     return cache, last

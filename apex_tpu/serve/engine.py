@@ -210,6 +210,12 @@ class ServeConfig:
     # base slot 0)
     lora_rank: int = 0
     max_adapters: int = 0
+    # model-parallel serving (apex_tpu.serve.sharded): a ParallelismPlan
+    # whose ONE sharding term (tp= / pp= / data='fsdp') picks the
+    # residency strategy — ``sharded.build_engine`` reads it; None keeps
+    # the single-chip engine. Validated inference-legal at validate()
+    # time via plan.serve_overrides() (optimizer-coupled knobs refused).
+    plan: Optional[Any] = None
     sampling: SamplingConfig = dataclasses.field(
         default_factory=SamplingConfig)
 
@@ -244,6 +250,20 @@ class ServeConfig:
             raise ValueError("lora_rank > 0 needs max_adapters >= 1")
         if self.max_adapters > 0 and self.lora_rank == 0:
             raise ValueError("max_adapters > 0 needs lora_rank > 0")
+        if self.plan is not None:
+            if not hasattr(self.plan, "serve_overrides"):
+                raise ValueError(
+                    f"plan must be a ParallelismPlan "
+                    f"(apex_tpu.parallel.plan), got {type(self.plan)!r}")
+            # runs the inference-legality validation eagerly: a plan that
+            # only makes sense feeding an optimizer dies here, not
+            # mid-build inside serve.sharded
+            self.plan.serve_overrides()
+            if self.lora_rank > 0:
+                raise NotImplementedError(
+                    "paged LoRA adapters are single-device for now — the "
+                    "AdapterPool is not plan-sharded (lora_rank needs "
+                    "plan=None)")
         self.sampling.validate()
 
 
@@ -331,6 +351,7 @@ class InferenceEngine:
         on_retire: Optional[Callable[[str, List[int]], None]] = None,
         chunk_tokens: int = 16,
         drafter: Optional[Drafter] = None,
+        gather_layer: Optional[Callable] = None,
         on_reject: Optional[Callable[[Request, Dict[str, Any]],
                                      None]] = None,
         meter: Optional[Meter] = None,
@@ -469,7 +490,17 @@ class InferenceEngine:
         self._decode_steps = 0
         self._n_params = sum(
             x.size for x in jax.tree_util.tree_leaves(params))
+        # model-parallel serving telemetry hook (serve.sharded sets it):
+        # a zero-arg callable returning the flat plan fields stats()
+        # merges — plan, hbm_model_bytes, weight_gather_ms,
+        # pp_bubble_fraction. None on single-chip engines (the fields
+        # are then absent, and monitor.regress skips what isn't there).
+        self.plan_stats: Optional[Callable[[], Dict[str, Any]]] = None
         wrap = transform if transform is not None else (lambda f: f)
+        # FSDP weight residency (serve.sharded): per-layer param
+        # materializer threaded into the paged forwards — params then
+        # carry resident shards, gathered for one layer body at a time
+        self._gather_layer = gather_layer
         self._use_pallas = use_pallas
         self._megakernel = self._resolve_megakernel()
         self._build_programs(wrap)
@@ -496,6 +527,9 @@ class InferenceEngine:
         q = self.serve_cfg.spec_k + 1
         if self._tp_axis is not None:
             reason = "TP-sharded programs ride the per-op layer body"
+        elif self._gather_layer is not None:
+            reason = ("plan-sharded (FSDP weight-resident) params ride "
+                      "the per-op layer body")
         elif self.serve_cfg.lora_rank > 0:
             reason = ("per-slot LoRA adapters (lora_rank > 0) ride the "
                       "per-op layer body")
@@ -582,7 +616,8 @@ class InferenceEngine:
                           key):
             cache, logits = gpt_prefill_chunk(
                 params, tokens, start, n_valid, cache, block_row, cfg,
-                kv_cfg, tp_axis=tp_axis, use_pallas=self._use_pallas)
+                kv_cfg, tp_axis=tp_axis, use_pallas=self._use_pallas,
+                gather_layer=self._gather_layer)
             # the draw for the token that will sit at position start+n_valid
             # — meaningful only on a prompt's FINAL chunk; junk otherwise
             tok = sample(logits[None], key[None],
@@ -603,7 +638,8 @@ class InferenceEngine:
                 cache, logits = gpt_decode_step(
                     params, last_tokens, seq_lens, active, cache,
                     block_tables, cfg, kv_cfg, tp_axis=tp_axis,
-                    use_pallas=self._use_pallas)
+                    use_pallas=self._use_pallas,
+                    gather_layer=self._gather_layer)
             toks = sample(logits, keys, seq_lens + 1, scfg.sampling)
             # in-graph step metrics: donation-safe, fixed treedef — the
             # monitor.Metrics contract (zero extra compilations)
@@ -625,7 +661,8 @@ class InferenceEngine:
                 cache, logits = gpt_verify_step(
                     params, fed_tokens, seq_lens, n_fed, active, cache,
                     block_tables, cfg, kv_cfg, tp_axis=tp_axis,
-                    use_pallas=self._use_pallas)
+                    use_pallas=self._use_pallas,
+                    gather_layer=self._gather_layer)
             k1 = fed_tokens.shape[1]
             draw_pos = seq_lens[:, None] + 1 + jnp.arange(k1)[None, :]
             toks = sample(logits, keys, draw_pos, scfg.sampling)
@@ -1624,6 +1661,13 @@ class InferenceEngine:
         # dotted keys; these are the two headline rates)
         out["prefix_hit_rate"] = out["prefix_cache"]["hit_rate"]
         out["spec_acceptance_rate"] = out["speculative"]["acceptance_rate"]
+        # model-parallel serving fields (serve.sharded engines only):
+        # plan (the residency story), hbm_model_bytes (unsharded "does
+        # it fit one chip" numerator), weight_gather_ms /
+        # pp_bubble_fraction (strategy-specific, lower-better under
+        # monitor.regress)
+        if self.plan_stats is not None:
+            out.update(self.plan_stats())
         out["hists"] = {k: v.to_dict() for k, v in self.hists.items()}
         if self._slo is not None:
             out["slo_report"] = self._slo.report()

@@ -44,6 +44,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from apex_tpu.parallel.mesh import TP_AXIS
+from apex_tpu.parallel.mesh import axis_size as _axis_size
 from apex_tpu.transformer.tensor_parallel.mappings import (
     copy_to_tensor_model_parallel_region,
     gather_from_sequence_parallel_region,
@@ -114,7 +115,7 @@ def vocab_parallel_embedding(ids, weight, axis_name: str = TP_AXIS,
     per_partition = weight.shape[0]
     rank = lax.axis_index(axis_name)
     start, end = VocabUtility.vocab_range_from_per_partition_vocab_size(
-        per_partition, rank, lax.axis_size(axis_name)
+        per_partition, rank, _axis_size(axis_name)
     )
     mask = (ids < start) | (ids >= end)
     local_ids = jnp.where(mask, 0, ids - start)

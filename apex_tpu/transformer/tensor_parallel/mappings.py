@@ -46,6 +46,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from apex_tpu.parallel.mesh import TP_AXIS
+from apex_tpu.parallel.mesh import axis_size as _axis_size
 from apex_tpu.transformer.tensor_parallel.utils import divide
 
 
@@ -66,7 +67,7 @@ def _pvary(x, axis_name: str):
 
 def _split(x, axis_name: str):
     """Keep this rank's slice of the last dim (ref mappings.py:36-52)."""
-    world = lax.axis_size(axis_name)
+    world = _axis_size(axis_name)
     chunk = divide(x.shape[-1], world)
     rank = lax.axis_index(axis_name)
     return lax.dynamic_slice_in_dim(x, rank * chunk, chunk, axis=x.ndim - 1)
@@ -167,7 +168,7 @@ def scatter_to_sequence_parallel_region(x, axis_name: str = TP_AXIS,
     recovers the FULL per-token cotangent. Use
     :func:`reduce_scatter_to_sequence_parallel_region` instead when the
     input still carries per-rank partial sums."""
-    world = lax.axis_size(axis_name)
+    world = _axis_size(axis_name)
     chunk = divide(x.shape[seq_axis], world)
     rank = lax.axis_index(axis_name)
     return lax.dynamic_slice_in_dim(x, rank * chunk, chunk, axis=seq_axis)

@@ -41,13 +41,28 @@ rate`` (higher-better), ``adapter_load_ms`` / ``adapter_evictions``
 (lower-better), and ``streams_equal``: the aid=0 cohort replayed
 through both fleets must match BITWISE or the record is ``ok: false``.
 
+``--plan {tp,pp,fsdp,all}`` swaps the cluster for the ISSUE-20
+**plan-sharded serving pass**: one ``ParallelismPlan``-driven engine
+(``apex_tpu.serve.sharded``) on a device slice, emitting the
+>1-chip-HBM headline — a model whose ``hbm_model_bytes`` EXCEEDS one
+simulated chip's budget (default: the midpoint of the plan-resident
+and single-chip totals; the record carries all three numbers) still
+serving the workload under the same SLO — next to the strategy's own
+accounting (``weight_gather_ms`` + modeled wire bytes for fsdp,
+``pp_bubble_fraction`` measured-vs-modeled for pp, the per-chip
+residency cut for tp) and a monolithic-oracle stream pin
+(``streams_equal`` — an undrained run or a stream mismatch makes the
+record ``ok: false``). ``--plan all`` drives every strategy and the
+flat gate fields take the worst case.
+
 Run: ``python benchmarks/bench_serve_mh.py [--hosts 2] [--wire-mode
 int8] [--out FILE]``. ``tpu_watch.sh`` stage 15 banks
 ``SERVE_MH_TPU.json`` from ``--hosts 2``, regression-gated via
 ``python -m apex_tpu.monitor.regress --tol 0.15``; CPU rehearsals carry
 ``_CPU_FALLBACK`` and never promote. Stage 18 banks
 ``SERVE_CHAOS_TPU.json`` from ``--hosts 3 --chaos``, stage 20 banks
-``SERVE_LORA_TPU.json`` from ``--lora``, both under the same promote
+``SERVE_LORA_TPU.json`` from ``--lora``, stage 24 banks
+``SERVE_PLAN_TPU.json`` from ``--plan all``, all under the same promote
 rules.
 """
 
@@ -72,6 +87,18 @@ def main(argv=None) -> int:
     pin_cpu_if_tunnel_dead()
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         pin_cpu_platform()
+
+    # the --plan pass shards a model over a device slice; a CPU rehearsal
+    # only has the virtual devices it asks for, and the flag must land
+    # before jax initializes the backend
+    argv_probe = sys.argv[1:] if argv is None else list(argv)
+    if (any(a == "--plan" or a.startswith("--plan=") for a in argv_probe)
+            and os.environ.get("JAX_PLATFORMS") == "cpu"
+            and "xla_force_host_platform_device_count"
+            not in os.environ.get("XLA_FLAGS", "")):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8")
 
     import jax
     import jax.numpy as jnp
@@ -143,6 +170,24 @@ def main(argv=None) -> int:
     ap.add_argument("--n-adapters", type=int, default=None,
                     help="distinct adapters ad0..ad{M-1} (default: one "
                          "per tenant)")
+    ap.add_argument("--plan", default=None,
+                    choices=["tp", "pp", "fsdp", "all"],
+                    help="plan-sharded serving pass (serve.sharded, "
+                         "ISSUE-20): ONE model-parallel engine on a "
+                         "device slice instead of the disaggregated "
+                         "cluster — emits the >1-chip-HBM headline "
+                         "(hbm_model_bytes vs a simulated per-chip "
+                         "budget), goodput under the same SLO, gather/"
+                         "bubble accounting and a monolithic-oracle "
+                         "stream pin")
+    ap.add_argument("--plan-world", type=int, default=None,
+                    help="chips the plan spans (default tp=4, pp=2, "
+                         "fsdp=8)")
+    ap.add_argument("--chip-hbm-mb", type=float, default=0.0,
+                    help="simulated per-chip HBM budget in MiB; 0 = the "
+                         "midpoint of the plan-resident and single-chip "
+                         "totals (the record carries all three numbers, "
+                         "so the arithmetic is inspectable)")
     args = ap.parse_args(argv)
 
     if args.hosts < 2:
@@ -185,6 +230,136 @@ def main(argv=None) -> int:
                        prefill_chunk=args.prefill_chunk,
                        spec_k=args.spec_k, megakernel=args.megakernel,
                        prefix_cache=False)
+    # -- plan-sharded serving pass (ISSUE-20, stage 24) -------------------
+    # ONE ParallelismPlan-driven engine on a device slice instead of the
+    # disaggregated cluster: the record's headline is residency — a model
+    # whose hbm_model_bytes EXCEEDS one simulated chip's budget still
+    # serving the workload under the same SLO — next to the strategy's
+    # own accounting (weight_gather_ms / pp_bubble_fraction) and a
+    # monolithic-oracle stream pin (transparency, not tolerance).
+    if args.plan:
+        import dataclasses as _dc
+
+        from apex_tpu.fsdp.accounting import hbm_serve_bytes
+        from apex_tpu.parallel import ParallelismPlan
+        from apex_tpu.serve import Request as _Req, build_engine
+        from apex_tpu.serve.kv_cache import kv_cache_bytes
+
+        name = "gpt_serve_plan_goodput"
+        if not on_tpu:
+            name += "_CPU_FALLBACK"
+        worlds = {"tp": 4, "pp": 2, "fsdp": 8}
+
+        oracle = InferenceEngine(params, cfg, scfg, retain_streams=False)
+        cohort = [_Req(f"eq{i}", list(r.tokens),
+                       max_new_tokens=min(r.max_new_tokens, 8),
+                       tenant=r.tenant)
+                  for i, (_, r) in enumerate(workload[:6])]
+        oracle_streams = oracle.run(cohort)
+        single_total = hbm_serve_bytes(
+            params, strategy="single", world=1,
+            kv_bytes=kv_cache_bytes(oracle.kv_cfg))["total"]
+
+        def plan_pass(strategy):
+            world = args.plan_world or worlds[strategy]
+            if world > jax.device_count():
+                return {"strategy": strategy, "ok": False,
+                        "reason": f"plan spans {world} chips, have "
+                                  f"{jax.device_count()}"}
+            plan = {"tp": lambda: ParallelismPlan(tp=world,
+                                                  overlap_comm=True),
+                    "pp": lambda: ParallelismPlan(pp=world),
+                    "fsdp": lambda: ParallelismPlan("fsdp", dp=world),
+                    }[strategy]()
+            eng = build_engine(params, cfg, _dc.replace(scfg, plan=plan),
+                               slo=slo, retain_streams=False)
+            pstats = run_workload(eng, workload)
+            pslo = pstats.get("slo_report", {})
+            # oracle stream pin AFTER the workload pass: the engine's
+            # completed counter is cumulative, and drained below reads
+            # the workload's own count
+            streams_equal = eng.run(
+                [_Req(r.uid, list(r.tokens),
+                      max_new_tokens=r.max_new_tokens, tenant=r.tenant)
+                 for r in cohort]) == oracle_streams
+            st = eng.stats()
+            chip_bytes = st["hbm_chip_bytes"]
+            budget = (args.chip_hbm_mb * 2 ** 20
+                      or (chip_bytes + single_total) / 2)
+            exceeds_single = single_total > budget
+            fits_plan = chip_bytes <= budget
+            drained = pstats.get("completed", 0) == len(workload)
+            sub = {
+                "strategy": strategy,
+                "plan_world": st["plan_world"],
+                "ok": bool(drained and streams_equal and exceeds_single
+                           and fits_plan),
+                "drained": drained,
+                "streams_equal": streams_equal,
+                "hbm_model_bytes": st["hbm_model_bytes"],
+                "hbm_chip_bytes": chip_bytes,
+                "chip_budget_bytes": round(budget),
+                "single_chip_total_bytes": single_total,
+                "exceeds_single_chip": exceeds_single,
+                "fits_plan_chip": fits_plan,
+                "hbm_cut_vs_single": round(single_total / chip_bytes, 4),
+                "goodput_rps": pslo.get("goodput_rps"),
+                "good_fraction": pslo.get("good_fraction"),
+                "violations": pslo.get("violations"),
+                "completed": pstats.get("completed"),
+                "tokens_per_s": pstats.get("tokens_per_s"),
+                **{k: pstats.get(k) for k in (
+                    "ttft_ms_p50", "ttft_ms_p99",
+                    "tpot_ms_p50", "tpot_ms_p99")},
+                "compilations": eng.compile_counts(),
+            }
+            for k in ("weight_gather_ms", "weight_gather_wire_bytes",
+                      "pp_bubble_fraction", "pp_bubble_fraction_modeled",
+                      "pp_microbatches", "pp_credit_waits"):
+                if k in st:
+                    sub[k] = st[k]
+            return sub
+
+        strategies = (["tp", "pp", "fsdp"] if args.plan == "all"
+                      else [args.plan])
+        passes = {s: plan_pass(s) for s in strategies}
+        rec = {
+            "metric": name,
+            "ok": all(p["ok"] for p in passes.values()),
+            "plan": args.plan,
+            "hbm_model_bytes": max(
+                (p["hbm_model_bytes"] for p in passes.values()
+                 if "hbm_model_bytes" in p), default=None),
+            "single_chip_total_bytes": single_total,
+            # worst driven strategy carries the flat gate fields: the
+            # budget headline must hold for EVERY plan, goodput for the
+            # slowest
+            "hbm_chip_bytes": max(
+                (p["hbm_chip_bytes"] for p in passes.values()
+                 if "hbm_chip_bytes" in p), default=None),
+            "goodput_rps": min(
+                (p["goodput_rps"] for p in passes.values()
+                 if p.get("goodput_rps") is not None), default=None),
+            "plans": passes,
+            "slo": slo.to_dict(),
+            "workload": {"mode": wcfg.mode, "n": wcfg.n_requests,
+                         "rate_rps": wcfg.rate_rps, "seed": wcfg.seed,
+                         "n_tenants": wcfg.n_tenants,
+                         "kv_quant": args.kv_quant,
+                         "spec_k": args.spec_k},
+            "backend": jax.default_backend(),
+        }
+        for s, key in (("fsdp", "weight_gather_ms"),
+                       ("pp", "pp_bubble_fraction")):
+            if s in passes and key in passes[s]:
+                rec[key] = passes[s][key]
+        line = json_record(**rec)
+        print(line, flush=True)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(line + "\n")
+        return 0
+
     tenant_w = {f"t{i}": w for i, w in enumerate(weights)}
     ccfg = ClusterConfig(
         n_prefill=n_prefill, n_decode=n_decode, serve=scfg,

@@ -40,8 +40,9 @@
 #  22 elastic train   bench_elastic.py      -> ELASTIC_TPU.json
 #  23 mega tier-2 A/B bench_serve.py --megakernel-ab --spec-k 4
 #                       --model flagship    -> DECODE_FUSED_T2_TPU.json
+#  24 serve plan      bench_serve_mh.py --plan all -> SERVE_PLAN_TPU.json
 # After the first seven, later healthy probes only refresh stage 1+3
-# (hourly) so the banked number tracks the latest code; stages 8-23
+# (hourly) so the banked number tracks the latest code; stages 8-24
 # ride the same hourly cadence until banked (additive evidence that must
 # never hold the suite out of refresh mode).
 #
@@ -74,6 +75,7 @@ last_lora=-3600     # stage-20 (per-tenant LoRA serve A/B) same
 last_attrib=-3600   # stage-21 (attribution + cost forensics A/B) same
 last_elastic=-3600  # stage-22 (elastic train: reshard + kill-resume) same
 last_megat2=-3600   # stage-23 (megakernel tier-2 flagship A/B) same
+last_serveplan=-3600 # stage-24 (plan-sharded serve residency) same
 
 note() { echo "$(date '+%F %T') $*" >> "$LOG"; }
 
@@ -867,6 +869,54 @@ $(cat /tmp/tpu_stage23_regress.out)"
   return 0
 }
 
+serveplan_stage() {
+  # stage 24: plan-sharded serving (apex_tpu.serve.sharded, ISSUE-20) —
+  # one ParallelismPlan-driven engine per residency strategy (tp / pp /
+  # fsdp) on the slice, goodput under the stage-10 SLO with the
+  # >1-chip-HBM headline: hbm_model_bytes exceeding the simulated
+  # per-chip budget while every strategy's resident bytes fit it. The
+  # record only counts if every driven strategy drained, matched the
+  # monolithic oracle's streams AND beat the budget (ok folds all of
+  # that); same promote rules as stages 10-23: CPU rehearsals (honest
+  # _CPU_FALLBACK suffix) never promote, ok:false never promotes,
+  # REGRESSION-GATED via monitor.regress --tol 0.15 once banked
+  # (weight_gather_ms / pp_bubble_fraction / hbm_model_bytes /
+  # hbm_chip_bytes lower-is-better, goodput_rps higher — the stage-24
+  # polarity entries); hourly even after banked so a residency or
+  # gather regression surfaces within an hour.
+  note "STAGE24 START: bench_serve_mh.py --plan all"
+  rm -f /tmp/serve_plan_try.json
+  timeout 1800 python benchmarks/bench_serve_mh.py --plan all \
+    --out /tmp/serve_plan_try.json \
+    > /tmp/tpu_stage24.out 2> /tmp/tpu_stage24.err
+  local rc=$?
+  note "STAGE24 EXIT=$rc"
+  [ -s /tmp/serve_plan_try.json ] || return 1
+  if grep -q CPU_FALLBACK /tmp/serve_plan_try.json; then
+    note "STAGE24 got CPU_FALLBACK, not promoting"
+    return 1
+  fi
+  if grep -Eq '"(streams_equal|ok)": false' /tmp/serve_plan_try.json; then
+    note "STAGE24 record has ok/streams_equal false, not promoting"
+    return 1
+  fi
+  if [ -s SERVE_PLAN_TPU.json ]; then
+    if ! python -m apex_tpu.monitor.regress SERVE_PLAN_TPU.json \
+        /tmp/serve_plan_try.json --tol 0.15 \
+        > /tmp/tpu_stage24_regress.out 2>> /tmp/tpu_stage24.err; then
+      note "STAGE24 REGRESSION vs banked, keeping banked record: \
+$(cat /tmp/tpu_stage24_regress.out)"
+      return 1
+    fi
+  fi
+  cp /tmp/serve_plan_try.json SERVE_PLAN_TPU.json
+  note "STAGE24 PROMOTED $(cat SERVE_PLAN_TPU.json)"
+  trend_bank serve_plan SERVE_PLAN_TPU.json
+  [ $rc -eq 0 ] || return 1
+  [ "$(cat "$STATE")" -eq 23 ] && echo 24 > "$STATE"
+  return 0
+}
+
 smoke_stage() {
   # Smoke to a temp file; promote ANY real-TPU artifact (a failing kernel
   # on the chip is exactly the evidence we must bank) but never a CPU
@@ -1020,6 +1070,13 @@ while true; do
           megat2_stage
           last_megat2=$now
         fi
+        # stage 24 (plan-sharded serve residency): same contract — a
+        # strategy that stopped fitting the chip budget, a gather/bubble
+        # regression or a stream divergence must surface within an hour
+        if [ $((now - last_serveplan)) -ge 3600 ]; then
+          serveplan_stage
+          last_serveplan=$now
+        fi
         last_refresh=$now
       fi
     else
@@ -1148,6 +1205,12 @@ while true; do
           && [ $((now - last_megat2)) -ge 3600 ]; then
         megat2_stage
         last_megat2=$now
+      fi
+      # stage 24: plan-sharded serve residency, same contract.
+      if [ "$(cat "$STATE")" -eq 23 ] \
+          && [ $((now - last_serveplan)) -ge 3600 ]; then
+        serveplan_stage
+        last_serveplan=$now
       fi
       last_refresh=$now
     fi
